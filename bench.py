@@ -1,0 +1,71 @@
+"""Headline benchmark: RS k=8 m=3 encode GB/s on one TPU chip.
+
+The driver runs this on real TPU hardware; it prints exactly ONE JSON
+line. Config matches BASELINE.md row 2: RS k=8, m=3, 4 MiB stripe,
+batched encode over 1024 objects (processed in device-sized sub-batches).
+`vs_baseline` is measured GB/s divided by the 40 GB/s/chip north-star
+target from BASELINE.json (no published reference number exists — see
+BASELINE.md; >1.0 means the target is beaten).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TARGET_GBPS = 40.0
+OBJECTS = 1024
+OBJECT_SIZE = 4 * 1024 * 1024  # 4 MiB stripe
+K, M = 8, 3
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from ceph_tpu.ec.matrices import reed_sol_van_matrix
+    from ceph_tpu.ops.rs_kernels import make_encoder
+
+    matrix = reed_sol_van_matrix(K, M)
+    chunk = OBJECT_SIZE // K  # 512 KiB, already 128-aligned
+
+    # Sub-batch sized to keep data + parity + headroom well inside 16 GB
+    # HBM; loop covers all 1024 objects per timed iteration.
+    sub = min(int(os.environ.get("BENCH_SUBBATCH", "128")), OBJECTS)
+    iters = max(1, OBJECTS // sub)
+    objects_done = sub * iters
+    rng = np.random.default_rng(0)
+    host = rng.integers(0, 256, size=(sub, K, chunk), dtype=np.uint8)
+    data = jax.device_put(host)
+
+    results = {}
+    impls = os.environ.get("BENCH_IMPLS", "bitlinear,mxu").split(",")
+    for impl in impls:
+        try:
+            fn = make_encoder(matrix, impl)
+            fn(data).block_until_ready()  # compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(data)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            results[impl] = sub * K * chunk * iters / dt / 1e9
+        except Exception as e:  # one impl failing shouldn't kill the bench
+            print(f"bench: impl {impl} failed: {e!r}", file=sys.stderr)
+    if not results:
+        raise SystemExit("all bench impls failed")
+    impl = max(results, key=results.get)
+    gbps = results[impl]
+    print(f"bench: {results} backend={jax.default_backend()}", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"rs_k{K}m{M}_encode_4MiB_x{objects_done}",
+        "value": round(gbps, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(gbps / TARGET_GBPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""ceph_tpu — a TPU-native erasure-coding / placement / integrity framework.
+
+From-scratch rebuild of the capabilities of the reference's storage hot
+paths (sashakot/ceph — see SURVEY.md): GF(2^8) Reed-Solomon / LRC / Clay
+erasure codes as batched XLA/Pallas kernels, vectorized CRUSH placement,
+crc32c/xxhash checksumming, and an ECBackend-style device-side recovery
+pipeline — designed TPU-first (jax/pjit/shard_map), not ported.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,197 @@
+"""The erasure-code contract, batched-array edition.
+
+Semantic mirror of the reference's plugin contract
+(ref: src/erasure-code/ErasureCodeInterface.h — init, chunk geometry,
+minimum_to_decode, encode/decode over shard-keyed buffers; and
+src/erasure-code/ErasureCode.{h,cc} for the default padding/split/concat
+behaviors), re-shaped for a TPU framework: the unit of work is a BATCH of
+objects, chunks are uint8 arrays of shape (batch, L), and the hot paths
+lower to the static-matrix kernels in ceph_tpu.ops.rs_kernels.
+
+A profile is a {str: str} dict exactly like ErasureCodeProfile, so
+reference profile strings (k=8 m=3 plugin=tpu technique=reed_sol_van)
+round-trip unchanged.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Mapping, Sequence
+
+import numpy as np
+
+# TPU lane width; also satisfies every CPU SIMD alignment the reference
+# cares about (jerasure wants chunks aligned to w*packetsize; BlueStore
+# to csum blocks). All chunk sizes are multiples of this.
+CHUNK_ALIGNMENT = 128
+
+ErasureCodeProfile = dict  # {str: str}
+
+
+class ErasureCode(abc.ABC):
+    """Base class: geometry + padding/split/concat defaults.
+
+    Subclasses set self.k, self.m after init() and implement the chunk
+    codecs. All byte-level layout rules (padding to stripe width, chunk
+    order) live here so every codec shares one bit-exact object<->chunk
+    mapping (ref: ErasureCode::encode prep + ECUtil stripe math).
+    """
+
+    k: int
+    m: int
+
+    def __init__(self, profile: Mapping[str, str] | None = None):
+        self.profile: ErasureCodeProfile = dict(profile or {})
+        if profile is not None:
+            self.init(self.profile)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def init(self, profile: Mapping[str, str]) -> None:
+        """Parse/validate the profile; set k, m; build matrices."""
+
+    # -- geometry ----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_coding_chunk_count(self) -> int:
+        return self.m
+
+    def get_chunk_mapping(self) -> list[int]:
+        """Shard-id permutation; identity unless a subclass remaps."""
+        return list(range(self.get_chunk_count()))
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        """Bytes per chunk for an object of `stripe_width` logical bytes,
+        padded so chunk_size is CHUNK_ALIGNMENT-aligned."""
+        align = self.k * CHUNK_ALIGNMENT
+        padded = -(-stripe_width // align) * align
+        return padded // self.k
+
+    # -- availability ------------------------------------------------------
+
+    def minimum_to_decode(self, want_to_read: Sequence[int],
+                          available: Sequence[int]) -> set[int]:
+        """Smallest chunk set from `available` able to produce `want_to_read`.
+
+        MDS default: any k available chunks (prefer wanted ones, then data
+        chunks — they're free to 'decode'). Locally-repairable codecs
+        override (LRC: the local group; Clay: sub-chunk ranges).
+        """
+        avail = set(available)
+        want = set(want_to_read)
+        n = self.get_chunk_count()
+        bad = [i for i in want | avail if not 0 <= i < n]
+        if bad:
+            raise ValueError(f"chunk ids must be in [0, {n}), got {sorted(bad)}")
+        if want - avail:
+            need = want & avail
+            rest = sorted(avail - want)
+            need.update(rest[:max(0, self.k - len(need))])
+            if len(need) < self.k:
+                raise ValueError(
+                    f"cannot decode {sorted(want)} from {sorted(avail)}: "
+                    f"only {len(avail)} chunks available, need {self.k}")
+            return need
+        return want
+
+    def minimum_to_decode_with_cost(self, want_to_read: Sequence[int],
+                                    available: Mapping[int, int]) -> set[int]:
+        """Like minimum_to_decode but with per-chunk read costs; default
+        picks the k cheapest (ref: ErasureCodeInterface minimum_to_decode_with_cost)."""
+        want = set(want_to_read)
+        avail = set(available)
+        n = self.get_chunk_count()
+        bad = [i for i in want | avail if not 0 <= i < n]
+        if bad:
+            raise ValueError(f"chunk ids must be in [0, {n}), got {sorted(bad)}")
+        if want - avail:
+            ordered = sorted(avail, key=lambda c: (available[c], c))
+            need = set(ordered[:self.k])
+            if len(need) < self.k:
+                raise ValueError("not enough chunks")
+            return need
+        return want
+
+    # -- byte-level encode/decode -----------------------------------------
+
+    def encode(self, want_to_encode: Sequence[int],
+               data: bytes | np.ndarray) -> dict[int, np.ndarray]:
+        """Full-object encode: pad to stripe width, split into k data
+        chunks, compute parity, return the requested chunk ids.
+
+        data: bytes or (object_bytes,) uint8, or (batch, object_bytes).
+        Returns {chunk_id: (batch, chunk_size) uint8} (batch dim kept).
+        """
+        arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+            data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
+        squeeze = arr.ndim == 1
+        if squeeze:
+            arr = arr[None, :]
+        b, n = arr.shape
+        cs = self.get_chunk_size(n)
+        padded = np.zeros((b, self.k * cs), dtype=np.uint8)
+        padded[:, :n] = arr
+        chunks = padded.reshape(b, self.k, cs)
+        coded = self.encode_chunks(chunks)  # (b, m, cs)
+        full = {i: chunks[:, i, :] for i in range(self.k)}
+        full.update({self.k + i: np.asarray(coded)[:, i, :] for i in range(self.m)})
+        out = {i: full[i] for i in want_to_encode}
+        if squeeze:
+            out = {i: v[0] for i, v in out.items()}
+        return out
+
+    @abc.abstractmethod
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        """(batch, k, L) data chunks -> (batch, m, L) coding chunks."""
+
+    def decode(self, want_to_read: Sequence[int],
+               chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
+        """Reconstruct `want_to_read` chunk ids from available `chunks`.
+
+        Systematic default (ref: ErasureCode::_decode): wanted chunks that
+        are already available pass through; the rest go to decode_chunks.
+        """
+        out: dict[int, np.ndarray] = {}
+        missing = []
+        for i in want_to_read:
+            if i in chunks:
+                out[i] = np.asarray(chunks[i])
+            else:
+                missing.append(i)
+        if missing:
+            out.update(self.decode_chunks(missing, chunks))
+        return out
+
+    @abc.abstractmethod
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
+        """Reconstruct the (erased) `want_to_read` ids from `chunks`."""
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray],
+                      object_size: int | None = None) -> np.ndarray:
+        """Recover and concatenate the data chunks (ref:
+        ErasureCodeInterface::decode_concat), trimming padding if
+        object_size is given."""
+        rec = self.decode(list(range(self.k)), chunks)
+        parts = [rec[i] for i in range(self.k)]
+        out = np.concatenate(parts, axis=-1)
+        if object_size is not None:
+            out = out[..., :object_size]
+        return out
+
+
+def profile_from_string(s: str) -> ErasureCodeProfile:
+    """Parse 'k=8 m=3 plugin=tpu technique=reed_sol_van' profile strings."""
+    out: ErasureCodeProfile = {}
+    for tok in s.split():
+        if "=" not in tok:
+            raise ValueError(f"bad profile token {tok!r}")
+        key, val = tok.split("=", 1)
+        out[key] = val
+    return out

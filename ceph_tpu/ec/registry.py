@@ -1,0 +1,62 @@
+"""Plugin registry — the Python face of ErasureCodePluginRegistry.
+
+The reference resolves plugins by dlopen("libec_<name>.so") and an
+__erasure_code_init entry point (ref: src/erasure-code/ErasureCodePlugin.cc
+ErasureCodePluginRegistry::{instance,load,factory,preload}). Here plugins
+are Python factories registered by name; the C++ shim in native/ gives
+out-of-process callers the same dlopen contract and forwards to this
+registry. Profiles stay string-maps so reference profiles work verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from .interface import ErasureCode, ErasureCodeProfile, profile_from_string
+
+_REGISTRY: dict[str, Callable[[Mapping[str, str]], ErasureCode]] = {}
+
+
+def register(name: str):
+    """Decorator: register an ErasureCode subclass (or factory) as a plugin."""
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def plugins() -> list[str]:
+    _ensure_loaded()
+    return sorted(_REGISTRY)
+
+
+def _ensure_loaded() -> None:
+    # "preload": import the bundled plugin modules so they self-register,
+    # mirroring ErasureCodePluginRegistry::preload's eager dlopen list.
+    from . import rs as _rs  # noqa: F401
+    for mod in ("lrc", "clay", "shec"):
+        name = f"{__package__}.{mod}"
+        try:
+            __import__(name)
+        except ModuleNotFoundError as e:
+            if e.name != name:  # plugin exists but is broken — surface it
+                raise
+
+
+def factory(profile: Mapping[str, str] | str) -> ErasureCode:
+    """Instantiate a coder from a profile (dict or profile string).
+
+    The plugin name comes from profile['plugin'] (default 'tpu_rs', our
+    jerasure-equivalent RS coder).
+    """
+    if isinstance(profile, str):
+        profile = profile_from_string(profile)
+    prof: ErasureCodeProfile = dict(profile)
+    name = prof.get("plugin", "tpu_rs")
+    _ensure_loaded()
+    try:
+        fac = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown EC plugin {name!r}; known: {sorted(_REGISTRY)}") from None
+    return fac(prof)

@@ -1,0 +1,119 @@
+"""Multi-chip sharding: the rebuild's distributed communication backend.
+
+The reference fans EC sub-ops out over OSDs through its AsyncMessenger
+(ref: src/msg/async/, ECBackend::handle_sub_write/_reply scatter/gather —
+SURVEY.md §2.5, §5 "Distributed communication backend"). TPU-native, that
+becomes a device mesh + XLA collectives over ICI:
+
+  axis "dp"    — data parallelism over the object batch (the reference's
+                 many-PGs-in-flight axis, P2 in SURVEY.md §2.7);
+  axis "shard" — shard placement: the k+m chunks of each stripe live on
+                 different devices, like chunks on different OSDs (P1/P3).
+
+Encode scatters parity shards across the "shard" axis (XLA inserts the
+scatter from the output sharding); degraded decode gathers surviving
+shards over ICI (XLA inserts the all-gather from the survivor indexing).
+No hand-written NCCL-style calls — shardings in, collectives out.
+
+Multi-host: the same Meshes span hosts via jax.distributed; ICI carries
+the "shard" axis within a pod, DCN carries "dp" across pods.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..gf.numpy_ref import decode_matrix
+from ..ops.rs_kernels import DEFAULT_IMPL, apply_matrix
+
+
+def default_mesh(devices=None, shard: int = 2) -> Mesh:
+    """(dp, shard) mesh over the given (default: all) devices.
+
+    `shard` devices hold disjoint subsets of each stripe's k+m chunks;
+    the rest of the devices form the batch-parallel axis.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    while shard > 1 and n % shard:
+        shard -= 1
+    return Mesh(devices.reshape(n // shard, shard), ("dp", "shard"))
+
+
+def chunk_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of a (batch, n_chunks, L) chunk tensor: batch over dp,
+    chunk slots over shard — each device is an 'OSD group' holding its
+    slice of every stripe."""
+    return NamedSharding(mesh, P("dp", "shard", None))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None, None))
+
+
+def padded_slots(n_chunks: int, mesh: Mesh) -> int:
+    """Chunk-slot count padded up to a multiple of the shard axis so the
+    slot axis divides evenly across devices (empty tail slots are zero —
+    the analog of unused placement slots, not of real shards)."""
+    s = mesh.devices.shape[mesh.axis_names.index("shard")]
+    return -(-n_chunks // s) * s
+
+
+def make_sharded_encoder(matrix: np.ndarray, mesh: Mesh,
+                         impl: str = DEFAULT_IMPL):
+    """Jitted step: (B, k, L) data -> (B, padded_slots(k+m), L) chunks,
+    output scattered over the shard axis (the TPU analog of
+    MOSDECSubOpWrite fan-out). Slots >= k+m are zero padding."""
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    n = matrix.shape[0] + matrix.shape[1]
+    pad = padded_slots(n, mesh) - n
+
+    def step(data):
+        parity = apply_matrix(matrix, data, impl=impl)
+        chunks = jnp.concatenate([data, parity], axis=1)
+        if pad:
+            chunks = jnp.pad(chunks, ((0, 0), (0, pad), (0, 0)))
+        return chunks
+
+    return jax.jit(step, in_shardings=data_sharding(mesh),
+                   out_shardings=chunk_sharding(mesh))
+
+
+def make_sharded_decoder(matrix: np.ndarray, erasures: tuple[int, ...],
+                         survivors: tuple[int, ...], mesh: Mesh,
+                         impl: str = DEFAULT_IMPL):
+    """Jitted step: sharded (B, n, L) chunks -> (B, E, L) reconstructed.
+
+    Indexing the survivor shard slots forces an ICI all-gather of exactly
+    the helper chunks (the TPU analog of MOSDECSubOpRead gather), then the
+    static decode matrix runs batched on every dp slice.
+    """
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    k = matrix.shape[1]
+    D = decode_matrix(matrix, list(erasures), k, list(survivors))
+    surv = np.asarray(survivors, dtype=np.int32)
+
+    def step(chunks):
+        stack = chunks[:, surv, :]
+        return apply_matrix(D, stack, impl=impl)
+
+    return jax.jit(step, in_shardings=chunk_sharding(mesh),
+                   out_shardings=data_sharding(mesh))
+
+
+@functools.lru_cache(maxsize=8)
+def _cpu_mesh_devices(n: int):
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devs)}")
+    return tuple(devs[:n])
+
+
+def virtual_mesh(n_devices: int, shard: int = 2) -> Mesh:
+    """Mesh over the first n devices (virtual CPU devices in tests)."""
+    return default_mesh(np.asarray(_cpu_mesh_devices(n_devices)), shard)

@@ -1,0 +1,26 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's tier-2 trick of testing multi-node behavior with
+many daemons on one box (ref: qa/standalone/ceph-helpers.sh): here,
+multi-chip sharding is exercised with 8 virtual CPU devices. Must run
+before jax is imported anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's TPU-tunnel site hook (sitecustomize -> axon.register)
+# force-selects its backend via jax.config at interpreter start, overriding
+# JAX_PLATFORMS from the env; a later config.update wins, keeping the test
+# suite hermetic on the virtual 8-device CPU mesh even if the tunnel is down.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""GF(2^8) arithmetic core tests.
+
+Field-axiom and known-value tests for the table layer (the rebuild's
+equivalent of gf-complete's gf_unit; ref: jerasure/gf-complete test
+strategy in SURVEY.md §4 tier 1).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import tables as T
+from ceph_tpu.gf import numpy_ref as R
+
+
+def test_known_values_poly_0x11d():
+    # alpha = 2; 2*128 = 0x100 -> reduced by 0x11D -> 0x1D
+    assert T.gf_mul_scalar(2, 128) == 0x1D
+    assert T.gf_mul_scalar(0, 77) == 0
+    assert T.gf_mul_scalar(1, 77) == 77
+    # exp table starts 1, 2, 4, ..., 128, 0x1D
+    assert list(T.GF_EXP[:9]) == [1, 2, 4, 8, 16, 32, 64, 128, 0x1D]
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert T.GF_EXP[T.GF_LOG[a]] == a
+
+
+def test_mul_table_matches_scalar():
+    mt = T.mul_table()
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert mt[a, b] == T.gf_mul_scalar(a, b)
+
+
+def test_field_axioms_sampled():
+    mt = T.mul_table()
+    rng = np.random.default_rng(1)
+    abc = rng.integers(0, 256, size=(100, 3))
+    for a, b, c in abc:
+        # commutativity, associativity, distributivity over XOR
+        assert mt[a, b] == mt[b, a]
+        assert mt[a, mt[b, c]] == mt[mt[a, b], c]
+        assert mt[a, b ^ c] == mt[a, b] ^ mt[a, c]
+
+
+def test_inverse():
+    inv = T.inv_table()
+    mt = T.mul_table()
+    for a in range(1, 256):
+        assert mt[a, inv[a]] == 1
+    with pytest.raises(ZeroDivisionError):
+        T.gf_inv_scalar(0)
+
+
+def test_nibble_tables_decompose_mul():
+    lo, hi = T.nibble_tables()
+    mt = T.mul_table()
+    rng = np.random.default_rng(2)
+    for _ in range(200):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        assert mt[c, x] == lo[c, x & 0xF] ^ hi[c, x >> 4]
+
+
+def test_bit_powers_linearity():
+    P = T.bit_powers()
+    mt = T.mul_table()
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        acc = 0
+        for b in range(8):
+            if (x >> b) & 1:
+                acc ^= int(P[c, b])
+        assert acc == mt[c, x]
+
+
+def test_bitmatrix_matches_mul():
+    rng = np.random.default_rng(4)
+    for _ in range(50):
+        c, x = int(rng.integers(256)), int(rng.integers(256))
+        M = T.gf_bitmatrix(c)
+        xbits = np.array([(x >> b) & 1 for b in range(8)], dtype=np.uint8)
+        ybits = (M @ xbits) % 2
+        y = sum(int(v) << b for b, v in enumerate(ybits))
+        assert y == T.gf_mul_scalar(c, x)
+
+
+def test_gf_matmul_identity_and_inverse():
+    rng = np.random.default_rng(5)
+    for n in (2, 4, 8):
+        # random invertible matrix via random tries
+        while True:
+            A = rng.integers(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                Ainv = R.gf_inv_matrix(A)
+                break
+            except ValueError:
+                continue
+        assert (R.gf_matmul(A, Ainv) == np.eye(n, dtype=np.uint8)).all()
+        assert (R.gf_matmul(Ainv, A) == np.eye(n, dtype=np.uint8)).all()
+
+
+def test_singular_matrix_raises():
+    A = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        R.gf_inv_matrix(A)

@@ -1,0 +1,132 @@
+"""EC benchmark CLI — flag-compatible recreation of the reference's tool.
+
+Mirrors src/test/erasure-code/ceph_erasure_code_benchmark.cc
+(ErasureCodeBench::{setup,run,encode,decode}; CLI: --plugin --parameter
+k=.. m=.. --size --iterations --workload encode|decode --erasures),
+extended with TPU batching knobs (--batch, --impl) since the unit of work
+here is a batch of objects, not one buffer.
+
+Examples:
+  python tools/ec_benchmark.py --plugin tpu_rs -P k=8 -P m=3 \
+      --size $((4*1024*1024)) --batch 64 --iterations 8 --workload encode
+  python tools/ec_benchmark.py -P k=8 -P m=3 --workload decode --erasures 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--plugin", "-p", default="tpu_rs")
+    ap.add_argument("--parameter", "-P", action="append", default=[],
+                    help="profile key=value (k=8, m=3, technique=reed_sol_van)")
+    ap.add_argument("--size", "-s", type=int, default=4 * 1024 * 1024,
+                    help="object (stripe) size in bytes [4 MiB]")
+    ap.add_argument("--batch", "-b", type=int, default=64,
+                    help="objects encoded per device launch")
+    ap.add_argument("--iterations", "-i", type=int, default=8)
+    ap.add_argument("--workload", "-w", choices=["encode", "decode"],
+                    default="encode")
+    ap.add_argument("--erasures", "-e", type=int, default=1,
+                    help="chunks erased per object for decode")
+    ap.add_argument("--impl", default=None,
+                    help="kernel lowering: bitlinear | mxu | logexp | auto")
+    ap.add_argument("--json", action="store_true", help="emit one JSON line")
+    return ap.parse_args(argv)
+
+
+def run_bench(plugin: str, profile: dict, size: int, batch: int,
+              iterations: int, workload: str, erasures: int,
+              impl: str | None) -> dict:
+    """Returns {seconds, gbps, bytes_per_iter, ...}. Timing covers only the
+    codec region, like ErasureCodeBench::encode/decode (buffers prepared
+    outside the loop, one warmup launch excluded for jit compile)."""
+    import jax
+
+    from ceph_tpu.ec import registry
+    from ceph_tpu.gf.numpy_ref import decode_matrix
+    from ceph_tpu.ops.rs_kernels import DEFAULT_IMPL, make_encoder
+
+    prof = dict(profile)
+    prof["plugin"] = plugin
+    if impl and impl != "auto":
+        prof["impl"] = impl
+    impl_used = prof.get("impl", DEFAULT_IMPL)
+    coder = registry.factory(prof)
+    k, m = coder.k, coder.m
+    cs = coder.get_chunk_size(size)
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(batch, k, cs), dtype=np.uint8)
+    dev_data = jax.device_put(data)
+
+    if workload == "encode":
+        fn = make_encoder(coder.matrix, impl_used)
+        operand = dev_data
+    else:
+        if not 0 < erasures <= m:
+            raise SystemExit(f"--erasures must be in [1, m={m}], got {erasures}")
+        ers = tuple(range(erasures))
+        survivors = tuple(range(erasures, erasures + k))
+        D = decode_matrix(coder.matrix, list(ers), k, list(survivors))
+        fn = make_encoder(D, impl_used)
+        # decode input: k surviving chunks per object
+        operand = dev_data
+
+    fn(operand).block_until_ready()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        out = fn(operand)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    payload = batch * k * cs  # bytes of data processed per iteration
+    return {
+        "plugin": plugin, "k": k, "m": m, "chunk_size": cs,
+        "object_size": size, "batch": batch, "iterations": iterations,
+        "workload": workload, "erasures": erasures if workload == "decode" else 0,
+        "impl": impl_used,
+        "seconds": dt,
+        "bytes_per_iter": payload,
+        "gbps": payload * iterations / dt / 1e9,
+        "backend": jax.default_backend(),
+    }
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    from ceph_tpu.ec.interface import profile_from_string
+    try:
+        profile = profile_from_string(" ".join(args.parameter))
+    except ValueError as e:
+        raise SystemExit(f"--parameter: {e}")
+    impls = ([args.impl] if args.impl and args.impl != "auto"
+             else ["bitlinear", "mxu"])
+    results = [run_bench(args.plugin, profile, args.size, args.batch,
+                         args.iterations, args.workload, args.erasures, i)
+               for i in impls]
+    best = max(results, key=lambda r: r["gbps"])
+    if args.json:
+        print(json.dumps(best))
+    else:
+        for r in results:
+            star = "*" if r is best else " "
+            print(f"{star} {r['workload']} {r['plugin']} k={r['k']} m={r['m']} "
+                  f"impl={r['impl']}: {r['seconds']:.3f}s for "
+                  f"{r['iterations']}x{r['bytes_per_iter'] / 1e6:.1f} MB "
+                  f"-> {r['gbps']:.2f} GB/s [{r['backend']}]")
+
+
+if __name__ == "__main__":
+    main()

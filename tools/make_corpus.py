@@ -1,0 +1,68 @@
+"""Generate the non-regression corpus: pin codec output bytes forever.
+
+Rebuild of the reference's ceph_erasure_code_non_regression harness
+(ref: src/test/erasure-code/ceph_erasure_code_non_regression.cc —
+SURVEY.md §4): deterministic input, encode, store content digests; any
+future change to matrices, tables, or kernels that alters one output
+byte fails tests/test_non_regression.py.
+
+Run: python tools/make_corpus.py   (writes tests/corpus/corpus.json)
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.ec.matrices import coding_matrix  # noqa: E402
+from ceph_tpu.gf.numpy_ref import encode_ref  # noqa: E402
+from ceph_tpu.gf.tables import GF_EXP  # noqa: E402
+
+CONFIGS = [
+    ("reed_sol_van", 4, 2),
+    ("reed_sol_van", 8, 3),
+    ("reed_sol_van", 8, 4),
+    ("cauchy_orig", 4, 2),
+    ("cauchy_orig", 8, 3),
+    ("cauchy_good", 8, 3),
+    ("cauchy_good", 8, 4),
+]
+
+CHUNK = 512
+SEED = 0xCE9
+
+
+def main() -> None:
+    out = {
+        "comment": "Pinned codec bytes. Regenerating must be a deliberate, "
+                   "reviewed act: it redefines the on-disk stripe format.",
+        "prim_poly": 0x11D,
+        "gf_exp_sha256": hashlib.sha256(GF_EXP.tobytes()).hexdigest(),
+        "entries": [],
+    }
+    for tech, k, m in CONFIGS:
+        mat = coding_matrix(tech, k, m)
+        rng = np.random.default_rng(SEED + k * 16 + m)
+        data = rng.integers(0, 256, size=(1, k, CHUNK), dtype=np.uint8)
+        parity = encode_ref(mat, data)
+        out["entries"].append({
+            "technique": tech, "k": k, "m": m,
+            "matrix": mat.tolist(),
+            "data_sha256": hashlib.sha256(data.tobytes()).hexdigest(),
+            "parity_sha256": hashlib.sha256(parity.tobytes()).hexdigest(),
+            "parity_head": parity[0, :, :16].tolist(),
+        })
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "tests", "corpus", "corpus.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}: {len(out['entries'])} entries")
+
+
+if __name__ == "__main__":
+    main()
