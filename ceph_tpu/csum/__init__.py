@@ -1,0 +1,16 @@
+"""Checksum subsystem — crc32c / xxhash, batched for TPU.
+
+Rebuild of the reference's block-checksum stack (ref: src/common/crc32c.cc
+`ceph_crc32c` dispatch + src/common/crc32c_intel_fast.c PCLMUL path;
+bundled src/xxHash/ XXH32/XXH64; consumed by BlueStore's per-blob
+Checksummer — src/os/bluestore/Checksummer.h — and by EC HashInfo
+bookkeeping in src/osd/ECUtil.{h,cc}).
+
+Layout:
+  reference.py   — pure numpy/python oracles + table/matrix construction
+  kernels.py     — batched JAX device kernels (deep-scrub bulk path)
+  checksummer.py — Checksummer-style per-block calculate/verify API
+"""
+
+from .checksummer import CSUM_ALGORITHMS, Checksummer  # noqa: F401
+from .reference import ceph_crc32c, crc32c, xxh32, xxh64  # noqa: F401

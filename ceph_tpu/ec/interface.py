@@ -128,6 +128,11 @@ class ErasureCode(abc.ABC):
         data: bytes or (object_bytes,) uint8, or (batch, object_bytes).
         Returns {chunk_id: (batch, chunk_size) uint8} (batch dim kept).
         """
+        n_chunks = self.get_chunk_count()
+        bad = [i for i in want_to_encode if not 0 <= i < n_chunks]
+        if bad:
+            raise ValueError(
+                f"chunk ids must be in [0, {n_chunks}), got {sorted(bad)}")
         arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
             data, (bytes, bytearray, memoryview)) else np.asarray(data, np.uint8)
         squeeze = arr.ndim == 1

@@ -147,6 +147,23 @@ def isa_rs_matrix(k: int, m: int) -> np.ndarray:
     return mat
 
 
+def isa_cauchy_matrix(k: int, m: int) -> np.ndarray:
+    """ISA-L-style Cauchy matrix (semantic mirror of isa-l ec_base.c
+    gf_gen_cauchy1, the reference isa plugin's technique=cauchy — ref:
+    src/erasure-code/isa/ErasureCodeIsa.cc): coding element (i, j) =
+    1 / ((k + i) XOR j). X = {k..k+m-1} and Y = {0..k-1} are disjoint, so
+    this is a true Cauchy matrix — MDS for every geometry. Distinct from
+    jerasure's cauchy_orig (1 / (i XOR (m + j))), so the two plugins'
+    parity bytes differ, as they do in the reference."""
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for w=8")
+    mat = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            mat[i, j] = gf_inv_scalar((k + i) ^ j)
+    return mat
+
+
 def reed_sol_r6_matrix(k: int, m: int) -> np.ndarray:
     """The RAID-6 matrix (reed_sol.c reed_sol_r6_coding_matrix): P row is
     plain XOR, Q row is powers of the generator: Q[j] = 2**j. m must be 2."""
@@ -164,6 +181,7 @@ TECHNIQUES = {
     "cauchy_orig": cauchy_orig_matrix,
     "cauchy_good": cauchy_good_matrix,
     "isa_reed_sol_van": isa_rs_matrix,
+    "isa_cauchy": isa_cauchy_matrix,
 }
 
 

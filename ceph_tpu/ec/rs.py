@@ -94,20 +94,36 @@ class IsaReedSolomon(ReedSolomon):
     decodability for small codes and rejects known-degenerate setups.
     """
 
+    # exhaustive MDS verification is C(k+m, m) tiny matrix inversions;
+    # above this budget reed_sol_van is refused rather than trusted.
+    _MDS_CHECK_BUDGET = 200_000
+
     def init(self, profile: Mapping[str, str]) -> None:
         prof = dict(profile)
         technique = prof.get("technique", "reed_sol_van")
         if technique == "reed_sol_van":
             prof["technique"] = "isa_reed_sol_van"
         elif technique == "cauchy":
-            prof["technique"] = "cauchy_orig"
+            prof["technique"] = "isa_cauchy"
         else:
             raise ValueError(f"isa plugin technique must be reed_sol_van or "
                              f"cauchy, got {technique!r}")
         super().init(prof)
         self.technique = technique
-        if technique == "reed_sol_van" and self.k + self.m <= 16:
+        if technique == "reed_sol_van":
+            # ISA-L's gf_gen_rs_matrix construction is NOT MDS for every
+            # geometry; accepting one would advertise fault tolerance that
+            # fails at decode time. Verify exhaustively, or refuse when
+            # the pattern space is too large to verify.
+            from math import comb
+
             from .matrices import is_mds
+            if comb(self.k + self.m, self.m) > self._MDS_CHECK_BUDGET:
+                raise ValueError(
+                    f"isa reed_sol_van k={self.k} m={self.m}: MDS property "
+                    f"cannot be verified exhaustively at this size and the "
+                    f"construction is not guaranteed MDS; use "
+                    f"technique=cauchy (always MDS)")
             if not is_mds(self.matrix, self.k):
                 raise ValueError(
                     f"isa reed_sol_van matrix is not MDS for k={self.k} "

@@ -36,12 +36,16 @@ def default_mesh(devices=None, shard: int = 2) -> Mesh:
     """(dp, shard) mesh over the given (default: all) devices.
 
     `shard` devices hold disjoint subsets of each stripe's k+m chunks;
-    the rest of the devices form the batch-parallel axis.
+    the rest of the devices form the batch-parallel axis. `shard` must
+    divide the device count — a silently different topology than the one
+    the caller modeled would misplace every shard group.
     """
     devices = np.asarray(devices if devices is not None else jax.devices())
     n = devices.size
-    while shard > 1 and n % shard:
-        shard -= 1
+    if shard < 1 or n % shard:
+        raise ValueError(
+            f"shard axis {shard} does not divide device count {n}; "
+            f"pick a divisor (e.g. {[d for d in (1, 2, 4, 8) if n % d == 0]})")
     return Mesh(devices.reshape(n // shard, shard), ("dp", "shard"))
 
 

@@ -146,3 +146,38 @@ def test_minimum_to_decode_rejects_bad_ids():
         coder.minimum_to_decode([7], [0, 1, 2, 3, 4, 5])
     with pytest.raises(ValueError):
         coder.minimum_to_decode_with_cost([0], {9: 1})
+
+
+def test_isa_non_mds_geometry_rejected():
+    factory = registry.factory
+    # gf_gen_rs_matrix-style construction is not MDS at k=12 m=5 (18 of
+    # 6188 five-erasure patterns hit a singular survivor submatrix);
+    # accepting it would advertise fault tolerance that fails at decode.
+    with pytest.raises(ValueError, match="not MDS"):
+        factory({"plugin": "isa", "k": "12", "m": "5"})
+
+
+def test_isa_cauchy_matches_isal_construction():
+    factory = registry.factory
+    # ISA-L gf_gen_cauchy1: element (i, j) = 1/((k+i) XOR j) — distinct
+    # from jerasure's cauchy_orig 1/(i XOR (m+j)).
+    coder = factory({"plugin": "isa", "k": "4", "m": "2",
+                     "technique": "cauchy"})
+    assert coder.matrix.tolist() == [[71, 167, 122, 186],
+                                     [167, 71, 186, 122]]
+    jer = factory({"plugin": "jerasure", "k": "4", "m": "2",
+                   "technique": "cauchy_orig"})
+    assert coder.matrix.tolist() != jer.matrix.tolist()
+
+
+def test_isa_cauchy_always_mds():
+    from ceph_tpu.ec.matrices import is_mds, isa_cauchy_matrix
+    for k, m in ((4, 2), (8, 3), (12, 5)):
+        assert is_mds(isa_cauchy_matrix(k, m), k)
+
+
+def test_encode_rejects_bad_chunk_ids():
+    factory = registry.factory
+    coder = factory({"plugin": "tpu_rs", "k": "4", "m": "2"})
+    with pytest.raises(ValueError, match=r"chunk ids must be in \[0, 6\)"):
+        coder.encode([99], b"hello world")

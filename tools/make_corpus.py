@@ -30,6 +30,11 @@ CONFIGS = [
     ("cauchy_orig", 8, 3),
     ("cauchy_good", 8, 3),
     ("cauchy_good", 8, 4),
+    ("reed_sol_r6_op", 8, 2),
+    ("isa_reed_sol_van", 4, 2),
+    ("isa_reed_sol_van", 8, 3),
+    ("isa_cauchy", 4, 2),
+    ("isa_cauchy", 8, 3),
 ]
 
 CHUNK = 512
