@@ -80,26 +80,32 @@ def _crc32c_linear(blocks: Array) -> Array:
     return c[:, 0]
 
 
+def _crc32c_zero_seed(blocks: Array) -> Array:
+    """Zero-seed CRC register over each row of (B, L) uint8, any L:
+    parallel slicing + log-depth combine for the 8-aligned head, <=7
+    unrolled byte steps for the tail."""
+    block_len = blocks.shape[1]
+    main = (block_len // 8) * 8
+    if main:
+        reg = _crc32c_linear(blocks[:, :main])
+    else:
+        reg = jnp.zeros((blocks.shape[0],), dtype=jnp.uint32)
+    for t in range(main, block_len):
+        byte = blocks[:, t].astype(jnp.uint32)
+        reg = (reg >> np.uint32(8)) ^ jnp.take(
+            _T0, ((reg ^ byte) & np.uint32(0xFF)).astype(jnp.int32))
+    return reg
+
+
 @functools.lru_cache(maxsize=64)
 def _crc32c_jit(block_len: int, init: int, xorout: int):
-    main = (block_len // 8) * 8
-    tail = block_len - main
     # init contribution: shift^{block_len}(init), a host constant
     const = apply_shift(init, block_len) ^ xorout if block_len else init ^ xorout
 
     def fn(blocks: Array) -> Array:
         if blocks.dtype != jnp.uint8 or blocks.ndim != 2:
             raise ValueError(f"blocks must be (B, {block_len}) uint8")
-        B = blocks.shape[0]
-        if main:
-            reg = _crc32c_linear(blocks[:, :main])
-        else:
-            reg = jnp.zeros((B,), dtype=jnp.uint32)
-        for t in range(tail):  # <= 7 unrolled byte steps
-            byte = blocks[:, main + t].astype(jnp.uint32)
-            reg = (reg >> np.uint32(8)) ^ jnp.take(
-                _T0, ((reg ^ byte) & np.uint32(0xFF)).astype(jnp.int32))
-        return reg ^ np.uint32(const)
+        return _crc32c_zero_seed(blocks) ^ np.uint32(const)
 
     return jax.jit(fn)
 
@@ -112,6 +118,27 @@ def crc32c_blocks(blocks, init: int = 0xFFFFFFFF,
     blocks = jnp.asarray(blocks, dtype=jnp.uint8)
     return _crc32c_jit(int(blocks.shape[1]), init & 0xFFFFFFFF,
                        xorout & 0xFFFFFFFF)(blocks)
+
+
+@functools.lru_cache(maxsize=64)
+def _crc32c_extend_jit(block_len: int):
+    shift_cols = matrix_cols_u32(shift_matrix(block_len))
+
+    def fn(regs: Array, blocks: Array) -> Array:
+        # register after block with runtime seed r: shift^{len}(r) ^ L(block)
+        return _apply_bitmatrix32(shift_cols, regs) ^ _crc32c_zero_seed(blocks)
+
+    return jax.jit(fn)
+
+
+def crc32c_extend(regs, blocks) -> Array:
+    """Advance raw CRC registers through one block each: regs (B,) uint32
+    current registers (the ceph_crc32c chaining state), blocks (B, L)
+    uint8. Returns the new registers — the batched form of
+    ceph_crc32c(reg, block), used by HashInfo appends across shards."""
+    blocks = jnp.asarray(blocks, dtype=jnp.uint8)
+    regs = jnp.asarray(regs, dtype=jnp.uint32)
+    return _crc32c_extend_jit(int(blocks.shape[1]))(regs, blocks)
 
 
 # ----------------------------------------------------------------- xxh32

@@ -1,0 +1,158 @@
+"""LRC plugin tests (ref: src/test/erasure-code/TestErasureCodeLrc.cc
+pattern: kml expansion, layered encode/decode round-trips, and the
+locality property — single-failure repair touches only the local group)."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry
+from ceph_tpu.ec.lrc import _expand_kml
+
+DOC_MAPPING = "__DD__DD"
+DOC_LAYERS = [["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]]
+
+
+def test_kml_expansion_matches_reference_doc():
+    # the documented expansion of k=4 m=2 l=3
+    mapping, layers = _expand_kml(4, 2, 3)
+    assert mapping == DOC_MAPPING
+    assert layers == DOC_LAYERS
+
+
+def test_kml_validation():
+    with pytest.raises(ValueError, match="multiple of"):
+        _expand_kml(4, 3, 3)  # k+m=7 not divisible by 3
+    with pytest.raises(ValueError):
+        _expand_kml(4, 2, 1)
+
+
+@pytest.fixture
+def coder():
+    return registry.factory({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+
+
+def test_geometry(coder):
+    assert coder.get_chunk_count() == 8
+    assert coder.get_data_chunk_count() == 4
+    assert coder.get_coding_chunk_count() == 4
+    assert coder.data_positions == (2, 3, 6, 7)
+
+
+def test_encode_roundtrip_no_loss(coder):
+    rng = np.random.default_rng(0)
+    obj = rng.integers(0, 256, size=997, dtype=np.uint8)
+    chunks = coder.encode(range(8), obj)
+    out = coder.decode_concat(chunks, object_size=997)
+    np.testing.assert_array_equal(out, obj)
+
+
+def test_local_parity_is_consistent(coder):
+    # each local parity equals its layer's RS parity over the group
+    rng = np.random.default_rng(1)
+    obj = rng.integers(0, 256, size=4 * 128, dtype=np.uint8)
+    chunks = coder.encode(range(8), obj)
+    for layer in coder.layers[1:]:  # local layers
+        ldata = np.stack([chunks[p] for p in layer.d_pos])[None]
+        parity = np.asarray(layer.coder.encode_chunks(ldata))[0]
+        for i, p in enumerate(layer.c_pos):
+            np.testing.assert_array_equal(parity[i], chunks[p])
+
+
+def test_single_failure_repair_is_local(coder):
+    # the LRC selling point: one lost chunk reads only its local group
+    for lost in range(8):
+        avail = [i for i in range(8) if i != lost]
+        need = coder.minimum_to_decode([lost], avail)
+        assert len(need) <= 3, (lost, need)  # l = 3, not k = 4
+        group = range(0, 4) if lost < 4 else range(4, 8)
+        assert need <= set(group), (lost, need)
+
+
+def test_single_failure_repair_bytes(coder):
+    rng = np.random.default_rng(2)
+    obj = rng.integers(0, 256, size=4 * 128, dtype=np.uint8)
+    chunks = coder.encode(range(8), obj)
+    for lost in range(8):
+        avail = {i: chunks[i] for i in range(8) if i != lost}
+        need = coder.minimum_to_decode([lost], list(avail))
+        rec = coder.decode([lost], {i: avail[i] for i in need})
+        np.testing.assert_array_equal(rec[lost], chunks[lost])
+
+
+def test_double_failure_repair(coder):
+    rng = np.random.default_rng(3)
+    obj = rng.integers(0, 256, size=4 * 128, dtype=np.uint8)
+    chunks = coder.encode(range(8), obj)
+    for lost in combinations(range(8), 2):
+        avail = {i: chunks[i] for i in range(8) if i not in lost}
+        need = coder.minimum_to_decode(list(lost), list(avail))
+        rec = coder.decode(list(lost), {i: avail[i] for i in need})
+        for p in lost:
+            np.testing.assert_array_equal(rec[p], chunks[p], err_msg=str(lost))
+
+
+def test_mapping_layers_profile_form():
+    import json
+    coder = registry.factory({
+        "plugin": "lrc", "mapping": DOC_MAPPING,
+        "layers": json.dumps(DOC_LAYERS)})
+    kml = registry.factory({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    obj = np.arange(512, dtype=np.uint16).astype(np.uint8)
+    a = coder.encode(range(8), obj)
+    b = kml.encode(range(8), obj)
+    for i in range(8):
+        np.testing.assert_array_equal(a[i], b[i])
+
+
+def test_unreconstructible_raises(coder):
+    # lose a whole local group incl. its global + local parity + 2 data
+    chunks = coder.encode(range(8), np.zeros(512, np.uint8))
+    avail = [0, 1, 2, 3]  # entire second group gone (4 chunks > tolerance)
+    with pytest.raises(ValueError, match="cannot reconstruct"):
+        coder.minimum_to_decode([6], avail)
+
+
+def test_bad_profiles_rejected():
+    with pytest.raises(ValueError, match="no layers"):
+        registry.factory({"plugin": "lrc", "mapping": "DD__"})
+    with pytest.raises(ValueError, match="length"):
+        registry.factory({"plugin": "lrc", "mapping": "DD_",
+                          "layers": [["cDDD", ""]]})
+    with pytest.raises(ValueError, match="neither data nor written"):
+        registry.factory({"plugin": "lrc", "mapping": "DD__",
+                          "layers": [["DDc_", ""]]})
+
+
+def test_batched_encode(coder):
+    rng = np.random.default_rng(4)
+    objs = rng.integers(0, 256, size=(5, 512), dtype=np.uint8)
+    chunks = coder.encode(range(8), objs)
+    assert chunks[0].shape == (5, 128)
+    single = coder.encode(range(8), objs[2])
+    for i in range(8):
+        np.testing.assert_array_equal(chunks[i][2], single[i])
+
+
+def test_layer_order_validation():
+    # a layer consuming a position no earlier layer wrote is rejected
+    with pytest.raises(ValueError, match="layer order"):
+        registry.factory({"plugin": "lrc", "mapping": "_DDD",
+                          "layers": [["DDDc", ""], ["cDD_", ""]]})
+    # same layers in producing order are fine
+    registry.factory({"plugin": "lrc", "mapping": "_DDD",
+                      "layers": [["cDD_", ""], ["DDDc", ""]]})
+
+
+def test_minimum_to_decode_with_cost_is_layer_aware(coder):
+    # chunk 2 lost; group-1 chunks made artificially cheap — the MDS
+    # default would pick {4,5,6,7}, an undecodable set for position 2
+    costs = {0: 10, 1: 10, 3: 10, 4: 1, 5: 1, 6: 1, 7: 1}
+    need = coder.minimum_to_decode_with_cost([2], costs)
+    assert need <= {0, 1, 3}
+    rng = np.random.default_rng(9)
+    obj = rng.integers(0, 256, size=512, dtype=np.uint8)
+    chunks = coder.encode(range(8), obj)
+    rec = coder.decode([2], {i: chunks[i] for i in need})
+    np.testing.assert_array_equal(rec[2], chunks[2])
