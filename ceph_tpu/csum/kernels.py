@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .reference import (apply_shift, crc32c_slice8_tables, crc32c_table,
-                        matrix_cols_u32, shift_matrix)
+                        inv_shift_matrix, matrix_cols_u32, shift_matrix)
 
 Array = jax.Array
 
@@ -135,10 +135,27 @@ def crc32c_extend(regs, blocks) -> Array:
     """Advance raw CRC registers through one block each: regs (B,) uint32
     current registers (the ceph_crc32c chaining state), blocks (B, L)
     uint8. Returns the new registers — the batched form of
-    ceph_crc32c(reg, block), used by HashInfo appends across shards."""
+    ceph_crc32c(reg, block), used by HashInfo appends across shards.
+
+    The kernel specializes on block length; arbitrary lengths would
+    compile (and cache-thrash) one program each, so blocks are zero-
+    padded up to the next power of two and the padding's register shift
+    is undone afterwards with the cached inverse GF(2) shift matrix —
+    a 32-bit host fixup, not a data pass.
+    """
     blocks = jnp.asarray(blocks, dtype=jnp.uint8)
     regs = jnp.asarray(regs, dtype=jnp.uint32)
-    return _crc32c_extend_jit(int(blocks.shape[1]))(regs, blocks)
+    L = int(blocks.shape[1])
+    bucket = max(64, 1 << (L - 1).bit_length()) if L else 0
+    pad = bucket - L
+    if pad:
+        blocks = jnp.pad(blocks, ((0, 0), (0, pad)))
+    out = _crc32c_extend_jit(bucket)(regs, blocks)
+    if pad:
+        # out = shift^pad(true): undo the zero-padding's linear shift
+        inv_cols = matrix_cols_u32(inv_shift_matrix(pad))
+        out = _apply_bitmatrix32(inv_cols, out)
+    return out
 
 
 # ----------------------------------------------------------------- xxh32

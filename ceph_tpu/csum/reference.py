@@ -123,6 +123,37 @@ def shift_matrix(nbytes: int) -> np.ndarray:
     return sq
 
 
+@functools.cache
+def inv_shift_matrix(nbytes: int) -> np.ndarray:
+    """Inverse of shift_matrix(nbytes): un-advances the register through
+    `nbytes` zero bytes. The zero-byte operator is a bijection on the
+    register space, so this always exists; built by GF(2) Gauss-Jordan
+    on the single-byte matrix, then square-and-multiply."""
+    if nbytes == 0:
+        return np.eye(32, dtype=np.uint8)
+    if nbytes == 1:
+        a = _zero_byte_matrix().astype(np.uint8) % 2
+        inv = np.eye(32, dtype=np.uint8)
+        a = a.copy()
+        for col in range(32):
+            pivot = col
+            while a[pivot, col] == 0:
+                pivot += 1
+            if pivot != col:
+                a[[col, pivot]] = a[[pivot, col]]
+                inv[[col, pivot]] = inv[[pivot, col]]
+            for row in range(32):
+                if row != col and a[row, col]:
+                    a[row] ^= a[col]
+                    inv[row] ^= inv[col]
+        return inv
+    half = inv_shift_matrix(nbytes // 2)
+    sq = _matmul_gf2(half, half).astype(np.uint8)
+    if nbytes % 2:
+        sq = _matmul_gf2(inv_shift_matrix(1), sq).astype(np.uint8)
+    return sq
+
+
 def matrix_cols_u32(m: np.ndarray) -> np.ndarray:
     """Pack a 32x32 GF(2) matrix into 32 uint32 column constants so that
     apply(m, x) == XOR over set bits b of x of cols[b]."""

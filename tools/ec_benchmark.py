@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--plugin", "-p", default="tpu_rs")
+    ap.add_argument("--plugin", "-p", default=None,
+                    help="EC plugin name [tpu_rs; -P plugin=... also works]")
     ap.add_argument("--parameter", "-P", action="append", default=[],
                     help="profile key=value (k=8, m=3, technique=reed_sol_van)")
     ap.add_argument("--size", "-s", type=int, default=4 * 1024 * 1024,
@@ -59,37 +60,67 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
     from ceph_tpu.ops.rs_kernels import DEFAULT_IMPL, make_encoder
 
     prof = dict(profile)
-    prof["plugin"] = plugin
+    if plugin is not None:
+        if prof.get("plugin", plugin) != plugin:
+            raise SystemExit(f"--plugin {plugin} conflicts with "
+                             f"-P plugin={prof['plugin']}")
+        prof["plugin"] = plugin
+    prof.setdefault("plugin", "tpu_rs")
+    plugin = prof["plugin"]
     if impl and impl != "auto":
         prof["impl"] = impl
     impl_used = prof.get("impl", DEFAULT_IMPL)
-    coder = registry.factory(prof)
+    try:
+        coder = registry.factory(prof)
+    except ValueError as e:
+        raise SystemExit(str(e))
     k, m = coder.k, coder.m
     cs = coder.get_chunk_size(size)
 
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(batch, k, cs), dtype=np.uint8)
-    dev_data = jax.device_put(data)
 
-    if workload == "encode":
-        fn = make_encoder(coder.matrix, impl_used)
+    if hasattr(coder, "matrix"):
+        # RS-family fast path: time the raw device kernel (the measured
+        # region of ceph_erasure_code_benchmark — codec math only)
+        dev_data = jax.device_put(data)
+        if workload == "encode":
+            fn = make_encoder(coder.matrix, impl_used)
+        else:
+            if not 0 < erasures <= m:
+                raise SystemExit(
+                    f"--erasures must be in [1, m={m}], got {erasures}")
+            ers = tuple(range(erasures))
+            survivors = tuple(range(erasures, erasures + k))
+            D = decode_matrix(coder.matrix, list(ers), k, list(survivors))
+            fn = make_encoder(D, impl_used)
         operand = dev_data
+        fn(operand).block_until_ready()  # warmup / compile
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            out = fn(operand)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
     else:
-        if not 0 < erasures <= m:
-            raise SystemExit(f"--erasures must be in [1, m={m}], got {erasures}")
-        ers = tuple(range(erasures))
-        survivors = tuple(range(erasures, erasures + k))
-        D = decode_matrix(coder.matrix, list(ers), k, list(survivors))
-        fn = make_encoder(D, impl_used)
-        # decode input: k surviving chunks per object
-        operand = dev_data
-
-    fn(operand).block_until_ready()  # warmup / compile
-    t0 = time.perf_counter()
-    for _ in range(iterations):
-        out = fn(operand)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+        # layered plugins (clay, lrc): time the full plugin path
+        impl_used = getattr(coder, "impl", impl_used)
+        if workload == "encode":
+            run = lambda: coder.encode_chunks(data)  # noqa: E731
+        else:
+            if not 0 < erasures <= m:
+                raise SystemExit(
+                    f"--erasures must be in [1, m={m}], got {erasures}")
+            parity = coder.encode_chunks(data)
+            full = {i: data[:, i, :] for i in range(k)}
+            full.update({k + j: parity[:, j, :] for j in range(m)})
+            ers = list(range(erasures))
+            have = {c: full[c] for c in full if c not in set(ers)}
+            run = lambda: coder.decode_chunks(ers, have)  # noqa: E731
+        run()  # warmup / compile
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            run()
+        dt = time.perf_counter() - t0
 
     payload = batch * k * cs  # bytes of data processed per iteration
     return {
@@ -111,8 +142,13 @@ def main(argv=None) -> None:
         profile = profile_from_string(" ".join(args.parameter))
     except ValueError as e:
         raise SystemExit(f"--parameter: {e}")
-    impls = ([args.impl] if args.impl and args.impl != "auto"
-             else ["bitlinear", "mxu"])
+    plugin_name = args.plugin or profile.get("plugin", "tpu_rs")
+    if args.impl and args.impl != "auto":
+        impls = [args.impl]
+    elif plugin_name in ("clay", "lrc", "tpu_lrc"):
+        impls = [None]  # layered plugins pick their own kernel impl
+    else:
+        impls = ["bitlinear", "mxu"]
     results = [run_bench(args.plugin, profile, args.size, args.batch,
                          args.iterations, args.workload, args.erasures, i)
                for i in impls]
