@@ -131,6 +131,20 @@ def _crc32c_extend_jit(block_len: int):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=256)
+def _inv_shift_cols(pad: int) -> np.ndarray:
+    return matrix_cols_u32(inv_shift_matrix(pad))
+
+
+def _unshift_host(regs: np.ndarray, pad: int) -> np.ndarray:
+    """Un-advance registers through `pad` zero bytes — a 32-constant XOR
+    on host uint32s, no device dispatch."""
+    cols = _inv_shift_cols(pad)
+    bits = (regs[:, None] >> np.arange(32, dtype=np.uint32)) & np.uint32(1)
+    terms = np.where(bits.astype(bool), cols[None, :], np.uint32(0))
+    return np.bitwise_xor.reduce(terms, axis=1)
+
+
 def crc32c_extend(regs, blocks) -> Array:
     """Advance raw CRC registers through one block each: regs (B,) uint32
     current registers (the ceph_crc32c chaining state), blocks (B, L)
@@ -153,8 +167,7 @@ def crc32c_extend(regs, blocks) -> Array:
     out = _crc32c_extend_jit(bucket)(regs, blocks)
     if pad:
         # out = shift^pad(true): undo the zero-padding's linear shift
-        inv_cols = matrix_cols_u32(inv_shift_matrix(pad))
-        out = _apply_bitmatrix32(inv_cols, out)
+        out = _unshift_host(np.asarray(out, np.uint32), pad)
     return out
 
 

@@ -338,6 +338,8 @@ class Clay(ErasureCode):
         mt = mul_table()
         M = np.zeros((self.m * nrp, nv), np.uint8)
         K = np.zeros((self.m * nrp, nc), np.uint8)
+        u_rows = {(n, z): self._u_expr(n, z, var_idx, const_idx, nv, nc, is_var)
+                  for n in range(nn) if n not in unknown for z in rplanes}
         for zi, z in enumerate(rplanes):
             for r in range(self.m):
                 eq = zi * self.m + r
@@ -348,8 +350,7 @@ class Clay(ErasureCode):
                     if n in unknown:
                         M[eq, var_idx[(n, z)]] ^= np.uint8(h)
                     else:
-                        V, C = self._u_expr(n, z, var_idx, const_idx,
-                                            nv, nc, is_var)
+                        V, C = u_rows[(n, z)]
                         M[eq] ^= mt[h, V]
                         K[eq] ^= mt[h, C]
         g = self.gamma
@@ -411,8 +412,13 @@ class Clay(ErasureCode):
 
     def decode_chunks(self, want_to_read: Sequence[int],
                       chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
-        want = tuple(sorted(want_to_read))
-        have = tuple(sorted(c for c in chunks if c not in set(want)))
+        want = tuple(sorted(set(want_to_read)))
+        passthrough = {c: np.asarray(chunks[c], np.uint8)
+                       for c in want if c in chunks}
+        missing = tuple(c for c in want if c not in chunks)
+        if not missing:
+            return passthrough
+        have = tuple(sorted(chunks))
         n = self.get_chunk_count()
         # the coupled system ties every chunk's sub-chunks together, so a
         # chunk neither wanted nor provided must be treated as ERASED too —
@@ -421,9 +427,9 @@ class Clay(ErasureCode):
         # minimum_to_decode contract) go through the repair path instead.
         erased = tuple(sorted(set(range(n)) - set(have)))
         if len(erased) > self.m:
-            if len(want) == 1 and len(have) >= self.d:
-                rebuilt = self.repair_from_chunks(want[0], dict(chunks))
-                return {want[0]: rebuilt}
+            if len(missing) == 1 and len(have) >= self.d:
+                rebuilt = self.repair_from_chunks(missing[0], dict(chunks))
+                return {**passthrough, missing[0]: rebuilt}
             raise ValueError(
                 f"cannot decode {sorted(want)}: {len(erased)} chunks "
                 f"unavailable (m={self.m}); provide more survivors")
@@ -438,8 +444,9 @@ class Clay(ErasureCode):
         out = self._apply(D, stacked).reshape(B, len(erased), L)
         if squeeze:
             out = out[0]
-        wanted = set(want)
-        return {e: out[..., i, :] for i, e in enumerate(erased) if e in wanted}
+        wanted = set(missing)
+        solved = {e: out[..., i, :] for i, e in enumerate(erased) if e in wanted}
+        return {**passthrough, **solved}
 
     # -- repair (the point of Clay) ----------------------------------------
 

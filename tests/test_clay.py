@@ -215,3 +215,18 @@ def test_decode_partial_survivors_treated_as_erased():
     # withholding two more exceeds m -> must raise, not corrupt
     with pytest.raises(ValueError):
         coder.decode_chunks([0], {c: full[c] for c in (1, 2, 3)})
+
+
+def test_decode_passthrough_of_provided_wanted_chunks():
+    # minimum_to_decode with no erasure says "read the chunks themselves";
+    # decode_chunks must then return them, not raise
+    coder = make(4, 2, 5)
+    full, L = rand_chunks(coder)
+    got = coder.decode_chunks([0, 1], {0: full[0], 1: full[1]})
+    np.testing.assert_array_equal(got[0], full[0])
+    np.testing.assert_array_equal(got[1], full[1])
+    # mixed: one provided, one missing (degraded read)
+    have = {c: full[c] for c in (1, 2, 3, 4)}
+    got = coder.decode_chunks([0, 1], have)
+    np.testing.assert_array_equal(got[0], full[0])
+    np.testing.assert_array_equal(got[1], full[1])

@@ -80,9 +80,12 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, size=(batch, k, cs), dtype=np.uint8)
 
-    if hasattr(coder, "matrix"):
-        # RS-family fast path: time the raw device kernel (the measured
-        # region of ceph_erasure_code_benchmark — codec math only)
+    from ceph_tpu.ec.rs import ReedSolomon
+    if isinstance(coder, ReedSolomon):
+        # plain-MDS fast path: time the raw device kernel (the measured
+        # region of ceph_erasure_code_benchmark — codec math only).
+        # Layered / non-MDS plugins (lrc, clay, shec) have their own
+        # decode planning and must NOT take this path.
         dev_data = jax.device_put(data)
         if workload == "encode":
             fn = make_encoder(coder.matrix, impl_used)
@@ -102,7 +105,8 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
         out.block_until_ready()
         dt = time.perf_counter() - t0
     else:
-        # layered plugins (clay, lrc): time the full plugin path
+        # layered / non-MDS plugins (clay, lrc, shec): time the full
+        # plugin path, including their own recovery planning
         impl_used = getattr(coder, "impl", impl_used)
         if workload == "encode":
             run = lambda: coder.encode_chunks(data)  # noqa: E731
@@ -114,7 +118,12 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
             full = {i: data[:, i, :] for i in range(k)}
             full.update({k + j: parity[:, j, :] for j in range(m)})
             ers = list(range(erasures))
-            have = {c: full[c] for c in full if c not in set(ers)}
+            try:
+                need = coder.minimum_to_decode(
+                    ers, [c for c in full if c not in set(ers)])
+            except ValueError as e:
+                raise SystemExit(str(e))
+            have = {c: full[c] for c in need if c not in set(ers)}
             run = lambda: coder.decode_chunks(ers, have)  # noqa: E731
         run()  # warmup / compile
         t0 = time.perf_counter()
@@ -143,12 +152,17 @@ def main(argv=None) -> None:
     except ValueError as e:
         raise SystemExit(f"--parameter: {e}")
     plugin_name = args.plugin or profile.get("plugin", "tpu_rs")
+    from ceph_tpu.ec import registry
+    from ceph_tpu.ec.rs import ReedSolomon
+    registry._ensure_loaded()
+    fac = registry._REGISTRY.get(plugin_name)
+    plain_rs = isinstance(fac, type) and issubclass(fac, ReedSolomon)
     if args.impl and args.impl != "auto":
         impls = [args.impl]
-    elif plugin_name in ("clay", "lrc", "tpu_lrc"):
-        impls = [None]  # layered plugins pick their own kernel impl
-    else:
+    elif plain_rs:
         impls = ["bitlinear", "mxu"]
+    else:
+        impls = [None]  # layered plugins pick their own kernel impl
     results = [run_bench(args.plugin, profile, args.size, args.batch,
                          args.iterations, args.workload, args.erasures, i)
                for i in impls]
