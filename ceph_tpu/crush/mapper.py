@@ -1,0 +1,316 @@
+"""Vectorized CRUSH mapper — crush_do_rule over a batch of PGs at once.
+
+The TPU rebuild of the reference's hot placement loop (ref:
+src/crush/mapper.c crush_do_rule / crush_choose_{firstn,indep} /
+bucket_straw2_choose — SURVEY.md §3.4): placement is pure integer math,
+so the whole rule program is executed as fixed-shape array ops over a
+(B,) batch of inputs. Data-dependent retry loops become a static unroll
+(tunables.choose_total_tries) with lane masks; the bucket hierarchy
+descent becomes max_depth gather steps; every draw stays uint32/float32
+so results are bit-identical to the scalar oracle (oracle.py) — pinned
+by parity tests.
+
+Call shape: VectorMapper(map).do_rule(rule_id, xs, weights, result_max)
+-> (B, R) int32 device ids with CRUSH_ITEM_NONE holes (indep) or
+NONE-padded tails (firstn).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hash import hash32_2, hash32_3, hash32_4
+from .map import (ALG_LIST, ALG_STRAW2, ALG_UNIFORM, CRUSH_ITEM_NONE,
+                  CrushMap, STEP_CHOOSE_FIRSTN, STEP_CHOOSE_INDEP,
+                  STEP_CHOOSELEAF_FIRSTN, STEP_CHOOSELEAF_INDEP, STEP_EMIT,
+                  STEP_TAKE)
+from .oracle import ln16_table
+
+_NONE = np.int32(CRUSH_ITEM_NONE)
+
+
+class VectorMapper:
+    def __init__(self, m: CrushMap):
+        self.m = m
+        p = m.pack()
+        self.tries = m.tunables.choose_total_tries
+        self.max_depth = p.max_depth
+        self.S = p.max_size
+        # device-resident map tables
+        self.t_items = jnp.asarray(p.items)                    # (NB, S) i32
+        self.t_w32 = jnp.asarray(
+            (p.weights.astype(np.float64) / 65536.0).astype(np.float32))
+        self.t_wzero = jnp.asarray(p.weights == 0)             # (NB, S)
+        self.t_size = jnp.asarray(p.size)                      # (NB,)
+        self.t_alg = jnp.asarray(p.alg)
+        self.t_type = jnp.asarray(p.type_id)
+        # list-bucket cumulative weights, split for 32-bit exact math
+        sw = p.sum_weights.astype(np.uint64)
+        self.t_sw_lo = jnp.asarray((sw & 0xFFFF).astype(np.uint32))
+        self.t_sw_hi = jnp.asarray((sw >> 16).astype(np.uint32))
+        self.t_iw_u32 = jnp.asarray(p.weights.astype(np.uint32))
+        self.t_ln16 = jnp.asarray(ln16_table())
+        self.algs_used = set(int(a) for a in np.unique(p.alg) if a != 0)
+        self.S_uniform = p.max_size_by_alg.get(ALG_UNIFORM, 1)
+        self._jitted = {}
+
+    # -- bucket choose (batched over lanes) ---------------------------------
+
+    def _rows(self, node):
+        """bucket id (negative) -> packed row; invalid lanes -> row 0."""
+        row = -1 - node
+        return jnp.clip(row, 0, self.t_items.shape[0] - 1)
+
+    def _straw2(self, row, x, r):
+        items = self.t_items[row]                       # (B, S)
+        w32 = self.t_w32[row]
+        slot_ok = (jnp.arange(self.S)[None, :] < self.t_size[row][:, None]) \
+            & ~self.t_wzero[row]
+        r_b = jnp.asarray(r, jnp.uint32)
+        r_b = r_b[:, None] if r_b.ndim else r_b
+        h = hash32_3(x[:, None], items.astype(jnp.uint32), r_b, np_like=jnp)
+        draws = self.t_ln16[(h & jnp.uint32(0xFFFF)).astype(jnp.int32)] / w32
+        draws = jnp.where(slot_ok, draws, -jnp.inf)
+        best = jnp.argmax(draws, axis=1)
+        item = jnp.take_along_axis(items, best[:, None], axis=1)[:, 0]
+        any_ok = slot_ok.any(axis=1)
+        return jnp.where(any_ok, item, _NONE)
+
+    def _uniform(self, row, x, r):
+        size = self.t_size[row]                         # (B,)
+        bid = (-1 - row).astype(jnp.uint32)
+        B = row.shape[0]
+        # unroll bound: largest UNIFORM bucket, not the global max size
+        # (a big straw2 root must not bloat every uniform choose)
+        SU = self.S_uniform
+        perm = jnp.broadcast_to(jnp.arange(SU, dtype=jnp.int32), (B, SU))
+        cols = jnp.arange(SU, dtype=jnp.int32)[None, :]
+        for i in range(SU - 1):
+            rem = jnp.maximum(size - i, 1)
+            h = hash32_3(x, bid, jnp.uint32(i), np_like=jnp)
+            j = i + (h % rem.astype(jnp.uint32)).astype(jnp.int32)
+            vi = perm[:, i]
+            vj = jnp.take_along_axis(perm, j[:, None], axis=1)[:, 0]
+            active = (i < size)[:, None]
+            swapped = jnp.where(cols == i, vj[:, None],
+                                jnp.where(cols == j[:, None], vi[:, None],
+                                          perm))
+            perm = jnp.where(active, swapped, perm)
+        r_arr = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (B,)) \
+            if jnp.ndim(r) == 0 else r.astype(jnp.int32)
+        pr = r_arr % jnp.maximum(size, 1)
+        slot = jnp.take_along_axis(perm, pr[:, None], axis=1)[:, 0]
+        item = jnp.take_along_axis(self.t_items[row], slot[:, None],
+                                   axis=1)[:, 0]
+        return jnp.where(size > 0, item, _NONE)
+
+    def _list(self, row, x, r):
+        items = self.t_items[row]
+        bid = (-1 - row).astype(jnp.uint32)
+        r_b = jnp.asarray(r, jnp.uint32)
+        r_b = r_b[:, None] if r_b.ndim else r_b
+        h = hash32_4(x[:, None], items.astype(jnp.uint32), r_b,
+                     bid[:, None], np_like=jnp)
+        h16 = h & jnp.uint32(0xFFFF)
+        # exact floor((h16 * sum_w) / 2^16) < item_w in 32-bit pieces
+        p_lo = h16 * self.t_sw_lo[row]
+        p_hi = h16 * self.t_sw_hi[row]
+        lhs = p_hi + (p_lo >> 16)
+        cond = lhs < self.t_iw_u32[row]
+        slot_ok = jnp.arange(self.S)[None, :] < self.t_size[row][:, None]
+        mask = cond & slot_ok
+        rev = mask[:, ::-1]
+        pos = jnp.argmax(rev, axis=1)
+        idx = self.S - 1 - pos
+        found = rev.any(axis=1)
+        slot = jnp.where(found, idx, 0)
+        item = jnp.take_along_axis(items, slot[:, None], axis=1)[:, 0]
+        return jnp.where(self.t_size[row] > 0, item, _NONE)
+
+    def _bucket_choose(self, node, x, r):
+        """node (B,) bucket ids (negative) -> chosen child item (B,)."""
+        row = self._rows(node)
+        alg = self.t_alg[row]
+        out = jnp.full(node.shape, _NONE, dtype=jnp.int32)
+        if ALG_STRAW2 in self.algs_used:
+            out = jnp.where(alg == ALG_STRAW2, self._straw2(row, x, r), out)
+        if ALG_UNIFORM in self.algs_used:
+            out = jnp.where(alg == ALG_UNIFORM, self._uniform(row, x, r), out)
+        if ALG_LIST in self.algs_used:
+            out = jnp.where(alg == ALG_LIST, self._list(row, x, r), out)
+        return out
+
+    # -- descent / rejection ------------------------------------------------
+
+    def _item_type(self, item):
+        row = self._rows(item)
+        return jnp.where(item >= 0, 0, self.t_type[row])
+
+    def _descend(self, node, x, r, want_type: int):
+        cur = node
+        for _ in range(self.max_depth + 1):
+            t = self._item_type(cur)
+            done = (t == want_type) | (cur == _NONE)
+            dead_end = (cur >= 0) & (t != want_type)
+            active = ~done & ~dead_end
+            nxt = self._bucket_choose(jnp.where(active, cur, -1), x, r)
+            cur = jnp.where(active, nxt, jnp.where(dead_end, _NONE, cur))
+        final_ok = self._item_type(cur) == want_type
+        return jnp.where(final_ok & (cur != _NONE), cur, _NONE)
+
+    def _is_out(self, weights, item, x):
+        """weights: (n_devices,) int32 16.16; item may be NONE/bucket."""
+        dev = jnp.clip(item, 0, weights.shape[0] - 1)
+        w = weights[dev]
+        h16 = hash32_2(x, item.astype(jnp.uint32), np_like=jnp) \
+            & jnp.uint32(0xFFFF)
+        rejected = jnp.where(w >= 0x10000, False,
+                             jnp.where(w == 0, True,
+                                       h16.astype(jnp.int32) >= w))
+        return jnp.where(item >= 0, rejected, False)
+
+    # -- choose -------------------------------------------------------------
+
+    def _choose_indep(self, take, x, numrep: int, want_type: int,
+                      weights, to_leaf: bool):
+        B = x.shape[0]
+        out0 = jnp.full((B, numrep), _NONE, dtype=jnp.int32)
+        leaves0 = jnp.full((B, numrep), _NONE, dtype=jnp.int32)
+
+        # one retry round is traced once; lax.fori_loop runs `tries` of
+        # them (the reference's data-dependent retry loop, made static)
+        def round_body(rnd, carry):
+            out, leaves = carry
+            for rep in range(numrep):
+                r = (jnp.uint32(rep) + rnd.astype(jnp.uint32)
+                     * jnp.uint32(numrep))
+                undecided = out[:, rep] == _NONE
+                item = self._descend(take, x, r, want_type)
+                valid = item != _NONE
+                collide = (item[:, None] == out).any(axis=1)
+                ok = undecided & valid & ~collide
+                if to_leaf:
+                    leaf = self._descend(jnp.where(valid, item, -1), x, r, 0)
+                    lvalid = (leaf != _NONE) \
+                        & ~(leaf[:, None] == leaves).any(axis=1) \
+                        & ~self._is_out(weights, leaf, x)
+                    ok = ok & lvalid
+                    leaves = leaves.at[:, rep].set(
+                        jnp.where(ok, leaf, leaves[:, rep]))
+                else:
+                    ok = ok & ~self._is_out(weights, item, x)
+                out = out.at[:, rep].set(jnp.where(ok, item, out[:, rep]))
+            return out, leaves
+
+        def cond(state):
+            rnd, (out, leaves) = state
+            undecided = ((leaves if to_leaf else out) == _NONE).any()
+            return (rnd < self.tries) & undecided
+
+        def body(state):
+            rnd, carry = state
+            return rnd + 1, round_body(rnd, carry)
+
+        # while_loop instead of a fixed unroll: nearly every lane
+        # succeeds in round 0, so the retry rounds only run (for the
+        # whole batch) while some slot is still NONE
+        _, (out, leaves) = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), (out0, leaves0)))
+        return leaves if to_leaf else out
+
+    def _choose_firstn(self, take, x, numrep: int, want_type: int,
+                       weights, to_leaf: bool):
+        B = x.shape[0]
+        out0 = jnp.full((B, numrep), _NONE, dtype=jnp.int32)
+        leaves0 = jnp.full((B, numrep), _NONE, dtype=jnp.int32)
+        ftotal0 = jnp.zeros((B,), dtype=jnp.int32)
+
+        def make_attempt(rep):
+            def attempt(_t, carry):
+                out, leaves, ftotal, found = carry
+                active = ~found & (ftotal < self.tries)
+                r = (jnp.int32(rep) + ftotal).astype(jnp.uint32)
+                item = self._descend(take, x, r, want_type)
+                valid = item != _NONE
+                collide = (item[:, None] == out).any(axis=1)
+                ok = active & valid & ~collide
+                if to_leaf:
+                    leaf = self._descend(jnp.where(valid, item, -1), x, r, 0)
+                    lvalid = (leaf != _NONE) \
+                        & ~(leaf[:, None] == leaves).any(axis=1) \
+                        & ~self._is_out(weights, leaf, x)
+                    ok = ok & lvalid
+                    leaves = leaves.at[:, rep].set(
+                        jnp.where(ok, leaf, leaves[:, rep]))
+                else:
+                    ok = ok & ~self._is_out(weights, item, x)
+                out = out.at[:, rep].set(jnp.where(ok, item, out[:, rep]))
+                ftotal = jnp.where(active & ~ok, ftotal + 1, ftotal)
+                found = found | ok
+                return out, leaves, ftotal, found
+
+            return attempt
+
+        out, leaves, ftotal = out0, leaves0, ftotal0
+        for rep in range(numrep):
+            found = jnp.zeros((B,), dtype=bool)
+            attempt = make_attempt(rep)
+
+            def cond(carry):
+                _out, _leaves, ft, fnd = carry
+                return (~fnd & (ft < self.tries)).any()
+
+            def body(carry):
+                return attempt(0, carry)
+
+            out, leaves, ftotal, found = jax.lax.while_loop(
+                cond, body, (out, leaves, ftotal, found))
+        return leaves if to_leaf else out
+
+    # -- rule execution -----------------------------------------------------
+
+    def _do_rule_impl(self, rule_id: int, result_max: int, xs, weights):
+        rule = self.m.rules[rule_id]
+        working = None
+        results = []
+        B = xs.shape[0]
+        for step in rule.steps:
+            if step.op == STEP_TAKE:
+                working = jnp.full((B, 1), np.int32(step.arg), jnp.int32)
+            elif step.op == STEP_EMIT:
+                results.append(working)
+                working = None
+            else:
+                numrep = step.arg if step.arg > 0 else result_max + step.arg
+                indep = step.op in (STEP_CHOOSE_INDEP, STEP_CHOOSELEAF_INDEP)
+                to_leaf = step.op in (STEP_CHOOSELEAF_FIRSTN,
+                                      STEP_CHOOSELEAF_INDEP)
+                fn = self._choose_indep if indep else self._choose_firstn
+                cols = []
+                for w in range(working.shape[1]):
+                    cols.append(fn(working[:, w], xs, numrep, step.type_id,
+                                   weights, to_leaf))
+                working = jnp.concatenate(cols, axis=1)
+        return jnp.concatenate(results, axis=1)
+
+    def do_rule(self, rule_id: int, xs, weights, result_max: int):
+        """xs: (B,) int/uint32 PG seeds; weights: (n_devices,) 16.16
+        int32 reweights. Returns (B, R) int32 items, CRUSH_ITEM_NONE
+        for unfilled slots."""
+        key = (rule_id, result_max)
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = jax.jit(functools.partial(self._do_rule_impl, rule_id,
+                                           result_max))
+            self._jitted[key] = fn
+        xs = jnp.asarray(xs).astype(jnp.uint32)
+        weights = jnp.asarray(weights, jnp.int32)
+        return fn(xs, weights)
+
+
+def full_weights(n_devices: int) -> np.ndarray:
+    return np.full(n_devices, 0x10000, dtype=np.int32)

@@ -1,0 +1,257 @@
+"""OSDMap — the epoch-versioned cluster map: object -> PG -> OSDs.
+
+Rebuild of the reference's placement layer above CRUSH (ref:
+src/osd/OSDMap.{h,cc} — object_locator_to_pg, raw_pg_to_pps via
+ceph_stable_mod, _pg_to_raw_osds, pg_to_up_acting_osds with
+pg_temp/primary_temp overrides; pool model ref: pg_pool_t in
+src/osd/osd_types.h; string hash ref: src/common/ceph_hash.cc
+ceph_str_hash_rjenkins).
+
+TPU-first shape: the per-PG scalar path exists for parity/debugging,
+but the real API is the batched one — `pgs_to_up(pool, ps_array)`
+pushes the whole PG population through the vectorized CRUSH mapper in
+one device launch; sparse pg_temp/primary_temp overrides are applied
+host-side after (they are rare, transient backfill state).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..crush.hash import hash32_2
+from ..crush.map import CRUSH_ITEM_NONE, CrushMap
+from ..crush.mapper import VectorMapper
+from ..crush.oracle import OracleMapper
+
+
+def ceph_stable_mod(x: int | np.ndarray, b: int, bmask: int):
+    """Stable modulo: doubling b reshuffles only the new half of the
+    space (what makes pg_num growth cheap)."""
+    lo = x & bmask
+    return np.where(lo < b, lo, x & (bmask >> 1)) if isinstance(
+        x, np.ndarray) else (lo if lo < b else x & (bmask >> 1))
+
+
+def pg_num_mask(pg_num: int) -> int:
+    """Smallest 2^n-1 >= pg_num-1 (the reference's calc_pg_masks)."""
+    if pg_num < 1:
+        raise ValueError("pg_num must be >= 1")
+    return (1 << (pg_num - 1).bit_length()) - 1
+
+
+def str_hash_rjenkins(s: bytes | str) -> int:
+    """Bob Jenkins' lookup2 string hash, the object-name hash (role of
+    ceph_str_hash_rjenkins). Shares the mixing round with crush.hash."""
+    if isinstance(s, str):
+        s = s.encode()
+    M = 0xFFFFFFFF
+
+    def mix(a, b, c):
+        from ..crush.hash import _mix
+        with np.errstate(over="ignore"):
+            a, b, c = _mix(np.uint32(a), np.uint32(b), np.uint32(c))
+        return int(a), int(b), int(c)
+
+    a = b = 0x9E3779B9
+    c = 0
+    n = len(s)
+    i = 0
+    while n - i >= 12:
+        a = (a + int.from_bytes(s[i:i + 4], "little")) & M
+        b = (b + int.from_bytes(s[i + 4:i + 8], "little")) & M
+        c = (c + int.from_bytes(s[i + 8:i + 12], "little")) & M
+        a, b, c = mix(a, b, c)
+        i += 12
+    c = (c + n) & M
+    tail = s[i:]
+    for idx, shift in ((10, 24), (9, 16), (8, 8)):
+        if len(tail) > idx:
+            c = (c + (tail[idx] << shift)) & M
+    for idx, shift in ((7, 24), (6, 16), (5, 8), (4, 0)):
+        if len(tail) > idx:
+            b = (b + (tail[idx] << shift)) & M
+    for idx, shift in ((3, 24), (2, 16), (1, 8), (0, 0)):
+        if len(tail) > idx:
+            a = (a + (tail[idx] << shift)) & M
+    a, b, c = mix(a, b, c)
+    return c
+
+
+@dataclass
+class PGPool:
+    """pg_pool_t equivalent: placement parameters of one pool."""
+    pool_id: int
+    pg_num: int
+    size: int                      # replicas / k+m
+    min_size: int
+    crush_rule: int
+    is_erasure: bool = False
+    pgp_num: int | None = None
+    ec_profile: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.pgp_num is None:
+            self.pgp_num = self.pg_num
+        self.pg_mask = pg_num_mask(self.pg_num)
+        self.pgp_mask = pg_num_mask(self.pgp_num)
+
+    def raw_pg_to_pps(self, ps: int | np.ndarray):
+        """Placement seed: stable-mod onto pgp_num then mix with the
+        pool id (the HASHPSPOOL behavior, the modern default)."""
+        m = ceph_stable_mod(ps, self.pgp_num, self.pgp_mask)
+        if isinstance(ps, np.ndarray):
+            return np.asarray(hash32_2(m.astype(np.uint32),
+                                       np.uint32(self.pool_id)))
+        return int(hash32_2(np.uint32(m), np.uint32(self.pool_id)))
+
+
+class OSDMap:
+    """Cluster map: CRUSH topology + pools + per-OSD runtime state."""
+
+    def __init__(self, crush: CrushMap, epoch: int = 1):
+        self.crush = crush
+        self.epoch = epoch
+        self.pools: dict[int, PGPool] = {}
+        n = crush.n_devices
+        self.osd_weight = np.full(n, 0x10000, dtype=np.int32)  # in/out 16.16
+        self.osd_up = np.ones(n, dtype=bool)
+        self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        self.primary_temp: dict[tuple[int, int], int] = {}
+        self._vm = VectorMapper(crush)
+        self._om = OracleMapper(crush)
+
+    # -- mutators (each bumps the epoch like an inc map) -------------------
+
+    def _bump(self):
+        self.epoch += 1
+
+    def add_pool(self, pool: PGPool) -> None:
+        if pool.crush_rule not in self.crush.rules:
+            raise ValueError(f"pool rule {pool.crush_rule} not in crush map")
+        self.pools[pool.pool_id] = pool
+        self._bump()
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_up[osd] = False
+        self._bump()
+
+    def mark_up(self, osd: int) -> None:
+        self.osd_up[osd] = True
+        self._bump()
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+        self._bump()
+
+    def mark_in(self, osd: int, weight: float = 1.0) -> None:
+        self.osd_weight[osd] = int(weight * 0x10000)
+        self._bump()
+
+    def set_pg_temp(self, pg: tuple[int, int], acting: list[int]) -> None:
+        if acting:
+            self.pg_temp[pg] = list(acting)
+        else:
+            self.pg_temp.pop(pg, None)
+        self._bump()
+
+    def set_primary_temp(self, pg: tuple[int, int], osd: int | None) -> None:
+        if osd is None:
+            self.primary_temp.pop(pg, None)
+        else:
+            self.primary_temp[pg] = osd
+        self._bump()
+
+    # -- object -> PG -------------------------------------------------------
+
+    def object_to_pg(self, pool_id: int, name: bytes | str) -> tuple[int, int]:
+        pool = self.pools[pool_id]
+        ps = ceph_stable_mod(str_hash_rjenkins(name), pool.pg_num,
+                             pool.pg_mask)
+        return (pool_id, ps)
+
+    # -- PG -> OSDs ---------------------------------------------------------
+
+    def _raw_pg_to_osds(self, pool: PGPool, ps: int) -> list[int]:
+        pps = pool.raw_pg_to_pps(ps)
+        out = self._om.do_rule(pool.crush_rule, pps, self.osd_weight,
+                               pool.size)
+        return (out + [CRUSH_ITEM_NONE] * pool.size)[:pool.size]
+
+    def _up_from_raw(self, raw: list[int]) -> list[int]:
+        """raw -> up: down OSDs become NONE holes (EC keeps slot order;
+        the reference filters in _raw_to_up_osds)."""
+        return [o if (o != CRUSH_ITEM_NONE and o < len(self.osd_up)
+                      and self.osd_up[o]) else CRUSH_ITEM_NONE for o in raw]
+
+    @staticmethod
+    def _primary_of(osds: list[int]) -> int:
+        for o in osds:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return -1
+
+    def pg_to_up_acting_osds(self, pool_id: int, ps: int):
+        """Returns (up, up_primary, acting, acting_primary) — the full
+        override pipeline: raw CRUSH -> drop down OSDs -> pg_temp /
+        primary_temp."""
+        pool = self.pools[pool_id]
+        raw = self._raw_pg_to_osds(pool, ps)
+        up = self._up_from_raw(raw)
+        up_primary = self._primary_of(up)
+        acting = self.pg_temp.get((pool_id, ps), up)
+        acting_primary = self.primary_temp.get((pool_id, ps),
+                                               self._primary_of(acting))
+        return up, up_primary, acting, acting_primary
+
+    def pg_to_acting_osds(self, pool_id: int, ps: int) -> list[int]:
+        return self.pg_to_up_acting_osds(pool_id, ps)[2]
+
+    # -- batched PG -> OSDs (the TPU path) ----------------------------------
+
+    def pgs_to_up(self, pool_id: int, ps: np.ndarray | None = None):
+        """Map ALL (or the given) PGs of a pool in one vectorized launch.
+
+        Returns (B, size) int32 UP sets with CRUSH_ITEM_NONE holes.
+        Like the scalar path, pg_temp does NOT affect up — it only
+        overrides acting (see pgs_to_acting).
+        """
+        pool = self.pools[pool_id]
+        if ps is None:
+            ps = np.arange(pool.pg_num, dtype=np.uint32)
+        ps = np.asarray(ps, np.uint32)
+        pps = pool.raw_pg_to_pps(ps)
+        raw = np.asarray(self._vm.do_rule(pool.crush_rule, pps,
+                                          self.osd_weight, pool.size))
+        raw = raw[:, :pool.size]
+        # down OSDs -> NONE
+        down_lut = ~self.osd_up
+        idx = np.clip(raw, 0, len(self.osd_up) - 1)
+        is_down = np.where(raw >= 0, down_lut[idx], False)
+        return np.where(is_down, np.int32(CRUSH_ITEM_NONE), raw)
+
+    def pgs_to_acting(self, pool_id: int, ps: np.ndarray | None = None):
+        """Batched acting sets: up overridden by the sparse pg_temp
+        entries (host-side; backfill state is rare and transient)."""
+        pool = self.pools[pool_id]
+        if ps is None:
+            ps = np.arange(pool.pg_num, dtype=np.uint32)
+        ps = np.asarray(ps, np.uint32)
+        acting = self.pgs_to_up(pool_id, ps).copy()
+        for (pid, s), override in self.pg_temp.items():
+            if pid == pool_id:
+                hit = np.nonzero(ps == s)[0]
+                if hit.size:
+                    row = (list(override) + [CRUSH_ITEM_NONE] * pool.size)
+                    acting[hit[0]] = row[:pool.size]
+        return acting
+
+    def pg_stats(self, pool_id: int):
+        """Placement summary over the whole pool: per-OSD PG counts and
+        degraded (holey) PG count — what `ceph osd df` surfaces."""
+        up = self.pgs_to_up(pool_id)
+        real = up[up != CRUSH_ITEM_NONE]
+        counts = np.bincount(real, minlength=len(self.osd_up))
+        degraded = int((up == CRUSH_ITEM_NONE).any(axis=1).sum())
+        return {"pg_per_osd": counts, "degraded_pgs": degraded}

@@ -1,0 +1,261 @@
+"""CRUSH tests — parity (vectorized == scalar oracle, bit-for-bit),
+weighted-distribution quality, failure-domain separation, and placement
+stability under device loss (mirrors src/test/crush/* properties and
+crushtool --test workflows)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import hash as H
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, CrushMap, Step, Tunables,
+                                build_hierarchy, ec_rule, replicated_rule)
+from ceph_tpu.crush.mapper import VectorMapper, full_weights
+from ceph_tpu.crush.oracle import OracleMapper
+
+np.seterr(over="ignore")
+
+
+# ------------------------------------------------------------------ hash
+
+def test_hash_backends_agree():
+    import jax.numpy as jnp
+    xs = (np.arange(100, dtype=np.uint64) * 2654435761 % (1 << 32)).astype(
+        np.uint32)
+    for fn, n in ((H.hash32_1, 1), (H.hash32_2, 2), (H.hash32_3, 3),
+                  (H.hash32_4, 4), (H.hash32_5, 5)):
+        args_np = [xs + i for i in range(n)]
+        args_j = [jnp.asarray(a) for a in args_np]
+        got_np = fn(*args_np)
+        got_j = np.asarray(fn(*args_j, np_like=jnp))
+        np.testing.assert_array_equal(got_np, got_j, err_msg=f"hash32_{n}")
+
+
+def test_hash_is_deterministic_and_mixing():
+    a = H.hash32_2(np.uint32(1), np.uint32(2))
+    b = H.hash32_2(np.uint32(1), np.uint32(2))
+    assert int(a) == int(b)
+    # flipping one input bit flips ~half the output bits on average
+    flips = []
+    for i in range(200):
+        x = np.uint32(i)
+        h0 = int(H.hash32_2(x, np.uint32(7)))
+        h1 = int(H.hash32_2(x ^ np.uint32(1), np.uint32(7)))
+        flips.append(bin(h0 ^ h1).count("1"))
+    assert 10 < np.mean(flips) < 22
+
+
+# -------------------------------------------------------------- map model
+
+def make_map(n_osds=32, osds_per_host=4, hosts_per_rack=4, alg="straw2",
+             tries=7):
+    m = build_hierarchy(n_osds, osds_per_host, hosts_per_rack, alg=alg)
+    m.tunables = Tunables(choose_total_tries=tries)
+    replicated_rule(m, 0, choose_type=1, firstn=True)
+    ec_rule(m, 1, choose_type=1)
+    return m
+
+
+def test_map_build_and_pack():
+    m = make_map(32, 4, 4)
+    p = m.pack()
+    assert m.n_devices == 32
+    assert p.max_depth == 3  # root -> rack -> host -> osd
+    assert p.items.shape[1] >= 4
+    m.validate()
+
+
+def test_bad_maps_rejected():
+    m = CrushMap()
+    with pytest.raises(ValueError):
+        m.add_bucket(1, 1, "straw2", [0])     # positive id
+    with pytest.raises(ValueError):
+        m.add_bucket(-1, 1, "tree", [0])      # unsupported alg
+    m.add_bucket(-1, 1, "straw2", [0, -5])    # dangling ref
+    with pytest.raises(ValueError):
+        m.validate()
+
+
+# ----------------------------------------------------- oracle vs vectorized
+
+@pytest.mark.parametrize("alg", ["straw2", "uniform", "list"])
+@pytest.mark.parametrize("rule_id,n", [(0, 3), (1, 4)])
+def test_parity_oracle_vs_vectorized(alg, rule_id, n):
+    m = make_map(32, 4, 4, alg=alg)
+    om = OracleMapper(m)
+    vm = VectorMapper(m)
+    weights = full_weights(32)
+    xs = np.arange(64, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(rule_id, xs, weights, n))
+    for i, x in enumerate(xs):
+        want = om.do_rule(rule_id, int(x), weights, n)
+        want = (want + [CRUSH_ITEM_NONE] * n)[:n]
+        assert got[i].tolist() == want, f"x={x} alg={alg} rule={rule_id}"
+
+
+def test_parity_with_reweights_and_out_osds():
+    m = make_map(32, 4, 4)
+    om, vm = OracleMapper(m), VectorMapper(m)
+    weights = full_weights(32)
+    weights[3] = 0                 # out
+    weights[7] = 0x8000            # half reweight
+    weights[12] = 0x4000
+    xs = np.arange(128, dtype=np.uint32)
+    for rule_id, n in ((0, 3), (1, 4)):
+        got = np.asarray(vm.do_rule(rule_id, xs, weights, n))
+        for i, x in enumerate(xs):
+            want = om.do_rule(rule_id, int(x), weights, n)
+            want = (want + [CRUSH_ITEM_NONE] * n)[:n]
+            assert got[i].tolist() == want, f"x={x} rule={rule_id}"
+        assert not (got == 3).any()  # out osd never chosen
+
+
+def test_parity_multi_step_rule():
+    # take -> choose 2 racks -> chooseleaf 2 hosts each -> emit
+    m = build_hierarchy(32, 4, 2)
+    m.tunables = Tunables(choose_total_tries=7)
+    from ceph_tpu.crush.map import STEP_CHOOSE_INDEP, STEP_CHOOSELEAF_INDEP, STEP_EMIT, STEP_TAKE
+    m.add_rule(2, [Step(STEP_TAKE, arg=m.root_id),
+                   Step(STEP_CHOOSE_INDEP, arg=2, type_id=2),
+                   Step(STEP_CHOOSELEAF_INDEP, arg=2, type_id=1),
+                   Step(STEP_EMIT)])
+    om, vm = OracleMapper(m), VectorMapper(m)
+    weights = full_weights(32)
+    xs = np.arange(48, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(2, xs, weights, 4))
+    for i, x in enumerate(xs):
+        want = om.do_rule(2, int(x), weights, 4)
+        assert got[i].tolist() == want, f"x={x}"
+
+
+# ------------------------------------------------------------ distribution
+
+def test_indep_fills_all_slots_and_separates_hosts():
+    m = make_map(64, 4, 4)
+    vm = VectorMapper(m)
+    xs = np.arange(2000, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(1, xs, full_weights(64), 4))
+    assert (got != CRUSH_ITEM_NONE).mean() > 0.999
+    hosts = np.where(got == CRUSH_ITEM_NONE, -1, got // 4)
+    for row, hr in zip(got, hosts):
+        real = hr[row != CRUSH_ITEM_NONE]
+        assert len(set(real.tolist())) == len(real), f"{row}"
+
+
+def test_weighted_distribution_tracks_weights():
+    # one host has double-weight osds -> should receive ~2x objects
+    m = CrushMap()
+    m.add_type(1, "host")
+    m.add_type(3, "root")
+    m.add_bucket(-1, 1, "straw2", [0, 1], [1.0, 1.0], name="h0")
+    m.add_bucket(-2, 1, "straw2", [2, 3], [2.0, 2.0], name="h1")
+    m.add_bucket(-3, 3, "straw2", [-1, -2], [2.0, 4.0], name="root")
+    m.root_id = -3
+    replicated_rule(m, 0, choose_type=1)
+    vm = VectorMapper(m)
+    xs = np.arange(30000, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(0, xs, full_weights(4), 1))[:, 0]
+    counts = np.bincount(got, minlength=4)
+    light = counts[0] + counts[1]
+    heavy = counts[2] + counts[3]
+    assert 1.8 < heavy / light < 2.2
+    # and osds inside a host split evenly
+    assert 0.9 < counts[0] / counts[1] < 1.1
+
+
+def test_uniform_bucket_distribution():
+    m = CrushMap()
+    m.add_type(3, "root")
+    m.add_bucket(-1, 3, "uniform", list(range(8)), name="root")
+    m.root_id = -1
+    replicated_rule(m, 0, choose_type=0)
+    vm = VectorMapper(m)
+    xs = np.arange(16000, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(0, xs, full_weights(8), 1))[:, 0]
+    counts = np.bincount(got, minlength=8)
+    assert counts.min() > 0.8 * counts.mean()
+    assert counts.max() < 1.2 * counts.mean()
+
+
+# --------------------------------------------------------------- stability
+
+def test_failure_moves_only_affected_replicas():
+    m = make_map(64, 4, 4)
+    vm = VectorMapper(m)
+    xs = np.arange(4000, dtype=np.uint32)
+    w0 = full_weights(64)
+    before = np.asarray(vm.do_rule(1, xs, w0, 4))
+    w1 = w0.copy()
+    w1[10] = 0  # fail osd 10
+    after = np.asarray(vm.do_rule(1, xs, w1, 4))
+    assert not (after == 10).any()
+    # slots that did not reference osd.10 must stay identical (indep
+    # placement independence — the property EC backfill relies on)
+    unaffected = before != 10
+    same = (before == after) | ~unaffected
+    assert same.mean() > 0.98
+
+
+def test_marking_out_rebalances_proportionally():
+    m = make_map(32, 4, 4)
+    vm = VectorMapper(m)
+    xs = np.arange(8000, dtype=np.uint32)
+    w = full_weights(32)
+    before = np.asarray(vm.do_rule(0, xs, w, 3))
+    w2 = w.copy()
+    w2[0] = 0
+    after = np.asarray(vm.do_rule(0, xs, w2, 3))
+    moved = (before != after).mean()
+    assert moved < 0.15  # only ~1/32 of data plus collateral moves
+
+
+def test_all_zero_weight_bucket_parity():
+    # a fully drained host: both mappers must agree (NONE -> retry)
+    m = CrushMap()
+    m.add_type(1, "host")
+    m.add_type(3, "root")
+    m.add_bucket(-1, 1, "straw2", [0, 1], [0.0, 0.0], name="drained")
+    m.add_bucket(-2, 1, "straw2", [2, 3], [1.0, 1.0], name="alive")
+    m.add_bucket(-3, 3, "straw2", [-1, -2], [0.0, 2.0], name="root")
+    m.root_id = -3
+    replicated_rule(m, 0, choose_type=1)
+    om, vm = OracleMapper(m), VectorMapper(m)
+    w = full_weights(4)
+    xs = np.arange(64, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(0, xs, w, 2))
+    for i, x in enumerate(xs):
+        want = om.do_rule(0, int(x), w, 2)
+        want = (want + [CRUSH_ITEM_NONE] * 2)[:2]
+        assert got[i].tolist() == want, f"x={x}"
+    # drained osds never placed
+    assert not np.isin(got, [0, 1]).any()
+
+
+def test_rule_builder_requires_root():
+    m = CrushMap()
+    m.add_type(1, "host")
+    m.add_bucket(-1, 1, "straw2", [0, 1])
+    with pytest.raises(ValueError, match="take target"):
+        replicated_rule(m, 0)
+    replicated_rule(m, 0, root=-1)  # explicit root works
+
+
+def test_uniform_unroll_bounded_by_uniform_buckets():
+    m = CrushMap()
+    m.add_type(1, "host")
+    m.add_type(3, "root")
+    m.add_bucket(-1, 1, "uniform", list(range(4)), name="h0")
+    m.add_bucket(-2, 1, "uniform", list(range(4, 8)), name="h1")
+    big = list(range(-1, -3, -1))
+    m.add_bucket(-3, 3, "straw2", big, [4.0, 4.0], name="root")
+    m.root_id = -3
+    replicated_rule(m, 0, choose_type=1)
+    vm = VectorMapper(m)
+    assert vm.S_uniform == 4   # not inflated by the straw2 root
+    om = OracleMapper(m)
+    w = full_weights(8)
+    xs = np.arange(32, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(0, xs, w, 2))
+    for i, x in enumerate(xs):
+        want = om.do_rule(0, int(x), w, 2)
+        want = (want + [CRUSH_ITEM_NONE] * 2)[:2]
+        assert got[i].tolist() == want

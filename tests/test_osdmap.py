@@ -1,0 +1,141 @@
+"""OSDMap tests: stable-mod properties, object->PG->OSD pipeline,
+pg_temp/primary_temp overrides, batched == scalar parity."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, Tunables, build_hierarchy,
+                                ec_rule, replicated_rule)
+from ceph_tpu.osd.osdmap import (OSDMap, PGPool, ceph_stable_mod,
+                                 pg_num_mask, str_hash_rjenkins)
+
+
+def make_osdmap(n_osds=32):
+    m = build_hierarchy(n_osds, 4, 4)
+    m.tunables = Tunables(choose_total_tries=7)
+    replicated_rule(m, 0, choose_type=1, firstn=True)
+    ec_rule(m, 1, choose_type=1)
+    om = OSDMap(m)
+    om.add_pool(PGPool(1, pg_num=64, size=3, min_size=2, crush_rule=0))
+    om.add_pool(PGPool(2, pg_num=64, size=6, min_size=5, crush_rule=1,
+                       is_erasure=True, ec_profile={"k": "4", "m": "2"}))
+    return om
+
+
+def test_stable_mod_basics():
+    # within range, identity-ish; doubling pg_num only remaps new half
+    for pg_num in (1, 3, 8, 12, 100):
+        mask = pg_num_mask(pg_num)
+        for x in range(500):
+            v = ceph_stable_mod(x, pg_num, mask)
+            assert 0 <= v < pg_num
+    # array form agrees with scalar
+    xs = np.arange(1000)
+    got = ceph_stable_mod(xs, 12, pg_num_mask(12))
+    want = [ceph_stable_mod(int(x), 12, pg_num_mask(12)) for x in xs]
+    assert got.tolist() == want
+
+
+def test_stable_mod_split_stability():
+    # growing pg_num from 8 to 16: objects whose (x & 15) < 8 keep their pg
+    m8, m16 = pg_num_mask(8), pg_num_mask(16)
+    for x in range(2000):
+        before = ceph_stable_mod(x, 8, m8)
+        after = ceph_stable_mod(x, 16, m16)
+        assert after % 8 == before
+
+
+def test_str_hash_deterministic():
+    h1 = str_hash_rjenkins("rbd_data.12345")
+    h2 = str_hash_rjenkins(b"rbd_data.12345")
+    assert h1 == h2
+    assert h1 != str_hash_rjenkins("rbd_data.12346")
+    assert 0 <= h1 < 2 ** 32
+    # all tail lengths exercise the switch
+    seen = {str_hash_rjenkins("x" * n) for n in range(30)}
+    assert len(seen) == 30
+
+
+def test_object_to_pg_and_up():
+    om = make_osdmap()
+    pg = om.object_to_pg(1, "obj-1")
+    assert pg[0] == 1 and 0 <= pg[1] < 64
+    up, upp, acting, actp = om.pg_to_up_acting_osds(*pg)
+    assert len(up) == 3
+    assert upp == up[0] and actp == acting[0]
+    assert all(0 <= o < 32 for o in up)
+
+
+def test_batched_matches_scalar():
+    om = make_osdmap()
+    for pool_id in (1, 2):
+        batched = om.pgs_to_up(pool_id)
+        pool = om.pools[pool_id]
+        for ps in range(0, 64, 7):
+            up, *_ = om.pg_to_up_acting_osds(pool_id, ps)
+            assert batched[ps].tolist() == up, f"pool={pool_id} ps={ps}"
+
+
+def test_down_osd_leaves_hole_in_up():
+    om = make_osdmap()
+    up0 = om.pgs_to_up(2)
+    victim = int(up0[0, 0])
+    om.mark_down(victim)
+    up1 = om.pgs_to_up(2)
+    assert not (up1 == victim).any()
+    # down (not out) keeps placement for other slots: only holes differ
+    changed = (up0 != up1)
+    assert (up0[changed] == victim).all()
+
+
+def test_out_osd_remaps():
+    om = make_osdmap()
+    up0 = om.pgs_to_up(1)
+    victim = int(up0[0, 0])
+    om.mark_out(victim)
+    up1 = om.pgs_to_up(1)
+    assert not (up1 == victim).any()
+    assert (up1 != CRUSH_ITEM_NONE).all()  # replicas found elsewhere
+
+
+def test_pg_temp_and_primary_temp():
+    om = make_osdmap()
+    pg = (1, 5)
+    up, upp, acting, actp = om.pg_to_up_acting_osds(*pg)
+    override = [(upp + 1) % 32, (upp + 2) % 32, (upp + 3) % 32]
+    om.set_pg_temp(pg, override)
+    om.set_primary_temp(pg, override[1])
+    up2, upp2, acting2, actp2 = om.pg_to_up_acting_osds(*pg)
+    assert up2 == up          # up unaffected
+    assert acting2 == override
+    assert actp2 == override[1]
+    # batched: up ignores pg_temp, acting applies it (same as scalar)
+    assert om.pgs_to_up(1)[5].tolist() == up
+    assert om.pgs_to_acting(1)[5].tolist() == override
+    # clearing restores
+    om.set_pg_temp(pg, [])
+    om.set_primary_temp(pg, None)
+    assert om.pg_to_up_acting_osds(*pg)[2] == up
+
+
+def test_epoch_bumps():
+    om = make_osdmap()
+    e0 = om.epoch
+    om.mark_down(0)
+    om.mark_out(0)
+    assert om.epoch == e0 + 2
+
+
+def test_pg_stats_balance():
+    om = make_osdmap()
+    stats = om.pg_stats(1)
+    assert stats["degraded_pgs"] == 0
+    counts = stats["pg_per_osd"]
+    assert counts.sum() == 64 * 3
+    assert counts.max() <= 4 * counts.mean()  # no pathological skew
+
+
+def test_pool_validation():
+    om = make_osdmap()
+    with pytest.raises(ValueError):
+        om.add_pool(PGPool(3, pg_num=8, size=3, min_size=2, crush_rule=99))

@@ -1,0 +1,133 @@
+"""crushtool — offline CRUSH map build/test CLI.
+
+Recreation of the reference's placement harness (ref:
+src/tools/crushtool.cc `crushtool --build/--test --show-mappings
+--show-statistics`; test engine ref: src/crush/CrushTester.cc): builds a
+hierarchy, runs a rule over a range of inputs through the VECTORIZED
+mapper in one launch, and reports per-device utilization + fill.
+
+Examples:
+  python tools/crushtool.py --build --num-osds 64 --osds-per-host 4 \
+      --hosts-per-rack 4 --test --rule ec --num-rep 6 --max-x 4096
+  python tools/crushtool.py --build --num-osds 10000 --test --rule ec \
+      --num-rep 11 --max-x 100000 --mark-out 0,17 --show-mappings 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--build", action="store_true",
+                    help="build a root/rack/host/osd hierarchy")
+    ap.add_argument("--num-osds", type=int, default=64)
+    ap.add_argument("--osds-per-host", type=int, default=8)
+    ap.add_argument("--hosts-per-rack", type=int, default=16)
+    ap.add_argument("--alg", default="straw2",
+                    choices=["straw2", "uniform", "list"])
+    ap.add_argument("--test", action="store_true", help="run a placement test")
+    ap.add_argument("--rule", default="replicated",
+                    choices=["replicated", "ec"])
+    ap.add_argument("--num-rep", type=int, default=3)
+    ap.add_argument("--min-x", type=int, default=0)
+    ap.add_argument("--max-x", type=int, default=1024)
+    ap.add_argument("--mark-out", default="",
+                    help="comma-separated osd ids to mark out")
+    ap.add_argument("--reweight", default="",
+                    help="osd:weight,... (e.g. 3:0.5,7:0)")
+    ap.add_argument("--tries", type=int, default=7,
+                    help="choose_total_tries tunable")
+    ap.add_argument("--show-mappings", type=int, default=0, metavar="N",
+                    help="print the first N mappings")
+    ap.add_argument("--show-statistics", action=argparse.BooleanOptionalAction,
+                    default=True, help="print the stats block")
+    ap.add_argument("--oracle", action="store_true",
+                    help="use the scalar oracle mapper (slow, for checking)")
+    ap.add_argument("--json", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    if not args.build:
+        raise SystemExit("only --build topologies supported (use --build)")
+    from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, Tunables,
+                                    build_hierarchy, ec_rule,
+                                    replicated_rule)
+    from ceph_tpu.crush.mapper import VectorMapper, full_weights
+
+    m = build_hierarchy(args.num_osds, args.osds_per_host,
+                        args.hosts_per_rack, alg=args.alg)
+    m.tunables = Tunables(choose_total_tries=args.tries)
+    replicated_rule(m, 0, choose_type=1, firstn=True)
+    ec_rule(m, 1, choose_type=1)
+    rule_id = 0 if args.rule == "replicated" else 1
+
+    if not args.test:
+        print(f"built map: {args.num_osds} osds, "
+              f"{len(m.buckets)} buckets, depth {m.pack().max_depth}")
+        return
+
+    weights = full_weights(args.num_osds)
+    for tok in filter(None, args.mark_out.split(",")):
+        weights[int(tok)] = 0
+    for tok in filter(None, args.reweight.split(",")):
+        osd, w = tok.split(":")
+        weights[int(osd)] = int(float(w) * 0x10000)
+
+    xs = np.arange(args.min_x, args.max_x, dtype=np.uint32)
+    n = args.num_rep
+    if args.oracle:
+        from ceph_tpu.crush.oracle import OracleMapper
+        om = OracleMapper(m)
+        t0 = time.perf_counter()
+        rows = [om.do_rule(rule_id, int(x), weights, n) for x in xs]
+        out = np.array([(r + [CRUSH_ITEM_NONE] * n)[:n] for r in rows],
+                       dtype=np.int64)
+        dt = time.perf_counter() - t0
+    else:
+        vm = VectorMapper(m)
+        # warm with the full shape: jit caches per batch shape
+        np.asarray(vm.do_rule(rule_id, xs, weights, n))
+        t0 = time.perf_counter()
+        out = np.asarray(vm.do_rule(rule_id, xs, weights, n))
+        dt = time.perf_counter() - t0
+
+    real = out[out != CRUSH_ITEM_NONE]
+    counts = np.bincount(real, minlength=args.num_osds)
+    in_w = weights.astype(np.float64) / 0x10000
+    expect = len(xs) * n * (in_w / in_w.sum())
+    fill = (out != CRUSH_ITEM_NONE).mean()
+    stats = {
+        "rule": args.rule, "num_rep": n, "inputs": len(xs),
+        "fill": round(float(fill), 6),
+        "seconds": round(dt, 4),
+        "mappings_per_s": round(len(xs) / dt, 1),
+        "device_util_min": int(counts.min()),
+        "device_util_max": int(counts.max()),
+        "device_util_stddev_vs_expected": round(float(
+            np.std((counts - expect)[in_w > 0])), 2),
+    }
+    for i in range(min(args.show_mappings, len(xs))):
+        print(f"CRUSH rule {rule_id} x {int(xs[i])} "
+              f"{[int(v) if v != CRUSH_ITEM_NONE else -1 for v in out[i]]}")
+    if args.json:
+        print(json.dumps(stats))
+    elif args.show_statistics:
+        for k, v in stats.items():
+            print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()
