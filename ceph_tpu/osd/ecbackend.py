@@ -1,0 +1,273 @@
+"""ECBackend — the erasure-coded PG data path, batched for TPU.
+
+Rebuild of the reference's EC read/write/recovery dataflow (ref:
+src/osd/ECBackend.{h,cc} + ECCommon.{h,cc} — submit_transaction write
+fan-out, objects_read_and_reconstruct degraded read,
+RecoveryOp/continue_recovery_op streaming recovery;
+ECTransaction::generate_transactions for the per-shard store writes;
+per-shard HashInfo bookkeeping ref: src/osd/ECUtil.{h,cc}).
+
+TPU-first reshaping (SURVEY.md §2.7 P1-P4): where the reference fans
+one object's sub-ops out over the network and recovers objects under a
+semaphore one RecoveryOp at a time, here the unit of work is a BATCH of
+objects — writes encode (B, k, chunk) in one device launch, recovery
+gathers surviving shards for B objects into (B, k, chunk) device
+arrays, runs ONE batched decode, and scatters the rebuilt shards back.
+The per-shard stores are MemStore instances standing in for OSDs, so
+the whole pipeline runs hermetically (the reference's
+many-daemons-one-box trick, in-process).
+
+Object placement: shard i of an object lands on the OSD in slot i of
+the PG's acting set (the chunk->shard identity mapping); a lost OSD
+means one lost shard per object, which is exactly the recovery
+workload metric #2 in BASELINE.md measures (objects/s).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ec.interface import ErasureCode
+from ..ec.registry import factory
+from .memstore import MemStore, Transaction
+from .stripe import HashInfo, StripeInfo
+
+HINFO_KEY = "hinfo_key"  # same xattr name role as the reference
+
+
+@dataclass
+class ShardSet:
+    """The 'cluster': one MemStore per OSD id."""
+    stores: dict[int, MemStore] = field(default_factory=dict)
+
+    def osd(self, osd_id: int) -> MemStore:
+        if osd_id not in self.stores:
+            self.stores[osd_id] = MemStore()
+        return self.stores[osd_id]
+
+
+def shard_cid(pg: str, shard: int) -> str:
+    """Collection name of one PG shard (role of spg_t's shard id)."""
+    return f"{pg}s{shard}"
+
+
+class ECBackend:
+    """One PG's EC backend over a set of per-OSD stores."""
+
+    def __init__(self, profile: dict | str, pg: str, acting: list[int],
+                 cluster: ShardSet | None = None,
+                 chunk_size: int | None = None):
+        self.coder: ErasureCode = factory(profile)
+        self.k = self.coder.get_data_chunk_count()
+        self.m = self.coder.get_coding_chunk_count()
+        self.n = self.k + self.m
+        if len(acting) != self.n:
+            raise ValueError(f"acting set size {len(acting)} != k+m={self.n}")
+        self.pg = pg
+        self.acting = list(acting)
+        if self.coder.get_chunk_mapping() != list(range(self.n)):
+            raise ValueError("non-identity chunk mappings not supported "
+                             "by this backend yet")
+        self.cluster = cluster or ShardSet()
+        cs = chunk_size or self.coder.get_chunk_size(0) or 4096
+        self.sinfo = StripeInfo(self.k, cs)
+        # one collection per shard on its OSD
+        for shard, osd in enumerate(self.acting):
+            t = Transaction().create_collection(shard_cid(pg, shard))
+            self.cluster.osd(osd).queue_transaction(t)
+        self.object_sizes: dict[str, int] = {}  # the PG log's size info
+
+    # -- helpers ------------------------------------------------------------
+
+    def _store(self, shard: int) -> MemStore:
+        return self.cluster.osd(self.acting[shard])
+
+    def _chunk_len(self, object_size: int) -> int:
+        padded = self.coder.get_chunk_size(
+            self.sinfo.logical_to_next_stripe_offset(object_size))
+        return max(padded, self.sinfo.chunk_size)
+
+    @staticmethod
+    def _batched_hinfo_crcs(chunks: np.ndarray) -> np.ndarray:
+        """One device launch for all shards' hinfo CRCs (raw register,
+        seed -1 — the HashInfo convention)."""
+        from ..csum.kernels import crc32c_blocks
+        return np.asarray(crc32c_blocks(chunks, init=0xFFFFFFFF, xorout=0))
+
+    # -- write path (submit_transaction) ------------------------------------
+
+    def write_objects(self, objects: dict[str, bytes | np.ndarray]) -> None:
+        """Full-object writes, batched: encode every equal-length group
+        in one device launch, then scatter per-shard store transactions
+        (the role of ECTransaction::generate_transactions)."""
+        by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
+        for name, data in objects.items():
+            arr = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
+                data, (bytes, bytearray, memoryview)) else np.asarray(
+                    data, np.uint8)
+            by_len.setdefault(len(arr), []).append((name, arr))
+        for olen, group in by_len.items():
+            batch = np.stack([a for _, a in group])
+            cl = self._chunk_len(olen)
+            # pad logical bytes to k*chunk_len, split to data shards
+            padded = np.zeros((len(group), self.k * cl), np.uint8)
+            padded[:, :olen] = batch
+            sin = StripeInfo(self.k, cl)
+            data_shards = sin.object_to_shards(padded)   # (B, k, cl)
+            parity = np.asarray(self.coder.encode_chunks(data_shards))
+            shards = np.concatenate([data_shards, parity], axis=1)
+            crcs = self._batched_hinfo_crcs(shards.reshape(-1, cl))
+            crcs = crcs.reshape(len(group), self.n)
+            for bi, (name, arr) in enumerate(group):
+                self.object_sizes[name] = olen
+                for shard in range(self.n):
+                    chunk = shards[bi, shard, :]
+                    hinfo = HashInfo(1, cl, [int(crcs[bi, shard])])
+                    # truncate clears any stale tail from a previous,
+                    # larger version of the object
+                    t = (Transaction()
+                         .write(shard_cid(self.pg, shard), name, 0, chunk)
+                         .truncate(shard_cid(self.pg, shard), name, cl)
+                         .setattr(shard_cid(self.pg, shard), name,
+                                  HINFO_KEY, hinfo.to_bytes()))
+                    self._store(shard).queue_transaction(t)
+
+    # -- read path -----------------------------------------------------------
+
+    def read_object(self, name: str,
+                    dead_osds: set[int] | None = None) -> np.ndarray:
+        """Read one object, reconstructing if shards are unavailable
+        (objects_read_and_reconstruct)."""
+        return self.read_objects([name], dead_osds)[name]
+
+    def read_objects(self, names: list[str],
+                     dead_osds: set[int] | None = None) -> dict[str, np.ndarray]:
+        dead = dead_osds or set()
+        avail = [s for s in range(self.n)
+                 if self.acting[s] not in dead]
+        want = list(range(self.k))
+        need = self.coder.minimum_to_decode(want, avail)
+        out: dict[str, np.ndarray] = {}
+        for name in names:
+            osize = self.object_sizes[name]
+            chunks = {s: self._store(s).read(shard_cid(self.pg, s), name)
+                      for s in need}
+            rec = self.coder.decode(want, chunks)
+            shards = np.stack([rec[i] for i in range(self.k)])
+            # single-stripe layout: shards concatenate back to the object
+            out[name] = StripeInfo(self.k, shards.shape[-1]).shards_to_object(
+                shards, osize)
+        return out
+
+    # -- recovery (the objects/s metric) -------------------------------------
+
+    def recover_shards(self, lost_shards: list[int],
+                       replacement_osds: dict[int, int] | None = None,
+                       batch: int = 128,
+                       verify_hinfo: bool = True) -> dict:
+        """Rebuild every object's lost shard(s): the RecoveryOp loop,
+        batched. Returns counters {objects, bytes, hinfo_failures}.
+
+        lost_shards: shard slots whose OSD died.
+        replacement_osds: slot -> new OSD id (defaults to reusing the
+        slot's OSD id, i.e. re-created store after replacement).
+        """
+        lost = sorted(set(lost_shards))
+        if len(lost) > self.m:
+            raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
+        repl = replacement_osds or {}
+        for s in lost:
+            new_osd = repl.get(s, self.acting[s])
+            self.acting[s] = new_osd
+            t = Transaction().create_collection(shard_cid(self.pg, s))
+            self.cluster.osd(new_osd).queue_transaction(t)
+
+        survivors = [s for s in range(self.n) if s not in lost]
+        helper = sorted(self.coder.minimum_to_decode(lost, survivors))
+        names = sorted(self.object_sizes)
+        counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+        for i in range(0, len(names), batch):
+            group = names[i:i + batch]
+            # batched gather: (B, |helper|, chunk) — stride the reads by
+            # equal chunk length groups
+            by_len: dict[int, list[str]] = {}
+            for name in group:
+                cl = self._chunk_len(self.object_sizes[name])
+                by_len.setdefault(cl, []).append(name)
+            for cl, subgroup in by_len.items():
+                stacks = {
+                    s: np.stack([self._store(s).read(shard_cid(self.pg, s), n)
+                                 for n in subgroup])
+                    for s in helper}
+                bad_pairs: dict[str, set[int]] = {}  # object -> bad shards
+                if verify_hinfo:
+                    # reject corrupt helper reads BEFORE decoding from
+                    # them (the reference checks hinfo on every EC read);
+                    # affected objects re-decode from alternate helpers
+                    for s in helper:
+                        crcs = self._batched_hinfo_crcs(stacks[s])
+                        for bi, name in enumerate(subgroup):
+                            hb = self._store(s).getattr(
+                                shard_cid(self.pg, s), name, HINFO_KEY)
+                            if HashInfo.from_bytes(hb).get_chunk_hash(0) \
+                                    != int(crcs[bi]):
+                                counters["hinfo_failures"] += 1
+                                bad_pairs.setdefault(name, set()).add(s)
+                rec = self.coder.decode_chunks(lost, stacks)  # {slot: (B, cl)}
+                rebuilt_all = np.stack([np.asarray(rec[s]) for s in lost],
+                                       axis=1)  # (B, |lost|, cl)
+                for name, bad in bad_pairs.items():
+                    bi = subgroup.index(name)
+                    alt = [s for s in survivors if s not in bad]
+                    alt_need = sorted(self.coder.minimum_to_decode(lost, alt))
+                    chunks = {s: self._store(s).read(shard_cid(self.pg, s),
+                                                     name)
+                              for s in alt_need}
+                    alt_rec = self.coder.decode_chunks(lost, chunks)
+                    for li, s in enumerate(lost):
+                        rebuilt_all[bi, li] = np.asarray(alt_rec[s])
+                crcs = self._batched_hinfo_crcs(
+                    rebuilt_all.reshape(-1, cl)).reshape(len(subgroup),
+                                                         len(lost))
+                for li, s in enumerate(lost):
+                    for bi, name in enumerate(subgroup):
+                        chunk = rebuilt_all[bi, li]
+                        hinfo = HashInfo(1, cl, [int(crcs[bi, li])])
+                        t = (Transaction()
+                             .write(shard_cid(self.pg, s), name, 0, chunk)
+                             .truncate(shard_cid(self.pg, s), name, cl)
+                             .setattr(shard_cid(self.pg, s), name,
+                                      HINFO_KEY, hinfo.to_bytes()))
+                        self._store(s).queue_transaction(t)
+                        counters["bytes"] += int(chunk.size)
+                counters["objects"] += len(subgroup)
+        return counters
+
+    # -- deep scrub ----------------------------------------------------------
+
+    def deep_scrub(self) -> dict:
+        """Read every shard of every object, verify stored hinfo CRCs
+        (the be_deep_scrub bulk-checksum audit), batched per shard."""
+        from ..csum.kernels import crc32c_blocks
+        bad: list[tuple[str, int]] = []
+        checked = 0
+        for s in range(self.n):
+            store = self._store(s)
+            cid = shard_cid(self.pg, s)
+            names = store.list_objects(cid)
+            by_len: dict[int, list[str]] = {}
+            for n in names:
+                by_len.setdefault(store.stat(cid, n), []).append(n)
+            for ln, group in by_len.items():
+                blocks = np.stack([store.read(cid, n) for n in group])
+                crcs = np.asarray(crc32c_blocks(blocks, init=0xFFFFFFFF,
+                                                xorout=0))
+                for bi, n in enumerate(group):
+                    hinfo = HashInfo.from_bytes(store.getattr(cid, n,
+                                                              HINFO_KEY))
+                    checked += 1
+                    if hinfo.get_chunk_hash(0) != int(crcs[bi]):
+                        bad.append((n, s))
+        return {"checked": checked, "inconsistent": bad}

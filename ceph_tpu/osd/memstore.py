@@ -1,0 +1,182 @@
+"""MemStore — the in-memory transactional object store.
+
+Rebuild of the reference's test/fake backend (ref: src/os/memstore/
+MemStore.{h,cc}; transactional API ref: src/os/ObjectStore.h —
+ObjectStore::Transaction op-codes OP_WRITE/OP_TRUNCATE/OP_SETATTR/
+OP_RM... applied atomically by queue_transaction). This is the store
+the hermetic recovery/cluster tests run against, exactly as the
+reference's store_test.cc runs one suite against MemStore and
+BlueStore.
+
+Objects live in collections (one per PG shard); each object holds byte
+data (a numpy uint8 array), xattrs (small bytes: hinfo lives here), and
+an omap dict. Transactions collect ops and apply all-or-nothing: any
+op that fails validation aborts the whole batch before any mutation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class _Object:
+    data: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint8))
+    xattrs: dict[str, bytes] = field(default_factory=dict)
+    omap: dict[bytes, bytes] = field(default_factory=dict)
+
+
+class Transaction:
+    """Ordered op list; build with the helpers, apply via
+    MemStore.queue_transaction."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+
+    def create_collection(self, cid: str):
+        self.ops.append(("mkcoll", cid))
+        return self
+
+    def remove_collection(self, cid: str):
+        self.ops.append(("rmcoll", cid))
+        return self
+
+    def touch(self, cid: str, oid: str):
+        self.ops.append(("touch", cid, oid))
+        return self
+
+    def write(self, cid: str, oid: str, offset: int, data):
+        arr = (np.frombuffer(bytes(data), dtype=np.uint8).copy()
+               if isinstance(data, (bytes, bytearray, memoryview))
+               else np.asarray(data, np.uint8).copy())
+        self.ops.append(("write", cid, oid, int(offset), arr))
+        return self
+
+    def truncate(self, cid: str, oid: str, size: int):
+        self.ops.append(("truncate", cid, oid, int(size)))
+        return self
+
+    def remove(self, cid: str, oid: str):
+        self.ops.append(("remove", cid, oid))
+        return self
+
+    def setattr(self, cid: str, oid: str, key: str, value: bytes):
+        self.ops.append(("setattr", cid, oid, key, bytes(value)))
+        return self
+
+    def rmattr(self, cid: str, oid: str, key: str):
+        self.ops.append(("rmattr", cid, oid, key))
+        return self
+
+    def omap_set(self, cid: str, oid: str, kv: dict[bytes, bytes]):
+        self.ops.append(("omap_set", cid, oid, dict(kv)))
+        return self
+
+
+class MemStore:
+    """All state in RAM; crash-consistency is trivially atomic because
+    transactions apply under a copy-validate-commit discipline."""
+
+    def __init__(self):
+        self.collections: dict[str, dict[str, _Object]] = {}
+        self.committed_txns = 0
+
+    # -- transaction apply --------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        self._validate(txn)
+        for op in txn.ops:
+            self._apply(op)
+        self.committed_txns += 1
+
+    def _validate(self, txn: Transaction) -> None:
+        # simulate the ObjectStore contract: ops referencing missing
+        # collections are caller bugs -> abort before mutating anything
+        cols = set(self.collections)
+        for op in txn.ops:
+            kind = op[0]
+            if kind == "mkcoll":
+                cols.add(op[1])
+            elif kind == "rmcoll":
+                if op[1] not in cols:
+                    raise KeyError(f"rmcoll: no collection {op[1]!r}")
+                cols.discard(op[1])
+            else:
+                if op[1] not in cols:
+                    raise KeyError(f"{kind}: no collection {op[1]!r}")
+
+    def _obj(self, cid: str, oid: str, create: bool = False) -> _Object:
+        coll = self.collections[cid]
+        if oid not in coll:
+            if not create:
+                raise KeyError(f"no object {cid}/{oid}")
+            coll[oid] = _Object()
+        return coll[oid]
+
+    def _apply(self, op: tuple) -> None:
+        kind = op[0]
+        if kind == "mkcoll":
+            self.collections.setdefault(op[1], {})
+        elif kind == "rmcoll":
+            self.collections.pop(op[1])
+        elif kind == "touch":
+            self._obj(op[1], op[2], create=True)
+        elif kind == "write":
+            _, cid, oid, off, data = op
+            o = self._obj(cid, oid, create=True)
+            end = off + len(data)
+            if end > len(o.data):
+                grown = np.zeros(end, dtype=np.uint8)
+                grown[:len(o.data)] = o.data
+                o.data = grown
+            o.data[off:end] = data
+        elif kind == "truncate":
+            _, cid, oid, size = op
+            o = self._obj(cid, oid, create=True)
+            if size <= len(o.data):
+                o.data = o.data[:size].copy()
+            else:
+                grown = np.zeros(size, dtype=np.uint8)
+                grown[:len(o.data)] = o.data
+                o.data = grown
+        elif kind == "remove":
+            self.collections[op[1]].pop(op[2], None)
+        elif kind == "setattr":
+            self._obj(op[1], op[2], create=True).xattrs[op[3]] = op[4]
+        elif kind == "rmattr":
+            # tolerant like remove: a missing object is a no-op, so the
+            # all-or-nothing apply contract can't break mid-transaction
+            o = self.collections[op[1]].get(op[2])
+            if o is not None:
+                o.xattrs.pop(op[3], None)
+        elif kind == "omap_set":
+            self._obj(op[1], op[2], create=True).omap.update(op[3])
+        else:
+            raise ValueError(f"unknown op {kind!r}")
+
+    # -- reads --------------------------------------------------------------
+
+    def read(self, cid: str, oid: str, offset: int = 0,
+             length: int | None = None) -> np.ndarray:
+        o = self._obj(cid, oid)
+        if length is None:
+            return o.data[offset:].copy()
+        return o.data[offset:offset + length].copy()
+
+    def stat(self, cid: str, oid: str) -> int:
+        return len(self._obj(cid, oid).data)
+
+    def getattr(self, cid: str, oid: str, key: str) -> bytes:
+        return self._obj(cid, oid).xattrs[key]
+
+    def exists(self, cid: str, oid: str) -> bool:
+        return cid in self.collections and oid in self.collections[cid]
+
+    def list_objects(self, cid: str) -> list[str]:
+        return sorted(self.collections.get(cid, {}))
+
+    def list_collections(self) -> list[str]:
+        return sorted(self.collections)

@@ -1,0 +1,93 @@
+"""PG recovery benchmark — objects/s through the mini-ECBackend.
+
+The harness for BASELINE.md metric #2 (ref dataflow:
+src/osd/ECBackend.cc RecoveryOp/continue_recovery_op, throttled by
+osd_recovery_max_active in the reference; here the batched pipeline IS
+the throttle knob). Writes N objects through the EC write path, kills
+shards, then times recover_shards end-to-end (helper reads -> batched
+decode on device -> writeback + hinfo).
+
+  python tools/recovery_bench.py -P k=8 -P m=3 --objects 256 \
+      --size $((1<<20)) --lost 1 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--parameter", "-P", action="append", default=[])
+    ap.add_argument("--objects", type=int, default=128)
+    ap.add_argument("--size", type=int, default=1 << 20)
+    ap.add_argument("--lost", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--no-verify-hinfo", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ceph_tpu.ec.interface import profile_from_string
+    from ceph_tpu.osd.ecbackend import ECBackend, ShardSet
+
+    profile = profile_from_string(" ".join(args.parameter)) or {}
+    profile.setdefault("k", "8")
+    profile.setdefault("m", "3")
+    try:
+        cluster = ShardSet()
+        k, m = int(profile["k"]), int(profile["m"])
+        be = ECBackend(profile, "1.0", list(range(k + m)), cluster)
+        if args.lost > m:
+            raise SystemExit(f"--lost {args.lost} exceeds m={m}")
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+    rng = np.random.default_rng(0)
+    objs = {f"obj{i:06d}": rng.integers(0, 256, size=args.size,
+                                        dtype=np.uint8)
+            for i in range(args.objects)}
+    t0 = time.perf_counter()
+    be.write_objects(objs)
+    t_write = time.perf_counter() - t0
+
+    lost = list(range(args.lost))
+    for s in lost:
+        cluster.stores.pop(be.acting[s], None)
+    repl = {s: 1000 + s for s in lost}
+
+    t0 = time.perf_counter()
+    counters = be.recover_shards(lost, replacement_osds=repl,
+                                 batch=args.batch,
+                                 verify_hinfo=not args.no_verify_hinfo)
+    t_rec = time.perf_counter() - t0
+
+    import jax
+    stats = {
+        "plugin": profile.get("plugin", "tpu_rs"), "k": k, "m": m,
+        "objects": args.objects, "object_size": args.size,
+        "lost_shards": args.lost,
+        "write_s": round(t_write, 3),
+        "recover_s": round(t_rec, 3),
+        "objects_per_s": round(args.objects / t_rec, 1),
+        "recovered_MBps": round(counters["bytes"] / t_rec / 1e6, 1),
+        "hinfo_failures": counters["hinfo_failures"],
+        "backend": jax.default_backend(),
+    }
+    if args.json:
+        print(json.dumps(stats))
+    else:
+        for kk, v in stats.items():
+            print(f"{kk}: {v}")
+
+
+if __name__ == "__main__":
+    main()
