@@ -111,11 +111,10 @@ class ECBackend:
         for olen, group in by_len.items():
             batch = np.stack([a for _, a in group])
             cl = self._chunk_len(olen)
-            # pad logical bytes to k*chunk_len, split to data shards
-            padded = np.zeros((len(group), self.k * cl), np.uint8)
-            padded[:, :olen] = batch
+            # object_to_shards pads to the stripe boundary (= k*cl here,
+            # since cl is derived from olen) and splits to data shards
             sin = StripeInfo(self.k, cl)
-            data_shards = sin.object_to_shards(padded)   # (B, k, cl)
+            data_shards = sin.object_to_shards(batch)    # (B, k, cl)
             parity = np.asarray(self.coder.encode_chunks(data_shards))
             shards = np.concatenate([data_shards, parity], axis=1)
             crcs = self._batched_hinfo_crcs(shards.reshape(-1, cl))
@@ -148,17 +147,23 @@ class ECBackend:
         avail = [s for s in range(self.n)
                  if self.acting[s] not in dead]
         want = list(range(self.k))
-        need = self.coder.minimum_to_decode(want, avail)
+        need = sorted(self.coder.minimum_to_decode(want, avail))
         out: dict[str, np.ndarray] = {}
+        # batched like recovery: stack equal-chunk-length groups and
+        # decode each group in ONE launch
+        by_len: dict[int, list[str]] = {}
         for name in names:
-            osize = self.object_sizes[name]
-            chunks = {s: self._store(s).read(shard_cid(self.pg, s), name)
+            by_len.setdefault(self._chunk_len(self.object_sizes[name]),
+                              []).append(name)
+        for cl, group in by_len.items():
+            stacks = {s: np.stack([self._store(s).read(shard_cid(self.pg, s),
+                                                       n) for n in group])
                       for s in need}
-            rec = self.coder.decode(want, chunks)
-            shards = np.stack([rec[i] for i in range(self.k)])
-            # single-stripe layout: shards concatenate back to the object
-            out[name] = StripeInfo(self.k, shards.shape[-1]).shards_to_object(
-                shards, osize)
+            rec = self.coder.decode(want, stacks)
+            shards = np.stack([rec[i] for i in range(self.k)], axis=1)
+            objs = StripeInfo(self.k, cl).shards_to_object(shards)  # (B, k*cl)
+            for bi, name in enumerate(group):
+                out[name] = objs[bi, :self.object_sizes[name]]
         return out
 
     # -- recovery (the objects/s metric) -------------------------------------

@@ -49,13 +49,19 @@ class Transaction:
         return self
 
     def write(self, cid: str, oid: str, offset: int, data):
+        if int(offset) < 0:
+            raise ValueError(f"write offset {offset} < 0")
         arr = (np.frombuffer(bytes(data), dtype=np.uint8).copy()
                if isinstance(data, (bytes, bytearray, memoryview))
                else np.asarray(data, np.uint8).copy())
+        if arr.ndim != 1:
+            raise ValueError(f"write data must be flat bytes, got {arr.shape}")
         self.ops.append(("write", cid, oid, int(offset), arr))
         return self
 
     def truncate(self, cid: str, oid: str, size: int):
+        if int(size) < 0:
+            raise ValueError(f"truncate size {size} < 0")
         self.ops.append(("truncate", cid, oid, int(size)))
         return self
 

@@ -1,0 +1,151 @@
+"""Config — typed options with layered sources and change observers.
+
+Rebuild of the reference's config system (ref: src/common/options/
+*.yaml.in option declarations -> md_config_t in src/common/config.cc;
+layering: compiled defaults < conf file < mon ConfigMonitor store <
+env/CLI overrides; runtime reaction via md_config_obs_t observers).
+
+Here options are declared in code (dataclass rows instead of YAML
+codegen), values resolve through the same precedence chain, and
+observers subscribe by key to react to runtime `set` calls — what lets
+a running daemon pick up e.g. a recovery throttle change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+_LEVELS = ("default", "file", "mon", "override")
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type
+    default: Any
+    description: str = ""
+    min: float | None = None
+    max: float | None = None
+
+    def coerce(self, value):
+        if self.type is bool and isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                value = True
+            elif low in ("false", "0", "no", "off"):
+                value = False
+            else:
+                raise ValueError(f"{self.name}: bad bool {value!r}")
+        try:
+            value = self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"{self.name}: {e}") from None
+        if self.min is not None and value < self.min:
+            raise ValueError(f"{self.name}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"{self.name}: {value} > max {self.max}")
+        return value
+
+
+# the framework's option schema (the subset of the reference's options
+# that have meaning here; same names where the concept matches)
+OPTIONS: list[Option] = [
+    Option("osd_pool_default_size", int, 3, "replicas for new pools", min=1),
+    Option("osd_pool_default_pg_num", int, 32, "PGs for new pools", min=1),
+    Option("osd_recovery_max_active", int, 3,
+           "concurrent recovery batches", min=1),
+    Option("osd_recovery_batch", int, 128,
+           "objects per batched recovery launch", min=1),
+    Option("osd_heartbeat_interval", float, 6.0,
+           "seconds between peer pings", min=0.1),
+    Option("osd_heartbeat_grace", float, 20.0,
+           "seconds of silence before reporting a peer down", min=0.1),
+    Option("mon_osd_down_out_interval", float, 600.0,
+           "seconds down before auto-out"),
+    Option("osd_scrub_auto_repair", bool, False,
+           "repair inconsistencies found by deep scrub"),
+    Option("erasure_code_profile", str,
+           "plugin=tpu_rs k=8 m=3 technique=reed_sol_van",
+           "default EC profile for new EC pools"),
+    Option("crush_choose_total_tries", int, 7,
+           "CRUSH retry rounds (vectorized unroll bound)", min=1, max=64),
+    Option("log_max_recent", int, 1000,
+           "in-memory ring of recent log entries", min=10),
+    Option("debug_level", int, 1, "global log gate", min=-1, max=30),
+]
+
+
+class Config:
+    """Layered values + observer fan-out."""
+
+    def __init__(self, schema: list[Option] | None = None):
+        self.schema = {o.name: o for o in (schema or OPTIONS)}
+        self._layers: dict[str, dict[str, Any]] = {lv: {} for lv in _LEVELS}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+
+    def _resolve(self, name: str):
+        for level in reversed(_LEVELS):
+            if name in self._layers[level]:
+                return self._layers[level][name]
+        return self.schema[name].default
+
+    def get(self, name: str):
+        if name not in self.schema:
+            raise KeyError(f"unknown option {name!r}")
+        return self._resolve(name)
+
+    def __getitem__(self, name: str):
+        return self.get(name)
+
+    def set(self, name: str, value, level: str = "mon") -> None:
+        """Runtime change (role of `ceph config set`); notifies observers
+        if the resolved value actually changed."""
+        if name not in self.schema:
+            raise KeyError(f"unknown option {name!r}")
+        if level not in _LEVELS:
+            raise ValueError(f"bad level {level!r}; use one of {_LEVELS}")
+        before = self._resolve(name)
+        self._layers[level][name] = self.schema[name].coerce(value)
+        after = self._resolve(name)
+        if after != before:
+            for cb in self._observers.get(name, []):
+                cb(name, after)
+
+    def rm(self, name: str, level: str = "mon") -> None:
+        before = self._resolve(name)
+        self._layers[level].pop(name, None)
+        after = self._resolve(name)
+        if after != before:
+            for cb in self._observers.get(name, []):
+                cb(name, after)
+
+    def load_file(self, pairs: dict[str, Any]) -> None:
+        """Bulk-load a conf-file layer."""
+        for k, v in pairs.items():
+            if k not in self.schema:
+                raise KeyError(f"unknown option {k!r}")
+            self._layers["file"][k] = self.schema[k].coerce(v)
+
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        """Register a change observer (role of md_config_obs_t)."""
+        if name not in self.schema:
+            raise KeyError(f"unknown option {name!r}")
+        self._observers.setdefault(name, []).append(cb)
+
+    def dump(self) -> dict:
+        return {name: self._resolve(name) for name in sorted(self.schema)}
+
+    def diff(self) -> dict:
+        """Non-default values with their source level (`config diff`)."""
+        out = {}
+        for name in self.schema:
+            for level in reversed(_LEVELS):
+                if name in self._layers[level]:
+                    out[name] = {"value": self._layers[level][name],
+                                 "level": level}
+                    break
+        return out
+
+
+g_conf = Config()

@@ -1,0 +1,115 @@
+"""OpTracker — per-operation stage timing and historic-op dumps.
+
+Rebuild of the reference's op tracking (ref: src/common/TrackedOp.{h,cc}
+— TrackedOp::mark_event stage marks, OpTracker in-flight registry,
+`dump_historic_ops` / `dump_ops_in_flight` admin-socket commands, slow
+op warnings past osd_op_complaint_time).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", op_id: int, desc: str):
+        self._tracker = tracker
+        self.id = op_id
+        self.desc = desc
+        self.t_start = time.perf_counter()
+        self.events: list[tuple[float, str]] = [(0.0, "initiated")]
+        self.done = False
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.perf_counter() - self.t_start, name))
+
+    def finish(self) -> None:
+        if not self.done:
+            self.mark_event("done")
+            self.done = True
+            self.t_end_wall = time.time()
+            self._tracker._retire(self)
+
+    @property
+    def duration(self) -> float:
+        if self.done:
+            return self.events[-1][0]
+        return time.perf_counter() - self.t_start
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *rest):
+        if exc_type is not None:
+            self.mark_event(f"failed: {exc_type.__name__}")
+        self.finish()
+        return False
+
+    def dump(self) -> dict:
+        return {
+            "id": self.id,
+            "description": self.desc,
+            "duration": round(self.duration, 6),
+            "type_data": {"events": [
+                {"time": round(t, 6), "event": name}
+                for t, name in self.events]},
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, history_duration: float = 600.0,
+                 complaint_time: float = 30.0):
+        self._ids = itertools.count(1)
+        self._in_flight: dict[int, TrackedOp] = {}
+        self._history: collections.deque[TrackedOp] = collections.deque(
+            maxlen=history_size)
+        self._slowest: list[TrackedOp] = []
+        self.history_duration = history_duration
+        self.complaint_time = complaint_time
+        self._lock = threading.Lock()
+
+    def create_op(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, next(self._ids), desc)
+        with self._lock:
+            self._in_flight[op.id] = op
+        return op
+
+    def _retire(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._in_flight.pop(op.id, None)
+            self._history.append(op)
+            self._slowest.append(op)
+            self._slowest.sort(key=lambda o: -o.duration)
+            del self._slowest[self._history.maxlen:]
+
+    def _prune_expired(self) -> None:
+        """Drop completed ops older than history_duration (the
+        reference's osd_op_history_duration expiry). Call with lock."""
+        cutoff = time.time() - self.history_duration
+        while self._history and self._history[0].t_end_wall < cutoff:
+            self._history.popleft()
+        self._slowest = [o for o in self._slowest
+                         if o.t_end_wall >= cutoff]
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._in_flight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self, by_duration: bool = False) -> dict:
+        with self._lock:
+            self._prune_expired()
+            src = self._slowest if by_duration else list(self._history)
+            ops = [op.dump() for op in src]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def slow_ops(self) -> list[dict]:
+        """In-flight ops past the complaint threshold (the
+        'slow request' warning source)."""
+        now = time.perf_counter()
+        with self._lock:
+            return [op.dump() for op in self._in_flight.values()
+                    if now - op.t_start > self.complaint_time]

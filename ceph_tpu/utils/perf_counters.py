@@ -1,0 +1,165 @@
+"""PerfCounters — metrics registry.
+
+Rebuild of the reference's counter subsystem (ref:
+src/common/perf_counters.{h,cc} — PerfCountersBuilder::add_u64_counter/
+add_u64/add_time_avg, PerfCounters::{inc,dec,set,tinc},
+PerfCountersCollection dumped over the admin socket as
+`perf dump` / scraped by the mgr prometheus module).
+
+Counter kinds:
+  * counter   — monotonically increasing u64 (inc)
+  * gauge     — settable value (set/inc/dec)
+  * time_avg  — (sum_seconds, count) pair; tinc(seconds) adds a sample,
+                dump reports sum + count + avg (latency counters)
+  * histogram — fixed power-of-two-bucket latency/size histogram
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Counter:
+    kind: str
+    description: str = ""
+    value: float = 0
+    sum_s: float = 0.0
+    count: int = 0
+    buckets: list[int] = field(default_factory=list)
+
+
+class PerfCountersBuilder:
+    """Declare-then-freeze, like the reference's builder."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, _Counter] = {}
+
+    def add_u64_counter(self, key: str, description: str = ""):
+        self._counters[key] = _Counter("counter", description)
+        return self
+
+    def add_u64(self, key: str, description: str = ""):
+        self._counters[key] = _Counter("gauge", description)
+        return self
+
+    def add_time_avg(self, key: str, description: str = ""):
+        self._counters[key] = _Counter("time_avg", description)
+        return self
+
+    def add_histogram(self, key: str, description: str = "",
+                      n_buckets: int = 32):
+        self._counters[key] = _Counter("histogram", description,
+                                       buckets=[0] * n_buckets)
+        return self
+
+    def create_perf_counters(self) -> "PerfCounters":
+        return PerfCounters(self.name, self._counters)
+
+
+class PerfCounters:
+    def __init__(self, name: str, counters: dict[str, _Counter]):
+        self.name = name
+        self._c = counters
+        self._lock = threading.Lock()
+
+    def _get(self, key: str, kinds: tuple[str, ...]) -> _Counter:
+        c = self._c[key]
+        if c.kind not in kinds:
+            raise TypeError(f"{self.name}.{key} is {c.kind}, not {kinds}")
+        return c
+
+    def inc(self, key: str, by: float = 1) -> None:
+        with self._lock:
+            self._get(key, ("counter", "gauge")).value += by
+
+    def dec(self, key: str, by: float = 1) -> None:
+        with self._lock:
+            self._get(key, ("gauge",)).value -= by
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._get(key, ("gauge",)).value = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        with self._lock:
+            c = self._get(key, ("time_avg",))
+            c.sum_s += seconds
+            c.count += 1
+
+    def hinc(self, key: str, value: float) -> None:
+        """Histogram sample: bucket = floor(log2(value)) clamped."""
+        with self._lock:
+            c = self._get(key, ("histogram",))
+            b = max(0, min(len(c.buckets) - 1,
+                           int(value).bit_length() - 1 if value >= 1 else 0))
+            c.buckets[b] += 1
+
+    def get(self, key: str):
+        with self._lock:
+            c = self._c[key]
+            if c.kind == "time_avg":
+                return {"sum": c.sum_s, "count": c.count,
+                        "avg": c.sum_s / c.count if c.count else 0.0}
+            if c.kind == "histogram":
+                return list(c.buckets)
+            return c.value
+
+    def time(self, key: str):
+        """Context manager feeding a time_avg counter."""
+        counters = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                counters.tinc(key, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def dump(self) -> dict:
+        out = {}
+        with self._lock:
+            for key, c in self._c.items():
+                if c.kind == "time_avg":
+                    out[key] = {"avgcount": c.count, "sum": round(c.sum_s, 9)}
+                elif c.kind == "histogram":
+                    out[key] = list(c.buckets)
+                else:
+                    out[key] = c.value
+        return out
+
+
+class PerfCountersCollection:
+    """Process-wide registry; `perf dump` equivalent."""
+
+    def __init__(self):
+        self._loggers: dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def add(self, counters: PerfCounters) -> PerfCounters:
+        with self._lock:
+            self._loggers[counters.name] = counters
+        return counters
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._loggers.pop(name, None)
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {name: c.dump() for name, c in self._loggers.items()}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump(), sort_keys=True)
+
+
+# the default process-wide collection (role of CephContext's collection)
+g_perf_counters = PerfCountersCollection()

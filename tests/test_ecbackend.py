@@ -207,3 +207,10 @@ class TestReviewRegressions:
         st.queue_transaction(
             Transaction().write("c", "a", 0, b"x").rmattr("c", "missing", "k"))
         assert st.exists("c", "a")  # whole txn applied
+
+    def test_negative_write_offset_rejected_before_apply(self):
+        st = MemStore()
+        st.queue_transaction(Transaction().create_collection("c"))
+        with pytest.raises(ValueError):
+            Transaction().write("c", "a", 0, b"x").write("c", "b", -2, b"xyz")
+        assert not st.exists("c", "a")

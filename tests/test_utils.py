@@ -1,0 +1,186 @@
+"""Aux subsystem tests: PerfCounters, Config layering/observers,
+Log ring + gates, OpTracker stage timing."""
+
+import io
+import time
+
+import pytest
+
+from ceph_tpu.utils.config import Config, Option
+from ceph_tpu.utils.log import Log
+from ceph_tpu.utils.op_tracker import OpTracker
+from ceph_tpu.utils.perf_counters import (PerfCountersBuilder,
+                                          PerfCountersCollection)
+
+
+class TestPerfCounters:
+    def build(self):
+        return (PerfCountersBuilder("osd")
+                .add_u64_counter("op_w", "writes")
+                .add_u64("numpg", "placement groups")
+                .add_time_avg("op_latency", "op latency")
+                .add_histogram("op_size_hist", "op sizes", n_buckets=8)
+                .create_perf_counters())
+
+    def test_counter_gauge(self):
+        c = self.build()
+        c.inc("op_w")
+        c.inc("op_w", 4)
+        assert c.get("op_w") == 5
+        c.set("numpg", 33)
+        c.dec("numpg", 3)
+        assert c.get("numpg") == 30
+        with pytest.raises(TypeError):
+            c.dec("op_w")  # counters are monotonic
+
+    def test_time_avg_and_timer(self):
+        c = self.build()
+        c.tinc("op_latency", 0.5)
+        c.tinc("op_latency", 1.5)
+        got = c.get("op_latency")
+        assert got["count"] == 2 and got["avg"] == 1.0
+        with c.time("op_latency"):
+            pass
+        assert c.get("op_latency")["count"] == 3
+
+    def test_histogram_buckets(self):
+        c = self.build()
+        for v in (1, 2, 3, 130):
+            c.hinc("op_size_hist", v)
+        assert sum(c.get("op_size_hist")) == 4
+        assert c.get("op_size_hist")[7] == 1  # 130 -> bucket 7
+
+    def test_collection_dump(self):
+        coll = PerfCountersCollection()
+        c = coll.add(self.build())
+        c.inc("op_w")
+        d = coll.dump()
+        assert d["osd"]["op_w"] == 1
+        assert d["osd"]["op_latency"] == {"avgcount": 0, "sum": 0.0}
+        coll.remove("osd")
+        assert coll.dump() == {}
+
+
+class TestConfig:
+    def test_defaults_and_layering(self):
+        c = Config()
+        assert c.get("osd_recovery_max_active") == 3
+        c.load_file({"osd_recovery_max_active": "5"})
+        assert c.get("osd_recovery_max_active") == 5
+        c.set("osd_recovery_max_active", 7)           # mon layer
+        assert c.get("osd_recovery_max_active") == 7
+        c.set("osd_recovery_max_active", 9, level="override")
+        assert c.get("osd_recovery_max_active") == 9
+        c.rm("osd_recovery_max_active", level="override")
+        assert c.get("osd_recovery_max_active") == 7
+
+    def test_validation(self):
+        c = Config()
+        with pytest.raises(KeyError):
+            c.get("nope")
+        with pytest.raises(ValueError):
+            c.set("osd_recovery_max_active", 0)       # min=1
+        with pytest.raises(ValueError):
+            c.set("osd_scrub_auto_repair", "maybe")
+        c.set("osd_scrub_auto_repair", "true")
+        assert c.get("osd_scrub_auto_repair") is True
+
+    def test_observers(self):
+        c = Config()
+        seen = []
+        c.observe("osd_heartbeat_grace", lambda k, v: seen.append((k, v)))
+        c.set("osd_heartbeat_grace", 10.0)
+        c.set("osd_heartbeat_grace", 10.0)  # no change -> no callback
+        c.set("osd_heartbeat_grace", 12.0)
+        assert seen == [("osd_heartbeat_grace", 10.0),
+                        ("osd_heartbeat_grace", 12.0)]
+
+    def test_diff(self):
+        c = Config()
+        c.set("debug_level", 5)
+        d = c.diff()
+        assert d == {"debug_level": {"value": 5, "level": "mon"}}
+
+
+class TestLog:
+    def test_gather_more_than_logged(self):
+        sink = io.StringIO()
+        lg = Log(max_recent=100, sink=sink)
+        lg.set_level("ec", 1, gather=5)
+        lg.dout("ec", 1, "printed and gathered")
+        lg.dout("ec", 4, "gathered only")
+        lg.dout("ec", 9, "dropped")
+        printed = sink.getvalue()
+        assert "printed and gathered" in printed
+        assert "gathered only" not in printed
+        recent = lg.dump_recent()
+        assert any("gathered only" in ln for ln in recent)
+        assert not any("dropped" in ln for ln in recent)
+
+    def test_ring_bounded(self):
+        lg = Log(max_recent=10, sink=None)
+        for i in range(50):
+            lg.dout("osd", 1, f"m{i}")
+        recent = lg.dump_recent()
+        assert len(recent) == 10
+        assert "m49" in recent[-1]
+
+    def test_crash_dump_format(self):
+        sink = io.StringIO()
+        lg = Log(max_recent=10, sink=None)
+        lg.dout("osd", 1, "boom context")
+        lg.dump_recent(file=sink)
+        out = sink.getvalue()
+        assert "begin dump of recent events" in out
+        assert "boom context" in out
+
+
+class TestOpTracker:
+    def test_stages_and_history(self):
+        tr = OpTracker(history_size=5)
+        with tr.create_op("osd_op(client.1 write obj1)") as op:
+            op.mark_event("queued")
+            op.mark_event("encoded")
+        assert tr.dump_ops_in_flight()["num_ops"] == 0
+        hist = tr.dump_historic_ops()
+        assert hist["num_ops"] == 1
+        events = [e["event"] for e in
+                  hist["ops"][0]["type_data"]["events"]]
+        assert events == ["initiated", "queued", "encoded", "done"]
+
+    def test_in_flight_and_slow(self):
+        tr = OpTracker(complaint_time=0.01)
+        op = tr.create_op("slow op")
+        assert tr.dump_ops_in_flight()["num_ops"] == 1
+        time.sleep(0.02)
+        assert len(tr.slow_ops()) == 1
+        op.finish()
+        assert tr.slow_ops() == []
+
+    def test_history_bounded_and_slowest(self):
+        tr = OpTracker(history_size=3)
+        for i in range(10):
+            tr.create_op(f"op{i}").finish()
+        assert tr.dump_historic_ops()["num_ops"] == 3
+        assert tr.dump_historic_ops(by_duration=True)["num_ops"] == 3
+
+    def test_exception_marks_failure(self):
+        tr = OpTracker()
+        with pytest.raises(RuntimeError):
+            with tr.create_op("bad") as op:
+                raise RuntimeError("x")
+        events = [e["event"] for e in
+                  tr.dump_historic_ops()["ops"][0]["type_data"]["events"]]
+        assert any("failed: RuntimeError" in e for e in events)
+
+
+def test_historic_ops_expire_by_age():
+    tr = OpTracker(history_size=10, history_duration=0.05)
+    tr.create_op("old").finish()
+    time.sleep(0.08)
+    tr.create_op("new").finish()
+    ops = tr.dump_historic_ops()["ops"]
+    descs = [o["description"] for o in ops]
+    assert descs == ["new"]
+    assert [o["description"] for o in
+            tr.dump_historic_ops(by_duration=True)["ops"]] == ["new"]
