@@ -1,0 +1,260 @@
+"""SimCluster — hermetic multi-OSD cluster with failure detection.
+
+Rebuild of the reference's elastic-recovery loop, in-process (refs:
+heartbeats src/osd/OSD.cc handle_osd_ping/maybe_update_heartbeat_peers
+with osd_heartbeat_grace; failure reports -> OSDMonitor::prepare_failure
+marking down, mon_osd_down_out_interval auto-out (src/mon/OSDMonitor.cc);
+map-change re-peering src/osd/PeeringState.cc choose_acting/activate;
+the standalone many-daemons-one-host test pattern qa/standalone/
+ceph-helpers.sh). The reference's teuthology Thrasher (qa/tasks/
+ceph_manager.py) is mirrored by tests/test_cluster.py's
+thrash-under-io property test.
+
+Everything runs on a VIRTUAL clock — tick(dt) advances time, delivers
+heartbeats, expires grace windows, applies down/out transitions, and
+drives recovery — so failure/recovery scenarios are deterministic and
+fast. Data lives in MemStores (one per OSD); each PG is a mini-
+ECBackend whose acting set tracks the OSDMap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.map import (CRUSH_ITEM_NONE, Tunables, build_hierarchy, ec_rule)
+from ..utils.log import g_log
+from ..utils.perf_counters import PerfCountersBuilder
+from .ecbackend import ECBackend, ShardSet
+from .osdmap import OSDMap, PGPool
+
+
+class SimCluster:
+    """n_osds OSDs, one EC pool, pg_num PGs, virtual-time failure
+    handling."""
+
+    def __init__(self, n_osds: int = 12, profile: str | dict =
+                 "plugin=tpu_rs k=4 m=2 impl=bitlinear",
+                 pg_num: int = 8, osds_per_host: int = 1,
+                 chunk_size: int = 256,
+                 heartbeat_interval: float = 6.0,
+                 heartbeat_grace: float = 20.0,
+                 down_out_interval: float = 600.0,
+                 min_down_reporters: int = 2):
+        crush = build_hierarchy(n_osds, osds_per_host=osds_per_host,
+                                hosts_per_rack=max(4, n_osds))
+        # the reference default (51): plenty of retry headroom once
+        # several OSDs are out; the vectorized mapper's while_loop
+        # early-exits, so unused rounds cost nothing
+        crush.tunables = Tunables(choose_total_tries=51)
+        ec_rule(crush, 1, choose_type=1)
+        self.osdmap = OSDMap(crush)
+        self.cluster = ShardSet()
+        self.profile = profile
+        from ..ec.registry import factory
+        coder = factory(profile)
+        self.pool_size = coder.get_chunk_count()
+        self.m = coder.get_coding_chunk_count()
+        self.osdmap.add_pool(PGPool(1, pg_num=pg_num, size=self.pool_size,
+                                    min_size=self.pool_size - self.m,
+                                    crush_rule=1, is_erasure=True))
+        self.pg_num = pg_num
+        self.chunk_size = chunk_size
+        # timing / failure model
+        self.now = 0.0
+        self.hb_interval = heartbeat_interval
+        self.hb_grace = heartbeat_grace
+        self.down_out_interval = down_out_interval
+        self.min_down_reporters = min_down_reporters
+        self.alive = np.ones(n_osds, dtype=bool)      # process up?
+        self.last_heard = np.zeros((n_osds, n_osds))  # peer hb stamps
+        self.down_since: dict[int, float] = {}
+        self.perf = (PerfCountersBuilder("cluster")
+                     .add_u64_counter("recovered_objects")
+                     .add_u64_counter("osd_marked_down")
+                     .add_u64_counter("osd_marked_out")
+                     .add_u64("degraded_pgs")
+                     .create_perf_counters())
+        # PG backends at their initial acting sets
+        self.pgs: dict[int, ECBackend] = {}
+        for ps in range(pg_num):
+            acting = self._acting(ps)
+            if any(a == CRUSH_ITEM_NONE for a in acting):
+                raise ValueError(f"pg {ps} has unfilled slots at creation; "
+                                 f"use more osds/hosts")
+            self.pgs[ps] = ECBackend(profile, f"1.{ps}", acting,
+                                     self.cluster, chunk_size=chunk_size)
+
+    # -- placement helpers --------------------------------------------------
+
+    def _acting(self, ps: int) -> list[int]:
+        up, _upp, acting, _actp = self.osdmap.pg_to_up_acting_osds(1, ps)
+        return acting
+
+    def locate(self, name: str) -> int:
+        return self.osdmap.object_to_pg(1, name)[1]
+
+    # -- client I/O ---------------------------------------------------------
+
+    def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
+        by_pg: dict[int, dict] = {}
+        for name, data in objects.items():
+            by_pg.setdefault(self.locate(name), {})[name] = data
+        for ps, group in by_pg.items():
+            self.pgs[ps].write_objects(group)
+
+    def read(self, name: str) -> np.ndarray:
+        ps = self.locate(name)
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        return self.pgs[ps].read_object(name, dead_osds=dead)
+
+    # -- failure model ------------------------------------------------------
+
+    def kill_osd(self, osd: int) -> None:
+        """Process death: store bytes survive, peer stops answering."""
+        self.alive[osd] = False
+        g_log.dout("osd", 1, f"osd.{osd} killed at t={self.now}")
+
+    def destroy_osd(self, osd: int) -> None:
+        """Disk loss: kill + drop the store."""
+        self.kill_osd(osd)
+        self.cluster.stores.pop(osd, None)
+
+    def revive_osd(self, osd: int) -> None:
+        if osd in self.down_since:
+            return  # must be handled by recovery once marked down
+        if osd not in self.cluster.stores:
+            raise ValueError(
+                f"osd.{osd} was destroyed (no store); it cannot rejoin "
+                f"with its old identity — let recovery re-place its data")
+        self.alive[osd] = True
+        self.last_heard[:, osd] = self.now
+        g_log.dout("osd", 1, f"osd.{osd} revived at t={self.now}")
+
+    def tick(self, dt: float = 1.0) -> None:
+        """Advance virtual time; deliver heartbeats; run the
+        monitor's failure logic; trigger recovery on map changes."""
+        steps = max(1, int(round(dt / self.hb_interval)))
+        for _ in range(steps):
+            self.now += dt / steps
+            up = self.alive
+            # alive peers hear each other every interval
+            self.last_heard[np.ix_(up, up)] = self.now
+            # grace expiry: alive i reports silent j
+            silent = self.now - self.last_heard > self.hb_grace
+            for j in range(len(up)):
+                if not self.osdmap.osd_up[j]:
+                    continue
+                reporters = int(silent[up, j].sum())
+                if reporters >= self.min_down_reporters:
+                    self._mark_down(j)
+            # down long enough -> out -> remap + recover
+            for j, since in list(self.down_since.items()):
+                if self.now - since >= self.down_out_interval:
+                    self._mark_out(j)
+
+    def _mark_down(self, osd: int) -> None:
+        if not self.osdmap.osd_up[osd]:
+            return
+        self.osdmap.mark_down(osd)
+        self.down_since[osd] = self.now
+        self.perf.inc("osd_marked_down")
+        g_log.dout("mon", 1, f"osd.{osd} marked down (epoch "
+                             f"{self.osdmap.epoch})")
+        self._update_degraded()
+
+    def _mark_out(self, osd: int) -> None:
+        if osd not in self.down_since:
+            return
+        self.osdmap.mark_out(osd)
+        del self.down_since[osd]
+        self.perf.inc("osd_marked_out")
+        g_log.dout("mon", 1, f"osd.{osd} marked out (epoch "
+                             f"{self.osdmap.epoch})")
+        self._repeer_all()
+
+    def _update_degraded(self) -> None:
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        degraded = sum(
+            1 for ps in range(self.pg_num)
+            if any(o in dead for o in self.pgs[ps].acting))
+        self.perf.set("degraded_pgs", degraded)
+
+    def _repeer_all(self) -> None:
+        """Map changed: every PG re-derives its acting set; shards on
+        replaced OSDs are recovered (dead source) or copied (backfill
+        from live source)."""
+        for ps in range(self.pg_num):
+            be = self.pgs[ps]
+            new_acting = self._acting(ps)
+            if new_acting == be.acting:
+                continue
+            if any(a == CRUSH_ITEM_NONE for a in new_acting):
+                g_log.dout("osd", 0, f"pg 1.{ps} undersized after remap")
+                continue
+            lost, moved = [], []
+            for slot, (old, new) in enumerate(zip(be.acting, new_acting)):
+                if old == new:
+                    continue
+                if self.alive[old] and old in self.cluster.stores:
+                    moved.append((slot, old, new))
+                else:
+                    lost.append((slot, new))
+            # backfill-lite: copy shard bytes from live old -> new
+            from .ecbackend import shard_cid
+            from .memstore import Transaction
+            for slot, old, new in moved:
+                src = self.cluster.osd(old)
+                dst = self.cluster.osd(new)
+                cid = shard_cid(be.pg, slot)
+                t = Transaction().create_collection(cid)
+                dst.queue_transaction(t)
+                for name in src.list_objects(cid):
+                    t = (Transaction()
+                         .write(cid, name, 0, src.read(cid, name))
+                         .setattr(cid, name, "hinfo_key",
+                                  src.getattr(cid, name, "hinfo_key")))
+                    dst.queue_transaction(t)
+                be.acting[slot] = new
+            if lost:
+                slots = [s for s, _ in lost]
+                repl = {s: n for s, n in lost}
+                counters = be.recover_shards(slots, replacement_osds=repl)
+                self.perf.inc("recovered_objects", counters["objects"])
+                g_log.dout("recovery", 1,
+                           f"pg 1.{ps}: rebuilt {counters['objects']} "
+                           f"objects onto {repl}")
+        self._update_degraded()
+
+    # -- health -------------------------------------------------------------
+
+    def health(self) -> dict:
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        degraded = active_clean = undersized = 0
+        for ps in range(self.pg_num):
+            acting = self.pgs[ps].acting
+            holes = sum(1 for o in acting if o == CRUSH_ITEM_NONE)
+            dead_in_pg = sum(1 for o in acting if o in dead)
+            if holes:
+                undersized += 1
+            elif dead_in_pg:
+                degraded += 1
+            else:
+                active_clean += 1
+        return {
+            "epoch": self.osdmap.epoch,
+            "osds_up": int(self.osdmap.osd_up.sum()),
+            "osds_alive": int(self.alive.sum()),
+            "pgs_active_clean": active_clean,
+            "pgs_degraded": degraded,
+            "pgs_undersized": undersized,
+        }
+
+    def verify_all(self, expected: dict[str, np.ndarray]) -> int:
+        """Read every object back and byte-compare; returns count."""
+        ok = 0
+        for name, data in expected.items():
+            got = self.read(name)
+            if not np.array_equal(got, np.asarray(data, np.uint8)):
+                raise AssertionError(f"data loss: {name}")
+            ok += 1
+        return ok

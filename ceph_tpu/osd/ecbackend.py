@@ -109,6 +109,19 @@ class ECBackend:
                     data, np.uint8)
             by_len.setdefault(len(arr), []).append((name, arr))
         for olen, group in by_len.items():
+            if olen == 0:
+                # zero-length objects: empty shards, hinfo over 0 bytes
+                hinfo = HashInfo(1, 0, [0xFFFFFFFF])
+                for name, _ in group:
+                    self.object_sizes[name] = 0
+                    for shard in range(self.n):
+                        t = (Transaction()
+                             .write(shard_cid(self.pg, shard), name, 0, b"")
+                             .truncate(shard_cid(self.pg, shard), name, 0)
+                             .setattr(shard_cid(self.pg, shard), name,
+                                      HINFO_KEY, hinfo.to_bytes()))
+                        self._store(shard).queue_transaction(t)
+                continue
             batch = np.stack([a for _, a in group])
             cl = self._chunk_len(olen)
             # object_to_shards pads to the stripe boundary (= k*cl here,
@@ -153,6 +166,9 @@ class ECBackend:
         # decode each group in ONE launch
         by_len: dict[int, list[str]] = {}
         for name in names:
+            if self.object_sizes[name] == 0:
+                out[name] = np.zeros(0, dtype=np.uint8)
+                continue
             by_len.setdefault(self._chunk_len(self.object_sizes[name]),
                               []).append(name)
         for cl, group in by_len.items():
@@ -199,6 +215,17 @@ class ECBackend:
             # equal chunk length groups
             by_len: dict[int, list[str]] = {}
             for name in group:
+                if self.object_sizes[name] == 0:
+                    # nothing to decode: re-create the empty shard
+                    hinfo = HashInfo(1, 0, [0xFFFFFFFF])
+                    for s in lost:
+                        t = (Transaction()
+                             .write(shard_cid(self.pg, s), name, 0, b"")
+                             .setattr(shard_cid(self.pg, s), name,
+                                      HINFO_KEY, hinfo.to_bytes()))
+                        self._store(s).queue_transaction(t)
+                    counters["objects"] += 1
+                    continue
                 cl = self._chunk_len(self.object_sizes[name])
                 by_len.setdefault(cl, []).append(name)
             for cl, subgroup in by_len.items():

@@ -214,3 +214,15 @@ class TestReviewRegressions:
         with pytest.raises(ValueError):
             Transaction().write("c", "a", 0, b"x").write("c", "b", -2, b"xyz")
         assert not st.exists("c", "a")
+
+    def test_zero_length_object(self):
+        be, _ = make_backend()
+        be.write_objects({"empty": b"", "full": b"hello world"})
+        assert be.read_object("empty").size == 0
+        assert be.read_object("full").tobytes() == b"hello world"
+        assert be.deep_scrub()["inconsistent"] == []
+        # recovery with an empty object in the corpus
+        be.cluster.stores.pop(1)
+        be.recover_shards([1], replacement_osds={1: 8})
+        assert be.read_object("empty").size == 0
+        assert be.deep_scrub()["inconsistent"] == []
