@@ -112,6 +112,9 @@ class Clay(ErasureCode):
     """Coupled-layer MSR code: MDS with optimal single-failure repair."""
 
     DEFAULT_GAMMA = 2
+    # bytes are coupled across the sub-chunk axis of each chunk, so a
+    # sub-window of a chunk is not independently en/decodable
+    positionwise = False
 
     def init(self, profile: Mapping[str, str]) -> None:
         self.k = int(profile.get("k", 4))

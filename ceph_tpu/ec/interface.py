@@ -40,6 +40,12 @@ class ErasureCode(abc.ABC):
     k: int
     m: int
 
+    # True when encode/decode act independently on every byte position
+    # of a chunk (all matrix codes). Vector codes that couple bytes
+    # across a chunk's sub-chunk axis (clay) set this False; callers
+    # like the RMW write path then fall back to whole-object windows.
+    positionwise: bool = True
+
     def __init__(self, profile: Mapping[str, str] | None = None):
         self.profile: ErasureCodeProfile = dict(profile or {})
         if profile is not None:

@@ -2,10 +2,11 @@
 
 Rebuild of the reference's EC read/write/recovery dataflow (ref:
 src/osd/ECBackend.{h,cc} + ECCommon.{h,cc} — submit_transaction write
-fan-out, objects_read_and_reconstruct degraded read,
-RecoveryOp/continue_recovery_op streaming recovery;
-ECTransaction::generate_transactions for the per-shard store writes;
-per-shard HashInfo bookkeeping ref: src/osd/ECUtil.{h,cc}).
+fan-out, RMWPipeline::start_rmw read-modify-write of partial stripes,
+objects_read_and_reconstruct degraded read, RecoveryOp/
+continue_recovery_op streaming recovery; ECTransaction::
+generate_transactions for the per-shard store writes; per-shard HashInfo
+bookkeeping ref: src/osd/ECUtil.{h,cc}).
 
 TPU-first reshaping (SURVEY.md §2.7 P1-P4): where the reference fans
 one object's sub-ops out over the network and recovers objects under a
@@ -16,6 +17,16 @@ arrays, runs ONE batched decode, and scatters the rebuilt shards back.
 The per-shard stores are MemStore instances standing in for OSDs, so
 the whole pipeline runs hermetically (the reference's
 many-daemons-one-box trick, in-process).
+
+Stripe geometry is POOL-WIDE and fixed (ref: pool stripe_unit →
+ECUtil::stripe_info_t): every object is laid out round-robin in stripes
+of k * chunk_size logical bytes, so objects span multiple stripes and a
+partial overwrite touches only the stripes covering its byte range.
+That makes the reference's read-modify-write pipeline meaningful here:
+`write_ranges` reads the pre-image of just the touched stripe window
+from the data shards (reconstructing the window from survivors when
+shards are down), overlays the new bytes, re-encodes the window in one
+batched launch, and emits per-shard sub-range writes.
 
 Object placement: shard i of an object lands on the OSD in slot i of
 the PG's acting set (the chunk->shard identity mapping); a lost OSD
@@ -32,7 +43,7 @@ import numpy as np
 from ..ec.interface import ErasureCode
 from ..ec.registry import factory
 from .memstore import MemStore, Transaction
-from .stripe import HashInfo, StripeInfo
+from .stripe import HashInfo, StripeInfo, as_flat_u8
 
 HINFO_KEY = "hinfo_key"  # same xattr name role as the reference
 
@@ -71,7 +82,11 @@ class ECBackend:
             raise ValueError("non-identity chunk mappings not supported "
                              "by this backend yet")
         self.cluster = cluster or ShardSet()
-        cs = chunk_size or self.coder.get_chunk_size(0) or 4096
+        # pool-wide stripe geometry; round the requested chunk size up
+        # through the coder's own alignment rule (clay needs sub-chunk
+        # multiples, everything needs CHUNK_ALIGNMENT)
+        requested = chunk_size or self.coder.get_chunk_size(0) or 4096
+        cs = self.coder.get_chunk_size(requested * self.k)
         self.sinfo = StripeInfo(self.k, cs)
         # one collection per shard on its OSD
         for shard, osd in enumerate(self.acting):
@@ -84,10 +99,8 @@ class ECBackend:
     def _store(self, shard: int) -> MemStore:
         return self.cluster.osd(self.acting[shard])
 
-    def _chunk_len(self, object_size: int) -> int:
-        padded = self.coder.get_chunk_size(
-            self.sinfo.logical_to_next_stripe_offset(object_size))
-        return max(padded, self.sinfo.chunk_size)
+    def _shard_len(self, object_size: int) -> int:
+        return self.sinfo.object_size_to_shard_size(object_size)
 
     @staticmethod
     def _batched_hinfo_crcs(chunks: np.ndarray) -> np.ndarray:
@@ -96,7 +109,18 @@ class ECBackend:
         from ..csum.kernels import crc32c_blocks
         return np.asarray(crc32c_blocks(chunks, init=0xFFFFFFFF, xorout=0))
 
-    # -- write path (submit_transaction) ------------------------------------
+    def _write_empty(self, name: str) -> None:
+        hinfo = HashInfo(1, 0, [0xFFFFFFFF])
+        self.object_sizes[name] = 0
+        for shard in range(self.n):
+            t = (Transaction()
+                 .write(shard_cid(self.pg, shard), name, 0, b"")
+                 .truncate(shard_cid(self.pg, shard), name, 0)
+                 .setattr(shard_cid(self.pg, shard), name,
+                          HINFO_KEY, hinfo.to_bytes()))
+            self._store(shard).queue_transaction(t)
+
+    # -- write path (submit_transaction, full-object) ------------------------
 
     def write_objects(self, objects: dict[str, bytes | np.ndarray]) -> None:
         """Full-object writes, batched: encode every equal-length group
@@ -104,47 +128,206 @@ class ECBackend:
         (the role of ECTransaction::generate_transactions)."""
         by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
         for name, data in objects.items():
-            arr = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
-                data, (bytes, bytearray, memoryview)) else np.asarray(
-                    data, np.uint8)
+            arr = as_flat_u8(data)
             by_len.setdefault(len(arr), []).append((name, arr))
         for olen, group in by_len.items():
             if olen == 0:
-                # zero-length objects: empty shards, hinfo over 0 bytes
-                hinfo = HashInfo(1, 0, [0xFFFFFFFF])
                 for name, _ in group:
-                    self.object_sizes[name] = 0
-                    for shard in range(self.n):
-                        t = (Transaction()
-                             .write(shard_cid(self.pg, shard), name, 0, b"")
-                             .truncate(shard_cid(self.pg, shard), name, 0)
-                             .setattr(shard_cid(self.pg, shard), name,
-                                      HINFO_KEY, hinfo.to_bytes()))
-                        self._store(shard).queue_transaction(t)
+                    self._write_empty(name)
                 continue
             batch = np.stack([a for _, a in group])
-            cl = self._chunk_len(olen)
-            # object_to_shards pads to the stripe boundary (= k*cl here,
-            # since cl is derived from olen) and splits to data shards
-            sin = StripeInfo(self.k, cl)
-            data_shards = sin.object_to_shards(batch)    # (B, k, cl)
+            sl = self._shard_len(olen)
+            data_shards = self.sinfo.object_to_shards(batch)  # (B, k, sl)
             parity = np.asarray(self.coder.encode_chunks(data_shards))
             shards = np.concatenate([data_shards, parity], axis=1)
-            crcs = self._batched_hinfo_crcs(shards.reshape(-1, cl))
+            crcs = self._batched_hinfo_crcs(shards.reshape(-1, sl))
             crcs = crcs.reshape(len(group), self.n)
             for bi, (name, arr) in enumerate(group):
                 self.object_sizes[name] = olen
                 for shard in range(self.n):
                     chunk = shards[bi, shard, :]
-                    hinfo = HashInfo(1, cl, [int(crcs[bi, shard])])
+                    hinfo = HashInfo(1, sl, [int(crcs[bi, shard])])
                     # truncate clears any stale tail from a previous,
                     # larger version of the object
                     t = (Transaction()
                          .write(shard_cid(self.pg, shard), name, 0, chunk)
-                         .truncate(shard_cid(self.pg, shard), name, cl)
+                         .truncate(shard_cid(self.pg, shard), name, sl)
                          .setattr(shard_cid(self.pg, shard), name,
                                   HINFO_KEY, hinfo.to_bytes()))
                     self._store(shard).queue_transaction(t)
+
+    # -- write path (RMW partial-stripe) -------------------------------------
+
+    def write_at(self, name: str, offset: int, data: bytes | np.ndarray,
+                 dead_osds: set[int] | None = None) -> None:
+        """Overwrite/extend an arbitrary (offset, len) byte range — the
+        reference's RMW write (ref: ECCommon::RMWPipeline::start_rmw)."""
+        self.write_ranges([(name, offset, data)], dead_osds)
+
+    def _read_data_window(self, names: list[str], c0: int, clen: int,
+                          dead: set[int],
+                          old_slens: list[int]) -> np.ndarray:
+        """Pre-image data-shard window (B, k, clen) for the RMW read
+        phase, reconstructing down data shards from survivors (the
+        degraded-write case). Reads past a shard's end zero-fill, which
+        matches the zero-padding layout rule.
+
+        old_slens: each object's current shard length — vector codes
+        (clay) must decode at the OLD length because their sub-chunk
+        geometry depends on chunk length; zero-extended chunks would
+        decode to garbage."""
+        B = len(names)
+        avail = [s for s in range(self.n) if self.acting[s] not in dead]
+        lost_data = [s for s in range(self.k) if s not in avail]
+
+        def read_window(s: int, nm: str, off: int, ln: int) -> np.ndarray:
+            buf = np.zeros(ln, dtype=np.uint8)
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            if st.exists(cid, nm):
+                got = st.read(cid, nm, off, ln)
+                buf[:len(got)] = got
+            return buf
+
+        window = np.zeros((B, self.k, clen), dtype=np.uint8)
+        for s in range(self.k):
+            if s in lost_data:
+                continue
+            for bi, nm in enumerate(names):
+                window[bi, s] = read_window(s, nm, c0, clen)
+        if not lost_data:
+            return window
+        helpers = sorted(self.coder.minimum_to_decode(lost_data, avail))
+        if getattr(self.coder, "positionwise", True):
+            # surviving data helpers are already in `window`; only read
+            # parity helpers from the stores
+            stacks = {s: window[:, s] if s < self.k else
+                      np.stack([read_window(s, nm, c0, clen)
+                                for nm in names])
+                      for s in helpers}
+            rec = self.coder.decode_chunks(lost_data, stacks)
+            for s in lost_data:
+                window[:, s] = np.asarray(rec[s])
+        else:
+            # decode whole chunks at each object's OLD shard length
+            # (the non-positionwise path always uses c0 == 0 windows)
+            by_old: dict[int, list[int]] = {}
+            for bi, sl in enumerate(old_slens):
+                if sl:
+                    by_old.setdefault(sl, []).append(bi)
+            for sl, idxs in by_old.items():
+                stacks = {s: np.stack([read_window(s, names[bi], 0, sl)
+                                       for bi in idxs])
+                          for s in helpers}
+                rec = self.coder.decode_chunks(lost_data, stacks)
+                ln = min(sl, clen)
+                for s in lost_data:
+                    window[idxs, s, :ln] = np.asarray(rec[s])[:, :ln]
+        return window
+
+    def write_ranges(self, ops: list[tuple[str, int, bytes | np.ndarray]],
+                     dead_osds: set[int] | None = None) -> None:
+        """Batched RMW: for every (name, offset, bytes) op, read the
+        touched stripe window, overlay, re-encode, and emit per-shard
+        sub-range writes + hinfo updates. Encode launches are batched
+        across objects whose windows have equal chunk length."""
+        dead = dead_osds or set()
+        k, si = self.k, self.sinfo
+        live = [s for s in range(self.n) if self.acting[s] not in dead]
+
+        # merge ops per object into one covering window
+        per_obj: dict[str, list[tuple[int, np.ndarray]]] = {}
+        for name, offset, data in ops:
+            if offset < 0:
+                raise ValueError(f"negative offset {offset}")
+            per_obj.setdefault(name, []).append(
+                (int(offset), as_flat_u8(data)))
+
+        jobs = []  # (name, writes, old_slen, new_size, s0, clen)
+        for name, writes in per_obj.items():
+            old_size = self.object_sizes.get(name, 0)
+            writes = [(off, a) for off, a in writes if len(a)]
+            if not writes:
+                # zero-length writes don't extend; just ensure existence
+                if name not in self.object_sizes:
+                    self._write_empty(name)
+                continue
+            hi = max(off + len(a) for off, a in writes)
+            new_size = max(old_size, hi)
+            lo = min(off for off, a in writes)
+            if not getattr(self.coder, "positionwise", True):
+                # vector codes (clay) couple bytes across the whole
+                # chunk: windows are not independently encodable, so
+                # fall back to a whole-object RMW
+                lo, hi = 0, new_size
+            s0, slen = si.offset_len_to_stripe_bounds(lo, hi - lo)
+            jobs.append((name, writes, self._shard_len(old_size),
+                         new_size, s0, slen // k))
+
+        by_clen: dict[int, list[tuple]] = {}
+        for job in jobs:
+            by_clen.setdefault(job[-1], []).append(job)
+
+        for clen, group in by_clen.items():
+            names = [j[0] for j in group]
+            old_slens = [j[2] for j in group]
+            c0s = {j[4] // k for j in group}
+            if len(c0s) == 1:
+                window = self._read_data_window(names, c0s.pop(), clen,
+                                                dead, old_slens)
+            else:
+                # mixed chunk offsets in one length group: read per job
+                window = np.stack([
+                    self._read_data_window([j[0]], j[4] // k, clen, dead,
+                                           [j[2]])[0]
+                    for j in group])
+            # overlay new bytes in logical space
+            logical = si.shards_to_object(window)  # (B, slen)
+            for bi, (name, writes, _, _, s0, _) in enumerate(group):
+                for off, arr in writes:
+                    logical[bi, off - s0:off - s0 + len(arr)] = arr
+            dshards = si.object_to_shards(logical)       # (B, k, clen)
+            parity = np.asarray(self.coder.encode_chunks(dshards))
+            shards = np.concatenate([dshards, parity], axis=1)  # (B, n, clen)
+
+            # apply sub-range writes + recompute full-shard hinfo on the
+            # LIVE shards only (down shards are rebuilt by recovery;
+            # touching their stores would resurrect destroyed OSD ids).
+            # Cumulative-CRC hinfo is append-only in the reference; an
+            # overwrite invalidates it, so the RMW path recomputes the
+            # full-shard CRC — batched per equal shard length.
+            new_full: dict[int, list[np.ndarray]] = {}  # nsl -> full bytes
+            slots: dict[int, list[tuple[int, int]]] = {}  # nsl -> (bi, s)
+            for bi, (name, writes, _, new_size, s0, _) in enumerate(group):
+                nsl = self._shard_len(new_size)
+                c0 = s0 // k
+                for s in live:
+                    st = self._store(s)
+                    cid = shard_cid(self.pg, s)
+                    old = st.read(cid, name) if st.exists(cid, name) \
+                        else np.zeros(0, dtype=np.uint8)
+                    full = np.zeros(nsl, dtype=np.uint8)
+                    full[:min(len(old), nsl)] = old[:nsl]
+                    full[c0:c0 + clen] = shards[bi, s]
+                    new_full.setdefault(nsl, []).append(full)
+                    slots.setdefault(nsl, []).append((bi, s))
+            crc_of: dict[tuple[int, int], int] = {}
+            for nsl, fulls in new_full.items():
+                crcs = self._batched_hinfo_crcs(np.stack(fulls))
+                for (bi, s), c in zip(slots[nsl], crcs):
+                    crc_of[(bi, s)] = int(c)
+            for bi, (name, writes, _, new_size, s0, _) in enumerate(group):
+                nsl = self._shard_len(new_size)
+                c0 = s0 // k
+                for s in live:
+                    hinfo = HashInfo(1, nsl, [crc_of[(bi, s)]])
+                    t = (Transaction()
+                         .write(shard_cid(self.pg, s), name, c0,
+                                shards[bi, s])
+                         .setattr(shard_cid(self.pg, s), name,
+                                  HINFO_KEY, hinfo.to_bytes()))
+                    self._store(s).queue_transaction(t)
+                self.object_sizes[name] = new_size
 
     # -- read path -----------------------------------------------------------
 
@@ -162,22 +345,22 @@ class ECBackend:
         want = list(range(self.k))
         need = sorted(self.coder.minimum_to_decode(want, avail))
         out: dict[str, np.ndarray] = {}
-        # batched like recovery: stack equal-chunk-length groups and
+        # batched like recovery: stack equal-shard-length groups and
         # decode each group in ONE launch
         by_len: dict[int, list[str]] = {}
         for name in names:
             if self.object_sizes[name] == 0:
                 out[name] = np.zeros(0, dtype=np.uint8)
                 continue
-            by_len.setdefault(self._chunk_len(self.object_sizes[name]),
+            by_len.setdefault(self._shard_len(self.object_sizes[name]),
                               []).append(name)
-        for cl, group in by_len.items():
+        for sl, group in by_len.items():
             stacks = {s: np.stack([self._store(s).read(shard_cid(self.pg, s),
                                                        n) for n in group])
                       for s in need}
             rec = self.coder.decode(want, stacks)
             shards = np.stack([rec[i] for i in range(self.k)], axis=1)
-            objs = StripeInfo(self.k, cl).shards_to_object(shards)  # (B, k*cl)
+            objs = self.sinfo.shards_to_object(shards)  # (B, k*sl)
             for bi, name in enumerate(group):
                 out[name] = objs[bi, :self.object_sizes[name]]
         return out
@@ -212,7 +395,7 @@ class ECBackend:
         for i in range(0, len(names), batch):
             group = names[i:i + batch]
             # batched gather: (B, |helper|, chunk) — stride the reads by
-            # equal chunk length groups
+            # equal shard length groups
             by_len: dict[int, list[str]] = {}
             for name in group:
                 if self.object_sizes[name] == 0:
@@ -226,9 +409,9 @@ class ECBackend:
                         self._store(s).queue_transaction(t)
                     counters["objects"] += 1
                     continue
-                cl = self._chunk_len(self.object_sizes[name])
-                by_len.setdefault(cl, []).append(name)
-            for cl, subgroup in by_len.items():
+                sl = self._shard_len(self.object_sizes[name])
+                by_len.setdefault(sl, []).append(name)
+            for sl, subgroup in by_len.items():
                 stacks = {
                     s: np.stack([self._store(s).read(shard_cid(self.pg, s), n)
                                  for n in subgroup])
@@ -247,9 +430,9 @@ class ECBackend:
                                     != int(crcs[bi]):
                                 counters["hinfo_failures"] += 1
                                 bad_pairs.setdefault(name, set()).add(s)
-                rec = self.coder.decode_chunks(lost, stacks)  # {slot: (B, cl)}
+                rec = self.coder.decode_chunks(lost, stacks)  # {slot: (B, sl)}
                 rebuilt_all = np.stack([np.asarray(rec[s]) for s in lost],
-                                       axis=1)  # (B, |lost|, cl)
+                                       axis=1)  # (B, |lost|, sl)
                 for name, bad in bad_pairs.items():
                     bi = subgroup.index(name)
                     alt = [s for s in survivors if s not in bad]
@@ -261,15 +444,15 @@ class ECBackend:
                     for li, s in enumerate(lost):
                         rebuilt_all[bi, li] = np.asarray(alt_rec[s])
                 crcs = self._batched_hinfo_crcs(
-                    rebuilt_all.reshape(-1, cl)).reshape(len(subgroup),
+                    rebuilt_all.reshape(-1, sl)).reshape(len(subgroup),
                                                          len(lost))
                 for li, s in enumerate(lost):
                     for bi, name in enumerate(subgroup):
                         chunk = rebuilt_all[bi, li]
-                        hinfo = HashInfo(1, cl, [int(crcs[bi, li])])
+                        hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
                         t = (Transaction()
                              .write(shard_cid(self.pg, s), name, 0, chunk)
-                             .truncate(shard_cid(self.pg, s), name, cl)
+                             .truncate(shard_cid(self.pg, s), name, sl)
                              .setattr(shard_cid(self.pg, s), name,
                                       HINFO_KEY, hinfo.to_bytes()))
                         self._store(s).queue_transaction(t)

@@ -32,6 +32,14 @@ from ..csum.kernels import crc32c_extend
 from ..csum.reference import ceph_crc32c
 
 
+def as_flat_u8(data) -> np.ndarray:
+    """Coerce bytes/memoryview/array input to a flat uint8 array — the
+    one shared byte-coercion rule for every write path."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return np.frombuffer(bytes(data), dtype=np.uint8)
+    return np.asarray(data, np.uint8).ravel()
+
+
 @dataclass(frozen=True)
 class StripeInfo:
     """Geometry of one EC pool's stripes (ref: ECUtil::stripe_info_t)."""
