@@ -25,8 +25,6 @@ from .matrices import coding_matrix
 from .registry import register
 
 
-@register("tpu_rs")
-@register("jerasure")  # accept reference profile strings unchanged
 class ReedSolomon(ErasureCode):
     """MDS Reed-Solomon over GF(2^8), batched on TPU."""
 
@@ -34,12 +32,6 @@ class ReedSolomon(ErasureCode):
         self.k = int(profile.get("k", 7))
         self.m = int(profile.get("m", 3))
         technique = profile.get("technique", "reed_sol_van")
-        if technique in ("liberation", "blaum_roth", "liber8tion"):
-            # bit-matrix-scheduled RAID-6 variants; their exact parity
-            # bytes differ from the generic matrices, so refusing beats
-            # silently writing an incompatible stripe format.
-            raise ValueError(f"technique {technique!r} not implemented yet; "
-                             f"use reed_sol_van / reed_sol_r6_op / cauchy_*")
         self.technique = technique
         self.impl = profile.get("impl", DEFAULT_IMPL)
         from ..ops.rs_kernels import _IMPLS
@@ -80,6 +72,20 @@ class ReedSolomon(ErasureCode):
         if squeeze:
             rec = rec[0]
         return {e: rec[..., i, :] for i, e in enumerate(erasures)}
+
+
+@register("tpu_rs")
+@register("jerasure")  # accept reference profile strings unchanged
+def _jerasure_factory(profile: Mapping[str, str]) -> ErasureCode:
+    """The jerasure plugin face: matrix techniques go to ReedSolomon,
+    bitmatrix/schedule techniques (liberation, blaum_roth, liber8tion)
+    to the XOR-schedule coder (ref: ErasureCodeJerasure.cc technique
+    dispatch in ErasureCodePluginJerasure::factory)."""
+    from .bitmatrix import BITMATRIX_TECHNIQUES, JerasureBitmatrix
+    technique = dict(profile).get("technique", "reed_sol_van")
+    if technique in BITMATRIX_TECHNIQUES:
+        return JerasureBitmatrix(profile)
+    return ReedSolomon(profile)
 
 
 @register("isa")
