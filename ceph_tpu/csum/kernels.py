@@ -167,7 +167,9 @@ def crc32c_extend(regs, blocks) -> Array:
     out = _crc32c_extend_jit(bucket)(regs, blocks)
     if pad:
         # out = shift^pad(true): undo the zero-padding's linear shift
-        out = _unshift_host(np.asarray(out, np.uint32), pad)
+        # (host fixup, then back to a device array so the return type is
+        # a jax Array on every path)
+        out = jnp.asarray(_unshift_host(np.asarray(out, np.uint32), pad))
     return out
 
 

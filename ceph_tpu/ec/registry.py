@@ -43,6 +43,18 @@ def _ensure_loaded() -> None:
                 raise
 
 
+def get_factory(name: str):
+    """Public lookup of a registered plugin factory by name (the
+    ErasureCodePluginRegistry::load analog without instantiation).
+    Raises ValueError for unknown plugins."""
+    _ensure_loaded()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown EC plugin {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
 def factory(profile: Mapping[str, str] | str) -> ErasureCode:
     """Instantiate a coder from a profile (dict or profile string).
 

@@ -30,19 +30,41 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
+def _source_hash() -> str:
+    import hashlib
+    h = hashlib.sha256()
+    for name in ("ec_tpu.cpp", "Makefile"):
+        with open(os.path.join(_NATIVE_DIR, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
 def build(force: bool = False) -> str:
-    """Compile the library if missing/stale; returns the .so path."""
+    """Compile the library if missing/stale; returns the .so path.
+
+    Staleness is a content hash of the sources (mtimes are unreliable:
+    a fresh clone checks out source and any stray binary with identical
+    timestamps), so a changed ec_tpu.cpp always triggers a rebuild and
+    a foreign .so is never trusted.
+    """
     src = os.path.join(_NATIVE_DIR, "ec_tpu.cpp")
     if not os.path.exists(src):
         raise NativeUnavailable(f"missing {src}")
-    if (force or not os.path.exists(_SO)
-            or os.path.getmtime(_SO) < os.path.getmtime(src)):
+    stamp = os.path.join(_NATIVE_DIR, ".build_hash")
+    want = _source_hash()
+    have = None
+    if os.path.exists(stamp):
+        with open(stamp) as f:
+            have = f.read().strip()
+    if force or not os.path.exists(_SO) or have != want:
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR],
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
                            check=True, capture_output=True, text=True)
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             raise NativeUnavailable(f"build failed: {detail}") from None
+        with open(stamp, "w") as f:
+            f.write(want)
     return _SO
 
 

@@ -19,6 +19,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <array>
 #include <vector>
 
 namespace {
@@ -262,17 +263,17 @@ int ec_decode(void* h, const int* erasures, int n_erasures,
 // crc32c (Castagnoli), raw-register convention like ceph_crc32c:
 // chainable, seed in, no final inversion (ref: src/common/crc32c.h).
 uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, int64_t len) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
+  // magic static: C++11 guarantees thread-safe one-time init
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
     for (uint32_t i = 0; i < 256; ++i) {
       uint32_t r = i;
       for (int j = 0; j < 8; ++j)
         r = (r >> 1) ^ ((r & 1) ? 0x82F63B78u : 0);
-      table[i] = r;
+      t[i] = r;
     }
-    init = true;
-  }
+    return t;
+  }();
   uint32_t reg = seed;
   for (int64_t i = 0; i < len; ++i)
     reg = (reg >> 8) ^ table[(reg ^ data[i]) & 0xFF];

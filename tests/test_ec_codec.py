@@ -114,10 +114,13 @@ def test_reed_sol_r6_op():
         registry.factory("k=4 m=3 technique=reed_sol_r6_op")
 
 
-def test_unimplemented_techniques_refused():
-    for tech in ("liberation", "blaum_roth", "liber8tion"):
-        with pytest.raises(ValueError):
-            registry.factory(f"k=4 m=2 technique={tech}")
+def test_bitmatrix_techniques_dispatch():
+    # liberation/blaum_roth/liber8tion route to the XOR-schedule coder
+    # (full coverage in tests/test_bitmatrix.py)
+    from ceph_tpu.ec.bitmatrix import JerasureBitmatrix
+    for tech, w in (("liberation", 5), ("blaum_roth", 4), ("liber8tion", 8)):
+        coder = registry.factory(f"k=4 m=2 technique={tech} w={w}")
+        assert isinstance(coder, JerasureBitmatrix)
 
 
 def test_bad_impl_rejected_with_choices():

@@ -154,8 +154,10 @@ def main(argv=None) -> None:
     plugin_name = args.plugin or profile.get("plugin", "tpu_rs")
     from ceph_tpu.ec import registry
     from ceph_tpu.ec.rs import ReedSolomon
-    registry._ensure_loaded()
-    fac = registry._REGISTRY.get(plugin_name)
+    try:
+        fac = registry.get_factory(plugin_name)
+    except ValueError:
+        fac = None
     plain_rs = isinstance(fac, type) and issubclass(fac, ReedSolomon)
     if args.impl and args.impl != "auto":
         impls = [args.impl]
