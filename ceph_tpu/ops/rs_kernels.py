@@ -136,10 +136,16 @@ def apply_matrix_traced(matrix: Array, data: Array) -> Array:
     return jnp.bitwise_xor.reduce(prod, axis=-2)
 
 
+def _apply_pallas(matrix: np.ndarray, data: Array) -> Array:
+    from .pallas_gf import apply_matrix_pallas
+    return apply_matrix_pallas(matrix, data)
+
+
 _IMPLS = {
     "bitlinear": _apply_bitlinear,
     "mxu": _apply_mxu,
     "logexp": _apply_logexp_static,
+    "pallas": _apply_pallas,
 }
 
 DEFAULT_IMPL = "bitlinear"

@@ -14,7 +14,7 @@ from ceph_tpu.ec.matrices import reed_sol_van_matrix
 from ceph_tpu.gf import numpy_ref as R
 from ceph_tpu.ops import rs_kernels as K
 
-IMPLS = ["bitlinear", "mxu", "logexp"]
+IMPLS = ["bitlinear", "mxu", "logexp", "pallas"]
 
 
 def _rand(b, k, L, seed=0):
