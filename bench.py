@@ -1,13 +1,39 @@
 """Headline benchmark: RS k=8 m=3 encode GB/s on one TPU chip.
 
-The driver runs this on real TPU hardware; it prints exactly ONE JSON
-line. Config matches BASELINE.md row 2: RS k=8, m=3, 4 MiB stripe,
-batched encode over 1024 objects (processed in device-sized sub-batches).
-`vs_baseline` is measured GB/s divided by the 40 GB/s/chip north-star
-target from BASELINE.json (no published reference number exists — see
-BASELINE.md; >1.0 means the target is beaten).
+Prints exactly ONE JSON line on stdout (driver contract); details land
+on stderr. Methodology per docs/BENCH_METHODOLOGY.md — every guard
+exists because round 1's naive loop reported a physically impossible
+number (20 TB/s) on the axon tunnel platform:
+
+* correctness gate: each timed kernel's full output for a small batch
+  is fetched and byte-compared against the pure-numpy GF oracle before
+  any timing; a wrong kernel aborts the bench.
+* distinct inputs: a 4-batch pool of device-generated random data
+  (`jax.random.bits`, no tunnel staging) is rotated every iteration.
+* elision-proof sync: the whole timed loop is ONE jitted `lax.scan`
+  whose carry XOR-folds a digest of every output; the clock stops when
+  the scalar digest reaches the host, so the result data-depends on
+  every encode and nothing can be dead-code-eliminated.
+* slope timing: the pipeline runs at n1 and n2 iterations (both warmed,
+  best of 3); throughput = bytes*(n2-n1)/(t2-t1), which cancels the
+  constant dispatch+fetch latency of the tunnel (~70 ms RTT) without
+  subtracting an unmeasured constant. Raw totals are printed so a
+  skeptic can recompute.
+* bytes accounting: the headline is INPUT bytes/s (k data shards), the
+  convention of the reference's ceph_erasure_code_benchmark (object
+  bytes / seconds; ref: src/test/erasure-code/
+  ceph_erasure_code_benchmark.cc ErasureCodeBench::encode); touched
+  bytes (k+m) are also reported.
+
+The JSON line's `extra` dict carries the full metric set VERDICT r01
+asked for: decode GB/s, every-impl encode table, CPU-native baseline,
+CRUSH placement throughput, and recovery objects/s.
+
+`vs_baseline` divides by the 40 GB/s/chip north-star target from
+BASELINE.json (no published reference number exists — BASELINE.md).
 """
 
+import functools
 import json
 import os
 import sys
@@ -16,54 +42,285 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 TARGET_GBPS = 40.0
-OBJECTS = 1024
-OBJECT_SIZE = 4 * 1024 * 1024  # 4 MiB stripe
 K, M = 8, 3
+OBJECT_SIZE = 4 * 1024 * 1024          # 4 MiB object
+CHUNK = OBJECT_SIZE // K               # 512 KiB chunk
+SUB = int(os.environ.get("BENCH_SUBBATCH", "32"))   # objects per iteration
+POOL = 4                               # rotated input batches
+N1, N2 = 4, 20
+REPS = 3
+
+
+def log(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def _pipeline(enc_fn, pool_arr):
+    """One-jit scan: iteration i encodes pool[i%POOL]; carry is a u8
+    XOR digest over every output byte (keeps all encodes live)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def pipe(pool, n):
+        def body(acc, i):
+            x = jax.lax.dynamic_index_in_dim(pool, i % POOL, keepdims=False)
+            out = enc_fn(x)
+            d = jnp.bitwise_xor.reduce(
+                jnp.bitwise_xor.reduce(out, axis=(0, 1)))
+            return acc ^ d, None
+        acc, _ = jax.lax.scan(body, jnp.uint8(0),
+                              jnp.arange(n, dtype=jnp.int32))
+        return acc
+    return lambda n: int(jax.device_get(pipe(pool_arr, n)))
+
+
+def _slope(run, bytes_per_iter):
+    """Time run(N1) and run(N2) (warmed, best of REPS); return
+    (GB/s, t1, t2). If jitter leaves no usable slope (t2 <= t1), fall
+    back to the latency-inclusive rate bytes*N2/t2 — a strict lower
+    bound on real throughput — rather than publishing a negative or
+    inflated number."""
+    for n in (N1, N2):
+        run(n)  # compile + warm both program sizes
+    t1 = min(_timed(run, N1) for _ in range(REPS))
+    t2 = min(_timed(run, N2) for _ in range(REPS))
+    if t2 > t1 * 1.02:
+        gbps = bytes_per_iter * (N2 - N1) / (t2 - t1) / 1e9
+    else:
+        gbps = bytes_per_iter * N2 / t2 / 1e9
+        log(f"slope unusable (t1={t1:.3f}s t2={t2:.3f}s); reporting "
+            f"latency-inclusive lower bound")
+    return gbps, t1, t2
+
+
+def _timed(run, n):
+    t0 = time.perf_counter()
+    run(n)
+    return time.perf_counter() - t0
+
+
+def bench_encode_impls(impls):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ceph_tpu.ec.matrices import reed_sol_van_matrix
+    from ceph_tpu.gf.numpy_ref import encode_ref
+    from ceph_tpu.ops.rs_kernels import make_encoder
+
+    matrix = reed_sol_van_matrix(K, M)
+
+    # correctness gate (small batch, full fetch, oracle compare)
+    rng = np.random.default_rng(11)
+    small = rng.integers(0, 256, size=(2, K, 8192), dtype=np.uint8)
+    want = np.stack([encode_ref(matrix, small[b]) for b in range(2)])
+
+    pool = jax.jit(
+        lambda key: jax.random.bits(key, (POOL, SUB, K, CHUNK), jnp.uint8)
+    )(jax.random.key(7))
+    pool.block_until_ready()
+    bytes_per_iter = SUB * K * CHUNK
+
+    results = {}
+    for impl in impls:
+        try:
+            fn = make_encoder(matrix, impl)
+            got = np.asarray(fn(small))
+            if not (got == want).all():
+                raise AssertionError(f"impl {impl} output != oracle")
+            run = _pipeline(fn, pool)
+            gbps, t1, t2 = _slope(run, bytes_per_iter)
+            results[impl] = gbps
+            log(f"encode {impl}: t({N1})={t1:.3f}s t({N2})={t2:.3f}s "
+                f"slope {gbps:.2f} GB/s in "
+                f"({bytes_per_iter * (N2 - N1) / 1e9:.2f} GB marginal, "
+                f"touched x{(K + M) / K:.3f})")
+        except AssertionError:
+            raise  # wrong bytes must kill the bench, not be skipped
+        except Exception as e:
+            log(f"encode impl {impl} failed: {e!r}")
+    return results
+
+
+def bench_decode():
+    """Degraded-read decode: rebuild 2 erased shards from k survivors
+    (erasures {0, 9}), static decode matrix — the ErasureCodeBench
+    --workload decode analog."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ceph_tpu.ec.matrices import reed_sol_van_matrix
+    from ceph_tpu.gf.numpy_ref import decode_matrix, encode_ref
+    from ceph_tpu.ops.rs_kernels import make_encoder
+
+    matrix = reed_sol_van_matrix(K, M)
+    erasures = [0, K + 1]
+    survivors = [i for i in range(K + M) if i not in erasures][:K]
+    D = decode_matrix(matrix, erasures, K, survivors)
+
+    # gate: decode oracle-encoded survivors, compare rebuilt shards
+    rng = np.random.default_rng(12)
+    small = rng.integers(0, 256, size=(2, K, 8192), dtype=np.uint8)
+    fn = make_encoder(D, "mxu")
+    full = [np.concatenate([small[b], encode_ref(matrix, small[b])], axis=0)
+            for b in range(2)]
+    surv = np.stack([f[survivors] for f in full])
+    want = np.stack([f[erasures] for f in full])
+    got = np.asarray(fn(surv))
+    if not (got == want).all():
+        raise AssertionError("decode output != oracle")
+
+    pool = jax.jit(
+        lambda key: jax.random.bits(key, (POOL, SUB, K, CHUNK), jnp.uint8)
+    )(jax.random.key(8))
+    pool.block_until_ready()
+    run = _pipeline(fn, pool)
+    bytes_per_iter = SUB * K * CHUNK  # k survivor chunks read per object
+    gbps, t1, t2 = _slope(run, bytes_per_iter)
+    log(f"decode mxu (2 erasures): t({N1})={t1:.3f}s t({N2})={t2:.3f}s "
+        f"slope {gbps:.2f} GB/s in")
+    return gbps
+
+
+def bench_cpu_native():
+    """CPU baseline via the native codec (BASELINE.md rows 1-2)."""
+    import numpy as np
+    out = {}
+    try:
+        import ceph_tpu.native  # noqa: F401 — registers the plugin
+        from ceph_tpu.ec.registry import factory
+        for kk, mm, size, label in ((4, 2, 1 << 20, "k4m2_1MiB"),
+                                    (K, M, OBJECT_SIZE, "k8m3_4MiB")):
+            coder = factory(f"plugin=native k={kk} m={mm}")
+            rng = np.random.default_rng(5)
+            batch = max(1, (64 << 20) // size)
+            data = rng.integers(0, 256, (batch, kk, size // kk), np.uint8)
+            coder.encode_chunks(data)  # warm table init
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                coder.encode_chunks(data)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            gbps = batch * size / best / 1e9
+            out[label] = round(gbps, 3)
+            log(f"cpu native encode {label}: {gbps:.2f} GB/s/core")
+    except Exception as e:
+        log(f"cpu native baseline failed: {e!r}")
+    return out
+
+
+def bench_crush(n_objects=int(os.environ.get("BENCH_CRUSH_OBJECTS",
+                                             "1000000")),
+                n_osds=10_000):
+    """BASELINE config #5 geometry: place n_objects PGs on an
+    n_osds-OSD CRUSH map (EC rule, indep), vectorized mapper. The full
+    10M run is config #5 verbatim; the default 1M keeps the driver
+    bench under budget and the rate extrapolates linearly (per-lane
+    cost is batch-independent — measured)."""
+    import numpy as np
+
+    from ceph_tpu.crush.map import build_hierarchy, ec_rule
+    from ceph_tpu.crush.mapper import VectorMapper, full_weights
+
+    try:
+        m = build_hierarchy(n_osds, osds_per_host=10, hosts_per_rack=25)
+        ec_rule(m, rule_id=1, choose_type=1)
+        vm = VectorMapper(m)
+        weights = full_weights(n_osds)
+        sub = 1_000_000
+        xs0 = np.arange(sub, dtype=np.uint32)
+        np.asarray(vm.do_rule(1, xs0, weights, K + M))  # compile + warm
+        t0 = time.perf_counter()
+        done = 0
+        # full sub-batches only (variable tails would recompile); the
+        # rate divides by the count actually placed
+        while done < n_objects:
+            xs = np.arange(done, done + sub, dtype=np.uint32)
+            res = vm.do_rule(1, xs, weights, K + M)
+            done += sub
+        np.asarray(res)  # sync on the last batch
+        dt = time.perf_counter() - t0
+        rate = done / dt
+        log(f"crush: {done} placements x{K + M} on {n_osds} OSDs "
+            f"in {dt:.2f}s = {rate / 1e6:.2f} M placements/s")
+        return rate
+    except Exception as e:
+        log(f"crush bench failed: {e!r}")
+        return None
+
+
+def bench_recovery(objects=128, size=1 << 20, lost=1):
+    """PG recovery objects/s through the mini-ECBackend (metric #2)."""
+    import numpy as np
+    try:
+        from ceph_tpu.ec.interface import profile_from_string
+        from ceph_tpu.osd.ecbackend import ECBackend, ShardSet
+
+        profile = profile_from_string(f"k={K} m={M}")
+        cluster = ShardSet()
+        be = ECBackend(profile, "1.0", list(range(K + M)), cluster)
+        rng = np.random.default_rng(0)
+        objs = {f"obj{i:06d}": rng.integers(0, 256, size, np.uint8)
+                for i in range(objects)}
+        be.write_objects(objs)
+        dead = list(range(lost))
+        for s in dead:
+            cluster.stores.pop(be.acting[s], None)
+        repl = {s: 1000 + s for s in dead}
+        t0 = time.perf_counter()
+        counters = be.recover_shards(dead, replacement_osds=repl)
+        dt = time.perf_counter() - t0
+        rate = objects / dt
+        log(f"recovery: {counters['bytes'] >> 20} MiB rebuilt over "
+            f"{objects} x {size >> 20} MiB objects in {dt:.2f}s = "
+            f"{rate:.1f} objects/s")
+        return rate
+    except Exception as e:
+        log(f"recovery bench failed: {e!r}")
+        return None
 
 
 def main() -> None:
     import jax
-    import numpy as np
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
-    from ceph_tpu.ec.matrices import reed_sol_van_matrix
-    from ceph_tpu.ops.rs_kernels import make_encoder
+    impls = os.environ.get("BENCH_IMPLS", "mxu,bitlinear,pallas").split(",")
+    enc = bench_encode_impls([i for i in impls if i])
+    if not enc:
+        raise SystemExit("all encode impls failed")
+    extra = {"encode_gbps_by_impl": {k: round(v, 3) for k, v in enc.items()}}
 
-    matrix = reed_sol_van_matrix(K, M)
-    chunk = OBJECT_SIZE // K  # 512 KiB, already 128-aligned
-
-    # Sub-batch sized to keep data + parity + headroom well inside 16 GB
-    # HBM; loop covers all 1024 objects per timed iteration.
-    sub = min(int(os.environ.get("BENCH_SUBBATCH", "128")), OBJECTS)
-    iters = max(1, OBJECTS // sub)
-    objects_done = sub * iters
-    rng = np.random.default_rng(0)
-    host = rng.integers(0, 256, size=(sub, K, chunk), dtype=np.uint8)
-    data = jax.device_put(host)
-
-    results = {}
-    impls = os.environ.get("BENCH_IMPLS", "bitlinear,mxu").split(",")
-    for impl in impls:
+    skip = set(os.environ.get("BENCH_SKIP", "").split(","))
+    if "decode" not in skip:
         try:
-            fn = make_encoder(matrix, impl)
-            fn(data).block_until_ready()  # compile + warmup
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(data)
-            out.block_until_ready()
-            dt = time.perf_counter() - t0
-            results[impl] = sub * K * chunk * iters / dt / 1e9
-        except Exception as e:  # one impl failing shouldn't kill the bench
-            print(f"bench: impl {impl} failed: {e!r}", file=sys.stderr)
-    if not results:
-        raise SystemExit("all bench impls failed")
-    impl = max(results, key=results.get)
-    gbps = results[impl]
-    print(f"bench: {results} backend={jax.default_backend()}", file=sys.stderr)
+            extra["decode_gbps"] = round(bench_decode(), 3)
+        except Exception as e:
+            log(f"decode bench failed: {e!r}")
+    if "cpu" not in skip:
+        extra["cpu_native_encode_gbps"] = bench_cpu_native()
+    if "crush" not in skip:
+        r = bench_crush()
+        if r:
+            extra["crush_placements_per_s"] = round(r)
+    if "recovery" not in skip:
+        r = bench_recovery()
+        if r:
+            extra["recovery_objects_per_s"] = round(r, 1)
+
+    impl = max(enc, key=enc.get)
+    gbps = enc[impl]
+    extra["best_impl"] = impl
+    extra["methodology"] = "slope-timed scan pipeline, digest-synced, " \
+        "oracle-gated (docs/BENCH_METHODOLOGY.md)"
     print(json.dumps({
-        "metric": f"rs_k{K}m{M}_encode_4MiB_x{objects_done}",
+        "metric": f"rs_k{K}m{M}_encode_4MiB_input",
         "value": round(gbps, 3),
         "unit": "GB/s/chip",
         "vs_baseline": round(gbps / TARGET_GBPS, 4),
+        "extra": extra,
     }))
 
 
