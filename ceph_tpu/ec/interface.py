@@ -79,6 +79,19 @@ class ErasureCode(abc.ABC):
         padded = -(-stripe_width // align) * align
         return padded // self.k
 
+    # -- device fast path --------------------------------------------------
+
+    def batch_decoder(self, erasures: Sequence[int],
+                      survivors: Sequence[int]):
+        """Optional device fast path: a jitted fn mapping a survivor
+        stack (B, k, L) uint8 (rows in `survivors` order) to the
+        rebuilt chunks (B, len(erasures), L) in `erasures` order,
+        suitable for fusing into larger jitted pipelines (recovery
+        CRC+decode+CRC in one launch). Only the first k survivors are
+        consumed. Returns None when the codec has no static-matrix form
+        for this pattern; callers must then use decode_chunks."""
+        return None
+
     # -- availability ------------------------------------------------------
 
     def minimum_to_decode(self, want_to_read: Sequence[int],

@@ -56,6 +56,18 @@ class ReedSolomon(ErasureCode):
             self._decode_cache[key] = hit
         return hit
 
+    def batch_decoder(self, erasures: Sequence[int],
+                      survivors: Sequence[int]):
+        # orders are honored as given (the interface contract: stack
+        # rows arrive in `survivors` order, outputs in `erasures`
+        # order); only the first k survivors are consumed
+        erasures = tuple(erasures)
+        survivors = tuple(survivors)[:self.k]
+        if len(survivors) < self.k:
+            return None
+        fn, _ = self._decoder_for(erasures, survivors)
+        return fn
+
     def decode_chunks(self, want_to_read: Sequence[int],
                       chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
         erasures = tuple(sorted(want_to_read))

@@ -367,17 +367,120 @@ class ECBackend:
 
     # -- recovery (the objects/s metric) -------------------------------------
 
+    def _fused_recover_fn(self, dec_fn, sl: int, verify: bool):
+        """ONE device launch per recovery batch: helper-CRC verify +
+        decode + rebuilt-CRC, all device-resident between stages (the
+        r01 path dispatched ~k+2 launches with host round-trips between
+        them — SURVEY §2.7 P5). Cached per (decoder, shard length,
+        verify); with verify off the helper CRCs are never computed."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (id(dec_fn), sl, verify)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            from ..csum.kernels import crc32c_blocks
+
+            def fused(stack, exp):        # (B, H, sl) u8, (B, H) u32
+                B, H, _ = stack.shape
+                rebuilt = dec_fn(stack)   # (B, E, sl)
+                E = rebuilt.shape[1]
+                rcrc = crc32c_blocks(rebuilt.reshape(B * E, sl),
+                                     init=0xFFFFFFFF,
+                                     xorout=0).reshape(B, E)
+                if verify:
+                    hcrc = crc32c_blocks(stack.reshape(B * H, sl),
+                                         init=0xFFFFFFFF,
+                                         xorout=0).reshape(B, H)
+                    ok = hcrc == exp
+                else:
+                    ok = jnp.ones((B, H), dtype=bool)
+                return rebuilt, rcrc, ok
+            fn = jax.jit(fused)
+            self._fused_cache[key] = fn
+        return fn
+
+    def _gather_helper_stack(self, helper: list[int], subgroup: list[str],
+                             sl: int,
+                             want_hinfo: bool) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side staging: helper chunks (B, H, sl) + their expected
+        hinfo CRCs (B, H) (zeros when hinfo isn't wanted — the xattr
+        may legitimately be absent then)."""
+        B, H = len(subgroup), len(helper)
+        stack = np.empty((B, H, sl), dtype=np.uint8)
+        exp = np.zeros((B, H), dtype=np.uint32)
+        for hi, s in enumerate(helper):
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            for bi, name in enumerate(subgroup):
+                stack[bi, hi] = st.read(cid, name)
+                if want_hinfo:
+                    hb = st.getattr(cid, name, HINFO_KEY)
+                    exp[bi, hi] = HashInfo.from_bytes(hb).get_chunk_hash(0)
+        return stack, exp
+
+    def _recover_fallback(self, lost: list[int], survivors: list[int],
+                          bad_pairs: dict[str, set[int]],
+                          subgroup: list[str], rebuilt_all: np.ndarray,
+                          counters: dict) -> None:
+        """Re-decode objects whose helper reads failed hinfo, batched by
+        identical bad-shard set (one decode launch per distinct set
+        instead of the r01 per-object loop)."""
+        by_bad: dict[tuple[int, ...], list[str]] = {}
+        for name, bad in bad_pairs.items():
+            by_bad.setdefault(tuple(sorted(bad)), []).append(name)
+        for bad, names_ in by_bad.items():
+            alt = [s for s in survivors if s not in bad]
+            alt_need = sorted(self.coder.minimum_to_decode(lost, alt))
+            stacks = {s: np.stack([self._store(s).read(
+                shard_cid(self.pg, s), n) for n in names_])
+                for s in alt_need}
+            alt_rec = self.coder.decode_chunks(lost, stacks)
+            for li, s in enumerate(lost):
+                rec_s = np.asarray(alt_rec[s])
+                for ni, name in enumerate(names_):
+                    rebuilt_all[subgroup.index(name), li] = rec_s[ni]
+
+    def _writeback_rebuilt(self, lost: list[int], subgroup: list[str],
+                           rebuilt_all: np.ndarray, crcs: np.ndarray,
+                           sl: int, counters: dict) -> None:
+        for li, s in enumerate(lost):
+            cid = shard_cid(self.pg, s)
+            store = self._store(s)
+            for bi, name in enumerate(subgroup):
+                chunk = rebuilt_all[bi, li]
+                hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
+                t = (Transaction()
+                     .write(cid, name, 0, chunk)
+                     .truncate(cid, name, sl)
+                     .setattr(cid, name, HINFO_KEY, hinfo.to_bytes()))
+                store.queue_transaction(t)
+                counters["bytes"] += int(chunk.size)
+        counters["objects"] += len(subgroup)
+
     def recover_shards(self, lost_shards: list[int],
                        replacement_osds: dict[int, int] | None = None,
                        batch: int = 128,
                        verify_hinfo: bool = True) -> dict:
         """Rebuild every object's lost shard(s): the RecoveryOp loop,
-        batched. Returns counters {objects, bytes, hinfo_failures}.
+        batched AND pipelined. Returns counters {objects, bytes,
+        hinfo_failures}.
+
+        Dataflow (ref: ECBackend::continue_recovery_op streaming, P5):
+        for codecs with a static decode matrix (batch_decoder), each
+        sub-batch is ONE fused device launch (helper-CRC + decode +
+        rebuilt-CRC); launches are enqueued asynchronously and results
+        fetched one batch behind, so host staging of batch i+1 overlaps
+        device compute of batch i (double buffering). Codecs without a
+        static matrix (clay/lrc local plans) take the generic
+        decode_chunks path, still batched per launch.
 
         lost_shards: shard slots whose OSD died.
         replacement_osds: slot -> new OSD id (defaults to reusing the
         slot's OSD id, i.e. re-created store after replacement).
         """
+        import jax
+
         lost = sorted(set(lost_shards))
         if len(lost) > self.m:
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
@@ -392,72 +495,97 @@ class ECBackend:
         helper = sorted(self.coder.minimum_to_decode(lost, survivors))
         names = sorted(self.object_sizes)
         counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
-        for i in range(0, len(names), batch):
-            group = names[i:i + batch]
-            # batched gather: (B, |helper|, chunk) — stride the reads by
-            # equal shard length groups
-            by_len: dict[int, list[str]] = {}
-            for name in group:
-                if self.object_sizes[name] == 0:
-                    # nothing to decode: re-create the empty shard
-                    hinfo = HashInfo(1, 0, [0xFFFFFFFF])
-                    for s in lost:
-                        t = (Transaction()
-                             .write(shard_cid(self.pg, s), name, 0, b"")
-                             .setattr(shard_cid(self.pg, s), name,
-                                      HINFO_KEY, hinfo.to_bytes()))
-                        self._store(s).queue_transaction(t)
-                    counters["objects"] += 1
-                    continue
-                sl = self._shard_len(self.object_sizes[name])
-                by_len.setdefault(sl, []).append(name)
-            for sl, subgroup in by_len.items():
-                stacks = {
-                    s: np.stack([self._store(s).read(shard_cid(self.pg, s), n)
-                                 for n in subgroup])
+        if not hasattr(self, "_fused_cache"):
+            self._fused_cache = {}
+
+        # split into (shard_len, subgroup) jobs of <= batch objects
+        by_len: dict[int, list[str]] = {}
+        for name in names:
+            if self.object_sizes[name] == 0:
+                hinfo = HashInfo(1, 0, [0xFFFFFFFF])
+                for s in lost:
+                    t = (Transaction()
+                         .write(shard_cid(self.pg, s), name, 0, b"")
+                         .setattr(shard_cid(self.pg, s), name,
+                                  HINFO_KEY, hinfo.to_bytes()))
+                    self._store(s).queue_transaction(t)
+                counters["objects"] += 1
+                continue
+            by_len.setdefault(self._shard_len(self.object_sizes[name]),
+                              []).append(name)
+        jobs = [(sl, group[i:i + batch])
+                for sl, group in by_len.items()
+                for i in range(0, len(group), batch)]
+
+        dec_fn = self.coder.batch_decoder(lost, helper)
+        pending: list[tuple] = []  # (sl, subgroup, exp, device handles)
+
+        def complete(entry) -> None:
+            sl, subgroup, exp, handles = entry
+            rebuilt_d, rcrc_d, ok_d = handles
+            rebuilt_all, crcs, ok = jax.device_get(
+                (rebuilt_d, rcrc_d, ok_d))
+            bad_pairs: dict[str, set[int]] = {}
+            if verify_hinfo and not ok.all():
+                for bi, hi in zip(*np.nonzero(~ok)):
+                    counters["hinfo_failures"] += 1
+                    bad_pairs.setdefault(subgroup[bi], set()).add(
+                        helper[hi])
+            if bad_pairs:
+                # device_get hands back read-only buffers; the fallback
+                # patches rebuilt rows in place
+                rebuilt_all = np.array(rebuilt_all)
+                self._recover_fallback(lost, survivors, bad_pairs,
+                                       subgroup, rebuilt_all, counters)
+                # CRCs of re-decoded chunks changed; recompute for those
+                idxs = sorted(subgroup.index(n) for n in bad_pairs)
+                fix = self._batched_hinfo_crcs(
+                    rebuilt_all[idxs].reshape(-1, sl)).reshape(
+                        len(idxs), len(lost))
+                crcs = np.array(crcs)
+                crcs[idxs] = fix
+            self._writeback_rebuilt(lost, subgroup, rebuilt_all, crcs,
+                                    sl, counters)
+
+        for sl, subgroup in jobs:
+            if dec_fn is None:
+                # generic path (clay/lrc): batched but not fused
+                stacks = {s: np.stack([self._store(s).read(
+                    shard_cid(self.pg, s), n) for n in subgroup])
                     for s in helper}
-                bad_pairs: dict[str, set[int]] = {}  # object -> bad shards
+                bad_pairs: dict[str, set[int]] = {}
                 if verify_hinfo:
-                    # reject corrupt helper reads BEFORE decoding from
-                    # them (the reference checks hinfo on every EC read);
-                    # affected objects re-decode from alternate helpers
                     for s in helper:
-                        crcs = self._batched_hinfo_crcs(stacks[s])
+                        crcs_s = self._batched_hinfo_crcs(stacks[s])
                         for bi, name in enumerate(subgroup):
                             hb = self._store(s).getattr(
                                 shard_cid(self.pg, s), name, HINFO_KEY)
                             if HashInfo.from_bytes(hb).get_chunk_hash(0) \
-                                    != int(crcs[bi]):
+                                    != int(crcs_s[bi]):
                                 counters["hinfo_failures"] += 1
                                 bad_pairs.setdefault(name, set()).add(s)
-                rec = self.coder.decode_chunks(lost, stacks)  # {slot: (B, sl)}
-                rebuilt_all = np.stack([np.asarray(rec[s]) for s in lost],
-                                       axis=1)  # (B, |lost|, sl)
-                for name, bad in bad_pairs.items():
-                    bi = subgroup.index(name)
-                    alt = [s for s in survivors if s not in bad]
-                    alt_need = sorted(self.coder.minimum_to_decode(lost, alt))
-                    chunks = {s: self._store(s).read(shard_cid(self.pg, s),
-                                                     name)
-                              for s in alt_need}
-                    alt_rec = self.coder.decode_chunks(lost, chunks)
-                    for li, s in enumerate(lost):
-                        rebuilt_all[bi, li] = np.asarray(alt_rec[s])
+                rec = self.coder.decode_chunks(lost, stacks)
+                rebuilt_all = np.stack(
+                    [np.asarray(rec[s]) for s in lost], axis=1)
+                if bad_pairs:
+                    self._recover_fallback(lost, survivors, bad_pairs,
+                                           subgroup, rebuilt_all, counters)
                 crcs = self._batched_hinfo_crcs(
                     rebuilt_all.reshape(-1, sl)).reshape(len(subgroup),
                                                          len(lost))
-                for li, s in enumerate(lost):
-                    for bi, name in enumerate(subgroup):
-                        chunk = rebuilt_all[bi, li]
-                        hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
-                        t = (Transaction()
-                             .write(shard_cid(self.pg, s), name, 0, chunk)
-                             .truncate(shard_cid(self.pg, s), name, sl)
-                             .setattr(shard_cid(self.pg, s), name,
-                                      HINFO_KEY, hinfo.to_bytes()))
-                        self._store(s).queue_transaction(t)
-                        counters["bytes"] += int(chunk.size)
-                counters["objects"] += len(subgroup)
+                self._writeback_rebuilt(lost, subgroup, rebuilt_all,
+                                        crcs, sl, counters)
+                continue
+            # fused path: stage, launch async, fetch one batch behind
+            stack, exp = self._gather_helper_stack(helper, subgroup, sl,
+                                                   verify_hinfo)
+            handles = self._fused_recover_fn(dec_fn, sl,
+                                             verify_hinfo)(stack, exp)
+            pending.append((sl, subgroup, exp, handles))
+            if len(pending) >= 2:
+                complete(pending.pop(0))
+        while pending:
+            complete(pending.pop(0))
         return counters
 
     # -- deep scrub ----------------------------------------------------------
