@@ -70,6 +70,10 @@ class SimCluster:
         self.down_since: dict[int, float] = {}
         self.perf = (PerfCountersBuilder("cluster")
                      .add_u64_counter("recovered_objects")
+                     .add_u64_counter("log_replayed_objects")
+                     .add_u64_counter("backfilled_objects")
+                     .add_u64_counter("revive_full_rebuilds")
+                     .add_u64_counter("deferred_replays")
                      .add_u64_counter("osd_marked_down")
                      .add_u64_counter("osd_marked_out")
                      .add_u64("degraded_pgs")
@@ -96,11 +100,15 @@ class SimCluster:
     # -- client I/O ---------------------------------------------------------
 
     def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
+        # dead processes get no sub-writes; their shards fall behind in
+        # the PG log and catch up on revive (ref: a down OSD misses
+        # MOSDECSubOpWrite fan-out; PGLog records the gap)
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
         by_pg: dict[int, dict] = {}
         for name, data in objects.items():
             by_pg.setdefault(self.locate(name), {})[name] = data
         for ps, group in by_pg.items():
-            self.pgs[ps].write_objects(group)
+            self.pgs[ps].write_objects(group, dead_osds=dead)
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
@@ -120,15 +128,71 @@ class SimCluster:
         self.cluster.stores.pop(osd, None)
 
     def revive_osd(self, osd: int) -> None:
-        if osd in self.down_since:
-            return  # must be handled by recovery once marked down
+        """Process restart with its store intact: the OSD rejoins and
+        every PG catches its shard up via PG-log delta replay (ref:
+        PeeringState GetLog/GetMissing -> log-based recovery), falling
+        back to a full shard rebuild only when the log was trimmed past
+        the shard's applied cursor (the backfill case). A destroyed
+        store cannot rejoin — recovery re-places its data instead."""
         if osd not in self.cluster.stores:
             raise ValueError(
                 f"osd.{osd} was destroyed (no store); it cannot rejoin "
                 f"with its old identity — let recovery re-place its data")
         self.alive[osd] = True
         self.last_heard[:, osd] = self.now
+        if not self.osdmap.osd_up[osd]:
+            self.osdmap.mark_up(osd)
+        self.down_since.pop(osd, None)
         g_log.dout("osd", 1, f"osd.{osd} revived at t={self.now}")
+        # every shard left behind (this OSD's, and any whose earlier
+        # replay was deferred for lack of live peers) tries to catch up
+        # now; reads stay safe meanwhile because ECBackend never serves
+        # an object from a shard whose cursor predates its last write
+        self._catch_up_all()
+
+    def _catch_up_all(self) -> None:
+        """Replay the PG-log delta into every behind shard whose OSD is
+        alive (ref: PeeringState GetMissing -> log-based recovery).
+        Shards whose PGs lack enough caught-up live peers stay deferred
+        (the reference's down/incomplete PG state) and retry on the next
+        revive."""
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        for ps in range(self.pg_num):
+            be = self.pgs[ps]
+            for slot, o in enumerate(be.acting):
+                if o in dead or be.shard_applied[slot] >= be.pg_log.head:
+                    continue
+                missed = be.pg_log.missing_since(be.shard_applied[slot])
+                backfill = missed is None
+                if backfill:
+                    # log trimmed past the cursor: full rebuild
+                    missed = sorted(be.object_sizes)
+                if not missed:
+                    be.shard_applied[slot] = be.pg_log.head
+                    continue
+                exclude = {s for s, oo in enumerate(be.acting)
+                           if s != slot and oo in dead}
+                try:
+                    counters = be.recover_shards(
+                        [slot], replacement_osds={slot: o}, names=missed,
+                        helper_exclude=exclude)
+                except ValueError as e:
+                    g_log.dout("recovery", 0,
+                               f"pg 1.{ps}: osd.{o} catch-up deferred "
+                               f"({e})")
+                    self.perf.inc("deferred_replays")
+                    continue
+                if backfill:
+                    self.perf.inc("revive_full_rebuilds")
+                    self.perf.inc("backfilled_objects",
+                                  counters["objects"])
+                else:
+                    self.perf.inc("log_replayed_objects",
+                                  counters["objects"])
+                g_log.dout("recovery", 1,
+                           f"pg 1.{ps}: osd.{o} "
+                           f"{'backfilled' if backfill else 'replayed'} "
+                           f"{counters['objects']} objects")
 
     def tick(self, dt: float = 1.0) -> None:
         """Advance virtual time; deliver heartbeats; run the
@@ -215,10 +279,18 @@ class SimCluster:
                                   src.getattr(cid, name, "hinfo_key")))
                     dst.queue_transaction(t)
                 be.acting[slot] = new
+                be.shard_applied[slot] = be.pg_log.head
             if lost:
                 slots = [s for s, _ in lost]
                 repl = {s: n for s, n in lost}
-                counters = be.recover_shards(slots, replacement_osds=repl)
+                # never read helper chunks from shards whose OSD is
+                # still dead (their stores are stale or gone)
+                exclude = {s for s, o in enumerate(be.acting)
+                           if s not in slots and
+                           (not self.alive[o] or
+                            o not in self.cluster.stores)}
+                counters = be.recover_shards(slots, replacement_osds=repl,
+                                             helper_exclude=exclude)
                 self.perf.inc("recovered_objects", counters["objects"])
                 g_log.dout("recovery", 1,
                            f"pg 1.{ps}: rebuilt {counters['objects']} "

@@ -92,7 +92,15 @@ class ECBackend:
         for shard, osd in enumerate(self.acting):
             t = Transaction().create_collection(shard_cid(pg, shard))
             self.cluster.osd(osd).queue_transaction(t)
-        self.object_sizes: dict[str, int] = {}  # the PG log's size info
+        self.object_sizes: dict[str, int] = {}  # authoritative size info
+        # mutation log + per-shard applied cursor (ref: PGLog /
+        # peering's last_update per shard): a shard that missed writes
+        # replays just the delta on rejoin (see recover_shards(names=))
+        from .pglog import PGLog
+        self.pg_log = PGLog()
+        self.shard_applied = [0] * self.n
+        self.object_versions: dict[str, int] = {}  # name -> last version
+        self._fused_cache: dict = {}
 
     # -- helpers ------------------------------------------------------------
 
@@ -109,23 +117,61 @@ class ECBackend:
         from ..csum.kernels import crc32c_blocks
         return np.asarray(crc32c_blocks(chunks, init=0xFFFFFFFF, xorout=0))
 
-    def _write_empty(self, name: str) -> None:
+    def _live_slots(self, dead_osds: set[int] | None) -> list[int]:
+        dead = dead_osds or set()
+        return [s for s in range(self.n) if self.acting[s] not in dead]
+
+    def _log_write(self, name: str, live: list[int]) -> None:
+        """Append to the PG log and advance the applied cursor of every
+        shard that received this write (down shards stay behind and
+        replay the delta on rejoin)."""
+        v = self.pg_log.append(name)
+        self.object_versions[name] = v
+        for s in live:
+            self.shard_applied[s] = v
+
+    def _fresh_for(self, names: list[str], shards: list[int]) -> list[int]:
+        """Shards (from `shards`) whose applied cursor covers the last
+        write of every object in `names` — a shard that was down across
+        a write holds STALE bytes for it and must not serve reads or
+        helper gathers until it replays (ref: peering's missing-set:
+        an OSD behind the authoritative log can't serve those objects)."""
+        need = max((self.object_versions.get(n, 0) for n in names),
+                   default=0)
+        return [s for s in shards if self.shard_applied[s] >= need]
+
+    def _check_min_size(self, live: list[int]) -> None:
+        """Writes need >= k receiving shards or the object could be
+        stored unrecoverably (the pool min_size gate: the reference
+        marks the PG inactive and blocks I/O below min_size)."""
+        if len(live) < self.k:
+            raise ValueError(
+                f"PG below min_size: {len(live)} live shards < k={self.k}; "
+                f"write refused (pg inactive)")
+
+    def _write_empty(self, name: str, live: list[int] | None = None) -> None:
         hinfo = HashInfo(1, 0, [0xFFFFFFFF])
         self.object_sizes[name] = 0
-        for shard in range(self.n):
+        live = live if live is not None else list(range(self.n))
+        for shard in live:
             t = (Transaction()
                  .write(shard_cid(self.pg, shard), name, 0, b"")
                  .truncate(shard_cid(self.pg, shard), name, 0)
                  .setattr(shard_cid(self.pg, shard), name,
                           HINFO_KEY, hinfo.to_bytes()))
             self._store(shard).queue_transaction(t)
+        self._log_write(name, live)
 
     # -- write path (submit_transaction, full-object) ------------------------
 
-    def write_objects(self, objects: dict[str, bytes | np.ndarray]) -> None:
+    def write_objects(self, objects: dict[str, bytes | np.ndarray],
+                      dead_osds: set[int] | None = None) -> None:
         """Full-object writes, batched: encode every equal-length group
         in one device launch, then scatter per-shard store transactions
-        (the role of ECTransaction::generate_transactions)."""
+        (the role of ECTransaction::generate_transactions). Shards on
+        dead OSDs are skipped and fall behind in the PG log."""
+        live = self._live_slots(dead_osds)
+        self._check_min_size(live)
         by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
         for name, data in objects.items():
             arr = as_flat_u8(data)
@@ -133,7 +179,7 @@ class ECBackend:
         for olen, group in by_len.items():
             if olen == 0:
                 for name, _ in group:
-                    self._write_empty(name)
+                    self._write_empty(name, live)
                 continue
             batch = np.stack([a for _, a in group])
             sl = self._shard_len(olen)
@@ -144,7 +190,7 @@ class ECBackend:
             crcs = crcs.reshape(len(group), self.n)
             for bi, (name, arr) in enumerate(group):
                 self.object_sizes[name] = olen
-                for shard in range(self.n):
+                for shard in live:
                     chunk = shards[bi, shard, :]
                     hinfo = HashInfo(1, sl, [int(crcs[bi, shard])])
                     # truncate clears any stale tail from a previous,
@@ -155,6 +201,7 @@ class ECBackend:
                          .setattr(shard_cid(self.pg, shard), name,
                                   HINFO_KEY, hinfo.to_bytes()))
                     self._store(shard).queue_transaction(t)
+                self._log_write(name, live)
 
     # -- write path (RMW partial-stripe) -------------------------------------
 
@@ -177,7 +224,8 @@ class ECBackend:
         geometry depends on chunk length; zero-extended chunks would
         decode to garbage."""
         B = len(names)
-        avail = [s for s in range(self.n) if self.acting[s] not in dead]
+        avail = self._fresh_for(
+            names, [s for s in range(self.n) if self.acting[s] not in dead])
         lost_data = [s for s in range(self.k) if s not in avail]
 
         def read_window(s: int, nm: str, off: int, ln: int) -> np.ndarray:
@@ -234,6 +282,7 @@ class ECBackend:
         dead = dead_osds or set()
         k, si = self.k, self.sinfo
         live = [s for s in range(self.n) if self.acting[s] not in dead]
+        self._check_min_size(live)
 
         # merge ops per object into one covering window
         per_obj: dict[str, list[tuple[int, np.ndarray]]] = {}
@@ -250,7 +299,7 @@ class ECBackend:
             if not writes:
                 # zero-length writes don't extend; just ensure existence
                 if name not in self.object_sizes:
-                    self._write_empty(name)
+                    self._write_empty(name, live)
                 continue
             hi = max(off + len(a) for off, a in writes)
             new_size = max(old_size, hi)
@@ -328,6 +377,7 @@ class ECBackend:
                                   HINFO_KEY, hinfo.to_bytes()))
                     self._store(s).queue_transaction(t)
                 self.object_sizes[name] = new_size
+                self._log_write(name, live)
 
     # -- read path -----------------------------------------------------------
 
@@ -340,10 +390,9 @@ class ECBackend:
     def read_objects(self, names: list[str],
                      dead_osds: set[int] | None = None) -> dict[str, np.ndarray]:
         dead = dead_osds or set()
-        avail = [s for s in range(self.n)
+        alive = [s for s in range(self.n)
                  if self.acting[s] not in dead]
         want = list(range(self.k))
-        need = sorted(self.coder.minimum_to_decode(want, avail))
         out: dict[str, np.ndarray] = {}
         # batched like recovery: stack equal-shard-length groups and
         # decode each group in ONE launch
@@ -355,6 +404,10 @@ class ECBackend:
             by_len.setdefault(self._shard_len(self.object_sizes[name]),
                               []).append(name)
         for sl, group in by_len.items():
+            # a shard that missed any of this group's writes is stale
+            # for it and must not serve (it replays on rejoin)
+            avail = self._fresh_for(group, alive)
+            need = sorted(self.coder.minimum_to_decode(want, avail))
             stacks = {s: np.stack([self._store(s).read(shard_cid(self.pg, s),
                                                        n) for n in group])
                       for s in need}
@@ -461,7 +514,9 @@ class ECBackend:
     def recover_shards(self, lost_shards: list[int],
                        replacement_osds: dict[int, int] | None = None,
                        batch: int = 128,
-                       verify_hinfo: bool = True) -> dict:
+                       verify_hinfo: bool = True,
+                       names: list[str] | None = None,
+                       helper_exclude: set[int] | None = None) -> dict:
         """Rebuild every object's lost shard(s): the RecoveryOp loop,
         batched AND pipelined. Returns counters {objects, bytes,
         hinfo_failures}.
@@ -478,6 +533,11 @@ class ECBackend:
         lost_shards: shard slots whose OSD died.
         replacement_osds: slot -> new OSD id (defaults to reusing the
         slot's OSD id, i.e. re-created store after replacement).
+        names: restrict recovery to these objects — the PG-log
+        delta-replay path (a revived shard rebuilds only what it
+        missed; ref: PGLog-driven recovery vs backfill).
+        helper_exclude: shard slots that must not serve helper reads
+        (other still-down OSDs during a partial rejoin).
         """
         import jax
 
@@ -491,12 +551,16 @@ class ECBackend:
             t = Transaction().create_collection(shard_cid(self.pg, s))
             self.cluster.osd(new_osd).queue_transaction(t)
 
-        survivors = [s for s in range(self.n) if s not in lost]
+        excluded = helper_exclude or set()
+        names = sorted(self.object_sizes) if names is None \
+            else sorted(n for n in names if n in self.object_sizes)
+        # helpers must be caught up for everything being rebuilt — a
+        # stale survivor would decode old bytes into the new shard
+        survivors = self._fresh_for(
+            names, [s for s in range(self.n)
+                    if s not in lost and s not in excluded])
         helper = sorted(self.coder.minimum_to_decode(lost, survivors))
-        names = sorted(self.object_sizes)
         counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
-        if not hasattr(self, "_fused_cache"):
-            self._fused_cache = {}
 
         # split into (shard_len, subgroup) jobs of <= batch objects
         by_len: dict[int, list[str]] = {}
@@ -504,8 +568,12 @@ class ECBackend:
             if self.object_sizes[name] == 0:
                 hinfo = HashInfo(1, 0, [0xFFFFFFFF])
                 for s in lost:
+                    # truncate clears a stale pre-failure chunk (the
+                    # object may have shrunk to empty while this shard
+                    # was down)
                     t = (Transaction()
                          .write(shard_cid(self.pg, s), name, 0, b"")
+                         .truncate(shard_cid(self.pg, s), name, 0)
                          .setattr(shard_cid(self.pg, s), name,
                                   HINFO_KEY, hinfo.to_bytes()))
                     self._store(s).queue_transaction(t)
@@ -518,10 +586,10 @@ class ECBackend:
                 for i in range(0, len(group), batch)]
 
         dec_fn = self.coder.batch_decoder(lost, helper)
-        pending: list[tuple] = []  # (sl, subgroup, exp, device handles)
+        pending: list[tuple] = []  # (sl, subgroup, device handles)
 
         def complete(entry) -> None:
-            sl, subgroup, exp, handles = entry
+            sl, subgroup, handles = entry
             rebuilt_d, rcrc_d, ok_d = handles
             rebuilt_all, crcs, ok = jax.device_get(
                 (rebuilt_d, rcrc_d, ok_d))
@@ -581,11 +649,14 @@ class ECBackend:
                                                    verify_hinfo)
             handles = self._fused_recover_fn(dec_fn, sl,
                                              verify_hinfo)(stack, exp)
-            pending.append((sl, subgroup, exp, handles))
+            pending.append((sl, subgroup, handles))
             if len(pending) >= 2:
                 complete(pending.pop(0))
         while pending:
             complete(pending.pop(0))
+        # recovered shards are now caught up with everything logged
+        for s in lost:
+            self.shard_applied[s] = self.pg_log.head
         return counters
 
     # -- deep scrub ----------------------------------------------------------

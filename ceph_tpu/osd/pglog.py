@@ -1,0 +1,57 @@
+"""PGLog — per-PG mutation log enabling delta rejoin.
+
+Rebuild of the reference's log-based catch-up (ref: src/osd/PGLog.{h,cc}
+pg_log_t entries with eversion_t versions; PeeringState GetLog/
+GetMissing computes a missing set from the authoritative log, and a
+rejoining OSD either LOG-REPLAYS the delta or, when the log has been
+trimmed past its last-applied version, falls back to BACKFILL).
+
+Simplified to what the sim's write model needs: every object mutation
+appends (version, name); a shard that was down across some window asks
+`missing_since(last_applied)` and gets the deduplicated set of objects
+it must re-apply — or None when the log no longer reaches back that far
+(the backfill signal). Versions are a single monotone counter per PG
+(the reference's eversion epoch component is carried by the OSDMap
+epoch at the cluster layer)."""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class PGLog:
+    """Append-only bounded mutation log for one PG."""
+
+    def __init__(self, max_entries: int = 10000):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = max_entries
+        self.head = 0          # newest version (0 = empty history)
+        self.tail = 0          # entries cover versions (tail, head]
+        self._entries: deque[tuple[int, str]] = deque()
+
+    def append(self, name: str) -> int:
+        """Record a mutation of `name`; returns its version."""
+        self.head += 1
+        self._entries.append((self.head, name))
+        while len(self._entries) > self.max_entries:
+            v, _ = self._entries.popleft()
+            self.tail = v
+        return self.head
+
+    def missing_since(self, version: int) -> list[str] | None:
+        """Objects mutated after `version` (dedup, oldest-first), or
+        None when `version` predates the retained log — the caller must
+        backfill (full copy) instead of replaying."""
+        if version >= self.head:
+            return []
+        if version < self.tail:
+            return None
+        seen: dict[str, None] = {}
+        for v, name in self._entries:
+            if v > version:
+                seen.setdefault(name)
+        return list(seen)
+
+    def __len__(self) -> int:
+        return len(self._entries)
