@@ -34,8 +34,11 @@ _NONE = np.int32(CRUSH_ITEM_NONE)
 
 
 class VectorMapper:
-    def __init__(self, m: CrushMap):
+    def __init__(self, m: CrushMap, draw: str = "fixed"):
+        if draw not in ("fixed", "float"):
+            raise ValueError(f"draw must be 'fixed' or 'float', got {draw!r}")
         self.m = m
+        self.draw = draw
         p = m.pack()
         self.tries = m.tunables.choose_total_tries
         self.max_depth = p.max_depth
@@ -54,6 +57,18 @@ class VectorMapper:
         self.t_sw_hi = jnp.asarray((sw >> 16).astype(np.uint32))
         self.t_iw_u32 = jnp.asarray(p.weights.astype(np.uint32))
         self.t_ln16 = jnp.asarray(ln16_table())
+        if draw == "fixed":
+            # per-distinct-weight q = A48 // w tables (ln48.py): the
+            # whole s64 draw/divide/compare pipeline reduces to two u32
+            # gathers + a lexicographic argmin, exact vs the oracle
+            from .ln48 import quotient_tables
+            widx_of, qhi, qlo = quotient_tables(p.weights.ravel())
+            widx = np.zeros(p.weights.shape, dtype=np.int32)
+            for w, i in widx_of.items():
+                widx[p.weights == w] = i
+            self.t_widx = jnp.asarray(widx)              # (NB, S)
+            self.t_qhi = jnp.asarray(qhi.reshape(-1))    # (D * 65536,)
+            self.t_qlo = jnp.asarray(qlo.reshape(-1))
         self.algs_used = set(int(a) for a in np.unique(p.alg) if a != 0)
         self.S_uniform = p.max_size_by_alg.get(ALG_UNIFORM, 1)
         self._jitted = {}
@@ -67,18 +82,37 @@ class VectorMapper:
 
     def _straw2(self, row, x, r):
         items = self.t_items[row]                       # (B, S)
-        w32 = self.t_w32[row]
         slot_ok = (jnp.arange(self.S)[None, :] < self.t_size[row][:, None]) \
             & ~self.t_wzero[row]
         r_b = jnp.asarray(r, jnp.uint32)
         r_b = r_b[:, None] if r_b.ndim else r_b
         h = hash32_3(x[:, None], items.astype(jnp.uint32), r_b, np_like=jnp)
-        draws = self.t_ln16[(h & jnp.uint32(0xFFFF)).astype(jnp.int32)] / w32
-        draws = jnp.where(slot_ok, draws, -jnp.inf)
-        best = jnp.argmax(draws, axis=1)
+        h16 = (h & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        if self.draw == "fixed":
+            best = self._straw2_best_fixed(row, h16, slot_ok)
+        else:
+            w32 = self.t_w32[row]
+            draws = self.t_ln16[h16] / w32
+            draws = jnp.where(slot_ok, draws, -jnp.inf)
+            best = jnp.argmax(draws, axis=1)
         item = jnp.take_along_axis(items, best[:, None], axis=1)[:, 0]
         any_ok = slot_ok.any(axis=1)
         return jnp.where(any_ok, item, _NONE)
+
+    def _straw2_best_fixed(self, row, h16, slot_ok):
+        """Winning slot under reference integer draw semantics: first
+        strictly-smallest q = A48 // w (48-bit, as u32 hi/lo pair) —
+        lexicographic argmin with first-wins ties (mapper.c keeps the
+        earlier item unless a later draw is STRICTLY greater)."""
+        umax = jnp.uint32(0xFFFFFFFF)
+        flat = self.t_widx[row] * 65536 + h16           # (B, S)
+        qhi = jnp.where(slot_ok, self.t_qhi[flat], umax)
+        qlo = jnp.where(slot_ok, self.t_qlo[flat], umax)
+        m1 = qhi.min(axis=1, keepdims=True)
+        cand = qhi == m1
+        lo_m = jnp.where(cand, qlo, umax)
+        m2 = lo_m.min(axis=1, keepdims=True)
+        return jnp.argmax(cand & (lo_m == m2), axis=1)  # first winner
 
     def _uniform(self, row, x, r):
         size = self.t_size[row]                         # (B,)

@@ -9,10 +9,14 @@ bit-for-bit (parity tests pin that).
 
 Divergences from upstream, frozen deliberately (reference unverifiable
 at build time — see SURVEY.md):
-  * straw2 draws are float32 `ln(u16)/w` with `ln` from a precomputed
-    65536-entry table (exact to float64 then cast) instead of the
-    two-level crush_ln fixed-point tables — same role, simpler, and
-    reproducible on both numpy and XLA backends bit-for-bit.
+  * straw2 draws default to FIXED-POINT crush_ln semantics (draw=
+    "fixed"): q = (2^48 - crush_ln(u)) // weight compared ascending,
+    first index winning ties — exactly the reference's truncating s64
+    division compare (see ln48.py; table values are the exact
+    mathematical log2 rather than upstream's two-level interpolation,
+    whose byte-exact tables cannot be verified against the empty
+    mount). The r01 float32 ln-table draw is kept as draw="float" for
+    comparison.
   * retry schedule: `choose_total_tries` rounds with r' = rep +
     round*numrep (indep) or r' = rep + ftotal (firstn); modern-profile
     behaviors (vary_r/stable) are the only semantics (no legacy modes).
@@ -44,8 +48,11 @@ def _u32(v: int) -> np.uint32:
 
 
 class OracleMapper:
-    def __init__(self, m: CrushMap):
+    def __init__(self, m: CrushMap, draw: str = "fixed"):
+        if draw not in ("fixed", "float"):
+            raise ValueError(f"draw must be 'fixed' or 'float', got {draw!r}")
         self.m = m
+        self.draw = draw
         self.tries = m.tunables.choose_total_tries
 
     # -- bucket choose ------------------------------------------------------
@@ -64,6 +71,8 @@ class OracleMapper:
         raise ValueError(f"unsupported bucket alg {b.alg}")
 
     def _straw2_choose(self, b, x: int, r: int) -> int:
+        if self.draw == "fixed":
+            return self._straw2_choose_fixed(b, x, r)
         ln = ln16_table()
         best_i = -1
         best_draw = None
@@ -75,6 +84,26 @@ class OracleMapper:
             draw = ln[h] / (np.float32(w) / np.float32(65536.0))
             if best_draw is None or draw > best_draw:
                 best_draw = draw
+                best_i = i
+        if best_i < 0:
+            return CRUSH_ITEM_NONE
+        return b.items[best_i]
+
+    def _straw2_choose_fixed(self, b, x: int, r: int) -> int:
+        """Reference integer semantics: draw = (crush_ln(u) - 2^48)/w,
+        truncating s64 division, first strictly-greatest draw wins —
+        equivalently first strictly-smallest q = A48 // w (ln48.py)."""
+        from .ln48 import a48_table
+        A = a48_table()
+        best_i = -1
+        best_q = None
+        for i, (item, w) in enumerate(zip(b.items, b.weights)):
+            if w == 0:
+                continue
+            h = int(hash32_3(_u32(x), _u32(item), _u32(r))) & 0xFFFF
+            q = int(A[h]) // int(w)
+            if best_q is None or q < best_q:
+                best_q = q
                 best_i = i
         if best_i < 0:
             return CRUSH_ITEM_NONE

@@ -68,10 +68,17 @@ class SimCluster:
         self.alive = np.ones(n_osds, dtype=bool)      # process up?
         self.last_heard = np.zeros((n_osds, n_osds))  # peer hb stamps
         self.down_since: dict[int, float] = {}
+        # async backfill state: ps -> {"moves": [(slot, old, new)],
+        # "names": set of objects still to copy}; while a PG backfills,
+        # pg_temp keeps the OLD acting set serving I/O (ref:
+        # PeeringState requests pg_temp until backfill completes)
+        self.backfills: dict[int, dict] = {}
+        self.backfill_rate = 32   # objects copied per PG per tick step
         self.perf = (PerfCountersBuilder("cluster")
                      .add_u64_counter("recovered_objects")
                      .add_u64_counter("log_replayed_objects")
                      .add_u64_counter("backfilled_objects")
+                     .add_u64_counter("backfills_completed")
                      .add_u64_counter("revive_full_rebuilds")
                      .add_u64_counter("deferred_replays")
                      .add_u64_counter("osd_marked_down")
@@ -94,6 +101,12 @@ class SimCluster:
         up, _upp, acting, _actp = self.osdmap.pg_to_up_acting_osds(1, ps)
         return acting
 
+    def _up(self, ps: int) -> list[int]:
+        """The CRUSH-mapped target set, ignoring pg_temp overrides —
+        what re-peering steers toward (acting may lag behind during
+        backfill by design)."""
+        return self.osdmap.pg_to_up_acting_osds(1, ps)[0]
+
     def locate(self, name: str) -> int:
         return self.osdmap.object_to_pg(1, name)[1]
 
@@ -109,6 +122,11 @@ class SimCluster:
             by_pg.setdefault(self.locate(name), {})[name] = data
         for ps, group in by_pg.items():
             self.pgs[ps].write_objects(group, dead_osds=dead)
+            job = self.backfills.get(ps)
+            if job is not None:
+                # bytes written during backfill go to the OLD (serving)
+                # set; the new shard must be (re-)copied
+                job["names"].update(group)
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
@@ -142,6 +160,7 @@ class SimCluster:
         self.last_heard[:, osd] = self.now
         if not self.osdmap.osd_up[osd]:
             self.osdmap.mark_up(osd)
+        was_out = self.osdmap.osd_weight[osd] == 0
         self.down_since.pop(osd, None)
         g_log.dout("osd", 1, f"osd.{osd} revived at t={self.now}")
         # every shard left behind (this OSD's, and any whose earlier
@@ -149,6 +168,14 @@ class SimCluster:
         # now; reads stay safe meanwhile because ECBackend never serves
         # an object from a shard whose cursor predates its last write
         self._catch_up_all()
+        if was_out:
+            # rejoin after auto-out: weight restored -> CRUSH moves
+            # slots back from their interim holders; those are live
+            # sources, so the moves run as pg_temp-protected backfills
+            self.osdmap.mark_in(osd)
+            g_log.dout("mon", 1, f"osd.{osd} marked in (epoch "
+                                 f"{self.osdmap.epoch})")
+            self._repeer_all()
 
     def _catch_up_all(self) -> None:
         """Replay the PG-log delta into every behind shard whose OSD is
@@ -215,6 +242,7 @@ class SimCluster:
             for j, since in list(self.down_since.items()):
                 if self.now - since >= self.down_out_interval:
                     self._mark_out(j)
+            self._progress_backfills()
 
     def _mark_down(self, osd: int) -> None:
         if not self.osdmap.osd_up[osd]:
@@ -249,7 +277,22 @@ class SimCluster:
         from live source)."""
         for ps in range(self.pg_num):
             be = self.pgs[ps]
-            new_acting = self._acting(ps)
+            new_acting = self._up(ps)
+            # reconcile in-flight backfills with the new map: a move
+            # whose destination died or is no longer the CRUSH target
+            # is cancelled (the old holder simply keeps serving)
+            job = self.backfills.get(ps)
+            if job is not None:
+                kept = [(s, o, n) for (s, o, n) in job["moves"]
+                        if self.alive[n] and new_acting[s] == n]
+                if len(kept) != len(job["moves"]):
+                    g_log.dout("osd", 1, f"pg 1.{ps}: cancelled "
+                               f"{len(job['moves']) - len(kept)} stale "
+                               f"backfill move(s) on map change")
+                job["moves"] = kept
+                if not kept:
+                    self.osdmap.set_pg_temp((1, ps), [])
+                    del self.backfills[ps]
             if new_acting == be.acting:
                 continue
             if any(a == CRUSH_ITEM_NONE for a in new_acting):
@@ -263,23 +306,6 @@ class SimCluster:
                     moved.append((slot, old, new))
                 else:
                     lost.append((slot, new))
-            # backfill-lite: copy shard bytes from live old -> new
-            from .ecbackend import shard_cid
-            from .memstore import Transaction
-            for slot, old, new in moved:
-                src = self.cluster.osd(old)
-                dst = self.cluster.osd(new)
-                cid = shard_cid(be.pg, slot)
-                t = Transaction().create_collection(cid)
-                dst.queue_transaction(t)
-                for name in src.list_objects(cid):
-                    t = (Transaction()
-                         .write(cid, name, 0, src.read(cid, name))
-                         .setattr(cid, name, "hinfo_key",
-                                  src.getattr(cid, name, "hinfo_key")))
-                    dst.queue_transaction(t)
-                be.acting[slot] = new
-                be.shard_applied[slot] = be.pg_log.head
             if lost:
                 slots = [s for s, _ in lost]
                 repl = {s: n for s, n in lost}
@@ -295,7 +321,83 @@ class SimCluster:
                 g_log.dout("recovery", 1,
                            f"pg 1.{ps}: rebuilt {counters['objects']} "
                            f"objects onto {repl}")
+            if moved:
+                # recovered slots are already flipped; moved slots keep
+                # serving from the OLD osd via pg_temp until the copy
+                # completes (ref: pg_temp during backfill)
+                self._start_backfill(ps, moved)
         self._update_degraded()
+
+    # -- backfill (async, pg_temp-protected) --------------------------------
+
+    def _start_backfill(self, ps: int, moves: list[tuple[int, int, int]]) \
+            -> None:
+        from .ecbackend import shard_cid
+        from .memstore import Transaction
+        be = self.pgs[ps]
+        job = self.backfills.setdefault(ps, {"moves": [], "names": set()})
+        for slot, old, new in moves:
+            job["moves"] = [mv for mv in job["moves"] if mv[0] != slot]
+            job["moves"].append((slot, old, new))
+            t = Transaction().create_collection(shard_cid(be.pg, slot))
+            self.cluster.osd(new).queue_transaction(t)
+        job["names"].update(be.object_sizes)
+        self.osdmap.set_pg_temp((1, ps), list(be.acting))
+        g_log.dout("osd", 1, f"pg 1.{ps} backfilling {len(job['moves'])} "
+                             f"slot(s); pg_temp keeps old acting serving")
+
+    def _progress_backfills(self) -> None:
+        """Copy up to backfill_rate objects per backfilling PG, then
+        cut over: flip acting, clear pg_temp. A source that died mid-
+        backfill converts that slot to recovery."""
+        from .ecbackend import HINFO_KEY, shard_cid
+        from .memstore import Transaction
+        for ps, job in list(self.backfills.items()):
+            be = self.pgs[ps]
+            for slot, old, new in list(job["moves"]):
+                # a dead destination cancels the move (the old holder
+                # keeps serving; a later map change re-plans the slot)
+                if not self.alive[new]:
+                    job["moves"].remove((slot, old, new))
+                    g_log.dout("osd", 1, f"pg 1.{ps}: backfill dest "
+                                         f"osd.{new} died; move cancelled")
+                    continue
+                # sources must still be alive; otherwise recover
+                if self.alive[old] and old in self.cluster.stores:
+                    continue
+                job["moves"].remove((slot, old, new))
+                exclude = {s for s, o in enumerate(be.acting)
+                           if s != slot and (not self.alive[o]
+                                             or o not in self.cluster.stores)}
+                counters = be.recover_shards([slot],
+                                             replacement_osds={slot: new},
+                                             helper_exclude=exclude)
+                self.perf.inc("recovered_objects", counters["objects"])
+            batch = sorted(job["names"])[:self.backfill_rate]
+            for name in batch:
+                job["names"].discard(name)
+                for slot, old, new in job["moves"]:
+                    src = self.cluster.osd(old)
+                    dst = self.cluster.osd(new)
+                    cid = shard_cid(be.pg, slot)
+                    if not src.exists(cid, name):
+                        continue
+                    data = src.read(cid, name)
+                    t = (Transaction()
+                         .write(cid, name, 0, data)
+                         .truncate(cid, name, len(data))
+                         .setattr(cid, name, HINFO_KEY,
+                                  src.getattr(cid, name, HINFO_KEY)))
+                    dst.queue_transaction(t)
+            if not job["names"]:
+                for slot, old, new in job["moves"]:
+                    be.acting[slot] = new
+                    be.shard_applied[slot] = be.pg_log.head
+                self.osdmap.set_pg_temp((1, ps), [])
+                del self.backfills[ps]
+                self.perf.inc("backfills_completed")
+                g_log.dout("osd", 1, f"pg 1.{ps} backfill complete; "
+                                     f"pg_temp cleared")
 
     # -- health -------------------------------------------------------------
 
@@ -310,7 +412,7 @@ class SimCluster:
                 undersized += 1
             elif dead_in_pg:
                 degraded += 1
-            else:
+            elif ps not in self.backfills:
                 active_clean += 1
         return {
             "epoch": self.osdmap.epoch,
@@ -319,6 +421,7 @@ class SimCluster:
             "pgs_active_clean": active_clean,
             "pgs_degraded": degraded,
             "pgs_undersized": undersized,
+            "pgs_backfilling": len(self.backfills),
         }
 
     def verify_all(self, expected: dict[str, np.ndarray]) -> int:
