@@ -1,0 +1,105 @@
+"""Objecter — the client-side placement + retry layer.
+
+Rebuild of the reference's client op path (ref: src/osdc/Objecter.cc
+op_submit -> _calc_target -> _op_submit: the client computes
+object -> PG -> primary OSD from ITS OWN cached OSDMap, sends the op,
+and when the cluster has moved on — wrong primary, down OSD, newer
+epoch — it refreshes its map, recomputes the target, and RESENDS
+without the caller ever noticing; librados ref: src/librados/
+IoCtxImpl.cc rados_write/rados_read on top of it).
+
+The sim transport is SimCluster.client_rpc, which behaves like a
+primary OSD session: it rejects ops addressed to the wrong primary
+with StaleMap (the reference OSD shares its newer map with the
+sender) and refuses connections to dead processes (lossy client
+connection). All data-plane batching stays intact: a write dict is
+grouped per PG and each PG's group is one batched submission."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.perf_counters import PerfCountersBuilder
+
+
+class ObjecterError(RuntimeError):
+    pass
+
+
+class Objecter:
+    """Client session against a SimCluster."""
+
+    MAX_ATTEMPTS = 8
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.perf = (PerfCountersBuilder("objecter")
+                     .add_u64_counter("op_send")
+                     .add_u64_counter("op_resend")
+                     .add_u64_counter("map_refresh")
+                     .create_perf_counters())
+        self._epoch = -1
+        self._primaries: dict[int, int] = {}
+        self._refresh()
+
+    # -- map view -----------------------------------------------------------
+
+    def _refresh(self) -> None:
+        """Pull the current OSDMap (the MOSDMap subscription analog)."""
+        om = self.cluster.osdmap
+        self._epoch = om.epoch
+        self._primaries = {
+            ps: om.pg_to_up_acting_osds(1, ps)[3]
+            for ps in range(self.cluster.pg_num)}
+        self.perf.inc("map_refresh")
+
+    def _calc_target(self, name: str) -> tuple[int, int]:
+        """object -> (ps, primary osd) from the CACHED map view
+        (Objecter::_calc_target)."""
+        ps = self.cluster.osdmap.object_to_pg(1, name)[1]
+        return ps, self._primaries.get(ps, -1)
+
+    # -- op submission ------------------------------------------------------
+
+    def _submit(self, kind: str, ps: int, payload) -> object:
+        """Send one PG-targeted op; retarget + resend on staleness
+        (the while loop is _op_submit's resend-on-new-map path)."""
+        from ..osd.cluster import StaleMap
+        for attempt in range(self.MAX_ATTEMPTS):
+            primary = self._primaries.get(ps, -1)
+            self.perf.inc("op_send")
+            if attempt:
+                self.perf.inc("op_resend")
+            try:
+                return self.cluster.client_rpc(primary, self._epoch,
+                                               kind, ps, payload)
+            except StaleMap:
+                self._refresh()
+        raise ObjecterError(
+            f"op on pg {ps} still untargetable after "
+            f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
+
+    def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
+        by_pg: dict[int, dict] = {}
+        for name, data in objects.items():
+            ps, _ = self._calc_target(name)
+            by_pg.setdefault(ps, {})[name] = data
+        for ps, group in by_pg.items():
+            self._submit("write", ps, group)
+
+    def write_at(self, name: str, offset: int,
+                 data: bytes | np.ndarray) -> None:
+        ps, _ = self._calc_target(name)
+        self._submit("write_ranges", ps, [(name, offset, data)])
+
+    def read(self, names: list[str] | str) -> dict[str, np.ndarray]:
+        single = isinstance(names, str)
+        names_l = [names] if single else list(names)
+        by_pg: dict[int, list[str]] = {}
+        for name in names_l:
+            ps, _ = self._calc_target(name)
+            by_pg.setdefault(ps, []).append(name)
+        out: dict[str, np.ndarray] = {}
+        for ps, group in by_pg.items():
+            out.update(self._submit("read", ps, group))
+        return out[names] if single else out

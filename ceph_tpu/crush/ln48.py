@@ -131,8 +131,10 @@ def a48_table() -> np.ndarray:
     return np.uint64(1 << 48) - _ln44_table_vec()
 
 
-@functools.cache
+@functools.lru_cache(maxsize=64)
 def _quotients_for(w: int) -> np.ndarray:
+    # bounded: each entry is a 512 KiB table, and real maps can carry
+    # per-OSD capacity-derived weights (many distinct values)
     if w < 1:
         raise ValueError("weight must be >= 1")
     return a48_table() // np.uint64(w)

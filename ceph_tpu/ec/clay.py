@@ -380,6 +380,18 @@ class Clay(ErasureCode):
         self._affine_cache[key] = result
         return result
 
+    def repair_plan_matrix(self, failed_chunk: int,
+                           helper_chunks: Sequence[int]
+                           ) -> tuple[np.ndarray, list[int]]:
+        """Public face of the cached affine repair solve: returns
+        (D, repair_planes) such that stacking the helpers' repair-plane
+        sub-chunks as (B, d*len(planes), s) and applying the static GF
+        matrix D yields the failed chunk's full (B, q^t, s) sub-chunks.
+        Lets callers (the sharded mesh path) run the bandwidth-optimal
+        MSR repair as one device matrix-apply."""
+        D, _ = self._affine_repair(int(failed_chunk), tuple(helper_chunks))
+        return D, self._repair_planes(int(failed_chunk))
+
     # -- data paths ---------------------------------------------------------
 
     def _apply(self, D: np.ndarray, stacked: np.ndarray) -> np.ndarray:

@@ -1,0 +1,71 @@
+"""Derive static repair matrices from any positionwise codec.
+
+Every positionwise-linear codec (all matrix codes: RS, LRC layers,
+bitmatrix techniques viewed per byte position) satisfies
+  lost_chunk = XOR_h C[h] * helper_chunk        (GF(2^8), byte-wise)
+for SOME coefficient row C once the helper set can repair the loss.
+This module recovers C empirically — probe the codec with random
+objects, read one byte column per sample, solve the GF linear system,
+verify on held-out samples and full chunks — so callers get a static
+matrix usable in fused/sharded device pipelines even when the codec
+(e.g. LRC's layered planner, ref: src/erasure-code/lrc/
+ErasureCodeLrc.cc minimum_to_decode layer walk) only exposes a
+procedural decode.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..gf.numpy_ref import gf_inv_matrix, gf_matmul
+from .interface import CHUNK_ALIGNMENT, ErasureCode
+
+
+def derive_repair_matrix(coder: ErasureCode, lost: Sequence[int],
+                         helpers: Sequence[int],
+                         seed: int = 0) -> np.ndarray:
+    """(len(lost), len(helpers)) GF matrix R with
+    lost_chunks = R (GF@) helper_chunks, byte-wise.
+
+    Raises ValueError when the codec is not positionwise or the probe
+    system is singular (helpers insufficient)."""
+    if not getattr(coder, "positionwise", True):
+        raise ValueError("codec couples byte positions (not positionwise); "
+                         "no per-byte repair matrix exists")
+    lost = [int(s) for s in lost]
+    helpers = [int(s) for s in helpers]
+    n = coder.get_chunk_count()
+    k = coder.get_data_chunk_count()
+    H = len(helpers)
+    cs = coder.get_chunk_size(k * CHUNK_ALIGNMENT)
+    rng = np.random.default_rng(seed)
+    S = H + 4
+    A = np.zeros((S, H), np.uint8)     # helper byte columns
+    Y = np.zeros((S, len(lost)), np.uint8)
+    full = []
+    for s in range(S):
+        obj = rng.integers(0, 256, k * cs, np.uint8)
+        enc = coder.encode(range(n), obj)
+        full.append(enc)
+        A[s] = [enc[h][0] for h in helpers]
+        Y[s] = [enc[t][0] for t in lost]
+    sq = A[:H]
+    try:
+        inv = gf_inv_matrix(sq)
+    except (ValueError, np.linalg.LinAlgError):
+        raise ValueError("probe system singular; try different helpers "
+                         "or another seed") from None
+    R = gf_matmul(inv, Y[:H]).T        # (len(lost), H)
+    # verify: held-out byte columns AND every byte of one full sample
+    if not np.array_equal(gf_matmul(A[H:], R.T), Y[H:]):
+        raise ValueError("repair relation failed held-out samples; "
+                         "helpers cannot linearly produce the lost chunks")
+    enc = full[0]
+    hstack = np.stack([np.asarray(enc[h]) for h in helpers])  # (H, cs)
+    want = np.stack([np.asarray(enc[t]) for t in lost])
+    if not np.array_equal(gf_matmul(R, hstack), want):
+        raise ValueError("repair matrix valid at byte 0 only — codec is "
+                         "not positionwise after all")
+    return R

@@ -28,6 +28,15 @@ from .ecbackend import ECBackend, ShardSet
 from .osdmap import OSDMap, PGPool
 
 
+class StaleMap(Exception):
+    """Op addressed to the wrong/unreachable primary — the OSD's
+    'I have a newer map' reply (the client must refresh and resend)."""
+
+    def __init__(self, epoch: int, why: str):
+        super().__init__(f"stale map (cluster at epoch {epoch}): {why}")
+        self.epoch = epoch
+
+
 class SimCluster:
     """n_osds OSDs, one EC pool, pg_num PGs, virtual-time failure
     handling."""
@@ -74,6 +83,10 @@ class SimCluster:
         # PeeringState requests pg_temp until backfill completes)
         self.backfills: dict[int, dict] = {}
         self.backfill_rate = 32   # objects copied per PG per tick step
+        # epoch at which each PG's serving set last changed; client ops
+        # carrying an older epoch are rejected with the current map
+        # (the reference OSD's require_same_or_newer_map behavior)
+        self.pg_changed_epoch: dict[int, int] = {}
         self.perf = (PerfCountersBuilder("cluster")
                      .add_u64_counter("recovered_objects")
                      .add_u64_counter("log_replayed_objects")
@@ -132,6 +145,51 @@ class SimCluster:
         ps = self.locate(name)
         dead = {o for o in range(len(self.alive)) if not self.alive[o]}
         return self.pgs[ps].read_object(name, dead_osds=dead)
+
+    # -- client RPC (the primary-OSD session an Objecter talks to) ----------
+
+    def _note_pg_change(self, ps: int) -> None:
+        self.pg_changed_epoch[ps] = self.osdmap.epoch
+
+    def client_rpc(self, target_osd: int, epoch: int, kind: str, ps: int,
+                   payload):
+        """One client op addressed to `target_osd` as pg `ps`'s
+        primary, carrying the client's map `epoch`. Raises StaleMap
+        when the op's epoch predates the PG's last serving-set change,
+        when the target is not the current acting primary, or when its
+        process is dead — the signals that make the Objecter refresh +
+        retarget (ref: OSD require_same_or_newer_map + map sharing;
+        lossy client connections)."""
+        if epoch < self.pg_changed_epoch.get(ps, 0):
+            raise StaleMap(self.osdmap.epoch,
+                           f"pg 1.{ps} remapped at epoch "
+                           f"{self.pg_changed_epoch[ps]}, op carries "
+                           f"epoch {epoch}")
+        primary = self.osdmap.pg_to_up_acting_osds(1, ps)[3]
+        if target_osd < 0 or target_osd != primary:
+            raise StaleMap(self.osdmap.epoch,
+                           f"pg 1.{ps} primary is osd.{primary}, "
+                           f"op sent to osd.{target_osd}")
+        if not self.alive[target_osd]:
+            raise StaleMap(self.osdmap.epoch,
+                           f"osd.{target_osd} is not answering")
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        be = self.pgs[ps]
+        if kind == "write":
+            be.write_objects(payload, dead_osds=dead)
+            job = self.backfills.get(ps)
+            if job is not None:
+                job["names"].update(payload)
+            return None
+        if kind == "write_ranges":
+            be.write_ranges(payload, dead_osds=dead)
+            job = self.backfills.get(ps)
+            if job is not None:
+                job["names"].update(n for n, _, _ in payload)
+            return None
+        if kind == "read":
+            return be.read_objects(payload, dead_osds=dead)
+        raise ValueError(f"unknown client op kind {kind!r}")
 
     # -- failure model ------------------------------------------------------
 
@@ -292,6 +350,7 @@ class SimCluster:
                 job["moves"] = kept
                 if not kept:
                     self.osdmap.set_pg_temp((1, ps), [])
+                    self._note_pg_change(ps)
                     del self.backfills[ps]
             if new_acting == be.acting:
                 continue
@@ -318,6 +377,7 @@ class SimCluster:
                 counters = be.recover_shards(slots, replacement_osds=repl,
                                              helper_exclude=exclude)
                 self.perf.inc("recovered_objects", counters["objects"])
+                self._note_pg_change(ps)
                 g_log.dout("recovery", 1,
                            f"pg 1.{ps}: rebuilt {counters['objects']} "
                            f"objects onto {repl}")
@@ -336,13 +396,21 @@ class SimCluster:
         from .memstore import Transaction
         be = self.pgs[ps]
         job = self.backfills.setdefault(ps, {"moves": [], "names": set()})
+        fresh = False
         for slot, old, new in moves:
+            if (slot, old, new) in job["moves"]:
+                continue  # already in flight — keep its copy progress
             job["moves"] = [mv for mv in job["moves"] if mv[0] != slot]
             job["moves"].append((slot, old, new))
+            fresh = True
             t = Transaction().create_collection(shard_cid(be.pg, slot))
             self.cluster.osd(new).queue_transaction(t)
-        job["names"].update(be.object_sizes)
+        if fresh:
+            # only a NEW destination needs the full object list; an
+            # unchanged in-flight move keeps its remaining set
+            job["names"].update(be.object_sizes)
         self.osdmap.set_pg_temp((1, ps), list(be.acting))
+        self._note_pg_change(ps)
         g_log.dout("osd", 1, f"pg 1.{ps} backfilling {len(job['moves'])} "
                              f"slot(s); pg_temp keeps old acting serving")
 
@@ -369,10 +437,32 @@ class SimCluster:
                 exclude = {s for s, o in enumerate(be.acting)
                            if s != slot and (not self.alive[o]
                                              or o not in self.cluster.stores)}
-                counters = be.recover_shards([slot],
-                                             replacement_osds={slot: new},
-                                             helper_exclude=exclude)
+                try:
+                    counters = be.recover_shards(
+                        [slot], replacement_osds={slot: new},
+                        helper_exclude=exclude)
+                except ValueError as e:
+                    # not enough live helpers right now: the slot stays
+                    # with its (dead) holder, the PG degraded; a later
+                    # revive or map change resolves it
+                    g_log.dout("recovery", 0,
+                               f"pg 1.{ps}: slot {slot} recovery "
+                               f"deferred during backfill ({e})")
+                    self.perf.inc("deferred_replays")
+                    continue
                 self.perf.inc("recovered_objects", counters["objects"])
+                # acting changed (slot flipped to `new`): keep pg_temp
+                # pointing at the real serving set, or clients would be
+                # steered at the dead old holder
+                self.osdmap.set_pg_temp((1, ps), list(be.acting))
+                self._note_pg_change(ps)
+            if not job["moves"]:
+                # nothing left to copy toward: drop the job without
+                # claiming a completed backfill
+                self.osdmap.set_pg_temp((1, ps), [])
+                self._note_pg_change(ps)
+                del self.backfills[ps]
+                continue
             batch = sorted(job["names"])[:self.backfill_rate]
             for name in batch:
                 job["names"].discard(name)
@@ -394,6 +484,7 @@ class SimCluster:
                     be.acting[slot] = new
                     be.shard_applied[slot] = be.pg_log.head
                 self.osdmap.set_pg_temp((1, ps), [])
+                self._note_pg_change(ps)
                 del self.backfills[ps]
                 self.perf.inc("backfills_completed")
                 g_log.dout("osd", 1, f"pg 1.{ps} backfill complete; "

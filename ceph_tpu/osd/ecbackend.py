@@ -544,22 +544,23 @@ class ECBackend:
         lost = sorted(set(lost_shards))
         if len(lost) > self.m:
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
+        excluded = helper_exclude or set()
+        names = sorted(self.object_sizes) if names is None \
+            else sorted(n for n in names if n in self.object_sizes)
+        # helpers must be caught up for everything being rebuilt — a
+        # stale survivor would decode old bytes into the new shard.
+        # Validate the plan BEFORE mutating acting, so an impossible
+        # recovery (insufficient live helpers) leaves no partial state.
+        survivors = self._fresh_for(
+            names, [s for s in range(self.n)
+                    if s not in lost and s not in excluded])
+        helper = sorted(self.coder.minimum_to_decode(lost, survivors))
         repl = replacement_osds or {}
         for s in lost:
             new_osd = repl.get(s, self.acting[s])
             self.acting[s] = new_osd
             t = Transaction().create_collection(shard_cid(self.pg, s))
             self.cluster.osd(new_osd).queue_transaction(t)
-
-        excluded = helper_exclude or set()
-        names = sorted(self.object_sizes) if names is None \
-            else sorted(n for n in names if n in self.object_sizes)
-        # helpers must be caught up for everything being rebuilt — a
-        # stale survivor would decode old bytes into the new shard
-        survivors = self._fresh_for(
-            names, [s for s in range(self.n)
-                    if s not in lost and s not in excluded])
-        helper = sorted(self.coder.minimum_to_decode(lost, survivors))
         counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
 
         # split into (shard_len, subgroup) jobs of <= batch objects

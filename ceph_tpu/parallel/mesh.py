@@ -88,26 +88,61 @@ def make_sharded_encoder(matrix: np.ndarray, mesh: Mesh,
                    out_shardings=chunk_sharding(mesh))
 
 
-def make_sharded_decoder(matrix: np.ndarray, erasures: tuple[int, ...],
-                         survivors: tuple[int, ...], mesh: Mesh,
-                         impl: str = DEFAULT_IMPL):
-    """Jitted step: sharded (B, n, L) chunks -> (B, E, L) reconstructed.
+def make_sharded_gather_apply(D: np.ndarray, slots: tuple[int, ...],
+                              mesh: Mesh, impl: str = DEFAULT_IMPL):
+    """Jitted step: sharded (B, n_slots, L) chunks -> (B, rows(D), L).
 
-    Indexing the survivor shard slots forces an ICI all-gather of exactly
-    the helper chunks (the TPU analog of MOSDECSubOpRead gather), then the
-    static decode matrix runs batched on every dp slice.
-    """
-    matrix = np.asarray(matrix, dtype=np.uint8)
-    k = matrix.shape[1]
-    D = decode_matrix(matrix, list(erasures), k, list(survivors))
-    surv = np.asarray(survivors, dtype=np.int32)
+    Indexing the given shard slots forces an ICI all-gather of exactly
+    those chunks (the TPU analog of MOSDECSubOpRead gather), then the
+    static GF matrix runs batched on every dp slice. The building block
+    for degraded decode, LRC local repair, and any derived linear
+    repair (ec.linearize)."""
+    D = np.asarray(D, dtype=np.uint8)
+    idx = np.asarray(slots, dtype=np.int32)
 
     def step(chunks):
-        stack = chunks[:, surv, :]
-        return apply_matrix(D, stack, impl=impl)
+        return apply_matrix(D, chunks[:, idx, :], impl=impl)
 
     return jax.jit(step, in_shardings=chunk_sharding(mesh),
                    out_shardings=data_sharding(mesh))
+
+
+def make_sharded_decoder(matrix: np.ndarray, erasures: tuple[int, ...],
+                         survivors: tuple[int, ...], mesh: Mesh,
+                         impl: str = DEFAULT_IMPL):
+    """Jitted step: sharded (B, n, L) chunks -> (B, E, L) reconstructed
+    (degraded read across the mesh; see make_sharded_gather_apply)."""
+    matrix = np.asarray(matrix, dtype=np.uint8)
+    k = matrix.shape[1]
+    D = decode_matrix(matrix, list(erasures), k, list(survivors))
+    return make_sharded_gather_apply(D, tuple(survivors), mesh, impl)
+
+
+def make_sharded_clay_repair(coder, failed_chunk: int,
+                             helper_chunks: tuple[int, ...], mesh: Mesh,
+                             impl: str = DEFAULT_IMPL):
+    """Jitted step: sharded (B, n_slots, L) chunks -> (B, L) rebuilt
+    Clay chunk, reading ONLY the helpers' repair-plane sub-chunks (the
+    MSR bandwidth win, beta = q^(t-1) of q^t sub-chunks per helper)
+    before one static matrix-apply on every dp slice."""
+    D, rplanes = coder.repair_plan_matrix(failed_chunk, helper_chunks)
+    D = np.asarray(D, dtype=np.uint8)
+    nsub = coder.get_sub_chunk_count()
+    idx = np.asarray(helper_chunks, dtype=np.int32)
+    planes = np.asarray(rplanes, dtype=np.int32)
+    d, nrp = len(helper_chunks), len(rplanes)
+
+    def step(chunks):
+        B, _, L = chunks.shape
+        helpers = chunks[:, idx, :]                    # ICI gather of d
+        sub = helpers.reshape(B, d, nsub, L // nsub)
+        rp = sub[:, :, planes, :]                      # beta sub-chunks
+        stacked = rp.reshape(B, d * nrp, L // nsub)
+        out = apply_matrix(D, stacked, impl=impl)      # (B, nsub, L//nsub)
+        return out.reshape(B, L)
+
+    return jax.jit(step, in_shardings=chunk_sharding(mesh),
+                   out_shardings=NamedSharding(mesh, P("dp", None)))
 
 
 @functools.lru_cache(maxsize=8)

@@ -6,20 +6,11 @@ requesting pg_temp during backfill; VERDICT r01 item 7)."""
 import numpy as np
 
 from ceph_tpu.osd.cluster import SimCluster
+from cluster_helpers import corpus, make_cluster
 
 
-def make_cluster(**kw):
-    kw.setdefault("n_osds", 12)
-    kw.setdefault("pg_num", 8)
-    kw.setdefault("heartbeat_grace", 20.0)
-    kw.setdefault("down_out_interval", 60.0)
-    return SimCluster(**kw)
 
 
-def corpus(n=40, size=700, seed=0, prefix="obj"):
-    rng = np.random.default_rng(seed)
-    return {f"{prefix}-{i}": rng.integers(0, 256, size=size, dtype=np.uint8)
-            for i in range(n)}
 
 
 def trigger_remap(c):

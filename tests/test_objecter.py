@@ -1,0 +1,99 @@
+"""Objecter client layer: object->PG->primary calc from a cached map,
+transparent retarget + resend when the cluster moves on (ref:
+src/osdc/Objecter.cc _calc_target/op_submit resend-on-new-map)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.objecter import Objecter, ObjecterError
+from ceph_tpu.osd.cluster import SimCluster, StaleMap
+from cluster_helpers import corpus, make_cluster
+
+
+
+
+
+
+def test_roundtrip_through_objecter():
+    c = make_cluster()
+    cl = Objecter(c)
+    objs = corpus()
+    cl.write(objs)
+    got = cl.read(list(objs))
+    for name, data in objs.items():
+        assert np.array_equal(got[name], data)
+    assert cl.perf.get("op_resend") == 0
+
+
+def test_partial_write_through_objecter():
+    c = make_cluster()
+    cl = Objecter(c)
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 256, 3000, np.uint8)
+    cl.write({"o": base})
+    patch = rng.integers(0, 256, 500, np.uint8)
+    cl.write_at("o", 700, patch)
+    want = base.copy()
+    want[700:1200] = patch
+    assert np.array_equal(cl.read("o"), want)
+
+
+def test_stale_client_retargets_after_remap():
+    """The VERDICT item-8 scenario: the map changes between the
+    client's snapshot and its submission; writes land correctly with
+    no caller involvement."""
+    c = make_cluster()
+    cl = Objecter(c)
+    objs = corpus()
+    cl.write(objs)
+    refreshes = cl.perf.get("map_refresh")
+    # cluster moves on: an OSD dies and is marked down+out -> primaries
+    # of several PGs change; the client still holds the old view
+    victims = {c.pgs[ps].acting[0] for ps in range(c.pg_num)}
+    victim = sorted(victims)[0]
+    c.kill_osd(victim)
+    c.tick(30.0)
+    c.tick(60.0)
+    assert c.osdmap.epoch > cl._epoch   # client is genuinely stale
+    rng = np.random.default_rng(2)
+    for name in objs:
+        objs[name] = rng.integers(0, 256, 700, np.uint8)
+    cl.write(objs)                      # must retarget internally
+    assert cl.perf.get("op_resend") > 0
+    assert cl.perf.get("map_refresh") > refreshes
+    got = cl.read(list(objs))
+    for name, data in objs.items():
+        assert np.array_equal(got[name], data)
+    assert c.verify_all(objs) == len(objs)
+
+
+def test_reads_resend_when_primary_dies_unnoticed():
+    c = make_cluster()
+    cl = Objecter(c)
+    objs = corpus(n=10)
+    cl.write(objs)
+    # kill a primary; within grace the map epoch hasn't moved, so the
+    # client refreshes, gets the same primary, retries, and only
+    # succeeds once failure detection promotes a new map
+    name = next(iter(objs))
+    ps = c.locate(name)
+    primary = c.osdmap.pg_to_up_acting_osds(1, ps)[3]
+    c.kill_osd(primary)
+    with pytest.raises(ObjecterError):
+        cl.read(name)                   # nobody answers yet
+    c.tick(30.0)                        # marked down -> new primary
+    got = cl.read(name)
+    assert np.array_equal(got, objs[name])
+
+
+def test_wrong_target_rejected_at_transport():
+    c = make_cluster()
+    cl = Objecter(c)
+    objs = corpus(n=4)
+    cl.write(objs)
+    name = next(iter(objs))
+    ps = c.locate(name)
+    primary = c.osdmap.pg_to_up_acting_osds(1, ps)[3]
+    wrong = next(o for o in range(12) if o != primary)
+    with pytest.raises(StaleMap):
+        c.client_rpc(wrong, c.osdmap.epoch, "read", ps, [name])

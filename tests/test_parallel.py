@@ -78,3 +78,80 @@ def test_flagship_k8m3_pads_shard_axis():
     dec = M.make_sharded_decoder(mat, (2, 10), (0, 1, 3, 4, 5, 6, 7, 8), mesh)
     rec = np.asarray(jax.device_get(dec(enc(data))))
     np.testing.assert_array_equal(rec[:, 0, :], data[:, 2, :])
+
+
+def _encode_all(coder, n, obj):
+    enc = coder.encode(range(n), obj)
+    return np.stack([np.asarray(enc[i]) for i in range(n)])
+
+
+def test_sharded_decode_multiple_erasure_patterns():
+    mesh = M.default_mesh()
+    k, m_ = 8, 3
+    mat = reed_sol_van_matrix(k, m_)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(8, k, 256), dtype=np.uint8)
+    chunks = M.make_sharded_encoder(mat, mesh)(data)
+    want = np.concatenate([data, R.encode_ref(mat, data)], axis=1)
+    for erasures in ((0, 9), (2, 5, 10), (8, 9, 10)):
+        survivors = tuple(s for s in range(k + m_)
+                          if s not in erasures)[:k]
+        dec = M.make_sharded_decoder(mat, erasures, survivors, mesh)
+        rec = np.asarray(jax.device_get(dec(chunks)))
+        for i, e in enumerate(erasures):
+            np.testing.assert_array_equal(rec[:, i, :], want[:, e, :],
+                                          err_msg=f"{erasures}")
+
+
+def test_sharded_lrc_local_repair():
+    from ceph_tpu.ec.linearize import derive_repair_matrix
+    from ceph_tpu.ec.registry import factory
+    mesh = M.default_mesh()
+    lrc = factory("plugin=lrc k=4 m=2 l=3")
+    n = lrc.get_chunk_count()
+    lost = 0
+    helpers = sorted(lrc.minimum_to_decode(
+        [lost], [i for i in range(n) if i != lost]))
+    assert len(helpers) < 4, "local repair must beat full decode width"
+    Rrow = derive_repair_matrix(lrc, [lost], helpers)
+    rng = np.random.default_rng(6)
+    objs = rng.integers(0, 256, size=(8, lrc.get_chunk_size(512) * 4),
+                        dtype=np.uint8)
+    chunks = np.stack([_encode_all(lrc, n, o) for o in objs])
+    pad = M.padded_slots(n, mesh) - n
+    if pad:
+        chunks = np.pad(chunks, ((0, 0), (0, pad), (0, 0)))
+    rep = M.make_sharded_gather_apply(Rrow, tuple(helpers), mesh)
+    got = np.asarray(jax.device_get(rep(chunks)))
+    np.testing.assert_array_equal(got[:, 0, :], chunks[:, lost, :])
+
+
+def test_sharded_clay_msr_repair():
+    from ceph_tpu.ec.registry import factory
+    mesh = M.default_mesh()
+    clay = factory("plugin=clay k=4 m=2")
+    n = clay.get_chunk_count()
+    failed = 1
+    helper_chunks = tuple(i for i in range(n) if i != failed)
+    rng = np.random.default_rng(7)
+    objs = rng.integers(0, 256, size=(8, clay.get_chunk_size(512) * 4),
+                        dtype=np.uint8)
+    chunks = np.stack([_encode_all(clay, n, o) for o in objs])
+    pad = M.padded_slots(n, mesh) - n
+    if pad:
+        chunks = np.pad(chunks, ((0, 0), (0, pad), (0, 0)))
+    rep = M.make_sharded_clay_repair(clay, failed, helper_chunks, mesh)
+    got = np.asarray(jax.device_get(rep(chunks)))
+    np.testing.assert_array_equal(got, chunks[:, failed, :])
+    # the bandwidth win: only beta of q^t sub-chunk planes are read
+    _, planes = clay.repair_plan_matrix(failed, helper_chunks)
+    assert len(planes) * clay.q == clay.get_sub_chunk_count()
+
+
+def test_derive_repair_matrix_rejects_non_positionwise():
+    import pytest
+    from ceph_tpu.ec.linearize import derive_repair_matrix
+    from ceph_tpu.ec.registry import factory
+    clay = factory("plugin=clay k=4 m=2")
+    with pytest.raises(ValueError, match="positionwise"):
+        derive_repair_matrix(clay, [0], [1, 2, 3, 4, 5])
