@@ -94,7 +94,19 @@ def lib() -> ctypes.CDLL:
     L.__erasure_code_init.restype = ctypes.c_int
     L.__erasure_code_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     L.ec_registered_plugin.restype = ctypes.c_char_p
+    L.ec_set_runtime_socket.argtypes = [ctypes.c_char_p]
+    L.ec_runtime_ping.restype = ctypes.c_int
     return L
+
+
+def set_runtime_socket(path: str | None) -> None:
+    """Point the shim's encode/decode at a running ECRuntimeServer
+    (ceph_tpu.native.server); None restores pure-CPU operation."""
+    lib().ec_set_runtime_socket(path.encode() if path else None)
+
+
+def runtime_ping() -> bool:
+    return bool(lib().ec_runtime_ping())
 
 
 def version() -> str:
@@ -147,7 +159,10 @@ class NativeReedSolomon(ErasureCode):
     def __del__(self):
         h = getattr(self, "_h", None)
         if h:
-            lib().ec_destroy(h)
+            try:
+                lib().ec_destroy(h)
+            except TypeError:
+                pass  # interpreter teardown already unloaded the lib
             self._h = None
 
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:

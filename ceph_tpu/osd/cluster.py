@@ -125,6 +125,23 @@ class SimCluster:
 
     # -- client I/O ---------------------------------------------------------
 
+    def _apply_write(self, ps: int, kind: str, payload,
+                     dead: set[int]) -> None:
+        """One PG write (full objects or ranges) with the invariants
+        every write path must keep: dead OSDs receive nothing (PGLog
+        records the gap), and objects written during a backfill are
+        (re-)queued for copy — the bytes went to the OLD serving set."""
+        be = self.pgs[ps]
+        if kind == "write":
+            be.write_objects(payload, dead_osds=dead)
+            names = payload.keys()
+        else:  # write_ranges
+            be.write_ranges(payload, dead_osds=dead)
+            names = {n for n, _, _ in payload}
+        job = self.backfills.get(ps)
+        if job is not None:
+            job["names"].update(names)
+
     def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
         # dead processes get no sub-writes; their shards fall behind in
         # the PG log and catch up on revive (ref: a down OSD misses
@@ -134,12 +151,7 @@ class SimCluster:
         for name, data in objects.items():
             by_pg.setdefault(self.locate(name), {})[name] = data
         for ps, group in by_pg.items():
-            self.pgs[ps].write_objects(group, dead_osds=dead)
-            job = self.backfills.get(ps)
-            if job is not None:
-                # bytes written during backfill go to the OLD (serving)
-                # set; the new shard must be (re-)copied
-                job["names"].update(group)
+            self._apply_write(ps, "write", group, dead)
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
@@ -174,21 +186,11 @@ class SimCluster:
             raise StaleMap(self.osdmap.epoch,
                            f"osd.{target_osd} is not answering")
         dead = {o for o in range(len(self.alive)) if not self.alive[o]}
-        be = self.pgs[ps]
-        if kind == "write":
-            be.write_objects(payload, dead_osds=dead)
-            job = self.backfills.get(ps)
-            if job is not None:
-                job["names"].update(payload)
-            return None
-        if kind == "write_ranges":
-            be.write_ranges(payload, dead_osds=dead)
-            job = self.backfills.get(ps)
-            if job is not None:
-                job["names"].update(n for n, _, _ in payload)
+        if kind in ("write", "write_ranges"):
+            self._apply_write(ps, kind, payload, dead)
             return None
         if kind == "read":
-            return be.read_objects(payload, dead_osds=dead)
+            return self.pgs[ps].read_objects(payload, dead_osds=dead)
         raise ValueError(f"unknown client op kind {kind!r}")
 
     # -- failure model ------------------------------------------------------

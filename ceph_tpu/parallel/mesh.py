@@ -32,6 +32,15 @@ from ..gf.numpy_ref import decode_matrix
 from ..ops.rs_kernels import DEFAULT_IMPL, apply_matrix
 
 
+def encode_all_chunks(coder, obj: np.ndarray) -> np.ndarray:
+    """(n_chunks, chunk_len) dense stack of every chunk of one object —
+    the bridge from a codec's dict-shaped encode() into the sharded
+    mesh paths (and their tests)."""
+    n = coder.get_chunk_count()
+    enc = coder.encode(range(n), obj)
+    return np.stack([np.asarray(enc[i]) for i in range(n)])
+
+
 def default_mesh(devices=None, shard: int = 2) -> Mesh:
     """(dp, shard) mesh over the given (default: all) devices.
 

@@ -20,7 +20,13 @@
 #include <cstdint>
 #include <cstring>
 #include <array>
+#include <mutex>
+#include <string>
 #include <vector>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
 
 namespace {
 
@@ -150,9 +156,170 @@ bool gf_invert(std::vector<uint8_t>& a, int s, std::vector<uint8_t>* out) {
   return true;
 }
 
+// ---- TPU runtime forwarding (SURVEY §7 step 9) -----------------------
+//
+// When a runtime socket is configured (ec_set_runtime_socket or the
+// EC_TPU_RUNTIME_SOCKET env var), ec_encode/ec_decode first try the
+// JAX process behind it (ceph_tpu/native/server.py wire format) and
+// fall back to the local CPU codec on ANY failure, so callers always
+// get an answer. One connection per process, guarded by a mutex.
+
+constexpr uint32_t kRpcMagic = 0xEC7B0001u;
+constexpr uint8_t kOpPing = 0, kOpEncode = 1, kOpDecode = 2;
+
+std::mutex g_rpc_mu;
+std::string g_socket_path;
+bool g_env_checked = false;
+int g_rpc_fd = -1;
+
+void rpc_close_locked() {
+  if (g_rpc_fd >= 0) {
+    ::close(g_rpc_fd);
+    g_rpc_fd = -1;
+  }
+}
+
+bool send_all(int fd, const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  while (n) {
+    // MSG_NOSIGNAL: a dead server must surface as a send error (CPU
+    // fallback), never as SIGPIPE killing a non-Python host process
+    ssize_t w = ::send(fd, c, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    c += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* p, size_t n) {
+  char* c = static_cast<char*>(p);
+  while (n) {
+    ssize_t r = ::recv(fd, c, n, 0);
+    if (r <= 0) return false;
+    c += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool rpc_connect_locked() {
+  if (g_rpc_fd >= 0) return true;
+  if (!g_env_checked) {
+    g_env_checked = true;
+    if (g_socket_path.empty()) {
+      const char* env = ::getenv("EC_TPU_RUNTIME_SOCKET");
+      if (env && *env) g_socket_path = env;
+    }
+  }
+  if (g_socket_path.empty() ||
+      g_socket_path.size() >= sizeof(sockaddr_un{}.sun_path))
+    return false;
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::memcpy(addr.sun_path, g_socket_path.c_str(),
+              g_socket_path.size() + 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return false;
+  }
+  g_rpc_fd = fd;
+  return true;
+}
+
+#pragma pack(push, 1)
+struct RpcHeader {
+  uint32_t magic;
+  uint8_t op, k, m, n_era;
+  int64_t chunk_len;
+  uint32_t batch;
+};
+#pragma pack(pop)
+
+// one EC op over the runtime socket; false => caller must fall back
+bool rpc_call(uint8_t op, const Coder* c, const int* erasures, int n_era,
+              const int* survivors, const uint8_t* payload,
+              int64_t chunk_len, int batch, uint8_t* out,
+              size_t out_len) {
+  std::lock_guard<std::mutex> lk(g_rpc_mu);
+  if (!rpc_connect_locked()) return false;
+  RpcHeader hdr{kRpcMagic, op, static_cast<uint8_t>(c->k),
+                static_cast<uint8_t>(c->m), static_cast<uint8_t>(n_era),
+                chunk_len, static_cast<uint32_t>(batch)};
+  const size_t payload_len =
+      static_cast<size_t>(batch) * c->k * static_cast<size_t>(chunk_len);
+  const uint64_t total =
+      sizeof(hdr) + (op == kOpDecode ? 4ull * (n_era + c->k) : 0ull) +
+      c->matrix.size() + payload_len;
+  if (total > 0xFFFFFFFFull) return false;  // u32 frame; CPU handles it
+  uint32_t body_len = static_cast<uint32_t>(total);
+  bool ok = send_all(g_rpc_fd, &body_len, 4) &&
+            send_all(g_rpc_fd, &hdr, sizeof(hdr));
+  if (ok && op == kOpDecode) {
+    std::vector<int32_t> idx(erasures, erasures + n_era);
+    idx.insert(idx.end(), survivors, survivors + c->k);
+    ok = send_all(g_rpc_fd, idx.data(), 4 * idx.size());
+  }
+  ok = ok && send_all(g_rpc_fd, c->matrix.data(), c->matrix.size()) &&
+       send_all(g_rpc_fd, payload, payload_len);
+  uint32_t resp_len = 0;
+  ok = ok && recv_all(g_rpc_fd, &resp_len, 4);
+  if (!ok || resp_len < 5 || resp_len != 5 + out_len) {
+    // drain what we can, then drop the connection — it is unsynced
+    rpc_close_locked();
+    return false;
+  }
+  uint32_t magic = 0;
+  uint8_t status = 1;
+  ok = recv_all(g_rpc_fd, &magic, 4) && recv_all(g_rpc_fd, &status, 1) &&
+       recv_all(g_rpc_fd, out, out_len);
+  if (!ok || magic != kRpcMagic || status != 0) {
+    rpc_close_locked();
+    return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
+
+// configure (or clear, with nullptr/"") the runtime socket path
+void ec_set_runtime_socket(const char* path) {
+  std::lock_guard<std::mutex> lk(g_rpc_mu);
+  rpc_close_locked();
+  g_socket_path = (path != nullptr) ? path : "";
+  g_env_checked = true;  // explicit setting overrides the env var
+}
+
+// 1 when a runtime server answers a ping on the configured socket
+int ec_runtime_ping() {
+  std::lock_guard<std::mutex> lk(g_rpc_mu);
+  if (!rpc_connect_locked()) return 0;
+  RpcHeader hdr{kRpcMagic, kOpPing, 1, 1, 0, 0, 0};
+  uint32_t body_len = sizeof(hdr);
+  if (!send_all(g_rpc_fd, &body_len, 4) ||
+      !send_all(g_rpc_fd, &hdr, sizeof(hdr))) {
+    rpc_close_locked();
+    return 0;
+  }
+  uint32_t resp_len = 0;
+  if (!recv_all(g_rpc_fd, &resp_len, 4) || resp_len < 5 ||
+      resp_len > 64) {
+    rpc_close_locked();
+    return 0;
+  }
+  std::vector<uint8_t> resp(resp_len);
+  if (!recv_all(g_rpc_fd, resp.data(), resp_len)) {
+    rpc_close_locked();
+    return 0;
+  }
+  uint32_t magic;
+  std::memcpy(&magic, resp.data(), 4);
+  return magic == kRpcMagic && resp[4] == 0;
+}
 
 const char* ec_tpu_version() { return "ceph-tpu-native 1.0 (gf256 0x11D)"; }
 
@@ -191,6 +358,10 @@ int ec_encode(void* h, const uint8_t* data, uint8_t* parity,
   if (!c || chunk_len < 0 || batch < 0) return -1;
   const int64_t in_stride = static_cast<int64_t>(c->k) * chunk_len;
   const int64_t out_stride = static_cast<int64_t>(c->m) * chunk_len;
+  // runtime path first (device speed); CPU loop on any failure
+  if (rpc_call(kOpEncode, c, nullptr, 0, nullptr, data, chunk_len, batch,
+               parity, static_cast<size_t>(batch) * out_stride))
+    return 0;
   for (int b = 0; b < batch; ++b) {
     const uint8_t* din = data + b * in_stride;
     uint8_t* pout = parity + b * out_stride;
@@ -214,6 +385,12 @@ int ec_decode(void* h, const int* erasures, int n_erasures,
   auto* c = static_cast<Coder*>(h);
   if (!c || n_erasures < 1 || n_erasures > c->m) return -1;
   const int k = c->k, n = c->k + c->m;
+  if (n_erasures <= 255 &&
+      rpc_call(kOpDecode, c, erasures, n_erasures, survivors, chunks,
+               chunk_len, batch,
+               out, static_cast<size_t>(batch) * n_erasures *
+                        static_cast<size_t>(chunk_len)))
+    return 0;
   // rows of [I; C] for the survivors
   std::vector<uint8_t> sub(static_cast<size_t>(k) * k, 0);
   for (int r = 0; r < k; ++r) {

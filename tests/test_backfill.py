@@ -9,10 +9,6 @@ from ceph_tpu.osd.cluster import SimCluster
 from cluster_helpers import corpus, make_cluster
 
 
-
-
-
-
 def trigger_remap(c):
     """Drive kill -> down -> out (lost slots recover onto interim
     holders) -> revive+mark-in (CRUSH moves the slots back from LIVE

@@ -7,20 +7,7 @@ import numpy as np
 import pytest
 
 from ceph_tpu.osd.cluster import SimCluster
-
-
-def make_cluster(**kw):
-    kw.setdefault("n_osds", 12)
-    kw.setdefault("pg_num", 8)
-    kw.setdefault("heartbeat_grace", 20.0)
-    kw.setdefault("down_out_interval", 60.0)
-    return SimCluster(**kw)
-
-
-def corpus(n=24, size=700, seed=0):
-    rng = np.random.default_rng(seed)
-    return {f"obj-{i}": rng.integers(0, 256, size=size, dtype=np.uint8)
-            for i in range(n)}
+from cluster_helpers import corpus, make_cluster
 
 
 def test_healthy_cluster_roundtrip():

@@ -114,3 +114,85 @@ def test_bad_geometry_rejected():
     from ceph_tpu.native import NativeReedSolomon
     with pytest.raises(ValueError):
         NativeReedSolomon({"k": "200", "m": "100"})
+
+
+class TestRuntimeIPC:
+    """The shim -> TPU-runtime forwarding hop (SURVEY §7 step 9): with
+    a live ECRuntimeServer the flat C API dispatches over the Unix
+    socket; without one it falls back to the CPU codec, bit-identical
+    either way."""
+
+    def _with_server(self):
+        import os
+        import tempfile
+
+        from ceph_tpu.native.server import ECRuntimeServer
+        path = os.path.join(tempfile.mkdtemp(), "ec.sock")
+        return path, ECRuntimeServer(path)
+
+    def test_encode_decode_roundtrip_via_runtime(self):
+        import numpy as np
+
+        from ceph_tpu.native import (NativeReedSolomon, runtime_ping,
+                                     set_runtime_socket)
+        path, srv = self._with_server()
+        with srv:
+            set_runtime_socket(path)
+            try:
+                assert runtime_ping()
+                coder = NativeReedSolomon({"k": "4", "m": "2"})
+                rng = np.random.default_rng(0)
+                d = rng.integers(0, 256, (3, 4, 512), np.uint8)
+                parity = coder.encode_chunks(d)
+                assert srv.requests_handled >= 2  # ping + encode
+                full = np.concatenate([d, parity], axis=1)
+                rec = coder.decode_chunks(
+                    [1, 4], {i: full[:, i] for i in (0, 2, 3, 5)})
+                assert (rec[1] == d[:, 1]).all()
+                assert (rec[4] == parity[:, 0]).all()
+                served = srv.requests_handled
+                assert served >= 3
+                # CPU fallback produces the SAME bytes
+                set_runtime_socket(None)
+                assert (coder.encode_chunks(d) == parity).all()
+                assert srv.requests_handled == served
+            finally:
+                set_runtime_socket(None)
+
+    def test_dead_socket_falls_back_to_cpu(self):
+        import numpy as np
+
+        from ceph_tpu.native import NativeReedSolomon, set_runtime_socket
+        set_runtime_socket("/nonexistent/ec.sock")
+        try:
+            coder = NativeReedSolomon({"k": "3", "m": "2"})
+            rng = np.random.default_rng(1)
+            d = rng.integers(0, 256, (2, 3, 256), np.uint8)
+            parity = coder.encode_chunks(d)      # silently CPU
+            set_runtime_socket(None)
+            assert (coder.encode_chunks(d) == parity).all()
+        finally:
+            set_runtime_socket(None)
+
+    def test_server_rejects_garbage_and_survives(self):
+        import socket
+        import struct
+
+        from ceph_tpu.native import (NativeReedSolomon, runtime_ping,
+                                     set_runtime_socket)
+        path, srv = self._with_server()
+        with srv:
+            # garbage frame: server answers an error and keeps serving
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            c.connect(path)
+            c.sendall(struct.pack("<I", 8) + b"garbage!")
+            ln = struct.unpack("<I", c.recv(4))[0]
+            body = c.recv(ln)
+            assert body[4] == 1  # status: error
+            c.close()
+            assert srv.errors == 1
+            set_runtime_socket(path)
+            try:
+                assert runtime_ping()
+            finally:
+                set_runtime_socket(None)

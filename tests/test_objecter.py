@@ -10,10 +10,6 @@ from ceph_tpu.osd.cluster import SimCluster, StaleMap
 from cluster_helpers import corpus, make_cluster
 
 
-
-
-
-
 def test_roundtrip_through_objecter():
     c = make_cluster()
     cl = Objecter(c)

@@ -80,11 +80,6 @@ def test_flagship_k8m3_pads_shard_axis():
     np.testing.assert_array_equal(rec[:, 0, :], data[:, 2, :])
 
 
-def _encode_all(coder, n, obj):
-    enc = coder.encode(range(n), obj)
-    return np.stack([np.asarray(enc[i]) for i in range(n)])
-
-
 def test_sharded_decode_multiple_erasure_patterns():
     mesh = M.default_mesh()
     k, m_ = 8, 3
@@ -117,7 +112,7 @@ def test_sharded_lrc_local_repair():
     rng = np.random.default_rng(6)
     objs = rng.integers(0, 256, size=(8, lrc.get_chunk_size(512) * 4),
                         dtype=np.uint8)
-    chunks = np.stack([_encode_all(lrc, n, o) for o in objs])
+    chunks = np.stack([M.encode_all_chunks(lrc, o) for o in objs])
     pad = M.padded_slots(n, mesh) - n
     if pad:
         chunks = np.pad(chunks, ((0, 0), (0, pad), (0, 0)))
@@ -136,7 +131,7 @@ def test_sharded_clay_msr_repair():
     rng = np.random.default_rng(7)
     objs = rng.integers(0, 256, size=(8, clay.get_chunk_size(512) * 4),
                         dtype=np.uint8)
-    chunks = np.stack([_encode_all(clay, n, o) for o in objs])
+    chunks = np.stack([M.encode_all_chunks(clay, o) for o in objs])
     pad = M.padded_slots(n, mesh) - n
     if pad:
         chunks = np.pad(chunks, ((0, 0), (0, pad), (0, 0)))

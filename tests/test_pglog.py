@@ -6,21 +6,8 @@ import numpy as np
 import pytest
 
 from ceph_tpu.osd.cluster import SimCluster
+from cluster_helpers import corpus, make_cluster
 from ceph_tpu.osd.pglog import PGLog
-
-
-def make_cluster(**kw):
-    kw.setdefault("n_osds", 12)
-    kw.setdefault("pg_num", 8)
-    kw.setdefault("heartbeat_grace", 20.0)
-    kw.setdefault("down_out_interval", 600.0)  # long: revive before out
-    return SimCluster(**kw)
-
-
-def corpus(n=24, size=700, seed=0, prefix="obj"):
-    rng = np.random.default_rng(seed)
-    return {f"{prefix}-{i}": rng.integers(0, 256, size=size, dtype=np.uint8)
-            for i in range(n)}
 
 
 class TestPGLogUnit:
