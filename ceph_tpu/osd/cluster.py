@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..crush.map import (CRUSH_ITEM_NONE, Tunables, build_hierarchy, ec_rule)
+from ..crush.map import (CRUSH_ITEM_NONE, Tunables, build_hierarchy, ec_rule,
+                         replicated_rule)
 from ..utils.log import g_log
 from ..utils.perf_counters import PerfCountersBuilder
 from .ecbackend import ECBackend, ShardSet
 from .osdmap import OSDMap, PGPool
+from .pgbackend import PGBackend, ReplicatedBackend
 
 
 class StaleMap(Exception):
@@ -55,17 +57,42 @@ class SimCluster:
         # several OSDs are out; the vectorized mapper's while_loop
         # early-exits, so unused rounds cost nothing
         crush.tunables = Tunables(choose_total_tries=51)
-        ec_rule(crush, 1, choose_type=1)
         self.osdmap = OSDMap(crush)
         self.cluster = ShardSet()
         self.profile = profile
-        from ..ec.registry import factory
-        coder = factory(profile)
-        self.pool_size = coder.get_chunk_count()
-        self.m = coder.get_coding_chunk_count()
+        # pool type switch (ref: pg_pool_t TYPE_REPLICATED vs
+        # TYPE_ERASURE; PrimaryLogPG drives either through PGBackend):
+        # profile "replicated size=3 [min_size=2]" makes a replicated
+        # pool; anything else is an EC profile string
+        from ..ec.interface import profile_from_string
+        if isinstance(profile, str):
+            toks = profile.split()
+            if toks and toks[0] == "replicated":  # "replicated size=3"
+                prof = {"plugin": "replicated",
+                        **profile_from_string(" ".join(toks[1:]))}
+            else:
+                prof = profile_from_string(profile)
+        else:
+            prof = dict(profile)
+        self.is_erasure = prof.get("plugin", "") != "replicated"
+        if self.is_erasure:
+            from ..ec.registry import factory
+            coder = factory(profile)
+            self.pool_size = coder.get_chunk_count()
+            self.m = coder.get_coding_chunk_count()
+            min_size = self.pool_size - self.m
+            ec_rule(crush, 1, choose_type=1)
+        else:
+            self.pool_size = int(prof.get("size", 3))
+            min_size = int(prof.get("min_size",
+                                    self.pool_size - self.pool_size // 2))
+            self.m = self.pool_size - min_size
+            replicated_rule(crush, 1, choose_type=1, firstn=True)
+        self.pool_min_size = min_size
         self.osdmap.add_pool(PGPool(1, pg_num=pg_num, size=self.pool_size,
-                                    min_size=self.pool_size - self.m,
-                                    crush_rule=1, is_erasure=True))
+                                    min_size=min_size,
+                                    crush_rule=1,
+                                    is_erasure=self.is_erasure))
         self.pg_num = pg_num
         self.chunk_size = chunk_size
         # timing / failure model
@@ -75,6 +102,7 @@ class SimCluster:
         self.down_out_interval = down_out_interval
         self.min_down_reporters = min_down_reporters
         self.alive = np.ones(n_osds, dtype=bool)      # process up?
+        self.destroyed: set[int] = set()              # disk gone for good
         self.last_heard = np.zeros((n_osds, n_osds))  # peer hb stamps
         self.down_since: dict[int, float] = {}
         # async backfill state: ps -> {"moves": [(slot, old, new)],
@@ -99,14 +127,20 @@ class SimCluster:
                      .add_u64("degraded_pgs")
                      .create_perf_counters())
         # PG backends at their initial acting sets
-        self.pgs: dict[int, ECBackend] = {}
+        self.pgs: dict[int, PGBackend] = {}
         for ps in range(pg_num):
             acting = self._acting(ps)
             if any(a == CRUSH_ITEM_NONE for a in acting):
                 raise ValueError(f"pg {ps} has unfilled slots at creation; "
                                  f"use more osds/hosts")
-            self.pgs[ps] = ECBackend(profile, f"1.{ps}", acting,
-                                     self.cluster, chunk_size=chunk_size)
+            if self.is_erasure:
+                self.pgs[ps] = ECBackend(profile, f"1.{ps}", acting,
+                                         self.cluster,
+                                         chunk_size=chunk_size)
+            else:
+                self.pgs[ps] = ReplicatedBackend(
+                    self.pool_size, f"1.{ps}", acting, self.cluster,
+                    min_size=min_size)
 
     # -- placement helpers --------------------------------------------------
 
@@ -204,6 +238,7 @@ class SimCluster:
         """Disk loss: kill + drop the store."""
         self.kill_osd(osd)
         self.cluster.stores.pop(osd, None)
+        self.destroyed.add(osd)
 
     def revive_osd(self, osd: int) -> None:
         """Process restart with its store intact: the OSD rejoins and
@@ -212,9 +247,9 @@ class SimCluster:
         back to a full shard rebuild only when the log was trimmed past
         the shard's applied cursor (the backfill case). A destroyed
         store cannot rejoin — recovery re-places its data instead."""
-        if osd not in self.cluster.stores:
+        if osd in self.destroyed:
             raise ValueError(
-                f"osd.{osd} was destroyed (no store); it cannot rejoin "
+                f"osd.{osd} was destroyed (disk lost); it cannot rejoin "
                 f"with its old identity — let recovery re-place its data")
         self.alive[osd] = True
         self.last_heard[:, osd] = self.now

@@ -43,9 +43,8 @@ import numpy as np
 from ..ec.interface import ErasureCode
 from ..ec.registry import factory
 from .memstore import MemStore, Transaction
+from .pgbackend import HINFO_KEY, PGBackend, shard_cid  # noqa: F401
 from .stripe import HashInfo, StripeInfo, as_flat_u8
-
-HINFO_KEY = "hinfo_key"  # same xattr name role as the reference
 
 
 @dataclass
@@ -59,12 +58,7 @@ class ShardSet:
         return self.stores[osd_id]
 
 
-def shard_cid(pg: str, shard: int) -> str:
-    """Collection name of one PG shard (role of spg_t's shard id)."""
-    return f"{pg}s{shard}"
-
-
-class ECBackend:
+class ECBackend(PGBackend):
     """One PG's EC backend over a set of per-OSD stores."""
 
     def __init__(self, profile: dict | str, pg: str, acting: list[int],
@@ -73,81 +67,29 @@ class ECBackend:
         self.coder: ErasureCode = factory(profile)
         self.k = self.coder.get_data_chunk_count()
         self.m = self.coder.get_coding_chunk_count()
-        self.n = self.k + self.m
-        if len(acting) != self.n:
-            raise ValueError(f"acting set size {len(acting)} != k+m={self.n}")
-        self.pg = pg
-        self.acting = list(acting)
-        if self.coder.get_chunk_mapping() != list(range(self.n)):
+        self.min_live = self.k  # EC pool min_size gate
+        if len(acting) != self.k + self.m:
+            raise ValueError(
+                f"acting set size {len(acting)} != k+m={self.k + self.m}")
+        if self.coder.get_chunk_mapping() != list(range(self.k + self.m)):
             raise ValueError("non-identity chunk mappings not supported "
                              "by this backend yet")
-        self.cluster = cluster or ShardSet()
         # pool-wide stripe geometry; round the requested chunk size up
         # through the coder's own alignment rule (clay needs sub-chunk
         # multiples, everything needs CHUNK_ALIGNMENT)
         requested = chunk_size or self.coder.get_chunk_size(0) or 4096
         cs = self.coder.get_chunk_size(requested * self.k)
         self.sinfo = StripeInfo(self.k, cs)
-        # one collection per shard on its OSD
-        for shard, osd in enumerate(self.acting):
-            t = Transaction().create_collection(shard_cid(pg, shard))
-            self.cluster.osd(osd).queue_transaction(t)
-        self.object_sizes: dict[str, int] = {}  # authoritative size info
-        # mutation log + per-shard applied cursor (ref: PGLog /
-        # peering's last_update per shard): a shard that missed writes
-        # replays just the delta on rejoin (see recover_shards(names=))
-        from .pglog import PGLog
-        self.pg_log = PGLog()
-        self.shard_applied = [0] * self.n
-        self.object_versions: dict[str, int] = {}  # name -> last version
+        self._init_common(pg, acting, cluster or ShardSet())
         self._fused_cache: dict = {}
 
     # -- helpers ------------------------------------------------------------
 
-    def _store(self, shard: int) -> MemStore:
-        return self.cluster.osd(self.acting[shard])
-
     def _shard_len(self, object_size: int) -> int:
         return self.sinfo.object_size_to_shard_size(object_size)
 
-    @staticmethod
-    def _batched_hinfo_crcs(chunks: np.ndarray) -> np.ndarray:
-        """One device launch for all shards' hinfo CRCs (raw register,
-        seed -1 — the HashInfo convention)."""
-        from ..csum.kernels import crc32c_blocks
-        return np.asarray(crc32c_blocks(chunks, init=0xFFFFFFFF, xorout=0))
-
-    def _live_slots(self, dead_osds: set[int] | None) -> list[int]:
-        dead = dead_osds or set()
-        return [s for s in range(self.n) if self.acting[s] not in dead]
-
-    def _log_write(self, name: str, live: list[int]) -> None:
-        """Append to the PG log and advance the applied cursor of every
-        shard that received this write (down shards stay behind and
-        replay the delta on rejoin)."""
-        v = self.pg_log.append(name)
-        self.object_versions[name] = v
-        for s in live:
-            self.shard_applied[s] = v
-
-    def _fresh_for(self, names: list[str], shards: list[int]) -> list[int]:
-        """Shards (from `shards`) whose applied cursor covers the last
-        write of every object in `names` — a shard that was down across
-        a write holds STALE bytes for it and must not serve reads or
-        helper gathers until it replays (ref: peering's missing-set:
-        an OSD behind the authoritative log can't serve those objects)."""
-        need = max((self.object_versions.get(n, 0) for n in names),
-                   default=0)
-        return [s for s in shards if self.shard_applied[s] >= need]
-
-    def _check_min_size(self, live: list[int]) -> None:
-        """Writes need >= k receiving shards or the object could be
-        stored unrecoverably (the pool min_size gate: the reference
-        marks the PG inactive and blocks I/O below min_size)."""
-        if len(live) < self.k:
-            raise ValueError(
-                f"PG below min_size: {len(live)} live shards < k={self.k}; "
-                f"write refused (pg inactive)")
+    # hinfo CRCs use the shared batched-launch helper
+    _batched_hinfo_crcs = staticmethod(PGBackend._batched_crcs)
 
     def _write_empty(self, name: str, live: list[int] | None = None) -> None:
         hinfo = HashInfo(1, 0, [0xFFFFFFFF])
@@ -205,11 +147,8 @@ class ECBackend:
 
     # -- write path (RMW partial-stripe) -------------------------------------
 
-    def write_at(self, name: str, offset: int, data: bytes | np.ndarray,
-                 dead_osds: set[int] | None = None) -> None:
-        """Overwrite/extend an arbitrary (offset, len) byte range — the
-        reference's RMW write (ref: ECCommon::RMWPipeline::start_rmw)."""
-        self.write_ranges([(name, offset, data)], dead_osds)
+    # write_at (the single-range RMW entry; ref: ECCommon::RMWPipeline::
+    # start_rmw) is inherited from PGBackend and lands in write_ranges
 
     def _read_data_window(self, names: list[str], c0: int, clen: int,
                           dead: set[int],
@@ -381,11 +320,8 @@ class ECBackend:
 
     # -- read path -----------------------------------------------------------
 
-    def read_object(self, name: str,
-                    dead_osds: set[int] | None = None) -> np.ndarray:
-        """Read one object, reconstructing if shards are unavailable
-        (objects_read_and_reconstruct)."""
-        return self.read_objects([name], dead_osds)[name]
+    # read_object is inherited; read_objects is the batched
+    # objects_read_and_reconstruct analog
 
     def read_objects(self, names: list[str],
                      dead_osds: set[int] | None = None) -> dict[str, np.ndarray]:

@@ -1,0 +1,383 @@
+"""PGBackend — the replicated-vs-erasure backend abstraction.
+
+Rebuild of the reference's per-PG backend split (ref: src/osd/PGBackend.h
+— PGBackend with submit_transaction / objects_read_async /
+recover_object / be_deep_scrub, subclassed by ReplicatedBackend
+(src/osd/ReplicatedBackend.{h,cc}) and ECBackend (src/osd/ECBackend.cc)).
+
+The shared machinery both backends need — per-slot store plumbing, the
+PG mutation log with per-shard applied cursors (staleness gating), the
+min-size write gate — lives here; ECBackend (osd/ecbackend.py) and
+ReplicatedBackend (below) differ only in how bytes are laid out across
+the acting set:
+
+* ReplicatedBackend: slot i holds a FULL copy of every object; writes
+  fan the same bytes out, reads come from any caught-up live replica,
+  recovery is a verified copy (push) from a surviving replica.
+* ECBackend: slot i holds shard i of the stripe; writes encode, reads/
+  recovery decode.
+
+TPU-first shaping: the replicated path has no GF math, but its integrity
+surface is the same batched checksum workload — full-object crc32c
+digests (the role of object_info_t's data_digest) computed in one
+device launch per equal-length group, both on write and on deep scrub.
+
+Both backends expose the same surface, so SimCluster (osd/cluster.py)
+drives either pool type through one code path — exactly how the
+reference's PrimaryLogPG calls through the PGBackend interface without
+knowing which backend it has.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .memstore import MemStore, Transaction
+from .pglog import PGLog
+from .stripe import HashInfo, as_flat_u8
+
+HINFO_KEY = "hinfo_key"  # same xattr name role as the reference
+
+
+def shard_cid(pg: str, shard: int) -> str:
+    """Collection name of one PG shard (role of spg_t's shard id)."""
+    return f"{pg}s{shard}"
+
+
+class PGBackend:
+    """Common base: store plumbing + PG-log bookkeeping (ref:
+    src/osd/PGBackend.h contract; log semantics ref: src/osd/PGLog.h)."""
+
+    #: live slots a write needs before it may proceed (the pool
+    #: min_size gate); subclasses set it in __init__
+    min_live: int = 1
+
+    def _init_common(self, pg: str, acting: list[int], cluster) -> None:
+        self.pg = pg
+        self.acting = list(acting)
+        self.n = len(acting)
+        self.cluster = cluster
+        for shard, osd in enumerate(self.acting):
+            t = Transaction().create_collection(shard_cid(pg, shard))
+            self.cluster.osd(osd).queue_transaction(t)
+        self.object_sizes: dict[str, int] = {}  # authoritative size info
+        # mutation log + per-shard applied cursor (ref: PGLog /
+        # peering's last_update per shard): a shard that missed writes
+        # replays just the delta on rejoin
+        self.pg_log = PGLog()
+        self.shard_applied = [0] * self.n
+        self.object_versions: dict[str, int] = {}  # name -> last version
+
+    # -- shared helpers ------------------------------------------------------
+
+    def _store(self, shard: int) -> MemStore:
+        return self.cluster.osd(self.acting[shard])
+
+    def _live_slots(self, dead_osds: set[int] | None) -> list[int]:
+        dead = dead_osds or set()
+        return [s for s in range(self.n) if self.acting[s] not in dead]
+
+    def _log_write(self, name: str, live: list[int]) -> None:
+        """Append to the PG log and advance the applied cursor of every
+        shard that received this write (down shards stay behind and
+        replay the delta on rejoin)."""
+        v = self.pg_log.append(name)
+        self.object_versions[name] = v
+        for s in live:
+            self.shard_applied[s] = v
+
+    def _fresh_for(self, names: list[str], shards: list[int]) -> list[int]:
+        """Shards (from `shards`) whose applied cursor covers the last
+        write of every object in `names` — a shard that was down across
+        a write holds STALE bytes for it and must not serve reads or
+        helper gathers until it replays (ref: peering's missing-set)."""
+        need = max((self.object_versions.get(n, 0) for n in names),
+                   default=0)
+        return [s for s in shards if self.shard_applied[s] >= need]
+
+    def _check_min_size(self, live: list[int]) -> None:
+        """Writes need >= min_live receiving slots or the PG goes
+        inactive and blocks I/O (the pool min_size gate). Counts
+        DISTINCT OSDs, not slots: mid-backfill an OSD can temporarily
+        hold two slots, and two copies on one disk are one failure
+        domain, not two."""
+        distinct = len({self.acting[s] for s in live})
+        if distinct < self.min_live:
+            raise ValueError(
+                f"PG below min_size: {distinct} live shards < "
+                f"min_size={self.min_live}; write refused (pg inactive)")
+
+    @staticmethod
+    def _batched_crcs(blocks: np.ndarray) -> np.ndarray:
+        """One device launch for a (B, L) stack of byte rows -> (B,)
+        uint32 CRCs (raw register, seed -1 — the HashInfo convention)."""
+        from ..csum.kernels import crc32c_blocks
+        return np.asarray(crc32c_blocks(blocks, init=0xFFFFFFFF, xorout=0))
+
+    # -- contract (ref: PGBackend.h pure virtuals) ---------------------------
+
+    def write_objects(self, objects, dead_osds=None) -> None:
+        raise NotImplementedError
+
+    def write_ranges(self, ops, dead_osds=None) -> None:
+        raise NotImplementedError
+
+    def write_at(self, name: str, offset: int, data,
+                 dead_osds: set[int] | None = None) -> None:
+        self.write_ranges([(name, offset, data)], dead_osds)
+
+    def read_objects(self, names, dead_osds=None) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def read_object(self, name: str,
+                    dead_osds: set[int] | None = None) -> np.ndarray:
+        return self.read_objects([name], dead_osds)[name]
+
+    def recover_shards(self, lost_shards, replacement_osds=None,
+                       batch: int = 128, verify_hinfo: bool = True,
+                       names=None, helper_exclude=None) -> dict:
+        raise NotImplementedError
+
+    def deep_scrub(self) -> dict:
+        raise NotImplementedError
+
+
+class ReplicatedBackend(PGBackend):
+    """Full-copy replication across the acting set (ref:
+    src/osd/ReplicatedBackend.{h,cc} — submit_transaction fans the same
+    transaction out to every replica; recovery pushes whole objects from
+    a surviving replica; be_deep_scrub compares replica digests).
+
+    Every slot stores the complete object plus a HashInfo xattr whose
+    single CRC covers the full byte stream (the data_digest role). The
+    xattr layout matches ECBackend's, so SimCluster's backfill copy loop
+    works unchanged for either pool type.
+    """
+
+    def __init__(self, size: int, pg: str, acting: list[int],
+                 cluster=None, min_size: int | None = None):
+        if len(acting) != size:
+            raise ValueError(f"acting set size {len(acting)} != size={size}")
+        from .ecbackend import ShardSet
+        self.size = size
+        # the reference default: size - size/2, i.e. ceil(size/2)
+        # (osd_pool_default_min_size=0 behavior) — 2 for size 3 AND 4
+        self.min_live = min_size if min_size is not None \
+            else size - size // 2
+        if not (1 <= self.min_live <= size):
+            raise ValueError(f"min_size {self.min_live} not in [1, {size}]")
+        self._init_common(pg, acting, cluster or ShardSet())
+
+    # -- write path ----------------------------------------------------------
+
+    def _put_full(self, name: str, arr: np.ndarray, crc: int,
+                  live: list[int]) -> None:
+        hinfo = HashInfo(1, len(arr), [crc])
+        for s in live:
+            t = (Transaction()
+                 .write(shard_cid(self.pg, s), name, 0, arr)
+                 .truncate(shard_cid(self.pg, s), name, len(arr))
+                 .setattr(shard_cid(self.pg, s), name,
+                          HINFO_KEY, hinfo.to_bytes()))
+            self._store(s).queue_transaction(t)
+        self.object_sizes[name] = len(arr)
+        self._log_write(name, live)
+
+    def write_objects(self, objects, dead_osds=None) -> None:
+        """Full-object writes: digest every equal-length group in one
+        batched CRC launch, then fan identical bytes to each live
+        replica (the repop fan-out, minus the network)."""
+        live = self._live_slots(dead_osds)
+        self._check_min_size(live)
+        by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
+        for name, data in objects.items():
+            arr = as_flat_u8(data)
+            by_len.setdefault(len(arr), []).append((name, arr))
+        for olen, group in by_len.items():
+            if olen == 0:
+                for name, arr in group:
+                    self._put_full(name, arr, 0xFFFFFFFF, live)
+                continue
+            crcs = self._batched_crcs(np.stack([a for _, a in group]))
+            for (name, arr), crc in zip(group, crcs):
+                self._put_full(name, arr, int(crc), live)
+
+    def write_ranges(self, ops, dead_osds=None) -> None:
+        """Arbitrary (offset, len) overwrites. Replication needs no RMW
+        of other shards — but the full-object digest does need the
+        pre-image, read from any caught-up live replica."""
+        dead = dead_osds or set()
+        live = self._live_slots(dead)
+        self._check_min_size(live)
+        per_obj: dict[str, list[tuple[int, np.ndarray]]] = {}
+        for name, offset, data in ops:
+            if offset < 0:
+                raise ValueError(f"negative offset {offset}")
+            per_obj.setdefault(name, []).append((int(offset),
+                                                as_flat_u8(data)))
+        staged: list[tuple[str, np.ndarray]] = []
+        for name, writes in per_obj.items():
+            old_size = self.object_sizes.get(name, 0)
+            writes = [(off, a) for off, a in writes if len(a)]
+            if not writes:
+                if name not in self.object_sizes:
+                    self._put_full(name, np.zeros(0, np.uint8),
+                                   0xFFFFFFFF, live)
+                continue
+            new_size = max(old_size,
+                           max(off + len(a) for off, a in writes))
+            buf = np.zeros(new_size, dtype=np.uint8)
+            if old_size:
+                src = self._fresh_for([name], live)
+                if not src:
+                    raise ValueError(
+                        f"no caught-up live replica holds {name!r}; "
+                        f"write blocked until recovery")
+                buf[:old_size] = self._store(src[0]).read(
+                    shard_cid(self.pg, src[0]), name)
+            for off, arr in writes:
+                buf[off:off + len(arr)] = arr
+            staged.append((name, buf))
+        # batched digest per equal new-length group, then fan out
+        by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
+        for name, buf in staged:
+            by_len.setdefault(len(buf), []).append((name, buf))
+        for olen, group in by_len.items():
+            crcs = (self._batched_crcs(np.stack([b for _, b in group]))
+                    if olen else [0xFFFFFFFF] * len(group))
+            for (name, buf), crc in zip(group, crcs):
+                self._put_full(name, buf, int(crc), live)
+
+    # -- read path -----------------------------------------------------------
+
+    def read_objects(self, names, dead_osds=None) -> dict[str, np.ndarray]:
+        """Serve each object from the first caught-up live replica
+        (primary-first, the reference's default read path)."""
+        alive = self._live_slots(dead_osds)
+        out: dict[str, np.ndarray] = {}
+        for name in names:
+            if name not in self.object_sizes:
+                raise KeyError(f"no object {name!r}")
+            src = self._fresh_for([name], alive)
+            if not src:
+                raise ValueError(f"no caught-up live replica for {name!r}")
+            out[name] = self._store(src[0]).read(
+                shard_cid(self.pg, src[0]), name)
+        return out
+
+    # -- recovery ------------------------------------------------------------
+
+    def recover_shards(self, lost_shards, replacement_osds=None,
+                       batch: int = 128, verify_hinfo: bool = True,
+                       names=None, helper_exclude=None) -> dict:
+        """Rebuild lost replicas by pushing verified copies from a
+        surviving replica (ref: ReplicatedBackend::recover_object /
+        prep_push). Copies are batched per equal length so the source-
+        verify CRC is one device launch per group.
+
+        Same signature/counters as ECBackend.recover_shards so
+        SimCluster's repeer/backfill/catch-up paths drive either."""
+        lost = sorted(set(lost_shards))
+        excluded = helper_exclude or set()
+        names = sorted(self.object_sizes) if names is None \
+            else sorted(n for n in names if n in self.object_sizes)
+        survivors = self._fresh_for(
+            names, [s for s in range(self.n)
+                    if s not in lost and s not in excluded])
+        if not survivors:
+            raise ValueError("no caught-up surviving replica to push from")
+        repl = replacement_osds or {}
+        for s in lost:
+            new_osd = repl.get(s, self.acting[s])
+            self.acting[s] = new_osd
+            t = Transaction().create_collection(shard_cid(self.pg, s))
+            self.cluster.osd(new_osd).queue_transaction(t)
+        counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+
+        by_len: dict[int, list[str]] = {}
+        for name in names:
+            by_len.setdefault(self.object_sizes[name], []).append(name)
+        for olen, group in by_len.items():
+            for i in range(0, len(group), batch):
+                sub = group[i:i + batch]
+                self._push_batch(sub, olen, lost, survivors,
+                                 verify_hinfo, counters)
+        for s in lost:
+            self.shard_applied[s] = self.pg_log.head
+        return counters
+
+    def _push_batch(self, sub: list[str], olen: int, lost: list[int],
+                    survivors: list[int], verify: bool,
+                    counters: dict) -> None:
+        src = survivors[0]
+        cid_src = shard_cid(self.pg, src)
+        st = self._store(src)
+        data = [st.read(cid_src, n) for n in sub]
+        crcs = [0xFFFFFFFF] * len(sub)
+        if olen:
+            crcs = [int(c) for c in
+                    self._batched_crcs(np.stack(data))]
+        for ni, name in enumerate(sub):
+            want = HashInfo.from_bytes(
+                st.getattr(cid_src, name, HINFO_KEY)).get_chunk_hash(0)
+            if verify and olen and crcs[ni] != want:
+                # source copy is corrupt: try the other survivors (the
+                # read-error failover the reference does on pull)
+                counters["hinfo_failures"] += 1
+                for alt in survivors[1:]:
+                    cid_a = shard_cid(self.pg, alt)
+                    cand = self._store(alt).read(cid_a, name)
+                    cc = int(self._batched_crcs(cand[None, :])[0])
+                    aw = HashInfo.from_bytes(self._store(alt).getattr(
+                        cid_a, name, HINFO_KEY)).get_chunk_hash(0)
+                    if cc == aw:
+                        data[ni], crcs[ni] = cand, cc
+                        break
+                else:
+                    raise ValueError(
+                        f"all surviving replicas of {name!r} fail digest")
+            hinfo = HashInfo(1, olen, [crcs[ni]])
+            for s in lost:
+                t = (Transaction()
+                     .write(shard_cid(self.pg, s), name, 0, data[ni])
+                     .truncate(shard_cid(self.pg, s), name, olen)
+                     .setattr(shard_cid(self.pg, s), name,
+                              HINFO_KEY, hinfo.to_bytes()))
+                self._store(s).queue_transaction(t)
+                counters["bytes"] += olen
+            counters["objects"] += 1
+
+    # -- scrub ---------------------------------------------------------------
+
+    def deep_scrub(self) -> dict:
+        """Read every replica of every object, verify its stored digest
+        (batched CRC per replica), and cross-check replicas agree (ref:
+        be_deep_scrub + the scrubber's authoritative-copy compare)."""
+        bad: list[tuple[str, int]] = []
+        checked = 0
+        digests: dict[str, set[int]] = {}
+        for s in range(self.n):
+            store = self._store(s)
+            cid = shard_cid(self.pg, s)
+            names = store.list_objects(cid)
+            by_len: dict[int, list[str]] = {}
+            for n in names:
+                by_len.setdefault(store.stat(cid, n), []).append(n)
+            for ln, group in by_len.items():
+                if ln:
+                    crcs = self._batched_crcs(
+                        np.stack([store.read(cid, n) for n in group]))
+                else:
+                    crcs = [0xFFFFFFFF] * len(group)
+                for n, c in zip(group, crcs):
+                    hinfo = HashInfo.from_bytes(
+                        store.getattr(cid, n, HINFO_KEY))
+                    checked += 1
+                    if hinfo.get_chunk_hash(0) != int(c):
+                        bad.append((n, s))
+                    digests.setdefault(n, set()).add(int(c))
+        # replicas that all self-verify but disagree with each other
+        # (e.g. a stale-but-internally-consistent copy)
+        split = [n for n, ds in digests.items() if len(ds) > 1]
+        return {"checked": checked, "inconsistent": bad,
+                "digest_mismatch": sorted(split)}

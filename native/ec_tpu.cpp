@@ -18,6 +18,7 @@
 // Build: make -C native   (g++ -O3 -shared -fPIC)
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <array>
 #include <mutex>
@@ -217,6 +218,18 @@ bool rpc_connect_locked() {
     return false;
   int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
   if (fd < 0) return false;
+  // bounded waits: a wedged runtime (stopped, deadlocked, mid-frame)
+  // must surface as an rpc failure -> CPU fallback, never a hang while
+  // holding g_rpc_mu. Override via EC_TPU_RUNTIME_TIMEOUT_MS.
+  long timeout_ms = 10000;
+  if (const char* t = ::getenv("EC_TPU_RUNTIME_TIMEOUT_MS")) {
+    char* end = nullptr;
+    long v = ::strtol(t, &end, 10);
+    if (end != t && v > 0) timeout_ms = v;
+  }
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   sockaddr_un addr{};
   addr.sun_family = AF_UNIX;
   std::memcpy(addr.sun_path, g_socket_path.c_str(),
