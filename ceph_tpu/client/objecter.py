@@ -31,12 +31,25 @@ class Objecter:
 
     MAX_ATTEMPTS = 8
 
-    def __init__(self, cluster):
+    def __init__(self, cluster, inflight_op_bytes: int = 100 << 20):
+        import threading
+        from ..utils.throttle import Throttle
         self.cluster = cluster
+        # SimCluster's PG state is not thread-safe; dispatch serializes
+        # under one lock (the reference Objecter likewise holds its
+        # rwlock across _op_submit). The throttle is taken OUTSIDE the
+        # lock so backpressure applies to concurrent callers.
+        self._dispatch_lock = threading.Lock()
+        # client-side backpressure (ref: Objecter's op_throttle_bytes /
+        # objecter_inflight_op_bytes): payload bytes are charged before
+        # dispatch and released after the reply; a flood of writes
+        # blocks the caller instead of ballooning memory
+        self.op_throttle = Throttle("objecter_bytes", inflight_op_bytes)
         self.perf = (PerfCountersBuilder("objecter")
                      .add_u64_counter("op_send")
                      .add_u64_counter("op_resend")
                      .add_u64_counter("map_refresh")
+                     .add_u64_counter("throttle_blocked_bytes")
                      .create_perf_counters())
         self._epoch = -1
         self._primaries: dict[int, int] = {}
@@ -61,23 +74,44 @@ class Objecter:
 
     # -- op submission ------------------------------------------------------
 
+    @staticmethod
+    def _payload_bytes(kind: str, payload) -> int:
+        if kind == "write":
+            return sum(len(np.asarray(v, np.uint8).reshape(-1))
+                       if not isinstance(v, (bytes, bytearray)) else len(v)
+                       for v in payload.values())
+        if kind == "write_ranges":
+            return sum(len(np.asarray(d, np.uint8).reshape(-1))
+                       if not isinstance(d, (bytes, bytearray)) else len(d)
+                       for _, _, d in payload)
+        return 0  # reads are charged on the reply side in the reference
+
     def _submit(self, kind: str, ps: int, payload) -> object:
         """Send one PG-targeted op; retarget + resend on staleness
         (the while loop is _op_submit's resend-on-new-map path)."""
         from ..osd.cluster import StaleMap
-        for attempt in range(self.MAX_ATTEMPTS):
-            primary = self._primaries.get(ps, -1)
-            self.perf.inc("op_send")
-            if attempt:
-                self.perf.inc("op_resend")
-            try:
-                return self.cluster.client_rpc(primary, self._epoch,
-                                               kind, ps, payload)
-            except StaleMap:
-                self._refresh()
-        raise ObjecterError(
-            f"op on pg {ps} still untargetable after "
-            f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
+        cost = self._payload_bytes(kind, payload)
+        if cost and not self.op_throttle.get_or_fail(cost):
+            self.perf.inc("throttle_blocked_bytes", cost)
+            self.op_throttle.get(cost)  # block until in-flight drains
+        try:
+            for attempt in range(self.MAX_ATTEMPTS):
+                primary = self._primaries.get(ps, -1)
+                self.perf.inc("op_send")
+                if attempt:
+                    self.perf.inc("op_resend")
+                try:
+                    with self._dispatch_lock:
+                        return self.cluster.client_rpc(
+                            primary, self._epoch, kind, ps, payload)
+                except StaleMap:
+                    self._refresh()
+            raise ObjecterError(
+                f"op on pg {ps} still untargetable after "
+                f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
+        finally:
+            if cost:
+                self.op_throttle.put(cost)
 
     def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
         by_pg: dict[int, dict] = {}

@@ -106,11 +106,26 @@ class SimCluster:
         self.last_heard = np.zeros((n_osds, n_osds))  # peer hb stamps
         self.down_since: dict[int, float] = {}
         # async backfill state: ps -> {"moves": [(slot, old, new)],
-        # "names": set of objects still to copy}; while a PG backfills,
-        # pg_temp keeps the OLD acting set serving I/O (ref:
-        # PeeringState requests pg_temp until backfill completes)
+        # "names": objects still to copy, "queued": names already
+        # enqueued on the op scheduler}; while a PG backfills, pg_temp
+        # keeps the OLD acting set serving I/O (ref: PeeringState
+        # requests pg_temp until backfill completes)
         self.backfills: dict[int, dict] = {}
-        self.backfill_rate = 32   # objects copied per PG per tick step
+        # mClock op scheduler paces background work (ref: src/osd/
+        # scheduler/mClockScheduler.cc); backfill copies ride the
+        # background_recovery class, whose limit is backfill_rate
+        # objects/s in virtual time
+        from .scheduler import MClockScheduler
+        self.sched = MClockScheduler()
+        self.backfill_rate = 32   # objects/s (sets the mclock limit)
+        # scrub scheduling (ref: osd_scrub_min_interval /
+        # osd_deep_scrub_interval; defaults scaled to virtual time)
+        self.scrub_interval = 300.0
+        self.deep_scrub_interval = 1800.0
+        self.last_scrub: dict[int, float] = {}
+        self.last_deep_scrub: dict[int, float] = {}
+        self._scrub_queued: set[int] = set()
+        self.scrub_reports: dict[int, dict] = {}
         # epoch at which each PG's serving set last changed; client ops
         # carrying an older epoch are rejected with the current map
         # (the reference OSD's require_same_or_newer_map behavior)
@@ -124,6 +139,9 @@ class SimCluster:
                      .add_u64_counter("deferred_replays")
                      .add_u64_counter("osd_marked_down")
                      .add_u64_counter("osd_marked_out")
+                     .add_u64_counter("scrubs_shallow")
+                     .add_u64_counter("scrubs_deep")
+                     .add_u64_counter("scrub_errors")
                      .add_u64("degraded_pgs")
                      .create_perf_counters())
         # PG backends at their initial acting sets
@@ -141,6 +159,23 @@ class SimCluster:
                 self.pgs[ps] = ReplicatedBackend(
                     self.pool_size, f"1.{ps}", acting, self.cluster,
                     min_size=min_size)
+
+    # -- QoS ----------------------------------------------------------------
+
+    @property
+    def backfill_rate(self) -> float:
+        return self._backfill_rate
+
+    @backfill_rate.setter
+    def backfill_rate(self, objs_per_s: float) -> None:
+        """Retune the background_recovery mClock limit (the
+        osd_mclock config-change path)."""
+        from .scheduler import ClientProfile
+        self._backfill_rate = objs_per_s
+        self.sched.set_profile(
+            "background_recovery",
+            ClientProfile(reservation=0.0, weight=5.0,
+                          limit=float(objs_per_s)))
 
     # -- placement helpers --------------------------------------------------
 
@@ -338,6 +373,8 @@ class SimCluster:
                 if self.now - since >= self.down_out_interval:
                     self._mark_out(j)
             self._progress_backfills()
+            self._schedule_scrubs()
+            self._pump()
 
     def _mark_down(self, osd: int) -> None:
         if not self.osdmap.osd_up[osd]:
@@ -386,9 +423,7 @@ class SimCluster:
                                f"backfill move(s) on map change")
                 job["moves"] = kept
                 if not kept:
-                    self.osdmap.set_pg_temp((1, ps), [])
-                    self._note_pg_change(ps)
-                    del self.backfills[ps]
+                    self._drop_backfill_job(ps)
             if new_acting == be.acting:
                 continue
             if any(a == CRUSH_ITEM_NONE for a in new_acting):
@@ -451,12 +486,20 @@ class SimCluster:
         g_log.dout("osd", 1, f"pg 1.{ps} backfilling {len(job['moves'])} "
                              f"slot(s); pg_temp keeps old acting serving")
 
+    def _drop_backfill_job(self, ps: int) -> None:
+        """Cancel a backfill: clear pg_temp AND purge its queued copy
+        ops so cancelled work doesn't burn recovery limit budget."""
+        self.osdmap.set_pg_temp((1, ps), [])
+        self._note_pg_change(ps)
+        del self.backfills[ps]
+        self.sched.remove_if("background_recovery",
+                             lambda op: op[0] == ps)
+
     def _progress_backfills(self) -> None:
-        """Copy up to backfill_rate objects per backfilling PG, then
-        cut over: flip acting, clear pg_temp. A source that died mid-
-        backfill converts that slot to recovery."""
-        from .ecbackend import HINFO_KEY, shard_cid
-        from .memstore import Transaction
+        """Pump backfill copies through the mClock scheduler (class
+        background_recovery, limit = backfill_rate objects/s in virtual
+        time), then cut over: flip acting, clear pg_temp. A source that
+        died mid-backfill converts that slot to recovery."""
         for ps, job in list(self.backfills.items()):
             be = self.pgs[ps]
             for slot, old, new in list(job["moves"]):
@@ -496,36 +539,116 @@ class SimCluster:
             if not job["moves"]:
                 # nothing left to copy toward: drop the job without
                 # claiming a completed backfill
-                self.osdmap.set_pg_temp((1, ps), [])
-                self._note_pg_change(ps)
-                del self.backfills[ps]
+                self._drop_backfill_job(ps)
                 continue
-            batch = sorted(job["names"])[:self.backfill_rate]
-            for name in batch:
-                job["names"].discard(name)
-                for slot, old, new in job["moves"]:
-                    src = self.cluster.osd(old)
-                    dst = self.cluster.osd(new)
-                    cid = shard_cid(be.pg, slot)
-                    if not src.exists(cid, name):
-                        continue
-                    data = src.read(cid, name)
-                    t = (Transaction()
-                         .write(cid, name, 0, data)
-                         .truncate(cid, name, len(data))
-                         .setattr(cid, name, HINFO_KEY,
-                                  src.getattr(cid, name, HINFO_KEY)))
-                    dst.queue_transaction(t)
-            if not job["names"]:
-                for slot, old, new in job["moves"]:
-                    be.acting[slot] = new
-                    be.shard_applied[slot] = be.pg_log.head
-                self.osdmap.set_pg_temp((1, ps), [])
-                self._note_pg_change(ps)
-                del self.backfills[ps]
-                self.perf.inc("backfills_completed")
-                g_log.dout("osd", 1, f"pg 1.{ps} backfill complete; "
-                                     f"pg_temp cleared")
+        # enqueue copy ops the scheduler hasn't seen yet
+        for ps, job in self.backfills.items():
+            queued = job.setdefault("queued", set())
+            for name in sorted(set(job["names"]) - queued):
+                self.sched.enqueue("background_recovery", (ps, name))
+                queued.add(name)
+
+    def _do_backfill_copy(self, ps: int, name: str) -> None:
+        from .ecbackend import HINFO_KEY, shard_cid
+        from .memstore import Transaction
+        job = self.backfills.get(ps)
+        if job is None:
+            return  # op outlived its backfill (cancelled/done)
+        job.setdefault("queued", set()).discard(name)
+        if name not in job["names"]:
+            return
+        job["names"].discard(name)
+        be = self.pgs[ps]
+        for slot, old, new in job["moves"]:
+            src = self.cluster.osd(old)
+            dst = self.cluster.osd(new)
+            cid = shard_cid(be.pg, slot)
+            if not src.exists(cid, name):
+                continue
+            data = src.read(cid, name)
+            t = (Transaction()
+                 .write(cid, name, 0, data)
+                 .truncate(cid, name, len(data))
+                 .setattr(cid, name, HINFO_KEY,
+                          src.getattr(cid, name, HINFO_KEY)))
+            dst.queue_transaction(t)
+        self.perf.inc("backfilled_objects")
+
+    def _complete_backfills(self) -> None:
+        """Cut over: everything copied and nothing still queued."""
+        for ps, job in list(self.backfills.items()):
+            if job["names"] or job.get("queued"):
+                continue
+            be = self.pgs[ps]
+            for slot, old, new in job["moves"]:
+                be.acting[slot] = new
+                be.shard_applied[slot] = be.pg_log.head
+            self.osdmap.set_pg_temp((1, ps), [])
+            self._note_pg_change(ps)
+            del self.backfills[ps]
+            self.perf.inc("backfills_completed")
+            g_log.dout("osd", 1, f"pg 1.{ps} backfill complete; "
+                                 f"pg_temp cleared")
+
+    # -- scrub scheduling ---------------------------------------------------
+
+    def _schedule_scrubs(self) -> None:
+        """Enqueue due scrubs on the scrub QoS class (ref: the scrub
+        scheduler in src/osd/scrubber/osd_scrub_sched.cc: periodic
+        shallow every osd_scrub_min_interval, deep every
+        osd_deep_scrub_interval). Degraded/backfilling PGs are skipped
+        until healthy, like the reference's active+clean gate."""
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        for ps in range(self.pg_num):
+            if ps in self.backfills or ps in self._scrub_queued:
+                continue
+            if any(o in dead for o in self.pgs[ps].acting):
+                continue
+            deep_due = (self.now - self.last_deep_scrub.get(ps, 0.0)
+                        >= self.deep_scrub_interval)
+            shallow_due = (self.now - self.last_scrub.get(ps, 0.0)
+                           >= self.scrub_interval)
+            if deep_due or shallow_due:
+                self.sched.enqueue(
+                    "scrub", (ps, "deep" if deep_due else "shallow"))
+                self._scrub_queued.add(ps)
+
+    def _do_scrub(self, ps: int, kind: str) -> None:
+        self._scrub_queued.discard(ps)
+        be = self.pgs[ps]
+        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        if ps in self.backfills or any(o in dead for o in be.acting):
+            return  # went unhealthy while queued; rescheduled when due
+        if kind == "deep":
+            rep = be.deep_scrub()
+            errs = len(rep["inconsistent"]) + len(
+                rep.get("digest_mismatch", []))
+            self.last_deep_scrub[ps] = self.now
+            self.last_scrub[ps] = self.now  # deep subsumes shallow
+            self.perf.inc("scrubs_deep")
+        else:
+            rep = be.shallow_scrub()
+            errs = len(rep["errors"])
+            self.last_scrub[ps] = self.now
+            self.perf.inc("scrubs_shallow")
+        if errs:
+            self.perf.inc("scrub_errors", errs)
+            self.scrub_reports[ps] = rep
+            g_log.dout("scrub", 0,
+                       f"pg 1.{ps} {kind} scrub: {errs} error(s)")
+
+    # -- op pump ------------------------------------------------------------
+
+    def _pump(self) -> None:
+        """One scheduler drain per tick step: background work (backfill
+        copies, scrubs) executes in mClock order until every class is
+        limit-bound for this instant of virtual time."""
+        for cls, op in self.sched.drain(self.now):
+            if cls == "background_recovery":
+                self._do_backfill_copy(*op)
+            elif cls == "scrub":
+                self._do_scrub(*op)
+        self._complete_backfills()
 
     # -- health -------------------------------------------------------------
 

@@ -88,6 +88,8 @@ class ECBackend(PGBackend):
     def _shard_len(self, object_size: int) -> int:
         return self.sinfo.object_size_to_shard_size(object_size)
 
+    _expected_shard_len = _shard_len  # shallow-scrub size rule
+
     # hinfo CRCs use the shared batched-launch helper
     _batched_hinfo_crcs = staticmethod(PGBackend._batched_crcs)
 

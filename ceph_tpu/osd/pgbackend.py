@@ -141,6 +141,57 @@ class PGBackend:
     def deep_scrub(self) -> dict:
         raise NotImplementedError
 
+    # -- shallow scrub (shared) ----------------------------------------------
+
+    def _expected_shard_len(self, object_size: int) -> int:
+        """Bytes slot s should hold for an object of `object_size`
+        logical bytes (replicated: the full object; EC: the shard)."""
+        raise NotImplementedError
+
+    def shallow_scrub(self, skip_slots: set[int] | None = None) -> dict:
+        """Metadata-only audit — no data reads (ref: the scrubber's
+        shallow pass compares object set, sizes, and attrs across
+        shards; src/osd/scrubber/pg_scrubber.cc). Checks every slot
+        against the authoritative object map: presence, stored length,
+        hinfo attr presence + its recorded length, and flags stray
+        objects the PG doesn't know about."""
+        skip = skip_slots or set()
+        errors: list[tuple[str, int, str]] = []  # (name, slot, what)
+        checked = 0
+        for s in range(self.n):
+            if s in skip:
+                continue
+            store = self._store(s)
+            cid = shard_cid(self.pg, s)
+            on_disk = set(store.list_objects(cid))
+            for name, osize in self.object_sizes.items():
+                checked += 1
+                # a shard that missed this object's last write is
+                # legitimately behind, not inconsistent
+                if self.shard_applied[s] < self.object_versions.get(
+                        name, 0):
+                    continue
+                if name not in on_disk:
+                    errors.append((name, s, "missing"))
+                    continue
+                want = self._expected_shard_len(osize)
+                have = store.stat(cid, name)
+                if have != want:
+                    errors.append((name, s, f"size {have} != {want}"))
+                try:
+                    hb = store.getattr(cid, name, HINFO_KEY)
+                except KeyError:
+                    errors.append((name, s, "no hinfo attr"))
+                    continue
+                hinfo = HashInfo.from_bytes(hb)
+                if hinfo.total_chunk_size != want:
+                    errors.append(
+                        (name, s, f"hinfo len {hinfo.total_chunk_size} "
+                                  f"!= {want}"))
+            for stray in on_disk - set(self.object_sizes):
+                errors.append((stray, s, "stray object"))
+        return {"checked": checked, "errors": errors}
+
 
 class ReplicatedBackend(PGBackend):
     """Full-copy replication across the acting set (ref:
@@ -167,6 +218,9 @@ class ReplicatedBackend(PGBackend):
         if not (1 <= self.min_live <= size):
             raise ValueError(f"min_size {self.min_live} not in [1, {size}]")
         self._init_common(pg, acting, cluster or ShardSet())
+
+    def _expected_shard_len(self, object_size: int) -> int:
+        return object_size  # every replica holds the whole object
 
     # -- write path ----------------------------------------------------------
 
@@ -359,7 +413,12 @@ class ReplicatedBackend(PGBackend):
         for s in range(self.n):
             store = self._store(s)
             cid = shard_cid(self.pg, s)
-            names = store.list_objects(cid)
+            # a replica that missed an object's last write is behind
+            # (pending replay), not corrupt — the scrubber's "missing"
+            # bucket; filter BEFORE reading so stale rows cost nothing
+            names = [n for n in store.list_objects(cid)
+                     if self.shard_applied[s]
+                     >= self.object_versions.get(n, 0)]
             by_len: dict[int, list[str]] = {}
             for n in names:
                 by_len.setdefault(store.stat(cid, n), []).append(n)

@@ -1,0 +1,191 @@
+"""mClock op scheduler — QoS-tagged dequeue for the OSD op path.
+
+Rebuild of the reference's scheduler (ref: src/osd/scheduler/
+mClockScheduler.{h,cc}, which wraps the dmclock library's
+PullPriorityQueue; op classes ref: src/osd/scheduler/OpSchedulerItem.h —
+client, background_recovery, background_best_effort, scrub...). The
+algorithm is the published mClock/dmClock tagging scheme:
+
+Each class has (reservation ρ, weight w, limit λ) in ops-per-second.
+Every enqueued op gets three tags from its class state:
+
+    R = max(now, R_prev + cost/ρ)     (reservation spacing)
+    L = max(now, L_prev + cost/λ)     (limit spacing)
+    P = max(now, P_prev) + cost/w     (proportional-share spacing)
+
+Dequeue at time `now`:
+ 1. constraint phase: among classes whose head R-tag <= now, pick the
+    smallest R-tag (reservations are met first, in tag order);
+ 2. weight phase: otherwise, among classes whose head L-tag <= now,
+    pick the smallest P-tag (spare capacity split by weight);
+ 3. else idle (every class is limit-bound).
+
+The scheduler is clock-agnostic: `dequeue(now)` takes the caller's
+time, so SimCluster drives it with virtual time and real daemons could
+drive it with wall time. Weight tags use a per-class "virtual start"
+bumped to now on idle->busy transitions so an idle class doesn't bank
+credit forever (dmclock's idle-adjustment).
+
+TPU relevance: the scheduler is the admission layer that decides WHICH
+batch the device runs next (client encode vs recovery decode vs scrub
+CRC); keeping it cost-aware keeps recovery from starving client
+latency, the exact failure mode mClock exists to prevent in the
+reference OSD.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ClientProfile:
+    """(ρ, w, λ) in ops/s; λ == 0 means unlimited (no limit phase)."""
+    reservation: float = 0.0
+    weight: float = 1.0
+    limit: float = 0.0
+
+    def __post_init__(self):
+        if self.reservation < 0 or self.weight <= 0 or self.limit < 0:
+            raise ValueError(f"bad profile {self}")
+        if self.limit and self.reservation > self.limit:
+            raise ValueError(f"reservation {self.reservation} > limit "
+                             f"{self.limit}")
+
+
+# the reference's built-in profile split (high_client_ops-ish defaults):
+# clients get a guaranteed floor and most of the weight; recovery gets a
+# floor but a ceiling too; scrub/best-effort scavenge spare capacity
+DEFAULT_PROFILES = {
+    "client": ClientProfile(reservation=50.0, weight=10.0, limit=0.0),
+    "background_recovery": ClientProfile(reservation=25.0, weight=5.0,
+                                         limit=100.0),
+    "background_best_effort": ClientProfile(reservation=0.0, weight=2.0,
+                                            limit=0.0),
+    "scrub": ClientProfile(reservation=0.0, weight=1.0, limit=50.0),
+}
+
+
+class _ClassQueue:
+    __slots__ = ("profile", "items", "r_prev", "l_prev", "p_prev",
+                 "busy")
+
+    def __init__(self, profile: ClientProfile):
+        self.profile = profile
+        self.items: list = []       # heap of (seq, item, cost) FIFO
+        self.r_prev = 0.0
+        self.l_prev = 0.0
+        self.p_prev = 0.0
+        self.busy = False
+
+
+class MClockScheduler:
+    def __init__(self, profiles: dict[str, ClientProfile] | None = None):
+        self._classes: dict[str, _ClassQueue] = {}
+        for name, prof in (profiles or DEFAULT_PROFILES).items():
+            self._classes[name] = _ClassQueue(prof)
+        self._seq = itertools.count()
+        self._len = 0
+
+    def add_class(self, name: str, profile: ClientProfile) -> None:
+        if name in self._classes:
+            raise ValueError(f"class {name!r} exists")
+        self._classes[name] = _ClassQueue(profile)
+
+    def remove_if(self, cls: str, pred) -> int:
+        """Drop queued ops of `cls` matching pred(item) — cancelled
+        work must not burn the class's limit budget as no-ops. Returns
+        the count removed."""
+        q = self._classes[cls]
+        keep = [e for e in q.items if not pred(e[1])]
+        removed = len(q.items) - len(keep)
+        if removed:
+            heapq.heapify(keep)
+            q.items = keep
+            self._len -= removed
+        return removed
+
+    def set_profile(self, name: str, profile: ClientProfile) -> None:
+        """Runtime QoS change (the reference's `ceph config set
+        osd_mclock_*` path); queued ops keep their order, tags restart
+        from the next dequeue."""
+        q = self._classes[name]
+        q.profile = profile
+        q.busy = False
+
+    def __len__(self) -> int:
+        return self._len
+
+    def enqueue(self, cls: str, item, cost: float = 1.0) -> None:
+        """cost is in 'op units' — callers scale it by bytes/ops so one
+        huge recovery batch doesn't count like one tiny client op (the
+        reference scales cost by osd_mclock_cost_per_byte)."""
+        if cost <= 0:
+            raise ValueError(f"cost {cost} <= 0")
+        q = self._classes[cls]  # KeyError for unknown class is correct
+        heapq.heappush(q.items, (next(self._seq), item, cost))
+        self._len += 1
+
+    def _head_tags(self, q: _ClassQueue, now: float):
+        """Tags the head op WOULD get if dequeued at `now`."""
+        _, _, cost = q.items[0]
+        p = q.profile
+        if not q.busy:
+            # idle->busy: tags restart from now — no banked credit, and
+            # no arrival penalty (dmclock assigns the first request
+            # R = max(now, ...) = now)
+            r_tag = now if p.reservation else float("inf")
+            l_tag = now
+            p_tag = now + cost / p.weight
+        else:
+            # R spaces from the PREVIOUS TAG, not from now: under
+            # backlog dmclock's arrival-time tags degenerate to pure
+            # spacing, so a late-served reservation keeps its credit
+            # and catches up (no drift). Idle credit is still dropped
+            # by the busy flag above.
+            r_tag = (q.r_prev + cost / p.reservation
+                     if p.reservation else float("inf"))
+            # L spaces purely too: a drain at one discrete virtual
+            # time instant may serve the whole λ*dt allotment of the
+            # elapsed window (SimCluster pumps once per tick step)
+            l_tag = (q.l_prev + cost / p.limit if p.limit else now)
+            p_tag = max(now, q.p_prev) + cost / p.weight
+        return r_tag, l_tag, p_tag
+
+    def dequeue(self, now: float):
+        """Returns (class_name, item) or None when idle/limit-bound."""
+        best_r = best_w = None
+        for name, q in self._classes.items():
+            if not q.items:
+                q.busy = False
+                continue
+            r_tag, l_tag, p_tag = self._head_tags(q, now)
+            if r_tag <= now and (best_r is None or r_tag < best_r[0]):
+                best_r = (r_tag, name, l_tag, p_tag)
+            if l_tag <= now and (best_w is None or p_tag < best_w[0]):
+                best_w = (p_tag, name, r_tag, l_tag)
+        if best_r is not None:
+            r_tag, name, l_tag, p_tag = best_r
+        elif best_w is not None:
+            p_tag, name, r_tag, l_tag = best_w
+        else:
+            return None
+        q = self._classes[name]
+        _, item, cost = heapq.heappop(q.items)
+        q.r_prev, q.l_prev, q.p_prev = r_tag, l_tag, p_tag
+        q.busy = True
+        self._len -= 1
+        return name, item
+
+    def drain(self, now: float, budget: int | None = None) -> list:
+        """Dequeue until idle/limit-bound (or budget ops); the per-tick
+        pump SimCluster uses."""
+        out = []
+        while budget is None or len(out) < budget:
+            got = self.dequeue(now)
+            if got is None:
+                break
+            out.append(got)
+        return out
