@@ -33,7 +33,77 @@ ALG_TREE = 3
 ALG_STRAW = 4
 ALG_STRAW2 = 5
 _SUPPORTED_ALGS = {"uniform": ALG_UNIFORM, "list": ALG_LIST,
+                   "tree": ALG_TREE, "straw": ALG_STRAW,
                    "straw2": ALG_STRAW2}
+ALG_NAMES = {v: k for k, v in _SUPPORTED_ALGS.items()}
+
+
+def calc_tree_nodes(weights: list[int]) -> list[int]:
+    """Tree-bucket node weights (ref: src/crush/builder.c
+    crush_make_tree_bucket / crush_calc_tree_node): items live at odd
+    node indices (item i -> node 2i+1) of an in-order-labelled binary
+    tree of num_nodes = next_pow2(2*size); internal node weight = sum
+    of its subtree. Missing leaves weigh 0 so they are never drawn."""
+    size = len(weights)
+    if size == 0:
+        return [0, 0]
+    depth = 1
+    while (1 << depth) < 2 * size:
+        depth += 1
+    num_nodes = 1 << depth
+    nodes = [0] * num_nodes
+    for i, w in enumerate(weights):
+        nodes[2 * i + 1] = int(w)
+    # fill internal nodes bottom-up: node n at height h spans
+    # [n - 2^h + 1, n + 2^h - 1]
+    for h in range(1, depth):
+        step = 1 << (h + 1)
+        first = 1 << h
+        for n in range(first, num_nodes, step):
+            nodes[n] = nodes[n - (1 << (h - 1))] + \
+                (nodes[n + (1 << (h - 1))]
+                 if n + (1 << (h - 1)) < num_nodes else 0)
+    return nodes
+
+
+def calc_straws(weights: list[int]) -> list[int]:
+    """Legacy-straw lengths (ref: src/crush/builder.c crush_calc_straw:
+    items ascending by weight; each weight tier's straw is scaled so
+    the win probability tracks the weight ratio — the approximation
+    whose known bias led to straw2). 16.16 fixed-point outputs.
+
+    NOTE: internally pinned (oracle==vector parity + monotonicity
+    tests), not byte-verified against the reference (empty mount —
+    SURVEY.md citation notice)."""
+    size = len(weights)
+    straws = [0] * size
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straw = 1.0
+    numleft = size
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        idx = order[i]
+        if weights[idx] == 0:
+            straws[idx] = 0
+            i += 1
+            numleft -= 1
+            continue
+        straws[idx] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        if weights[order[i]] == weights[order[i - 1]]:
+            continue  # same tier shares the straw length
+        wbelow += (float(weights[order[i - 1]]) - lastw) * numleft
+        numleft = sum(1 for j in range(i, size)
+                      if weights[order[j]] >= weights[order[i]])
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = float(weights[order[i - 1]])
+    return straws
 
 # rule step opcodes (crush.h CRUSH_RULE_*)
 STEP_TAKE = "take"
@@ -114,7 +184,7 @@ class CrushMap:
         if alg not in _SUPPORTED_ALGS:
             raise ValueError(
                 f"bucket alg {alg!r} unsupported (supported: "
-                f"{sorted(_SUPPORTED_ALGS)}; legacy tree/straw are not)")
+                f"{sorted(_SUPPORTED_ALGS)})")
         if weights is None:
             weights = [1.0] * len(items)
         if len(weights) != len(items):
@@ -167,6 +237,75 @@ class CrushMap:
             return 1
         return 1 + max(self.depth_below(i, seen | {item}) for i in b.items)
 
+    # -- wire form (ref: CrushWrapper::encode/decode) -----------------------
+
+    def encode(self) -> bytes:
+        """Versioned wire form (ref: src/crush/CrushWrapper encode —
+        buckets, rules, types, tunables; here via the repo's
+        utils/encoding.py section protocol)."""
+        from ..utils.encoding import Encoder
+        e = Encoder().start(1, 1)
+        e.i32(self.max_device)
+        e.boolean(self.root_id is not None)
+        if self.root_id is not None:
+            e.i32(self.root_id)
+        e.u32(self.tunables.choose_total_tries)
+        e.mapping(self.types, lambda en, k: en.i32(k),
+                  lambda en, v: en.string(v))
+        def enc_bucket(en, b: Bucket):
+            en.start(1, 1)
+            en.i32(b.id).i32(b.type_id).u8(b.alg).u8(b.hash_id)
+            en.string(b.name)
+            en.list(b.items, lambda e2, it: e2.i32(it))
+            en.list(b.weights, lambda e2, w: e2.i64(w))
+            en.finish()
+        e.list(sorted(self.buckets.values(), key=lambda b: -b.id),
+               enc_bucket)
+        def enc_rule(en, r: Rule):
+            en.start(1, 1)
+            en.i32(r.id).string(r.name)
+            def enc_step(e2, s: Step):
+                e2.string(s.op).i64(s.arg).i32(s.type_id)
+            en.list(r.steps, enc_step)
+            en.finish()
+        e.list(sorted(self.rules.values(), key=lambda r: r.id), enc_rule)
+        return e.finish().bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "CrushMap":
+        from ..utils.encoding import Decoder
+        d = Decoder(data)
+        d.start(1)
+        m = cls()
+        m.max_device = d.i32()
+        if d.boolean():
+            m.root_id = d.i32()
+        m.tunables = Tunables(choose_total_tries=d.u32())
+        m.types = d.mapping(lambda dd: dd.i32(), lambda dd: dd.string())
+        def dec_bucket(dd) -> Bucket:
+            dd.start(1)
+            b = Bucket(dd.i32(), dd.i32(), dd.u8(), hash_id=0)
+            b.hash_id = dd.u8()
+            b.name = dd.string()
+            b.items = dd.list(lambda e2: e2.i32())
+            b.weights = dd.list(lambda e2: e2.i64())
+            dd.finish()
+            return b
+        for b in d.list(dec_bucket):
+            m.buckets[b.id] = b
+        def dec_rule(dd) -> Rule:
+            dd.start(1)
+            rid, name = dd.i32(), dd.string()
+            steps = dd.list(lambda e2: Step(e2.string(), e2.i64(),
+                                            e2.i32()))
+            dd.finish()
+            return Rule(rid, steps, name)
+        for r in d.list(dec_rule):
+            m.rules[r.id] = r
+        d.finish()
+        m.validate()
+        return m
+
     # -- packing -----------------------------------------------------------
 
     def pack(self) -> "PackedMap":
@@ -208,6 +347,28 @@ class PackedMap:
             self.weights[r, :b.size] = b.weights
             self.bucket_weight[r] = b.weight
             self.sum_weights[r, :b.size] = np.cumsum(b.weights)
+        # legacy-alg aux tables, only materialized when used:
+        # tree node-weight rows (padded to the largest num_nodes) and
+        # straw lengths (16.16)
+        algs = set(int(a) for a in self.alg)
+        self.tree_nodes = None
+        self.tree_num_nodes = None
+        if ALG_TREE in algs:
+            rows = {(-1 - bid): calc_tree_nodes(b.weights)
+                    for bid, b in m.buckets.items() if b.alg == ALG_TREE}
+            mn = max(len(v) for v in rows.values())
+            self.tree_nodes = np.zeros((nrows, mn), dtype=np.int64)
+            self.tree_num_nodes = np.ones(nrows, dtype=np.int32)
+            for r, v in rows.items():
+                self.tree_nodes[r, :len(v)] = v
+                self.tree_num_nodes[r] = len(v)
+        self.straws = None
+        if ALG_STRAW in algs:
+            self.straws = np.zeros((nrows, self.max_size), dtype=np.int64)
+            for bid, b in m.buckets.items():
+                if b.alg == ALG_STRAW:
+                    r = -1 - bid
+                    self.straws[r, :b.size] = calc_straws(b.weights)
         self.max_depth = max((m.depth_below(bid) for bid in m.buckets), default=0)
         # per-alg max sizes so the mapper can bound its unrolls tightly
         self.max_size_by_alg = {}

@@ -24,13 +24,28 @@ import jax.numpy as jnp
 import numpy as np
 
 from .hash import hash32_2, hash32_3, hash32_4
-from .map import (ALG_LIST, ALG_STRAW2, ALG_UNIFORM, CRUSH_ITEM_NONE,
-                  CrushMap, STEP_CHOOSE_FIRSTN, STEP_CHOOSE_INDEP,
-                  STEP_CHOOSELEAF_FIRSTN, STEP_CHOOSELEAF_INDEP, STEP_EMIT,
-                  STEP_TAKE)
+from .map import (ALG_LIST, ALG_STRAW, ALG_STRAW2, ALG_TREE, ALG_UNIFORM,
+                  CRUSH_ITEM_NONE, CrushMap, STEP_CHOOSE_FIRSTN,
+                  STEP_CHOOSE_INDEP, STEP_CHOOSELEAF_FIRSTN,
+                  STEP_CHOOSELEAF_INDEP, STEP_EMIT, STEP_TAKE)
 from .oracle import ln16_table
 
 _NONE = np.int32(CRUSH_ITEM_NONE)
+
+
+def _mulhi32(h, w):
+    """Exact (h * w) >> 32 for uint32 operands without 64-bit ints:
+    16-bit split with carry tracking (the tree draw needs the high
+    word of a 32x32 product, like mapper.c's __u64 shift)."""
+    a, b = h >> 16, h & jnp.uint32(0xFFFF)
+    c, d = w >> 16, w & jnp.uint32(0xFFFF)
+    mid = a * d
+    s = mid + b * c
+    carry = (s < mid).astype(jnp.uint32)
+    lo = b * d
+    s2 = s + (lo >> 16)
+    carry2 = (s2 < s).astype(jnp.uint32)
+    return a * c + (s2 >> 16) + ((carry + carry2) << 16)
 
 
 class VectorMapper:
@@ -71,6 +86,17 @@ class VectorMapper:
             self.t_qlo = jnp.asarray(qlo.reshape(-1))
         self.algs_used = set(int(a) for a in np.unique(p.alg) if a != 0)
         self.S_uniform = p.max_size_by_alg.get(ALG_UNIFORM, 1)
+        if p.tree_nodes is not None:
+            # node weights capped to u32 like the reference's __u32
+            self.t_tree_nodes = jnp.asarray(
+                (p.tree_nodes & 0xFFFFFFFF).astype(np.uint32))
+            self.t_tree_nn = jnp.asarray(p.tree_num_nodes)
+            self.tree_depth = int(np.log2(p.tree_nodes.shape[1])) + 1
+        if p.straws is not None:
+            st = p.straws.astype(np.uint64)
+            self.t_straw_hi = jnp.asarray((st >> 16).astype(np.uint32))
+            self.t_straw_lo = jnp.asarray((st & 0xFFFF).astype(np.uint32))
+            self.t_straw_zero = jnp.asarray(p.straws == 0)
         self._jitted = {}
 
     # -- bucket choose (batched over lanes) ---------------------------------
@@ -165,6 +191,62 @@ class VectorMapper:
         item = jnp.take_along_axis(items, slot[:, None], axis=1)[:, 0]
         return jnp.where(self.t_size[row] > 0, item, _NONE)
 
+    def _tree(self, row, x, r):
+        """In-order binary-tree walk, all lanes in lockstep for
+        tree_depth steps (ref: mapper.c bucket_tree_choose). Terminal
+        (odd) nodes self-loop: half = lowest-set-bit(n) >> 1 is 0."""
+        nodes_b = self.t_tree_nodes[row]              # (B, MN)
+        nn = self.t_tree_nn[row]                      # (B,)
+        n = (nn >> 1).astype(jnp.int32)
+        bid = (-1 - row).astype(jnp.uint32)
+        r_b = jnp.broadcast_to(jnp.asarray(r, jnp.uint32), n.shape) \
+            if jnp.ndim(r) == 0 else r.astype(jnp.uint32)
+        root_w = jnp.take_along_axis(nodes_b, n[:, None], axis=1)[:, 0]
+
+        def walk(_i, n):
+            half = (n & -n) >> 1                      # 0 when n is odd
+            w = jnp.take_along_axis(nodes_b, n[:, None], axis=1)[:, 0]
+            h = hash32_4(x, n.astype(jnp.uint32), r_b, bid, np_like=jnp)
+            t = _mulhi32(h, w)
+            left = n - half
+            wl = jnp.take_along_axis(nodes_b, left[:, None],
+                                     axis=1)[:, 0]
+            return jnp.where(half > 0,
+                             jnp.where(t < wl, left, n + half), n)
+        # fori_loop keeps the traced program small: the walk body is
+        # emitted once, not tree_depth times per descend level
+        n = jax.lax.fori_loop(0, self.tree_depth, walk, n)
+        item = jnp.take_along_axis(self.t_items[row], (n >> 1)[:, None],
+                                   axis=1)[:, 0]
+        ok = ((n & 1) == 1) & (root_w > 0)
+        return jnp.where(ok, item, _NONE)
+
+    def _straw(self, row, x, r):
+        """Legacy straw: draw = h16 * straw (48-bit) with the replica
+        rank hashed in, first-wins max, compared as (hi, lo16) u32
+        pairs (ref: bucket_straw_choose hashes (x, item, r))."""
+        items = self.t_items[row]
+        r_b = jnp.asarray(r, jnp.uint32)
+        r_b = r_b[:, None] if r_b.ndim else r_b
+        h = hash32_3(x[:, None], items.astype(jnp.uint32), r_b,
+                     np_like=jnp)
+        h16 = h & jnp.uint32(0xFFFF)
+        slot_ok = jnp.arange(self.S)[None, :] < self.t_size[row][:, None]
+        hi = h16 * self.t_straw_hi[row] \
+            + ((h16 * self.t_straw_lo[row]) >> 16)
+        lo = (h16 * self.t_straw_lo[row]) & jnp.uint32(0xFFFF)
+        hi = jnp.where(slot_ok, hi, 0)
+        lo = jnp.where(slot_ok, lo, 0)
+        m1 = hi.max(axis=1, keepdims=True)
+        cand = hi == m1
+        lo_m = jnp.where(cand, lo, 0)
+        m2 = lo_m.max(axis=1, keepdims=True)
+        best = jnp.argmax(cand & (lo_m == m2), axis=1)  # first winner
+        item = jnp.take_along_axis(items, best[:, None], axis=1)[:, 0]
+        dead = jnp.take_along_axis(self.t_straw_zero[row], best[:, None],
+                                   axis=1)[:, 0]
+        return jnp.where((self.t_size[row] > 0) & ~dead, item, _NONE)
+
     def _bucket_choose(self, node, x, r):
         """node (B,) bucket ids (negative) -> chosen child item (B,)."""
         row = self._rows(node)
@@ -176,6 +258,10 @@ class VectorMapper:
             out = jnp.where(alg == ALG_UNIFORM, self._uniform(row, x, r), out)
         if ALG_LIST in self.algs_used:
             out = jnp.where(alg == ALG_LIST, self._list(row, x, r), out)
+        if ALG_TREE in self.algs_used:
+            out = jnp.where(alg == ALG_TREE, self._tree(row, x, r), out)
+        if ALG_STRAW in self.algs_used:
+            out = jnp.where(alg == ALG_STRAW, self._straw(row, x, r), out)
         return out
 
     # -- descent / rejection ------------------------------------------------

@@ -29,10 +29,11 @@ import functools
 import numpy as np
 
 from .hash import hash32_2, hash32_3, hash32_4
-from .map import (ALG_LIST, ALG_STRAW2, ALG_UNIFORM, CRUSH_ITEM_NONE,
-                  CrushMap, Rule, Step, STEP_CHOOSE_FIRSTN,
-                  STEP_CHOOSE_INDEP, STEP_CHOOSELEAF_FIRSTN,
-                  STEP_CHOOSELEAF_INDEP, STEP_EMIT, STEP_TAKE)
+from .map import (ALG_LIST, ALG_STRAW, ALG_STRAW2, ALG_TREE, ALG_UNIFORM,
+                  CRUSH_ITEM_NONE, CrushMap, Rule, Step,
+                  STEP_CHOOSE_FIRSTN, STEP_CHOOSE_INDEP,
+                  STEP_CHOOSELEAF_FIRSTN, STEP_CHOOSELEAF_INDEP,
+                  STEP_EMIT, STEP_TAKE, calc_straws, calc_tree_nodes)
 
 
 @functools.cache
@@ -54,6 +55,8 @@ class OracleMapper:
         self.m = m
         self.draw = draw
         self.tries = m.tunables.choose_total_tries
+        self._tree_cache: dict[int, list[int]] = {}
+        self._straw_cache: dict[int, list[int]] = {}
 
     # -- bucket choose ------------------------------------------------------
 
@@ -68,7 +71,58 @@ class OracleMapper:
                 return self._perm_choose(b, x, r)
             if b.alg == ALG_LIST:
                 return self._list_choose(b, x, r)
+            if b.alg == ALG_TREE:
+                return self._tree_choose(b, x, r)
+            if b.alg == ALG_STRAW:
+                return self._straw_choose(b, x, r)
         raise ValueError(f"unsupported bucket alg {b.alg}")
+
+    def _tree_choose(self, b, x: int, r: int) -> int:
+        """In-order binary tree walk (ref: mapper.c bucket_tree_choose):
+        at internal node n (height h = lowest set bit), draw
+        t = (hash32_4(x, n, r, id) * node_weight(n)) >> 32 and descend
+        left iff t < weight(left subtree). Leaves are odd nodes; leaf
+        2i+1 holds item i."""
+        nodes = self._tree_cache.get(b.id)
+        if nodes is None:
+            nodes = calc_tree_nodes(b.weights)
+            self._tree_cache[b.id] = nodes
+        n = len(nodes) >> 1
+        if nodes[n] == 0:
+            return CRUSH_ITEM_NONE
+        while not (n & 1):
+            h = 1
+            while not (n >> h) & 1:
+                h += 1
+            half = 1 << (h - 1)
+            w = nodes[n]
+            t = (int(hash32_4(_u32(x), _u32(n), _u32(r), _u32(b.id)))
+                 * w) >> 32
+            left = n - half
+            n = left if t < nodes[left] else n + half
+        return b.items[n >> 1]
+
+    def _straw_choose(self, b, x: int, r: int) -> int:
+        """Legacy straw draw (ref: mapper.c bucket_straw_choose):
+        draw = (hash32_3(x, item, r) & 0xffff) * straws[i], max wins,
+        first index on ties. The replica rank r MUST be hashed in or
+        every rank would draw the same winner and multi-replica straw
+        placement could never fill >1 slot."""
+        straws = self._straw_cache.get(b.id)
+        if straws is None:
+            straws = calc_straws(b.weights)
+            self._straw_cache[b.id] = straws
+        best_i = -1
+        best = -1
+        for i, item in enumerate(b.items):
+            h = int(hash32_3(_u32(x), _u32(item), _u32(r))) & 0xFFFF
+            draw = h * straws[i]
+            if draw > best:
+                best = draw
+                best_i = i
+        if best_i < 0 or straws[best_i] == 0:
+            return CRUSH_ITEM_NONE
+        return b.items[best_i]
 
     def _straw2_choose(self, b, x: int, r: int) -> int:
         if self.draw == "fixed":

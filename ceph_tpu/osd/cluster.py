@@ -636,6 +636,10 @@ class SimCluster:
             self.scrub_reports[ps] = rep
             g_log.dout("scrub", 0,
                        f"pg 1.{ps} {kind} scrub: {errs} error(s)")
+        else:
+            # a clean scrub clears any stale error report — monitoring
+            # must not show a repaired PG as inconsistent forever
+            self.scrub_reports.pop(ps, None)
 
     # -- op pump ------------------------------------------------------------
 

@@ -122,6 +122,64 @@ class OSDMap:
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
+    # -- wire form (ref: OSDMap::encode/decode) -----------------------------
+
+    def encode(self) -> bytes:
+        """Versioned wire form: epoch, crush map, per-OSD runtime state,
+        pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
+        from ..utils.encoding import Encoder
+        e = Encoder().start(1, 1)
+        e.u32(self.epoch)
+        e.blob(self.crush.encode())
+        e.list([int(w) for w in self.osd_weight],
+               lambda en, w: en.i32(w))
+        e.list([bool(u) for u in self.osd_up],
+               lambda en, u: en.boolean(u))
+        def enc_pool(en, p: PGPool):
+            en.start(1, 1)
+            en.i32(p.pool_id).u32(p.pg_num).u32(p.size).u32(p.min_size)
+            en.i32(p.crush_rule).boolean(p.is_erasure).u32(p.pgp_num)
+            en.mapping(p.ec_profile, lambda e2, k: e2.string(k),
+                       lambda e2, v: e2.string(str(v)))
+            en.finish()
+        e.list([self.pools[k] for k in sorted(self.pools)], enc_pool)
+        e.mapping(self.pg_temp,
+                  lambda en, k: en.i32(k[0]).u32(k[1]),
+                  lambda en, v: en.list(v, lambda e2, o: e2.i32(o)))
+        e.mapping(self.primary_temp,
+                  lambda en, k: en.i32(k[0]).u32(k[1]),
+                  lambda en, v: en.i32(v))
+        return e.finish().bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "OSDMap":
+        from ..utils.encoding import Decoder
+        d = Decoder(data)
+        d.start(1)
+        epoch = d.u32()
+        crush = CrushMap.decode(d.blob())
+        m = cls(crush, epoch=epoch)
+        weights = d.list(lambda dd: dd.i32())
+        ups = d.list(lambda dd: dd.boolean())
+        m.osd_weight = np.asarray(weights, dtype=np.int32)
+        m.osd_up = np.asarray(ups, dtype=bool)
+        def dec_pool(dd) -> PGPool:
+            dd.start(1)
+            p = PGPool(dd.i32(), dd.u32(), dd.u32(), dd.u32(), dd.i32(),
+                       dd.boolean(), dd.u32(),
+                       dd.mapping(lambda e2: e2.string(),
+                                  lambda e2: e2.string()))
+            dd.finish()
+            return p
+        for p in d.list(dec_pool):
+            m.pools[p.pool_id] = p
+        m.pg_temp = d.mapping(lambda dd: (dd.i32(), dd.u32()),
+                              lambda dd: dd.list(lambda e2: e2.i32()))
+        m.primary_temp = d.mapping(lambda dd: (dd.i32(), dd.u32()),
+                                   lambda dd: dd.i32())
+        d.finish()
+        return m
+
     # -- mutators (each bumps the epoch like an inc map) -------------------
 
     def _bump(self):

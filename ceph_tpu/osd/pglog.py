@@ -55,3 +55,26 @@ class PGLog:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    # -- wire form (ref: pg_log_t encode/decode) ----------------------------
+
+    def encode(self) -> bytes:
+        from ..utils.encoding import Encoder
+        e = Encoder().start(1, 1)
+        e.u32(self.max_entries).u64(self.head).u64(self.tail)
+        e.list(list(self._entries),
+               lambda en, ent: en.u64(ent[0]).string(ent[1]))
+        return e.finish().bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PGLog":
+        from ..utils.encoding import Decoder
+        d = Decoder(data)
+        d.start(1)
+        log = cls(max_entries=d.u32())
+        log.head = d.u64()
+        log.tail = d.u64()
+        for v, name in d.list(lambda dd: (dd.u64(), dd.string())):
+            log._entries.append((v, name))
+        d.finish()
+        return log

@@ -1,0 +1,202 @@
+"""Wire encoding — the bufferlist encode/decode layer.
+
+Rebuild of the reference's serialization core (ref: src/include/
+encoding.h — `encode()`/`decode()` over bufferlists, little-endian
+primitives, length-prefixed strings/containers, and the
+ENCODE_START(v, compat)/ENCODE_FINISH versioned-section protocol that
+gives every structure forward AND backward compatibility: a section
+carries (version, compat_version, length); an old reader meeting a
+newer section checks `compat <= my_version` and skips the bytes past
+what it understands; a new reader meeting an old section sees the low
+version and decodes only the fields that existed then).
+
+Everything is explicit little-endian bytes — no pickle, no struct-
+by-reflection — so the format is stable across Python versions and
+auditable on the wire, the same property the reference's hand-rolled
+encoders guarantee.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class EncodingError(ValueError):
+    pass
+
+
+class Encoder:
+    """Append-only byte builder (the `bufferlist& bl` role)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._sections: list[int] = []  # offsets of open length slots
+
+    # -- primitives ---------------------------------------------------------
+
+    def u8(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<B", v)
+        return self
+
+    def u16(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<H", v)
+        return self
+
+    def u32(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<I", v)
+        return self
+
+    def u64(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<Q", v)
+        return self
+
+    def i32(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<i", v)
+        return self
+
+    def i64(self, v: int) -> "Encoder":
+        self._buf += struct.pack("<q", v)
+        return self
+
+    def f64(self, v: float) -> "Encoder":
+        self._buf += struct.pack("<d", v)
+        return self
+
+    def boolean(self, v: bool) -> "Encoder":
+        return self.u8(1 if v else 0)
+
+    def blob(self, b: bytes) -> "Encoder":
+        self.u32(len(b))
+        self._buf += b
+        return self
+
+    def string(self, s: str) -> "Encoder":
+        return self.blob(s.encode("utf-8"))
+
+    def list(self, items, fn) -> "Encoder":
+        """u32 count + fn(self, item) each (container convention)."""
+        self.u32(len(items))
+        for it in items:
+            fn(self, it)
+        return self
+
+    def mapping(self, d: dict, kfn, vfn) -> "Encoder":
+        self.u32(len(d))
+        for k in d:
+            kfn(self, k)
+            vfn(self, d[k])
+        return self
+
+    # -- versioned sections (ENCODE_START / ENCODE_FINISH) ------------------
+
+    def start(self, version: int, compat: int) -> "Encoder":
+        if compat > version:
+            raise EncodingError(f"compat {compat} > version {version}")
+        self.u8(version).u8(compat)
+        self._sections.append(len(self._buf))
+        self.u32(0)  # length slot, patched by finish()
+        return self
+
+    def finish(self) -> "Encoder":
+        if not self._sections:
+            raise EncodingError("finish() without start()")
+        at = self._sections.pop()
+        body_len = len(self._buf) - at - 4
+        self._buf[at:at + 4] = struct.pack("<I", body_len)
+        return self
+
+    def bytes(self) -> bytes:
+        if self._sections:
+            raise EncodingError(f"{len(self._sections)} unfinished "
+                                f"section(s)")
+        return bytes(self._buf)
+
+
+class Decoder:
+    """Cursor over bytes (the `bufferlist::const_iterator` role)."""
+
+    def __init__(self, data: bytes):
+        self._buf = memoryview(bytes(data))
+        self._off = 0
+        self._ends: list[int] = []  # section end offsets
+
+    def _take(self, n: int) -> memoryview:
+        if self._off + n > len(self._buf):
+            raise EncodingError(
+                f"decode past end: need {n} at {self._off}, "
+                f"have {len(self._buf)}")
+        if self._ends and self._off + n > self._ends[-1]:
+            raise EncodingError(
+                f"decode past section end {self._ends[-1]}")
+        v = self._buf[self._off:self._off + n]
+        self._off += n
+        return v
+
+    def u8(self) -> int:
+        return struct.unpack("<B", self._take(1))[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def boolean(self) -> bool:
+        return self.u8() != 0
+
+    def blob(self) -> bytes:
+        return bytes(self._take(self.u32()))
+
+    def string(self) -> str:
+        return self.blob().decode("utf-8")
+
+    def list(self, fn) -> list:
+        return [fn(self) for _ in range(self.u32())]
+
+    def mapping(self, kfn, vfn) -> dict:
+        return {kfn(self): vfn(self) for _ in range(self.u32())}
+
+    # -- versioned sections (DECODE_START / DECODE_FINISH) ------------------
+
+    def start(self, supported: int) -> int:
+        """Open a section; returns its encoded version. Raises when the
+        writer declared we're too old to read it at all."""
+        v = self.u8()
+        compat = self.u8()
+        if compat > supported:
+            raise EncodingError(
+                f"section compat {compat} > supported {supported}: "
+                f"written by an incompatible future version")
+        length = self.u32()
+        end = self._off + length
+        if end > len(self._buf) or (self._ends and end > self._ends[-1]):
+            raise EncodingError("section length overruns buffer")
+        self._ends.append(end)
+        return v
+
+    def finish(self) -> None:
+        """Skip any trailing fields a newer writer appended."""
+        if not self._ends:
+            raise EncodingError("finish() without start()")
+        self._off = self._ends.pop()
+
+    def remaining_in_section(self) -> int:
+        if not self._ends:
+            return len(self._buf) - self._off
+        return self._ends[-1] - self._off
+
+    @property
+    def offset(self) -> int:
+        return self._off

@@ -69,7 +69,7 @@ def test_bad_maps_rejected():
     with pytest.raises(ValueError):
         m.add_bucket(1, 1, "straw2", [0])     # positive id
     with pytest.raises(ValueError):
-        m.add_bucket(-1, 1, "tree", [0])      # unsupported alg
+        m.add_bucket(-1, 1, "quantum", [0])   # unsupported alg
     m.add_bucket(-1, 1, "straw2", [0, -5])    # dangling ref
     with pytest.raises(ValueError):
         m.validate()
@@ -77,7 +77,8 @@ def test_bad_maps_rejected():
 
 # ----------------------------------------------------- oracle vs vectorized
 
-@pytest.mark.parametrize("alg", ["straw2", "uniform", "list"])
+@pytest.mark.parametrize("alg", ["straw2", "uniform", "list", "tree",
+                                 "straw"])
 @pytest.mark.parametrize("rule_id,n", [(0, 3), (1, 4)])
 def test_parity_oracle_vs_vectorized(alg, rule_id, n):
     m = make_map(32, 4, 4, alg=alg)
@@ -362,3 +363,66 @@ class TestFixedPointDraw:
             assert int(A[u]) == (1 << 48) - ln44(int(u) + 1), u
         assert int(A[0xFFFF]) == 0
         assert int(A[0]) == 1 << 48
+
+
+# ------------------------------------------------- legacy buckets (tree/straw)
+
+def test_calc_tree_nodes_structure():
+    from ceph_tpu.crush.map import calc_tree_nodes
+    nodes = calc_tree_nodes([0x10000, 0x20000, 0x30000])
+    # 3 items -> 8 nodes; leaves at 1,3,5; internal sums
+    assert len(nodes) == 8
+    assert nodes[1] == 0x10000 and nodes[3] == 0x20000
+    assert nodes[5] == 0x30000 and nodes[7] == 0
+    assert nodes[2] == 0x30000          # 1+3
+    assert nodes[6] == 0x30000          # 5+7
+    assert nodes[4] == 0x60000          # root
+
+def test_calc_straws_monotone_in_weight():
+    from ceph_tpu.crush.map import calc_straws
+    ws = [0x8000, 0x10000, 0x20000, 0x20000, 0x40000]
+    st = calc_straws(ws)
+    assert st[2] == st[3]               # equal weights, equal straws
+    assert st[0] < st[1] < st[2] < st[4]
+    assert all(s > 0 for s in st)
+    assert calc_straws([0, 0x10000])[0] == 0  # zero weight -> zero straw
+
+@pytest.mark.parametrize("alg", ["tree", "straw"])
+def test_legacy_bucket_weight_proportionality(alg):
+    # one bucket, skewed weights: selection frequency tracks weight
+    m = CrushMap()
+    m.add_type(1, "host")
+    weights = [1.0, 1.0, 2.0, 4.0]
+    m.add_bucket(-1, 1, alg, [0, 1, 2, 3], weights, name="b")
+    m.root_id = -1
+    om = OracleMapper(m)
+    counts = np.zeros(4)
+    for x in range(4000):
+        it = om.bucket_choose(-1, x, 0)
+        counts[it] += 1
+    freq = counts / counts.sum()
+    want = np.asarray(weights) / sum(weights)
+    assert np.abs(freq - want).max() < 0.05, (alg, freq, want)
+
+def test_legacy_algs_wire_roundtrip_parity():
+    m = make_map(32, 4, 4, alg="tree")
+    m2 = CrushMap.decode(m.encode())
+    om, vm = OracleMapper(m), VectorMapper(m2)
+    weights = full_weights(32)
+    xs = np.arange(48, dtype=np.uint32)
+    got = np.asarray(vm.do_rule(1, xs, weights, 4))
+    for i, x in enumerate(xs):
+        want = om.do_rule(1, int(x), weights, 4)
+        want = (want + [CRUSH_ITEM_NONE] * 4)[:4]
+        assert got[i].tolist() == want
+
+def test_straw_fills_all_replica_slots():
+    # regression: the draw must hash the replica rank r, or every rank
+    # picks the same child and num_rep>1 can never fill
+    m = make_map(32, 4, 4, alg="straw")
+    om = OracleMapper(m)
+    w = full_weights(32)
+    for x in range(20):
+        got = om.do_rule(0, x, w, 3)
+        real = [g for g in got if g != CRUSH_ITEM_NONE]
+        assert len(real) == 3 and len(set(real)) == 3, (x, got)

@@ -1,0 +1,128 @@
+"""CRUSH text compile/decompile round-trip (ref: src/crush/
+CrushCompiler.cc; crushtool -c / -d workflows)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.compiler import CompileError, compile_text, decompile
+from ceph_tpu.crush.map import (CrushMap, Tunables, build_hierarchy,
+                                ec_rule, replicated_rule)
+from ceph_tpu.crush.mapper import VectorMapper, full_weights
+
+
+def built_map(alg="straw2"):
+    m = build_hierarchy(24, osds_per_host=3, hosts_per_rack=4, alg=alg)
+    m.tunables = Tunables(choose_total_tries=19)
+    replicated_rule(m, 0, choose_type=1, firstn=True)
+    ec_rule(m, 1, choose_type=1)
+    return m
+
+
+@pytest.mark.parametrize("alg", ["straw2", "tree", "straw", "list"])
+def test_roundtrip_places_identically(alg):
+    m = built_map(alg)
+    m2 = compile_text(decompile(m))
+    assert m2.tunables.choose_total_tries == 19
+    assert m2.root_id == m.root_id
+    w = full_weights(24)
+    xs = np.arange(300, dtype=np.uint32)
+    for rule in (0, 1):
+        a = np.asarray(VectorMapper(m).do_rule(rule, xs, w, 4))
+        b = np.asarray(VectorMapper(m2).do_rule(rule, xs, w, 4))
+        assert np.array_equal(a, b), alg
+
+
+def test_text_is_stable_fixpoint():
+    m = built_map()
+    t1 = decompile(m)
+    t2 = decompile(compile_text(t1))
+    assert t1 == t2
+
+
+def test_handwritten_map_compiles():
+    text = """
+# comment
+tunable choose_total_tries 13
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+type 0 osd
+type 1 host
+type 2 root
+host ha {
+    id -1
+    alg straw2
+    hash 0
+    item osd.0 weight 1.000
+    item osd.1 weight 2.000
+}
+host hb {
+    id -2
+    alg straw2
+    hash 0
+    item osd.2 weight 1.000
+    item osd.3 weight 1.000
+}
+root default {
+    id -3
+    alg straw2
+    hash 0
+    item ha weight 3.000
+    item hb weight 2.000
+}
+rule data {
+    id 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+"""
+    m = compile_text(text)
+    assert m.tunables.choose_total_tries == 13
+    assert m.root_id == -3
+    assert m.buckets[-1].weights == [0x10000, 0x20000]
+    got = np.asarray(VectorMapper(m).do_rule(0, np.arange(200,
+                                                          dtype=np.uint32),
+                                             full_weights(4), 2))
+    # two replicas on distinct hosts
+    hosts = np.where(got < 2, 0, 1)
+    assert (hosts[:, 0] != hosts[:, 1]).all()
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("bogus directive", "unknown directive"),
+    ("type 1 host\nhost h {\n id -1\n}", "no alg"),
+    ("type 1 host\nhost h {\n alg straw2\n}", "no id"),
+    ("type 1 host\nhost h {\n id -1\n alg warp\n}", "unknown alg"),
+    ("rule r {\n id 0\n step emit\n}", "must start with take"),
+    ("type 1 host\nhost h {\n id -1\n alg straw2\n item nope\n}",
+     "unknown item"),
+])
+def test_bad_text_rejected(bad, msg):
+    with pytest.raises((CompileError, ValueError), match=msg):
+        compile_text(bad)
+
+
+def test_cli_compile_decompile_roundtrip(tmp_path):
+    m = built_map()
+    txt = tmp_path / "map.txt"
+    txt.write_text(decompile(m))
+    binf = tmp_path / "map.bin"
+    r = subprocess.run(
+        [sys.executable, "tools/crushtool.py", "-c", str(txt),
+         "-o", str(binf)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert binf.exists()
+    r2 = subprocess.run(
+        [sys.executable, "tools/crushtool.py", "-d", str(binf)],
+        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 0, r2.stderr
+    assert r2.stdout == decompile(m)

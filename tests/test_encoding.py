@@ -1,0 +1,162 @@
+"""Wire-encoding tests: primitive round-trips, the versioned-section
+forward/backward-compat protocol, and the CrushMap/OSDMap/PGLog wire
+forms (ref: src/include/encoding.h ENCODE_START/DECODE_FINISH
+semantics; OSDMap/CrushWrapper/pg_log_t encode)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.map import (CrushMap, Step, STEP_CHOOSELEAF_INDEP,
+                                STEP_EMIT, STEP_TAKE, build_hierarchy,
+                                ec_rule)
+from ceph_tpu.osd.osdmap import OSDMap, PGPool
+from ceph_tpu.osd.pglog import PGLog
+from ceph_tpu.utils.encoding import Decoder, Encoder, EncodingError
+
+
+class TestPrimitives:
+    def test_roundtrip_all(self):
+        e = (Encoder().u8(7).u16(65535).u32(1 << 31).u64(1 << 63)
+             .i32(-5).i64(-(1 << 40)).f64(2.5).boolean(True)
+             .string("héllo").blob(b"\x00\xff"))
+        e.list([1, 2, 3], lambda en, v: en.u32(v))
+        e.mapping({"a": 1}, lambda en, k: en.string(k),
+                  lambda en, v: en.u32(v))
+        d = Decoder(e.bytes())
+        assert d.u8() == 7
+        assert d.u16() == 65535
+        assert d.u32() == 1 << 31
+        assert d.u64() == 1 << 63
+        assert d.i32() == -5
+        assert d.i64() == -(1 << 40)
+        assert d.f64() == 2.5
+        assert d.boolean() is True
+        assert d.string() == "héllo"
+        assert d.blob() == b"\x00\xff"
+        assert d.list(lambda dd: dd.u32()) == [1, 2, 3]
+        assert d.mapping(lambda dd: dd.string(),
+                         lambda dd: dd.u32()) == {"a": 1}
+
+    def test_decode_past_end_raises(self):
+        d = Decoder(Encoder().u16(1).bytes())
+        d.u8()
+        d.u8()
+        with pytest.raises(EncodingError):
+            d.u8()
+
+    def test_unfinished_section_refuses_bytes(self):
+        e = Encoder().start(1, 1).u8(1)
+        with pytest.raises(EncodingError):
+            e.bytes()
+
+
+class TestVersionedSections:
+    def test_old_reader_skips_new_fields(self):
+        # v2 writer appends a field; v1 reader must skip it cleanly
+        # and decode what follows the section
+        e = Encoder()
+        e.start(2, 1).u32(42).string("new-in-v2").finish()
+        e.u32(99)  # field after the section
+        d = Decoder(e.bytes())
+        v = d.start(1)  # reader only understands v1
+        assert v == 2
+        assert d.u32() == 42
+        d.finish()      # skips "new-in-v2"
+        assert d.u32() == 99
+
+    def test_incompatible_compat_raises(self):
+        e = Encoder().start(5, 3).u32(1).finish()
+        d = Decoder(e.bytes())
+        with pytest.raises(EncodingError, match="incompatible"):
+            d.start(2)  # reader v2 < compat 3
+
+    def test_reader_cannot_overrun_section(self):
+        e = Encoder().start(1, 1).u32(1).finish().u64(7)
+        d = Decoder(e.bytes())
+        d.start(1)
+        d.u32()
+        with pytest.raises(EncodingError):
+            d.u32()  # would cross section boundary into the u64
+
+    def test_nested_sections(self):
+        e = Encoder().start(1, 1)
+        e.start(3, 1).u8(9).string("inner-extra").finish()
+        e.u8(5)
+        e.finish()
+        d = Decoder(e.bytes())
+        d.start(1)
+        assert d.start(1) == 3
+        assert d.u8() == 9
+        d.finish()
+        assert d.u8() == 5
+        d.finish()
+
+
+class TestWireForms:
+    def test_crushmap_roundtrip_same_placements(self):
+        m = build_hierarchy(64, osds_per_host=4, hosts_per_rack=4)
+        ec_rule(m, 1, choose_type=1)
+        m2 = CrushMap.decode(m.encode())
+        assert m2.encode() == m.encode()  # canonical bytes
+        from ceph_tpu.crush.mapper import VectorMapper, full_weights
+        w = full_weights(64)
+        xs = np.arange(500, dtype=np.uint32)
+        a = np.asarray(VectorMapper(m).do_rule(1, xs, w, 6))
+        b = np.asarray(VectorMapper(m2).do_rule(1, xs, w, 6))
+        assert np.array_equal(a, b)
+
+    def test_crushmap_rejects_corrupt(self):
+        m = build_hierarchy(8, osds_per_host=2, hosts_per_rack=2)
+        raw = bytearray(m.encode())
+        raw[2] = 0xFF  # clobber the section length
+        with pytest.raises(EncodingError):
+            CrushMap.decode(bytes(raw))
+
+    def test_osdmap_roundtrip(self):
+        m = build_hierarchy(16, osds_per_host=2, hosts_per_rack=4)
+        ec_rule(m, 1, choose_type=1)
+        om = OSDMap(m)
+        om.add_pool(PGPool(1, pg_num=8, size=6, min_size=4,
+                           crush_rule=1, is_erasure=True,
+                           ec_profile={"k": "4", "m": "2"}))
+        om.mark_down(3)
+        om.mark_out(3)
+        om.set_pg_temp((1, 2), [5, 6, 7, 8, 9, 10])
+        om.set_primary_temp((1, 2), 6)
+        om2 = OSDMap.decode(om.encode())
+        assert om2.epoch == om.epoch
+        assert np.array_equal(om2.osd_weight, om.osd_weight)
+        assert np.array_equal(om2.osd_up, om.osd_up)
+        assert om2.pools[1].ec_profile == {"k": "4", "m": "2"}
+        assert om2.pg_temp == om.pg_temp
+        assert om2.primary_temp == om.primary_temp
+        # identical placement behavior (pg_temp override included)
+        for ps in range(8):
+            assert (om.pg_to_up_acting_osds(1, ps)
+                    == om2.pg_to_up_acting_osds(1, ps))
+
+    def test_pglog_roundtrip_preserves_missing_semantics(self):
+        log = PGLog(max_entries=4)
+        for n in ["a", "b", "c", "a", "d", "e", "f"]:
+            log.append(n)
+        log2 = PGLog.decode(log.encode())
+        assert log2.head == log.head and log2.tail == log.tail
+        for v in range(log.head + 1):
+            assert log2.missing_since(v) == log.missing_since(v)
+
+    def test_rule_step_program_survives(self):
+        m = CrushMap()
+        m.add_type(1, "host")
+        m.add_bucket(-2, 1, "straw2", [0, 1], name="h0")
+        m.add_bucket(-1, 2, "straw2", [-2], name="root")
+        m.root_id = -1
+        m.add_rule(3, [Step(STEP_TAKE, arg=-1),
+                       Step(STEP_CHOOSELEAF_INDEP, arg=0, type_id=1),
+                       Step(STEP_EMIT)], name="custom")
+        m2 = CrushMap.decode(m.encode())
+        r = m2.rules[3]
+        assert r.name == "custom"
+        assert [s.op for s in r.steps] == [STEP_TAKE,
+                                           STEP_CHOOSELEAF_INDEP,
+                                           STEP_EMIT]
+        assert r.steps[0].arg == -1

@@ -35,10 +35,21 @@ def parse_args(argv=None):
     ap.add_argument("--osds-per-host", type=int, default=8)
     ap.add_argument("--hosts-per-rack", type=int, default=16)
     ap.add_argument("--alg", default="straw2",
-                    choices=["straw2", "uniform", "list"])
+                    choices=["straw2", "uniform", "list", "tree", "straw"])
+    ap.add_argument("-d", "--decompile", metavar="MAPFILE",
+                    help="decompile a binary map file to text "
+                         "(use with --build to decompile the built map)")
+    ap.add_argument("-c", "--compile", dest="compile_txt", metavar="TXTFILE",
+                    help="compile a text map file (use as the test map)")
+    ap.add_argument("-o", "--outfn", metavar="OUT",
+                    help="write binary map / text output here "
+                         "(default stdout for text)")
     ap.add_argument("--test", action="store_true", help="run a placement test")
     ap.add_argument("--rule", default="replicated",
-                    choices=["replicated", "ec"])
+                    help="rule to test: 'replicated', 'ec', or a rule "
+                         "name from a compiled map")
+    ap.add_argument("--rule-id", type=int, default=None,
+                    help="test this exact rule id (compiled maps)")
     ap.add_argument("--num-rep", type=int, default=3)
     ap.add_argument("--min-x", type=int, default=0)
     ap.add_argument("--max-x", type=int, default=1024)
@@ -60,19 +71,83 @@ def parse_args(argv=None):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
-    if not args.build:
-        raise SystemExit("only --build topologies supported (use --build)")
-    from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, Tunables,
+    from ceph_tpu.crush.compiler import compile_text, decompile
+    from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, CrushMap, Tunables,
                                     build_hierarchy, ec_rule,
                                     replicated_rule)
     from ceph_tpu.crush.mapper import VectorMapper, full_weights
 
-    m = build_hierarchy(args.num_osds, args.osds_per_host,
-                        args.hosts_per_rack, alg=args.alg)
-    m.tunables = Tunables(choose_total_tries=args.tries)
-    replicated_rule(m, 0, choose_type=1, firstn=True)
-    ec_rule(m, 1, choose_type=1)
-    rule_id = 0 if args.rule == "replicated" else 1
+    from ceph_tpu.crush.compiler import CompileError
+    from ceph_tpu.utils.encoding import EncodingError
+
+    if args.decompile:
+        # binary wire form -> editable text (crushtool -d)
+        with open(args.decompile, "rb") as f:
+            try:
+                m = CrushMap.decode(f.read())
+            except (EncodingError, ValueError) as e:
+                raise SystemExit(
+                    f"crushtool: {args.decompile}: not a crush map "
+                    f"({e})")
+        text = decompile(m)
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return
+
+    if args.compile_txt:
+        # text -> map (crushtool -c); -o writes the binary wire form;
+        # --test runs placements against the compiled map
+        with open(args.compile_txt) as f:
+            try:
+                m = compile_text(f.read())
+            except (CompileError, ValueError) as e:
+                raise SystemExit(f"crushtool: {args.compile_txt}: {e}")
+        if args.outfn:
+            with open(args.outfn, "wb") as f:
+                f.write(m.encode())
+            print(f"wrote {args.outfn} ({len(m.buckets)} buckets, "
+                  f"{len(m.rules)} rules)")
+        # pick the test rule: --rule-id wins; a single-rule map is
+        # unambiguous; otherwise match --rule against rule names
+        rules = sorted(m.rules)
+        if args.rule_id is not None:
+            if args.rule_id not in m.rules:
+                raise SystemExit(
+                    f"crushtool: no rule id {args.rule_id} "
+                    f"(map has {rules})")
+            rule_id = args.rule_id
+        elif len(rules) == 1:
+            rule_id = rules[0]
+        else:
+            by_name = {r.name: rid for rid, r in m.rules.items()}
+            if args.rule in by_name:
+                rule_id = by_name[args.rule]
+            elif args.rule == "replicated" and 0 in m.rules:
+                rule_id = 0
+            elif args.rule == "ec" and 1 in m.rules:
+                rule_id = 1
+            else:
+                raise SystemExit(
+                    f"crushtool: ambiguous rule; pass --rule-id "
+                    f"(map has ids {rules}, names "
+                    f"{sorted(by_name)})")
+        args.num_osds = m.n_devices
+    elif args.build:
+        m = build_hierarchy(args.num_osds, args.osds_per_host,
+                            args.hosts_per_rack, alg=args.alg)
+        m.tunables = Tunables(choose_total_tries=args.tries)
+        replicated_rule(m, 0, choose_type=1, firstn=True)
+        ec_rule(m, 1, choose_type=1)
+        if args.outfn:
+            with open(args.outfn, "wb") as f:
+                f.write(m.encode())
+            print(f"wrote {args.outfn}")
+        rule_id = 0 if args.rule == "replicated" else 1
+    else:
+        raise SystemExit("need --build, --compile, or --decompile")
 
     if not args.test:
         print(f"built map: {args.num_osds} osds, "
