@@ -169,12 +169,20 @@ def compile_text(text: str) -> CrushMap:
                 elif bw[0] in ("min_size", "max_size"):
                     pass  # legacy fields, accepted and ignored
                 elif bw[0] == "step":
+                    if len(bw) < 2:
+                        err(bln, "bare 'step'")
                     if bw[1] == "take":
+                        # reject qualifiers we don't implement (e.g.
+                        # 'class ssd') rather than silently dropping a
+                        # placement constraint
+                        if len(bw) != 3:
+                            err(bln, "step take <bucketname> (device-"
+                                     "class qualifiers unsupported)")
                         steps.append(Step(STEP_TAKE,
                                           arg=resolve_item(bln, bw[2])))
                     elif bw[1] == "emit":
                         steps.append(Step(STEP_EMIT))
-                    elif (bw[1], bw[2]) in _CHOOSE_OPS:
+                    elif len(bw) >= 3 and (bw[1], bw[2]) in _CHOOSE_OPS:
                         if len(bw) != 6 or bw[4] != "type":
                             err(bln, "step choose* <firstn|indep> <n> "
                                      "type <typename>")

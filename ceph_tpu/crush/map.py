@@ -12,10 +12,11 @@ vectorized JAX mapper consumes. Bucket ids are negative (devices are
 non-negative), exactly the reference's convention; internally a bucket
 id b maps to row (-1 - b).
 
-Supported bucket algs: uniform, list, straw2 (the modern default).
-tree and original-straw are legacy (straw2 replaced straw in Hammer;
-tree was never common) and are rejected at build time with a clear
-error rather than silently mis-placing.
+Supported bucket algs: uniform, list, straw2 (the modern default),
+plus the legacy tree and original-straw buckets (straw2 replaced straw
+in Hammer) — calc_tree_nodes/calc_straws below hold their build-time
+aux tables, and both mappers implement their draws with pinned
+oracle==vector parity.
 """
 
 from __future__ import annotations
@@ -53,16 +54,19 @@ def calc_tree_nodes(weights: list[int]) -> list[int]:
     num_nodes = 1 << depth
     nodes = [0] * num_nodes
     for i, w in enumerate(weights):
-        nodes[2 * i + 1] = int(w)
+        nodes[2 * i + 1] = int(w) & 0xFFFFFFFF
     # fill internal nodes bottom-up: node n at height h spans
-    # [n - 2^h + 1, n + 2^h - 1]
+    # [n - 2^h + 1, n + 2^h - 1]. Sums wrap mod 2^32 — the reference
+    # stores node_weights as __u32, so both mappers must share the
+    # same wraparound or oracle==vector parity breaks on huge buckets.
     for h in range(1, depth):
         step = 1 << (h + 1)
         first = 1 << h
         for n in range(first, num_nodes, step):
-            nodes[n] = nodes[n - (1 << (h - 1))] + \
-                (nodes[n + (1 << (h - 1))]
-                 if n + (1 << (h - 1)) < num_nodes else 0)
+            nodes[n] = (nodes[n - (1 << (h - 1))] +
+                        (nodes[n + (1 << (h - 1))]
+                         if n + (1 << (h - 1)) < num_nodes else 0)) \
+                & 0xFFFFFFFF
     return nodes
 
 

@@ -87,9 +87,10 @@ class VectorMapper:
         self.algs_used = set(int(a) for a in np.unique(p.alg) if a != 0)
         self.S_uniform = p.max_size_by_alg.get(ALG_UNIFORM, 1)
         if p.tree_nodes is not None:
-            # node weights capped to u32 like the reference's __u32
+            # calc_tree_nodes already wraps mod 2^32 (__u32 parity
+            # with the oracle); the cast is lossless
             self.t_tree_nodes = jnp.asarray(
-                (p.tree_nodes & 0xFFFFFFFF).astype(np.uint32))
+                p.tree_nodes.astype(np.uint32))
             self.t_tree_nn = jnp.asarray(p.tree_num_nodes)
             self.tree_depth = int(np.log2(p.tree_nodes.shape[1])) + 1
         if p.straws is not None:

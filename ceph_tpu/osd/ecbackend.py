@@ -42,6 +42,7 @@ import numpy as np
 
 from ..ec.interface import ErasureCode
 from ..ec.registry import factory
+from ..utils.tracing import span
 from .memstore import MemStore, Transaction
 from .pgbackend import HINFO_KEY, PGBackend, shard_cid  # noqa: F401
 from .stripe import HashInfo, StripeInfo, as_flat_u8
@@ -584,10 +585,12 @@ class ECBackend(PGBackend):
                                         crcs, sl, counters)
                 continue
             # fused path: stage, launch async, fetch one batch behind
-            stack, exp = self._gather_helper_stack(helper, subgroup, sl,
-                                                   verify_hinfo)
-            handles = self._fused_recover_fn(dec_fn, sl,
-                                             verify_hinfo)(stack, exp)
+            with span("ecbackend.recover.stage"):
+                stack, exp = self._gather_helper_stack(
+                    helper, subgroup, sl, verify_hinfo)
+            with span("ecbackend.recover.launch"):
+                handles = self._fused_recover_fn(dec_fn, sl,
+                                                 verify_hinfo)(stack, exp)
             pending.append((sl, subgroup, handles))
             if len(pending) >= 2:
                 complete(pending.pop(0))

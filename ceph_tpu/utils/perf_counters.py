@@ -160,6 +160,55 @@ class PerfCountersCollection:
     def dump_json(self) -> str:
         return json.dumps(self.dump(), sort_keys=True)
 
+    def prometheus_text(self, prefix: str = "ceph_tpu") -> str:
+        """Prometheus exposition format over every registered logger —
+        the role of the mgr prometheus module's scrape endpoint (ref:
+        src/pybind/mgr/prometheus/module.py: counters become
+        `<prefix>_<logger>_<key>` with HELP/TYPE headers; time_avg
+        maps to a summary's _sum/_count pair; histograms emit one
+        `_bucket{le=...}` series per slot)."""
+        def clean(s: str) -> str:
+            return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                           for ch in s)
+        lines: list[str] = []
+        with self._lock:
+            loggers = dict(self._loggers)
+        for lname in sorted(loggers):
+            pc = loggers[lname]
+            with pc._lock:
+                items = {k: (c.kind, c.description, c.value, c.sum_s,
+                             c.count, list(c.buckets))
+                         for k, c in pc._c.items()}
+            for key in sorted(items):
+                kind, desc, value, sum_s, count, buckets = items[key]
+                metric = f"{clean(prefix)}_{clean(lname)}_{clean(key)}"
+                if desc:
+                    lines.append(f"# HELP {metric} {desc}")
+                # full precision: %g truncates to 6 significant digits,
+                # which corrupts counters past ~1e6
+                val = (str(int(value)) if float(value).is_integer()
+                       else repr(float(value)))
+                if kind == "counter":
+                    lines.append(f"# TYPE {metric} counter")
+                    lines.append(f"{metric} {val}")
+                elif kind == "gauge":
+                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(f"{metric} {val}")
+                elif kind == "time_avg":
+                    lines.append(f"# TYPE {metric} summary")
+                    lines.append(f"{metric}_sum {sum_s!r}")
+                    lines.append(f"{metric}_count {count}")
+                elif kind == "histogram":
+                    lines.append(f"# TYPE {metric} histogram")
+                    total = 0
+                    for i, b in enumerate(buckets):
+                        total += b
+                        lines.append(
+                            f'{metric}_bucket{{le="{i}"}} {total}')
+                    lines.append(f'{metric}_bucket{{le="+Inf"}} {total}')
+                    lines.append(f"{metric}_count {total}")
+        return "\n".join(lines) + "\n"
+
 
 # the default process-wide collection (role of CephContext's collection)
 g_perf_counters = PerfCountersCollection()

@@ -1,0 +1,87 @@
+"""Tracing — named spans bridging to jax.profiler.
+
+Rebuild of the reference's tracepoint layer (ref: src/tracing/*.tp
+LTTng tracepoints + src/common/tracer.cc Jaeger/OpenTelemetry spans,
+compiled in behind WITH_LTTNG/WITH_JAEGER and cheap no-ops otherwise).
+Here the trace sink is the XLA profiler: a `span("name")` shows up in
+a jax.profiler trace (TensorBoard / xprof) alongside the device
+timeline, which is the TPU-native way to answer "which host stage
+stalled the launch pipeline" — the question LTTng answers for the
+reference's op path.
+
+Spans degrade to near-zero-cost no-ops when profiling is off, exactly
+like compiled-out tracepoints; they also time into an optional
+PerfCounters time_avg key so production counters and profiler traces
+come from the SAME instrumentation points (the reference does this
+double-duty with OpTracker + tracepoints).
+
+Usage:
+    with span("ecbackend.recover.batch"):
+        ...
+    with span("osd.op", counters=perf, key="op_latency"):
+        ...
+    start_trace("/tmp/trace")   # capture; view in tensorboard/xprof
+    ...
+    stop_trace()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+def _annotation(name: str):
+    """jax.profiler.TraceAnnotation when jax is importable, else None.
+    Imported lazily so pure-host users never pay for jax import."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # pragma: no cover - jax is baked into this image
+        return None
+    return TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def span(name: str, counters=None, key: str | None = None):
+    """Named span: visible in jax.profiler traces; optionally tincs
+    `counters[key]` (a time_avg) with the wall duration."""
+    ann = _annotation(name)
+    t0 = time.perf_counter() if counters is not None else 0.0
+    if ann is not None:
+        with ann:
+            yield
+    else:
+        yield
+    if counters is not None and key is not None:
+        counters.tinc(key, time.perf_counter() - t0)
+
+
+def start_trace(log_dir: str) -> bool:
+    """Begin a jax.profiler capture (the 'enable tracing' admin-socket
+    toggle). Returns False when the profiler is unavailable."""
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        return True
+    except Exception:
+        return False
+
+
+def stop_trace() -> bool:
+    try:
+        import jax
+        jax.profiler.stop_trace()
+        return True
+    except Exception:
+        return False
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a whole block: `with trace("/tmp/tr"): run_workload()`."""
+    ok = start_trace(log_dir)
+    try:
+        yield ok
+    finally:
+        if ok:
+            stop_trace()

@@ -110,6 +110,8 @@ def main(argv=None) -> None:
                 f.write(m.encode())
             print(f"wrote {args.outfn} ({len(m.buckets)} buckets, "
                   f"{len(m.rules)} rules)")
+        if not args.test:
+            return
         # pick the test rule: --rule-id wins; a single-rule map is
         # unambiguous; otherwise match --rule against rule names
         rules = sorted(m.rules)
