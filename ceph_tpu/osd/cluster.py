@@ -254,6 +254,16 @@ class SimCluster:
         if not self.alive[target_osd]:
             raise StaleMap(self.osdmap.epoch,
                            f"osd.{target_osd} is not answering")
+        # a PG that peered down/incomplete blocks I/O entirely (the
+        # reference parks ops on a waiting list; our client retries
+        # until a revive makes the PG serviceable again)
+        from .peering import peer
+        res = peer(self.pgs[ps], self.alive,
+                   backfilling=ps in self.backfills,
+                   compute_missing=False)
+        if not res.serviceable:
+            raise StaleMap(self.osdmap.epoch,
+                           f"pg 1.{ps} is {res.state}; op parked")
         dead = {o for o in range(len(self.alive)) if not self.alive[o]}
         if kind in ("write", "write_ranges"):
             self._apply_write(ps, kind, payload, dead)
@@ -308,27 +318,25 @@ class SimCluster:
             self._repeer_all()
 
     def _catch_up_all(self) -> None:
-        """Replay the PG-log delta into every behind shard whose OSD is
-        alive (ref: PeeringState GetMissing -> log-based recovery).
-        Shards whose PGs lack enough caught-up live peers stay deferred
-        (the reference's down/incomplete PG state) and retry on the next
-        revive."""
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        """Re-peer every PG (GetInfo -> GetLog -> GetMissing via
+        peering.peer) and execute the resulting per-shard missing plan:
+        behind live shards replay the log delta, log-trimmed shards get
+        a full rebuild. Shards whose PGs lack enough caught-up live
+        peers stay deferred (the down/incomplete PG state) and retry on
+        the next revive."""
+        from .peering import BACKFILL, peer
         for ps in range(self.pg_num):
             be = self.pgs[ps]
-            for slot, o in enumerate(be.acting):
-                if o in dead or be.shard_applied[slot] >= be.pg_log.head:
-                    continue
-                missed = be.pg_log.missing_since(be.shard_applied[slot])
-                backfill = missed is None
-                if backfill:
-                    # log trimmed past the cursor: full rebuild
-                    missed = sorted(be.object_sizes)
+            res = peer(be, self.alive, backfilling=ps in self.backfills)
+            for slot, plan in sorted(res.missing.items()):
+                o = be.acting[slot]
+                backfill = plan == BACKFILL
+                missed = sorted(be.object_sizes) if backfill else plan
                 if not missed:
                     be.shard_applied[slot] = be.pg_log.head
                     continue
-                exclude = {s for s, oo in enumerate(be.acting)
-                           if s != slot and oo in dead}
+                exclude = {i.slot for i in res.infos
+                           if i.slot != slot and not i.alive}
                 try:
                     counters = be.recover_shards(
                         [slot], replacement_osds={slot: o}, names=missed,
@@ -656,27 +664,31 @@ class SimCluster:
 
     # -- health -------------------------------------------------------------
 
+    def pg_state(self, ps: int) -> str:
+        """Current pg_state string from a fresh peering pass (the
+        `ceph pg stat` view)."""
+        from .peering import peer
+        return peer(self.pgs[ps], self.alive,
+                    backfilling=ps in self.backfills,
+                    compute_missing=False).state
+
     def health(self) -> dict:
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
-        degraded = active_clean = undersized = 0
-        for ps in range(self.pg_num):
-            acting = self.pgs[ps].acting
-            holes = sum(1 for o in acting if o == CRUSH_ITEM_NONE)
-            dead_in_pg = sum(1 for o in acting if o in dead)
-            if holes:
-                undersized += 1
-            elif dead_in_pg:
-                degraded += 1
-            elif ps not in self.backfills:
-                active_clean += 1
+        states = {ps: self.pg_state(ps) for ps in range(self.pg_num)}
         return {
             "epoch": self.osdmap.epoch,
             "osds_up": int(self.osdmap.osd_up.sum()),
             "osds_alive": int(self.alive.sum()),
-            "pgs_active_clean": active_clean,
-            "pgs_degraded": degraded,
-            "pgs_undersized": undersized,
+            "pgs_active_clean": sum(
+                1 for s in states.values() if s == "active+clean"),
+            "pgs_degraded": sum(
+                1 for s in states.values() if "degraded" in s),
+            "pgs_undersized": sum(
+                1 for s in states.values() if "undersized" in s),
             "pgs_backfilling": len(self.backfills),
+            "pgs_down": sum(
+                1 for s in states.values()
+                if s in ("down", "incomplete")),
+            "pg_states": states,
         }
 
     def verify_all(self, expected: dict[str, np.ndarray]) -> int:

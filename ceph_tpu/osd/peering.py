@@ -1,0 +1,119 @@
+"""PeeringState — the GetInfo/GetLog/GetMissing consensus pass.
+
+Rebuild of the reference's peering machine (ref: src/osd/
+PeeringState.{h,cc} — a boost::statechart whose load-bearing phases
+are: GetInfo (query every up shard for its pg_info_t: last_update,
+log bounds), GetLog (pick the authoritative log holder via
+find_best_info and pull its log), GetMissing (diff every shard's
+last_update against the authoritative log into per-shard missing
+sets), then choose_acting/Activate — after which missing objects are
+recovered log-first, and shards whose gap predates the log tail are
+backfilled instead).
+
+Mapped onto this repo's primitives: each PGBackend already carries the
+authoritative in-memory log (`pg_log`) and a per-shard applied cursor
+(`shard_applied` — the last_update analog), so peering here is a PURE
+FUNCTION over (backend, liveness): it produces the per-shard missing
+plan and the PG's resulting state. SimCluster drives it on every map
+change / revive and executes the plan through recover_shards; the
+state lands in `health()` exactly like `ceph pg stat` strings.
+
+States (the reference's pg_state_t names):
+  active+clean        every slot alive and caught up
+  active+degraded     >= min_size fresh shards, but some slot down or
+                      behind (recovery pending/possible)
+  active+backfilling  a slot is receiving a full copy (pg_temp serves)
+  down                not enough live shards to serve I/O at all
+  incomplete          live shards exist, but fewer than min_size of
+                      them reach the newest write — recent data is
+                      unserviceable until a fresher shard returns
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+BACKFILL = "backfill"  # plan marker: log trimmed past cursor
+
+
+@dataclass
+class ShardInfo:
+    """One GetInfo reply (pg_info_t slice)."""
+    slot: int
+    osd: int
+    alive: bool
+    applied: int          # last_update analog
+
+
+@dataclass
+class PeeringResult:
+    state: str                      # pg_state string
+    auth_version: int               # newest version any live shard has
+    head: int                       # the log's newest version
+    infos: list[ShardInfo]
+    # live-but-behind slots -> list of object names to replay, or
+    # BACKFILL when the log has been trimmed past their cursor
+    missing: dict[int, list[str] | str] = field(default_factory=dict)
+
+    @property
+    def serviceable(self) -> bool:
+        return self.state not in ("down", "incomplete")
+
+
+def peer(backend, alive_osds, backfilling: bool = False,
+         compute_missing: bool = True) -> PeeringResult:
+    """Run the GetInfo -> GetLog -> GetMissing phases for one PG.
+
+    backend: a PGBackend (holds acting, pg_log, shard_applied).
+    alive_osds: container with `alive_osds[osd]` truthy when the OSD
+    process answers (the heartbeat view).
+    backfilling: the cluster's flag that this PG has an in-flight
+    pg_temp-protected copy.
+    compute_missing: False skips the GetMissing log walk (classify-only
+    mode for per-op serviceability gates and health polls — the state
+    depends only on cursor counts, and walking a 10k-entry log per
+    client op would be pure waste).
+    """
+    head = backend.pg_log.head
+
+    # -- GetInfo: per-slot infos; dead shards don't reply; an unfilled
+    # CRUSH slot (undersized PG) has nobody to ask
+    infos = [ShardInfo(slot, osd,
+                       osd >= 0 and bool(alive_osds[osd]),
+                       backend.shard_applied[slot])
+             for slot, osd in enumerate(backend.acting)]
+    live = [i for i in infos if i.alive]
+    undersized = any(i.osd < 0 for i in infos)
+
+    # -- GetLog: the authoritative version reachable from live shards ------
+    auth_version = max((i.applied for i in live), default=0)
+
+    # -- GetMissing: per live shard, what it must replay -------------------
+    behind = [i for i in live if i.applied < head]
+    missing: dict[int, list[str] | str] = {}
+    if compute_missing:
+        for i in behind:
+            names = backend.pg_log.missing_since(i.applied)
+            missing[i.slot] = BACKFILL if names is None else names
+
+    # -- classify (choose_acting/Activate outcome) -------------------------
+    # distinct OSDs, mirroring the min_size gate: two slots on one
+    # disk are one failure domain
+    live_osds = {i.osd for i in live}
+    fresh_osds = {i.osd for i in live if i.applied >= head}
+    min_live = backend.min_live
+    if len(live_osds) < min_live:
+        state = "down"
+    elif len(fresh_osds) < min_live:
+        # enough processes, but not enough of them have the newest
+        # writes: I/O on recent objects would be wrong/unrecoverable
+        state = "incomplete"
+    elif backfilling:
+        state = "active+backfilling"
+    elif behind or len(live) < len(infos):
+        state = "active+degraded"
+    else:
+        state = "active+clean"
+    if undersized and state.startswith("active"):
+        state += "+undersized"
+    return PeeringResult(state, auth_version, head, infos, missing)

@@ -80,11 +80,19 @@ class PGBackend:
     def _log_write(self, name: str, live: list[int]) -> None:
         """Append to the PG log and advance the applied cursor of every
         shard that received this write (down shards stay behind and
-        replay the delta on rejoin)."""
+        replay the delta on rejoin).
+
+        The cursor only advances CONTIGUOUSLY: a live-but-behind shard
+        (revived, replay still pending) receives the new bytes but
+        keeps its old cursor, else its gap would silently close and
+        reads could select it as fresh for objects it missed (the
+        reference keeps last_update + an explicit missing set; our
+        conservative cursor re-replays a little instead)."""
         v = self.pg_log.append(name)
         self.object_versions[name] = v
         for s in live:
-            self.shard_applied[s] = v
+            if self.shard_applied[s] == v - 1:
+                self.shard_applied[s] = v
 
     def _fresh_for(self, names: list[str], shards: list[int]) -> list[int]:
         """Shards (from `shards`) whose applied cursor covers the last

@@ -98,6 +98,7 @@ class PerfCounters:
             b = max(0, min(len(c.buckets) - 1,
                            int(value).bit_length() - 1 if value >= 1 else 0))
             c.buckets[b] += 1
+            c.sum_s += value  # powers the prometheus _sum series
 
     def get(self, key: str):
         with self._lock:
@@ -199,13 +200,19 @@ class PerfCountersCollection:
                     lines.append(f"{metric}_sum {sum_s!r}")
                     lines.append(f"{metric}_count {count}")
                 elif kind == "histogram":
+                    # slot i holds samples in [2^i, 2^(i+1)), so the
+                    # cumulative le bound is the slot's real upper
+                    # value — histogram_quantile() then works in the
+                    # sample's units, not bucket indices
                     lines.append(f"# TYPE {metric} histogram")
                     total = 0
                     for i, b in enumerate(buckets):
                         total += b
                         lines.append(
-                            f'{metric}_bucket{{le="{i}"}} {total}')
+                            f'{metric}_bucket{{le="{1 << (i + 1)}"}} '
+                            f'{total}')
                     lines.append(f'{metric}_bucket{{le="+Inf"}} {total}')
+                    lines.append(f"{metric}_sum {sum_s!r}")
                     lines.append(f"{metric}_count {total}")
         return "\n".join(lines) + "\n"
 

@@ -47,13 +47,17 @@ def span(name: str, counters=None, key: str | None = None):
     `counters[key]` (a time_avg) with the wall duration."""
     ann = _annotation(name)
     t0 = time.perf_counter() if counters is not None else 0.0
-    if ann is not None:
-        with ann:
+    try:
+        if ann is not None:
+            with ann:
+                yield
+        else:
             yield
-    else:
-        yield
-    if counters is not None and key is not None:
-        counters.tinc(key, time.perf_counter() - t0)
+    finally:
+        # record even when the body raises — failing/slow-error ops are
+        # exactly the ones worth timing (PerfCounters.time() semantics)
+        if counters is not None and key is not None:
+            counters.tinc(key, time.perf_counter() - t0)
 
 
 def start_trace(log_dir: str) -> bool:

@@ -1,0 +1,158 @@
+"""PeeringState tests — GetInfo/GetLog/GetMissing classification and
+missing-plan computation (ref: src/osd/PeeringState.{h,cc} phases;
+pg_state strings per ceph pg stat)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.cluster import StaleMap
+from ceph_tpu.osd.ecbackend import ECBackend, ShardSet
+from ceph_tpu.osd.peering import BACKFILL, peer
+from cluster_helpers import corpus, make_cluster
+
+
+def make_be(k=4, m=2):
+    cluster = ShardSet()
+    be = ECBackend(f"plugin=tpu_rs k={k} m={m} impl=bitlinear", "1.0",
+                   list(range(k + m)), cluster, chunk_size=128)
+    return be
+
+
+def alive(n, dead=()):
+    a = np.ones(n, dtype=bool)
+    for d in dead:
+        a[d] = False
+    return a
+
+
+class TestClassification:
+    def test_clean(self):
+        be = make_be()
+        be.write_objects(corpus(4, 256, seed=1))
+        res = peer(be, alive(6))
+        assert res.state == "active+clean"
+        assert res.missing == {}
+        assert res.auth_version == res.head == be.pg_log.head
+
+    def test_degraded_on_dead_shard(self):
+        be = make_be()
+        be.write_objects(corpus(4, 256, seed=2))
+        res = peer(be, alive(6, dead=[0]))
+        assert res.state == "active+degraded"
+        assert res.serviceable
+
+    def test_down_below_min_size(self):
+        be = make_be()  # k=4 -> min_live 4
+        res = peer(be, alive(6, dead=[0, 1, 2]))
+        assert res.state == "down"
+        assert not res.serviceable
+
+    def test_incomplete_when_fresh_quorum_lost(self):
+        be = make_be()
+        be.write_objects(corpus(2, 256, seed=3))
+        # a write lands while osd.0 is down -> only shards 1..5 fresh
+        be.write_objects({"late": b"x" * 100}, dead_osds={0})
+        # then two FRESH shards die and osd.0 comes back: 4 live
+        # (>= min) but only 3 reach the newest write
+        res = peer(be, alive(6, dead=[1, 2]))
+        assert res.state == "incomplete"
+        assert not res.serviceable
+
+    def test_backfilling_flag(self):
+        be = make_be()
+        be.write_objects(corpus(2, 256, seed=4))
+        res = peer(be, alive(6), backfilling=True)
+        assert res.state == "active+backfilling"
+
+
+class TestMissingPlan:
+    def test_replay_names(self):
+        be = make_be()
+        be.write_objects({"a": b"1" * 64, "b": b"2" * 64})
+        be.write_objects({"c": b"3" * 64}, dead_osds={5})
+        res = peer(be, alive(6))
+        assert res.missing == {5: ["c"]}
+        assert res.state == "active+degraded"
+
+    def test_backfill_after_log_trim(self):
+        be = make_be()
+        be.pg_log.max_entries = 4
+        be.write_objects({"a": b"1" * 64}, dead_osds={5})
+        for i in range(6):  # trim past shard 5's cursor
+            be.write_objects({f"x{i}": bytes([i]) * 64})
+        res = peer(be, alive(6))
+        assert res.missing[5] == BACKFILL
+
+    def test_dead_shards_not_in_plan(self):
+        be = make_be()
+        be.write_objects({"a": b"1" * 64}, dead_osds={5})
+        res = peer(be, alive(6, dead=[5]))
+        assert 5 not in res.missing
+        assert res.state == "active+degraded"
+
+
+class TestClusterIntegration:
+    def test_health_reports_pg_states(self):
+        c = make_cluster(pg_num=4)
+        c.write(corpus(8, 300, seed=5))
+        h = c.health()
+        assert set(h["pg_states"]) == {0, 1, 2, 3}
+        assert all(s == "active+clean" for s in h["pg_states"].values())
+        assert h["pgs_down"] == 0
+
+    def test_down_pg_parks_client_ops(self):
+        c = make_cluster(pg_num=4, n_osds=12, down_out_interval=10_000)
+        objs = corpus(8, 300, seed=6)
+        c.write(objs)
+        # kill enough OSDs of pg 0 to push it below min_size
+        victims = c.pgs[0].acting[:c.m + 1]
+        for v in victims:
+            c.kill_osd(v)
+        assert c.pg_state(0) == "down"
+        primary = c.osdmap.pg_to_up_acting_osds(1, 0)[3]
+        with pytest.raises(StaleMap, match="parked|not answering"):
+            c.client_rpc(primary, c.osdmap.epoch, "read", 0,
+                         [n for n in objs if c.locate(n) == 0][:1])
+        # revive -> peering makes it serviceable again
+        for v in victims:
+            c.revive_osd(v)
+        assert c.pg_state(0).startswith("active")
+        assert c.verify_all(objs) == len(objs)
+
+    def test_revive_executes_missing_plan(self):
+        c = make_cluster(pg_num=4, n_osds=12, down_out_interval=10_000)
+        c.write(corpus(8, 300, seed=7))
+        c.kill_osd(3)
+        c.tick(30)
+        late = corpus(6, 300, seed=8, prefix="late")
+        c.write(late)
+        # some PG on osd.3 now has a missing plan for it
+        plans = [peer(c.pgs[ps], np.ones(12, dtype=bool)).missing
+                 for ps in range(4)]
+        assert any(plans)
+        c.revive_osd(3)
+        for ps in range(4):
+            res = peer(c.pgs[ps], c.alive,
+                       backfilling=ps in c.backfills)
+            assert res.missing == {}, ps
+
+
+class TestContiguousCursor:
+    def test_behind_shard_never_serves_missed_overwrite(self):
+        # regression: osd revives, replay deferred, NEW write arrives;
+        # its cursor must stay behind so reads never pick its stale
+        # chunk of the overwritten object
+        be = make_be()
+        objs = {"obj": b"\xaa" * 512}
+        be.write_objects(objs)
+        be.write_objects({"obj": b"\xbb" * 512}, dead_osds={2})  # v2 missed
+        # slot 2 "revives" (no replay) and receives a new write
+        be.write_objects({"other": b"\xcc" * 256})
+        assert be.shard_applied[2] < be.pg_log.head
+        # read of the overwritten object must not use slot 2
+        got = be.read_object("obj")
+        assert got.tobytes() == b"\xbb" * 512
+        # and peering still plans its replay
+        res = peer(be, alive(6))
+        assert set(res.missing) == {2}
+        assert "obj" in res.missing[2]

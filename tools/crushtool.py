@@ -111,6 +111,10 @@ def main(argv=None) -> None:
             print(f"wrote {args.outfn} ({len(m.buckets)} buckets, "
                   f"{len(m.rules)} rules)")
         if not args.test:
+            if not args.outfn:  # compile-only: confirm what was built
+                print(f"compiled map: {m.n_devices} osds, "
+                      f"{len(m.buckets)} buckets, {len(m.rules)} rules, "
+                      f"depth {m.pack().max_depth}")
             return
         # pick the test rule: --rule-id wins; a single-rule map is
         # unambiguous; otherwise match --rule against rule names
