@@ -1,0 +1,128 @@
+"""Chunk-dim tiling — stream stripes bigger than device memory.
+
+The long-context analog of the reference's striping stack (SURVEY.md
+§2.7 P7 and §5: scaling "sequence length" here means scaling object/
+stripe size — ref: src/libradosstriper/ client-side striping,
+ECUtil::stripe_info_t round-robin layout, BlueStore extent/blob
+splitting). GF codecs are POSITIONWISE over the byte axis: parity byte
+i depends only on data bytes i across shards, so a stripe of any
+length streams through a fixed-shape kernel in tiles with bit-exact
+results.
+
+Two lowering levels, composable:
+
+* `make_tiled_encoder` — device-side tiling: ONE jit whose lax.map
+  walks (T, B, k, tile) so XLA's working set stays one tile regardless
+  of chunk length. Use when the full array fits in HBM but a monolithic
+  launch would blow VMEM or compile poorly.
+* `StreamingCodec` — host-side tiling with async double buffering:
+  chunk bytes live on the HOST (bigger than HBM); tile i+1's
+  host->device transfer is enqueued while tile i computes (JAX's async
+  dispatch overlaps them), and results land in a preallocated host
+  buffer one tile behind. Use for > HBM objects — the P5/P7 dataflow.
+
+Both reuse make_encoder's impls (bitlinear/mxu/pallas/logexp), and —
+like make_encoder — both serve ENCODE and DECODE alike: the "matrix"
+is any static GF matrix (coding matrix or inverted decode matrix).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .rs_kernels import DEFAULT_IMPL, apply_matrix, make_encoder
+
+
+def make_tiled_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
+                       tile: int = 1 << 20):
+    """Jitted (B, k, L) -> (B, m, L) that internally lax.maps over
+    L/tile chunk tiles. L must be a multiple of `tile` (the stripe
+    layer already pads chunks to alignment)."""
+    import jax
+    import jax.numpy as jnp
+
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    m, k = matrix.shape
+
+    @jax.jit
+    def enc(data):
+        B, kk, L = data.shape
+        if kk != k:
+            raise ValueError(f"data has {kk} shards, matrix wants {k}")
+        if L % tile:
+            raise ValueError(f"chunk len {L} not a multiple of "
+                             f"tile {tile}")
+        t = L // tile
+        # (B, k, T, tile) -> (T, B, k, tile): tiles become the mapped
+        # leading axis; lax.map emits ONE tile program + a loop
+        tiles = jnp.moveaxis(data.reshape(B, kk, t, tile), 2, 0)
+        out = jax.lax.map(
+            functools.partial(apply_matrix, matrix, impl=impl), tiles)
+        return jnp.moveaxis(out, 0, 2).reshape(B, m, L)
+
+    return enc
+
+
+class StreamingCodec:
+    """Host-resident stripes streamed tile-by-tile through the device.
+
+    encode(data) accepts a HOST (B, k, L) uint8 array of any L and
+    returns host (B, m, L) parity without ever materializing more than
+    `depth` tiles on device. The per-tile kernel shape is fixed, so one
+    compile serves every stripe length (ragged tails are zero-padded —
+    padding encodes to padding for any linear code, so the tail slice
+    of the output is exact).
+    """
+
+    def __init__(self, matrix: np.ndarray, impl: str = DEFAULT_IMPL,
+                 tile: int = 1 << 20, depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+        self.m, self.k = matrix.shape
+        self.tile = int(tile)
+        self.depth = depth  # in-flight tiles (double buffering = 2)
+        self._fn = make_encoder(matrix, impl)
+
+    def encode(self, data: np.ndarray, out: np.ndarray | None = None
+               ) -> np.ndarray:
+        import jax
+
+        data = np.asarray(data)
+        if data.ndim != 3 or data.shape[1] != self.k \
+                or data.dtype != np.uint8:
+            raise ValueError(
+                f"want (B, {self.k}, L) uint8, got "
+                f"{data.shape} {data.dtype}")
+        B, _, L = data.shape
+        if out is None:
+            out = np.empty((B, self.m, L), dtype=np.uint8)
+        elif out.shape != (B, self.m, L) or out.dtype != np.uint8:
+            raise ValueError(f"out must be ({B}, {self.m}, {L}) uint8")
+        tl = self.tile
+        n_tiles = max(1, -(-L // tl))
+        inflight: list[tuple[int, int, object]] = []  # (off, len, dev)
+
+        def drain(entry):
+            off, ln, dev = entry
+            host = np.asarray(jax.device_get(dev))
+            out[:, :, off:off + ln] = host[:, :, :ln]
+
+        for ti in range(n_tiles):
+            off = ti * tl
+            ln = min(tl, L - off)
+            src = data[:, :, off:off + tl]
+            if ln < tl:  # ragged tail: zero-pad to the fixed shape
+                pad = np.zeros((B, self.k, tl), dtype=np.uint8)
+                pad[:, :, :ln] = src
+                src = pad
+            # enqueue: device_put + launch return immediately (async
+            # dispatch); compute of tile i overlaps staging of i+1
+            inflight.append((off, ln, self._fn(jax.device_put(src))))
+            if len(inflight) >= self.depth:
+                drain(inflight.pop(0))
+        while inflight:
+            drain(inflight.pop(0))
+        return out

@@ -77,13 +77,20 @@ def peer(backend, alive_osds, backfilling: bool = False,
     head = backend.pg_log.head
 
     # -- GetInfo: per-slot infos; dead shards don't reply; an unfilled
-    # CRUSH slot (undersized PG) has nobody to ask
+    # CRUSH slot (hole sentinel CRUSH_ITEM_NONE = 0x7FFFFFFF, or any
+    # id outside the OSD table) has nobody to ask -> undersized PG
+    from ..crush.map import CRUSH_ITEM_NONE
+    n_osds = len(alive_osds)
+
+    def hole(osd: int) -> bool:
+        return osd == CRUSH_ITEM_NONE or not (0 <= osd < n_osds)
+
     infos = [ShardInfo(slot, osd,
-                       osd >= 0 and bool(alive_osds[osd]),
+                       not hole(osd) and bool(alive_osds[osd]),
                        backend.shard_applied[slot])
              for slot, osd in enumerate(backend.acting)]
     live = [i for i in infos if i.alive]
-    undersized = any(i.osd < 0 for i in infos)
+    undersized = any(hole(i.osd) for i in infos)
 
     # -- GetLog: the authoritative version reachable from live shards ------
     auth_version = max((i.applied for i in live), default=0)

@@ -203,14 +203,17 @@ class PerfCountersCollection:
                     # slot i holds samples in [2^i, 2^(i+1)), so the
                     # cumulative le bound is the slot's real upper
                     # value — histogram_quantile() then works in the
-                    # sample's units, not bucket indices
+                    # sample's units, not bucket indices. The LAST slot
+                    # is hinc's overflow clamp (values may exceed its
+                    # nominal bound), so it folds into +Inf only.
                     lines.append(f"# TYPE {metric} histogram")
                     total = 0
-                    for i, b in enumerate(buckets):
+                    for i, b in enumerate(buckets[:-1]):
                         total += b
                         lines.append(
                             f'{metric}_bucket{{le="{1 << (i + 1)}"}} '
                             f'{total}')
+                    total += buckets[-1]
                     lines.append(f'{metric}_bucket{{le="+Inf"}} {total}')
                     lines.append(f"{metric}_sum {sum_s!r}")
                     lines.append(f"{metric}_count {total}")

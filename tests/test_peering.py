@@ -156,3 +156,15 @@ class TestContiguousCursor:
         res = peer(be, alive(6))
         assert set(res.missing) == {2}
         assert "obj" in res.missing[2]
+
+
+def test_undersized_slot_classified_not_crashed():
+    # hole sentinel is CRUSH_ITEM_NONE (positive!) — peer must treat it
+    # as an unfilled slot, not index the alive array with it
+    from ceph_tpu.crush.map import CRUSH_ITEM_NONE
+    be = make_be()
+    be.write_objects(corpus(2, 256, seed=9))
+    be.acting[5] = CRUSH_ITEM_NONE
+    res = peer(be, alive(6))
+    assert "undersized" in res.state
+    assert res.serviceable  # 5 live shards >= k=4

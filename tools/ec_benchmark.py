@@ -41,6 +41,10 @@ def parse_args(argv=None):
                     default="encode")
     ap.add_argument("--erasures", "-e", type=int, default=1,
                     help="chunks erased per object for decode")
+    ap.add_argument("--stream-tile", type=int, default=0, metavar="BYTES",
+                    help="stream host-resident chunks through the device "
+                         "in tiles of this many bytes (the >HBM object "
+                         "path; plain RS only)")
     ap.add_argument("--impl", default=None,
                     help="kernel lowering: bitlinear | mxu | logexp | auto")
     ap.add_argument("--json", action="store_true", help="emit one JSON line")
@@ -49,7 +53,7 @@ def parse_args(argv=None):
 
 def run_bench(plugin: str, profile: dict, size: int, batch: int,
               iterations: int, workload: str, erasures: int,
-              impl: str | None) -> dict:
+              impl: str | None, stream_tile: int = 0) -> dict:
     """Returns {seconds, gbps, bytes_per_iter, ...}. Timing covers only the
     codec region, like ErasureCodeBench::encode/decode (buffers prepared
     outside the loop, one warmup launch excluded for jit compile)."""
@@ -81,29 +85,46 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
     data = rng.integers(0, 256, size=(batch, k, cs), dtype=np.uint8)
 
     from ceph_tpu.ec.rs import ReedSolomon
+    if stream_tile and not isinstance(coder, ReedSolomon):
+        raise SystemExit("--stream-tile needs a plain RS plugin "
+                         "(layered plugins plan their own decode)")
     if isinstance(coder, ReedSolomon):
         # plain-MDS fast path: time the raw device kernel (the measured
         # region of ceph_erasure_code_benchmark — codec math only).
         # Layered / non-MDS plugins (lrc, clay, shec) have their own
         # decode planning and must NOT take this path.
-        dev_data = jax.device_put(data)
         if workload == "encode":
-            fn = make_encoder(coder.matrix, impl_used)
+            mat = coder.matrix
         else:
             if not 0 < erasures <= m:
                 raise SystemExit(
                     f"--erasures must be in [1, m={m}], got {erasures}")
             ers = tuple(range(erasures))
             survivors = tuple(range(erasures, erasures + k))
-            D = decode_matrix(coder.matrix, list(ers), k, list(survivors))
-            fn = make_encoder(D, impl_used)
-        operand = dev_data
-        fn(operand).block_until_ready()  # warmup / compile
-        t0 = time.perf_counter()
-        for _ in range(iterations):
-            out = fn(operand)
-        out.block_until_ready()
-        dt = time.perf_counter() - t0
+            mat = decode_matrix(coder.matrix, list(ers), k,
+                                list(survivors))
+        if stream_tile:
+            # host-resident path: double-buffered tile streaming (the
+            # >HBM object dataflow; ceph_tpu/ops/streaming.py). The
+            # full array never lands in HBM — only `depth` tiles — and
+            # timing includes host<->device transfers: that IS the
+            # workload being measured.
+            from ceph_tpu.ops.streaming import StreamingCodec
+            sc = StreamingCodec(mat, impl_used, tile=stream_tile)
+            out_buf = sc.encode(data)  # warmup / compile
+            t0 = time.perf_counter()
+            for _ in range(iterations):
+                sc.encode(data, out=out_buf)
+            dt = time.perf_counter() - t0
+        else:
+            fn = make_encoder(mat, impl_used)
+            operand = jax.device_put(data)
+            fn(operand).block_until_ready()  # warmup / compile
+            t0 = time.perf_counter()
+            for _ in range(iterations):
+                out = fn(operand)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
     else:
         # layered / non-MDS plugins (clay, lrc, shec): time the full
         # plugin path, including their own recovery planning
@@ -166,7 +187,8 @@ def main(argv=None) -> None:
     else:
         impls = [None]  # layered plugins pick their own kernel impl
     results = [run_bench(args.plugin, profile, args.size, args.batch,
-                         args.iterations, args.workload, args.erasures, i)
+                         args.iterations, args.workload, args.erasures, i,
+                         stream_tile=args.stream_tile)
                for i in impls]
     best = max(results, key=lambda r: r["gbps"])
     if args.json:
