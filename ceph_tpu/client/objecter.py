@@ -126,14 +126,22 @@ class Objecter:
         ps, _ = self._calc_target(name)
         self._submit("write_ranges", ps, [(name, offset, data)])
 
+    def _by_pg(self, names: list[str]) -> dict[int, list[str]]:
+        by_pg: dict[int, list[str]] = {}
+        for name in names:
+            ps, _ = self._calc_target(name)
+            by_pg.setdefault(ps, []).append(name)
+        return by_pg
+
+    def remove(self, names: list[str] | str) -> None:
+        names_l = [names] if isinstance(names, str) else list(names)
+        for ps, group in self._by_pg(names_l).items():
+            self._submit("remove", ps, group)
+
     def read(self, names: list[str] | str) -> dict[str, np.ndarray]:
         single = isinstance(names, str)
         names_l = [names] if single else list(names)
-        by_pg: dict[int, list[str]] = {}
-        for name in names_l:
-            ps, _ = self._calc_target(name)
-            by_pg.setdefault(ps, []).append(name)
         out: dict[str, np.ndarray] = {}
-        for ps, group in by_pg.items():
+        for ps, group in self._by_pg(names_l).items():
             out.update(self._submit("read", ps, group))
         return out[names] if single else out

@@ -1,0 +1,183 @@
+"""librados-shaped client API + radosstriper analog.
+
+Rebuild of the reference's public object API (ref: src/librados/
+librados.cc `rados_write/rados_write_full/rados_read/rados_remove/
+rados_stat`, RadosClient/IoCtxImpl split; python binding shape ref:
+src/pybind/rados/rados.pyx — Rados.open_ioctx -> IoCtx methods) and of
+the client-side striper (ref: src/libradosstriper/
+RadosStriperImpl.cc — a logical byte stream striped round-robin in
+stripe_unit pieces across stripe_count rados objects of object_size
+each; the layout ref: libradosstriper's default one-object-set
+striping, same math as ECUtil's round-robin but client-side).
+
+Everything routes through the Objecter (retry/retarget on map change),
+so callers get the same semantics librados users get: write during a
+remap lands correctly without caller involvement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .objecter import Objecter
+
+
+class Rados:
+    """Cluster handle (the RadosClient role)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._objecter = Objecter(cluster)
+
+    def open_ioctx(self, pool: str = "default") -> "IoCtx":
+        # the sim carries one pool (id 1); named lookup mirrors
+        # rados_ioctx_create's pool-name resolution
+        if pool not in ("default", "1"):
+            raise ValueError(f"no pool {pool!r}")
+        return IoCtx(self, pool)
+
+    def stat_cluster(self) -> dict:
+        return self.cluster.health()
+
+
+class IoCtx:
+    """Per-pool I/O context (IoCtxImpl)."""
+
+    def __init__(self, rados: Rados, pool: str):
+        self.rados = rados
+        self.pool = pool
+        self._ob = rados._objecter
+
+    # -- object ops (librados C API names) ----------------------------------
+
+    def write_full(self, name: str, data: bytes | np.ndarray) -> None:
+        self._ob.write({name: data})
+
+    def write(self, name: str, data: bytes | np.ndarray,
+              offset: int = 0) -> None:
+        self._ob.write_at(name, offset, data)
+
+    def read(self, name: str, length: int | None = None,
+             offset: int = 0) -> bytes:
+        arr = self._ob.read(name)
+        if length is None:
+            return arr[offset:].tobytes()
+        return arr[offset:offset + length].tobytes()
+
+    def remove(self, name: str) -> None:
+        self._ob.remove(name)
+
+    def stat(self, name: str) -> int:
+        """Object size in bytes (rados_stat's pmtime is meaningless in
+        virtual time)."""
+        ps = self.rados.cluster.locate(name)
+        return self.rados.cluster.pgs[ps].stat_object(name)
+
+    def list_objects(self) -> list[str]:
+        c = self.rados.cluster
+        return sorted(n for ps in range(c.pg_num)
+                      for n in c.pgs[ps].list_pg_objects())
+
+
+class RadosStriper:
+    """Client-side striping over rados objects (libradosstriper).
+
+    A logical byte stream `soid` maps to objects `{soid}.{q:016x}`:
+    logical offset L lives in stripe-unit su = (L // stripe_unit),
+    which round-robins onto object (su % stripe_count) within an
+    object set of stripe_count objects; object sets advance every
+    stripe_count * object_size logical bytes. Size is tracked in a
+    striper metadata object (the striper's size xattr role).
+    """
+
+    def __init__(self, ioctx: IoCtx, stripe_unit: int = 1 << 16,
+                 stripe_count: int = 4, object_size: int = 1 << 22):
+        if object_size % stripe_unit:
+            raise ValueError("object_size must be a multiple of "
+                             "stripe_unit")
+        if stripe_count < 1 or stripe_unit < 1:
+            raise ValueError("bad striping parameters")
+        self.io = ioctx
+        self.su = stripe_unit
+        self.sc = stripe_count
+        self.osz = object_size
+
+    def _obj(self, soid: str, q: int) -> str:
+        return f"{soid}.{q:016x}"
+
+    def _meta(self, soid: str) -> str:
+        return f"{soid}.meta"
+
+    def _extents(self, offset: int, length: int):
+        """Yield (object index, object offset, logical offset, len)
+        pieces covering [offset, offset+length)."""
+        units_per_set = self.sc * (self.osz // self.su)
+        pos = offset
+        end = offset + length
+        while pos < end:
+            su_idx = pos // self.su
+            intra = pos % self.su
+            take = min(self.su - intra, end - pos)
+            obj_set, in_set = divmod(su_idx, units_per_set)
+            obj_in_set = in_set % self.sc
+            row = in_set // self.sc          # stripe row within the set
+            q = obj_set * self.sc + obj_in_set
+            ooff = row * self.su + intra
+            yield q, ooff, pos, take
+            pos += take
+
+    def size(self, soid: str) -> int:
+        try:
+            return int.from_bytes(self.io.read(self._meta(soid)), "little")
+        except KeyError:
+            raise KeyError(f"no striped object {soid!r}")
+
+    def write(self, soid: str, data: bytes | np.ndarray,
+              offset: int = 0) -> None:
+        arr = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if isinstance(data, (bytes, bytearray, memoryview)) \
+            else np.asarray(data, np.uint8).reshape(-1)
+        for q, ooff, lpos, ln in self._extents(offset, len(arr)):
+            piece = arr[lpos - offset:lpos - offset + ln]
+            self.io.write(self._obj(soid, q), piece, offset=ooff)
+        try:
+            cur = self.size(soid)
+        except KeyError:
+            cur = 0
+        new = max(cur, offset + len(arr))
+        if new != cur:
+            self.io.write_full(self._meta(soid),
+                               new.to_bytes(8, "little"))
+
+    def read(self, soid: str, length: int | None = None,
+             offset: int = 0) -> bytes:
+        total = self.size(soid)
+        if length is None:
+            length = max(0, total - offset)
+        length = min(length, max(0, total - offset))
+        out = np.zeros(length, dtype=np.uint8)
+        if not length:
+            return b""
+        cache: dict[str, np.ndarray] = {}
+        for q, ooff, lpos, ln in self._extents(offset, length):
+            name = self._obj(soid, q)
+            if name not in cache:
+                try:
+                    cache[name] = np.frombuffer(self.io.read(name),
+                                                dtype=np.uint8)
+                except KeyError:
+                    cache[name] = np.zeros(0, dtype=np.uint8)
+            obj = cache[name]
+            piece = obj[ooff:ooff + ln]
+            out[lpos - offset:lpos - offset + len(piece)] = piece
+        return out.tobytes()
+
+    def remove(self, soid: str) -> None:
+        total = self.size(soid)
+        qs = {q for q, _, _, _ in self._extents(0, max(total, 1))}
+        for q in sorted(qs):
+            try:
+                self.io.remove(self._obj(soid, q))
+            except KeyError:
+                pass  # sparse stripe: unit never written
+        self.io.remove(self._meta(soid))

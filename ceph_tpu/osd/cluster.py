@@ -204,6 +204,9 @@ class SimCluster:
         if kind == "write":
             be.write_objects(payload, dead_osds=dead)
             names = payload.keys()
+        elif kind == "remove":
+            be.remove_objects(payload, dead_osds=dead)
+            names = set(payload)
         else:  # write_ranges
             be.write_ranges(payload, dead_osds=dead)
             names = {n for n, _, _ in payload}
@@ -211,21 +214,31 @@ class SimCluster:
         if job is not None:
             job["names"].update(names)
 
+    def _dead_osds(self) -> set[int]:
+        return {o for o in range(len(self.alive)) if not self.alive[o]}
+
     def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
         # dead processes get no sub-writes; their shards fall behind in
         # the PG log and catch up on revive (ref: a down OSD misses
         # MOSDECSubOpWrite fan-out; PGLog records the gap)
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
         by_pg: dict[int, dict] = {}
         for name, data in objects.items():
             by_pg.setdefault(self.locate(name), {})[name] = data
         for ps, group in by_pg.items():
-            self._apply_write(ps, "write", group, dead)
+            self._apply_write(ps, "write", group, self._dead_osds())
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
         dead = {o for o in range(len(self.alive)) if not self.alive[o]}
         return self.pgs[ps].read_object(name, dead_osds=dead)
+
+    def remove(self, names: list[str] | str) -> None:
+        names = [names] if isinstance(names, str) else list(names)
+        by_pg: dict[int, list[str]] = {}
+        for name in names:
+            by_pg.setdefault(self.locate(name), []).append(name)
+        for ps, group in by_pg.items():
+            self._apply_write(ps, "remove", group, self._dead_osds())
 
     # -- client RPC (the primary-OSD session an Objecter talks to) ----------
 
@@ -265,7 +278,7 @@ class SimCluster:
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} is {res.state}; op parked")
         dead = {o for o in range(len(self.alive)) if not self.alive[o]}
-        if kind in ("write", "write_ranges"):
+        if kind in ("write", "write_ranges", "remove"):
             self._apply_write(ps, kind, payload, dead)
             return None
         if kind == "read":
@@ -331,7 +344,18 @@ class SimCluster:
             for slot, plan in sorted(res.missing.items()):
                 o = be.acting[slot]
                 backfill = plan == BACKFILL
-                missed = sorted(be.object_sizes) if backfill else plan
+                if backfill:
+                    # full rebuild, PLUS purge of objects deleted while
+                    # the shard was down (the trimmed log can't name
+                    # them, but the shard's own store can)
+                    from .ecbackend import shard_cid
+                    cid = shard_cid(be.pg, slot)
+                    strays = [n for n in
+                              self.cluster.osd(o).list_objects(cid)
+                              if n not in be.object_sizes]
+                    missed = sorted(be.object_sizes) + strays
+                else:
+                    missed = plan
                 if not missed:
                     be.shard_applied[slot] = be.pg_log.head
                     continue
@@ -572,6 +596,10 @@ class SimCluster:
             dst = self.cluster.osd(new)
             cid = shard_cid(be.pg, slot)
             if not src.exists(cid, name):
+                # removed (or never written): propagate the delete so a
+                # previously-copied version doesn't survive at the dest
+                if dst.exists(cid, name):
+                    dst.queue_transaction(Transaction().remove(cid, name))
                 continue
             data = src.read(cid, name)
             t = (Transaction()

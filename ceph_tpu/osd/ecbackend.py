@@ -485,15 +485,20 @@ class ECBackend(PGBackend):
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
         excluded = helper_exclude or set()
         names = sorted(self.object_sizes) if names is None \
-            else sorted(n for n in names if n in self.object_sizes)
-        # helpers must be caught up for everything being rebuilt — a
+            else sorted(set(names))
+        # helpers must be caught up for everything being REBUILT — a
         # stale survivor would decode old bytes into the new shard.
         # Validate the plan BEFORE mutating acting, so an impossible
         # recovery (insufficient live helpers) leaves no partial state.
-        survivors = self._fresh_for(
-            names, [s for s in range(self.n)
-                    if s not in lost and s not in excluded])
-        helper = sorted(self.coder.minimum_to_decode(lost, survivors))
+        # A deletes-only replay needs no helper data at all.
+        rebuild = [n for n in names if n in self.object_sizes]
+        survivors: list[int] = []
+        helper: list[int] = []
+        if rebuild:
+            survivors = self._fresh_for(
+                rebuild, [s for s in range(self.n)
+                          if s not in lost and s not in excluded])
+            helper = sorted(self.coder.minimum_to_decode(lost, survivors))
         repl = replacement_osds or {}
         for s in lost:
             new_osd = repl.get(s, self.acting[s])
@@ -501,6 +506,8 @@ class ECBackend(PGBackend):
             t = Transaction().create_collection(shard_cid(self.pg, s))
             self.cluster.osd(new_osd).queue_transaction(t)
         counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+        # names whose last log entry was a DELETE replay as removals
+        names = self._replay_deletes(lost, names)
 
         # split into (shard_len, subgroup) jobs of <= batch objects
         by_len: dict[int, list[str]] = {}
@@ -525,7 +532,7 @@ class ECBackend(PGBackend):
                 for sl, group in by_len.items()
                 for i in range(0, len(group), batch)]
 
-        dec_fn = self.coder.batch_decoder(lost, helper)
+        dec_fn = self.coder.batch_decoder(lost, helper) if jobs else None
         pending: list[tuple] = []  # (sl, subgroup, device handles)
 
         def complete(entry) -> None:

@@ -141,6 +141,54 @@ class PGBackend:
                     dead_osds: set[int] | None = None) -> np.ndarray:
         return self.read_objects([name], dead_osds)[name]
 
+    def remove_objects(self, names, dead_osds=None) -> None:
+        """Delete objects from every live slot. A remove is a LOGGED
+        mutation (ref: pg_log_entry_t DELETE): a shard that was down
+        across it replays the delete on rejoin instead of resurrecting
+        a stale copy."""
+        live = self._live_slots(dead_osds)
+        self._check_min_size(live)
+        names = list(names)
+        # validate the whole batch before mutating anything (the
+        # recover_shards convention): a bad name mid-batch must not
+        # leave a half-applied, half-logged delete
+        for name in names:
+            if name not in self.object_sizes:
+                raise KeyError(f"no object {name!r}")
+        seen: set[str] = set()
+        for name in names:
+            if name in seen:
+                continue
+            seen.add(name)
+            for s in live:
+                t = Transaction().remove(shard_cid(self.pg, s), name)
+                self._store(s).queue_transaction(t)
+            del self.object_sizes[name]
+            self._log_write(name, live)
+
+    def stat_object(self, name: str) -> int:
+        """Logical object size (the rados_stat role)."""
+        return self.object_sizes[name]
+
+    def list_pg_objects(self) -> list[str]:
+        return sorted(self.object_sizes)
+
+    def _replay_deletes(self, lost: list[int], names) -> list[str]:
+        """Split a recovery name list: apply deletes for names the PG
+        no longer knows (their last log entry was a remove) to the
+        recovering slots, and return the names still to rebuild."""
+        keep = []
+        for name in names:
+            if name in self.object_sizes:
+                keep.append(name)
+                continue
+            for s in lost:
+                cid = shard_cid(self.pg, s)
+                if self._store(s).exists(cid, name):
+                    self._store(s).queue_transaction(
+                        Transaction().remove(cid, name))
+        return keep
+
     def recover_shards(self, lost_shards, replacement_osds=None,
                        batch: int = 128, verify_hinfo: bool = True,
                        names=None, helper_exclude=None) -> dict:
@@ -197,6 +245,12 @@ class PGBackend:
                         (name, s, f"hinfo len {hinfo.total_chunk_size} "
                                   f"!= {want}"))
             for stray in on_disk - set(self.object_sizes):
+                # a behind shard may hold an object whose delete it
+                # hasn't replayed yet — lag, not corruption (same
+                # excuse the missing/size checks apply above)
+                if self.shard_applied[s] < self.object_versions.get(
+                        stray, 0):
+                    continue
                 errors.append((stray, s, "stray object"))
         return {"checked": checked, "errors": errors}
 
@@ -342,12 +396,17 @@ class ReplicatedBackend(PGBackend):
         lost = sorted(set(lost_shards))
         excluded = helper_exclude or set()
         names = sorted(self.object_sizes) if names is None \
-            else sorted(n for n in names if n in self.object_sizes)
-        survivors = self._fresh_for(
-            names, [s for s in range(self.n)
-                    if s not in lost and s not in excluded])
-        if not survivors:
-            raise ValueError("no caught-up surviving replica to push from")
+            else sorted(set(names))
+        # a deletes-only replay pushes nothing and needs no source
+        rebuild = [n for n in names if n in self.object_sizes]
+        survivors: list[int] = []
+        if rebuild:
+            survivors = self._fresh_for(
+                rebuild, [s for s in range(self.n)
+                          if s not in lost and s not in excluded])
+            if not survivors:
+                raise ValueError(
+                    "no caught-up surviving replica to push from")
         repl = replacement_osds or {}
         for s in lost:
             new_osd = repl.get(s, self.acting[s])
@@ -355,6 +414,8 @@ class ReplicatedBackend(PGBackend):
             t = Transaction().create_collection(shard_cid(self.pg, s))
             self.cluster.osd(new_osd).queue_transaction(t)
         counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+        # names whose last log entry was a DELETE replay as removals
+        names = self._replay_deletes(lost, names)
 
         by_len: dict[int, list[str]] = {}
         for name in names:
