@@ -50,7 +50,8 @@ class SimCluster:
                  heartbeat_interval: float = 6.0,
                  heartbeat_grace: float = 20.0,
                  down_out_interval: float = 600.0,
-                 min_down_reporters: int = 2):
+                 min_down_reporters: int = 2,
+                 n_mons: int = 3):
         crush = build_hierarchy(n_osds, osds_per_host=osds_per_host,
                                 hosts_per_rack=max(4, n_osds))
         # the reference default (51): plenty of retry headroom once
@@ -103,6 +104,12 @@ class SimCluster:
         self.min_down_reporters = min_down_reporters
         self.alive = np.ones(n_osds, dtype=bool)      # process up?
         self.destroyed: set[int] = set()              # disk gone for good
+        # monitor quorum gates every map mutation (ref: OSDMonitor
+        # commits through Paxos; no majority -> the map freezes and
+        # failure handling stalls cluster-wide)
+        from ..mon.monitor import MonitorCluster, NoQuorum
+        self._NoQuorum = NoQuorum
+        self.mons = MonitorCluster(n_mons)
         self.last_heard = np.zeros((n_osds, n_osds))  # peer hb stamps
         self.down_since: dict[int, float] = {}
         # async backfill state: ps -> {"moves": [(slot, old, new)],
@@ -229,7 +236,7 @@ class SimCluster:
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        dead = self._dead_osds()
         return self.pgs[ps].read_object(name, dead_osds=dead)
 
     def remove(self, names: list[str] | str) -> None:
@@ -277,7 +284,7 @@ class SimCluster:
         if not res.serviceable:
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} is {res.state}; op parked")
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        dead = self._dead_osds()
         if kind in ("write", "write_ranges", "remove"):
             self._apply_write(ps, kind, payload, dead)
             return None
@@ -312,6 +319,10 @@ class SimCluster:
         self.alive[osd] = True
         self.last_heard[:, osd] = self.now
         if not self.osdmap.osd_up[osd]:
+            if not self._mon_commit(f"osd.{osd} up"):
+                # the process is back but the map can't record it; the
+                # next tick with quorum will (boot message retried)
+                return
             self.osdmap.mark_up(osd)
         was_out = self.osdmap.osd_weight[osd] == 0
         self.down_since.pop(osd, None)
@@ -400,6 +411,14 @@ class SimCluster:
                 reporters = int(silent[up, j].sum())
                 if reporters >= self.min_down_reporters:
                     self._mark_down(j)
+            # boot retries FIRST: an OSD revived during monitor quorum
+            # loss is alive but still map-down (down_since retained);
+            # re-announcing before the down->out pass prevents a
+            # spurious mark-out + double repeer of a live OSD the
+            # instant quorum heals
+            for o in np.nonzero(self.alive & ~self.osdmap.osd_up)[0]:
+                if int(o) not in self.destroyed:
+                    self.revive_osd(int(o))
             # down long enough -> out -> remap + recover
             for j, since in list(self.down_since.items()):
                 if self.now - since >= self.down_out_interval:
@@ -408,8 +427,42 @@ class SimCluster:
             self._schedule_scrubs()
             self._pump()
 
+    # -- monitor plumbing ---------------------------------------------------
+
+    def _mon_commit(self, what: str) -> bool:
+        """Commit a map mutation through the monitor quorum; False
+        (and no mutation) when the monitors lack a majority."""
+        try:
+            self.mons.propose("osdmap/last_change",
+                              (self.osdmap.epoch + 1, what))
+            return True
+        except self._NoQuorum:
+            g_log.dout("mon", 0, f"no quorum; {what} deferred")
+            return False
+
+    def kill_mon(self, rank: int) -> None:
+        self.mons.kill(rank)
+        g_log.dout("mon", 1, f"mon.{rank} killed")
+
+    def revive_mon(self, rank: int) -> None:
+        self.mons.revive(rank)
+        g_log.dout("mon", 1, f"mon.{rank} revived")
+
+    def config_set(self, name: str, value) -> None:
+        """`ceph config set` analog: commit through the monitor KV,
+        then distribute into the runtime config (the ConfigMonitor ->
+        md_config_t observer path)."""
+        self.mons.config_set(name, value)
+        from ..utils.config import g_conf
+        try:
+            g_conf.set(name, value, level="mon")
+        except KeyError:
+            pass  # not a declared runtime option; KV still holds it
+
     def _mark_down(self, osd: int) -> None:
         if not self.osdmap.osd_up[osd]:
+            return
+        if not self._mon_commit(f"osd.{osd} down"):
             return
         self.osdmap.mark_down(osd)
         self.down_since[osd] = self.now
@@ -421,6 +474,8 @@ class SimCluster:
     def _mark_out(self, osd: int) -> None:
         if osd not in self.down_since:
             return
+        if not self._mon_commit(f"osd.{osd} out"):
+            return
         self.osdmap.mark_out(osd)
         del self.down_since[osd]
         self.perf.inc("osd_marked_out")
@@ -429,7 +484,7 @@ class SimCluster:
         self._repeer_all()
 
     def _update_degraded(self) -> None:
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        dead = self._dead_osds()
         degraded = sum(
             1 for ps in range(self.pg_num)
             if any(o in dead for o in self.pgs[ps].acting))
@@ -634,7 +689,7 @@ class SimCluster:
         shallow every osd_scrub_min_interval, deep every
         osd_deep_scrub_interval). Degraded/backfilling PGs are skipped
         until healthy, like the reference's active+clean gate."""
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        dead = self._dead_osds()
         for ps in range(self.pg_num):
             if ps in self.backfills or ps in self._scrub_queued:
                 continue
@@ -652,7 +707,7 @@ class SimCluster:
     def _do_scrub(self, ps: int, kind: str) -> None:
         self._scrub_queued.discard(ps)
         be = self.pgs[ps]
-        dead = {o for o in range(len(self.alive)) if not self.alive[o]}
+        dead = self._dead_osds()
         if ps in self.backfills or any(o in dead for o in be.acting):
             return  # went unhealthy while queued; rescheduled when due
         if kind == "deep":
@@ -704,6 +759,8 @@ class SimCluster:
         states = {ps: self.pg_state(ps) for ps in range(self.pg_num)}
         return {
             "epoch": self.osdmap.epoch,
+            "mon_quorum": self.mons.quorum(),
+            "mon_leader": self.mons.leader(),
             "osds_up": int(self.osdmap.osd_up.sum()),
             "osds_alive": int(self.alive.sum()),
             "pgs_active_clean": sum(

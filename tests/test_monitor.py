@@ -1,0 +1,168 @@
+"""Monitor quorum/election/replication tests + the quorum gate on the
+cluster's failure pipeline (refs: src/mon/Elector.cc rank election,
+src/mon/Paxos.cc quorum commits, src/mon/OSDMonitor.cc map updates,
+src/mon/ConfigMonitor.cc)."""
+
+import pytest
+
+from ceph_tpu.mon.monitor import MonitorCluster, NoQuorum
+from cluster_helpers import corpus, make_cluster
+
+
+class TestMonitorCluster:
+    def test_election_lowest_alive_rank(self):
+        mc = MonitorCluster(5)
+        assert mc.leader() == 0
+        mc.kill(0)
+        assert mc.leader() == 1
+        mc.kill(1)
+        assert mc.leader() == 2
+        mc.revive(0)
+        assert mc.leader() == 0
+        assert mc.elections >= 3
+
+    def test_quorum_majority(self):
+        mc = MonitorCluster(5)
+        for r in (0, 1):
+            mc.kill(r)
+        assert mc.quorum() == [2, 3, 4]
+        mc.kill(2)
+        assert mc.quorum() is None
+        assert mc.leader() is None
+
+    def test_propose_requires_quorum(self):
+        mc = MonitorCluster(3)
+        v1 = mc.propose("k", "v1")
+        assert mc.get("k") == "v1"
+        mc.kill(0)
+        mc.kill(1)
+        with pytest.raises(NoQuorum):
+            mc.propose("k", "v2")
+        with pytest.raises(NoQuorum):
+            mc.get("k")
+        mc.revive(0)  # 2/3 -> majority again
+        assert mc.get("k") == "v1"
+        assert mc.propose("k", "v2") > v1
+
+    def test_rejoin_syncs_committed_state(self):
+        mc = MonitorCluster(3)
+        mc.propose("a", 1)
+        mc.kill(2)
+        mc.propose("a", 2)
+        mc.propose("b", 3)
+        assert mc.mons[2].version < mc.version()
+        mc.revive(2)
+        assert mc.mons[2].version == mc.version()
+        assert mc.mons[2].store["a"] == 2
+        # the synced monitor can now lead and serve
+        mc.kill(0)
+        mc.kill(1)
+        with pytest.raises(NoQuorum):
+            mc.get("a")  # 1/3 alive
+        mc.revive(0)
+        assert mc.get("b") == 3
+
+    def test_single_mon_cluster(self):
+        mc = MonitorCluster(1)
+        assert mc.propose("x", 1) == 1
+        mc.kill(0)
+        with pytest.raises(NoQuorum):
+            mc.propose("x", 2)
+
+    def test_config_kv(self):
+        mc = MonitorCluster(3)
+        mc.config_set("osd_max_backfills", 7)
+        assert mc.config_dump() == {"osd_max_backfills": 7}
+
+
+class TestQuorumGatesCluster:
+    def test_no_quorum_freezes_failure_handling(self):
+        c = make_cluster(pg_num=4, n_osds=12)
+        objs = corpus(8, 300, seed=1)
+        c.write(objs)
+        c.kill_mon(0)
+        c.kill_mon(1)  # 1/3 monitors -> no majority
+        epoch0 = c.osdmap.epoch
+        victim = c.pgs[0].acting[0]
+        c.kill_osd(victim)
+        c.tick(30)   # grace expires, but the map CANNOT change
+        c.tick(90)   # nor can down->out
+        assert c.osdmap.epoch == epoch0
+        assert bool(c.osdmap.osd_up[victim])
+        assert c.health()["mon_quorum"] is None
+        # monitors heal -> the deferred transitions commit
+        c.revive_mon(0)
+        c.tick(12)
+        assert not c.osdmap.osd_up[victim]
+        c.tick(90)
+        assert c.osdmap.osd_weight[victim] == 0  # marked out
+        for _ in range(60):
+            if not c.backfills:
+                break
+            c.tick(6)
+        assert c.verify_all(objs) == len(objs)
+
+    def test_revive_during_quorum_loss_retries_boot(self):
+        c = make_cluster(pg_num=4, n_osds=12, down_out_interval=10_000)
+        objs = corpus(6, 300, seed=2)
+        c.write(objs)
+        victim = c.pgs[0].acting[1]
+        c.kill_osd(victim)
+        c.tick(30)
+        assert not c.osdmap.osd_up[victim]
+        c.kill_mon(1)
+        c.kill_mon(2)
+        c.revive_osd(victim)       # boot can't commit; map still down
+        assert not c.osdmap.osd_up[victim]
+        c.revive_mon(1)
+        c.tick(6)                  # boot message retried under quorum
+        assert bool(c.osdmap.osd_up[victim])
+        assert c.verify_all(objs) == len(objs)
+
+    def test_config_set_distributes(self):
+        c = make_cluster(pg_num=2)
+        c.config_set("some_unknown_knob", "42")
+        assert c.mons.config_dump()["some_unknown_knob"] == "42"
+
+
+class TestQuorumReformSync:
+    def test_stale_leader_cannot_fork_history(self):
+        # regression: quorum re-formed from revived-but-stale members
+        # must sync before serving, or a stale leader reuses versions
+        # and loses quorum-committed keys
+        mc = MonitorCluster(3)
+        mc.propose("a", 1)
+        mc.kill(0)
+        v_b = mc.propose("b", 2)      # committed by {1, 2}
+        mc.kill(1)
+        mc.kill(2)
+        mc.revive(0)                  # still no quorum; stale
+        mc.revive(1)                  # quorum {0, 1}: must sync mon0
+        assert mc.leader() == 0
+        assert mc.get("b") == 2       # committed data survives
+        v_c = mc.propose("c", 3)
+        assert v_c > v_b              # versions stay monotone
+        assert mc.get("c") == 3
+
+    def test_no_spurious_out_after_quorum_heals(self):
+        # regression: an OSD revived during quorum loss must be marked
+        # up on the first healed tick BEFORE the down->out pass, not
+        # marked out and double-repeered
+        c = make_cluster(pg_num=4, n_osds=12, down_out_interval=60.0)
+        objs = corpus(6, 300, seed=3)
+        c.write(objs)
+        victim = c.pgs[0].acting[0]
+        c.kill_osd(victim)
+        c.tick(30)
+        assert not c.osdmap.osd_up[victim]
+        c.kill_mon(1)
+        c.kill_mon(2)
+        c.revive_osd(victim)          # boot deferred (no quorum)
+        c.tick(120)                   # way past down_out_interval
+        c.revive_mon(1)
+        out_before = c.perf.get("osd_marked_out")
+        c.tick(6)
+        assert bool(c.osdmap.osd_up[victim])
+        assert c.perf.get("osd_marked_out") == out_before
+        assert c.osdmap.osd_weight[victim] > 0  # never marked out
+        assert c.verify_all(objs) == len(objs)

@@ -1,0 +1,185 @@
+"""ceph — cluster status/observability CLI.
+
+Recreation of the reference's operator surface (ref: src/ceph.in — the
+`ceph` admin CLI; `ceph status` / `ceph health` / `ceph pg stat` /
+`ceph daemon <id> perf dump` via the admin socket
+src/common/admin_socket.cc; `ceph config set/get` via
+src/mon/ConfigMonitor.cc; the prometheus scrape via
+src/pybind/mgr/prometheus/module.py).
+
+The cluster is hermetic (SimCluster), so the CLI builds one from a
+scenario first, then answers against it:
+
+  python tools/ceph_cli.py status
+  python tools/ceph_cli.py --scenario osd-failure status
+  python tools/ceph_cli.py --scenario osd-failure pg stat
+  python tools/ceph_cli.py --scenario mon-loss health
+  python tools/ceph_cli.py perf dump
+  python tools/ceph_cli.py prometheus
+  python tools/ceph_cli.py config set osd_max_backfills 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIOS = ("healthy", "osd-failure", "mon-loss", "backfill")
+
+
+def build_cluster(name: str, n_osds: int, pg_num: int):
+    from ceph_tpu.osd.cluster import SimCluster
+    c = SimCluster(n_osds=n_osds, pg_num=pg_num,
+                   heartbeat_grace=20.0, down_out_interval=60.0)
+    rng = np.random.default_rng(0)
+    objs = {f"obj-{i}": rng.integers(0, 256, 600, np.uint8)
+            for i in range(4 * pg_num)}
+    c.write(objs)
+    if name == "osd-failure":
+        c.kill_osd(c.pgs[0].acting[0])
+        c.tick(30)
+        c.tick(90)
+        c.tick(30)
+    elif name == "mon-loss":
+        c.kill_mon(1)
+        c.kill_mon(2)
+        c.kill_osd(c.pgs[0].acting[0])
+        c.tick(30)  # failure observed, map frozen (no quorum)
+    elif name == "backfill":
+        c.backfill_rate = 1
+        victim = c.pgs[0].acting[0]
+        c.kill_osd(victim)
+        c.tick(30)
+        c.tick(90)
+        c.revive_osd(victim)
+        c.tick(6)
+    return c
+
+
+def cmd_status(c, args) -> None:
+    h = c.health()
+    states: dict[str, int] = {}
+    for s in h["pg_states"].values():
+        states[s] = states.get(s, 0) + 1
+    if args.json:
+        print(json.dumps(h | {"pg_state_counts": states}, default=str))
+        return
+    q = h["mon_quorum"]
+    healthy = not h["pgs_degraded"] and not h["pgs_down"] and q
+    mon_line = (f"quorum {q}, leader mon.{h['mon_leader']}"
+                if q is not None else "quorum NONE (no majority!)")
+    print("  cluster:")
+    print(f"    health: {'HEALTH_OK' if healthy else 'HEALTH_WARN'}")
+    print("  services:")
+    print(f"    mon: {len(c.mons.mons)} monitors, {mon_line}")
+    print(f"    osd: {len(c.alive)} osds: {h['osds_up']} up, "
+          f"{int((c.osdmap.osd_weight > 0).sum())} in (epoch {h['epoch']})")
+    print("  data:")
+    print(f"    pgs: " + ", ".join(f"{n} {s}"
+                                   for s, n in sorted(states.items())))
+    if h["pgs_backfilling"]:
+        print(f"    backfilling: {h['pgs_backfilling']} pgs")
+
+
+def cmd_health(c, args) -> None:
+    h = c.health()
+    ok = (not h["pgs_degraded"] and not h["pgs_down"]
+          and h["mon_quorum"] is not None)
+    if args.json:
+        print(json.dumps({"status": "HEALTH_OK" if ok else "HEALTH_WARN"}))
+        return
+    print("HEALTH_OK" if ok else "HEALTH_WARN")
+    if h["mon_quorum"] is None:
+        print("  MON_DOWN: monitors have no quorum; cluster map frozen")
+    if h["pgs_degraded"]:
+        print(f"  PG_DEGRADED: {h['pgs_degraded']} pgs degraded")
+    if h["pgs_down"]:
+        print(f"  PG_AVAILABILITY: {h['pgs_down']} pgs down/incomplete")
+
+
+def cmd_pg_stat(c, args) -> None:
+    h = c.health()
+    if args.json:
+        print(json.dumps({str(k): v for k, v in h["pg_states"].items()}))
+        return
+    for ps, state in sorted(h["pg_states"].items()):
+        be = c.pgs[ps]
+        print(f"  1.{ps}  {state:<28} acting {be.acting} "
+              f"objects {len(be.object_sizes)}")
+
+
+def cmd_perf_dump(c, args) -> None:
+    print(json.dumps({"cluster": c.perf.dump()}, indent=None if args.json
+                     else 2, sort_keys=True))
+
+
+def cmd_prometheus(c, args) -> None:
+    from ceph_tpu.utils.perf_counters import PerfCountersCollection
+    coll = PerfCountersCollection()
+    coll.add(c.perf)
+    sys.stdout.write(coll.prometheus_text())
+
+
+def cmd_config(c, args) -> None:
+    if args.action == "set":
+        if args.value is None:
+            raise SystemExit("config set needs <name> <value>")
+        c.config_set(args.name, args.value)
+        print(f"set {args.name} = {args.value} "
+              f"(mon kv v{c.mons.version()})")
+    elif args.action == "get":
+        dump = c.mons.config_dump()
+        if args.name not in dump:
+            raise SystemExit(f"no config value {args.name!r}")
+        print(dump[args.name])
+    else:  # dump
+        print(json.dumps(c.mons.config_dump(), sort_keys=True))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--scenario", default="healthy", choices=SCENARIOS)
+    ap.add_argument("--num-osds", type=int, default=12)
+    ap.add_argument("--pg-num", type=int, default=8)
+    ap.add_argument("--json", action="store_true")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    sub.add_parser("health")
+    pg = sub.add_parser("pg")
+    pg.add_argument("pg_cmd", choices=["stat"])
+    perf = sub.add_parser("perf")
+    perf.add_argument("perf_cmd", choices=["dump"])
+    sub.add_parser("prometheus")
+    cfg = sub.add_parser("config")
+    cfg.add_argument("action", choices=["set", "get", "dump"])
+    cfg.add_argument("name", nargs="?")
+    cfg.add_argument("value", nargs="?")
+    args = ap.parse_args(argv)
+
+    c = build_cluster(args.scenario, args.num_osds, args.pg_num)
+    if args.cmd == "status":
+        cmd_status(c, args)
+    elif args.cmd == "health":
+        cmd_health(c, args)
+    elif args.cmd == "pg":
+        cmd_pg_stat(c, args)
+    elif args.cmd == "perf":
+        cmd_perf_dump(c, args)
+    elif args.cmd == "prometheus":
+        cmd_prometheus(c, args)
+    elif args.cmd == "config":
+        if args.action in ("set", "get") and not args.name:
+            raise SystemExit(f"config {args.action} needs a name")
+        cmd_config(c, args)
+
+
+if __name__ == "__main__":
+    main()
