@@ -449,15 +449,19 @@ class SimCluster:
         g_log.dout("mon", 1, f"mon.{rank} revived")
 
     def config_set(self, name: str, value) -> None:
-        """`ceph config set` analog: commit through the monitor KV,
-        then distribute into the runtime config (the ConfigMonitor ->
-        md_config_t observer path)."""
-        self.mons.config_set(name, value)
+        """`ceph config set` analog: VALIDATE, commit through the
+        monitor KV, then distribute into the runtime config (the
+        ConfigMonitor -> md_config_t observer path). A value the
+        schema rejects must never reach the replicated KV — a
+        poisoned KV would re-distribute the bad value on every sync."""
         from ..utils.config import g_conf
-        try:
+        declared = name in g_conf.schema
+        if declared:
+            value = g_conf.schema[name].coerce(value)  # raises on junk
+        self.mons.config_set(name, value)  # NoQuorum -> nothing applied
+        if declared:
             g_conf.set(name, value, level="mon")
-        except KeyError:
-            pass  # not a declared runtime option; KV still holds it
+            g_log.dout("mon", 1, f"config set {name} = {value}")
 
     def _mark_down(self, osd: int) -> None:
         if not self.osdmap.osd_up[osd]:

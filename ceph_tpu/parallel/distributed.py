@@ -1,0 +1,87 @@
+"""Multi-host initialization — the DCN half of the comm backend.
+
+The reference scales across nodes with its AsyncMessenger (ref:
+src/msg/async/AsyncMessenger.cc — every OSD/mon process dials peers
+over TCP/RDMA; SURVEY.md §5 "Distributed communication backend" maps
+that to: ICI collectives inside a pod, DCN + jax.distributed across
+hosts). This module owns the process-level wiring:
+
+* `init_process()` — jax.distributed.initialize with explicit
+  coordinator/rank/size (the messenger bind+dial step). After it, every
+  process sees the GLOBAL device list and Meshes span hosts.
+* `host_mesh()` — a ("dp", "shard") mesh laid out so the shard axis
+  stays INSIDE each process's local devices (ICI) and dp crosses
+  processes (DCN). Shard-group collectives (the per-stripe
+  gather/scatter, the hot path) then never leave a host; only the
+  batch axis — which needs no communication during encode/decode —
+  spans the slow network. This is the layout rule from the scaling
+  playbook: put the bandwidth-hungry axis on the fast interconnect.
+* `global_batch()` — assemble per-host (B_local, k, L) arrays into one
+  global jax.Array over that mesh (jax.make_array_from_process_local
+  _data), the moral analog of each OSD contributing its own objects.
+
+Verified by tests/test_distributed.py, which launches REAL multiple
+processes (two jax.distributed CPU processes on localhost) and runs
+the sharded encoder over the spanning mesh — the many-daemons-one-box
+trick (qa/standalone/ceph-helpers.sh) applied to hosts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_process(coordinator: str, num_processes: int,
+                 process_id: int, local_devices: int | None = None):
+    """Join the process group (call once per process, before any other
+    jax use). Returns the jax module for convenience."""
+    import jax
+    if local_devices is not None:
+        # CPU hosts: carve N virtual local devices (tests / dev boxes)
+        jax.config.update("jax_num_cpu_devices", local_devices)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax
+
+
+def host_mesh(shard: int | None = None):
+    """Global ("dp", "shard") mesh with shard-axis locality: device
+    columns within a row belong to one process, so per-stripe
+    collectives ride ICI; rows (dp) cross hosts over DCN."""
+    import jax
+    from jax.sharding import Mesh
+
+    procs: dict[int, list] = {}
+    for d in jax.devices():
+        procs.setdefault(d.process_index, []).append(d)
+    per_host = {p: len(ds) for p, ds in procs.items()}
+    if len(set(per_host.values())) > 1:
+        # uneven hosts would contribute uneven dp-row counts, breaking
+        # the equal-local-batch contract of global_batch(); TPU pods
+        # are homogeneous, so reject loudly instead of silently
+        # dropping devices
+        raise ValueError(f"heterogeneous hosts {per_host}; host_mesh "
+                         f"needs the same device count per process")
+    n_local = next(iter(per_host.values()))
+    if shard is None:
+        shard = n_local
+    if shard < 1 or n_local % shard:
+        raise ValueError(f"shard={shard} does not divide the "
+                         f"{n_local} local devices per host")
+    rows = []
+    for p in sorted(procs):
+        ds = procs[p]
+        for i in range(0, n_local, shard):
+            rows.append(ds[i:i + shard])
+    return Mesh(np.asarray(rows), ("dp", "shard"))
+
+
+def global_batch(mesh, local: np.ndarray):
+    """Per-process (B_local, k, L) uint8 -> one global jax.Array
+    sharded (dp-major) over the mesh; B_global = sum of locals."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("dp", None, None))
+    return jax.make_array_from_process_local_data(sharding, local)

@@ -127,6 +127,16 @@ def cmd_prometheus(c, args) -> None:
 
 
 def cmd_config(c, args) -> None:
+    from ceph_tpu.mon.monitor import NoQuorum
+    try:
+        _cmd_config(c, args)
+    except NoQuorum as e:
+        raise SystemExit(f"Error: no monitor quorum ({e})")
+    except ValueError as e:
+        raise SystemExit(f"Error: {e}")
+
+
+def _cmd_config(c, args) -> None:
     if args.action == "set":
         if args.value is None:
             raise SystemExit("config set needs <name> <value>")
