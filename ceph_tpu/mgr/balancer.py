@@ -1,0 +1,146 @@
+"""Balancer — the upmap placement optimizer.
+
+Rebuild of the reference's mgr balancer module in upmap mode (ref:
+src/pybind/mgr/balancer/module.py `do_upmap`, which drives
+OSDMap::calc_pg_upmaps — greedy moves of PGs from overfull to
+underfull OSDs via pg_upmap_items entries, bounded per round by
+max_optimizations, stopping at max_deviation).
+
+TPU-first shaping: the expensive part of balancing is knowing where
+every PG currently maps — here that is ONE batched `pgs_to_up` launch
+per round (the vectorized CRUSH mapper) instead of the reference's
+per-PG loop; the load histogram and the greedy move-selection derive
+from that single array host-side.
+
+Failure-domain safety: a move is only legal if the target device does
+not put two shards of the PG into one failure domain, at the SAME
+bucket level the pool's CRUSH rule separates on (chooseleaf type) —
+host rules separate hosts, rack rules separate racks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.map import (CRUSH_ITEM_NONE, STEP_CHOOSE_FIRSTN,
+                         STEP_CHOOSE_INDEP, STEP_CHOOSELEAF_FIRSTN,
+                         STEP_CHOOSELEAF_INDEP)
+
+
+def load_from_up(up: np.ndarray, n_osds: int) -> np.ndarray:
+    """PG-shard count per OSD from a (B, size) up array."""
+    flat = np.asarray(up)
+    flat = flat[flat != CRUSH_ITEM_NONE]
+    return np.bincount(flat, minlength=n_osds)
+
+
+def device_load(osdmap, pool_id: int) -> np.ndarray:
+    """Convenience: one vectorized mapping launch -> per-OSD load
+    (the same histogram OSDMap.pg_stats exposes as pg_per_osd)."""
+    return load_from_up(osdmap.pgs_to_up(pool_id),
+                        len(osdmap.osd_weight))
+
+
+def _rule_domain_type(crush, rule_id: int) -> int:
+    """The bucket type the rule separates replicas on (the chooseleaf/
+    choose step's type); 0 (osd) when the rule picks devices directly."""
+    for step in crush.rules[rule_id].steps:
+        if step.op in (STEP_CHOOSELEAF_FIRSTN, STEP_CHOOSELEAF_INDEP,
+                       STEP_CHOOSE_FIRSTN, STEP_CHOOSE_INDEP):
+            return step.type_id
+    return 0
+
+
+def _domain_of(crush, item: int, type_id: int,
+               _parent_cache: dict | None = None) -> int | None:
+    """The ancestor bucket of `item` at `type_id` (transitive walk —
+    a rack-level domain is two levels above an osd)."""
+    if type_id == 0:
+        return item
+    parents = _parent_cache if _parent_cache is not None else {}
+    if not parents:
+        for bid, b in crush.buckets.items():
+            for it in b.items:
+                parents[it] = bid
+    cur = item
+    for _ in range(len(crush.buckets) + 1):
+        cur = parents.get(cur)
+        if cur is None:
+            return None
+        if crush.buckets[cur].type_id == type_id:
+            return cur
+    return None
+
+
+def calc_pg_upmaps(osdmap, pool_id: int, max_deviation: int = 1,
+                   max_optimizations: int = 10) -> list[tuple]:
+    """One optimization run: returns the applied
+    [((pool, ps), (from_osd, to_osd)), ...] moves (already set on the
+    map — one redirect pair per move).
+
+    Greedy: move a shard from the most-loaded OSD to the least-loaded
+    OSD that is up+in, doesn't already serve the PG, and lives in a
+    failure domain serving no other shard of it. Stops when the
+    max-min spread over up+in OSDs is within max_deviation or no legal
+    move exists.
+    """
+    crush = osdmap.crush
+    pool = osdmap.pools[pool_id]
+    dom_type = _rule_domain_type(crush, pool.crush_rule)
+    parent_cache: dict = {}
+    applied: list[tuple] = []
+    for _ in range(max_optimizations):
+        up_all = np.asarray(osdmap.pgs_to_up(pool_id))  # ONE launch
+        load = load_from_up(up_all, len(osdmap.osd_weight))
+        usable = (np.asarray(osdmap.osd_weight) > 0) \
+            & np.asarray(osdmap.osd_up)
+        in_osds = np.nonzero(usable)[0]
+        if len(in_osds) < 2:
+            break
+        sub = load[in_osds]
+        if sub.max() - sub.min() <= max_deviation:
+            break
+        overfull = int(in_osds[np.argmax(sub)])
+        targets = [int(o) for o in in_osds[np.argsort(sub, kind="stable")]
+                   if int(o) != overfull]
+        moved = False
+        for ps in np.nonzero((up_all == overfull).any(axis=1))[0]:
+            pg = (pool_id, int(ps))
+            members = [int(o) for o in up_all[ps]
+                       if o != CRUSH_ITEM_NONE]
+            doms = {_domain_of(crush, o, dom_type, parent_cache)
+                    for o in members if o != overfull}
+            raw = osdmap._raw_pg_to_osds(pool, int(ps))
+            items = osdmap.pg_upmap_items.get(pg, [])
+            # who sources overfull in this PG? Either overfull itself
+            # is in the raw mapping, or an ACTIVE redirect (f ->
+            # overfull, f in raw) produced it; rewriting an INACTIVE
+            # redirect would move the wrong OSD's shard
+            if overfull in raw:
+                src_pair = None
+            else:
+                act = [f for f, t in items
+                       if t == overfull and f in raw]
+                if not act:
+                    continue  # can't attribute the shard; skip this pg
+                src_pair = act[0]
+            for to in targets:
+                if to in members:
+                    continue
+                if _domain_of(crush, to, dom_type, parent_cache) in doms:
+                    continue  # would stack two shards in one domain
+                if src_pair is None:
+                    new_items = items + [(overfull, to)]
+                else:
+                    new_items = [(f, t) for f, t in items
+                                 if (f, t) != (src_pair, overfull)]
+                    new_items.append((src_pair, to))
+                osdmap.set_pg_upmap_items(pg, new_items)
+                applied.append((pg, (overfull, to)))
+                moved = True
+                break
+            if moved:
+                break
+        if not moved:
+            break  # no legal move improves this round
+    return applied

@@ -119,6 +119,9 @@ class OSDMap:
         self.osd_up = np.ones(n, dtype=bool)
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
+        # balancer overrides (ref: OSDMap pg_upmap_items + _apply_upmap)
+        self.pg_upmap_items: dict[tuple[int, int],
+                                  list[tuple[int, int]]] = {}
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
@@ -128,7 +131,9 @@ class OSDMap:
         """Versioned wire form: epoch, crush map, per-OSD runtime state,
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
-        e = Encoder().start(1, 1)
+        # v2 appends pg_upmap_items; compat stays 1 (a v1 reader skips
+        # the tail via the section length — the ENCODE_START contract)
+        e = Encoder().start(2, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -149,13 +154,17 @@ class OSDMap:
         e.mapping(self.primary_temp,
                   lambda en, k: en.i32(k[0]).u32(k[1]),
                   lambda en, v: en.i32(v))
+        e.mapping(self.pg_upmap_items,
+                  lambda en, k: en.i32(k[0]).u32(k[1]),
+                  lambda en, v: en.list(
+                      v, lambda e2, ft: e2.i32(ft[0]).i32(ft[1])))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        d.start(1)
+        v = d.start(2)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -177,6 +186,10 @@ class OSDMap:
                               lambda dd: dd.list(lambda e2: e2.i32()))
         m.primary_temp = d.mapping(lambda dd: (dd.i32(), dd.u32()),
                                    lambda dd: dd.i32())
+        if v >= 2:
+            m.pg_upmap_items = d.mapping(
+                lambda dd: (dd.i32(), dd.u32()),
+                lambda dd: dd.list(lambda e2: (e2.i32(), e2.i32())))
         d.finish()
         return m
 
@@ -201,7 +214,31 @@ class OSDMap:
 
     def mark_out(self, osd: int) -> None:
         self.osd_weight[osd] = 0
+        self.clean_pg_upmaps()
         self._bump()
+
+    def set_pg_upmap_items(self, pg: tuple[int, int],
+                           items: list[tuple[int, int]]) -> None:
+        """Balancer override: per-PG (from_osd, to_osd) redirects
+        (ref: `ceph osd pg-upmap-items`). Empty list clears."""
+        if items:
+            self.pg_upmap_items[pg] = [(int(f), int(t)) for f, t in items]
+        else:
+            self.pg_upmap_items.pop(pg, None)
+        self._bump()
+
+    def clean_pg_upmaps(self) -> None:
+        """Drop upmap entries that point at out OSDs (ref:
+        OSDMap::clean_pg_upmaps, run on map changes so stale balancer
+        decisions never pin data to dead devices)."""
+        for pg, items in list(self.pg_upmap_items.items()):
+            kept = [(f, t) for f, t in items
+                    if t < len(self.osd_weight) and self.osd_weight[t] > 0]
+            if len(kept) != len(items):
+                if kept:
+                    self.pg_upmap_items[pg] = kept
+                else:
+                    del self.pg_upmap_items[pg]
 
     def mark_in(self, osd: int, weight: float = 1.0) -> None:
         self.osd_weight[osd] = int(weight * 0x10000)
@@ -237,6 +274,24 @@ class OSDMap:
                                pool.size)
         return (out + [CRUSH_ITEM_NONE] * pool.size)[:pool.size]
 
+    def _apply_upmap(self, pool_id: int, ps: int,
+                     raw: list[int]) -> list[int]:
+        """pg_upmap_items overrides (ref: OSDMap::_apply_upmap): each
+        (from, to) pair redirects that OSD's slot for this PG — the
+        balancer's fine-grained placement override."""
+        items = self.pg_upmap_items.get((pool_id, ps))
+        if not items:
+            return raw
+        out = list(raw)
+        for frm, to in items:
+            if to in out:
+                continue  # a duplicate target would break slot sets
+            for i, o in enumerate(out):
+                if o == frm:
+                    out[i] = to
+                    break
+        return out
+
     def _up_from_raw(self, raw: list[int]) -> list[int]:
         """raw -> up: down OSDs become NONE holes (EC keeps slot order;
         the reference filters in _raw_to_up_osds)."""
@@ -255,7 +310,8 @@ class OSDMap:
         override pipeline: raw CRUSH -> drop down OSDs -> pg_temp /
         primary_temp."""
         pool = self.pools[pool_id]
-        raw = self._raw_pg_to_osds(pool, ps)
+        raw = self._apply_upmap(pool_id, ps,
+                                self._raw_pg_to_osds(pool, ps))
         up = self._up_from_raw(raw)
         up_primary = self._primary_of(up)
         acting = self.pg_temp.get((pool_id, ps), up)
@@ -282,7 +338,16 @@ class OSDMap:
         pps = pool.raw_pg_to_pps(ps)
         raw = np.asarray(self._vm.do_rule(pool.crush_rule, pps,
                                           self.osd_weight, pool.size))
-        raw = raw[:, :pool.size]
+        raw = raw[:, :pool.size].copy()
+        if self.pg_upmap_items:
+            # sparse host-side overlay (like pg_temp in pgs_to_acting):
+            # upmaps are rare relative to pg_num
+            pos_of = {int(p): i for i, p in enumerate(ps)}
+            for (pid, s), items in self.pg_upmap_items.items():
+                if pid != pool_id or s not in pos_of:
+                    continue
+                raw[pos_of[s]] = self._apply_upmap(
+                    pid, s, [int(o) for o in raw[pos_of[s]]])
         # down OSDs -> NONE
         down_lut = ~self.osd_up
         idx = np.clip(raw, 0, len(self.osd_up) - 1)

@@ -1,0 +1,117 @@
+"""Balancer/upmap tests (refs: OSDMap::_apply_upmap /
+clean_pg_upmaps; mgr balancer module do_upmap)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, build_hierarchy,
+                                replicated_rule)
+from ceph_tpu.mgr.balancer import calc_pg_upmaps, device_load
+from ceph_tpu.osd.osdmap import OSDMap, PGPool
+
+
+def make_map(n_osds=16, pg_num=64, size=3):
+    m = build_hierarchy(n_osds, osds_per_host=2, hosts_per_rack=4)
+    replicated_rule(m, 1, choose_type=1, firstn=True)
+    om = OSDMap(m)
+    om.add_pool(PGPool(1, pg_num=pg_num, size=size, min_size=2,
+                       crush_rule=1))
+    return om
+
+
+class TestUpmapMechanics:
+    def test_upmap_redirects_one_slot(self):
+        om = make_map()
+        up0 = om.pg_to_up_acting_osds(1, 0)[0]
+        frm = up0[1]
+        to = next(o for o in range(16)
+                  if o not in up0 and o // 2 not in {x // 2 for x in up0})
+        om.set_pg_upmap_items((1, 0), [(frm, to)])
+        up1 = om.pg_to_up_acting_osds(1, 0)[0]
+        assert up1[1] == to
+        assert up1[0] == up0[0] and up1[2] == up0[2]
+        # batched path agrees with the scalar path
+        batched = np.asarray(om.pgs_to_up(1))[0]
+        assert batched.tolist() == up1
+
+    def test_clear_and_clean(self):
+        om = make_map()
+        up0 = om.pg_to_up_acting_osds(1, 5)[0]
+        frm, to = up0[0], next(o for o in range(16) if o not in up0
+                               and o // 2 not in {x // 2 for x in up0})
+        om.set_pg_upmap_items((1, 5), [(frm, to)])
+        assert om.pg_to_up_acting_osds(1, 5)[0][0] == to
+        om.set_pg_upmap_items((1, 5), [])
+        assert om.pg_to_up_acting_osds(1, 5)[0] == up0
+        # an upmap to an OSD that later goes out is auto-dropped
+        om.set_pg_upmap_items((1, 5), [(frm, to)])
+        om.mark_out(to)
+        assert (1, 5) not in om.pg_upmap_items
+
+    def test_wire_v2_roundtrip_and_v1_compat(self):
+        om = make_map()
+        up0 = om.pg_to_up_acting_osds(1, 3)[0]
+        to = next(o for o in range(16) if o not in up0
+                  and o // 2 not in {x // 2 for x in up0})
+        om.set_pg_upmap_items((1, 3), [(up0[2], to)])
+        om2 = OSDMap.decode(om.encode())
+        assert om2.pg_upmap_items == om.pg_upmap_items
+        assert om2.pg_to_up_acting_osds(1, 3) == \
+            om.pg_to_up_acting_osds(1, 3)
+
+
+class TestBalancer:
+    def test_reduces_spread(self):
+        om = make_map(n_osds=16, pg_num=128)
+        before = device_load(om, 1)
+        in_mask = np.asarray(om.osd_weight) > 0
+        spread0 = int(before[in_mask].max() - before[in_mask].min())
+        moves = calc_pg_upmaps(om, 1, max_deviation=1,
+                               max_optimizations=64)
+        after = device_load(om, 1)
+        spread1 = int(after[in_mask].max() - after[in_mask].min())
+        assert after.sum() == before.sum()  # no shard lost
+        if spread0 > 1:
+            assert moves
+            assert spread1 < spread0
+        assert spread1 <= max(spread0, 1)
+
+    def test_moves_respect_host_separation(self):
+        om = make_map(n_osds=16, pg_num=128)
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=64)
+        up = np.asarray(om.pgs_to_up(1))
+        hosts = np.where(up == CRUSH_ITEM_NONE, -1, up // 2)
+        for row in hosts:
+            real = row[row >= 0]
+            assert len(set(real.tolist())) == len(real)
+
+    def test_noop_when_already_balanced(self):
+        om = make_map(n_osds=4, pg_num=4, size=2)
+        calc_pg_upmaps(om, 1, max_deviation=100)
+        assert om.pg_upmap_items == {}
+
+
+class TestReviewRegressions:
+    def test_down_but_in_osd_never_a_target(self):
+        om = make_map(n_osds=16, pg_num=128)
+        om.mark_down(3)  # down but still in (weight > 0)
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=64)
+        for items in om.pg_upmap_items.values():
+            assert all(t != 3 for _, t in items)
+        # and no placement round degraded a PG into the down osd
+        up = np.asarray(om.pgs_to_up(1))
+        assert not (up == 3).any()
+
+    def test_rack_rule_respects_rack_separation(self):
+        from ceph_tpu.crush.map import build_hierarchy
+        m = build_hierarchy(16, osds_per_host=2, hosts_per_rack=2)
+        replicated_rule(m, 1, choose_type=2, firstn=True)  # rack level
+        om = OSDMap(m)
+        om.add_pool(PGPool(1, pg_num=64, size=3, min_size=2,
+                           crush_rule=1))
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=64)
+        up = np.asarray(om.pgs_to_up(1))
+        racks = np.where(up == CRUSH_ITEM_NONE, -1, up // 4)
+        for row in racks:
+            real = row[row >= 0]
+            assert len(set(real.tolist())) == len(real), row
