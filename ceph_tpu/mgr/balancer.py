@@ -89,58 +89,101 @@ def calc_pg_upmaps(osdmap, pool_id: int, max_deviation: int = 1,
     dom_type = _rule_domain_type(crush, pool.crush_rule)
     parent_cache: dict = {}
     applied: list[tuple] = []
-    for _ in range(max_optimizations):
+    n_osds = len(osdmap.osd_weight)
+    while len(applied) < max_optimizations:
         up_all = np.asarray(osdmap.pgs_to_up(pool_id))  # ONE launch
-        load = load_from_up(up_all, len(osdmap.osd_weight))
-        usable = (np.asarray(osdmap.osd_weight) > 0) \
-            & np.asarray(osdmap.osd_up)
-        in_osds = np.nonzero(usable)[0]
+        load = load_from_up(up_all, n_osds).astype(np.float64)
+        w = np.asarray(osdmap.osd_weight, dtype=np.float64) / 0x10000
+        usable = (w > 0) & np.asarray(osdmap.osd_up)
+        in_osds = [int(o) for o in np.nonzero(usable)[0]]
         if len(in_osds) < 2:
             break
-        sub = load[in_osds]
-        if sub.max() - sub.min() <= max_deviation:
-            break
-        overfull = int(in_osds[np.argmax(sub)])
-        targets = [int(o) for o in in_osds[np.argsort(sub, kind="stable")]
-                   if int(o) != overfull]
-        moved = False
-        for ps in np.nonzero((up_all == overfull).any(axis=1))[0]:
-            pg = (pool_id, int(ps))
-            members = [int(o) for o in up_all[ps]
-                       if o != CRUSH_ITEM_NONE]
-            doms = {_domain_of(crush, o, dom_type, parent_cache)
-                    for o in members if o != overfull}
-            raw = osdmap._raw_pg_to_osds(pool, int(ps))
-            items = osdmap.pg_upmap_items.get(pg, [])
-            # who sources overfull in this PG? Either overfull itself
-            # is in the raw mapping, or an ACTIVE redirect (f ->
-            # overfull, f in raw) produced it; rewriting an INACTIVE
-            # redirect would move the wrong OSD's shard
-            if overfull in raw:
-                src_pair = None
-            else:
-                act = [f for f, t in items
-                       if t == overfull and f in raw]
-                if not act:
-                    continue  # can't attribute the shard; skip this pg
-                src_pair = act[0]
-            for to in targets:
-                if to in members:
-                    continue
-                if _domain_of(crush, to, dom_type, parent_cache) in doms:
-                    continue  # would stack two shards in one domain
-                if src_pair is None:
+        # deviation vs the WEIGHT-PROPORTIONAL target (a half-weight
+        # device should carry half the PGs; equalizing raw counts
+        # would fight CRUSH — the reference measures the same way)
+        total = load[usable].sum()
+        wsum = w[in_osds].sum()
+        expected = {o: total * w[o] / wsum for o in in_osds}
+
+        def dev(o):
+            return load[o] - expected[o]
+
+        # many moves per mapping launch: update the load histogram
+        # incrementally and only re-launch when a full pass over the
+        # candidates makes no further progress
+        moved_pgs: set[int] = set()
+        round_moves = 0
+        progress = True
+        while progress and len(applied) < max_optimizations:
+            progress = False
+            devs = sorted(in_osds, key=dev, reverse=True)
+            if dev(devs[0]) - dev(devs[-1]) <= max_deviation:
+                break
+            for overfull in devs:
+                if dev(overfull) <= 0:
+                    break  # nothing left that is actually overfull
+                targets = sorted((o for o in in_osds if o != overfull),
+                                 key=dev)
+                hit = self_move = None
+                for ps in np.nonzero(
+                        (up_all == overfull).any(axis=1))[0]:
+                    ps = int(ps)
+                    if ps in moved_pgs:
+                        continue  # up_all is stale for moved pgs
+                    pg = (pool_id, ps)
+                    raw = osdmap._raw_pg_to_osds(pool, ps)
+                    # domain safety derives from the RAW set: a
+                    # down-but-in member still owns its slot, and
+                    # stacking into its domain breaks separation the
+                    # moment it rejoins
+                    members = {int(o) for o in raw
+                               if o != CRUSH_ITEM_NONE}
+                    for _f, t in osdmap.pg_upmap_items.get(pg, []):
+                        members.add(t)
+                    doms = {_domain_of(crush, o, dom_type, parent_cache)
+                            for o in members if o != overfull}
+                    items = osdmap.pg_upmap_items.get(pg, [])
+                    # who sources overfull here? Either overfull is in
+                    # the raw mapping, or an ACTIVE redirect (f ->
+                    # overfull, f in raw) produced it; rewriting an
+                    # inactive redirect would move the wrong shard
+                    if overfull in raw:
+                        src_pair = None
+                    else:
+                        act = [f for f, t in items
+                               if t == overfull and f in raw]
+                        if not act:
+                            continue
+                        src_pair = act[0]
+                    for to in targets:
+                        if dev(to) >= dev(overfull) - 1:
+                            break  # no target improves balance
+                        if to in members:
+                            continue
+                        if _domain_of(crush, to, dom_type,
+                                      parent_cache) in doms:
+                            continue  # two shards in one domain
+                        hit, self_move = (pg, ps, items, to), src_pair
+                        break
+                    if hit:
+                        break
+                if not hit:
+                    continue  # this osd is stuck; try the next
+                pg, ps, items, to = hit
+                if self_move is None:
                     new_items = items + [(overfull, to)]
                 else:
                     new_items = [(f, t) for f, t in items
-                                 if (f, t) != (src_pair, overfull)]
-                    new_items.append((src_pair, to))
+                                 if (f, t) != (self_move, overfull)]
+                    new_items.append((self_move, to))
                 osdmap.set_pg_upmap_items(pg, new_items)
                 applied.append((pg, (overfull, to)))
-                moved = True
-                break
-            if moved:
-                break
-        if not moved:
-            break  # no legal move improves this round
+                moved_pgs.add(ps)
+                load[overfull] -= 1
+                load[to] += 1
+                round_moves += 1
+                progress = True
+                break  # re-rank deviations after every move
+        if round_moves == 0:
+            break  # a full relaunch would see the same stuck state
     return applied

@@ -1,0 +1,56 @@
+"""pg_autoscaler — PG-count recommendations.
+
+Rebuild of the reference's autoscaler mgr module (ref: src/pybind/mgr/
+pg_autoscaler/module.py — for each pool: ideal pg_num = in-OSD count *
+mon_target_pg_per_osd * pool's capacity share / pool size, rounded to
+a power of two; a change is only recommended when the current value is
+off by more than the threshold factor (default 3.0), because pg_num
+changes cause mass data movement and must not flap).
+
+Scope note: like the reference module in `warn` mode, this produces
+RECOMMENDATIONS; actually re-splitting PGs online is the OSD-side
+pg_split machinery, out of this slice's scope (SURVEY §2 names the
+autoscaler; splitting lives in the non-target BlueStore/PG internals).
+"""
+
+from __future__ import annotations
+
+
+def _pow2_round(x: float) -> int:
+    """Nearest power of two (>= 1), the reference's nearest_power."""
+    if x <= 1:
+        return 1
+    lo = 1 << (int(x).bit_length() - 1)
+    hi = lo << 1
+    return lo if x / lo < hi / x else hi
+
+
+def recommend_pg_num(osdmap, pool_id: int,
+                     target_pg_per_osd: int = 100,
+                     threshold: float = 3.0) -> dict:
+    """Autoscale advice for one pool. capacity share is split evenly
+    across pools (the sim carries no per-pool byte usage)."""
+    if threshold < 1.0:
+        raise ValueError(f"threshold {threshold} must be >= 1.0")
+    pool = osdmap.pools[pool_id]
+    n_in = int((osdmap.osd_weight > 0).sum())
+    share = 1.0 / max(1, len(osdmap.pools))
+    ideal = max(1.0, n_in * target_pg_per_osd * share / pool.size)
+    recommended = _pow2_round(ideal)
+    ratio = (pool.pg_num / recommended if pool.pg_num >= recommended
+             else recommended / pool.pg_num)
+    return {
+        "pool_id": pool_id,
+        "pg_num_current": pool.pg_num,
+        "pg_num_ideal": round(ideal, 1),
+        "pg_num_recommended": recommended,
+        "would_adjust": ratio > threshold,
+        "reason": (f"{n_in} in-osds x {target_pg_per_osd} target/osd "
+                   f"x {share:.2f} share / size {pool.size}"),
+    }
+
+
+def autoscale_status(osdmap, target_pg_per_osd: int = 100,
+                     threshold: float = 3.0) -> list[dict]:
+    return [recommend_pg_num(osdmap, pid, target_pg_per_osd, threshold)
+            for pid in sorted(osdmap.pools)]

@@ -137,6 +137,10 @@ class SimCluster:
         # carrying an older epoch are rejected with the current map
         # (the reference OSD's require_same_or_newer_map behavior)
         self.pg_changed_epoch: dict[int, int] = {}
+        # per-op stage tracking on the client path (ref: OpTracker/
+        # TrackedOp, dump_historic_ops on the admin socket)
+        from ..utils.op_tracker import OpTracker
+        self.op_tracker = OpTracker()
         self.perf = (PerfCountersBuilder("cluster")
                      .add_u64_counter("recovered_objects")
                      .add_u64_counter("log_replayed_objects")
@@ -261,6 +265,13 @@ class SimCluster:
         process is dead — the signals that make the Objecter refresh +
         retarget (ref: OSD require_same_or_newer_map + map sharing;
         lossy client connections)."""
+        with self.op_tracker.create_op(
+                f"client_rpc {kind} pg 1.{ps} -> osd.{target_osd}") as op:
+            return self._client_rpc_tracked(op, target_osd, epoch, kind,
+                                            ps, payload)
+
+    def _client_rpc_tracked(self, op, target_osd: int, epoch: int,
+                            kind: str, ps: int, payload):
         if epoch < self.pg_changed_epoch.get(ps, 0):
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} remapped at epoch "
@@ -284,12 +295,16 @@ class SimCluster:
         if not res.serviceable:
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} is {res.state}; op parked")
+        op.mark_event("reached_pg")  # map checks + peering gate passed
         dead = self._dead_osds()
         if kind in ("write", "write_ranges", "remove"):
             self._apply_write(ps, kind, payload, dead)
+            op.mark_event("commit_sent")
             return None
         if kind == "read":
-            return self.pgs[ps].read_objects(payload, dead_osds=dead)
+            out = self.pgs[ps].read_objects(payload, dead_osds=dead)
+            op.mark_event("reply_sent")
+            return out
         raise ValueError(f"unknown client op kind {kind!r}")
 
     # -- failure model ------------------------------------------------------

@@ -115,3 +115,87 @@ class TestReviewRegressions:
         for row in racks:
             real = row[row >= 0]
             assert len(set(real.tolist())) == len(real), row
+
+
+class TestAutoscaler:
+    def test_recommendation_shape_and_pow2(self):
+        from ceph_tpu.mgr.pg_autoscaler import (autoscale_status,
+                                                recommend_pg_num)
+        om = make_map(n_osds=16, pg_num=8, size=3)
+        r = recommend_pg_num(om, 1, target_pg_per_osd=100)
+        # 16 osds * 100 / 3 ~ 533 -> pow2 512
+        assert r["pg_num_recommended"] == 512
+        assert r["would_adjust"]  # 8 vs 512 is way past threshold
+        assert (r["pg_num_recommended"]
+                & (r["pg_num_recommended"] - 1)) == 0
+        rows = autoscale_status(om)
+        assert len(rows) == 1 and rows[0]["pool_id"] == 1
+
+    def test_within_threshold_no_adjust(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = make_map(n_osds=16, pg_num=256, size=3)
+        r = recommend_pg_num(om, 1, target_pg_per_osd=100)
+        assert r["pg_num_recommended"] == 512
+        assert not r["would_adjust"]  # 256 vs 512 is 2x < 3x threshold
+
+    def test_out_osds_shrink_recommendation(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = make_map(n_osds=16, pg_num=256, size=3)
+        for o in range(8):
+            om.mark_out(o)
+        r = recommend_pg_num(om, 1)
+        assert r["pg_num_recommended"] == 256  # 8*100/3 ~ 267 -> 256
+
+
+def test_cluster_balancer_triggers_pg_temp_backfills():
+    # upmap moves on a LIVE cluster repeer into pg_temp backfills and
+    # data stays byte-exact through the migration
+    from cluster_helpers import corpus, make_cluster
+    from ceph_tpu.mgr.balancer import calc_pg_upmaps
+    c = make_cluster(n_osds=12, pg_num=16)
+    objs = corpus(48, 400, seed=11)
+    c.write(objs)
+    moves = calc_pg_upmaps(c.osdmap, 1, max_deviation=1,
+                           max_optimizations=40)
+    if moves:
+        c._repeer_all()
+        for _ in range(120):
+            if not c.backfills:
+                break
+            c.tick(6)
+        assert not c.backfills
+    assert c.verify_all(objs) == len(objs)
+    for be in c.pgs.values():
+        assert be.shallow_scrub()["errors"] == []
+
+
+class TestReviewRegressions2:
+    def test_domains_derive_from_raw_not_up(self):
+        # a down-but-in OSD still owns its slot: balancing while it is
+        # down must not stack another shard into its failure domain
+        om = make_map(n_osds=16, pg_num=128)
+        om.mark_down(6)
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=64)
+        pool = om.pools[1]
+        for ps in range(pool.pg_num):
+            raw = om._apply_upmap(1, ps, om._raw_pg_to_osds(pool, ps))
+            hosts = [o // 2 for o in raw if o != CRUSH_ITEM_NONE]
+            assert len(set(hosts)) == len(hosts), (ps, raw)
+
+    def test_weight_proportional_targets(self):
+        # a quarter-weight device must NOT be filled to uniform count
+        om = make_map(n_osds=16, pg_num=256)
+        om.mark_in(0, weight=0.25)
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=200)
+        load = device_load(om, 1)
+        mean_full = load[1:].mean()
+        assert load[0] < 0.6 * mean_full, (load[0], mean_full)
+
+    def test_partial_balance_when_top_osd_stuck(self):
+        # even if the most-loaded osd has no legal move, others are
+        # still balanced (no premature give-up) — exercised simply by
+        # checking convergence still happens on a normal map
+        om = make_map(n_osds=16, pg_num=128)
+        calc_pg_upmaps(om, 1, max_deviation=1, max_optimizations=128)
+        load = device_load(om, 1)
+        assert load.max() - load.min() <= 2

@@ -196,3 +196,21 @@ class TestRuntimeIPC:
                 assert runtime_ping()
             finally:
                 set_runtime_socket(None)
+
+
+def test_sanitizer_harness_clean():
+    """ASAN+UBSAN build + standalone ABI harness must pass (SURVEY §5
+    sanitizers; skipped if the toolchain lacks libasan)."""
+    import shutil
+    import subprocess
+    if not shutil.which("g++"):
+        import pytest
+        pytest.skip("no g++")
+    r = subprocess.run(["make", "-C", "native", "sancheck"],
+                       capture_output=True, text=True, timeout=300)
+    if "asan" in (r.stdout + r.stderr).lower() and r.returncode != 0 \
+            and "cannot find" in (r.stdout + r.stderr):
+        import pytest
+        pytest.skip("libasan unavailable")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sancheck OK" in r.stdout

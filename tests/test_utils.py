@@ -251,3 +251,24 @@ class TestTracing:
         if ok:
             import os
             assert any(os.scandir(str(tmp_path)))
+
+
+class TestOpTracking:
+    def test_client_rpc_ops_are_tracked(self):
+        from cluster_helpers import corpus, make_cluster
+        from ceph_tpu.client.objecter import Objecter
+        c = make_cluster(pg_num=2)
+        ob = Objecter(c)
+        objs = corpus(4, 200, seed=30)
+        ob.write(objs)
+        ob.read(list(objs))
+        hist = c.op_tracker.dump_historic_ops()
+        assert hist["num_ops"] >= 2
+        descs = " ".join(o["description"] for o in hist["ops"])
+        assert "client_rpc write" in descs
+        assert "client_rpc read" in descs
+        events = [ev["event"] for o in hist["ops"]
+                  for ev in o["type_data"]["events"]]
+        assert "reached_pg" in events
+        inflight = c.op_tracker.dump_ops_in_flight()
+        assert inflight.get("num_ops", inflight.get("num", 0)) == 0

@@ -168,6 +168,8 @@ def main(argv=None) -> None:
     perf = sub.add_parser("perf")
     perf.add_argument("perf_cmd", choices=["dump"])
     sub.add_parser("prometheus")
+    sub.add_parser("autoscale-status")
+    sub.add_parser("balancer")
     cfg = sub.add_parser("config")
     cfg.add_argument("action", choices=["set", "get", "dump"])
     cfg.add_argument("name", nargs="?")
@@ -185,6 +187,31 @@ def main(argv=None) -> None:
         cmd_perf_dump(c, args)
     elif args.cmd == "prometheus":
         cmd_prometheus(c, args)
+    elif args.cmd == "autoscale-status":
+        from ceph_tpu.mgr.pg_autoscaler import autoscale_status
+        rows = autoscale_status(c.osdmap)
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            for r in rows:
+                print(f"  pool {r['pool_id']}: pg_num "
+                      f"{r['pg_num_current']} -> recommend "
+                      f"{r['pg_num_recommended']} "
+                      f"({'ADJUST' if r['would_adjust'] else 'ok'}; "
+                      f"{r['reason']})")
+    elif args.cmd == "balancer":
+        import numpy as np
+        from ceph_tpu.mgr.balancer import calc_pg_upmaps, device_load
+        in_mask = np.asarray(c.osdmap.osd_weight) > 0  # out osds are 0
+        before = device_load(c.osdmap, 1)[in_mask]
+        moves = calc_pg_upmaps(c.osdmap, 1, max_optimizations=100)
+        after = device_load(c.osdmap, 1)[in_mask]
+        if moves:
+            c._repeer_all()  # upmapped PGs start pg_temp backfills
+        print(f"  {len(moves)} upmap move(s); per-osd pg spread "
+              f"{int(before.max() - before.min())} -> "
+              f"{int(after.max() - after.min())}; "
+              f"{len(c.backfills)} backfill(s) started")
     elif args.cmd == "config":
         if args.action in ("set", "get") and not args.name:
             raise SystemExit(f"config {args.action} needs a name")
