@@ -163,11 +163,39 @@ def _make_jitted(matrix_bytes: bytes, m: int, k: int, impl: str):
     return jax.jit(fn)
 
 
-def make_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL):
+def pow2_bucket(n: int) -> int:
+    """Next power of two >= n (>= 1): the shared batch-bucketing rule
+    that keeps variable per-PG batch sizes from compiling one XLA
+    program per distinct B."""
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+def make_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
+                 bucket_batch: bool = False):
     """Jitted closure computing matrix @ data for a fixed matrix.
 
     Works for encode (coding matrix) and decode (decode matrix) alike —
     both are static-matrix GF matmuls over (batch, shard, L) uint8.
+
+    bucket_batch: pad the batch dim up to the next power of two (and
+    slice the result back). The cluster write/recovery paths see
+    arbitrary per-PG batch sizes; without bucketing every distinct B
+    compiles its own program (XLA shapes are static), turning small
+    mixed batches into compile churn. Benchmarks keep it OFF so their
+    measured bytes match the computed bytes exactly.
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
-    return _make_jitted(matrix.tobytes(), *matrix.shape, impl)
+    jitted = _make_jitted(matrix.tobytes(), *matrix.shape, impl)
+    if not bucket_batch:
+        return jitted
+
+    def run(data):
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        B = data.shape[0]
+        bucket = pow2_bucket(B)
+        if bucket == B:
+            return jitted(data)
+        pad = [(0, bucket - B)] + [(0, 0)] * (data.ndim - 1)
+        return jitted(jnp.pad(data, pad))[:B]
+
+    return run

@@ -118,9 +118,20 @@ class PGBackend:
     @staticmethod
     def _batched_crcs(blocks: np.ndarray) -> np.ndarray:
         """One device launch for a (B, L) stack of byte rows -> (B,)
-        uint32 CRCs (raw register, seed -1 — the HashInfo convention)."""
+        uint32 CRCs (raw register, seed -1 — the HashInfo convention).
+        The row count is bucketed to a power of two: per-PG batches
+        vary freely and each distinct B would otherwise compile its
+        own program."""
         from ..csum.kernels import crc32c_blocks
-        return np.asarray(crc32c_blocks(blocks, init=0xFFFFFFFF, xorout=0))
+        from ..ops.rs_kernels import pow2_bucket
+        blocks = np.asarray(blocks, dtype=np.uint8)
+        B = blocks.shape[0]
+        bucket = pow2_bucket(B)
+        if bucket != B:
+            blocks = np.pad(blocks, ((0, bucket - B), (0, 0)))
+        out = np.asarray(crc32c_blocks(blocks, init=0xFFFFFFFF,
+                                       xorout=0))
+        return out[:B]
 
     # -- contract (ref: PGBackend.h pure virtuals) ---------------------------
 

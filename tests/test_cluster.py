@@ -133,3 +133,40 @@ def test_revive_destroyed_osd_refused():
     c.destroy_osd(2)
     with pytest.raises(ValueError, match="destroyed"):
         c.revive_osd(2)
+
+
+def test_thrash_with_monitor_churn_no_data_loss():
+    """Thrash OSDs AND monitors together: map changes stall whenever
+    quorum is lost and resume when it heals; every byte survives."""
+    c = make_cluster(n_osds=14, pg_num=8, down_out_interval=30.0,
+                     n_mons=5)
+    rng = np.random.default_rng(7)
+    all_objs: dict[str, np.ndarray] = {}
+    alive_pool = set(range(14))
+    for round_i in range(4):
+        fresh = {f"m{round_i}-o{i}": rng.integers(0, 256, size=400,
+                                                  dtype=np.uint8)
+                 for i in range(6)}
+        c.write(fresh)
+        all_objs.update(fresh)
+        # drop monitors to exactly lose quorum on odd rounds
+        downed_mons = []
+        if round_i % 2:
+            downed_mons = list(rng.choice(5, size=3, replace=False))
+            for m in downed_mons:
+                c.kill_mon(int(m))
+        victim = int(rng.choice(sorted(alive_pool)))
+        alive_pool.discard(victim)
+        c.destroy_osd(victim)
+        c.tick(30.0)
+        if downed_mons:
+            # no quorum: the dead OSD is still 'up' in the frozen map
+            assert c.health()["mon_quorum"] is None
+            assert bool(c.osdmap.osd_up[victim])
+            for m in downed_mons:
+                c.revive_mon(int(m))
+        c.tick(30.0)   # detect (now under quorum)
+        c.tick(40.0)   # out + recover
+        assert c.verify_all(all_objs) == len(all_objs)
+        assert c.health()["pgs_degraded"] == 0
+    assert c.perf.get("recovered_objects") > 0

@@ -208,10 +208,17 @@ def main(argv=None) -> None:
         after = device_load(c.osdmap, 1)[in_mask]
         if moves:
             c._repeer_all()  # upmapped PGs start pg_temp backfills
-        print(f"  {len(moves)} upmap move(s); per-osd pg spread "
-              f"{int(before.max() - before.min())} -> "
-              f"{int(after.max() - after.min())}; "
-              f"{len(c.backfills)} backfill(s) started")
+        result = {"moves": len(moves),
+                  "spread_before": int(before.max() - before.min()),
+                  "spread_after": int(after.max() - after.min()),
+                  "backfills_started": len(c.backfills)}
+        if args.json:
+            print(json.dumps(result))
+        else:
+            print(f"  {result['moves']} upmap move(s); per-osd pg "
+                  f"spread {result['spread_before']} -> "
+                  f"{result['spread_after']}; "
+                  f"{result['backfills_started']} backfill(s) started")
     elif args.cmd == "config":
         if args.action in ("set", "get") and not args.name:
             raise SystemExit(f"config {args.action} needs a name")
