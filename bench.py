@@ -125,7 +125,7 @@ def bench_encode_impls(impls):
     results = {}
     for impl in impls:
         try:
-            fn = make_encoder(matrix, impl)
+            fn = make_encoder(matrix, impl, bucket_batch=False)
             got = np.asarray(fn(small))
             if not (got == want).all():
                 raise AssertionError(f"impl {impl} output != oracle")
@@ -163,7 +163,7 @@ def bench_decode():
     # gate: decode oracle-encoded survivors, compare rebuilt shards
     rng = np.random.default_rng(12)
     small = rng.integers(0, 256, size=(2, K, 8192), dtype=np.uint8)
-    fn = make_encoder(D, "mxu")
+    fn = make_encoder(D, "mxu", bucket_batch=False)
     full = [np.concatenate([small[b], encode_ref(matrix, small[b])], axis=0)
             for b in range(2)]
     surv = np.stack([f[survivors] for f in full])

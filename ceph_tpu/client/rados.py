@@ -172,6 +172,24 @@ class RadosStriper:
             out[lpos - offset:lpos - offset + len(piece)] = piece
         return out.tobytes()
 
+    def truncate(self, soid: str, new_size: int,
+                 zero_chunk: int = 1 << 20) -> None:
+        """Shrink (or grow) the logical stream. A shrink ZEROES the
+        discarded range before dropping the size, so a later re-grow
+        reads zeros there, not resurrected bytes (the block-device
+        contract; the reference trims/zeroes objects)."""
+        if new_size < 0:
+            raise ValueError(f"truncate to {new_size} < 0")
+        old = self.size(soid)
+        if new_size < old:
+            pos = new_size
+            while pos < old:
+                n = min(zero_chunk, old - pos)
+                self.write(soid, b"\x00" * n, offset=pos)
+                pos += n
+        self.io.write_full(self._meta(soid),
+                           new_size.to_bytes(8, "little"))
+
     def remove(self, soid: str) -> None:
         total = self.size(soid)
         qs = {q for q, _, _, _ in self._extents(0, max(total, 1))}

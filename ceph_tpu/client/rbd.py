@@ -1,0 +1,122 @@
+"""librbd-shaped block-image API over the striper.
+
+Rebuild of the reference's block-device surface shape (ref:
+src/librbd/ — `rbd create/resize/remove`, Image::{read,write,size};
+python binding shape ref: src/pybind/rbd/rbd.pyx RBD()/Image()). An
+RBD image IS striped rados objects plus a small header recording
+size/order — exactly what RadosStriper already provides — so this
+layer is deliberately thin: naming, header bookkeeping, bounds
+checking, resize semantics. Snapshots/clones/journaling are out of the
+target slice (SURVEY.md marks L8 services as context).
+
+Layout compatibility note: the reference stores data objects as
+`rbd_data.<id>.<object_no:016x>` with one object per object_size span;
+here objects are the striper's `<name>.<q:016x>` pieces with
+stripe_unit round-robin (the reference supports the same fancy
+striping via --stripe-unit/--stripe-count).
+"""
+
+from __future__ import annotations
+
+from .rados import IoCtx, RadosStriper
+
+
+class RBD:
+    """Image administration (the RBD() role)."""
+
+    def __init__(self, ioctx: IoCtx, stripe_unit: int = 1 << 16,
+                 stripe_count: int = 4, object_size: int = 1 << 22):
+        self.io = ioctx
+        self._geom = (stripe_unit, stripe_count, object_size)
+
+    def _hdr(self, name: str) -> str:
+        return f"rbd_header.{name}"
+
+    def create(self, name: str, size: int) -> "Image":
+        if size < 0:
+            raise ValueError(f"size {size} < 0")
+        if self._exists(name):
+            raise FileExistsError(f"image {name!r} exists")
+        self.io.write_full(self._hdr(name),
+                           size.to_bytes(8, "little"))
+        return Image(self, name)
+
+    def _exists(self, name: str) -> bool:
+        try:
+            self.io.read(self._hdr(name))
+            return True
+        except KeyError:
+            return False
+
+    def list(self) -> list[str]:
+        pre = "rbd_header."
+        return sorted(n[len(pre):] for n in self.io.list_objects()
+                      if n.startswith(pre))
+
+    def remove(self, name: str) -> None:
+        img = Image(self, name)  # raises if missing
+        st = img._striper
+        try:
+            st.remove(f"rbd_data.{name}")
+        except KeyError:
+            pass  # never written
+        self.io.remove(self._hdr(name))
+
+
+class Image:
+    """One open image (the Image() role): bounds-checked random-access
+    byte I/O over the striped data objects."""
+
+    def __init__(self, rbd: RBD, name: str):
+        self.rbd = rbd
+        self.name = name
+        su, sc, osz = rbd._geom
+        self._striper = RadosStriper(rbd.io, stripe_unit=su,
+                                     stripe_count=sc, object_size=osz)
+        self._soid = f"rbd_data.{name}"
+        self.size()  # existence check
+
+    def size(self) -> int:
+        return int.from_bytes(self.rbd.io.read(
+            self.rbd._hdr(self.name)), "little")
+
+    def resize(self, new_size: int) -> None:
+        """Grow or shrink. A shrink really discards the bytes past the
+        boundary (striper truncate zeroes them), so a later re-grow
+        reads zeros there — the block-device contract."""
+        if new_size < 0:
+            raise ValueError(f"size {new_size} < 0")
+        if new_size < self.size():
+            try:
+                self._striper.truncate(self._soid, new_size)
+            except KeyError:
+                pass  # nothing ever written; nothing to discard
+        self.rbd.io.write_full(self.rbd._hdr(self.name),
+                               new_size.to_bytes(8, "little"))
+
+    def write(self, offset: int, data: bytes) -> int:
+        end = offset + len(data)
+        if offset < 0 or end > self.size():
+            raise ValueError(
+                f"write [{offset}, {end}) outside image size "
+                f"{self.size()}")
+        self._striper.write(self._soid, data, offset=offset)
+        return len(data)
+
+    def read(self, offset: int, length: int) -> bytes:
+        size = self.size()
+        if offset < 0 or offset > size:
+            raise ValueError(f"read offset {offset} outside size {size}")
+        length = min(length, size - offset)
+        if length <= 0:
+            return b""
+        got = self._striper_read(offset, length)
+        # sparse regions (never written) read as zeros, like a block dev
+        return got.ljust(length, b"\x00")
+
+    def _striper_read(self, offset: int, length: int) -> bytes:
+        try:
+            return self._striper.read(self._soid, length=length,
+                                      offset=offset)
+        except KeyError:
+            return b""  # nothing written yet

@@ -41,8 +41,7 @@ class ReedSolomon(ErasureCode):
         if self.k < 1 or self.m < 1 or self.k + self.m > 256:
             raise ValueError(f"bad geometry k={self.k} m={self.m} (w=8)")
         self.matrix = coding_matrix(technique, self.k, self.m)
-        self._encode_fn = make_encoder(self.matrix, self.impl,
-                                       bucket_batch=True)
+        self._encode_fn = make_encoder(self.matrix, self.impl)
         self._decode_cache: dict[tuple[tuple[int, ...], tuple[int, ...]], tuple] = {}
 
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
@@ -53,8 +52,7 @@ class ReedSolomon(ErasureCode):
         hit = self._decode_cache.get(key)
         if hit is None:
             D = decode_matrix(self.matrix, list(erasures), self.k, list(survivors))
-            hit = (make_encoder(D, self.impl, bucket_batch=True),
-                   survivors)
+            hit = (make_encoder(D, self.impl), survivors)
             self._decode_cache[key] = hit
         return hit
 

@@ -170,32 +170,35 @@ def pow2_bucket(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
+def run_bucketed(fn, arr):
+    """Call `fn` with `arr`'s leading dim padded to the pow2 bucket and
+    slice the result back — the ONE implementation of the bucketing
+    idiom (encoders, CRC stacks, anything row-batched)."""
+    arr = jnp.asarray(arr)
+    B = arr.shape[0]
+    bucket = pow2_bucket(B)
+    if bucket != B:
+        arr = jnp.pad(arr, [(0, bucket - B)] + [(0, 0)] * (arr.ndim - 1))
+    return fn(arr)[:B]
+
+
 def make_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
-                 bucket_batch: bool = False):
+                 bucket_batch: bool = True):
     """Jitted closure computing matrix @ data for a fixed matrix.
 
     Works for encode (coding matrix) and decode (decode matrix) alike —
     both are static-matrix GF matmuls over (batch, shard, L) uint8.
 
-    bucket_batch: pad the batch dim up to the next power of two (and
-    slice the result back). The cluster write/recovery paths see
-    arbitrary per-PG batch sizes; without bucketing every distinct B
-    compiles its own program (XLA shapes are static), turning small
-    mixed batches into compile churn. Benchmarks keep it OFF so their
+    bucket_batch (DEFAULT ON): pad the batch dim up to the next power
+    of two (and slice the result back). Cluster write/recovery paths
+    see arbitrary per-PG batch sizes; without bucketing every distinct
+    B compiles its own program (XLA shapes are static), turning small
+    mixed batches into compile churn. Benchmarks pass False so their
     measured bytes match the computed bytes exactly.
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     jitted = _make_jitted(matrix.tobytes(), *matrix.shape, impl)
     if not bucket_batch:
         return jitted
-
-    def run(data):
-        data = jnp.asarray(data, dtype=jnp.uint8)
-        B = data.shape[0]
-        bucket = pow2_bucket(B)
-        if bucket == B:
-            return jitted(data)
-        pad = [(0, bucket - B)] + [(0, 0)] * (data.ndim - 1)
-        return jitted(jnp.pad(data, pad))[:B]
-
-    return run
+    return lambda data: run_bucketed(jitted,
+                                     jnp.asarray(data, jnp.uint8))

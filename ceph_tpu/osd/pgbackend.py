@@ -123,15 +123,10 @@ class PGBackend:
         vary freely and each distinct B would otherwise compile its
         own program."""
         from ..csum.kernels import crc32c_blocks
-        from ..ops.rs_kernels import pow2_bucket
-        blocks = np.asarray(blocks, dtype=np.uint8)
-        B = blocks.shape[0]
-        bucket = pow2_bucket(B)
-        if bucket != B:
-            blocks = np.pad(blocks, ((0, bucket - B), (0, 0)))
-        out = np.asarray(crc32c_blocks(blocks, init=0xFFFFFFFF,
-                                       xorout=0))
-        return out[:B]
+        from ..ops.rs_kernels import run_bucketed
+        return np.asarray(run_bucketed(
+            lambda b: crc32c_blocks(b, init=0xFFFFFFFF, xorout=0),
+            np.asarray(blocks, dtype=np.uint8)))
 
     # -- contract (ref: PGBackend.h pure virtuals) ---------------------------
 

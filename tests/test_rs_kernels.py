@@ -84,4 +84,19 @@ def test_traced_matrix_batched():
 
 def test_make_encoder_caches():
     mat = reed_sol_van_matrix(4, 2)
-    assert K.make_encoder(mat) is K.make_encoder(mat.copy())
+    # the jitted program is cached by matrix bytes; the default
+    # bucketing wrapper is a thin lambda over that shared program
+    assert K.make_encoder(mat, bucket_batch=False) \
+        is K.make_encoder(mat.copy(), bucket_batch=False)
+    assert K._make_jitted(mat.tobytes(), 2, 4, K.DEFAULT_IMPL) \
+        is K._make_jitted(mat.copy().tobytes(), 2, 4, K.DEFAULT_IMPL)
+
+
+def test_bucketed_encoder_matches_exact():
+    mat = reed_sol_van_matrix(4, 2)
+    rng = np.random.default_rng(3)
+    for B in (1, 3, 5, 8):
+        d = rng.integers(0, 256, (B, 4, 512), np.uint8)
+        a = np.asarray(K.make_encoder(mat)(d))               # bucketed
+        b = np.asarray(K.make_encoder(mat, bucket_batch=False)(d))
+        assert np.array_equal(a, b), B

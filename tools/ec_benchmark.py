@@ -117,7 +117,7 @@ def run_bench(plugin: str, profile: dict, size: int, batch: int,
                 sc.encode(data, out=out_buf)
             dt = time.perf_counter() - t0
         else:
-            fn = make_encoder(mat, impl_used)
+            fn = make_encoder(mat, impl_used, bucket_batch=False)
             operand = jax.device_put(data)
             fn(operand).block_until_ready()  # warmup / compile
             t0 = time.perf_counter()
