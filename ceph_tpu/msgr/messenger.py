@@ -1,0 +1,385 @@
+"""Messenger — typed, CRC-protected, lossless-peer RPC.
+
+Rebuild of the reference's wire layer (ref: src/msg/Messenger.h
+Messenger/Connection/Dispatcher; src/msg/async/AsyncMessenger.cc —
+listen + per-connection state machines; src/msg/async/ProtocolV2.cc —
+banner exchange, crc-protected frame segments, and RESET/reconnect
+semantics; src/messages/*.h — typed Message subclasses). The control
+plane the sim runs in-process gets a real cross-process transport
+here: the native EC shim already crosses processes for DATA (its unix
+socket), this module is the typed CONTROL path (the role MOSDPing /
+MOSDPGLog / mon messages play).
+
+Scope and mapping (SURVEY §2.5/§5): bulk data movement between chips
+is ICI/DCN collectives, NOT this messenger — so this layer stays small
+and correctness-first. Implemented faithfully:
+
+* banner + identity handshake carrying the receiver's last-seen
+  sequence number per peer, so a reconnect resumes exactly where the
+  stream broke (the lossless_peer policy's replay);
+* frames `[u32 len][u64 seq][u16 type][payload][u32 crc32c]` — the
+  crc covers everything before it; a corrupt frame kills the
+  connection (ProtocolV2 crc mode behavior), and the sender's replay
+  queue redelivers on reconnect;
+* explicit ACKs retire the sender's unacked queue; receivers dedup by
+  (peer, seq) so redelivery is exactly-once upward;
+* a Dispatcher callback per message type (ms_fast_dispatch role).
+
+Threading model: one reader thread per connection + locked writers
+(the reference runs epoll worker threads; blocking threads keep this
+deterministic and dependency-free).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+
+from ..csum.reference import ceph_crc32c
+from ..utils.encoding import Decoder, Encoder
+
+BANNER = b"ceph_tpu msgr v2\n"
+ACK_TYPE = 0
+
+_MSG_TYPES: dict[int, type] = {}
+
+
+def register_message(cls):
+    """Class decorator: register a Message subclass by its type_id."""
+    tid = cls.type_id
+    if tid in _MSG_TYPES and _MSG_TYPES[tid] is not cls:
+        raise ValueError(f"message type {tid} already registered")
+    if tid == ACK_TYPE:
+        raise ValueError("type 0 is reserved for ACK")
+    _MSG_TYPES[tid] = cls
+    return cls
+
+
+class Message:
+    """Typed payload (the Message subclass contract): subclasses set
+    type_id and implement encode_payload/decode_payload."""
+
+    type_id: int = -1
+
+    def encode_payload(self, e: Encoder) -> None:
+        raise NotImplementedError
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "Message":
+        raise NotImplementedError
+
+
+def _crc(data: bytes) -> int:
+    return int(ceph_crc32c(0xFFFFFFFF, data)) & 0xFFFFFFFF
+
+
+class _Conn:
+    """One live socket + replay state toward one peer."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.alive = True
+
+    def send_frame(self, seq: int, type_id: int, payload: bytes) -> None:
+        body = struct.pack("<QH", seq, type_id) + payload
+        frame = struct.pack("<I", len(body)) + body
+        frame += struct.pack("<I", _crc(frame))
+        with self.wlock:
+            self.sock.sendall(frame)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class Messenger:
+    """Bind, connect, send typed messages, dispatch callbacks.
+
+    Lossless-peer semantics: every logical message gets a sequence
+    number; unacked messages survive connection death and are replayed
+    after the automatic reconnect (send() never silently drops)."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1"):
+        self.name = name
+        self._handlers: dict[int, callable] = {}
+        self._lock = threading.Lock()
+        # one lock per PEER held across seq-assignment + transmit:
+        # frames must hit the socket in sequence order or the
+        # receiver's max-seq dedup would discard reordered messages,
+        # and concurrent connects would race adopting sockets
+        self._peer_locks: dict[str, threading.RLock] = {}
+        # per-peer-name state (the lossless session, not the socket):
+        self._out_seq: dict[str, int] = {}
+        self._unacked: dict[str, deque] = {}   # (seq, type, payload)
+        self._in_seq: dict[str, int] = {}      # last delivered seq
+        self._conns: dict[str, _Conn] = {}
+        self._addr_of: dict[str, tuple] = {}
+        self._stopping = False
+        self._listener = socket.create_server((host, 0))
+        self.addr = self._listener.getsockname()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def register_handler(self, type_id: int, fn) -> None:
+        """fn(peer_name: str, msg: Message) — ms_fast_dispatch."""
+        self._handlers[type_id] = fn
+
+    # -- connection management ----------------------------------------------
+
+    def _accept_loop(self) -> None:
+        import time
+        while not self._stopping:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                if self._stopping:
+                    return
+                # transient failure (e.g. EMFILE): a dead listener
+                # would look exactly like a partition to peers — keep
+                # accepting rather than silently going deaf
+                time.sleep(0.05)
+                continue
+            threading.Thread(target=self._handshake_in, args=(sock,),
+                             daemon=True).start()
+
+    def _handshake_in(self, sock: socket.socket) -> None:
+        try:
+            if self._recv_exact(sock, len(BANNER)) != BANNER:
+                sock.close()
+                return
+            nlen = struct.unpack("<H", self._recv_exact(sock, 2))[0]
+            peer = self._recv_exact(sock, nlen).decode()
+            # symmetric handshake: both sides exchange their last-seen
+            # sequence so BOTH replay their unacked queues — an
+            # acceptor has stranded messages too after a reconnect
+            (peer_seen,) = struct.unpack(
+                "<Q", self._recv_exact(sock, 8))
+            sock.sendall(BANNER)
+            with self._lock:
+                last_seen = self._in_seq.get(peer, 0)
+            sock.sendall(struct.pack("<Q", last_seen))
+        except (OSError, ConnectionError, UnicodeDecodeError):
+            sock.close()
+            return
+        conn = _Conn(sock)
+        if not self._adopt(peer, conn, inbound=True):
+            return
+        self._replay(peer, conn, peer_seen)
+
+    def _replay(self, peer: str, conn: _Conn, peer_seen: int) -> None:
+        """Retire entries the peer's handshake already acknowledges
+        (a lost final ACK must not wedge flush forever), then resend
+        the rest in order (lossless_peer replay)."""
+        with self._plock(peer):
+            with self._lock:
+                q = self._unacked.get(peer)
+                while q and q[0][0] <= peer_seen:
+                    q.popleft()
+                pending = list(q or ())
+            try:
+                for seq, tid, payload in pending:
+                    conn.send_frame(seq, tid, payload)
+            except (OSError, ConnectionError):
+                pass  # conn died again; next reconnect replays
+
+    def _connect(self, peer: str) -> _Conn:
+        """Dial + handshake + replay. Callers hold the peer lock, so
+        only one connect per peer runs and replay order is exact."""
+        with self._plock(peer):
+            conn = self._conns.get(peer)
+            if conn is not None and conn.alive:
+                return conn  # someone beat us to it
+            addr = self._addr_of[peer]
+            sock = socket.create_connection(tuple(addr), timeout=10)
+            sock.sendall(BANNER)
+            name_b = self.name.encode()
+            sock.sendall(struct.pack("<H", len(name_b)) + name_b)
+            with self._lock:
+                my_seen = self._in_seq.get(peer, 0)
+            sock.sendall(struct.pack("<Q", my_seen))
+            if self._recv_exact(sock, len(BANNER)) != BANNER:
+                sock.close()
+                raise ConnectionError(f"bad banner from {peer}")
+            peer_seen = struct.unpack("<Q",
+                                      self._recv_exact(sock, 8))[0]
+            conn = _Conn(sock)
+            if not self._adopt(peer, conn, inbound=False):
+                raise ConnectionError(f"lost connection race to {peer}")
+            self._replay(peer, conn, peer_seen)
+            return conn
+
+    def _adopt(self, peer: str, conn: _Conn, inbound: bool) -> bool:
+        """Install the connection for `peer`, resolving simultaneous-
+        connect races deterministically (ProtocolV2's race-winner
+        rule): the LOWER name is the designated dialer, so when crossed
+        dials collide, its outgoing socket wins and the other side's
+        inbound attempt is refused. Returns False if refused."""
+        with self._lock:
+            old = self._conns.get(peer)
+            if (inbound and self.name < peer
+                    and old is not None and old.alive):
+                keep_old = True
+            else:
+                keep_old = False
+                self._conns[peer] = conn
+        if keep_old:
+            conn.close()
+            return False
+        if old is not None and old is not conn:
+            old.close()
+        threading.Thread(target=self._read_loop, args=(peer, conn),
+                         daemon=True).start()
+        return True
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            got = sock.recv(n - len(buf))
+            if not got:
+                raise ConnectionError("peer closed")
+            buf += got
+        return buf
+
+    # -- send ----------------------------------------------------------------
+
+    def add_peer(self, peer: str, addr) -> None:
+        self._addr_of[peer] = tuple(addr)
+
+    def _plock(self, peer: str) -> threading.RLock:
+        with self._lock:
+            lk = self._peer_locks.get(peer)
+            if lk is None:
+                lk = self._peer_locks[peer] = threading.RLock()
+            return lk
+
+    def send(self, peer: str, msg: Message) -> None:
+        """Queue + transmit; survives connection death (replayed on
+        the next reconnect). Raises only if the peer is unknown or the
+        payload won't encode."""
+        e = Encoder()
+        msg.encode_payload(e)
+        payload = e.bytes()
+        with self._plock(peer):
+            with self._lock:
+                seq = self._out_seq.get(peer, 0) + 1
+                self._out_seq[peer] = seq
+                self._unacked.setdefault(peer, deque()).append(
+                    (seq, msg.type_id, payload))
+                conn = self._conns.get(peer)
+            try:
+                if conn is None or not conn.alive:
+                    conn = self._connect(peer)
+                    # _connect replayed the queue incl. this message
+                    return
+                conn.send_frame(seq, msg.type_id, payload)
+            except (OSError, ConnectionError):
+                # connection died mid-send: the message stays unacked
+                # and replays on the next send/reconnect. Identity
+                # check: a fresh conn adopted meanwhile must survive.
+                with self._lock:
+                    if conn is not None \
+                            and self._conns.get(peer) is conn:
+                        del self._conns[peer]
+
+    def flush(self, peer: str, timeout: float = 10.0) -> bool:
+        """Block until the peer acked everything (or timeout). The
+        sender-side barrier tests use; returns False on timeout."""
+        import time
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            with self._lock:
+                if not self._unacked.get(peer):
+                    return True
+                conn = self._conns.get(peer)
+            if conn is None or not conn.alive:
+                try:
+                    self._connect(peer)
+                except (OSError, ConnectionError, KeyError):
+                    pass
+            time.sleep(0.01)
+        return False
+
+    # -- receive -------------------------------------------------------------
+
+    def _read_loop(self, peer: str, conn: _Conn) -> None:
+        try:
+            while conn.alive:
+                raw_len = self._recv_exact(conn.sock, 4)
+                (blen,) = struct.unpack("<I", raw_len)
+                if blen < 10 or blen > (1 << 26):
+                    raise ConnectionError(f"bad frame length {blen}")
+                body = self._recv_exact(conn.sock, blen)
+                (crc,) = struct.unpack("<I",
+                                       self._recv_exact(conn.sock, 4))
+                if _crc(raw_len + body) != crc:
+                    # ProtocolV2 crc mode: corrupt frame kills the
+                    # session; replay redelivers after reconnect
+                    raise ConnectionError("frame crc mismatch")
+                seq, tid = struct.unpack("<QH", body[:10])
+                payload = body[10:]
+                if tid == ACK_TYPE:
+                    if len(payload) != 8:
+                        raise ConnectionError("malformed ACK frame")
+                    (acked,) = struct.unpack("<Q", payload)
+                    with self._lock:
+                        q = self._unacked.get(peer)
+                        while q and q[0][0] <= acked:
+                            q.popleft()
+                    continue
+                deliver = False
+                with self._lock:
+                    if seq > self._in_seq.get(peer, 0):
+                        self._in_seq[peer] = seq
+                        deliver = True  # else: replayed dup, drop
+                try:
+                    conn.send_frame(0, ACK_TYPE,
+                                    struct.pack("<Q", seq))
+                except (OSError, ConnectionError):
+                    pass
+                if deliver:
+                    cls = _MSG_TYPES.get(tid)
+                    handler = self._handlers.get(tid)
+                    if cls is not None and handler is not None:
+                        try:
+                            handler(peer,
+                                    cls.decode_payload(Decoder(payload)))
+                        except Exception as e:  # poison message: the
+                            # frame was crc-valid and is already acked;
+                            # contain the blast radius to this message
+                            # (fast dispatch must not kill the session)
+                            from ..utils.log import g_log
+                            g_log.dout("msgr", 0,
+                                       f"dispatch error from {peer} "
+                                       f"type={tid:#x} seq={seq}: {e!r}")
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            conn.close()
+            with self._lock:
+                if self._conns.get(peer) is conn:
+                    del self._conns[peer]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
