@@ -1,0 +1,77 @@
+"""PG export/import tests (ref: ceph_objectstore_tool --op export/
+import; SURVEY §5 checkpoint/resume)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.pg_export import (export_pg, import_objects,
+                                    read_export)
+from cluster_helpers import corpus, make_cluster
+
+
+def pg_objects(c, ps):
+    return {n: c.read(n) for n in c.pgs[ps].list_pg_objects()}
+
+
+class TestExportImport:
+    def test_roundtrip_healthy(self, tmp_path):
+        c = make_cluster(pg_num=4)
+        objs = corpus(16, 500, seed=1)
+        c.write(objs)
+        path = str(tmp_path / "pg.export")
+        s = export_pg(c, 0, path)
+        assert s["objects"] == len(c.pgs[0].object_sizes)
+        exp = read_export(path)
+        assert exp["pg"] == "1.0"
+        for n, d in exp["objects"].items():
+            assert np.array_equal(d, objs[n])
+
+    def test_export_degraded_reconstructs(self, tmp_path):
+        c = make_cluster(pg_num=4, down_out_interval=10_000)
+        objs = corpus(16, 500, seed=2)
+        c.write(objs)
+        want = pg_objects(c, 1)
+        c.kill_osd(c.pgs[1].acting[0])
+        c.kill_osd(c.pgs[1].acting[2])  # m=2: max tolerable loss
+        path = str(tmp_path / "pg.export")
+        export_pg(c, 1, path)
+        exp = read_export(path)
+        assert set(exp["objects"]) == set(want)
+        for n, d in exp["objects"].items():
+            assert np.array_equal(d, want[n])
+
+    def test_import_into_different_profile(self, tmp_path):
+        c = make_cluster(pg_num=4)
+        objs = corpus(12, 400, seed=3)
+        c.write(objs)
+        path = str(tmp_path / "pg.export")
+        export_pg(c, 2, path)
+        dst = make_cluster(pg_num=8, profile="replicated size=3")
+        res = import_objects(dst, path)
+        assert res["objects"] == len(c.pgs[2].object_sizes)
+        for n in c.pgs[2].list_pg_objects():
+            assert np.array_equal(dst.read(n), c.read(n))
+
+    def test_import_refuses_clobber(self, tmp_path):
+        c = make_cluster(pg_num=2)
+        objs = corpus(8, 200, seed=4)
+        c.write(objs)
+        path = str(tmp_path / "pg.export")
+        export_pg(c, 0, path)
+        with pytest.raises(FileExistsError):
+            import_objects(c, path)
+        res = import_objects(c, path, overwrite=True)
+        assert res["objects"] > 0
+        assert c.verify_all(objs) == len(objs)
+
+    def test_empty_pg_and_bad_file(self, tmp_path):
+        c = make_cluster(pg_num=2)
+        path = str(tmp_path / "empty.export")
+        s = export_pg(c, 0, path)
+        assert s["objects"] == 0
+        dst = make_cluster(pg_num=2)
+        assert import_objects(dst, path)["objects"] == 0
+        bad = tmp_path / "junk"
+        bad.write_bytes(b"\x00" * 16)
+        with pytest.raises(ValueError):
+            read_export(str(bad))

@@ -1,0 +1,93 @@
+"""ceph-objectstore-tool — offline PG export/import demo CLI.
+
+Recreation of the reference's disaster-recovery workflow (ref:
+src/tools/ceph_objectstore_tool.cc `--op export` / `--op import`;
+SURVEY §5 checkpoint/resume). The cluster is hermetic, so the CLI
+demonstrates the full round trip end to end:
+
+  python tools/objectstore_tool.py demo --pg 0
+      builds a cluster, writes objects, DEGRADES the PG (one OSD
+      killed), exports it (reads reconstruct), imports the file into
+      a FRESH cluster with a different pool profile, verifies bytes.
+
+  python tools/objectstore_tool.py inspect <export-file>
+      prints an export file's header + object list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def cmd_demo(args) -> None:
+    from ceph_tpu.osd.cluster import SimCluster
+    from ceph_tpu.osd.pg_export import export_pg, import_objects
+
+    src = SimCluster(n_osds=12, pg_num=4)
+    rng = np.random.default_rng(0)
+    objs = {f"obj-{i}": rng.integers(0, 256, 700, np.uint8)
+            for i in range(24)}
+    src.write(objs)
+    ps = args.pg
+    src.kill_osd(src.pgs[ps].acting[0])   # export must reconstruct
+    path = args.file or os.path.join(tempfile.gettempdir(),
+                                     f"pg1.{ps}.export")
+    summary = export_pg(src, ps, path)
+    print(f"exported degraded pg 1.{ps}: {summary['objects']} objects, "
+          f"{summary['bytes']} bytes -> {path}")
+
+    dst = SimCluster(n_osds=12, pg_num=8,
+                     profile="plugin=tpu_rs k=8 m=3 impl=bitlinear",
+                     chunk_size=128)
+    res = import_objects(dst, path)
+    print(f"imported into fresh cluster (source profile "
+          f"{res['source_profile']!r} -> k=8 m=3): "
+          f"{res['objects']} objects")
+    ok = sum(1 for n in objs
+             if src.locate(n) == ps
+             and bytes(dst.read(n)) == objs[n].tobytes())
+    exported = summary["objects"]
+    print(f"verified {ok}/{exported} objects byte-exact in the "
+          f"destination")
+    if ok != exported:
+        raise SystemExit("objectstore_tool: verification FAILED")
+
+
+def cmd_inspect(args) -> None:
+    from ceph_tpu.osd.pg_export import read_export
+    try:
+        exp = read_export(args.file)
+    except (ValueError, OSError) as e:
+        raise SystemExit(f"objectstore_tool: {e}")
+    print(f"pg {exp['pg']} profile {exp['profile']!r} "
+          f"log [{exp['log_tail']}, {exp['log_head']}]")
+    for n, d in sorted(exp["objects"].items()):
+        print(f"  {n}  {len(d)} bytes")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    demo = sub.add_parser("demo")
+    demo.add_argument("--pg", type=int, default=0)
+    demo.add_argument("--file", default=None)
+    insp = sub.add_parser("inspect")
+    insp.add_argument("file")
+    args = ap.parse_args(argv)
+    if args.cmd == "demo":
+        cmd_demo(args)
+    else:
+        cmd_inspect(args)
+
+
+if __name__ == "__main__":
+    main()
