@@ -243,6 +243,16 @@ class SimCluster:
         dead = self._dead_osds()
         return self.pgs[ps].read_object(name, dead_osds=dead)
 
+    def repair_pg(self, ps: int) -> dict:
+        """`ceph pg repair 1.<ps>`: scrub + rewrite inconsistent
+        shards/replicas from the surviving good copies."""
+        rep = self.pgs[ps].repair_pg(dead_osds=self._dead_osds())
+        if rep["repaired"]:
+            self.scrub_reports.pop(ps, None)  # rot is gone
+            g_log.dout("scrub", 1, f"pg 1.{ps} repaired "
+                                   f"{rep['repaired']} shard(s)")
+        return rep
+
     def remove(self, names: list[str] | str) -> None:
         names = [names] if isinstance(names, str) else list(names)
         by_pg: dict[int, list[str]] = {}

@@ -83,6 +83,9 @@ class ECBackend(PGBackend):
         self.sinfo = StripeInfo(self.k, cs)
         self._init_common(pg, acting, cluster or ShardSet())
         self._fused_cache: dict = {}
+        # read-path EIO accounting (verify-on-read mismatches + the
+        # in-place rewrites they triggered)
+        self.eio_stats = {"read_eio": 0, "repaired": 0}
 
     # -- helpers ------------------------------------------------------------
 
@@ -327,7 +330,15 @@ class ECBackend(PGBackend):
     # objects_read_and_reconstruct analog
 
     def read_objects(self, names: list[str],
-                     dead_osds: set[int] | None = None) -> dict[str, np.ndarray]:
+                     dead_osds: set[int] | None = None,
+                     verify: bool = True) -> dict[str, np.ndarray]:
+        """Batched reads with BlueStore-style verify-on-read: every
+        chunk consumed is CRC-checked against its stored hinfo in one
+        batched launch (ref: BlueStore::_verify_csum on every read);
+        a mismatch is the EIO path — the read transparently re-decodes
+        from other shards AND repairs the rotten chunk in place (ref:
+        the read-error recovery qa/standalone/erasure-code/
+        test-erasure-eio.sh exercises)."""
         dead = dead_osds or set()
         alive = [s for s in range(self.n)
                  if self.acting[s] not in dead]
@@ -350,12 +361,120 @@ class ECBackend(PGBackend):
             stacks = {s: np.stack([self._store(s).read(shard_cid(self.pg, s),
                                                        n) for n in group])
                       for s in need}
+            bad: dict[str, set[int]] = {}
+            if verify:
+                rows = np.concatenate([stacks[s] for s in need])
+                crcs = self._batched_crcs(rows).reshape(
+                    len(need), len(group))
+                for si, s in enumerate(need):
+                    st = self._store(s)
+                    cid = shard_cid(self.pg, s)
+                    for bi, nm in enumerate(group):
+                        hinfo = HashInfo.from_bytes(
+                            st.getattr(cid, nm, HINFO_KEY))
+                        if int(crcs[si, bi]) != hinfo.get_chunk_hash(0):
+                            bad.setdefault(nm, set()).add(s)
+            clean_group = [n for n in group if n not in bad]
+            if clean_group:
+                idx = [group.index(n) for n in clean_group]
+                sub = {s: stacks[s][idx] for s in need}
+                rec = self.coder.decode(want, sub)
+                shards = np.stack([rec[i] for i in range(self.k)],
+                                  axis=1)
+                objs = self.sinfo.shards_to_object(shards)
+                for oi, name in enumerate(clean_group):
+                    out[name] = objs[oi, :self.object_sizes[name]]
+            for name, bad_set in bad.items():
+                self.eio_stats["read_eio"] += len(bad_set)
+                out[name] = self._read_eio(name, sl, avail, bad_set)
+        return out
+
+    def _read_eio(self, name: str, sl: int, avail: list[int],
+                  bad: set[int]) -> np.ndarray:
+        """One object's EIO path: decode around the rotten shards,
+        return the bytes, and repair the rot in place.
+
+        Substitute shards are CRC-VERIFIED before they feed the decode:
+        an unverified substitute with its own rot would hand the client
+        corrupt bytes and then durably launder them — the repair would
+        rewrite the flagged shard from corrupt data under a freshly
+        matching CRC that no future scrub could catch."""
+        want = list(range(self.k))
+        bad = set(bad)
+        while True:
+            ok_shards = [s for s in avail if s not in bad]
+            need = sorted(self.coder.minimum_to_decode(want, ok_shards))
+            stacks = {}
+            newly_bad = False
+            for s in need:
+                st = self._store(s)
+                cid = shard_cid(self.pg, s)
+                chunk = st.read(cid, name)
+                crc = int(self._batched_crcs(chunk[None, :])[0])
+                hinfo = HashInfo.from_bytes(st.getattr(cid, name,
+                                                       HINFO_KEY))
+                if crc != hinfo.get_chunk_hash(0):
+                    self.eio_stats["read_eio"] += 1
+                    bad.add(s)
+                    newly_bad = True
+                    break
+                stacks[s] = chunk[None, :]
+            if newly_bad:
+                continue  # re-plan without the newly found rot
             rec = self.coder.decode(want, stacks)
             shards = np.stack([rec[i] for i in range(self.k)], axis=1)
-            objs = self.sinfo.shards_to_object(shards)  # (B, k*sl)
-            for bi, name in enumerate(group):
-                out[name] = objs[bi, :self.object_sizes[name]]
-        return out
+            obj = self.sinfo.shards_to_object(shards)[0]
+            self._repair_shards(name, obj, sorted(bad), sl)
+            return obj[:self.object_sizes[name]]
+
+    def _repair_shards(self, name: str, logical: np.ndarray,
+                       slots: list[int], sl: int) -> None:
+        """Rewrite specific shards of one object from its logical bytes
+        (the read-error / `ceph pg repair` writeback)."""
+        dshards = self.sinfo.object_to_shards(logical[None, :])
+        parity = np.asarray(self.coder.encode_chunks(dshards))
+        full = np.concatenate([dshards, parity], axis=1)[0]  # (n, sl)
+        crcs = self._batched_hinfo_crcs(full[slots])
+        for ci, s in enumerate(slots):
+            hinfo = HashInfo(1, sl, [int(crcs[ci])])
+            t = (Transaction()
+                 .write(shard_cid(self.pg, s), name, 0, full[s])
+                 .truncate(shard_cid(self.pg, s), name, sl)
+                 .setattr(shard_cid(self.pg, s), name,
+                          HINFO_KEY, hinfo.to_bytes()))
+            self._store(s).queue_transaction(t)
+            self.eio_stats["repaired"] += 1
+
+    def repair_pg(self, dead_osds: set[int] | None = None) -> dict:
+        """`ceph pg repair` analog: deep-scrub, then rewrite every
+        inconsistent shard from the surviving majority (ref:
+        PrimaryLogPG repair path driven by the scrubber's
+        authoritative-copy decision)."""
+        dead = dead_osds or set()
+        rep = self.deep_scrub(dead_osds=dead)
+        alive = [s for s in range(self.n)
+                 if self.acting[s] not in dead]
+        alive_set = set(alive)
+        by_name: dict[str, list[int]] = {}
+        skipped = 0
+        for name, slot in rep["inconsistent"]:
+            # never write to a dead slot (repairing it would resurrect
+            # a destroyed OSD's store; recovery rebuilds it instead),
+            # and a deleted object's leftover is delete-replay's job
+            if slot not in alive_set or name not in self.object_sizes:
+                skipped += 1
+                continue
+            by_name.setdefault(name, []).append(slot)
+        repaired = 0
+        for name, slots in sorted(by_name.items()):
+            sl = self._shard_len(self.object_sizes[name])
+            obj = self._read_eio(name, sl,
+                                 self._fresh_for([name], alive),
+                                 set(slots))
+            del obj  # _read_eio already repaired in place
+            repaired += len(slots)
+        return {"checked": rep["checked"], "repaired": repaired,
+                "objects": len(by_name), "skipped": skipped}
 
     # -- recovery (the objects/s metric) -------------------------------------
 
@@ -610,16 +729,27 @@ class ECBackend(PGBackend):
 
     # -- deep scrub ----------------------------------------------------------
 
-    def deep_scrub(self) -> dict:
-        """Read every shard of every object, verify stored hinfo CRCs
-        (the be_deep_scrub bulk-checksum audit), batched per shard."""
+    def deep_scrub(self, dead_osds: set[int] | None = None) -> dict:
+        """Read every LIVE shard of every object, verify stored hinfo
+        CRCs (the be_deep_scrub bulk-checksum audit), batched per
+        shard. Dead slots are skipped — even touching their stores
+        would resurrect destroyed OSD ids."""
         from ..csum.kernels import crc32c_blocks
+        dead = dead_osds or set()
         bad: list[tuple[str, int]] = []
         checked = 0
         for s in range(self.n):
+            if self.acting[s] in dead:
+                continue
             store = self._store(s)
             cid = shard_cid(self.pg, s)
-            names = store.list_objects(cid)
+            # a shard behind on an object's last write (or holding a
+            # not-yet-replayed delete's leftover) is lagging, not
+            # corrupt — same staleness excuse the replicated scrub and
+            # shallow scrub apply
+            names = [n for n in store.list_objects(cid)
+                     if self.shard_applied[s]
+                     >= self.object_versions.get(n, 0)]
             by_len: dict[int, list[str]] = {}
             for n in names:
                 by_len.setdefault(store.stat(cid, n), []).append(n)

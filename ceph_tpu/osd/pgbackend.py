@@ -286,6 +286,7 @@ class ReplicatedBackend(PGBackend):
         if not (1 <= self.min_live <= size):
             raise ValueError(f"min_size {self.min_live} not in [1, {size}]")
         self._init_common(pg, acting, cluster or ShardSet())
+        self.eio_stats = {"read_eio": 0, "repaired": 0}
 
     def _expected_shard_len(self, object_size: int) -> int:
         return object_size  # every replica holds the whole object
@@ -372,20 +373,136 @@ class ReplicatedBackend(PGBackend):
 
     # -- read path -----------------------------------------------------------
 
-    def read_objects(self, names, dead_osds=None) -> dict[str, np.ndarray]:
+    def read_objects(self, names, dead_osds=None,
+                     verify: bool = True) -> dict[str, np.ndarray]:
         """Serve each object from the first caught-up live replica
-        (primary-first, the reference's default read path)."""
+        (primary-first, the reference's default read path), with
+        verify-on-read: a digest mismatch fails over to the next good
+        replica and repairs the rotten copy in place (the read-error
+        EIO path)."""
         alive = self._live_slots(dead_osds)
         out: dict[str, np.ndarray] = {}
+        srcs_of: dict[str, list[int]] = {}
+        # happy path batched per (chosen replica, size): ONE CRC launch
+        # per group, matching the file's batch-per-equal-length
+        # convention everywhere else
+        plan: dict[tuple[int, int], list[str]] = {}
         for name in names:
             if name not in self.object_sizes:
                 raise KeyError(f"no object {name!r}")
-            src = self._fresh_for([name], alive)
-            if not src:
+            srcs = self._fresh_for([name], alive)
+            if not srcs:
                 raise ValueError(f"no caught-up live replica for {name!r}")
-            out[name] = self._store(src[0]).read(
-                shard_cid(self.pg, src[0]), name)
+            if not verify:
+                out[name] = self._store(srcs[0]).read(
+                    shard_cid(self.pg, srcs[0]), name)
+                continue
+            srcs_of[name] = srcs
+            plan.setdefault((srcs[0], self.object_sizes[name]),
+                            []).append(name)
+        suspects: list[str] = []
+        for (s, size), group in plan.items():
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            datas = {n: st.read(cid, n) for n in group}
+            ok_len = [n for n in group if len(datas[n]) == size]
+            for n in group:  # length rot can't even be stacked
+                if n not in ok_len:
+                    self.eio_stats["read_eio"] += 1
+                    suspects.append(n)
+            if not ok_len:
+                continue
+            crcs = (self._batched_crcs(
+                np.stack([datas[n] for n in ok_len]))
+                if size else [0xFFFFFFFF] * len(ok_len))
+            for n, crc in zip(ok_len, crcs):
+                hinfo = HashInfo.from_bytes(
+                    st.getattr(cid, n, HINFO_KEY))
+                if int(crc) == hinfo.get_chunk_hash(0):
+                    out[n] = datas[n]
+                else:
+                    self.eio_stats["read_eio"] += 1
+                    suspects.append(n)
+        for name in suspects:  # EIO path: failover + repair
+            out[name] = self._read_failover(name, srcs_of[name],
+                                            {srcs_of[name][0]})
         return out
+
+    def _read_failover(self, name: str, srcs: list[int],
+                       bad: set[int]) -> np.ndarray:
+        """Try the remaining fresh replicas in order; the first
+        digest-valid copy wins and repairs every rotten one met."""
+        good = None
+        for s in srcs:
+            if s in bad:
+                continue
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            data = st.read(cid, name)
+            crc = (int(self._batched_crcs(data[None, :])[0])
+                   if data.size else 0xFFFFFFFF)
+            hinfo = HashInfo.from_bytes(st.getattr(cid, name,
+                                                   HINFO_KEY))
+            if crc == hinfo.get_chunk_hash(0) \
+                    and len(data) == self.object_sizes[name]:
+                good = data
+                break
+            self.eio_stats["read_eio"] += 1
+            bad.add(s)
+        if good is None:
+            raise ValueError(
+                f"every replica of {name!r} fails its digest")
+        for s in bad:
+            self._rewrite_replica(name, s, good)
+        return good
+
+    def _rewrite_replica(self, name: str, s: int,
+                         good: np.ndarray) -> None:
+        crc = (int(self._batched_crcs(good[None, :])[0])
+               if good.size else 0xFFFFFFFF)
+        hinfo = HashInfo(1, len(good), [crc])
+        t = (Transaction()
+             .write(shard_cid(self.pg, s), name, 0, good)
+             .truncate(shard_cid(self.pg, s), name, len(good))
+             .setattr(shard_cid(self.pg, s), name,
+                      HINFO_KEY, hinfo.to_bytes()))
+        self._store(s).queue_transaction(t)
+        self.eio_stats["repaired"] += 1
+
+    def repair_pg(self, dead_osds: set[int] | None = None) -> dict:
+        """`ceph pg repair`: deep-scrub, rewrite every inconsistent
+        replica the scrub flagged from a digest-valid copy (not just
+        the ones a read would stumble over). Dead slots are recovery's
+        job, not repair's; replicas the verified read already fixed in
+        passing are not rewritten (or counted) twice."""
+        dead = dead_osds or set()
+        rep = self.deep_scrub(dead_osds=dead)
+        alive_set = set(self._live_slots(dead))
+        by_name: dict[str, list[int]] = {}
+        skipped = 0
+        for name, slot in rep["inconsistent"]:
+            if slot not in alive_set or name not in self.object_sizes:
+                skipped += 1
+                continue
+            by_name.setdefault(name, []).append(slot)
+        repaired = 0
+        for name, slots in sorted(by_name.items()):
+            good = self.read_objects([name], dead_osds,
+                                     verify=True)[name]
+            want_crc = (int(self._batched_crcs(good[None, :])[0])
+                        if good.size else 0xFFFFFFFF)
+            for s in slots:
+                st = self._store(s)
+                cid = shard_cid(self.pg, s)
+                cur = st.read(cid, name)
+                cur_crc = (int(self._batched_crcs(cur[None, :])[0])
+                           if cur.size else 0xFFFFFFFF)
+                if cur_crc == want_crc:
+                    continue  # the verified read repaired it already
+                self._rewrite_replica(name, s, good)
+                repaired += 1
+        return {"checked": rep["checked"], "repaired": repaired,
+                "objects": len(by_name), "skipped": skipped}
 
     # -- recovery ------------------------------------------------------------
 
@@ -478,14 +595,19 @@ class ReplicatedBackend(PGBackend):
 
     # -- scrub ---------------------------------------------------------------
 
-    def deep_scrub(self) -> dict:
-        """Read every replica of every object, verify its stored digest
-        (batched CRC per replica), and cross-check replicas agree (ref:
-        be_deep_scrub + the scrubber's authoritative-copy compare)."""
+    def deep_scrub(self, dead_osds: set[int] | None = None) -> dict:
+        """Read every LIVE replica of every object, verify its stored
+        digest (batched CRC per replica), and cross-check replicas
+        agree (ref: be_deep_scrub + the scrubber's authoritative-copy
+        compare). Dead slots are skipped — touching their stores would
+        resurrect destroyed OSD ids."""
+        dead = dead_osds or set()
         bad: list[tuple[str, int]] = []
         checked = 0
         digests: dict[str, set[int]] = {}
         for s in range(self.n):
+            if self.acting[s] in dead:
+                continue
             store = self._store(s)
             cid = shard_cid(self.pg, s)
             # a replica that missed an object's last write is behind
