@@ -51,9 +51,12 @@ class SimCluster:
                  heartbeat_grace: float = 20.0,
                  down_out_interval: float = 600.0,
                  min_down_reporters: int = 2,
-                 n_mons: int = 3):
+                 n_mons: int = 3,
+                 hosts_per_rack: int | None = None):
+        if hosts_per_rack is None:
+            hosts_per_rack = max(4, n_osds)  # one big rack by default
         crush = build_hierarchy(n_osds, osds_per_host=osds_per_host,
-                                hosts_per_rack=max(4, n_osds))
+                                hosts_per_rack=hosts_per_rack)
         # the reference default (51): plenty of retry headroom once
         # several OSDs are out; the vectorized mapper's while_loop
         # early-exits, so unused rounds cost nothing
@@ -76,19 +79,40 @@ class SimCluster:
         else:
             prof = dict(profile)
         self.is_erasure = prof.get("plugin", "") != "replicated"
+        # the reference's pool creation consumes crush-failure-domain
+        # from the EC profile (ref: OSDMonitor pool create ->
+        # CrushWrapper rule from profile); honor the same key
+        domains = {"osd": 0, "host": 1, "rack": 2}
+        fd = prof.get("crush-failure-domain", "host")
+        if fd not in domains:
+            raise ValueError(f"crush-failure-domain {fd!r} not in "
+                             f"{sorted(domains)}")
+        choose_type = domains[fd]
+        # the domain must actually exist in enough copies, or every PG
+        # would come up short at creation with a confusing error
+        n_hosts = -(-n_osds // osds_per_host)
+        n_domains = {0: n_osds, 1: n_hosts,
+                     2: -(-n_hosts // hosts_per_rack)}[choose_type]
         if self.is_erasure:
             from ..ec.registry import factory
             coder = factory(profile)
             self.pool_size = coder.get_chunk_count()
             self.m = coder.get_coding_chunk_count()
             min_size = self.pool_size - self.m
-            ec_rule(crush, 1, choose_type=1)
+            ec_rule(crush, 1, choose_type=choose_type)
         else:
             self.pool_size = int(prof.get("size", 3))
             min_size = int(prof.get("min_size",
                                     self.pool_size - self.pool_size // 2))
             self.m = self.pool_size - min_size
-            replicated_rule(crush, 1, choose_type=1, firstn=True)
+            replicated_rule(crush, 1, choose_type=choose_type,
+                            firstn=True)
+        if n_domains < self.pool_size:
+            raise ValueError(
+                f"crush-failure-domain={fd}: only {n_domains} "
+                f"domain(s) in the topology but the pool needs "
+                f"{self.pool_size}; add osds/hosts/racks (e.g. "
+                f"hosts_per_rack=) or pick a finer domain")
         self.pool_min_size = min_size
         self.osdmap.add_pool(PGPool(1, pg_num=pg_num, size=self.pool_size,
                                     min_size=min_size,

@@ -170,3 +170,45 @@ def test_thrash_with_monitor_churn_no_data_loss():
         assert c.verify_all(all_objs) == len(all_objs)
         assert c.health()["pgs_degraded"] == 0
     assert c.perf.get("recovered_objects") > 0
+
+
+def test_reference_profile_strings_accepted():
+    """A reference user's profile string works verbatim: jerasure
+    plugin name, technique, and crush-failure-domain all honored."""
+    c = SimCluster(
+        n_osds=12, pg_num=4, osds_per_host=2,
+        profile="plugin=jerasure k=4 m=2 technique=reed_sol_van "
+                "crush-failure-domain=osd")
+    objs = corpus(8, 300, seed=20)
+    c.write(objs)
+    assert c.verify_all(objs) == len(objs)
+    # failure-domain=osd: shards may share a host (2 osds/host, 6
+    # shards over 6 hosts would otherwise be forced apart)
+    c2 = SimCluster(
+        n_osds=12, pg_num=4, osds_per_host=2,
+        profile="plugin=jerasure k=4 m=2 "
+                "crush-failure-domain=host")
+    for ps in range(4):
+        hosts = [o // 2 for o in c2.pgs[ps].acting]
+        assert len(set(hosts)) == len(hosts)  # host-separated
+    with pytest.raises(ValueError, match="crush-failure-domain"):
+        SimCluster(n_osds=6, pg_num=2,
+                   profile="k=2 m=1 plugin=tpu_rs "
+                           "crush-failure-domain=datacenter")
+    # rack domain with a single-rack topology is rejected upfront,
+    # not left to fail confusingly at PG creation
+    with pytest.raises(ValueError, match="rack"):
+        SimCluster(n_osds=12, pg_num=2, osds_per_host=2,
+                   profile="k=4 m=2 plugin=tpu_rs "
+                           "crush-failure-domain=rack")
+    # with enough racks it works end to end
+    c3 = SimCluster(n_osds=12, pg_num=2, osds_per_host=1,
+                    hosts_per_rack=2,
+                    profile="k=2 m=1 plugin=tpu_rs "
+                            "crush-failure-domain=rack")
+    objs3 = corpus(4, 200, seed=21)
+    c3.write(objs3)
+    assert c3.verify_all(objs3) == len(objs3)
+    for ps in range(2):
+        racks = [o // 2 for o in c3.pgs[ps].acting]
+        assert len(set(racks)) == len(racks)

@@ -68,8 +68,11 @@ def main(argv=None) -> None:
 
     profile = (args.profile or "plugin=tpu_rs k=4 m=2 impl=bitlinear") \
         if args.pool == "ec" else "replicated size=3"
-    c = SimCluster(n_osds=args.num_osds, pg_num=args.pg_num,
-                   profile=profile, chunk_size=4096)
+    try:
+        c = SimCluster(n_osds=args.num_osds, pg_num=args.pg_num,
+                       profile=profile, chunk_size=4096)
+    except ValueError as e:
+        raise SystemExit(f"rados_bench: {e}")
     io = Rados(c).open_ioctx()
     ob = io._ob
     rng = np.random.default_rng(0)
