@@ -1,0 +1,176 @@
+"""Chaos thrash — every subsystem at once under a seeded schedule
+(the teuthology Thrasher maximized: qa/tasks/ceph_manager.py randomly
+kills/revives/reweights during I/O; here the menu also covers monitor
+churn, bit rot with EIO repair, the balancer, partial writes, and
+removes — after every healing phase all surviving data must be
+byte-exact and all healthy PGs scrub-clean)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.objecter import Objecter, ObjecterError
+from ceph_tpu.mgr.balancer import calc_pg_upmaps
+from ceph_tpu.osd.cluster import SimCluster, StaleMap
+from ceph_tpu.osd.ecbackend import shard_cid
+
+
+@pytest.mark.parametrize("seed", [101, 202])
+def test_chaos_thrash_no_data_loss(seed):
+    rng = np.random.default_rng(seed)
+    N_OSDS = 14
+    c = SimCluster(n_osds=N_OSDS, pg_num=8, down_out_interval=30.0,
+                   heartbeat_grace=20.0)
+    ob = Objecter(c)
+    shadow: dict[str, bytes] = {}
+    dead_osds: set[int] = set()
+    destroyed: set[int] = set()
+    dead_mons: set[int] = set()
+    obj_i = 0
+
+    def fresh_names(n):
+        nonlocal obj_i
+        names = [f"chaos-{seed}-{obj_i + j}" for j in range(n)]
+        obj_i += n
+        return names
+
+    def safe_client(fn, *a):
+        try:
+            fn(*a)
+            return True
+        except (ObjecterError, StaleMap, ValueError):
+            return False  # pg down/incomplete mid-chaos: op parked
+
+    def act_write():
+        objs = {n: rng.integers(0, 256, int(rng.integers(50, 900)),
+                                np.uint8).tobytes()
+                for n in fresh_names(int(rng.integers(2, 7)))}
+        if safe_client(ob.write, objs):
+            shadow.update(objs)
+
+    def act_overwrite():
+        if not shadow:
+            return
+        name = sorted(shadow)[int(rng.integers(len(shadow)))]
+        data = rng.integers(0, 256, int(rng.integers(50, 900)),
+                            np.uint8).tobytes()
+        if safe_client(ob.write, {name: data}):
+            shadow[name] = data
+
+    def act_rmw():
+        if not shadow:
+            return
+        name = sorted(shadow)[int(rng.integers(len(shadow)))]
+        old = shadow[name]
+        off = int(rng.integers(0, max(1, len(old))))
+        patch = rng.integers(0, 256, int(rng.integers(1, 200)),
+                             np.uint8).tobytes()
+        if safe_client(ob.write_at, name, off, patch):
+            buf = bytearray(max(len(old), off + len(patch)))
+            buf[:len(old)] = old
+            buf[off:off + len(patch)] = patch
+            shadow[name] = bytes(buf)
+
+    def act_remove():
+        if len(shadow) < 4:
+            return
+        name = sorted(shadow)[int(rng.integers(len(shadow)))]
+        if safe_client(ob.remove, name):
+            del shadow[name]
+
+    def act_kill_osd():
+        # budget: at most m CONCURRENT failures among OSDs that still
+        # hold mapped data (healed-out destroyed disks no longer count
+        # — their data was re-replicated, so fresh failures are safe)
+        alive = [o for o in range(N_OSDS)
+                 if o not in dead_osds and o not in destroyed]
+        if len(dead_osds) >= c.m:
+            return
+        victim = int(rng.choice(alive))
+        (c.destroy_osd if rng.random() < 0.3 else c.kill_osd)(victim)
+        if victim in c.destroyed:
+            destroyed.add(victim)
+        dead_osds.add(victim)
+
+    def act_mon_churn():
+        if dead_mons:
+            r = dead_mons.pop()
+            c.revive_mon(r)
+        elif rng.random() < 0.7:
+            r = int(rng.integers(3))
+            c.kill_mon(r)
+            dead_mons.add(r)
+
+    def act_rot():
+        if not shadow:
+            return
+        name = sorted(shadow)[int(rng.integers(len(shadow)))]
+        ps = c.locate(name)
+        be = c.pgs[ps]
+        slot = int(rng.integers(be.n))
+        osd = be.acting[slot]
+        if osd in dead_osds or osd in destroyed:
+            return
+        store = c.cluster.osd(osd)
+        obj = store.collections.get(shard_cid(be.pg, slot), {}).get(name)
+        if obj is not None and obj.data.size:
+            obj.data[int(rng.integers(obj.data.size))] ^= 0x3C
+
+    def act_balance():
+        if dead_mons and c.mons.quorum() is None:
+            return
+        if calc_pg_upmaps(c.osdmap, 1, max_optimizations=6):
+            c._repeer_all()
+
+    def act_repair():
+        ps = int(rng.integers(c.pg_num))
+        if c.pg_state(ps).startswith("active") \
+                and ps not in c.backfills:
+            c.repair_pg(ps)
+
+    menu = [act_write, act_write, act_overwrite, act_rmw, act_remove,
+            act_kill_osd, act_mon_churn, act_rot, act_balance,
+            act_repair]
+
+    for round_i in range(6):
+        act_write()  # every round has fresh data on the line
+        for _ in range(int(rng.integers(2, 5))):
+            menu[int(rng.integers(len(menu)))]()
+            c.tick(6.0)
+        # heal: monitors back to quorum, revive killed (not destroyed)
+        # osds, let down->out + recovery + backfills run dry
+        while dead_mons:
+            c.revive_mon(dead_mons.pop())
+        for o in sorted(dead_osds - destroyed):
+            c.revive_osd(o)
+            dead_osds.discard(o)
+        # destroyed disks leave the failure budget once healed: their
+        # data is re-replicated onto live OSDs below
+        dead_osds.difference_update(destroyed)
+        c.tick(60.0)
+        for _ in range(120):
+            if not c.backfills:
+                break
+            c.tick(6.0)
+        assert not c.backfills, f"round {round_i}: backfills stuck"
+        # every surviving byte exact (reads also run verify-on-read,
+        # so lingering rot gets caught AND repaired here)
+        for name, want in sorted(shadow.items()):
+            got = ob.read(name)
+            assert got.tobytes() == want, f"round {round_i}: {name}"
+        # reads repaired rot on the shards they consumed; rot on
+        # parity shards is scrub's to find and repair's to fix —
+        # after repair every healthy PG must be clean
+        for ps in range(c.pg_num):
+            if c.pg_state(ps) == "active+clean":
+                dead_now = c._dead_osds()
+                rep = c.pgs[ps].deep_scrub(dead_osds=dead_now)
+                if rep["inconsistent"]:
+                    c.repair_pg(ps)
+                    rep = c.pgs[ps].deep_scrub(dead_osds=dead_now)
+                assert rep["inconsistent"] == [], (round_i, ps, rep)
+
+    assert shadow, "chaos never wrote anything"
+    if destroyed:
+        # losing a disk for good must have forced real reconstruction
+        assert c.perf.get("recovered_objects") \
+            + c.perf.get("backfilled_objects") > 0
