@@ -25,6 +25,7 @@ def test_chaos_thrash_no_data_loss(seed):
     dead_osds: set[int] = set()
     destroyed: set[int] = set()
     dead_mons: set[int] = set()
+    expect_rebuild = [False]
     obj_i = 0
 
     def fresh_names(n):
@@ -89,14 +90,21 @@ def test_chaos_thrash_no_data_loss(seed):
         (c.destroy_osd if rng.random() < 0.3 else c.kill_osd)(victim)
         if victim in c.destroyed:
             destroyed.add(victim)
+            # a destroy of data-holding shards MUST force rebuilds by
+            # the end of the heal phase (checked there)
+            if any(victim in c.pgs[ps].acting
+                   and c.pgs[ps].object_sizes
+                   for ps in range(c.pg_num)):
+                expect_rebuild[0] = True
         dead_osds.add(victim)
 
     def act_mon_churn():
-        if dead_mons:
-            r = dead_mons.pop()
-            c.revive_mon(r)
-        elif rng.random() < 0.7:
-            r = int(rng.integers(3))
+        # allowed to take out a MAJORITY (2 of 3): the no-quorum
+        # map-freeze path is part of what chaos must exercise
+        if dead_mons and rng.random() < 0.4:
+            c.revive_mon(dead_mons.pop())
+        elif len(dead_mons) < 2:
+            r = next(m for m in range(3) if m not in dead_mons)
             c.kill_mon(r)
             dead_mons.add(r)
 
@@ -138,6 +146,8 @@ def test_chaos_thrash_no_data_loss(seed):
             c.tick(6.0)
         # heal: monitors back to quorum, revive killed (not destroyed)
         # osds, let down->out + recovery + backfills run dry
+        rebuilt0 = (c.perf.get("recovered_objects")
+                    + c.perf.get("backfilled_objects"))
         while dead_mons:
             c.revive_mon(dead_mons.pop())
         for o in sorted(dead_osds - destroyed):
@@ -152,6 +162,12 @@ def test_chaos_thrash_no_data_loss(seed):
                 break
             c.tick(6.0)
         assert not c.backfills, f"round {round_i}: backfills stuck"
+        if expect_rebuild[0]:
+            rebuilt1 = (c.perf.get("recovered_objects")
+                        + c.perf.get("backfilled_objects"))
+            assert rebuilt1 > rebuilt0, \
+                f"round {round_i}: destroyed data never rebuilt"
+            expect_rebuild[0] = False
         # every surviving byte exact (reads also run verify-on-read,
         # so lingering rot gets caught AND repaired here)
         for name, want in sorted(shadow.items()):
@@ -170,7 +186,3 @@ def test_chaos_thrash_no_data_loss(seed):
                 assert rep["inconsistent"] == [], (round_i, ps, rep)
 
     assert shadow, "chaos never wrote anything"
-    if destroyed:
-        # losing a disk for good must have forced real reconstruction
-        assert c.perf.get("recovered_objects") \
-            + c.perf.get("backfilled_objects") > 0
