@@ -7,7 +7,7 @@ number (20 TB/s) on the axon tunnel platform:
 
 * correctness gate: each timed kernel's full output for a small batch
   is fetched and byte-compared against the pure-numpy GF oracle before
-  any timing; a wrong kernel aborts the bench.
+  any timing; a wrong kernel aborts that impl's bench.
 * distinct inputs: a 4-batch pool of device-generated random data
   (`jax.random.bits`, no tunnel staging) is rotated every iteration.
 * elision-proof sync: the whole timed loop is ONE jitted `lax.scan`
@@ -25,9 +25,29 @@ number (20 TB/s) on the axon tunnel platform:
   ceph_erasure_code_benchmark.cc ErasureCodeBench::encode); touched
   bytes (k+m) are also reported.
 
-The JSON line's `extra` dict carries the full metric set VERDICT r01
-asked for: decode GB/s, every-impl encode table, CPU-native baseline,
-CRUSH placement throughput, and recovery objects/s.
+Availability engineering (round 3 — the tunnel was sick for the whole
+of rounds 1-2 and the driver gets exactly ONE run per round):
+
+* backend acquisition happens in SUBPROCESSES with hard timeouts — the
+  known failure mode is a jax.devices() call that hangs forever, which
+  no in-process try/except can survive. Probes retry with exponential
+  backoff for up to BENCH_TPU_WAIT seconds (default 600).
+* if the chip never comes up, the bench falls back to the CPU backend
+  (jax.config.update wins over the site hook's axon selection), runs
+  every section that is still meaningful, and reports
+  `extra.tpu_ok: false` plus the probe diagnostics.
+* a watchdog thread flushes whatever has been measured as the one JSON
+  line and hard-exits at BENCH_DEADLINE seconds (default 1800), so a
+  MID-RUN hang also cannot produce an empty artifact.
+* results land in a shared STATE dict the moment they are measured;
+  the final line is assembled from STATE by whoever emits first
+  (normal path or watchdog), guarded by an Event.
+
+The JSON line's `extra` dict carries the full metric set: decode GB/s,
+every-impl encode table (incl. pallas), CPU-native baseline, CRUSH
+placement throughput, recovery objects/s + GB/s at the 4 MiB/2-loss
+north-star geometry, and LRC/Clay single-chunk repair (GB/s + measured
+helper-I/O ratios — BASELINE rows 3 and 4).
 
 `vs_baseline` divides by the 40 GB/s/chip north-star target from
 BASELINE.json (no published reference number exists — BASELINE.md).
@@ -36,7 +56,9 @@ BASELINE.json (no published reference number exists — BASELINE.md).
 import functools
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -49,11 +71,176 @@ SUB = int(os.environ.get("BENCH_SUBBATCH", "32"))   # objects per iteration
 POOL = 4                               # rotated input batches
 N1, N2 = 4, 20
 REPS = 3
+TPU_WAIT = float(os.environ.get("BENCH_TPU_WAIT", "600"))
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "1800"))
+
+T0 = time.monotonic()
+STATE = {"extra": {}, "errors": [], "backend": None, "tpu_ok": False}
+_EMITTED = threading.Event()       # wakes the watchdog's sleep
+_EMIT_LOCK = threading.Lock()      # serializes the one emission
 
 
 def log(msg: str) -> None:
-    print(f"bench: {msg}", file=sys.stderr, flush=True)
+    print(f"bench[{time.monotonic() - T0:7.1f}s]: {msg}",
+          file=sys.stderr, flush=True)
 
+
+def fail(where: str, err) -> None:
+    msg = f"{where}: {err!r}"
+    log(msg)
+    STATE["errors"].append(msg[:300])
+
+
+def _snapshot_state() -> dict:
+    """Deep-copy STATE tolerating concurrent inserts from the main
+    thread (the watchdog emits while sections may still be running)."""
+    import copy
+    for _ in range(5):
+        try:
+            return copy.deepcopy(STATE)
+        except RuntimeError:       # "dictionary changed size..."
+            time.sleep(0.05)
+    return {"extra": {}, "errors": STATE["errors"][:],
+            "backend": STATE["backend"], "tpu_ok": STATE["tpu_ok"]}
+
+
+def emit(note: str | None = None) -> None:
+    """Assemble and print THE one JSON line from STATE. Exactly-once:
+    lock + flag (an Event alone would be check-then-set racy between
+    the watchdog and the normal path)."""
+    with _EMIT_LOCK:
+        if _EMITTED.is_set():
+            return
+        _EMITTED.set()
+        snap = _snapshot_state()
+    extra = snap["extra"]
+    enc = extra.get("encode_gbps_by_impl") or {}
+    ok = bool(enc) and snap["tpu_ok"]
+    if enc:
+        impl = max(enc, key=enc.get)
+        gbps = enc[impl]
+        extra["best_impl"] = impl
+    else:
+        gbps = 0.0
+    extra["ok"] = ok
+    extra["backend"] = snap["backend"]
+    extra["tpu_ok"] = snap["tpu_ok"]
+    extra["elapsed_s"] = round(time.monotonic() - T0, 1)
+    if note:
+        extra["note"] = note
+    if snap["errors"]:
+        extra["errors"] = snap["errors"][:8]
+    extra["methodology"] = "slope-timed scan pipeline, digest-synced, " \
+        "oracle-gated (docs/BENCH_METHODOLOGY.md)"
+    print(json.dumps({
+        "metric": f"rs_k{K}m{M}_encode_4MiB_input",
+        "value": round(gbps, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(gbps / TARGET_GBPS, 4),
+        "extra": extra,
+    }), flush=True)
+
+
+def _watchdog() -> None:
+    def run():
+        budget = DEADLINE - (time.monotonic() - T0) - 5.0
+        if _EMITTED.wait(timeout=max(budget, 1.0)):
+            return
+        try:
+            log(f"WATCHDOG: deadline {DEADLINE}s reached; flushing "
+                f"partial results and exiting")
+            STATE["errors"].append(
+                "watchdog: deadline hit, partial results")
+            emit(note="watchdog flush")
+            sys.stderr.flush()
+        except BaseException as e:     # noqa: BLE001 — last resort:
+            try:                       # the line MUST still print
+                print(json.dumps({
+                    "metric": f"rs_k{K}m{M}_encode_4MiB_input",
+                    "value": 0.0, "unit": "GB/s/chip",
+                    "vs_baseline": 0.0,
+                    "extra": {"ok": False,
+                              "note": f"watchdog emergency: {e!r}"},
+                }), flush=True)
+            except BaseException:      # noqa: BLE001
+                pass
+        finally:
+            os._exit(0)
+    threading.Thread(target=run, daemon=True).start()
+
+
+# -- backend acquisition ----------------------------------------------------
+
+_PROBE_SRC = """\
+import jax, jax.numpy as jnp
+ds = jax.devices()
+v = int(jax.jit(lambda x: x + 1)(jnp.int32(41)))
+assert v == 42, v
+print("PLATFORM=" + ds[0].platform, flush=True)
+"""
+
+
+def _probe(timeout: float) -> str | None:
+    """Probe backend setup AND a tiny jit compile in a subprocess (the
+    known failure mode is a hang no in-process guard survives). Returns
+    the platform string or None."""
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        fail("probe", f"hung > {timeout:.0f}s (killed)")
+        return None
+    except Exception as e:        # noqa: BLE001 — diagnostics, not control
+        fail("probe", e)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    tail = (r.stderr or "").strip().splitlines()[-3:]
+    fail("probe", f"rc={r.returncode} stderr={' | '.join(tail)[:200]}")
+    return None
+
+
+def acquire_backend() -> str:
+    """Patiently wait for the TPU tunnel; fall back to CPU. Returns the
+    platform this process should use ('axon'/'tpu'/'cpu'/...). No jax
+    import happens in this process until the decision is made."""
+    want_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) and \
+        os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    if not want_tpu:
+        plat = _probe(timeout=180) or "cpu"
+        log(f"no TPU tunnel configured; backend={plat}")
+        return plat
+    probe_deadline = time.monotonic() + min(TPU_WAIT, DEADLINE * 0.45)
+    delay, attempt = 5.0, 0
+    while time.monotonic() < probe_deadline:
+        attempt += 1
+        left = probe_deadline - time.monotonic()
+        per_probe = max(60.0, min(150.0, left))
+        log(f"TPU probe #{attempt} (timeout {per_probe:.0f}s, "
+            f"{left:.0f}s of patience left)")
+        plat = _probe(timeout=per_probe)
+        if plat:
+            log(f"TPU probe #{attempt} OK: platform={plat}")
+            return plat
+        if time.monotonic() + delay >= probe_deadline:
+            break
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
+    log(f"TPU never came up after {attempt} probes; falling back to CPU "
+        f"(CPU sections still run; tpu_ok=false)")
+    return "cpu"
+
+
+def _force_cpu() -> None:
+    """Make THIS process use the CPU backend even though the site hook
+    selected axon at startup: an explicit jax.config update outranks
+    both the hook and JAX_PLATFORMS (same trick as tests/conftest.py)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+# -- timed pipeline ---------------------------------------------------------
 
 def _pipeline(enc_fn, pool_arr):
     """One-jit scan: iteration i encodes pool[i%POOL]; carry is a u8
@@ -100,6 +287,8 @@ def _timed(run, n):
     return time.perf_counter() - t0
 
 
+# -- sections ---------------------------------------------------------------
+
 def bench_encode_impls(impls):
     import jax
     import jax.numpy as jnp
@@ -122,7 +311,7 @@ def bench_encode_impls(impls):
     pool.block_until_ready()
     bytes_per_iter = SUB * K * CHUNK
 
-    results = {}
+    results = STATE["extra"].setdefault("encode_gbps_by_impl", {})
     for impl in impls:
         try:
             fn = make_encoder(matrix, impl, bucket_batch=False)
@@ -131,15 +320,13 @@ def bench_encode_impls(impls):
                 raise AssertionError(f"impl {impl} output != oracle")
             run = _pipeline(fn, pool)
             gbps, t1, t2 = _slope(run, bytes_per_iter)
-            results[impl] = gbps
+            results[impl] = round(gbps, 3)
             log(f"encode {impl}: t({N1})={t1:.3f}s t({N2})={t2:.3f}s "
                 f"slope {gbps:.2f} GB/s in "
                 f"({bytes_per_iter * (N2 - N1) / 1e9:.2f} GB marginal, "
                 f"touched x{(K + M) / K:.3f})")
-        except AssertionError:
-            raise  # wrong bytes must kill the bench, not be skipped
-        except Exception as e:
-            log(f"encode impl {impl} failed: {e!r}")
+        except Exception as e:    # noqa: BLE001 — isolate per impl
+            fail(f"encode impl {impl}", e)
     return results
 
 
@@ -181,13 +368,14 @@ def bench_decode():
     gbps, t1, t2 = _slope(run, bytes_per_iter)
     log(f"decode mxu (2 erasures): t({N1})={t1:.3f}s t({N2})={t2:.3f}s "
         f"slope {gbps:.2f} GB/s in")
+    STATE["extra"]["decode_gbps"] = round(gbps, 3)
     return gbps
 
 
 def bench_cpu_native():
     """CPU baseline via the native codec (BASELINE.md rows 1-2)."""
     import numpy as np
-    out = {}
+    out = STATE["extra"].setdefault("cpu_native_encode_gbps", {})
     try:
         import ceph_tpu.native  # noqa: F401 — registers the plugin
         from ceph_tpu.ec.registry import factory
@@ -207,8 +395,8 @@ def bench_cpu_native():
             gbps = batch * size / best / 1e9
             out[label] = round(gbps, 3)
             log(f"cpu native encode {label}: {gbps:.2f} GB/s/core")
-    except Exception as e:
-        log(f"cpu native baseline failed: {e!r}")
+    except Exception as e:        # noqa: BLE001 — section isolation
+        fail("cpu native baseline", e)
     return out
 
 
@@ -219,109 +407,231 @@ def bench_crush(n_objects=int(os.environ.get("BENCH_CRUSH_OBJECTS",
     n_osds-OSD CRUSH map (EC rule, indep), vectorized mapper. The full
     10M run is config #5 verbatim; the default 1M keeps the driver
     bench under budget and the rate extrapolates linearly (per-lane
-    cost is batch-independent — measured)."""
+    cost is batch-independent — measured at 10M, see BASELINE.md)."""
     import numpy as np
 
     from ceph_tpu.crush.map import build_hierarchy, ec_rule
     from ceph_tpu.crush.mapper import VectorMapper, full_weights
 
-    try:
-        m = build_hierarchy(n_osds, osds_per_host=10, hosts_per_rack=25)
-        ec_rule(m, rule_id=1, choose_type=1)
-        vm = VectorMapper(m)
-        weights = full_weights(n_osds)
-        sub = 1_000_000
-        xs0 = np.arange(sub, dtype=np.uint32)
-        np.asarray(vm.do_rule(1, xs0, weights, K + M))  # compile + warm
-        t0 = time.perf_counter()
-        done = 0
-        # full sub-batches only (variable tails would recompile); the
-        # rate divides by the count actually placed
-        while done < n_objects:
+    m = build_hierarchy(n_osds, osds_per_host=10, hosts_per_rack=25)
+    ec_rule(m, rule_id=1, choose_type=1)
+    vm = VectorMapper(m)
+    weights = full_weights(n_osds)
+    # CPU fallback: XLA's constant folding on the bucket-table gathers
+    # scales with lane count at compile time — smaller sub-batches keep
+    # the section inside the deadline (rate is lane-count independent)
+    sub = 1_000_000 if STATE["tpu_ok"] else 100_000
+    n_objects = n_objects if STATE["tpu_ok"] else min(n_objects, 500_000)
+    xs0 = np.arange(sub, dtype=np.uint32)
+    np.asarray(vm.do_rule(1, xs0, weights, K + M))  # compile + warm
+    t0 = time.perf_counter()
+    done = 0
+    # full sub-batches only (variable tails would recompile); the
+    # rate divides by the count actually placed
+    while done < n_objects:
+        xs = np.arange(done, done + sub, dtype=np.uint32)
+        res = vm.do_rule(1, xs, weights, K + M)
+        done += sub
+    np.asarray(res)  # sync on the last batch
+    dt = time.perf_counter() - t0
+    rate = done / dt
+    log(f"crush: {done} placements x{K + M} on {n_osds} OSDs "
+        f"in {dt:.2f}s = {rate / 1e6:.2f} M placements/s")
+    STATE["extra"]["crush_placements_per_s"] = round(rate)
+    # BASELINE config #5 is 10M objects verbatim: extend to the full
+    # run when the measured rate says it fits the deadline comfortably
+    full = 10_000_000
+    if done < full and (full - done) / rate < 150:
+        while done < full:
             xs = np.arange(done, done + sub, dtype=np.uint32)
             res = vm.do_rule(1, xs, weights, K + M)
             done += sub
-        np.asarray(res)  # sync on the last batch
+        np.asarray(res)
         dt = time.perf_counter() - t0
-        rate = done / dt
-        log(f"crush: {done} placements x{K + M} on {n_osds} OSDs "
-            f"in {dt:.2f}s = {rate / 1e6:.2f} M placements/s")
-        return rate
-    except Exception as e:
-        log(f"crush bench failed: {e!r}")
-        return None
+        log(f"crush full config#5: {done} placements in {dt:.2f}s = "
+            f"{done / dt / 1e6:.2f} M placements/s")
+        STATE["extra"]["crush_placements_per_s_10M"] = round(done / dt)
+    return rate
 
 
-def bench_recovery(objects=128, size=1 << 20, lost=1):
-    """PG recovery objects/s through the mini-ECBackend (metric #2)."""
+def bench_recovery(objects=int(os.environ.get("BENCH_RECOVERY_OBJECTS",
+                                              "128")),
+                   size=OBJECT_SIZE, lost=2):
+    """PG recovery at the north-star geometry: 4 MiB objects, TWO lost
+    shards, rebuilt through ECBackend's fused CRC+decode+CRC pipeline
+    with double-buffered host staging (ref: src/osd/ECBackend.cc
+    continue_recovery_op). Reports objects/s and GB/s of data rebuilt."""
     import numpy as np
-    try:
-        from ceph_tpu.ec.interface import profile_from_string
-        from ceph_tpu.osd.ecbackend import ECBackend, ShardSet
+    from ceph_tpu.ec.interface import profile_from_string
+    from ceph_tpu.osd.ecbackend import ECBackend, ShardSet
 
-        profile = profile_from_string(f"k={K} m={M}")
-        cluster = ShardSet()
-        be = ECBackend(profile, "1.0", list(range(K + M)), cluster)
-        rng = np.random.default_rng(0)
-        objs = {f"obj{i:06d}": rng.integers(0, 256, size, np.uint8)
-                for i in range(objects)}
-        be.write_objects(objs)
-        dead = list(range(lost))
-        for s in dead:
-            cluster.stores.pop(be.acting[s], None)
-        repl = {s: 1000 + s for s in dead}
+    if not STATE["tpu_ok"]:
+        objects = min(objects, 32)   # CPU fallback: stay in deadline
+    profile = profile_from_string(f"k={K} m={M}")
+    cluster = ShardSet()
+    be = ECBackend(profile, "1.0", list(range(K + M)), cluster)
+    rng = np.random.default_rng(0)
+    objs = {f"obj{i:06d}": rng.integers(0, 256, size, np.uint8)
+            for i in range(objects)}
+    be.write_objects(objs)
+    dead = list(range(lost))
+    for s in dead:
+        cluster.stores.pop(be.acting[s], None)
+    repl = {s: 1000 + s for s in dead}
+    t0 = time.perf_counter()
+    counters = be.recover_shards(dead, replacement_osds=repl)
+    dt = time.perf_counter() - t0
+    rate = objects / dt
+    gbps = counters["bytes"] / dt / 1e9
+    log(f"recovery: {counters['bytes'] >> 20} MiB rebuilt over "
+        f"{objects} x {size >> 20} MiB objects ({lost} shards lost) "
+        f"in {dt:.2f}s = {rate:.1f} objects/s, {gbps:.2f} GB/s rebuilt")
+    STATE["extra"]["recovery_objects_per_s"] = round(rate, 1)
+    STATE["extra"]["recovery_rebuilt_gbps"] = round(gbps, 3)
+    return rate
+
+
+def bench_lrc_repair(k=8, m=4, l=4):
+    """LRC single-chunk repair (BASELINE row 3): k=8 m=4 l=4 — one lost
+    data chunk repairs from its LOCAL group (l chunks read), not k.
+    Reports repair GB/s (rebuilt bytes/s) and the measured
+    helper-bytes/chunk-bytes ratio, vs k for plain RS (ref:
+    src/erasure-code/lrc/ErasureCodeLrc.cc minimum_to_decode)."""
+    import numpy as np
+    from ceph_tpu.ec.registry import factory
+
+    coder = factory(f"plugin=lrc k={k} m={m} l={l}")
+    n = coder.get_chunk_count()
+    chunk = 256 * 1024
+    B = max(1, (64 << 20) // (k * chunk))
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, (B, k, chunk), np.uint8)
+    parity = coder.encode_chunks(data)        # (B, n-k, chunk)
+    # assemble the full stripe in POSITION order (LRC interleaves data
+    # and local/global parity positions via its mapping string)
+    data_pos = list(coder.data_positions)
+    coding_pos = [i for i in range(n) if i not in set(data_pos)]
+    full = np.zeros((B, n, chunk), np.uint8)
+    full[:, data_pos] = data
+    full[:, coding_pos] = parity
+    lost = data_pos[0]                        # a data chunk
+    avail = [i for i in range(n) if i != lost]
+    helpers = sorted(coder.minimum_to_decode([lost], avail))
+    ratio = len(helpers)                      # helper-bytes / chunk-bytes
+    have = {h: full[:, h] for h in helpers}
+    # correctness gate then timed repair
+    rec = coder.decode_chunks([lost], have)
+    if not (rec[lost] == full[:, lost]).all():
+        raise AssertionError("lrc repair != original")
+    best = None
+    for _ in range(3):
         t0 = time.perf_counter()
-        counters = be.recover_shards(dead, replacement_osds=repl)
+        coder.decode_chunks([lost], have)
         dt = time.perf_counter() - t0
-        rate = objects / dt
-        log(f"recovery: {counters['bytes'] >> 20} MiB rebuilt over "
-            f"{objects} x {size >> 20} MiB objects in {dt:.2f}s = "
-            f"{rate:.1f} objects/s")
-        return rate
-    except Exception as e:
-        log(f"recovery bench failed: {e!r}")
+        best = dt if best is None else min(best, dt)
+    gbps = B * chunk / best / 1e9
+    res = {"repair_gbps": round(gbps, 3), "helper_chunks": ratio,
+           "rs_helper_chunks": k, "io_savings": round(k / ratio, 2)}
+    STATE["extra"]["lrc_repair_k8m4l4"] = res
+    log(f"lrc k={k} m={m} l={l} repair: {gbps:.2f} GB/s rebuilt, "
+        f"{ratio} helper chunks vs {k} for RS (I/O savings "
+        f"{k / ratio:.1f}x)")
+    return res
+
+
+def bench_clay_repair(k=8, m=4, d=11):
+    """Clay MSR single-chunk repair (BASELINE row 4): k=8 m=4 d=11 —
+    each of d helpers contributes only beta = 1/(d-k+1) of its bytes.
+    Reports repair GB/s and the measured helper-bytes/(k*chunk) ratio
+    vs 1.0 for plain RS (ref: src/erasure-code/clay/ErasureCodeClay.cc
+    minimum_to_decode sub-chunk ranges)."""
+    import numpy as np
+    from ceph_tpu.ec.registry import factory
+
+    coder = factory(f"plugin=clay k={k} m={m} d={d}")
+    sub_count = coder.get_sub_chunk_count()
+    chunk = 256 * 1024
+    assert chunk % sub_count == 0
+    B = max(1, (32 << 20) // (k * chunk))
+    rng = np.random.default_rng(22)
+    data = rng.integers(0, 256, (B, k, chunk), np.uint8)
+    parity = coder.encode_chunks(data)        # (B, m, chunk)
+    full = np.concatenate([data, parity], axis=1)   # (B, k+m, chunk)
+    lost = 0
+    avail = [i for i in range(k + m) if i != lost]
+    need = coder.minimum_to_decode_subchunks(lost, avail)
+    helper_bytes = sum(len(planes) for planes in need.values()) \
+        * (chunk // sub_count)
+    beta_ratio = helper_bytes / (k * chunk)   # vs full-rebuild read
+    have = {h: full[:, h] for h in need}
+    rec = coder.repair_from_chunks(lost, have)
+    if not (rec == full[:, lost]).all():
+        raise AssertionError("clay repair != original")
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        coder.repair_from_chunks(lost, have)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    gbps = B * chunk / best / 1e9
+    res = {"repair_gbps": round(gbps, 3),
+           "helper_bytes_ratio_vs_rs": round(beta_ratio, 4),
+           "theory_ratio": round(d / ((d - k + 1) * k), 4),
+           "io_savings": round(1.0 / beta_ratio, 2)}
+    STATE["extra"]["clay_repair_k8m4d11"] = res
+    log(f"clay k={k} m={m} d={d} repair: {gbps:.2f} GB/s rebuilt, "
+        f"helper bytes = {beta_ratio:.3f} of RS full-read "
+        f"(theory {d / ((d - k + 1) * k):.3f}, savings "
+        f"{1.0 / beta_ratio:.1f}x)")
+    return res
+
+
+def _section(name: str, skip: set, fn, *a, **kw):
+    if name in skip:
+        log(f"section {name}: skipped via BENCH_SKIP")
+        return None
+    log(f"section {name}: start")
+    try:
+        return fn(*a, **kw)
+    except Exception as e:        # noqa: BLE001 — section isolation
+        fail(f"section {name}", e)
         return None
 
 
 def main() -> None:
-    import jax
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    _watchdog()
+    global SUB, N2
+    try:
+        plat = acquire_backend()
+        STATE["backend"] = plat
+        STATE["tpu_ok"] = plat not in (None, "cpu")
+        if plat == "cpu":
+            _force_cpu()
+            # interpreter-speed backend: shrink the working set so the
+            # CPU fallback still finishes inside the deadline
+            SUB = min(SUB, 4)
+            N2 = min(N2, 10)
+        import jax
+        log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
-    impls = os.environ.get("BENCH_IMPLS", "mxu,bitlinear,pallas").split(",")
-    enc = bench_encode_impls([i for i in impls if i])
-    if not enc:
-        raise SystemExit("all encode impls failed")
-    extra = {"encode_gbps_by_impl": {k: round(v, 3) for k, v in enc.items()}}
+        default_impls = "mxu,bitlinear,pallas" if STATE["tpu_ok"] \
+            else "mxu,bitlinear"   # pallas on CPU = interpret mode: not
+        #                            a kernel measurement, just minutes
+        impls = [i for i in os.environ.get(
+            "BENCH_IMPLS", default_impls).split(",") if i]
 
-    skip = set(os.environ.get("BENCH_SKIP", "").split(","))
-    if "decode" not in skip:
-        try:
-            extra["decode_gbps"] = round(bench_decode(), 3)
-        except Exception as e:
-            log(f"decode bench failed: {e!r}")
-    if "cpu" not in skip:
-        extra["cpu_native_encode_gbps"] = bench_cpu_native()
-    if "crush" not in skip:
-        r = bench_crush()
-        if r:
-            extra["crush_placements_per_s"] = round(r)
-    if "recovery" not in skip:
-        r = bench_recovery()
-        if r:
-            extra["recovery_objects_per_s"] = round(r, 1)
-
-    impl = max(enc, key=enc.get)
-    gbps = enc[impl]
-    extra["best_impl"] = impl
-    extra["methodology"] = "slope-timed scan pipeline, digest-synced, " \
-        "oracle-gated (docs/BENCH_METHODOLOGY.md)"
-    print(json.dumps({
-        "metric": f"rs_k{K}m{M}_encode_4MiB_input",
-        "value": round(gbps, 3),
-        "unit": "GB/s/chip",
-        "vs_baseline": round(gbps / TARGET_GBPS, 4),
-        "extra": extra,
-    }))
+        skip = set(os.environ.get("BENCH_SKIP", "").split(","))
+        _section("encode", skip, bench_encode_impls, impls)
+        _section("decode", skip, bench_decode)
+        _section("cpu", skip, bench_cpu_native)
+        _section("crush", skip, bench_crush)
+        _section("recovery", skip, bench_recovery)
+        _section("lrc", skip, bench_lrc_repair)
+        _section("clay", skip, bench_clay_repair)
+    except BaseException as e:    # noqa: BLE001 — the line must print
+        fail("main", e)
+    emit()
+    sys.exit(0)
 
 
 if __name__ == "__main__":
