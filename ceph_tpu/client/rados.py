@@ -126,11 +126,27 @@ class RadosStriper:
             yield q, ooff, pos, take
             pos += take
 
-    def size(self, soid: str) -> int:
+    def _read_meta(self, soid: str) -> tuple[int, int]:
+        """(logical size, high-water-mark size). The hwm tracks the
+        LARGEST size the stream ever had, so remove() can find pieces
+        a later truncate-shrink left behind (zeroed but extant). Old
+        8-byte metas (pre-hwm) read back hwm == size."""
         try:
-            return int.from_bytes(self.io.read(self._meta(soid)), "little")
+            raw = bytes(self.io.read(self._meta(soid)))
         except KeyError:
             raise KeyError(f"no striped object {soid!r}")
+        size = int.from_bytes(raw[:8], "little")
+        hwm = int.from_bytes(raw[8:16], "little") if len(raw) >= 16 \
+            else size
+        return size, max(size, hwm)
+
+    def _write_meta(self, soid: str, size: int, hwm: int) -> None:
+        self.io.write_full(self._meta(soid),
+                           size.to_bytes(8, "little")
+                           + hwm.to_bytes(8, "little"))
+
+    def size(self, soid: str) -> int:
+        return self._read_meta(soid)[0]
 
     def write(self, soid: str, data: bytes | np.ndarray,
               offset: int = 0) -> None:
@@ -141,13 +157,12 @@ class RadosStriper:
             piece = arr[lpos - offset:lpos - offset + ln]
             self.io.write(self._obj(soid, q), piece, offset=ooff)
         try:
-            cur = self.size(soid)
+            cur, hwm = self._read_meta(soid)
         except KeyError:
-            cur = 0
+            cur = hwm = 0
         new = max(cur, offset + len(arr))
         if new != cur:
-            self.io.write_full(self._meta(soid),
-                               new.to_bytes(8, "little"))
+            self._write_meta(soid, new, max(hwm, new))
 
     def read(self, soid: str, length: int | None = None,
              offset: int = 0) -> bytes:
@@ -180,19 +195,21 @@ class RadosStriper:
         contract; the reference trims/zeroes objects)."""
         if new_size < 0:
             raise ValueError(f"truncate to {new_size} < 0")
-        old = self.size(soid)
+        old, hwm = self._read_meta(soid)
         if new_size < old:
             pos = new_size
             while pos < old:
                 n = min(zero_chunk, old - pos)
                 self.write(soid, b"\x00" * n, offset=pos)
                 pos += n
-        self.io.write_full(self._meta(soid),
-                           new_size.to_bytes(8, "little"))
+        self._write_meta(soid, new_size, max(hwm, new_size))
 
     def remove(self, soid: str) -> None:
-        total = self.size(soid)
-        qs = {q for q, _, _, _ in self._extents(0, max(total, 1))}
+        # walk to the HIGH-WATER mark, not the current size: a
+        # truncate-shrink keeps (zeroed) pieces past the new boundary
+        # that a size-bounded walk would leak forever
+        _, hwm = self._read_meta(soid)
+        qs = {q for q, _, _, _ in self._extents(0, max(hwm, 1))}
         for q in sorted(qs):
             try:
                 self.io.remove(self._obj(soid, q))

@@ -76,9 +76,20 @@ def calc_straws(weights: list[int]) -> list[int]:
     the win probability tracks the weight ratio — the approximation
     whose known bias led to straw2). 16.16 fixed-point outputs.
 
+    Models straw_calc_version=1 semantics: zero-weight items get a
+    zero straw AND are excluded from the tier accounting (numleft
+    decrements) — the v1 fix for the v0 bug where zero weights skewed
+    every later tier. The all-zero-draw winner diverges knowingly:
+    both mapper impls return ITEM_NONE (a failed draw that retries/
+    rejects), where the reference's bucket_straw_choose returns
+    items[0] — i.e. an all-zero-weight straw bucket here places
+    nothing instead of always its first item.
+
     NOTE: internally pinned (oracle==vector parity + monotonicity
     tests), not byte-verified against the reference (empty mount —
-    SURVEY.md citation notice)."""
+    SURVEY.md citation notice). First action if the mount populates:
+    pin calc_straws + zero-straw winner semantics against crushtool
+    output for maps with zero and duplicate weights."""
     size = len(weights)
     straws = [0] * size
     order = sorted(range(size), key=lambda i: (weights[i], i))

@@ -172,9 +172,16 @@ class Messenger:
             sock.close()
             return
         conn = _Conn(sock)
-        if not self._adopt(peer, conn, inbound=True):
-            return
-        self._replay(peer, conn, peer_seen)
+        # adopt+replay must be one atomic step under the peer lock:
+        # published-but-not-yet-replayed is a window where a concurrent
+        # send() (which holds only the peer lock) could emit a NEW
+        # higher-seq frame first, making the receiver's max-seq dedup
+        # discard the later-replayed older frames — silent loss.
+        # _connect() already orders it this way; mirror it here.
+        with self._plock(peer):
+            if not self._adopt(peer, conn, inbound=True):
+                return
+            self._replay(peer, conn, peer_seen)
 
     def _replay(self, peer: str, conn: _Conn, peer_seen: int) -> None:
         """Retire entries the peer's handshake already acknowledges

@@ -15,6 +15,7 @@ large request at the head is not starved by small ones slipping past
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 
@@ -65,8 +66,19 @@ class Throttle:
                 return True
             ev = threading.Event()
             self._waiters.append((c, ev))
+        # one monotonic deadline for the WHOLE wait: each wakeup that
+        # doesn't admit us resumes with the remaining time, so repeated
+        # baton-passing can't extend the caller's timeout unboundedly
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
         while True:
-            if not ev.wait(timeout):
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                timed_out = True
+            else:
+                timed_out = not ev.wait(remaining)
+            if timed_out:
                 with self._lock:
                     try:
                         self._waiters.remove((c, ev))
