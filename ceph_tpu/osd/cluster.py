@@ -52,7 +52,9 @@ class SimCluster:
                  down_out_interval: float = 600.0,
                  min_down_reporters: int = 2,
                  n_mons: int = 3,
-                 hosts_per_rack: int | None = None):
+                 hosts_per_rack: int | None = None,
+                 store: str = "mem",
+                 store_dir: str | None = None):
         if hosts_per_rack is None:
             hosts_per_rack = max(4, n_osds)  # one big rack by default
         crush = build_hierarchy(n_osds, osds_per_host=osds_per_host,
@@ -63,6 +65,27 @@ class SimCluster:
         crush.tunables = Tunables(choose_total_tries=51)
         self.osdmap = OSDMap(crush)
         self.cluster = ShardSet()
+        # store backend switch (the store_test.cc parameterization):
+        # "mem" = RAM MemStore (process death keeps bytes by fiat);
+        # "tin" = persistent TinStore (kill really drops RAM and revive
+        # really recovers from WAL+checkpoint — measured, not assumed)
+        if store not in ("mem", "tin"):
+            raise ValueError(f"store={store!r} not in ('mem', 'tin')")
+        self.store_kind = store
+        self.store_dir = store_dir
+        if store == "tin":
+            import os as _os
+            import tempfile
+            from .tinstore import TinStore
+            if store_dir is None:
+                self.store_dir = tempfile.mkdtemp(prefix="tinstore-")
+            # verify_reads off INSIDE the cluster: shard integrity is
+            # the backend's hinfo CRC layer (verify-on-read + EIO
+            # reconstruct), which must see rotten bytes to repair them;
+            # TinStore still verifies every object at mount/fsck
+            self.cluster.store_factory = lambda o: TinStore(
+                _os.path.join(self.store_dir, f"osd.{o}"),
+                verify_reads=False)
         self.profile = profile
         # pool type switch (ref: pg_pool_t TYPE_REPLICATED vs
         # TYPE_ERASURE; PrimaryLogPG drives either through PGBackend):
@@ -344,14 +367,24 @@ class SimCluster:
     # -- failure model ------------------------------------------------------
 
     def kill_osd(self, osd: int) -> None:
-        """Process death: store bytes survive, peer stops answering."""
+        """Process death: store bytes survive, peer stops answering.
+        On a persistent store this is REAL SIGKILL semantics — the RAM
+        mirror is dropped and only WAL+checkpoint bytes remain; any
+        path that still reads the dead store raises instead of quietly
+        seeing ghost state."""
         self.alive[osd] = False
+        st = self.cluster.stores.get(osd)
+        if st is not None:
+            st.crash()
         g_log.dout("osd", 1, f"osd.{osd} killed at t={self.now}")
 
     def destroy_osd(self, osd: int) -> None:
-        """Disk loss: kill + drop the store."""
+        """Disk loss: kill + drop the store (and its on-disk files)."""
         self.kill_osd(osd)
-        self.cluster.stores.pop(osd, None)
+        st = self.cluster.stores.pop(osd, None)
+        if st is not None and st.path is not None:
+            import shutil
+            shutil.rmtree(st.path, ignore_errors=True)
         self.destroyed.add(osd)
 
     def revive_osd(self, osd: int) -> None:
@@ -365,6 +398,12 @@ class SimCluster:
             raise ValueError(
                 f"osd.{osd} was destroyed (disk lost); it cannot rejoin "
                 f"with its old identity — let recovery re-place its data")
+        st = self.cluster.stores.get(osd)
+        if st is not None and st.is_down:
+            # persistent store: recover state from WAL+checkpoint (the
+            # OSD boot mount; what MemStore keeps by fiat, TinStore
+            # must actually replay)
+            st.remount()
         self.alive[osd] = True
         self.last_heard[:, osd] = self.now
         if not self.osdmap.osd_up[osd]:

@@ -50,12 +50,17 @@ from .stripe import HashInfo, StripeInfo, as_flat_u8
 
 @dataclass
 class ShardSet:
-    """The 'cluster': one MemStore per OSD id."""
+    """The 'cluster': one ObjectStore per OSD id. `store_factory` picks
+    the backend — MemStore (default) or a persistent TinStore keyed by
+    osd id (the store_test.cc parameterization, applied to the whole
+    cluster sim)."""
     stores: dict[int, MemStore] = field(default_factory=dict)
+    store_factory: "callable | None" = None
 
     def osd(self, osd_id: int) -> MemStore:
         if osd_id not in self.stores:
-            self.stores[osd_id] = MemStore()
+            self.stores[osd_id] = (self.store_factory(osd_id)
+                                   if self.store_factory else MemStore())
         return self.stores[osd_id]
 
 
@@ -603,8 +608,10 @@ class ECBackend(PGBackend):
         if len(lost) > self.m:
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
         excluded = helper_exclude or set()
+        full_plan = names is None
         names = sorted(self.object_sizes) if names is None \
             else sorted(set(names))
+        provided = set(names)
         # helpers must be caught up for everything being REBUILT — a
         # stale survivor would decode old bytes into the new shard.
         # Validate the plan BEFORE mutating acting, so an impossible
@@ -722,9 +729,7 @@ class ECBackend(PGBackend):
                 complete(pending.pop(0))
         while pending:
             complete(pending.pop(0))
-        # recovered shards are now caught up with everything logged
-        for s in lost:
-            self.shard_applied[s] = self.pg_log.head
+        self._mark_caught_up(lost, full_plan, provided)
         return counters
 
     # -- deep scrub ----------------------------------------------------------

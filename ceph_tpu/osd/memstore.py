@@ -86,9 +86,26 @@ class MemStore:
     """All state in RAM; crash-consistency is trivially atomic because
     transactions apply under a copy-validate-commit discipline."""
 
+    #: no on-disk footprint (the lifecycle contract shared w/ TinStore)
+    path: str | None = None
+
     def __init__(self):
         self.collections: dict[str, dict[str, _Object]] = {}
         self.committed_txns = 0
+
+    # -- lifecycle (shared store contract; see tinstore.TinStore) -----------
+    # RAM-only semantics: "process death keeps bytes by fiat", so
+    # crash/remount are no-ops and the store is never down.
+
+    @property
+    def is_down(self) -> bool:
+        return False
+
+    def crash(self) -> None:
+        pass
+
+    def remount(self) -> None:
+        pass
 
     # -- transaction apply --------------------------------------------------
 

@@ -200,6 +200,21 @@ class PGBackend:
                        names=None, helper_exclude=None) -> dict:
         raise NotImplementedError
 
+    def _mark_caught_up(self, lost: list[int], full_plan: bool,
+                        provided: set) -> None:
+        """Advance recovered slots' applied cursors to the log head —
+        but only when the recovered names cover the slot's whole
+        missing set. A narrower caller-supplied subset must not mark
+        objects it never touched as fresh (that would defeat
+        _fresh_for's staleness gate). Shared by both backends so the
+        gate can't silently diverge."""
+        for s in lost:
+            missing = self.pg_log.missing_since(self.shard_applied[s])
+            if missing is None:           # log trimmed: backfill must
+                missing = self.object_sizes   # have covered everything
+            if full_plan or set(missing) <= provided:
+                self.shard_applied[s] = self.pg_log.head
+
     def deep_scrub(self) -> dict:
         raise NotImplementedError
 
@@ -518,8 +533,10 @@ class ReplicatedBackend(PGBackend):
         SimCluster's repeer/backfill/catch-up paths drive either."""
         lost = sorted(set(lost_shards))
         excluded = helper_exclude or set()
+        full_plan = names is None
         names = sorted(self.object_sizes) if names is None \
             else sorted(set(names))
+        provided = set(names)
         # a deletes-only replay pushes nothing and needs no source
         rebuild = [n for n in names if n in self.object_sizes]
         survivors: list[int] = []
@@ -548,8 +565,7 @@ class ReplicatedBackend(PGBackend):
                 sub = group[i:i + batch]
                 self._push_batch(sub, olen, lost, survivors,
                                  verify_hinfo, counters)
-        for s in lost:
-            self.shard_applied[s] = self.pg_log.head
+        self._mark_caught_up(lost, full_plan, provided)
         return counters
 
     def _push_batch(self, sub: list[str], olen: int, lost: list[int],

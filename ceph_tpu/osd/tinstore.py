@@ -1,0 +1,517 @@
+"""TinStore — the persistent, crash-consistent ObjectStore.
+
+A minimal file-backed store behind the exact ObjectStore interface
+MemStore implements, so every backend/cluster path runs unchanged on
+either (the reference parameterizes one suite over MemStore and
+BlueStore the same way; ref: src/test/objectstore/store_test.cc).
+
+Design (the load-bearing slice of the reference's L4, ref:
+src/os/bluestore/BlueStore.cc _do_write/_kv_sync_thread WAL discipline,
+_verify_csum read-path checksums, BlueStore::fsck; transactional
+contract ref: src/os/ObjectStore.h Transaction/queue_transaction):
+
+* WRITE-AHEAD LOG. Every queue_transaction serializes its op list to
+  one length-prefixed, crc32c-sealed record and appends it to
+  `wal.log` BEFORE any state mutates. A transaction is either wholly
+  in the WAL or absent — the atomicity unit is the record. `flush()`
+  to the OS happens on every commit (process-kill consistency);
+  `o_dsync=True` adds an fsync per commit (machine-crash consistency,
+  the reference's bluefs WAL fsync).
+* RAM MIRROR. Committed state is applied to an internal MemStore,
+  which serves all reads — the disk is the durability plane, RAM the
+  serving plane (BlueStore's onode/buffer cache role, taken to the
+  limit that fits this framework's test scale).
+* CHECKPOINTS. When the WAL exceeds `wal_max_bytes`, the whole state
+  is serialized (versioned encoding, per-object crc32c, whole-file
+  seal) to `ckpt.tmp` and atomically renamed over `ckpt`; WAL records
+  up to the checkpoint seq become dead weight and the log is reset.
+  Replay seq-skips anything the checkpoint already covers, so a crash
+  between rename and reset double-applies nothing.
+* VERIFY-ON-READ. Each object carries its crc32c (native C kernel,
+  bit-identical to ceph_crc32c — csum/reference.py parity-pinned);
+  read()/getattr-adjacent paths re-checksum the served data and raise
+  `TinStoreCorruption` on mismatch (the _verify_csum -EIO analog).
+  Mount re-verifies every object loaded from a checkpoint.
+* RECOVERY. mount() = load newest valid checkpoint, then replay WAL
+  records in seq order, each crc-checked. A torn tail record (the
+  crash-mid-append window) is detected and truncated away; a corrupt
+  record BEFORE valid ones is real damage and fails fsck loudly.
+* FSCK. TinStore.fsck(path) re-reads everything offline and reports
+  {objects, bad_objects, wal_records, torn_tail, errors} without
+  touching a live instance.
+
+Process-kill semantics for the chaos tests: crash() drops the RAM
+mirror and file handles with NO checkpoint (what SIGKILL leaves
+behind); remount() recovers purely from disk. SimCluster(store="tin")
+routes kill/revive through these, so thrash survival is a measured
+property of the WAL, not an axiom of the sim.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import numpy as np
+
+from ..utils.encoding import Decoder, Encoder, EncodingError
+from .memstore import MemStore, Transaction, _Object
+
+_REC_MAGIC = 0x544E4952    # "RINT" little-endian: record
+_REC_HDR = struct.Struct("<IQI")     # magic, seq, body_len
+_CKPT_VERSION = 1
+
+
+class TinStoreCorruption(IOError):
+    """Checksum mismatch on the read path (the -EIO analog)."""
+
+
+_crc_impl = None
+
+
+def _crc32c(data) -> int:
+    """Whole-buffer crc32c, raw-register convention (seed 0xFFFFFFFF,
+    no final inversion) — native C fast path, pure-python fallback."""
+    global _crc_impl
+    if _crc_impl is None:
+        try:
+            from ..native import lib
+            L = lib()
+
+            def _crc_impl(b, _L=L):
+                return int(_L.ec_crc32c(0xFFFFFFFF, b, len(b)))
+        except Exception:          # no toolchain: correctness over speed
+            from ..csum.reference import ceph_crc32c
+
+            def _crc_impl(b):
+                return int(ceph_crc32c(0xFFFFFFFF, b))
+    b = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+    return _crc_impl(b)
+
+
+# -- transaction (de)serialization ------------------------------------------
+
+def _encode_op(e: Encoder, op: tuple) -> None:
+    kind = op[0]
+    e.string(kind)
+    if kind in ("mkcoll", "rmcoll"):
+        e.string(op[1])
+    elif kind in ("touch", "remove"):
+        e.string(op[1]).string(op[2])
+    elif kind == "write":
+        e.string(op[1]).string(op[2]).u64(op[3]).blob(op[4].tobytes())
+    elif kind == "truncate":
+        e.string(op[1]).string(op[2]).u64(op[3])
+    elif kind == "setattr":
+        e.string(op[1]).string(op[2]).string(op[3]).blob(op[4])
+    elif kind == "rmattr":
+        e.string(op[1]).string(op[2]).string(op[3])
+    elif kind == "omap_set":
+        e.string(op[1]).string(op[2])
+        e.mapping(op[3], Encoder.blob, Encoder.blob)
+    else:
+        raise EncodingError(f"unknown op {kind!r}")
+
+
+def _decode_op(d: Decoder) -> tuple:
+    kind = d.string()
+    if kind in ("mkcoll", "rmcoll"):
+        return (kind, d.string())
+    if kind in ("touch", "remove"):
+        return (kind, d.string(), d.string())
+    if kind == "write":
+        cid, oid, off = d.string(), d.string(), d.u64()
+        data = np.frombuffer(d.blob(), dtype=np.uint8).copy()
+        return (kind, cid, oid, off, data)
+    if kind == "truncate":
+        return (kind, d.string(), d.string(), d.u64())
+    if kind == "setattr":
+        return (kind, d.string(), d.string(), d.string(), d.blob())
+    if kind == "rmattr":
+        return (kind, d.string(), d.string(), d.string())
+    if kind == "omap_set":
+        return (kind, d.string(), d.string(),
+                d.mapping(Decoder.blob, Decoder.blob))
+    raise EncodingError(f"unknown op {kind!r}")
+
+
+def _encode_txn(txn: Transaction) -> bytes:
+    e = Encoder()
+    e.start(1, 1)
+    e.list(txn.ops, _encode_op)
+    e.finish()
+    return e.bytes()
+
+
+def _decode_txn(body: bytes) -> Transaction:
+    d = Decoder(body)
+    d.start(1)
+    txn = Transaction()
+    txn.ops = d.list(_decode_op)
+    d.finish()
+    return txn
+
+
+class TinStore:
+    """File-backed ObjectStore: WAL + checkpoint durability, RAM-mirror
+    serving, crc32c verify-on-read. Interface == MemStore."""
+
+    def __init__(self, path: str, o_dsync: bool = False,
+                 verify_reads: bool = True,
+                 wal_max_bytes: int = 64 << 20):
+        self.path = path
+        self.o_dsync = o_dsync
+        self.verify_reads = verify_reads
+        self.wal_max_bytes = wal_max_bytes
+        self._lock = threading.RLock()
+        self._mem: MemStore | None = None
+        self._crcs: dict[tuple[str, str], int] = {}
+        self._seq = 0              # last committed WAL seq
+        self._wal_f = None
+        os.makedirs(path, exist_ok=True)
+        self.mount()
+
+    # -- paths ---------------------------------------------------------------
+
+    @property
+    def _wal_path(self) -> str:
+        return os.path.join(self.path, "wal.log")
+
+    @property
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.path, "ckpt")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def mount(self) -> None:
+        """Load checkpoint (verify every object), replay WAL tail."""
+        with self._lock:
+            self._mem = MemStore()
+            self._crcs = {}
+            self._seq = 0
+            base_seq = self._load_checkpoint()
+            self._seq = base_seq
+            self._replay_wal(base_seq)
+            self._wal_f = open(self._wal_path, "ab")
+
+    @property
+    def is_down(self) -> bool:
+        """True between crash()/umount() and the next (re)mount()."""
+        return self._mem is None
+
+    def crash(self) -> None:
+        """SIGKILL semantics: drop RAM state and handles, NO flush, NO
+        checkpoint. Only bytes already written to the files survive."""
+        with self._lock:
+            if self._wal_f is not None:
+                try:
+                    self._wal_f.close()   # data already flushed per-commit;
+                except OSError:           # close() loses nothing extra
+                    pass
+                self._wal_f = None
+            self._mem = None
+            self._crcs = {}
+
+    def remount(self) -> None:
+        """Restart after crash(): recover purely from disk."""
+        self.mount()
+
+    def umount(self) -> None:
+        """Clean shutdown: checkpoint then release handles."""
+        with self._lock:
+            self.checkpoint()
+            self._wal_f.close()
+            self._wal_f = None
+            self._mem = None
+            self._crcs = {}
+
+    def _alive(self) -> MemStore:
+        if self._mem is None:
+            raise RuntimeError(f"TinStore {self.path} is down "
+                               f"(crashed/umounted; remount() first)")
+        return self._mem
+
+    # -- WAL -----------------------------------------------------------------
+
+    def _append_record(self, body: bytes) -> None:
+        self._seq += 1
+        hdr = _REC_HDR.pack(_REC_MAGIC, self._seq, len(body))
+        rec = hdr + body
+        rec += struct.pack("<I", _crc32c(rec))
+        self._wal_f.write(rec)
+        self._wal_f.flush()                      # survives process kill
+        if self.o_dsync:
+            os.fsync(self._wal_f.fileno())       # survives machine crash
+
+    def _scan_wal(self):
+        """Yield (seq, body) for every valid record; returns via
+        StopIteration the (good_bytes, torn_tail, error) triple."""
+        try:
+            with open(self._wal_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return 0, False, None
+        off = 0
+        n = len(raw)
+        while off < n:
+            if off + _REC_HDR.size + 4 > n:
+                return off, True, None           # torn header
+            magic, seq, blen = _REC_HDR.unpack_from(raw, off)
+            if magic != _REC_MAGIC:
+                return off, False, f"bad magic at {off}"
+            end = off + _REC_HDR.size + blen + 4
+            if end > n:
+                return off, True, None           # torn body
+            (crc,) = struct.unpack_from("<I", raw, end - 4)
+            if _crc32c(raw[off:end - 4]) != crc:
+                # a bad crc at the very tail is a torn append; bad crc
+                # FOLLOWED by more bytes is real corruption
+                return off, end >= n, (None if end >= n
+                                       else f"crc mismatch at {off}")
+            yield seq, raw[off + _REC_HDR.size:end - 4]
+            off = end
+        return off, False, None
+
+    def _replay_wal(self, base_seq: int) -> None:
+        gen = self._scan_wal()
+        while True:
+            try:
+                seq, body = next(gen)
+            except StopIteration as stop:
+                good_bytes, torn, err = stop.value
+                if err:
+                    raise TinStoreCorruption(
+                        f"{self._wal_path}: {err} (mid-log corruption; "
+                        f"run fsck)")
+                if torn:
+                    # crash mid-append: drop the partial record
+                    with open(self._wal_path, "ab") as f:
+                        f.truncate(good_bytes)
+                return
+            if seq <= base_seq:
+                continue                         # checkpoint covers it
+            if seq != self._seq + 1:
+                raise TinStoreCorruption(
+                    f"{self._wal_path}: seq jump {self._seq} -> {seq}")
+            txn = _decode_txn(body)
+            for op in txn.ops:
+                self._mem._apply(op)
+            self._mem.committed_txns += 1
+            self._seq = seq
+            self._note_crcs(txn)
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def checkpoint(self) -> None:
+        """Serialize full state atomically; then reset the WAL. Crash
+        windows: before rename -> old ckpt + full WAL; after rename,
+        before reset -> new ckpt + stale WAL records whose seqs are
+        skipped at replay. Either way state is exact."""
+        with self._lock:
+            mem = self._alive()
+            e = Encoder()
+            e.start(_CKPT_VERSION, 1)
+            e.u64(self._seq)
+            e.u64(mem.committed_txns)
+            e.u32(len(mem.collections))
+            for cid in sorted(mem.collections):
+                e.string(cid)
+                coll = mem.collections[cid]
+                e.u32(len(coll))
+                for oid in sorted(coll):
+                    o = coll[oid]
+                    e.string(oid)
+                    e.blob(o.data.tobytes())
+                    e.u32(self._crcs.get((cid, oid), 0))
+                    e.mapping(o.xattrs, Encoder.string, Encoder.blob)
+                    e.mapping(o.omap, Encoder.blob, Encoder.blob)
+            e.finish()
+            body = e.bytes()
+            body += struct.pack("<I", _crc32c(body))
+            tmp = self._ckpt_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(body)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._ckpt_path)
+            if self._wal_f is not None:
+                self._wal_f.close()
+            self._wal_f = open(self._wal_path, "wb")  # reset the log
+
+    def _load_checkpoint(self) -> int:
+        try:
+            with open(self._ckpt_path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return 0
+        if len(raw) < 4:
+            raise TinStoreCorruption(f"{self._ckpt_path}: truncated")
+        (crc,) = struct.unpack_from("<I", raw, len(raw) - 4)
+        if _crc32c(raw[:-4]) != crc:
+            raise TinStoreCorruption(f"{self._ckpt_path}: file seal "
+                                     f"crc mismatch")
+        d = Decoder(raw[:-4])
+        d.start(_CKPT_VERSION)
+        seq = d.u64()
+        self._mem.committed_txns = d.u64()
+        for _ in range(d.u32()):
+            cid = d.string()
+            coll = self._mem.collections.setdefault(cid, {})
+            for _ in range(d.u32()):
+                oid = d.string()
+                data = np.frombuffer(d.blob(), dtype=np.uint8).copy()
+                want = d.u32()
+                got = _crc32c(data)
+                if got != want:
+                    raise TinStoreCorruption(
+                        f"{self._ckpt_path}: {cid}/{oid} data crc "
+                        f"{got:#x} != stored {want:#x}")
+                xattrs = d.mapping(Decoder.string, Decoder.blob)
+                omap = d.mapping(Decoder.blob, Decoder.blob)
+                coll[oid] = _Object(data=data, xattrs=xattrs, omap=omap)
+                self._crcs[(cid, oid)] = want
+        d.finish()
+        return seq
+
+    # -- transactional write path -------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            mem = self._alive()
+            mem._validate(txn)
+            self._append_record(_encode_txn(txn))   # WAL first
+            for op in txn.ops:
+                mem._apply(op)
+            mem.committed_txns += 1
+            self._note_crcs(txn)
+            if self._wal_f.tell() >= self.wal_max_bytes:
+                self.checkpoint()
+
+    def _note_crcs(self, txn: Transaction) -> None:
+        """Refresh the per-object crc for every object a txn touched."""
+        touched: set[tuple[str, str]] = set()
+        for op in txn.ops:
+            kind = op[0]
+            if kind == "rmcoll":
+                cid = op[1]
+                self._crcs = {k: v for k, v in self._crcs.items()
+                              if k[0] != cid}
+            elif kind == "remove":
+                self._crcs.pop((op[1], op[2]), None)
+                touched.discard((op[1], op[2]))
+            elif kind in ("write", "truncate", "touch", "setattr",
+                          "rmattr", "omap_set"):
+                touched.add((op[1], op[2]))
+        for cid, oid in touched:
+            coll = self._mem.collections.get(cid)
+            if coll is not None and oid in coll:
+                self._crcs[(cid, oid)] = _crc32c(coll[oid].data)
+
+    # -- reads (verify-on-read) ----------------------------------------------
+
+    def _verify(self, cid: str, oid: str, o: _Object) -> None:
+        want = self._crcs.get((cid, oid))
+        if want is None:
+            return                 # object predates crc tracking: skip
+        got = _crc32c(o.data)
+        if got != want:
+            raise TinStoreCorruption(
+                f"{cid}/{oid}: crc {got:#x} != expected {want:#x} "
+                f"(verify-on-read)")
+
+    def read(self, cid: str, oid: str, offset: int = 0,
+             length: int | None = None) -> np.ndarray:
+        with self._lock:
+            mem = self._alive()
+            o = mem._obj(cid, oid)
+            if self.verify_reads:
+                self._verify(cid, oid, o)
+            if length is None:
+                return o.data[offset:].copy()
+            return o.data[offset:offset + length].copy()
+
+    def stat(self, cid: str, oid: str) -> int:
+        return self._alive().stat(cid, oid)
+
+    def getattr(self, cid: str, oid: str, key: str) -> bytes:
+        return self._alive().getattr(cid, oid, key)
+
+    def exists(self, cid: str, oid: str) -> bool:
+        return self._alive().exists(cid, oid)
+
+    def list_objects(self, cid: str) -> list[str]:
+        return self._alive().list_objects(cid)
+
+    def list_collections(self) -> list[str]:
+        return self._alive().list_collections()
+
+    @property
+    def collections(self):
+        """Direct state access, like MemStore.collections — the tests
+        and scrub paths poke objects through this; mutations made here
+        bypass the WAL on purpose (that's what corruption IS)."""
+        return self._alive().collections
+
+    @property
+    def committed_txns(self) -> int:
+        return self._alive().committed_txns
+
+    @committed_txns.setter
+    def committed_txns(self, v: int) -> None:
+        self._alive().committed_txns = v
+
+    # -- fsck ----------------------------------------------------------------
+
+    @staticmethod
+    def fsck(path: str) -> dict:
+        """Offline integrity audit (ref: BlueStore::fsck): re-read the
+        checkpoint + WAL into a scratch state, verify every crc, and
+        report without mutating anything on disk."""
+        report = {"objects": 0, "bad_objects": [], "wal_records": 0,
+                  "torn_tail": False, "errors": []}
+        scratch = TinStore.__new__(TinStore)
+        scratch.path = path
+        scratch._lock = threading.RLock()
+        scratch._mem = MemStore()
+        scratch._crcs = {}
+        scratch._seq = 0
+        scratch._wal_f = None
+        try:
+            base = scratch._load_checkpoint()
+        except TinStoreCorruption as e:
+            report["errors"].append(str(e))
+            return report
+        gen = scratch._scan_wal()
+        seq = base
+        while True:
+            try:
+                rseq, body = next(gen)
+            except StopIteration as stop:
+                _, torn, err = stop.value
+                report["torn_tail"] = torn
+                if err:
+                    report["errors"].append(err)
+                break
+            if rseq <= base:
+                continue
+            if rseq != seq + 1:
+                report["errors"].append(f"seq jump {seq} -> {rseq}")
+                break
+            try:
+                txn = _decode_txn(body)
+                for op in txn.ops:
+                    scratch._mem._apply(op)
+                scratch._note_crcs(txn)
+            except (EncodingError, KeyError) as e:
+                report["errors"].append(f"record {rseq}: {e}")
+                break
+            seq = rseq
+            report["wal_records"] += 1
+        for cid, coll in scratch._mem.collections.items():
+            for oid, o in coll.items():
+                report["objects"] += 1
+                want = scratch._crcs.get((cid, oid))
+                if want is not None and _crc32c(o.data) != want:
+                    report["bad_objects"].append(f"{cid}/{oid}")
+        return report

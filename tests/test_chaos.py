@@ -14,12 +14,18 @@ from ceph_tpu.osd.cluster import SimCluster, StaleMap
 from ceph_tpu.osd.ecbackend import shard_cid
 
 
-@pytest.mark.parametrize("seed", [101, 202])
-def test_chaos_thrash_no_data_loss(seed):
+@pytest.mark.parametrize("seed,store", [(101, "mem"), (202, "mem"),
+                                        (303, "tin"), (404, "tin")])
+def test_chaos_thrash_no_data_loss(seed, store, tmp_path):
+    """store="tin" runs the SAME schedule with process-kill semantics
+    made real: kill_osd drops the RAM mirror, revive remounts from
+    WAL+checkpoint — thrash survival on the persistent store is a
+    measured property, not a sim axiom."""
     rng = np.random.default_rng(seed)
     N_OSDS = 14
     c = SimCluster(n_osds=N_OSDS, pg_num=8, down_out_interval=30.0,
-                   heartbeat_grace=20.0)
+                   heartbeat_grace=20.0, store=store,
+                   store_dir=str(tmp_path / "osds"))
     ob = Objecter(c)
     shadow: dict[str, bytes] = {}
     dead_osds: set[int] = set()
