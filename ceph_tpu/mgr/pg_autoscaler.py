@@ -7,10 +7,11 @@ a power of two; a change is only recommended when the current value is
 off by more than the threshold factor (default 3.0), because pg_num
 changes cause mass data movement and must not flap).
 
-Scope note: like the reference module in `warn` mode, this produces
-RECOMMENDATIONS; actually re-splitting PGs online is the OSD-side
-pg_split machinery, out of this slice's scope (SURVEY §2 names the
-autoscaler; splitting lives in the non-target BlueStore/PG internals).
+This module produces RECOMMENDATIONS (the reference's `warn` mode);
+executing them is `SimCluster.apply_autoscale()`, which drives the
+OSD-side split machinery (`split_pgs`: quorum-gated pg_num bump,
+local collection split, pg_temp-protected child backfill — ref:
+src/osd/PG.cc split) — the reference's autoscale `on` mode.
 """
 
 from __future__ import annotations

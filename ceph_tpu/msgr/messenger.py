@@ -23,7 +23,18 @@ and correctness-first. Implemented faithfully:
   queue redelivers on reconnect;
 * explicit ACKs retire the sender's unacked queue; receivers dedup by
   (peer, seq) so redelivery is exactly-once upward;
-* a Dispatcher callback per message type (ms_fast_dispatch role).
+* a Dispatcher callback per message type (ms_fast_dispatch role);
+* SECURE mode (ref: src/msg/async/ProtocolV2.cc secure session
+  handshake + cephx): a Messenger built with a shared secret
+  negotiates mode at handshake (strict — a secure endpoint refuses a
+  crc peer, the anti-downgrade stance), mutually authenticates with
+  an HMAC challenge/response over both sides' nonces (the cephx
+  role, collapsed to one pre-shared key), derives a per-connection
+  AES-256-GCM session key via HKDF(secret, nonce_c||nonce_s), and
+  seals every frame `[u32 len][12B nonce][AES-GCM(seq|type|payload)]`
+  with the length as AAD. Nonces are direction-prefixed counters
+  (never reused under one key); a tampered frame fails the GCM tag
+  and kills the session exactly like a crc mismatch — replay heals.
 
 Threading model: one reader thread per connection + locked writers
 (the reference runs epoll worker threads; blocking threads keep this
@@ -42,8 +53,77 @@ from ..utils.encoding import Decoder, Encoder
 
 BANNER = b"ceph_tpu msgr v2\n"
 ACK_TYPE = 0
+MODE_CRC = 0
+MODE_SECURE = 1
+_GCM_TAG = 16
+_NONCE = 12
 
 _MSG_TYPES: dict[int, type] = {}
+
+
+class _SecureBox:
+    """Per-connection AES-256-GCM sealer/opener. One direction-unique
+    4-byte prefix + 8-byte little-endian counter per nonce — counters
+    are advanced under the connection's write lock, so a nonce is
+    never reused under the session key."""
+
+    def __init__(self, key: bytes, tx_prefix: bytes, rx_prefix: bytes):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        self._gcm = AESGCM(key)
+        self._tx_prefix = tx_prefix
+        self._rx_prefix = rx_prefix
+        self._tx_ctr = 0
+
+    def seal(self, plain: bytes, aad: bytes) -> bytes:
+        nonce = self._tx_prefix + self._tx_ctr.to_bytes(8, "little")
+        self._tx_ctr += 1
+        return nonce + self._gcm.encrypt(nonce, plain, aad)
+
+    def open(self, body: bytes, aad: bytes) -> bytes:
+        from cryptography.exceptions import InvalidTag
+        if len(body) < _NONCE + _GCM_TAG:
+            raise ConnectionError("secure frame too short")
+        nonce, ct = body[:_NONCE], body[_NONCE:]
+        if nonce[:4] != self._rx_prefix:
+            raise ConnectionError("secure frame nonce from wrong "
+                                  "direction")
+        try:
+            return self._gcm.decrypt(nonce, ct, aad)
+        except InvalidTag:
+            # tampered/garbled ciphertext kills the session, exactly
+            # like a crc mismatch in crc mode; replay redelivers
+            raise ConnectionError("secure frame auth tag mismatch")
+
+
+def _derive_key(secret: bytes, nonce_c: bytes, nonce_s: bytes) -> bytes:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    return HKDF(algorithm=hashes.SHA256(), length=32,
+                salt=nonce_c + nonce_s,
+                info=b"ceph_tpu msgr v2 secure session").derive(secret)
+
+
+#: fixed per-role nonce prefixes: deterministic direction separation
+#: (random nonce slices would collide with p=2^-32 per connection and
+#: alias both directions' counter spaces under ONE AES-GCM key)
+_PREFIX_SRV = b"srv\x00"
+_PREFIX_CLI = b"cli\x00"
+
+
+def _auth_proof(secret: bytes, role: bytes, nonce_c: bytes,
+                nonce_s: bytes, name: str,
+                seen_c: int, seen_s: int) -> bytes:
+    """The proofs bind EVERY plaintext handshake field — name and both
+    last-seen sequence numbers — not just the nonces: an unauth'd
+    peer_seen would let an active tamperer inflate it and silently
+    flush the victim's unacked replay queue."""
+    import hashlib
+    import hmac
+    return hmac.new(secret,
+                    role + nonce_c + nonce_s + name.encode()
+                    + seen_c.to_bytes(8, "little")
+                    + seen_s.to_bytes(8, "little"),
+                    hashlib.sha256).digest()
 
 
 def register_message(cls):
@@ -78,17 +158,27 @@ def _crc(data: bytes) -> int:
 class _Conn:
     """One live socket + replay state toward one peer."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, box: _SecureBox | None = None):
         self.sock = sock
         self.wlock = threading.Lock()
         self.alive = True
+        self.box = box
 
     def send_frame(self, seq: int, type_id: int, payload: bytes) -> None:
-        body = struct.pack("<QH", seq, type_id) + payload
-        frame = struct.pack("<I", len(body)) + body
-        frame += struct.pack("<I", _crc(frame))
-        with self.wlock:
-            self.sock.sendall(frame)
+        plain = struct.pack("<QH", seq, type_id) + payload
+        if self.box is None:
+            frame = struct.pack("<I", len(plain)) + plain
+            frame += struct.pack("<I", _crc(frame))
+            with self.wlock:
+                self.sock.sendall(frame)
+        else:
+            with self.wlock:
+                # seal under the lock: the nonce counter must advance
+                # in transmit order or a reordered pair would reuse one
+                hdr = struct.pack(
+                    "<I", _NONCE + len(plain) + _GCM_TAG)
+                frame = hdr + self.box.seal(plain, hdr)
+                self.sock.sendall(frame)
 
     def close(self) -> None:
         self.alive = False
@@ -106,8 +196,16 @@ class Messenger:
     number; unacked messages survive connection death and are replayed
     after the automatic reconnect (send() never silently drops)."""
 
-    def __init__(self, name: str, host: str = "127.0.0.1"):
+    def __init__(self, name: str, host: str = "127.0.0.1",
+                 secret: bytes | None = None):
+        """`secret` switches the endpoint to SECURE mode: every
+        connection mutually authenticates against the shared secret
+        and encrypts frames with a per-connection AES-GCM key. A
+        secure endpoint refuses crc peers and vice versa (strict
+        negotiation — no downgrade path)."""
         self.name = name
+        self.secret = secret
+        self.mode = MODE_SECURE if secret is not None else MODE_CRC
         self._handlers: dict[int, callable] = {}
         self._lock = threading.Lock()
         # one lock per PEER held across seq-assignment + transmit:
@@ -153,6 +251,7 @@ class Messenger:
                              daemon=True).start()
 
     def _handshake_in(self, sock: socket.socket) -> None:
+        box = None
         try:
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
@@ -164,14 +263,39 @@ class Messenger:
             # acceptor has stranded messages too after a reconnect
             (peer_seen,) = struct.unpack(
                 "<Q", self._recv_exact(sock, 8))
+            peer_mode = self._recv_exact(sock, 1)[0]
+            if peer_mode != self.mode:
+                # strict negotiation: refusing the mismatch beats
+                # silently downgrading an endpoint that demands secure
+                sock.close()
+                return
+            nonce_c = b""
+            if self.mode == MODE_SECURE:
+                nonce_c = self._recv_exact(sock, 16)
             sock.sendall(BANNER)
             with self._lock:
                 last_seen = self._in_seq.get(peer, 0)
-            sock.sendall(struct.pack("<Q", last_seen))
+            sock.sendall(struct.pack("<Q", last_seen)
+                         + bytes([self.mode]))
+            if self.mode == MODE_SECURE:
+                import os as _os
+                nonce_s = _os.urandom(16)
+                sock.sendall(nonce_s + _auth_proof(
+                    self.secret, b"srv", nonce_c, nonce_s, self.name,
+                    peer_seen, last_seen))
+                proof_c = self._recv_exact(sock, 32)
+                want = _auth_proof(self.secret, b"cli", nonce_c,
+                                   nonce_s, peer, peer_seen, last_seen)
+                import hmac as _hmac
+                if not _hmac.compare_digest(proof_c, want):
+                    raise ConnectionError(f"auth failure from {peer}")
+                box = _SecureBox(
+                    _derive_key(self.secret, nonce_c, nonce_s),
+                    tx_prefix=_PREFIX_SRV, rx_prefix=_PREFIX_CLI)
         except (OSError, ConnectionError, UnicodeDecodeError):
             sock.close()
             return
-        conn = _Conn(sock)
+        conn = _Conn(sock, box)
         # adopt+replay must be one atomic step under the peer lock:
         # published-but-not-yet-replayed is a window where a concurrent
         # send() (which holds only the peer lock) could emit a NEW
@@ -213,13 +337,40 @@ class Messenger:
             sock.sendall(struct.pack("<H", len(name_b)) + name_b)
             with self._lock:
                 my_seen = self._in_seq.get(peer, 0)
-            sock.sendall(struct.pack("<Q", my_seen))
+            nonce_c = b""
+            if self.mode == MODE_SECURE:
+                import os as _os
+                nonce_c = _os.urandom(16)
+            sock.sendall(struct.pack("<Q", my_seen)
+                         + bytes([self.mode]) + nonce_c)
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
                 raise ConnectionError(f"bad banner from {peer}")
             peer_seen = struct.unpack("<Q",
                                       self._recv_exact(sock, 8))[0]
-            conn = _Conn(sock)
+            peer_mode = self._recv_exact(sock, 1)[0]
+            if peer_mode != self.mode:
+                sock.close()
+                raise ConnectionError(
+                    f"mode mismatch with {peer}: "
+                    f"ours={self.mode} theirs={peer_mode}")
+            box = None
+            if self.mode == MODE_SECURE:
+                nonce_s = self._recv_exact(sock, 16)
+                proof_s = self._recv_exact(sock, 32)
+                import hmac as _hmac
+                want = _auth_proof(self.secret, b"srv", nonce_c,
+                                   nonce_s, peer, my_seen, peer_seen)
+                if not _hmac.compare_digest(proof_s, want):
+                    sock.close()
+                    raise ConnectionError(f"auth failure from {peer}")
+                sock.sendall(_auth_proof(self.secret, b"cli", nonce_c,
+                                         nonce_s, self.name,
+                                         my_seen, peer_seen))
+                box = _SecureBox(
+                    _derive_key(self.secret, nonce_c, nonce_s),
+                    tx_prefix=_PREFIX_CLI, rx_prefix=_PREFIX_SRV)
+            conn = _Conn(sock, box)
             if not self._adopt(peer, conn, inbound=False):
                 raise ConnectionError(f"lost connection race to {peer}")
             self._replay(peer, conn, peer_seen)
@@ -324,15 +475,22 @@ class Messenger:
             while conn.alive:
                 raw_len = self._recv_exact(conn.sock, 4)
                 (blen,) = struct.unpack("<I", raw_len)
-                if blen < 10 or blen > (1 << 26):
+                floor = 10 if conn.box is None \
+                    else 10 + _NONCE + _GCM_TAG
+                if blen < floor or blen > (1 << 26):
                     raise ConnectionError(f"bad frame length {blen}")
                 body = self._recv_exact(conn.sock, blen)
-                (crc,) = struct.unpack("<I",
-                                       self._recv_exact(conn.sock, 4))
-                if _crc(raw_len + body) != crc:
-                    # ProtocolV2 crc mode: corrupt frame kills the
-                    # session; replay redelivers after reconnect
-                    raise ConnectionError("frame crc mismatch")
+                if conn.box is None:
+                    (crc,) = struct.unpack(
+                        "<I", self._recv_exact(conn.sock, 4))
+                    if _crc(raw_len + body) != crc:
+                        # ProtocolV2 crc mode: corrupt frame kills the
+                        # session; replay redelivers after reconnect
+                        raise ConnectionError("frame crc mismatch")
+                else:
+                    # secure mode: the GCM tag is the integrity check
+                    # (and the length header is bound in as AAD)
+                    body = conn.box.open(body, raw_len)
                 seq, tid = struct.unpack("<QH", body[:10])
                 payload = body[10:]
                 if tid == ACK_TYPE:

@@ -209,14 +209,14 @@ class SimCluster:
             if any(a == CRUSH_ITEM_NONE for a in acting):
                 raise ValueError(f"pg {ps} has unfilled slots at creation; "
                                  f"use more osds/hosts")
-            if self.is_erasure:
-                self.pgs[ps] = ECBackend(profile, f"1.{ps}", acting,
-                                         self.cluster,
-                                         chunk_size=chunk_size)
-            else:
-                self.pgs[ps] = ReplicatedBackend(
-                    self.pool_size, f"1.{ps}", acting, self.cluster,
-                    min_size=min_size)
+            self.pgs[ps] = self._make_backend(f"1.{ps}", acting)
+
+    def _make_backend(self, pg: str, acting: list[int]) -> PGBackend:
+        if self.is_erasure:
+            return ECBackend(self.profile, pg, acting, self.cluster,
+                             chunk_size=self.chunk_size)
+        return ReplicatedBackend(self.pool_size, pg, acting,
+                                 self.cluster, min_size=self.pool_min_size)
 
     # -- QoS ----------------------------------------------------------------
 
@@ -299,6 +299,111 @@ class SimCluster:
             g_log.dout("scrub", 1, f"pg 1.{ps} repaired "
                                    f"{rep['repaired']} shard(s)")
         return rep
+
+    # -- PG splitting (pg_num increase) --------------------------------------
+
+    def split_pgs(self, new_pg_num: int) -> dict:
+        """Execute a pg_num increase — the split machinery the
+        autoscaler's recommendation needs (ref: src/osd/PG.cc split;
+        src/mon/OSDMonitor.cc pg_num handling; ceph_stable_mod
+        re-bucketing). Sequence:
+
+        1. quorum-gated map mutation (pg_num is monitor state);
+        2. children are created ON THEIR PARENT'S acting set and the
+           re-bucketed objects move store-LOCALLY (collection split —
+           no bytes cross OSDs, both PG logs record the transfer);
+        3. _repeer_all() then steers each child toward its own CRUSH
+           targets with the standard pg_temp-protected backfill, so
+           reads keep working from the parent's OSDs mid-move.
+
+        Requires a settled cluster (no live backfills, every parent
+        clean) — the reference likewise splits healthy PGs; the
+        autoscaler simply retries later otherwise."""
+        old = self.pg_num
+        if new_pg_num <= old:
+            raise ValueError(f"pg_num {new_pg_num} <= current {old} "
+                             f"(merges not supported)")
+        if self.backfills:
+            raise ValueError("backfills in flight; let the cluster "
+                             "settle before splitting")
+        dead = self._dead_osds()
+        for ps in range(old):
+            be = self.pgs[ps]
+            if any(o in dead or o not in self.cluster.stores
+                   for o in be.acting):
+                raise ValueError(f"pg 1.{ps} degraded; heal before "
+                                 f"splitting")
+            # a live-but-behind shard (revive during quorum loss defers
+            # its catch-up) must refuse HERE, while nothing has moved
+            # and the map is untouched — split_to's own check would
+            # otherwise abort mid-split with children half-created
+            for s in range(be.n):
+                if be.shard_applied[s] < be.pg_log.head:
+                    raise ValueError(
+                        f"pg 1.{ps} shard {s} not caught up; heal "
+                        f"before splitting")
+        if not self._mon_commit(f"pool 1 pg_num {old} -> {new_pg_num}"):
+            raise ValueError("no monitor quorum; pg_num change refused")
+        from .osdmap import (ceph_stable_mod, pg_num_mask,
+                             str_hash_rjenkins)
+        old_mask = pg_num_mask(old)
+        new_mask = pg_num_mask(new_pg_num)
+        children: dict[int, int] = {}
+        moved = 0
+        # one hash pass per parent buckets every re-homed object (the
+        # child ids are deterministic: parent == stable_mod(child, old))
+        kids_of: dict[int, list[int]] = {}
+        for child_ps in range(old, new_pg_num):
+            kids_of.setdefault(
+                int(ceph_stable_mod(child_ps, old, old_mask)),
+                []).append(child_ps)
+        for parent_ps, kids in kids_of.items():
+            parent = self.pgs[parent_ps]
+            rehome: dict[int, list[str]] = {c: [] for c in kids}
+            for n in parent.list_pg_objects():
+                tgt = int(ceph_stable_mod(str_hash_rjenkins(n),
+                                          new_pg_num, new_mask))
+                if tgt != parent_ps:
+                    rehome[tgt].append(n)
+            for child_ps in kids:
+                child = self._make_backend(f"1.{child_ps}",
+                                           list(parent.acting))
+                moved += parent.split_to(child, rehome[child_ps])
+                self.pgs[child_ps] = child
+                children[child_ps] = parent_ps
+        # flip the map LAST: every re-homed byte is already in its
+        # child's collections, so the instant locate() starts routing
+        # to children their data is in place (no observable gap, and
+        # no abort path can leave pg_num pointing at missing PGs)
+        self.osdmap.set_pg_num(1, new_pg_num)
+        self.pg_num = new_pg_num
+        g_log.dout("osd", 1,
+                   f"pool 1 split {old} -> {new_pg_num} pgs; "
+                   f"{moved} objects re-homed into "
+                   f"{len(children)} children (collection split)")
+        # steer children from their parents' OSDs to their own CRUSH
+        # targets; pg_temp keeps the parent set serving meanwhile
+        self._repeer_all()
+        return {"pg_num": new_pg_num, "children": children,
+                "objects_moved": moved}
+
+    def apply_autoscale(self, target_pg_per_osd: int = 100,
+                        threshold: float = 3.0,
+                        max_pg_num: int | None = None) -> dict | None:
+        """Run the autoscaler and EXECUTE its recommendation (the
+        reference's autoscale `on` mode, vs the advisory `warn` the
+        mgr module defaults to; ref: src/pybind/mgr/pg_autoscaler).
+        Returns split_pgs()' report, or None when no change is due.
+        `max_pg_num` caps the jump (mon_max_pool_pg_num role)."""
+        from ..mgr.pg_autoscaler import recommend_pg_num
+        rec = recommend_pg_num(self.osdmap, 1, target_pg_per_osd,
+                               threshold)
+        target = rec["pg_num_recommended"]
+        if max_pg_num is not None:
+            target = min(target, max_pg_num)
+        if not rec["would_adjust"] or target <= self.pg_num:
+            return None
+        return self.split_pgs(target)
 
     def remove(self, names: list[str] | str) -> None:
         names = [names] if isinstance(names, str) else list(names)
@@ -611,6 +716,13 @@ class SimCluster:
             lost, moved = [], []
             for slot, (old, new) in enumerate(zip(be.acting, new_acting)):
                 if old == new:
+                    continue
+                if not self.alive[new]:
+                    # destination died but isn't marked down in the map
+                    # yet (the kill->grace->report window): writing to
+                    # its store would be lost bytes on MemStore and an
+                    # outright error on a crashed TinStore. Defer — the
+                    # mark-down bumps the map and re-plans this slot.
                     continue
                 if self.alive[old] and old in self.cluster.stores:
                     moved.append((slot, old, new))

@@ -251,6 +251,23 @@ class OSDMap:
             self.pg_temp.pop(pg, None)
         self._bump()
 
+    def set_pg_num(self, pool_id: int, pg_num: int) -> None:
+        """Grow a pool's pg_num (and pgp_num with it) — the map half of
+        a PG split (ref: src/mon/OSDMonitor.cc pg_num handling). The
+        stable_mod hash space makes this cheap: surviving parents keep
+        their ps (stable_mod is the identity below the old pg_num), so
+        only split-off children remap. Shrinking (PG merge) is not
+        supported."""
+        pool = self.pools[pool_id]
+        if pg_num < pool.pg_num:
+            raise ValueError(f"pg_num {pg_num} < current {pool.pg_num}: "
+                             f"merges not supported")
+        if pg_num == pool.pg_num:
+            return
+        pool.pg_num = pool.pgp_num = pg_num
+        pool.pg_mask = pool.pgp_mask = pg_num_mask(pg_num)
+        self._bump()
+
     def set_primary_temp(self, pg: tuple[int, int], osd: int | None) -> None:
         if osd is None:
             self.primary_temp.pop(pg, None)

@@ -179,6 +179,60 @@ class PGBackend:
     def list_pg_objects(self) -> list[str]:
         return sorted(self.object_sizes)
 
+    def split_to(self, child: "PGBackend", names) -> int:
+        """PG split, the data half (ref: src/osd/PG.cc split machinery;
+        on-disk it is a LOCAL collection split — no bytes cross OSDs):
+        move `names`' shards store-locally from this PG's collections
+        into `child`'s, carrying the hinfo xattrs, and log the transfer
+        on both sides (child: create entries; parent: delete entries)
+        so later delta-rejoins replay exactly. The child must start on
+        the parent's acting set — relocation to its own CRUSH targets
+        is the cluster layer's pg_temp-protected backfill, afterwards.
+
+        Caller contract: every shard caught up (a clean PG) — enforced
+        here because a behind shard would silently split a stale copy.
+        """
+        if child.acting != self.acting:
+            raise ValueError("split child must start on the parent's "
+                             "acting set")
+        for s in range(self.n):
+            if self.shard_applied[s] < self.pg_log.head:
+                raise ValueError(
+                    f"shard {s} is behind (applied "
+                    f"{self.shard_applied[s]} < head {self.pg_log.head}); "
+                    f"split requires a clean PG")
+        moved = [n for n in names if n in self.object_sizes]
+        for s in range(self.n):
+            st = self._store(s)
+            src = shard_cid(self.pg, s)
+            dst = shard_cid(child.pg, s)
+            t = Transaction()
+            for name in moved:
+                if not st.exists(src, name):
+                    # clean PG + absent store entry = the zero-length
+                    # convention; mirror it on the child WITH an empty
+                    # hinfo — deep scrub reads the xattr unguarded
+                    t.touch(dst, name).truncate(dst, name, 0)
+                    t.setattr(dst, name, HINFO_KEY,
+                              HashInfo(1, 0, [0xFFFFFFFF]).to_bytes())
+                    continue
+                data = st.read(src, name)
+                t.write(dst, name, 0, data).truncate(dst, name, len(data))
+                try:
+                    t.setattr(dst, name, HINFO_KEY,
+                              st.getattr(src, name, HINFO_KEY))
+                except KeyError:
+                    pass    # zero-length objects may carry no hinfo
+                t.remove(src, name)
+            st.queue_transaction(t)
+        live = list(range(self.n))
+        for name in moved:
+            child.object_sizes[name] = self.object_sizes.pop(name)
+            child._log_write(name, live)
+            self.object_versions.pop(name, None)
+            self._log_write(name, live)   # the parent-side DELETE entry
+        return len(moved)
+
     def _replay_deletes(self, lost: list[int], names) -> list[str]:
         """Split a recovery name list: apply deletes for names the PG
         no longer knows (their last log entry was a remove) to the

@@ -141,9 +141,20 @@ def test_chaos_thrash_no_data_loss(seed, store, tmp_path):
                 and ps not in c.backfills:
             c.repair_pg(ps)
 
+    def act_split():
+        # pg_num splitting mid-chaos: a settled healthy cluster splits
+        # for real; anything else must REFUSE cleanly (degraded / busy
+        # / no quorum), never corrupt
+        if c.pg_num >= 32:
+            return
+        try:
+            c.split_pgs(c.pg_num * 2)
+        except ValueError:
+            pass   # refusal is the contract under chaos
+
     menu = [act_write, act_write, act_overwrite, act_rmw, act_remove,
             act_kill_osd, act_mon_churn, act_rot, act_balance,
-            act_repair]
+            act_repair, act_split]
 
     for round_i in range(6):
         act_write()  # every round has fresh data on the line

@@ -50,9 +50,9 @@ class OpReply(Message):
         return m
 
 
-def pair():
-    a = Messenger("osd.0")
-    b = Messenger("osd.1")
+def pair(secret_a=None, secret_b=None):
+    a = Messenger("osd.0", secret=secret_a)
+    b = Messenger("osd.1", secret=secret_b)
     a.add_peer("osd.1", b.addr)
     b.add_peer("osd.0", a.addr)
     return a, b
@@ -243,6 +243,92 @@ class TestReconnectEdges:
                 a.send("osd.1", Ping(i))
             assert a.flush("osd.1", timeout=10)
             assert wait_for(lambda: got == [12, 14]), got
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+class TestSecureMode:
+    """ProtocolV2 secure session analog (ref: src/msg/async/
+    ProtocolV2.cc secure handshake; cephx collapsed to one PSK):
+    mutual auth, AES-GCM frames, strict mode negotiation."""
+
+    SECRET = b"0123456789abcdef0123456789abcdef"
+
+    def secure_pair(self):
+        return pair(secret_a=self.SECRET, secret_b=self.SECRET)
+
+    def test_roundtrip_and_exactly_once_replay(self):
+        a, b = self.secure_pair()
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(1, "sealed"))
+            assert wait_for(lambda: got == [1])
+            # every live conn carries a box (frames are ciphertext)
+            assert all(c.box is not None for c in a._conns.values())
+            for conn in list(a._conns.values()):
+                conn.close()
+            time.sleep(0.05)
+            for i in (2, 3):
+                a.send("osd.1", Ping(i))
+            assert a.flush("osd.1", timeout=15)
+            assert wait_for(lambda: got == [1, 2, 3]), got
+            time.sleep(0.2)
+            assert got == [1, 2, 3]    # replay stays exactly-once
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_tampered_ciphertext_kills_session_then_heals(self):
+        a, b = self.secure_pair()
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(1))
+            assert wait_for(lambda: got == [1])
+            conn = next(iter(a._conns.values()))
+            # a validly-framed but bit-flipped ciphertext: GCM tag
+            # must fail and the receiver must drop the session
+            plain = struct.pack("<QH", 99, Ping.type_id) + b"evil"
+            hdr = struct.pack("<I", 12 + len(plain) + 16)
+            with conn.wlock:
+                sealed = conn.box.seal(plain, hdr)
+                sealed = sealed[:-1] + bytes([sealed[-1] ^ 0x01])
+                conn.sock.sendall(hdr + sealed)
+            assert wait_for(lambda: not conn.alive)
+            assert got == [1]          # nothing forged was dispatched
+            a.send("osd.1", Ping(2))
+            assert a.flush("osd.1", timeout=15)
+            assert wait_for(lambda: got == [1, 2])
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_wrong_secret_refused(self):
+        a, b = pair(secret_a=self.SECRET,
+                    secret_b=b"not the same secret at all!!....")
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                a.send("osd.1", Ping(1))
+                # dialer may only notice at proof check on 2nd leg
+                assert not a.flush("osd.1", timeout=2)
+                raise ConnectionError("never authenticated")
+            assert not b._in_seq     # nothing ever dispatched
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_mode_mismatch_refused_no_downgrade(self):
+        a, b = pair(secret_a=self.SECRET, secret_b=None)
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                a.send("osd.1", Ping(1))
+                assert not a.flush("osd.1", timeout=2)
+                raise ConnectionError("secure endpoint accepted crc")
+            assert not b._in_seq
         finally:
             a.shutdown()
             b.shutdown()
