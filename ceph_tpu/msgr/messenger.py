@@ -158,11 +158,16 @@ def _crc(data: bytes) -> int:
 class _Conn:
     """One live socket + replay state toward one peer."""
 
-    def __init__(self, sock: socket.socket, box: _SecureBox | None = None):
+    def __init__(self, sock: socket.socket, box: _SecureBox | None = None,
+                 peer_inst: bytes = b""):
         self.sock = sock
         self.wlock = threading.Lock()
         self.alive = True
         self.box = box
+        # which peer INCARNATION this conn authenticated: frames from
+        # a conn whose incarnation is no longer current must never
+        # reach the session state (see _read_loop)
+        self.peer_inst = peer_inst
 
     def send_frame(self, seq: int, type_id: int, payload: bytes) -> None:
         plain = struct.pack("<QH", seq, type_id) + payload
@@ -206,6 +211,15 @@ class Messenger:
         self.name = name
         self.secret = secret
         self.mode = MODE_SECURE if secret is not None else MODE_CRC
+        # instance cookie (ref: ProtocolV2 client/server cookies +
+        # RESET_SESSION): a rebooted process reuses its NAME but not
+        # its sequence space — peers detect the new cookie at
+        # handshake and reset the receive direction, else every frame
+        # from the new incarnation would be dropped as a replayed
+        # duplicate by the max-seq dedup
+        import os as _os
+        self.instance_nonce = _os.urandom(8)
+        self._peer_nonce: dict[str, bytes] = {}
         self._handlers: dict[int, callable] = {}
         self._lock = threading.Lock()
         # one lock per PEER held across seq-assignment + transmit:
@@ -250,6 +264,17 @@ class Messenger:
             threading.Thread(target=self._handshake_in, args=(sock,),
                              daemon=True).start()
 
+    def _check_incarnation(self, peer: str, nonce: bytes) -> None:
+        """A changed instance cookie = the peer rebooted: its sequence
+        space restarted, so our receive cursor must too (the
+        RESET_SESSION role). Our own send state stays — the fresh peer
+        reports seen=0 and triggers a full replay of unacked."""
+        with self._lock:
+            old = self._peer_nonce.get(peer)
+            if old is not None and old != nonce:
+                self._in_seq.pop(peer, None)
+            self._peer_nonce[peer] = nonce
+
     def _handshake_in(self, sock: socket.socket) -> None:
         box = None
         try:
@@ -258,6 +283,7 @@ class Messenger:
                 return
             nlen = struct.unpack("<H", self._recv_exact(sock, 2))[0]
             peer = self._recv_exact(sock, nlen).decode()
+            peer_inst = self._recv_exact(sock, 8)
             # symmetric handshake: both sides exchange their last-seen
             # sequence so BOTH replay their unacked queues — an
             # acceptor has stranded messages too after a reconnect
@@ -272,20 +298,31 @@ class Messenger:
             nonce_c = b""
             if self.mode == MODE_SECURE:
                 nonce_c = self._recv_exact(sock, 16)
-            sock.sendall(BANNER)
+            sock.sendall(BANNER + self.instance_nonce)
+            # report seen=0 toward a NEW peer incarnation (its seq
+            # space restarted) — but do NOT mutate session state yet:
+            # an unauthenticated dialer must not be able to reset the
+            # dedup cursor or fence off live conns. The reset commits
+            # only after the handshake fully validates (below).
             with self._lock:
-                last_seen = self._in_seq.get(peer, 0)
+                stored = self._peer_nonce.get(peer)
+                fresh_inst = stored is not None and stored != peer_inst
+                last_seen = 0 if fresh_inst \
+                    else self._in_seq.get(peer, 0)
             sock.sendall(struct.pack("<Q", last_seen)
                          + bytes([self.mode]))
             if self.mode == MODE_SECURE:
                 import os as _os
                 nonce_s = _os.urandom(16)
                 sock.sendall(nonce_s + _auth_proof(
-                    self.secret, b"srv", nonce_c, nonce_s, self.name,
-                    peer_seen, last_seen))
+                    self.secret, b"srv",
+                    peer_inst + nonce_c, self.instance_nonce + nonce_s,
+                    self.name, peer_seen, last_seen))
                 proof_c = self._recv_exact(sock, 32)
-                want = _auth_proof(self.secret, b"cli", nonce_c,
-                                   nonce_s, peer, peer_seen, last_seen)
+                want = _auth_proof(
+                    self.secret, b"cli",
+                    peer_inst + nonce_c, self.instance_nonce + nonce_s,
+                    peer, peer_seen, last_seen)
                 import hmac as _hmac
                 if not _hmac.compare_digest(proof_c, want):
                     raise ConnectionError(f"auth failure from {peer}")
@@ -295,7 +332,8 @@ class Messenger:
         except (OSError, ConnectionError, UnicodeDecodeError):
             sock.close()
             return
-        conn = _Conn(sock, box)
+        self._check_incarnation(peer, peer_inst)   # post-validation
+        conn = _Conn(sock, box, peer_inst=peer_inst)
         # adopt+replay must be one atomic step under the peer lock:
         # published-but-not-yet-replayed is a window where a concurrent
         # send() (which holds only the peer lock) could emit a NEW
@@ -334,7 +372,8 @@ class Messenger:
             sock = socket.create_connection(tuple(addr), timeout=10)
             sock.sendall(BANNER)
             name_b = self.name.encode()
-            sock.sendall(struct.pack("<H", len(name_b)) + name_b)
+            sock.sendall(struct.pack("<H", len(name_b)) + name_b
+                         + self.instance_nonce)
             with self._lock:
                 my_seen = self._in_seq.get(peer, 0)
             nonce_c = b""
@@ -346,6 +385,7 @@ class Messenger:
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
                 raise ConnectionError(f"bad banner from {peer}")
+            peer_inst = self._recv_exact(sock, 8)
             peer_seen = struct.unpack("<Q",
                                       self._recv_exact(sock, 8))[0]
             peer_mode = self._recv_exact(sock, 1)[0]
@@ -359,33 +399,50 @@ class Messenger:
                 nonce_s = self._recv_exact(sock, 16)
                 proof_s = self._recv_exact(sock, 32)
                 import hmac as _hmac
-                want = _auth_proof(self.secret, b"srv", nonce_c,
-                                   nonce_s, peer, my_seen, peer_seen)
+                want = _auth_proof(
+                    self.secret, b"srv",
+                    self.instance_nonce + nonce_c, peer_inst + nonce_s,
+                    peer, my_seen, peer_seen)
                 if not _hmac.compare_digest(proof_s, want):
                     sock.close()
                     raise ConnectionError(f"auth failure from {peer}")
-                sock.sendall(_auth_proof(self.secret, b"cli", nonce_c,
-                                         nonce_s, self.name,
-                                         my_seen, peer_seen))
+                sock.sendall(_auth_proof(
+                    self.secret, b"cli",
+                    self.instance_nonce + nonce_c, peer_inst + nonce_s,
+                    self.name, my_seen, peer_seen))
                 box = _SecureBox(
                     _derive_key(self.secret, nonce_c, nonce_s),
                     tx_prefix=_PREFIX_CLI, rx_prefix=_PREFIX_SRV)
-            conn = _Conn(sock, box)
+            self._check_incarnation(peer, peer_inst)  # post-validation
+            conn = _Conn(sock, box, peer_inst=peer_inst)
             if not self._adopt(peer, conn, inbound=False):
-                raise ConnectionError(f"lost connection race to {peer}")
+                # a crossing dial won (we're the non-designated side):
+                # the WINNING connection carries the session now — put
+                # our pending frames on it instead of stranding them
+                # until some future reconnect
+                with self._lock:
+                    winner = self._conns.get(peer)
+                if winner is None or not winner.alive:
+                    raise ConnectionError(
+                        f"lost connection race to {peer}")
+                self._replay(peer, winner, peer_seen)
+                return winner
             self._replay(peer, conn, peer_seen)
             return conn
 
     def _adopt(self, peer: str, conn: _Conn, inbound: bool) -> bool:
         """Install the connection for `peer`, resolving simultaneous-
         connect races deterministically (ProtocolV2's race-winner
-        rule): the LOWER name is the designated dialer, so when crossed
-        dials collide, its outgoing socket wins and the other side's
-        inbound attempt is refused. Returns False if refused."""
+        rule): the LOWER name is the designated dialer. The rule must
+        bind BOTH sides — the lower name refuses inbound when it has a
+        live conn, AND the higher name yields its own outbound dial to
+        a live conn — or crossed dials flip-flop killing each other's
+        sockets forever. Returns False if this conn lost."""
         with self._lock:
             old = self._conns.get(peer)
-            if (inbound and self.name < peer
-                    and old is not None and old.alive):
+            if (old is not None and old.alive
+                    and ((inbound and self.name < peer)
+                         or (not inbound and self.name > peer))):
                 keep_old = True
             else:
                 keep_old = False
@@ -493,6 +550,18 @@ class Messenger:
                     body = conn.box.open(body, raw_len)
                 seq, tid = struct.unpack("<QH", body[:10])
                 payload = body[10:]
+                # incarnation fencing: a conn authenticated against a
+                # peer incarnation that is no longer current must not
+                # touch session state — a dying incarnation's buffered
+                # frames arriving AFTER the new one's handshake reset
+                # would re-poison in_seq with stale high seqs (black-
+                # holing the new peer) or retire fresh unacked via old
+                # ACKs. Kill the stale conn instead.
+                with self._lock:
+                    cur = self._peer_nonce.get(peer)
+                if cur is not None and conn.peer_inst != cur:
+                    raise ConnectionError(
+                        "frame from a stale peer incarnation")
                 if tid == ACK_TYPE:
                     if len(payload) != 8:
                         raise ConnectionError("malformed ACK frame")

@@ -1,0 +1,1027 @@
+"""Standalone cluster — the control plane on REAL wire traffic.
+
+Where SimCluster models the cluster in-process under virtual time,
+this module runs it the way the reference's qa/standalone tier does
+(ref: qa/standalone/ceph-helpers.sh run_osd/run_mon/wait_for_clean):
+N OSD daemons + 3 monitors + clients as independent endpoints on
+localhost, every interaction a typed, CRC/AES-GCM-protected frame on
+the Messenger — nothing reaches around the wire:
+
+* client I/O:      MOSDOp / MOSDOpReply        (ref: MOSDOp.h)
+* shard writes:    MStoreOp / MStoreReply       (the MOSDECSubOpWrite
+  role: the PG primary fans per-shard store transactions out to the
+  OSDs that own them; reads pull helper shards back the same way)
+* liveness:        MOSDPing / MOSDPingReply     (ref: MOSDPing.h)
+* failure reports: MOSDFailure -> monitor       (ref: MOSDFailure.h)
+* map commits:     MMonPropose / MMonAccept     (Paxos-lite: leader
+  proposes, commits on majority accept — ref: src/mon/Paxos.cc
+  collapsed to one phase for an alive-leader quorum)
+* map fan-out:     MOSDMap epoch + full encoded OSDMap (MOSDMap.h)
+* boot:            MOSDBoot                     (ref: MOSDBoot.h)
+
+Key design points, and what they re-validate from the in-process sim:
+
+* The PG backends are the SAME ECBackend/ReplicatedBackend classes —
+  unchanged — but their ShardSet hands out RemoteStore proxies, so
+  every queue_transaction/read/getattr/exists a backend performs
+  becomes a blocking RPC to the OSD that owns the bytes (its own
+  shard short-circuits to the local store). The "exactly-once,
+  lossless" messenger guarantees are thereby exercised under real
+  workload ordering, not just test_msgr's synthetic schedules.
+* PG metadata travels WITH the data (the reference's transactions
+  carry pg_log entries to every shard): after each write the primary
+  persists {object_sizes, versions, pg_log, cursors} as an omap blob
+  on every live shard, so a surviving acting member can take over as
+  primary from its local copy after the old primary dies.
+* Failure detection is emergent: OSDs ping each other in real time,
+  report unanswered peers to the monitor leader, the leader commits
+  down+out through its quorum and broadcasts the new epoch; primaries
+  then recover the lost slot onto the CRUSH replacement — every step
+  as frames.
+
+Scope: monitor-leader failover and mid-paxos monitor death stay with
+the in-process monitor layer (mon/monitor.py, which models quorum
+loss); this tier's job is proving the wire transport under daemon
+death. Secure mode composes: pass secret= to run the whole cluster
+over AES-GCM sessions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..msgr.messenger import Message, Messenger, register_message
+from ..utils.encoding import Decoder, Encoder
+from .ecbackend import ECBackend, ShardSet, shard_cid
+from .memstore import MemStore, Transaction
+from .osdmap import OSDMap, PGPool
+from .pgbackend import ReplicatedBackend
+from .pglog import PGLog
+from .tinstore import _decode_txn, _encode_txn
+
+PG_META_KEY = b"pg_meta"
+
+
+# -- typed frames (0x30 block) ----------------------------------------------
+
+class _Blob(Message):
+    """Shared shape: (req_id, ok, kind, payload-bytes)."""
+
+    def __init__(self, req_id: int, ok: bool = True, kind: str = "",
+                 blob: bytes = b"", err: str = ""):
+        self.req_id, self.ok = req_id, ok
+        self.kind, self.blob, self.err = kind, blob, err
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).u64(self.req_id).boolean(self.ok)
+         .string(self.kind).blob(self.blob).string(self.err).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "_Blob":
+        d.start(1)
+        m = cls(d.u64(), d.boolean(), d.string(), d.blob(), d.string())
+        d.finish()
+        return m
+
+
+@register_message
+class MStoreOp(_Blob):
+    type_id = 0x30
+
+
+@register_message
+class MStoreReply(_Blob):
+    type_id = 0x31
+
+
+@register_message
+class MOSDOp(_Blob):
+    type_id = 0x32
+
+
+@register_message
+class MOSDOpReply(_Blob):
+    type_id = 0x33
+
+
+@register_message
+class MOSDPing(Message):
+    type_id = 0x34
+
+    def __init__(self, stamp: float):
+        self.stamp = stamp
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).f64(self.stamp).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDPing":
+        d.start(1)
+        m = cls(d.f64())
+        d.finish()
+        return m
+
+
+@register_message
+class MOSDPingReply(MOSDPing):
+    type_id = 0x35
+
+
+@register_message
+class MOSDFailure(Message):
+    type_id = 0x36
+
+    def __init__(self, failed: int):
+        self.failed = failed
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).i32(self.failed).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDFailure":
+        d.start(1)
+        m = cls(d.i32())
+        d.finish()
+        return m
+
+
+@register_message
+class MOSDBoot(MOSDFailure):
+    type_id = 0x37          # payload: the booting osd id
+
+
+@register_message
+class MMonPropose(Message):
+    type_id = 0x38
+
+    def __init__(self, epoch: int, map_bytes: bytes):
+        self.epoch, self.map_bytes = epoch, map_bytes
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).u32(self.epoch).blob(self.map_bytes).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonPropose":
+        d.start(1)
+        m = cls(d.u32(), d.blob())
+        d.finish()
+        return m
+
+
+@register_message
+class MMonAccept(Message):
+    type_id = 0x39
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).u32(self.epoch).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonAccept":
+        d.start(1)
+        m = cls(d.u32())
+        d.finish()
+        return m
+
+
+@register_message
+class MOSDMapMsg(MMonPropose):
+    type_id = 0x3A          # same shape: epoch + encoded map
+
+
+# -- request/reply plumbing --------------------------------------------------
+
+class _Rpc:
+    """Blocking request/reply over the messenger: correlation ids +
+    per-request events. Reply handlers route by req_id."""
+
+    def __init__(self, msgr: Messenger, reply_type: int):
+        self.msgr = msgr
+        self._lock = threading.Lock()
+        self._next = 1
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        msgr.register_handler(reply_type, self._on_reply)
+
+    def _on_reply(self, peer: str, msg) -> None:
+        with self._lock:
+            ent = self._pending.get(msg.req_id)
+        if ent is not None:
+            ent[1].append(msg)
+            ent[0].set()
+
+    def call(self, peer: str, make_msg, timeout: float = 10.0):
+        """make_msg(req_id) -> Message. Returns the reply or raises
+        ConnectionError on timeout (the caller treats the peer as
+        suspect — the OSD op timeout role)."""
+        with self._lock:
+            rid = self._next
+            self._next += 1
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._pending[rid] = ev
+        try:
+            self.msgr.send(peer, make_msg(rid))
+            if not ev[0].wait(timeout):
+                raise ConnectionError(f"rpc to {peer} timed out")
+            return ev[1][0]
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+
+class RemoteStore:
+    """ObjectStore proxy: the MOSDECSubOpWrite/Read role. Every method
+    is one MStoreOp frame to the OSD owning the physical store."""
+
+    path = None
+
+    def __init__(self, rpc: _Rpc, peer: str, timeout: float = 10.0):
+        self._rpc = rpc
+        self._peer = peer
+        self._timeout = timeout
+
+    def _call(self, kind: str, body: bytes = b"") -> bytes:
+        rep = self._rpc.call(
+            self._peer,
+            lambda rid: MStoreOp(rid, True, kind, body),
+            timeout=self._timeout)
+        if not rep.ok:
+            if rep.err.startswith("KeyError"):
+                raise KeyError(rep.err[9:] or rep.err)
+            raise ConnectionError(f"store op {kind} on {self._peer}: "
+                                  f"{rep.err}")
+        return rep.blob
+
+    @staticmethod
+    def _co(cid: str, oid: str = "", extra=None) -> bytes:
+        e = Encoder()
+        e.string(cid).string(oid)
+        if extra is not None:
+            extra(e)
+        return e.bytes()
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        self._call("txn", _encode_txn(txn))
+
+    def read(self, cid: str, oid: str, offset: int = 0,
+             length: int | None = None) -> np.ndarray:
+        body = self._co(cid, oid, lambda e: e.i64(offset)
+                        .i64(-1 if length is None else length))
+        return np.frombuffer(self._call("read", body), np.uint8).copy()
+
+    def stat(self, cid: str, oid: str) -> int:
+        return Decoder(self._call("stat", self._co(cid, oid))).i64()
+
+    def getattr(self, cid: str, oid: str, key: str) -> bytes:
+        return self._call(
+            "getattr", self._co(cid, oid, lambda e: e.string(key)))
+
+    def exists(self, cid: str, oid: str) -> bool:
+        return bool(self._call("exists", self._co(cid, oid))[0])
+
+    def list_objects(self, cid: str) -> list[str]:
+        d = Decoder(self._call("ls", self._co(cid)))
+        return d.list(Decoder.string)
+
+    def omap_get(self, cid: str, oid: str, key: bytes) -> bytes:
+        return self._call(
+            "omap_get", self._co(cid, oid, lambda e: e.blob(key)))
+
+
+# -- daemons -----------------------------------------------------------------
+
+class OSDDaemon:
+    """One OSD endpoint: local store + the PGs it primaries."""
+
+    def __init__(self, osd_id: int, cluster: "StandaloneCluster"):
+        self.osd_id = osd_id
+        self.c = cluster
+        self.name = f"osd.{osd_id}"
+        self.store = cluster.make_store(osd_id)
+        self.msgr = Messenger(self.name, secret=cluster.secret)
+        self.rpc = _Rpc(self.msgr, MStoreReply.type_id)
+        self.osdmap: OSDMap | None = None
+        self.backends: dict[int, object] = {}     # ps -> PGBackend
+        self.suspect: set[int] = set()            # osd ids (local view)
+        self._lock = threading.RLock()
+        self._store_lock = threading.Lock()
+        self._last_pong: dict[int, float] = {}
+        self._reported: set[int] = set()
+        self._stop = threading.Event()
+        self._start()
+
+    def _start(self) -> None:
+        """Register handlers + start the heartbeat thread (shared by
+        __init__ and revive so the two can't silently diverge)."""
+        m = self.msgr
+        m.register_handler(MStoreOp.type_id, self._on_store_op)
+        m.register_handler(MOSDOp.type_id, self._on_client_op)
+        m.register_handler(MOSDPing.type_id, self._on_ping)
+        m.register_handler(MOSDPingReply.type_id, self._on_pong)
+        m.register_handler(MOSDMapMsg.type_id, self._on_map)
+        self._hb = threading.Thread(target=self._heartbeat_loop,
+                                    daemon=True)
+        self._hb.start()
+
+    # -- store service (the SubOp executor) ---------------------------------
+
+    def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
+        try:
+            with self._store_lock:
+                blob = self._store_op(msg.kind, msg.blob)
+            rep = MStoreReply(msg.req_id, True, msg.kind, blob)
+        except KeyError as e:
+            rep = MStoreReply(msg.req_id, False, msg.kind,
+                              err=f"KeyError:{e}")
+        except Exception as e:   # noqa: BLE001 — fault isolation: the
+            # daemon must answer, not die, on a bad op
+            rep = MStoreReply(msg.req_id, False, msg.kind,
+                              err=f"{type(e).__name__}:{e}")
+        try:
+            self.msgr.send(peer, rep)
+        except (KeyError, OSError, ConnectionError):
+            pass                 # requester died; nothing to tell
+
+    def _store_op(self, kind: str, body: bytes) -> bytes:
+        st = self.store
+        if kind == "txn":
+            st.queue_transaction(_decode_txn(body))
+            return b""
+        d = Decoder(body)
+        cid, oid = d.string(), d.string()
+        if kind == "read":
+            off, ln = d.i64(), d.i64()
+            arr = st.read(cid, oid, off, None if ln < 0 else ln)
+            return arr.tobytes()
+        if kind == "stat":
+            return Encoder().i64(st.stat(cid, oid)).bytes()
+        if kind == "getattr":
+            return st.getattr(cid, oid, d.string())
+        if kind == "exists":
+            return b"\x01" if st.exists(cid, oid) else b"\x00"
+        if kind == "ls":
+            return Encoder().list(st.list_objects(cid),
+                                  Encoder.string).bytes()
+        if kind == "omap_get":
+            key = d.blob()
+            obj = st.collections[cid].get(oid)
+            if obj is None or key not in obj.omap:
+                raise KeyError(f"{cid}/{oid}:{key!r}")
+            return obj.omap[key]
+        raise ValueError(f"unknown store op {kind!r}")
+
+    # -- PG hosting ----------------------------------------------------------
+
+    def _shard_set(self) -> ShardSet:
+        def factory(osd_id: int):
+            if osd_id == self.osd_id:
+                return self.store
+            return RemoteStore(self.rpc, f"osd.{osd_id}",
+                               timeout=self.c.op_timeout)
+        return ShardSet(store_factory=factory)
+
+    def _acting(self, ps: int) -> list[int]:
+        return self.osdmap.pg_to_up_acting_osds(1, ps)[2]
+
+    def _make_backend(self, ps: int, acting: list[int]):
+        if self.c.is_erasure:
+            return ECBackend(self.c.profile, f"1.{ps}", acting,
+                             self._shard_set(),
+                             chunk_size=self.c.chunk_size)
+        return ReplicatedBackend(self.c.pool_size, f"1.{ps}", acting,
+                                 self._shard_set(),
+                                 min_size=self.c.pool_min_size)
+
+    def _persist_meta(self, ps: int) -> None:
+        """Ship the PG's metadata to every live shard as omap (the
+        pg_log-rides-with-the-transaction discipline, ref:
+        PGLog entries inside ObjectStore::Transaction)."""
+        be = self.backends[ps]
+        e = Encoder()
+        e.start(1, 1)
+        e.mapping(be.object_sizes, Encoder.string,
+                  lambda en, v: en.u64(v))
+        e.mapping(be.object_versions, Encoder.string,
+                  lambda en, v: en.u64(v))
+        e.blob(be.pg_log.encode())
+        e.list(be.shard_applied, lambda en, v: en.u64(v))
+        e.list(be.acting, lambda en, v: en.i32(v))
+        e.finish()
+        blob = e.bytes()
+        for s, osd in enumerate(be.acting):
+            if osd in self.suspect:
+                continue
+            t = Transaction().omap_set(shard_cid(be.pg, s), "__pg_meta__",
+                                       {PG_META_KEY: blob})
+            try:
+                be.cluster.osd(osd).queue_transaction(t)
+            except (ConnectionError, OSError):
+                self.suspect.add(osd)
+
+    def _load_meta(self, ps: int, acting: list[int]) -> bytes | None:
+        """Find the freshest persisted PG metadata: local shard first,
+        then any live acting member over the wire (a takeover primary
+        may be the brand-new replacement with an empty store)."""
+        pgid = f"1.{ps}"
+        for s in range(len(acting)):
+            obj = self.store.collections.get(
+                shard_cid(pgid, s), {}).get("__pg_meta__")
+            if obj is not None and PG_META_KEY in obj.omap:
+                return obj.omap[PG_META_KEY]
+        for s, osd in enumerate(acting):
+            if osd == self.osd_id or osd in self.suspect:
+                continue
+            try:
+                return RemoteStore(self.rpc, f"osd.{osd}",
+                                   timeout=2.0).omap_get(
+                    shard_cid(pgid, s), "__pg_meta__", PG_META_KEY)
+            except (KeyError, ConnectionError, OSError):
+                continue
+        return None
+
+    def _restore_backend(self, ps: int, acting: list[int]):
+        """Primary takeover: rebuild the PG from persisted metadata.
+        The backend is restored with the acting set the metadata was
+        recorded against — _reconcile then sees old != new and runs
+        the recovery that re-creates the changed slots (the GetLog/
+        GetMissing outcome)."""
+        blob = self._load_meta(ps, acting)
+        be = self._make_backend(ps, acting)
+        if blob is None:
+            return be            # virgin PG: nothing written yet
+        d = Decoder(blob)
+        d.start(1)
+        be.object_sizes = d.mapping(Decoder.string, Decoder.u64)
+        be.object_versions = d.mapping(Decoder.string, Decoder.u64)
+        be.pg_log = PGLog.decode(d.blob())
+        applied = d.list(Decoder.u64)
+        meta_acting = d.list(Decoder.i32)
+        d.finish()
+        # adopt the RECORDED acting so the reconcile pass recovers any
+        # slot whose OSD has since changed (collections for the new
+        # set already exist — _make_backend created them above)
+        be.acting = list(meta_acting)
+        be.shard_applied = list(applied)
+        return be
+
+    def _on_map(self, peer: str, msg: MOSDMapMsg) -> None:
+        with self._lock:
+            if self.osdmap is not None \
+                    and msg.epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = OSDMap.decode(msg.map_bytes)
+            # an OSD the map marks UP again is no longer suspect and
+            # may be REPORTED again on its next real failure (else a
+            # revived OSD's second death would never reach the mon)
+            now = time.monotonic()
+            for osd in self.c.osd_ids():
+                if osd != self.osd_id and self.osdmap.osd_up[osd]:
+                    if osd in self._reported or osd in self.suspect:
+                        self._last_pong[osd] = now
+                    self._reported.discard(osd)
+                    self.suspect.discard(osd)
+            self._reconcile()
+
+    def _reconcile(self) -> None:
+        """Map changed: adopt/recover the PGs this daemon primaries
+        (the PeeringState Get* exchange outcome, driven from the
+        authoritative persisted metadata)."""
+        for ps in range(self.c.pg_num):
+            acting = self._acting(ps)
+            if not acting or acting[0] != self.osd_id:
+                self.backends.pop(ps, None)   # not ours (anymore)
+                continue
+            be = self.backends.get(ps)
+            if be is None:
+                be = self._restore_backend(ps, acting)
+                self.backends[ps] = be
+            if be.acting != acting:
+                # a changed slot whose old OSD is still up is a MOVE
+                # (CRUSH re-slotted a live member: copy the shard
+                # bytes); only a dead old OSD is a LOSS (decode-rebuild
+                # from helpers). Conflating them would overrun m.
+                lost, moves = [], []
+                for s, (o, n) in enumerate(zip(be.acting, acting)):
+                    if o == n:
+                        continue
+                    if self.osdmap.osd_up[o] and o not in self.suspect:
+                        moves.append((s, o, n))
+                    else:
+                        lost.append(s)
+                try:
+                    for s, o, n in moves:
+                        self._move_shard(be, s, o, n)
+                    if lost:
+                        repl = {s: acting[s] for s in lost}
+                        dead = {be.acting[s] for s in lost}
+                        exclude = {
+                            s for s, o in enumerate(be.acting)
+                            if s not in lost
+                            and (o in self.suspect
+                                 or not self.osdmap.osd_up[o])}
+                        be.recover_shards(lost, replacement_osds=repl,
+                                          helper_exclude=exclude)
+                        self.suspect -= dead
+                    self._persist_meta(ps)
+                except (ValueError, ConnectionError, KeyError) as e:
+                    self.c.log(f"{self.name}: pg 1.{ps} recovery "
+                               f"deferred: {e}")
+
+    def _move_shard(self, be, slot: int, old_osd: int,
+                    new_osd: int) -> None:
+        """Backfill-by-copy for a re-slotted LIVE member: pull the
+        shard's bytes from the old holder, push to the new one — all
+        as store-op frames (the backfill push role)."""
+        from .pgbackend import HINFO_KEY
+        cid = shard_cid(be.pg, slot)
+        src = be.cluster.osd(old_osd)
+        dst = be.cluster.osd(new_osd)
+        t = Transaction().create_collection(cid)
+        for name in be.list_pg_objects():
+            if not src.exists(cid, name):
+                continue
+            data = np.asarray(src.read(cid, name), np.uint8)
+            t.write(cid, name, 0, data).truncate(cid, name, len(data))
+            try:
+                t.setattr(cid, name, HINFO_KEY,
+                          src.getattr(cid, name, HINFO_KEY))
+            except KeyError:
+                pass
+        dst.queue_transaction(t)
+        be.acting[slot] = new_osd
+        self.c.log(f"{self.name}: pg {be.pg} slot {slot} moved "
+                   f"osd.{old_osd} -> osd.{new_osd}")
+
+    # -- client ops ----------------------------------------------------------
+
+    def _on_client_op(self, peer: str, msg: MOSDOp) -> None:
+        try:
+            with self._lock:
+                blob = self._client_op(msg.kind, msg.blob)
+            rep = MOSDOpReply(msg.req_id, True, msg.kind, blob)
+        except Exception as e:   # noqa: BLE001 — reply, don't die
+            rep = MOSDOpReply(msg.req_id, False, msg.kind,
+                              err=f"{type(e).__name__}:{e}")
+        try:
+            self.msgr.send(peer, rep)
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _client_op(self, kind: str, body: bytes) -> bytes:
+        d = Decoder(body)
+        ps = d.u32()
+        be = self.backends.get(ps)
+        if be is None:
+            raise RuntimeError(f"not primary for pg 1.{ps} "
+                               f"(epoch {self.osdmap.epoch})")
+        if kind == "write":
+            objs = d.mapping(Decoder.string, Decoder.blob)
+            try:
+                be.write_objects(objs, dead_osds=set(self.suspect))
+            except (ConnectionError, OSError):
+                # a shard holder died mid-fan-out: mark it suspect and
+                # retry once degraded; the client write must not bounce
+                self._mark_suspects(be)
+                be.write_objects(objs, dead_osds=set(self.suspect))
+            self._persist_meta(ps)
+            return b""
+        if kind == "read":
+            name = d.string()
+            data = be.read_object(name, dead_osds=set(self.suspect))
+            return np.asarray(data, np.uint8).tobytes()
+        raise ValueError(f"unknown client op {kind!r}")
+
+    def _mark_suspects(self, be) -> None:
+        for osd in set(be.acting):
+            if osd == self.osd_id or osd in self.suspect:
+                continue
+            try:
+                self.rpc.call(f"osd.{osd}",
+                              lambda rid: MStoreOp(rid, True, "exists",
+                                                   RemoteStore._co("x")),
+                              timeout=1.0)
+            except (ConnectionError, KeyError, OSError):
+                self.suspect.add(osd)
+
+    # -- liveness ------------------------------------------------------------
+
+    def _on_ping(self, peer: str, msg: MOSDPing) -> None:
+        try:
+            self.msgr.send(peer, MOSDPingReply(msg.stamp))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _on_pong(self, peer: str, msg: MOSDPingReply) -> None:
+        if peer.startswith("osd."):
+            self._last_pong[int(peer[4:])] = time.monotonic()
+
+    def _heartbeat_loop(self) -> None:
+        beat = 0
+        while not self._stop.wait(self.c.hb_interval):
+            beat += 1
+            if beat % 4 == 0 and self.osdmap is not None \
+                    and self._lock.acquire(blocking=False):
+                try:
+                    # retry deferred recoveries (a reconcile is cheap
+                    # when everything already matches the map)
+                    self._reconcile()
+                except Exception as e:  # noqa: BLE001 — the heartbeat
+                    self.c.log(f"{self.name}: reconcile retry "
+                               f"failed: {e!r}")   # thread must not die
+                finally:
+                    self._lock.release()
+            now = time.monotonic()
+            for osd in self.c.osd_ids():
+                if osd == self.osd_id:
+                    continue
+                if self.osdmap is not None \
+                        and not self.osdmap.osd_up[osd]:
+                    # the map already says down: pinging would only
+                    # grow the lossless queue without bound and flood
+                    # the peer with stale pings on revive
+                    continue
+                self._last_pong.setdefault(osd, now)
+                try:
+                    self.msgr.send(f"osd.{osd}", MOSDPing(now))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+                if now - self._last_pong[osd] > self.c.hb_grace \
+                        and osd not in self._reported:
+                    self._reported.add(osd)
+                    self.suspect.add(osd)
+                    try:
+                        self.msgr.send(self.c.mon_leader,
+                                       MOSDFailure(osd))
+                    except (KeyError, OSError, ConnectionError):
+                        pass
+
+    def kill(self) -> None:
+        """SIGKILL: stop answering everything, drop RAM state."""
+        self._stop.set()
+        self.msgr.shutdown()
+        self.store.crash()
+
+    def revive(self) -> "OSDDaemon":
+        """Fresh process, same disk: remount and boot."""
+        self.store.remount()
+        fresh = OSDDaemon.__new__(OSDDaemon)
+        fresh.__dict__.update(self.__dict__)
+        fresh.msgr = Messenger(self.name, secret=self.c.secret)
+        fresh.rpc = _Rpc(fresh.msgr, MStoreReply.type_id)
+        fresh.backends = {}
+        fresh.suspect = set()
+        fresh._last_pong = {}
+        fresh._reported = set()
+        fresh._stop = threading.Event()
+        fresh._start()
+        return fresh
+
+
+class MonDaemon:
+    """Monitor endpoint. Rank 0 leads; commits go through a one-phase
+    majority round to the peer monitors (Paxos-lite over real frames),
+    then fan out as MOSDMap broadcasts."""
+
+    def __init__(self, rank: int, cluster: "StandaloneCluster",
+                 osdmap: OSDMap | None = None):
+        self.rank = rank
+        self.c = cluster
+        self.name = f"mon.{rank}"
+        self.msgr = Messenger(self.name, secret=cluster.secret)
+        self.osdmap = osdmap
+        self._accepts: dict[int, set[str]] = {}
+        self._reporters: dict[int, set[str]] = {}
+        self._lock = threading.RLock()
+        m = self.msgr
+        m.register_handler(MOSDFailure.type_id, self._on_failure)
+        m.register_handler(MOSDBoot.type_id, self._on_boot)
+        m.register_handler(MMonPropose.type_id, self._on_propose)
+        m.register_handler(MMonAccept.type_id, self._on_accept)
+
+    # -- peer side -----------------------------------------------------------
+
+    def _on_propose(self, peer: str, msg: MMonPropose) -> None:
+        with self._lock:
+            self.osdmap = OSDMap.decode(msg.map_bytes)
+        try:
+            self.msgr.send(peer, MMonAccept(msg.epoch))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _on_accept(self, peer: str, msg: MMonAccept) -> None:
+        with self._lock:
+            got = self._accepts.setdefault(msg.epoch, set())
+            got.add(peer)
+            # broadcast exactly once, on the TRANSITION to quorum
+            if len(got) + 1 == (len(self.c.mons) // 2) + 1:
+                self._broadcast(msg.epoch)
+
+    # -- leader side ---------------------------------------------------------
+
+    def _commit(self, mutate) -> None:
+        """Apply `mutate(osdmap)`, then drive the quorum round."""
+        with self._lock:
+            mutate(self.osdmap)
+            epoch = self.osdmap.epoch
+            blob = self.osdmap.encode()
+            self._accepts.setdefault(epoch, set())
+        for mon in self.c.mons:
+            if mon is not self:
+                try:
+                    self.msgr.send(mon.name, MMonPropose(epoch, blob))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+
+    def _broadcast(self, epoch: int) -> None:
+        with self._lock:
+            if self.osdmap.epoch != epoch:
+                return
+            blob = self.osdmap.encode()
+        for peer in self.c.map_subscribers():
+            try:
+                self.msgr.send(peer, MOSDMapMsg(epoch, blob))
+            except (KeyError, OSError, ConnectionError):
+                pass
+
+    def _on_failure(self, peer: str, msg: MOSDFailure) -> None:
+        with self._lock:
+            osd = msg.failed
+            if not self.osdmap.osd_up[osd]:
+                return
+            rep = self._reporters.setdefault(osd, set())
+            rep.add(peer)
+            if len(rep) < self.c.min_reporters:
+                return
+            del self._reporters[osd]
+        self.c.log(f"{self.name}: marking osd.{osd} down+out "
+                   f"({self.c.min_reporters} reporters)")
+
+        def mutate(m: OSDMap) -> None:
+            m.mark_down(osd)
+            m.mark_out(osd)
+        self._commit(mutate)
+
+    def _on_boot(self, peer: str, msg: MOSDBoot) -> None:
+        osd = msg.failed
+        self.c.log(f"{self.name}: osd.{osd} boots")
+
+        def mutate(m: OSDMap) -> None:
+            if not m.osd_up[osd]:
+                m.mark_up(osd)
+            m.mark_in(osd)
+        self._commit(mutate)
+
+    def kill(self) -> None:
+        self.msgr.shutdown()
+
+
+class Client:
+    """librados over the wire: locate the PG from the cached map, talk
+    to its primary, retry on map change / primary death."""
+
+    def __init__(self, cluster: "StandaloneCluster", name: str = "client"):
+        self.c = cluster
+        self.msgr = Messenger(name, secret=cluster.secret)
+        self.rpc = _Rpc(self.msgr, MOSDOpReply.type_id)
+        self.osdmap: OSDMap | None = None
+        self._lock = threading.Lock()
+        self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
+
+    def _on_map(self, peer: str, msg: MOSDMapMsg) -> None:
+        with self._lock:
+            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+                self.osdmap = OSDMap.decode(msg.map_bytes)
+
+    def _primary(self, ps: int) -> str:
+        acting = self.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        if not acting:
+            raise ConnectionError(f"pg 1.{ps} has no acting set")
+        return f"osd.{acting[0]}"
+
+    def _op(self, kind: str, ps: int, body_fn, timeout=None,
+            retries=30, retry_sleep=0.3) -> bytes:
+        if timeout is None:
+            timeout = self.c.op_timeout + 8.0   # server-side retry room
+        last = None
+        for _ in range(retries):
+            e = Encoder()
+            e.u32(ps)
+            body_fn(e)
+            try:
+                rep = self.rpc.call(
+                    self._primary(ps),
+                    lambda rid: MOSDOp(rid, True, kind, e.bytes()),
+                    timeout=timeout)
+                if rep.ok:
+                    return rep.blob
+                last = rep.err
+            except (ConnectionError, KeyError, OSError) as err:
+                last = str(err)
+            time.sleep(retry_sleep)   # map may be in flight; retarget
+        raise ConnectionError(f"op {kind} pg 1.{ps} failed: {last}")
+
+    def write(self, objects: dict[str, bytes]) -> None:
+        by_pg: dict[int, dict[str, bytes]] = {}
+        for name, data in objects.items():
+            ps = self.osdmap.object_to_pg(1, name)[1]
+            by_pg.setdefault(ps, {})[name] = bytes(data)
+        for ps, group in by_pg.items():
+            self._op("write", ps,
+                     lambda e, g=group: e.mapping(
+                         g, Encoder.string, Encoder.blob))
+
+    def read(self, name: str) -> bytes:
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        return self._op("read", ps,
+                        lambda e: e.string(name))
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+
+class StandaloneCluster:
+    """Orchestrates the endpoints; the qa/standalone helpers' role."""
+
+    def __init__(self, n_osds: int = 6,
+                 profile: str = "plugin=tpu_rs k=2 m=1 impl=bitlinear",
+                 pg_num: int = 4, store: str = "mem",
+                 store_dir: str | None = None,
+                 secret: bytes | None = None,
+                 hb_interval: float = 0.25, hb_grace: float = 1.2,
+                 min_reporters: int = 2, op_timeout: float = 8.0,
+                 chunk_size: int = 256, verbose: bool | None = None):
+        import os as _os
+        if verbose is None:
+            verbose = bool(_os.environ.get("STANDALONE_VERBOSE"))
+        from ..crush.map import Tunables, build_hierarchy, ec_rule, \
+            replicated_rule
+        from ..ec.interface import profile_from_string
+        from ..ec.registry import factory
+        self.secret = secret
+        self.hb_interval, self.hb_grace = hb_interval, hb_grace
+        self.min_reporters = min_reporters
+        self.op_timeout = op_timeout
+        self.chunk_size = chunk_size
+        self.verbose = verbose
+        self.profile = profile
+        toks = profile.split()
+        self.is_erasure = toks[0] != "replicated"
+        crush = build_hierarchy(n_osds, osds_per_host=1,
+                                hosts_per_rack=max(4, n_osds))
+        crush.tunables = Tunables(choose_total_tries=51)
+        if self.is_erasure:
+            coder = factory(profile)
+            self.pool_size = coder.get_chunk_count()
+            self.pool_min_size = coder.get_data_chunk_count()
+            ec_rule(crush, 1, choose_type=1)
+        else:
+            prof = profile_from_string(" ".join(toks[1:]))
+            self.pool_size = int(prof.get("size", 3))
+            self.pool_min_size = int(prof.get(
+                "min_size", self.pool_size - self.pool_size // 2))
+            replicated_rule(crush, 1, choose_type=1, firstn=True)
+        osdmap = OSDMap(crush)
+        osdmap.add_pool(PGPool(1, pg_num=pg_num, size=self.pool_size,
+                               min_size=self.pool_min_size,
+                               crush_rule=1,
+                               is_erasure=self.is_erasure))
+        self.pg_num = pg_num
+        self.n_osds = n_osds
+        self.store_kind = store
+        self.store_dir = store_dir
+        if store == "tin" and store_dir is None:
+            import tempfile
+            self.store_dir = tempfile.mkdtemp(prefix="standalone-tin-")
+        self.mons = [MonDaemon(r, self) for r in range(3)]
+        self.mons[0].osdmap = osdmap
+        for m in self.mons[1:]:
+            m.osdmap = OSDMap.decode(osdmap.encode())
+        self.osds = {o: OSDDaemon(o, self) for o in range(n_osds)}
+        self.clients: list[Client] = []
+        self._wire_peers()
+        # initial map fan-out (the boot subscription)
+        self.mons[0]._broadcast(osdmap.epoch)
+        self._wait(lambda: all(d.osdmap is not None
+                               for d in self.osds.values()), 10,
+                   "initial map fan-out")
+
+    # -- topology ------------------------------------------------------------
+
+    def log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"standalone: {msg}", flush=True)
+
+    def osd_ids(self) -> list[int]:
+        return list(self.osds)
+
+    @property
+    def mon_leader(self) -> str:
+        return "mon.0"
+
+    def map_subscribers(self) -> list[str]:
+        subs = [d.name for d in self.osds.values()
+                if not d._stop.is_set()]
+        subs += [c.msgr.name for c in self.clients]
+        return subs
+
+    def make_store(self, osd_id: int):
+        if self.store_kind == "tin":
+            import os
+            from .tinstore import TinStore
+            return TinStore(os.path.join(self.store_dir,
+                                         f"osd.{osd_id}"),
+                            verify_reads=False)
+        return MemStore()
+
+    def _wire_peers(self) -> None:
+        every = ([(d.name, d.msgr) for d in self.osds.values()]
+                 + [(m.name, m.msgr) for m in self.mons]
+                 + [(c.msgr.name, c.msgr) for c in self.clients])
+        for name_a, msgr_a in every:
+            for name_b, msgr_b in every:
+                if name_a != name_b:
+                    msgr_a.add_peer(name_b, msgr_b.addr)
+
+    def client(self) -> Client:
+        cl = Client(self, f"client.{len(self.clients)}")
+        self.clients.append(cl)
+        self._wire_peers()
+        # subscribe: any mon will answer with the current map
+        self.mons[0]._broadcast(self.mons[0].osdmap.epoch)
+        self._wait(lambda: cl.osdmap is not None, 10, "client map")
+        return cl
+
+    # -- fault injection ------------------------------------------------------
+
+    def kill_osd(self, osd: int) -> None:
+        self.log(f"SIGKILL osd.{osd}")
+        self.osds[osd].kill()
+
+    def revive_osd(self, osd: int) -> None:
+        self.log(f"revive osd.{osd}")
+        fresh = self.osds[osd].revive()
+        self.osds[osd] = fresh
+        self._wire_peers()   # registers fresh's new address everywhere
+        fresh.msgr.send(self.mon_leader, MOSDBoot(osd))
+
+    # -- barriers -------------------------------------------------------------
+
+    def _wait(self, pred, timeout: float, what: str) -> None:
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            if pred():
+                return
+            time.sleep(0.05)
+        import os as _os
+        if _os.environ.get("STANDALONE_DEBUG"):
+            import faulthandler
+            import sys as _sys
+            print(f"=== STANDALONE_DEBUG: '{what}' timed out; "
+                  f"all thread stacks:", file=_sys.stderr, flush=True)
+            faulthandler.dump_traceback(file=_sys.stderr)
+        raise TimeoutError(f"standalone: {what} not reached "
+                           f"in {timeout}s")
+
+    def wait_for_down(self, osd: int, timeout: float = 15.0) -> None:
+        """Emergent failure detection: pings miss -> reports -> quorum
+        commit -> everyone's map shows the OSD down."""
+        self._wait(
+            lambda: all(d.osdmap is not None
+                        and not d.osdmap.osd_up[osd]
+                        for d in self.osds.values()
+                        if not d._stop.is_set()),
+            timeout, f"osd.{osd} marked down everywhere")
+
+    def wait_for_clean(self, timeout: float = 30.0) -> None:
+        """Every PG's primary hosts a backend whose acting set matches
+        the map and whose shards are all caught up."""
+        def clean() -> bool:
+            for ps in range(self.pg_num):
+                owner = None
+                for d in self.osds.values():
+                    if d._stop.is_set() or d.osdmap is None:
+                        continue
+                    acting = d.osdmap.pg_to_up_acting_osds(1, ps)[2]
+                    if acting and acting[0] == d.osd_id:
+                        owner = d
+                        break
+                if owner is None:
+                    return False
+                be = owner.backends.get(ps)
+                if be is None or be.acting != acting:
+                    return False
+            return True
+        self._wait(clean, timeout, "all PGs clean")
+
+    def shutdown(self) -> None:
+        for cl in self.clients:
+            cl.shutdown()
+        for d in self.osds.values():
+            if not d._stop.is_set():
+                d.kill()
+        for m in self.mons:
+            m.kill()

@@ -332,3 +332,33 @@ class TestSecureMode:
         finally:
             a.shutdown()
             b.shutdown()
+
+
+class TestIncarnation:
+    """ProtocolV2 cookie/RESET_SESSION analog: a rebooted process
+    reuses its NAME but restarts its sequence space — peers must reset
+    their receive cursor, not drop the new incarnation's frames as
+    replayed duplicates."""
+
+    def test_rebooted_peer_delivers_despite_stale_in_seq(self):
+        a, b = pair()
+        try:
+            got = []
+            a.register_handler(OpReply.type_id,
+                               lambda p, m: got.append(m.result))
+            for i in range(5):     # a's in_seq for osd.1 climbs to 5
+                b.send("osd.0", OpReply(i))
+            assert wait_for(lambda: len(got) == 5)
+            b.shutdown()           # SIGKILL the process behind osd.1
+            b2 = Messenger("osd.1")    # fresh incarnation, seqs from 1
+            b2.add_peer("osd.0", a.addr)
+            a.add_peer("osd.1", b2.addr)
+            try:
+                b2.send("osd.0", OpReply(99))
+                assert b2.flush("osd.0", timeout=10)
+                assert wait_for(lambda: got[-1:] == [99]), got
+            finally:
+                b2.shutdown()
+        finally:
+            a.shutdown()
+            b.shutdown()

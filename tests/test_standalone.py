@@ -1,0 +1,130 @@
+"""Standalone (wire-transport) cluster tests — the qa/standalone tier
+(ref: qa/standalone/ceph-helpers.sh run_osd/run_mon/wait_for_clean).
+Real messenger endpoints on localhost, real threads, real time: client
+I/O, shard fan-out, heartbeats, failure reports, quorum map commits and
+broadcasts are ALL typed frames. Nothing reaches around the wire: a
+primary can only touch a peer's bytes through MStoreOp frames, so a
+passing read IS proof the data plane crossed sockets."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.standalone import StandaloneCluster
+
+
+def corpus(seed, n=24, lo=100, hi=800):
+    rng = np.random.default_rng(seed)
+    return {f"sa-{seed}-{i}":
+            rng.integers(0, 256, int(rng.integers(lo, hi)),
+                         np.uint8).tobytes() for i in range(n)}
+
+
+@pytest.fixture
+def cluster(request):
+    kw = getattr(request, "param", {})
+    c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0, **kw)
+    try:
+        c.wait_for_clean(timeout=20)
+        yield c
+    finally:
+        c.shutdown()
+
+
+class TestStandaloneIO:
+    def test_write_read_bytes_exact_over_wire(self, cluster):
+        cl = cluster.client()
+        objs = corpus(1)
+        cl.write(objs)
+        for name, want in objs.items():
+            assert cl.read(name) == want
+        # the proof the fan-out crossed sockets: every non-primary
+        # acting member's LOCAL store holds its shard of some object
+        probe = next(iter(objs))
+        ps = cl.osdmap.object_to_pg(1, probe)[1]
+        acting = cl.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        from ceph_tpu.osd.ecbackend import shard_cid
+        for slot, osd in enumerate(acting[1:], start=1):
+            st = cluster.osds[osd].store
+            assert probe in st.list_objects(shard_cid(f"1.{ps}", slot))
+
+    def test_kill_nonprimary_mid_io_heals_bytes_exact(self, cluster):
+        cl = cluster.client()
+        first = corpus(2)
+        cl.write(first)
+        # pick a victim that is NOT a primary of any PG (pure shard
+        # holder) so this test isolates the replica-loss path
+        primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                     for ps in range(cluster.pg_num)}
+        victim = next(o for o in cluster.osd_ids()
+                      if o not in primaries)
+        cluster.kill_osd(victim)
+        # I/O DURING the failure window: must ride out suspicion and
+        # degraded writes without bouncing to the client
+        second = corpus(3)
+        cl.write(second)
+        cluster.wait_for_down(victim)        # emergent: pings -> report
+        cluster.wait_for_clean(timeout=40)   # -> quorum -> recovery
+        for name, want in {**first, **second}.items():
+            assert cl.read(name) == want
+
+    def test_kill_primary_failover_restores_from_meta(self, cluster):
+        cl = cluster.client()
+        objs = corpus(4)
+        cl.write(objs)
+        victim = cl.osdmap.pg_to_up_acting_osds(1, 0)[2][0]
+        cluster.kill_osd(victim)
+        cluster.wait_for_down(victim)
+        cluster.wait_for_clean(timeout=40)
+        # the new primary restored {sizes, versions, log} from the
+        # metadata that rode with the data, then recovered the slot
+        for name, want in objs.items():
+            assert cl.read(name) == want
+        # and the cluster still takes writes afterwards
+        more = corpus(5, n=8)
+        cl.write(more)
+        for name, want in more.items():
+            assert cl.read(name) == want
+
+
+@pytest.mark.parametrize(
+    "cluster", [{"secret": b"sixteen byte key" * 2}], indirect=True)
+class TestStandaloneSecure:
+    def test_whole_cluster_over_aes_gcm(self, cluster):
+        # every endpoint was built with the shared secret: all of the
+        # above traffic is AES-GCM sealed (mode negotiation is strict,
+        # so ONE crc endpoint would deadlock the boot map fan-out —
+        # reaching clean at all proves every session negotiated secure)
+        assert all(d.msgr.secret for d in cluster.osds.values())
+        cl = cluster.client()
+        objs = corpus(6, n=12)
+        cl.write(objs)
+        victim = cl.osdmap.pg_to_up_acting_osds(1, 1)[2][0]
+        cluster.kill_osd(victim)
+        cluster.wait_for_down(victim)
+        cluster.wait_for_clean(timeout=40)
+        for name, want in objs.items():
+            assert cl.read(name) == want
+
+
+@pytest.mark.parametrize("cluster", [{"store": "tin"}], indirect=True)
+class TestStandalonePersistent:
+    def test_revive_remounts_and_rejoins(self, cluster):
+        cl = cluster.client()
+        objs = corpus(7, n=16)
+        cl.write(objs)
+        victim = cl.osdmap.pg_to_up_acting_osds(1, 2)[2][0]
+        cluster.kill_osd(victim)             # REALLY drops RAM (tin)
+        cluster.wait_for_down(victim)
+        cluster.wait_for_clean(timeout=40)
+        for name, want in objs.items():
+            assert cl.read(name) == want
+        cluster.revive_osd(victim)           # WAL remount + boot frame
+        # revived osd is marked up+in again by the monitor quorum
+        cluster._wait(
+            lambda: all(d.osdmap.osd_up[victim]
+                        for d in cluster.osds.values()
+                        if not d._stop.is_set()),
+            15, f"osd.{victim} back up in every map")
+        cluster.wait_for_clean(timeout=40)
+        for name, want in objs.items():
+            assert cl.read(name) == want
