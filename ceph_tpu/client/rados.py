@@ -58,8 +58,14 @@ class IoCtx:
         self._ob.write_at(name, offset, data)
 
     def read(self, name: str, length: int | None = None,
-             offset: int = 0) -> bytes:
-        arr = self._ob.read(name)
+             offset: int = 0, snap: int | None = None) -> bytes:
+        """`snap` reads the object's state as of that pool snapshot
+        (the rados_ioctx_snap_set_read role, per-call instead of
+        sticky context)."""
+        if snap is None:
+            arr = self._ob.read(name)
+        else:
+            arr = self.rados.cluster.snap_read(name, snap)
         if length is None:
             return arr[offset:].tobytes()
         return arr[offset:offset + length].tobytes()
@@ -77,6 +83,37 @@ class IoCtx:
         c = self.rados.cluster
         return sorted(n for ps in range(c.pg_num)
                       for n in c.pgs[ps].list_pg_objects())
+
+    # -- pool snapshots (rados_ioctx_snap_*) --------------------------------
+
+    def snap_create(self) -> int:
+        return self.rados.cluster.snap_create()
+
+    def snap_remove(self, snap_id: int) -> int:
+        return self.rados.cluster.snap_remove(snap_id)
+
+    def snap_rollback(self, name: str, snap_id: int) -> None:
+        self.rados.cluster.snap_rollback(name, snap_id)
+
+    def snap_list(self) -> list[int]:
+        return sorted(self.rados.cluster.snaps)
+
+    # -- watch / notify (rados_watch3/rados_notify2) ------------------------
+
+    def watch(self, name: str, callback) -> int:
+        return self.rados.cluster.watch(name, callback)
+
+    def unwatch(self, name: str, cookie: int) -> None:
+        self.rados.cluster.unwatch(name, cookie)
+
+    def notify(self, name: str, payload: bytes = b"") -> dict:
+        return self.rados.cluster.notify(name, payload)
+
+    # -- object classes (rados_exec) ----------------------------------------
+
+    def execute(self, name: str, cls: str, method: str,
+                inp: bytes = b"") -> bytes:
+        return self.rados.cluster.cls_exec(name, cls, method, inp)
 
 
 class RadosStriper:

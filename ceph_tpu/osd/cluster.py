@@ -165,6 +165,21 @@ class SimCluster:
         # keeps the OLD acting set serving I/O (ref: PeeringState
         # requests pg_temp until backfill completes)
         self.backfills: dict[int, dict] = {}
+        # pool snapshots (ref: pg_pool_t snap_seq/snaps; PrimaryLogPG
+        # make_writeable copy-on-write clones + SnapSet; snaptrim):
+        # clones are REGULAR objects (placed/recovered/scrubbed like
+        # any other; divergence from the reference disclosed: they
+        # hash to their own PG rather than the head's), metadata here
+        self.snap_seq = 0
+        self.snaps: dict[int, float] = {}          # id -> ctime
+        self.snapsets: dict[str, list[int]] = {}   # head -> clone seqs
+        self.object_births: dict[str, int] = {}    # head -> seq at create
+        # watch/notify registry (ref: PrimaryLogPG watch/notify;
+        # Objecter::linger): cookie -> callback per object
+        self.watches: dict[str, dict[int, object]] = {}
+        self._next_cookie = 1
+        # object-class KV plane (ref: cls_* methods' omap usage)
+        self.obj_kv: dict[str, dict] = {}
         # mClock op scheduler paces background work (ref: src/osd/
         # scheduler/mClockScheduler.cc); backfill copies ride the
         # background_recovery class, whose limit is backfill_rate
@@ -260,14 +275,31 @@ class SimCluster:
         (re-)queued for copy — the bytes went to the OLD serving set."""
         be = self.pgs[ps]
         if kind == "write":
-            be.write_objects(payload, dead_osds=dead)
-            names = payload.keys()
+            names = set(payload.keys())
         elif kind == "remove":
-            be.remove_objects(payload, dead_osds=dead)
             names = set(payload)
         else:  # write_ranges
-            be.write_ranges(payload, dead_osds=dead)
             names = {n for n, _, _ in payload}
+        # snapshot copy-on-write (PrimaryLogPG::make_writeable): any
+        # mutation of a head whose newest clone predates the newest
+        # snap first preserves the current state as a clone
+        if self.snaps:
+            self._preserve_clones(names)
+        if kind == "write":
+            be.write_objects(payload, dead_osds=dead)
+        elif kind == "remove":
+            be.remove_objects(payload, dead_osds=dead)
+            # per-object side state dies with the object (the
+            # reference's omap and watches are object-lifetime): a
+            # recreated name must not inherit a dead object's locks,
+            # watchers, or birth era. SnapSets survive — clones
+            # outlive the head by design.
+            for name in names:
+                self.obj_kv.pop(name, None)
+                self.watches.pop(name, None)
+                self.object_births.pop(name, None)
+        else:
+            be.write_ranges(payload, dead_osds=dead)
         job = self.backfills.get(ps)
         if job is not None:
             job["names"].update(names)
@@ -404,6 +436,151 @@ class SimCluster:
         if not rec["would_adjust"] or target <= self.pg_num:
             return None
         return self.split_pgs(target)
+
+    # -- pool snapshots (PrimaryLogPG snap machinery) ------------------------
+
+    _SNAP_SEP = "@@snap."
+
+    @classmethod
+    def _clone_name(cls, name: str, seq: int) -> str:
+        return f"{name}{cls._SNAP_SEP}{seq:08x}"
+
+    def _preserve_clones(self, names) -> None:
+        """COW step: for each head about to mutate, if its state hasn't
+        been preserved since the newest snap, write the current bytes
+        as a clone object and record it in the SnapSet."""
+        dead = self._dead_osds()
+        for name in sorted(names):
+            if self._SNAP_SEP in name:
+                continue            # clones never re-clone
+            ps = self.locate(name)
+            be = self.pgs[ps]
+            if name not in be.object_sizes:
+                # creation: remember the snap era it was born in, so
+                # reads at older snaps correctly say "didn't exist"
+                self.object_births[name] = self.snap_seq
+                continue
+            if self.object_births.get(name, 0) >= self.snap_seq:
+                # born AFTER the newest snap: no snap contains it, so
+                # preserving a clone would make it phantom-exist there
+                continue
+            ss = self.snapsets.setdefault(name, [])
+            if ss and ss[-1] >= self.snap_seq:
+                continue            # newest snap already has its clone
+            data = be.read_object(name, dead_osds=dead)
+            clone = self._clone_name(name, self.snap_seq)
+            cps = self.locate(clone)
+            self._apply_write(cps, "write", {clone: data}, dead)
+            ss.append(self.snap_seq)
+
+    def snap_create(self) -> int:
+        """Take a pool snapshot (ref: OSDMonitor pool mksnap ->
+        pg_pool_t::add_snap): monitor-quorum-gated seq bump; data is
+        preserved lazily by the write-path COW."""
+        if not self._mon_commit(f"pool 1 mksnap {self.snap_seq + 1}"):
+            raise ValueError("no monitor quorum; snap refused")
+        self.snap_seq += 1
+        self.snaps[self.snap_seq] = self.now
+        return self.snap_seq
+
+    def snap_read(self, name: str, sid: int) -> np.ndarray:
+        """Read an object's state as of snap `sid`: the OLDEST clone
+        with seq >= sid, else the unmodified head (ref: PrimaryLogPG
+        find_object_context snap resolution via SnapSet.clones)."""
+        if sid not in self.snaps:
+            raise KeyError(f"no snap {sid}")
+        cands = [c for c in self.snapsets.get(name, []) if c >= sid]
+        if cands:
+            return self.read(self._clone_name(name, min(cands)))
+        ps = self.locate(name)
+        if name in self.pgs[ps].object_sizes \
+                and self.object_births.get(name, 0) < sid:
+            return self.read(name)   # unchanged since before the snap
+        raise KeyError(f"{name!r} did not exist at snap {sid}")
+
+    def snap_rollback(self, name: str, sid: int) -> None:
+        """rados rollback: write the snap's state back onto the head
+        (itself COW-protected, so the pre-rollback head is preserved
+        if a newer snap needs it)."""
+        self.write({name: self.snap_read(name, sid)})
+
+    def snap_remove(self, sid: int) -> int:
+        """Delete a snap + trim clones no live snap reads anymore (the
+        snaptrim role; ref: PrimaryLogPG::trim_object). Returns the
+        number of clone objects trimmed."""
+        if sid not in self.snaps:
+            raise KeyError(f"no snap {sid}")
+        if not self._mon_commit(f"pool 1 rmsnap {sid}"):
+            raise ValueError("no monitor quorum; snap removal refused")
+        del self.snaps[sid]
+        return self._snap_trim()
+
+    def _snap_trim(self) -> int:
+        """Drop clones no live snap reads anymore. Idempotent and
+        failure-tolerant: a clone whose removal is refused mid-chaos
+        (degraded PG) stays in the SnapSet and is retried on the next
+        trim — the snap deletion itself never half-applies."""
+        trimmed = 0
+        for name, ss in list(self.snapsets.items()):
+            keep: list[int] = []
+            prev = 0
+            for c in ss:             # ascending; clone c covers snaps
+                if any(prev < s <= c for s in self.snaps):   # (prev, c]
+                    keep.append(c)
+                    prev = c
+                    continue
+                try:
+                    self.remove(self._clone_name(name, c))
+                    trimmed += 1
+                except KeyError:
+                    trimmed += 1     # already gone: count as trimmed
+                except ValueError:
+                    keep.append(c)   # PG unwritable right now: keep
+                    prev = c         # the clone, retry on a later trim
+            if keep:
+                self.snapsets[name] = keep
+            else:
+                del self.snapsets[name]
+        return trimmed
+
+    # -- watch / notify ------------------------------------------------------
+
+    def watch(self, name: str, callback) -> int:
+        """Register interest in an object (ref: PrimaryLogPG watch;
+        callback(notifier_name, payload) -> optional reply bytes)."""
+        ps = self.locate(name)
+        if name not in self.pgs[ps].object_sizes:
+            raise KeyError(f"no object {name!r}")
+        cookie = self._next_cookie
+        self._next_cookie += 1
+        self.watches.setdefault(name, {})[cookie] = callback
+        return cookie
+
+    def unwatch(self, name: str, cookie: int) -> None:
+        self.watches.get(name, {}).pop(cookie, None)
+
+    def notify(self, name: str, payload: bytes = b"") -> dict:
+        """Invoke every watcher; returns {cookie: reply-or-None}. A
+        watcher whose callback raises is reported as None (the
+        timed-out-watcher slot in the reference's notify reply)."""
+        acks: dict[int, bytes | None] = {}
+        for cookie, cb in list(self.watches.get(name, {}).items()):
+            try:
+                acks[cookie] = cb(name, payload)
+            except Exception:        # noqa: BLE001 — a broken watcher
+                acks[cookie] = None  # must not kill the notify fan-out
+        return acks
+
+    # -- object classes ------------------------------------------------------
+
+    def cls_exec(self, name: str, cls: str, method: str,
+                 inp: bytes = b"") -> bytes:
+        """Execute a registered object-class method against an object
+        at its primary (ref: PrimaryLogPG::do_osd_ops OP_CALL ->
+        ClassHandler). Writes made by the method ride the normal
+        client path (COW, PG log, EC fan-out included)."""
+        from .objclass import cls_call
+        return cls_call(self, name, cls, method, inp)
 
     def remove(self, names: list[str] | str) -> None:
         names = [names] if isinstance(names, str) else list(names)

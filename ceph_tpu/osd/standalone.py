@@ -423,25 +423,41 @@ class OSDDaemon:
                 self.suspect.add(osd)
 
     def _load_meta(self, ps: int, acting: list[int]) -> bytes | None:
-        """Find the freshest persisted PG metadata: local shard first,
-        then any live acting member over the wire (a takeover primary
-        may be the brand-new replacement with an empty store)."""
+        """Find the FRESHEST persisted PG metadata: gather the blob
+        from the local shard AND every reachable acting member, decode
+        each, and keep the one with the highest pg_log head — a local
+        copy can be stale (e.g. this member was skipped by
+        _persist_meta while transiently suspect), and restoring stale
+        metadata would make recent writes unreadable."""
         pgid = f"1.{ps}"
+        blobs: list[bytes] = []
         for s in range(len(acting)):
             obj = self.store.collections.get(
                 shard_cid(pgid, s), {}).get("__pg_meta__")
             if obj is not None and PG_META_KEY in obj.omap:
-                return obj.omap[PG_META_KEY]
+                blobs.append(obj.omap[PG_META_KEY])
         for s, osd in enumerate(acting):
             if osd == self.osd_id or osd in self.suspect:
                 continue
             try:
-                return RemoteStore(self.rpc, f"osd.{osd}",
-                                   timeout=2.0).omap_get(
-                    shard_cid(pgid, s), "__pg_meta__", PG_META_KEY)
+                blobs.append(RemoteStore(self.rpc, f"osd.{osd}",
+                                         timeout=2.0).omap_get(
+                    shard_cid(pgid, s), "__pg_meta__", PG_META_KEY))
             except (KeyError, ConnectionError, OSError):
                 continue
-        return None
+        best, best_head = None, -1
+        for blob in blobs:
+            try:
+                d = Decoder(blob)
+                d.start(1)
+                d.mapping(Decoder.string, Decoder.u64)
+                d.mapping(Decoder.string, Decoder.u64)
+                head = PGLog.decode(d.blob()).head
+            except Exception:    # noqa: BLE001 — a corrupt candidate
+                continue         # must not block takeover
+            if head > best_head:
+                best, best_head = blob, head
+        return best
 
     def _restore_backend(self, ps: int, acting: list[int]):
         """Primary takeover: rebuild the PG from persisted metadata.

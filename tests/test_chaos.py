@@ -152,9 +152,27 @@ def test_chaos_thrash_no_data_loss(seed, store, tmp_path):
         except ValueError:
             pass   # refusal is the contract under chaos
 
+    snap_shadow: dict[int, dict[str, bytes]] = {}
+
+    def act_snap():
+        # pool snapshots mid-chaos: COW must preserve exactly the
+        # shadow state at snap time, through kills/splits/rot/repair
+        if snap_shadow and (len(snap_shadow) >= 3 or rng.random() < 0.4):
+            sid = sorted(snap_shadow)[int(rng.integers(len(snap_shadow)))]
+            try:
+                c.snap_remove(sid)
+                del snap_shadow[sid]
+            except (ValueError, KeyError):
+                pass   # no quorum: snap stays, retried later
+        else:
+            try:
+                snap_shadow[c.snap_create()] = dict(shadow)
+            except ValueError:
+                pass   # no quorum mid-chaos: clean refusal
+
     menu = [act_write, act_write, act_overwrite, act_rmw, act_remove,
             act_kill_osd, act_mon_churn, act_rot, act_balance,
-            act_repair, act_split]
+            act_repair, act_split, act_snap]
 
     for round_i in range(6):
         act_write()  # every round has fresh data on the line
@@ -190,6 +208,14 @@ def test_chaos_thrash_no_data_loss(seed, store, tmp_path):
         for name, want in sorted(shadow.items()):
             got = ob.read(name)
             assert got.tobytes() == want, f"round {round_i}: {name}"
+        # every live snapshot reads back EXACTLY the shadow state at
+        # snap time — overwrites, removes, splits, and repairs since
+        # must not leak through the COW clones
+        for sid, snap_state in sorted(snap_shadow.items()):
+            for name, want in sorted(snap_state.items()):
+                got = c.snap_read(name, sid)
+                assert bytes(got) == want, \
+                    f"round {round_i}: snap {sid} {name}"
         # reads repaired rot on the shards they consumed; rot on
         # parity shards is scrub's to find and repair's to fix —
         # after repair every healthy PG must be clean

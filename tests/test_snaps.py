@@ -1,0 +1,264 @@
+"""PrimaryLogPG object features: pool snapshots (COW clones, SnapSet
+resolution, rollback, snaptrim), watch/notify, and object classes
+(refs: src/osd/PrimaryLogPG.cc make_writeable/find_object_context/
+trim_object + watch machinery; src/cls/lock, src/cls/refcount;
+src/objclass/objclass.h)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.osd.cluster import SimCluster
+from ceph_tpu.osd.objclass import ClsError
+
+
+def mk(**kw):
+    kw.setdefault("n_osds", 8)
+    kw.setdefault("pg_num", 4)
+    c = SimCluster(**kw)
+    return c, Rados(c).open_ioctx()
+
+
+class TestSnapshots:
+    def test_snap_preserves_state_across_overwrites(self):
+        c, io = mk()
+        io.write_full("a", b"version one")
+        s1 = io.snap_create()
+        io.write_full("a", b"version two")
+        s2 = io.snap_create()
+        io.write_full("a", b"version three")
+        assert io.read("a") == b"version three"
+        assert io.read("a", snap=s1) == b"version one"
+        assert io.read("a", snap=s2) == b"version two"
+
+    def test_unmodified_object_reads_head_at_snap(self):
+        c, io = mk()
+        io.write_full("quiet", b"never changed")
+        s1 = io.snap_create()
+        # no write since the snap: the head IS the snap state (no
+        # clone was materialized — COW is lazy)
+        assert io.read("quiet", snap=s1) == b"never changed"
+        assert not c.snapsets.get("quiet")
+
+    def test_object_created_after_snap_did_not_exist(self):
+        c, io = mk()
+        io.write_full("old", b"x")
+        s1 = io.snap_create()
+        io.write_full("new", b"y")
+        with pytest.raises(KeyError, match="did not exist"):
+            io.read("new", snap=s1)
+        assert io.read("old", snap=s1) == b"x"
+
+    def test_remove_preserves_snap_state(self):
+        c, io = mk()
+        io.write_full("gone", b"last words")
+        s1 = io.snap_create()
+        io.remove("gone")
+        with pytest.raises(KeyError):
+            io.read("gone")
+        assert io.read("gone", snap=s1) == b"last words"
+
+    def test_rollback(self):
+        c, io = mk()
+        io.write_full("r", b"good state")
+        s1 = io.snap_create()
+        io.write_full("r", b"bad state")
+        io.snap_rollback("r", s1)
+        assert io.read("r") == b"good state"
+
+    def test_snaptrim_deletes_unreferenced_clones(self):
+        c, io = mk()
+        io.write_full("t", b"one")
+        s1 = io.snap_create()
+        io.write_full("t", b"two")
+        s2 = io.snap_create()
+        io.write_full("t", b"three")
+        assert len(c.snapsets["t"]) == 2       # clones for s1 and s2
+        trimmed = io.snap_remove(s1)
+        assert trimmed == 1                    # s1's clone unreferenced
+        assert io.read("t", snap=s2) == b"two"
+        trimmed = io.snap_remove(s2)
+        assert trimmed == 1
+        assert "t" not in c.snapsets           # snapset fully trimmed
+        assert io.read("t") == b"three"
+        # no clone objects left behind anywhere
+        assert not [n for n in io.list_objects() if "@@snap." in n]
+
+    def test_middle_snap_removal_keeps_coverage(self):
+        c, io = mk()
+        io.write_full("m", b"v1")
+        s1 = io.snap_create()
+        s2 = io.snap_create()          # two snaps, same state
+        io.write_full("m", b"v2")      # one clone covers both
+        assert len(c.snapsets["m"]) == 1
+        io.snap_remove(s1)             # clone still covers s2
+        assert io.read("m", snap=s2) == b"v1"
+        io.snap_remove(s2)
+        assert "m" not in c.snapsets
+
+    def test_snaps_survive_pg_split_and_recovery(self):
+        c, io = mk(down_out_interval=30.0)
+        rng = np.random.default_rng(3)
+        data1 = rng.integers(0, 256, 600, np.uint8).tobytes()
+        data2 = rng.integers(0, 256, 600, np.uint8).tobytes()
+        for i in range(12):
+            io.write_full(f"s{i}", data1)
+        s1 = io.snap_create()
+        for i in range(12):
+            io.write_full(f"s{i}", data2)
+        c.split_pgs(8)                 # clones re-home like any object
+        victim = c.pgs[0].acting[0]
+        c.kill_osd(victim)
+        c.tick(40.0)
+        for _ in range(120):
+            if not c.backfills:
+                break
+            c.tick(6.0)
+        for i in range(12):
+            assert io.read(f"s{i}") == data2
+            assert io.read(f"s{i}", snap=s1) == data1
+
+    def test_snap_needs_quorum(self):
+        c, io = mk()
+        io.write_full("q", b"x")
+        c.kill_mon(0)
+        c.kill_mon(1)
+        with pytest.raises(ValueError, match="quorum"):
+            io.snap_create()
+        c.revive_mon(0)
+        assert io.snap_create() == 1
+
+
+class TestWatchNotify:
+    def test_notify_reaches_all_watchers(self):
+        c, io = mk()
+        io.write_full("w", b"data")
+        got_a, got_b = [], []
+        ca = io.watch("w", lambda n, p: (got_a.append((n, p)), b"ackA")[1])
+        cb = io.watch("w", lambda n, p: (got_b.append((n, p)), b"ackB")[1])
+        acks = io.notify("w", b"hello")
+        assert got_a == [("w", b"hello")] and got_b == [("w", b"hello")]
+        assert acks == {ca: b"ackA", cb: b"ackB"}
+
+    def test_unwatch_and_broken_watcher(self):
+        c, io = mk()
+        io.write_full("w", b"data")
+        got = []
+        c1 = io.watch("w", lambda n, p: got.append(p))
+        c2 = io.watch("w", lambda n, p: 1 / 0)
+        io.unwatch("w", c1)
+        acks = io.notify("w", b"x")
+        assert got == []                # unwatched: not invoked
+        assert acks == {c2: None}       # broken watcher reported None
+
+    def test_watch_missing_object_raises(self):
+        c, io = mk()
+        with pytest.raises(KeyError):
+            io.watch("nope", lambda n, p: None)
+
+
+class TestObjectClasses:
+    def test_lock_exclusive_and_break(self):
+        c, io = mk()
+        io.write_full("locked", b"x")
+        io.execute("locked", "lock", "lock",
+                   b'{"owner": "client.a", "type": "exclusive"}')
+        with pytest.raises(ClsError, match="EBUSY"):
+            io.execute("locked", "lock", "lock",
+                       b'{"owner": "client.b", "type": "exclusive"}')
+        import json
+        info = json.loads(io.execute("locked", "lock", "get_info"))
+        assert info == {"type": "exclusive", "holders": ["client.a"]}
+        io.execute("locked", "lock", "break_lock",
+                   b'{"owner": "client.a"}')
+        io.execute("locked", "lock", "lock",
+                   b'{"owner": "client.b", "type": "exclusive"}')
+
+    def test_shared_locks(self):
+        c, io = mk()
+        io.write_full("shared", b"x")
+        for who in ("a", "b", "c"):
+            io.execute("shared", "lock", "lock",
+                       f'{{"owner": "{who}", "type": "shared"}}'.encode())
+        with pytest.raises(ClsError):
+            io.execute("shared", "lock", "lock",
+                       b'{"owner": "d", "type": "exclusive"}')
+        for who in ("a", "b", "c"):
+            io.execute("shared", "lock", "unlock",
+                       f'{{"owner": "{who}"}}'.encode())
+        io.execute("shared", "lock", "lock",
+                   b'{"owner": "d", "type": "exclusive"}')
+
+    def test_refcount_lifecycle(self):
+        import json
+        c, io = mk()
+        io.write_full("ref", b"payload")
+        io.execute("ref", "refcount", "get")
+        io.execute("ref", "refcount", "get")
+        assert json.loads(io.execute("ref", "refcount", "read")) == \
+            {"refs": 2}
+        io.execute("ref", "refcount", "put")
+        assert io.read("ref") == b"payload"    # still one ref
+        io.execute("ref", "refcount", "put")   # last ref: object gone
+        with pytest.raises(KeyError):
+            io.read("ref")
+
+    def test_version_bump(self):
+        import json
+        c, io = mk()
+        io.write_full("v", b"x")
+        for want in (1, 2, 3):
+            got = json.loads(io.execute("v", "version", "bump"))
+            assert got == {"ver": want}
+
+    def test_unknown_class_raises(self):
+        c, io = mk()
+        io.write_full("o", b"x")
+        with pytest.raises(KeyError):
+            io.execute("o", "nope", "nope")
+
+    def test_cls_write_is_cow_protected(self):
+        """A cls method's write goes through the snapshot COW path like
+        any client write."""
+        c, io = mk()
+        io.write_full("doc", b"snapshotted")
+        s1 = io.snap_create()
+
+        from ceph_tpu.osd.objclass import _CLS
+        def rewrite(h, inp):
+            h.write_full(b"rewritten by cls")
+            return b""
+        _CLS[("testcls", "rewrite")] = rewrite
+        try:
+            io.execute("doc", "testcls", "rewrite")
+        finally:
+            del _CLS[("testcls", "rewrite")]
+        assert io.read("doc") == b"rewritten by cls"
+        assert io.read("doc", snap=s1) == b"snapshotted"
+
+
+class TestSnapEdgeCases:
+    """Regressions from review: phantom existence, ghost side-state."""
+
+    def test_object_born_after_snap_never_phantom_exists(self):
+        c, io = mk()
+        s1 = io.snap_create()
+        io.write_full("late", b"v1")
+        io.write_full("late", b"v2")   # overwrite must NOT clone at s1
+        with pytest.raises(KeyError, match="did not exist"):
+            io.read("late", snap=s1)
+        assert io.read("late") == b"v2"
+
+    def test_recreated_object_inherits_no_ghost_state(self):
+        c, io = mk()
+        io.write_full("ghost", b"x")
+        io.execute("ghost", "lock", "lock", b'{"owner": "a"}')
+        fired = []
+        io.watch("ghost", lambda n, p: fired.append(p))
+        io.remove("ghost")
+        io.write_full("ghost", b"fresh")
+        # the dead object's lock is gone: a new owner locks cleanly
+        io.execute("ghost", "lock", "lock", b'{"owner": "b"}')
+        # and its watchers died with it
+        assert io.notify("ghost", b"ping") == {}
+        assert fired == []
