@@ -172,7 +172,11 @@ class SimCluster:
         # hash to their own PG rather than the head's), metadata here
         self.snap_seq = 0
         self.snaps: dict[int, float] = {}          # id -> ctime
-        self.snapsets: dict[str, list[int]] = {}   # head -> clone seqs
+        # head -> [(clone seq, birth era)]: a clone covers snaps s
+        # with birth < s <= seq (the birth rides with the clone so an
+        # object born BETWEEN snaps never phantom-exists at the older
+        # one, even after the head is removed or recreated)
+        self.snapsets: dict[str, list[tuple[int, int]]] = {}
         self.object_births: dict[str, int] = {}    # head -> seq at create
         # watch/notify registry (ref: PrimaryLogPG watch/notify;
         # Objecter::linger): cookie -> callback per object
@@ -465,13 +469,14 @@ class SimCluster:
                 # preserving a clone would make it phantom-exist there
                 continue
             ss = self.snapsets.setdefault(name, [])
-            if ss and ss[-1] >= self.snap_seq:
+            if ss and ss[-1][0] >= self.snap_seq:
                 continue            # newest snap already has its clone
             data = be.read_object(name, dead_osds=dead)
             clone = self._clone_name(name, self.snap_seq)
             cps = self.locate(clone)
             self._apply_write(cps, "write", {clone: data}, dead)
-            ss.append(self.snap_seq)
+            ss.append((self.snap_seq,
+                       self.object_births.get(name, 0)))
 
     def snap_create(self) -> int:
         """Take a pool snapshot (ref: OSDMonitor pool mksnap ->
@@ -489,7 +494,8 @@ class SimCluster:
         find_object_context snap resolution via SnapSet.clones)."""
         if sid not in self.snaps:
             raise KeyError(f"no snap {sid}")
-        cands = [c for c in self.snapsets.get(name, []) if c >= sid]
+        cands = [seq for seq, birth in self.snapsets.get(name, [])
+                 if seq >= sid and birth < sid]   # alive AT the snap
         if cands:
             return self.read(self._clone_name(name, min(cands)))
         ps = self.locate(name)
@@ -522,11 +528,13 @@ class SimCluster:
         trim — the snap deletion itself never half-applies."""
         trimmed = 0
         for name, ss in list(self.snapsets.items()):
-            keep: list[int] = []
+            keep: list[tuple[int, int]] = []
             prev = 0
-            for c in ss:             # ascending; clone c covers snaps
-                if any(prev < s <= c for s in self.snaps):   # (prev, c]
-                    keep.append(c)
+            for c, birth in ss:      # ascending; clone c covers snaps
+                # (prev_kept, c], minus snaps older than its birth era
+                if any(prev < s <= c and s > birth
+                       for s in self.snaps):
+                    keep.append((c, birth))
                     prev = c
                     continue
                 try:
@@ -535,8 +543,8 @@ class SimCluster:
                 except KeyError:
                     trimmed += 1     # already gone: count as trimmed
                 except ValueError:
-                    keep.append(c)   # PG unwritable right now: keep
-                    prev = c         # the clone, retry on a later trim
+                    keep.append((c, birth))   # PG unwritable: keep the
+                    prev = c                  # clone, retry later
             if keep:
                 self.snapsets[name] = keep
             else:

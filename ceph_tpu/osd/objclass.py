@@ -105,10 +105,15 @@ def _lock_lock(h: ClsHandle, inp: bytes) -> bytes:
         raise ClsError(f"bad lock type {ltype!r}")
     st = _lock_state(h)
     if st["holders"]:
-        if st["type"] == "exclusive" or ltype == "exclusive":
-            if owner not in st["holders"]:
-                raise ClsError("EBUSY: lock held")
+        if owner in st["holders"]:
+            if ltype != st["type"]:
+                # upgrades/downgrades are not silent no-ops — the
+                # caller would believe it holds the new type (the
+                # reference cls_lock returns -EBUSY here too)
+                raise ClsError("EBUSY: lock upgrade not supported")
             return b"{}"             # re-entrant for the same owner
+        if st["type"] == "exclusive" or ltype == "exclusive":
+            raise ClsError("EBUSY: lock held")
     st["type"] = ltype
     st["holders"][owner] = {"since": "held"}
     return b"{}"

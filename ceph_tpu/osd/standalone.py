@@ -194,6 +194,11 @@ class MOSDMapMsg(MMonPropose):
     type_id = 0x3A          # same shape: epoch + encoded map
 
 
+@register_message
+class MMonSyncReq(MMonAccept):
+    type_id = 0x3B          # payload: requester's current epoch
+
+
 # -- request/reply plumbing --------------------------------------------------
 
 class _Rpc:
@@ -669,11 +674,15 @@ class OSDDaemon:
                         and osd not in self._reported:
                     self._reported.add(osd)
                     self.suspect.add(osd)
-                    try:
-                        self.msgr.send(self.c.mon_leader,
-                                       MOSDFailure(osd))
-                    except (KeyError, OSError, ConnectionError):
-                        pass
+                    # broadcast to EVERY monitor: whoever currently
+                    # leads acts, so leader failover needs no OSD-side
+                    # coordination (the reference forwards via the
+                    # session mon the same way)
+                    for mon_name in self.c.mon_names():
+                        try:
+                            self.msgr.send(mon_name, MOSDFailure(osd))
+                        except (KeyError, OSError, ConnectionError):
+                            pass
 
     def kill(self) -> None:
         """SIGKILL: stop answering everything, drop RAM state."""
@@ -698,9 +707,15 @@ class OSDDaemon:
 
 
 class MonDaemon:
-    """Monitor endpoint. Rank 0 leads; commits go through a one-phase
-    majority round to the peer monitors (Paxos-lite over real frames),
-    then fan out as MOSDMap broadcasts."""
+    """Monitor endpoint. The lowest rank BELIEVED ALIVE leads (rank
+    election over real ping frames — ref: src/mon/Elector.cc's
+    lowest-rank-wins outcome, with liveness standing in for the
+    propose/ack rounds); commits go through a one-phase majority
+    round to the peer monitors (Paxos-lite over real frames), then
+    fan out as MOSDMap broadcasts. A dead leader is detected by the
+    next rank within the heartbeat grace and leadership moves — OSD
+    reports are broadcast to every monitor and handled by whoever
+    currently leads, so failover needs no client coordination."""
 
     def __init__(self, rank: int, cluster: "StandaloneCluster",
                  osdmap: OSDMap | None = None):
@@ -710,21 +725,114 @@ class MonDaemon:
         self.msgr = Messenger(self.name, secret=cluster.secret)
         self.osdmap = osdmap
         self._accepts: dict[int, set[str]] = {}
+        self._pending: dict[int, bytes] = {}   # proposed, not committed
         self._reporters: dict[int, set[str]] = {}
         self._lock = threading.RLock()
+        self._peer_pong: dict[int, float] = {}
+        # peers start PRESUMED ALIVE for one grace window: a freshly
+        # (re)started monitor must not claim leadership over a living
+        # lower rank it simply hasn't heard from yet (dual-leader
+        # window). Death is proven by grace expiry, not assumed.
+        self._boot = time.monotonic()
+        self._stop = threading.Event()
         m = self.msgr
         m.register_handler(MOSDFailure.type_id, self._on_failure)
         m.register_handler(MOSDBoot.type_id, self._on_boot)
         m.register_handler(MMonPropose.type_id, self._on_propose)
         m.register_handler(MMonAccept.type_id, self._on_accept)
+        m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
+        m.register_handler(MOSDPing.type_id, self._on_ping)
+        m.register_handler(MOSDPingReply.type_id, self._on_pong)
+        self._hb = threading.Thread(target=self._mon_hb_loop,
+                                    daemon=True)
+        self._hb.start()
+
+    # -- election (rank + liveness) ------------------------------------------
+
+    def _alive_ranks(self) -> set[int]:
+        now = time.monotonic()
+        alive = {self.rank}
+        for mon in self.c.mons:
+            r = mon.rank
+            if r == self.rank:
+                continue
+            last = self._peer_pong.get(r, self._boot)
+            if now - last <= self.c.hb_grace:
+                alive.add(r)
+        return alive
+
+    def is_leader(self) -> bool:
+        return self.rank == min(self._alive_ranks())
+
+    def _on_ping(self, peer: str, msg: MOSDPing) -> None:
+        try:
+            self.msgr.send(peer, MOSDPingReply(msg.stamp))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _on_pong(self, peer: str, msg: MOSDPingReply) -> None:
+        if peer.startswith("mon."):
+            self._peer_pong[int(peer[4:])] = time.monotonic()
+
+    def _mon_hb_loop(self) -> None:
+        while not self._stop.wait(self.c.hb_interval):
+            for mon in self.c.mons:
+                if mon.rank == self.rank or mon._stop.is_set():
+                    continue
+                try:
+                    self.msgr.send(mon.name,
+                                   MOSDPing(time.monotonic()))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+            # re-propose uncommitted proposals: a mutation proposed
+            # while the quorum was short must COMMIT once peers return
+            # (the reporters already consumed their one report), and a
+            # superseded proposal is pruned
+            with self._lock:
+                if self.osdmap is not None:
+                    for e in [e for e in self._pending
+                              if e <= self.osdmap.epoch]:
+                        del self._pending[e]
+                pending = list(self._pending.items())
+            if pending and self.is_leader():
+                for epoch, blob in pending:
+                    for mon in self.c.mons:
+                        if mon is not self and not mon._stop.is_set():
+                            try:
+                                self.msgr.send(mon.name,
+                                               MMonPropose(epoch, blob))
+                            except (KeyError, OSError,
+                                    ConnectionError):
+                                pass
 
     # -- peer side -----------------------------------------------------------
 
     def _on_propose(self, peer: str, msg: MMonPropose) -> None:
         with self._lock:
-            self.osdmap = OSDMap.decode(msg.map_bytes)
+            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+                self.osdmap = OSDMap.decode(msg.map_bytes)
+            elif not (msg.epoch == self.osdmap.epoch
+                      and msg.map_bytes == self.osdmap.encode()):
+                # REJECTED (stale or competing-at-same-epoch): acking
+                # it would let the losing proposer count a false
+                # majority and broadcast a conflicting map
+                return
         try:
             self.msgr.send(peer, MMonAccept(msg.epoch))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _on_sync_req(self, peer: str, msg) -> None:
+        """A revived monitor asks for the current map; answer with a
+        propose-shaped frame it folds in by epoch (the mon store sync
+        role, ref: src/mon/Monitor.cc sync_start)."""
+        with self._lock:
+            if self.osdmap is None:
+                return
+            blob = self.osdmap.encode()
+            epoch = self.osdmap.epoch
+        try:
+            self.msgr.send(peer, MMonPropose(epoch, blob))
         except (KeyError, OSError, ConnectionError):
             pass
 
@@ -732,21 +840,36 @@ class MonDaemon:
         with self._lock:
             got = self._accepts.setdefault(msg.epoch, set())
             got.add(peer)
-            # broadcast exactly once, on the TRANSITION to quorum
-            if len(got) + 1 == (len(self.c.mons) // 2) + 1:
-                self._broadcast(msg.epoch)
+            # commit + broadcast exactly once, on the TRANSITION to a
+            # peer majority — only NOW does the proposer's own map
+            # advance (propose-then-commit: a quorum-less leader's
+            # mutation must never become its local state, or a later
+            # store sync would make it durable without a majority)
+            if len(got) + 1 != (len(self.c.mons) // 2) + 1:
+                return
+            blob = self._pending.pop(msg.epoch, None)
+            if blob is None:
+                return                 # not ours / already committed
+            if self.osdmap is not None \
+                    and msg.epoch <= self.osdmap.epoch:
+                return                 # a competing commit won
+            self.osdmap = OSDMap.decode(blob)
+            self._broadcast(msg.epoch)
 
     # -- leader side ---------------------------------------------------------
 
     def _commit(self, mutate) -> None:
-        """Apply `mutate(osdmap)`, then drive the quorum round."""
+        """Propose `mutate(candidate)` to the peers; the map advances
+        only when a majority accepts (see _on_accept)."""
         with self._lock:
-            mutate(self.osdmap)
-            epoch = self.osdmap.epoch
-            blob = self.osdmap.encode()
+            candidate = OSDMap.decode(self.osdmap.encode())
+            mutate(candidate)
+            epoch = candidate.epoch
+            blob = candidate.encode()
+            self._pending[epoch] = blob
             self._accepts.setdefault(epoch, set())
         for mon in self.c.mons:
-            if mon is not self:
+            if mon is not self and not mon._stop.is_set():
                 try:
                     self.msgr.send(mon.name, MMonPropose(epoch, blob))
                 except (KeyError, OSError, ConnectionError):
@@ -764,6 +887,8 @@ class MonDaemon:
                 pass
 
     def _on_failure(self, peer: str, msg: MOSDFailure) -> None:
+        if not self.is_leader() or self.osdmap is None:
+            return          # reports reach every mon; the leader acts
         with self._lock:
             osd = msg.failed
             if not self.osdmap.osd_up[osd]:
@@ -782,6 +907,8 @@ class MonDaemon:
         self._commit(mutate)
 
     def _on_boot(self, peer: str, msg: MOSDBoot) -> None:
+        if not self.is_leader() or self.osdmap is None:
+            return
         osd = msg.failed
         self.c.log(f"{self.name}: osd.{osd} boots")
 
@@ -792,6 +919,7 @@ class MonDaemon:
         self._commit(mutate)
 
     def kill(self) -> None:
+        self._stop.set()
         self.msgr.shutdown()
 
 
@@ -934,9 +1062,8 @@ class StandaloneCluster:
     def osd_ids(self) -> list[int]:
         return list(self.osds)
 
-    @property
-    def mon_leader(self) -> str:
-        return "mon.0"
+    def mon_names(self) -> list[str]:
+        return [m.name for m in self.mons if not m._stop.is_set()]
 
     def map_subscribers(self) -> list[str]:
         subs = [d.name for d in self.osds.values()
@@ -982,7 +1109,41 @@ class StandaloneCluster:
         fresh = self.osds[osd].revive()
         self.osds[osd] = fresh
         self._wire_peers()   # registers fresh's new address everywhere
-        fresh.msgr.send(self.mon_leader, MOSDBoot(osd))
+        for mon_name in self.mon_names():
+            try:
+                fresh.msgr.send(mon_name, MOSDBoot(osd))
+            except (KeyError, OSError, ConnectionError):
+                pass
+
+    def kill_mon(self, rank: int) -> None:
+        """SIGKILL a monitor; the quorum machinery and leadership
+        election carry on without it (2 of 3 still commit)."""
+        self.log(f"SIGKILL mon.{rank}")
+        self.mons[rank].kill()
+
+    def revive_mon(self, rank: int) -> None:
+        """Restart a monitor: fresh endpoint, then a store sync from
+        the surviving peers BEFORE it may lead — a stale-map leader
+        could commit an epoch the cluster already passed."""
+        self.log(f"revive mon.{rank}")
+        old = self.mons[rank]
+        fresh = MonDaemon(rank, self, osdmap=None)
+        self.mons[rank] = fresh
+        self._wire_peers()
+        for mon in self.mons:
+            if mon is not fresh and not mon._stop.is_set():
+                try:
+                    fresh.msgr.send(mon.name, MMonSyncReq(0))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+        # wait for the sync to land (peers answer with their map);
+        # if no peer is alive there is no quorum anyway and the
+        # revived mon stays follower-without-map until one appears
+        if any(not m._stop.is_set() for m in self.mons
+               if m is not fresh):
+            self._wait(lambda: fresh.osdmap is not None, 10,
+                       f"mon.{rank} store sync")
+        del old
 
     # -- barriers -------------------------------------------------------------
 

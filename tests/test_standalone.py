@@ -128,3 +128,94 @@ class TestStandalonePersistent:
         cluster.wait_for_clean(timeout=40)
         for name, want in objs.items():
             assert cl.read(name) == want
+
+
+class TestMonitorFailover:
+    """Monitor election + leader failover over the wire (ref:
+    src/mon/Elector.cc lowest-rank outcome; src/mon/Monitor.cc sync).
+    These were axioms in the in-process mon layer; here they are
+    emergent from ping/propose/accept frames."""
+
+    def test_leader_death_moves_leadership_and_detection_continues(self):
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            objs = corpus(20)
+            cl.write(objs)
+            assert c.mons[0].is_leader()
+            c.kill_mon(0)
+            # mon.1 must take over within the grace window
+            c._wait(lambda: c.mons[1].is_leader(), 10,
+                    "mon.1 leadership")
+            # an OSD death is still detected and committed (mon.1
+            # proposes, mon.2 accepts: 2-of-3 quorum)
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(c.pg_num)}
+            victim = next(o for o in c.osd_ids() if o not in primaries)
+            c.kill_osd(victim)
+            c.wait_for_down(victim)
+            c.wait_for_clean(timeout=40)
+            for name, want in objs.items():
+                assert cl.read(name) == want
+        finally:
+            c.shutdown()
+
+    def test_no_quorum_freezes_commits_then_revive_heals(self):
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            objs = corpus(21, n=8)
+            cl.write(objs)
+            c.kill_mon(1)
+            c.kill_mon(2)        # leader alone: 1 of 3 is NO majority
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(c.pg_num)}
+            victim = next(o for o in c.osd_ids() if o not in primaries)
+            c.kill_osd(victim)
+            import time as _t
+            _t.sleep(3 * c.hb_grace)
+            # reports arrived but no commit could reach majority:
+            # every live map still shows the victim up (frozen)
+            assert all(d.osdmap.osd_up[victim]
+                       for d in c.osds.values()
+                       if not d._stop.is_set())
+            c.revive_mon(1)      # quorum restored: 2 of 3
+            c.wait_for_down(victim, timeout=20)
+            c.wait_for_clean(timeout=40)
+            for name, want in objs.items():
+                assert cl.read(name) == want
+        finally:
+            c.shutdown()
+
+    def test_revived_leader_syncs_before_leading(self):
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            cl.write(corpus(22, n=6))
+            c.kill_mon(0)
+            c._wait(lambda: c.mons[1].is_leader(), 10, "mon.1 leads")
+            # epoch advances while mon.0 is dead
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(c.pg_num)}
+            victim = next(o for o in c.osd_ids() if o not in primaries)
+            c.kill_osd(victim)
+            c.wait_for_down(victim)
+            epoch_now = c.mons[1].osdmap.epoch
+            c.revive_mon(0)      # store sync runs inside revive_mon
+            assert c.mons[0].osdmap is not None
+            assert c.mons[0].osdmap.epoch >= epoch_now
+            # rank 0 resumes leadership once peers see it alive again
+            c._wait(lambda: c.mons[0].is_leader(), 10,
+                    "mon.0 resumes leadership")
+            # and can commit: revive the OSD, map must mark it up
+            c.revive_osd(victim)
+            c._wait(lambda: all(d.osdmap.osd_up[victim]
+                                for d in c.osds.values()
+                                if not d._stop.is_set()),
+                    20, "revived osd marked up by resynced leader")
+            c.wait_for_clean(timeout=40)
+        finally:
+            c.shutdown()
