@@ -1,0 +1,3 @@
+from .client import FsClient, FsError, IsADir, NotADir, NotEmpty
+
+__all__ = ["FsClient", "FsError", "IsADir", "NotADir", "NotEmpty"]

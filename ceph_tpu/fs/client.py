@@ -1,0 +1,308 @@
+"""CephFS-lite — the POSIX-shaped file layer over rados.
+
+Rebuild of the reference's filesystem data/metadata split (ref:
+src/mds/ — CInode/CDentry/MDCache; dirfrag omap objects holding
+dentries with EMBEDDED inodes, src/mds/CDir.cc; file DATA addressed
+by inode number through the file layout into plain rados objects,
+src/osd + libcephfs read/write path; client ops shape ref:
+src/client/Client.cc mkdir/create/unlink/rename/readdir).
+
+Mapping onto this framework:
+
+* DIRECTORIES are objects (`.fs.dir.{ino}`) whose dentries live in
+  the object-class KV plane and mutate atomically AT the object via
+  the `fs_dir` class below — exactly the dirfrag-omap role. Each
+  dentry embeds its inode (type, size, mtime, ino), the reference's
+  primary-dentry embedding.
+* FILE DATA is striped at `.fs.data.{ino}` through the RadosStriper —
+  the file-layout striping of {ino}.{index} objects, client-side.
+* INODE NUMBERS come from an allocator object (`.fs.meta`) bumped via
+  cls (the InoTable role).
+* The MDS ITSELF — a metadata-caching server process — collapses to
+  these object-class methods: metadata mutations are already atomic
+  at the dirfrag object, so the sim needs no extra daemon between
+  client and OSD. Locking/caps/multiple-active-MDS are out of scope
+  (single-writer semantics, like a one-client mount).
+
+Everything rides librados/striper: EC fan-out, snapshots' COW,
+recovery, scrub, and PG splits apply to file data and dirfrags with
+no special cases."""
+
+from __future__ import annotations
+
+import json
+import posixpath
+
+from ..client.rados import IoCtx, RadosStriper
+from ..osd.objclass import ClsError, ClsHandle, register_cls
+
+ROOT_INO = 1
+_META_OBJ = ".fs.meta"
+
+
+class FsError(Exception):
+    pass
+
+
+class NotADir(FsError, NotADirectoryError):
+    pass
+
+
+class IsADir(FsError, IsADirectoryError):
+    pass
+
+
+class NotEmpty(FsError, OSError):
+    pass
+
+
+# -- dirfrag object class (CDir dentry ops) ----------------------------------
+
+@register_cls("fs_dir", "link")
+def _dir_link(h: ClsHandle, inp: bytes) -> bytes:
+    req = json.loads(inp)
+    dents = h.kv.setdefault("dentries", {})
+    if req["name"] in dents and not req.get("replace", False):
+        raise ClsError(f"EEXIST: {req['name']}")
+    dents[req["name"]] = req["ent"]
+    return b"{}"
+
+
+@register_cls("fs_dir", "unlink")
+def _dir_unlink(h: ClsHandle, inp: bytes) -> bytes:
+    name = json.loads(inp)["name"]
+    dents = h.kv.setdefault("dentries", {})
+    if name not in dents:
+        raise ClsError(f"ENOENT: {name}")
+    return json.dumps(dents.pop(name)).encode()
+
+
+@register_cls("fs_dir", "lookup")
+def _dir_lookup(h: ClsHandle, inp: bytes) -> bytes:
+    name = json.loads(inp)["name"]
+    ent = h.kv.get("dentries", {}).get(name)
+    if ent is None:
+        raise ClsError(f"ENOENT: {name}")
+    return json.dumps(ent).encode()
+
+
+@register_cls("fs_dir", "list")
+def _dir_list(h: ClsHandle, inp: bytes) -> bytes:
+    return json.dumps(h.kv.get("dentries", {})).encode()
+
+
+@register_cls("fs_dir", "update")
+def _dir_update(h: ClsHandle, inp: bytes) -> bytes:
+    req = json.loads(inp)
+    ent = h.kv.get("dentries", {}).get(req["name"])
+    if ent is None:
+        raise ClsError(f"ENOENT: {req['name']}")
+    ent.update(req["fields"])
+    return json.dumps(ent).encode()
+
+
+@register_cls("fs_meta", "alloc_ino")
+def _meta_alloc(h: ClsHandle, inp: bytes) -> bytes:
+    nxt = h.kv.get("next_ino", ROOT_INO + 1)
+    h.kv["next_ino"] = nxt + 1
+    return json.dumps({"ino": nxt}).encode()
+
+
+class FsClient:
+    """A mounted filesystem handle (the libcephfs Client role)."""
+
+    STRIPE_UNIT = 1 << 16
+    STRIPE_COUNT = 4
+    OBJECT_SIZE = 1 << 20
+
+    def __init__(self, ioctx: IoCtx):
+        self.io = ioctx
+        self._striper = RadosStriper(
+            ioctx, stripe_unit=self.STRIPE_UNIT,
+            stripe_count=self.STRIPE_COUNT,
+            object_size=self.OBJECT_SIZE)
+        # mkfs-on-first-mount: root dirfrag + ino allocator
+        try:
+            self.io.stat(_META_OBJ)
+        except KeyError:
+            self.io.write_full(_META_OBJ, b"fsmeta")
+            self.io.write_full(self._dir_obj(ROOT_INO), b"dirfrag")
+
+    # -- naming --------------------------------------------------------------
+
+    @staticmethod
+    def _dir_obj(ino: int) -> str:
+        return f".fs.dir.{ino}"
+
+    @staticmethod
+    def _data_obj(ino: int) -> str:
+        return f".fs.data.{ino}"
+
+    def _clock(self) -> float:
+        import time
+        return getattr(self.io.rados.cluster, "now", 0.0) or time.time()
+
+    def _alloc_ino(self) -> int:
+        out = self.io.execute(_META_OBJ, "fs_meta", "alloc_ino")
+        return json.loads(out)["ino"]
+
+    # -- path walk (MDCache::path_traverse) ----------------------------------
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        path = posixpath.normpath("/" + path)
+        return [p for p in path.split("/") if p]
+
+    def _walk(self, parts: list[str]) -> dict:
+        """Resolve to the dentry of the LAST part; root pseudo-dentry
+        for []. Raises FileNotFoundError / NotADir on the way."""
+        cur = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0.0}
+        for i, name in enumerate(parts):
+            if cur["type"] != "dir":
+                raise NotADir("/" + "/".join(parts[:i]))
+            try:
+                raw = self.io.execute(self._dir_obj(cur["ino"]),
+                                      "fs_dir", "lookup",
+                                      json.dumps({"name": name}).encode())
+            except ClsError:
+                raise FileNotFoundError(
+                    "/" + "/".join(parts[:i + 1])) from None
+            cur = json.loads(raw)
+        return cur
+
+    def _parent_and_name(self, path: str) -> tuple[dict, str]:
+        parts = self._split(path)
+        if not parts:
+            raise FsError("operation on /")
+        parent = self._walk(parts[:-1])
+        if parent["type"] != "dir":
+            raise NotADir(posixpath.dirname("/" + "/".join(parts)))
+        return parent, parts[-1]
+
+    # -- metadata ops --------------------------------------------------------
+
+    def mkdir(self, path: str) -> None:
+        parent, name = self._parent_and_name(path)
+        ino = self._alloc_ino()
+        self.io.write_full(self._dir_obj(ino), b"dirfrag")
+        ent = {"ino": ino, "type": "dir", "size": 0,
+               "mtime": self._clock()}
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir", "link",
+                        json.dumps({"name": name, "ent": ent}).encode())
+
+    def create(self, path: str, data: bytes = b"") -> None:
+        """create + write in one call (the O_CREAT|O_WRONLY shape)."""
+        parent, name = self._parent_and_name(path)
+        ino = self._alloc_ino()
+        ent = {"ino": ino, "type": "file", "size": 0,
+               "mtime": self._clock()}
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir", "link",
+                        json.dumps({"name": name, "ent": ent}).encode())
+        if data:
+            self.write(path, data)
+
+    def stat(self, path: str) -> dict:
+        return dict(self._walk(self._split(path)))
+
+    def readdir(self, path: str) -> dict[str, dict]:
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        raw = self.io.execute(self._dir_obj(ent["ino"]),
+                              "fs_dir", "list")
+        return json.loads(raw)
+
+    def unlink(self, path: str) -> None:
+        parent, name = self._parent_and_name(path)
+        ent = self._walk(self._split(path))
+        if ent["type"] == "dir":
+            raise IsADir(path)
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
+                        "unlink", json.dumps({"name": name}).encode())
+        try:
+            self._striper.remove(self._data_obj(ent["ino"]))
+        except KeyError:
+            pass                     # never written
+
+    def rmdir(self, path: str) -> None:
+        parent, name = self._parent_and_name(path)
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        if self.readdir(path):
+            raise NotEmpty(path)
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
+                        "unlink", json.dumps({"name": name}).encode())
+        self.io.remove(self._dir_obj(ent["ino"]))
+
+    def rename(self, src: str, dst: str) -> None:
+        """Atomic-at-the-dentries rename: unlink src, link dst with
+        the SAME inode — data never moves (the MDS rename property).
+        An existing dst file is replaced (POSIX); a dst dir must not
+        exist."""
+        sparent, sname = self._parent_and_name(src)
+        dparent, dname = self._parent_and_name(dst)
+        ent = self._walk(self._split(src))
+        try:
+            dent = self._walk(self._split(dst))
+            if dent["type"] == "dir":
+                raise FsError(f"EEXIST: {dst} is a directory")
+            old_ino = dent["ino"]
+        except FileNotFoundError:
+            old_ino = None
+        self.io.execute(self._dir_obj(dparent["ino"]), "fs_dir", "link",
+                        json.dumps({"name": dname, "ent": ent,
+                                    "replace": True}).encode())
+        self.io.execute(self._dir_obj(sparent["ino"]), "fs_dir",
+                        "unlink", json.dumps({"name": sname}).encode())
+        if old_ino is not None and old_ino != ent["ino"]:
+            try:
+                self._striper.remove(self._data_obj(old_ino))
+            except KeyError:
+                pass
+
+    # -- data ops ------------------------------------------------------------
+
+    def write(self, path: str, data: bytes, offset: int = 0) -> None:
+        parent, name = self._parent_and_name(path)
+        ent = self._walk(self._split(path))
+        if ent["type"] != "file":
+            raise IsADir(path)
+        self._striper.write(self._data_obj(ent["ino"]), bytes(data),
+                            offset=offset)
+        new_size = max(ent["size"], offset + len(data))
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
+                        "update",
+                        json.dumps({"name": name,
+                                    "fields": {"size": new_size,
+                                               "mtime": self._clock()}
+                                    }).encode())
+
+    def read(self, path: str, length: int | None = None,
+             offset: int = 0) -> bytes:
+        ent = self._walk(self._split(path))
+        if ent["type"] != "file":
+            raise IsADir(path)
+        if ent["size"] == 0:
+            return b""
+        if length is None:
+            length = max(0, ent["size"] - offset)
+        return self._striper.read(self._data_obj(ent["ino"]),
+                                  length=length, offset=offset)
+
+    def truncate(self, path: str, size: int) -> None:
+        parent, name = self._parent_and_name(path)
+        ent = self._walk(self._split(path))
+        if ent["type"] != "file":
+            raise IsADir(path)
+        if ent["size"] == 0 and size > 0:
+            # sparse grow of a never-written file: materialize zeros
+            self._striper.write(self._data_obj(ent["ino"]), b"\x00")
+        if ent["size"] > 0 or size > 0:
+            self._striper.truncate(self._data_obj(ent["ino"]), size)
+        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
+                        "update",
+                        json.dumps({"name": name,
+                                    "fields": {"size": size,
+                                               "mtime": self._clock()}
+                                    }).encode())

@@ -1,0 +1,3 @@
+from .gateway import Gateway, GatewayError, NoSuchBucket, NoSuchKey
+
+__all__ = ["Gateway", "GatewayError", "NoSuchBucket", "NoSuchKey"]

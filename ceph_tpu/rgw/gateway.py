@@ -1,0 +1,347 @@
+"""RGW-lite — the S3-shaped object gateway over rados.
+
+Rebuild of the reference's radosgw data path (ref: src/rgw/ —
+rgw_op.cc RGWPutObj/RGWGetObj/RGWDeleteObj/RGWListBucket,
+rgw_rados.cc head+tail object layout, cls/rgw/cls_rgw.cc bucket-index
+omap ops, multipart assembly in rgw_multi.cc). What's kept and how it
+maps onto this framework:
+
+* BUCKETS + INDEX. Each bucket has an index object whose entries are
+  maintained by a server-side object class (`rgw_index` below) — the
+  exact role cls_rgw plays for the reference: the index mutates
+  atomically AT the object, not read-modify-write from the client.
+  Listing supports prefix + marker pagination like ListObjectsV2.
+* OBJECT LAYOUT. Small objects land in one rados object; everything
+  is written through the RadosStriper, so big S3 objects stripe
+  across rados objects exactly as RGW's head+tails do. ETag =
+  hex(crc32c) of the payload (the reference uses MD5; the framework's
+  native checksum keeps the property that matters — content-derived,
+  verified end to end).
+* MULTIPART. initiate/upload_part/complete/abort: parts are striped
+  objects of their own; complete writes a MANIFEST the GET path
+  follows (RGW's multipart manifest), so completion is O(parts), not
+  a data rewrite.
+* VERSIONING/S3-AUTH are out of scope: snapshots already provide
+  point-in-time reads at the pool layer, and the wire's AES-GCM +
+  shared-secret handshake is this framework's authn story.
+
+Everything routes through librados/striper, so EC encode fan-out,
+snapshots' COW, scrub, recovery, and PG splits all apply to gateway
+data with no special cases."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..client.rados import IoCtx, RadosStriper
+from ..osd.objclass import ClsError, ClsHandle, register_cls
+
+_BUCKETS_ROOT = ".rgw.root"          # object listing all buckets
+
+
+class GatewayError(Exception):
+    pass
+
+
+class NoSuchBucket(GatewayError, KeyError):
+    pass
+
+
+class NoSuchKey(GatewayError, KeyError):
+    pass
+
+
+# -- bucket index object class (the cls_rgw role) ----------------------------
+
+@register_cls("rgw_index", "add")
+def _idx_add(h: ClsHandle, inp: bytes) -> bytes:
+    ent = json.loads(inp)
+    idx = h.kv.setdefault("entries", {})
+    idx[ent["key"]] = {"size": ent["size"], "etag": ent["etag"],
+                       "mtime": ent["mtime"]}
+    return b"{}"
+
+
+@register_cls("rgw_index", "rm")
+def _idx_rm(h: ClsHandle, inp: bytes) -> bytes:
+    key = json.loads(inp)["key"]
+    idx = h.kv.setdefault("entries", {})
+    if key not in idx:
+        raise ClsError(f"ENOENT: {key}")
+    del idx[key]
+    return b"{}"
+
+
+@register_cls("rgw_index", "list")
+def _idx_list(h: ClsHandle, inp: bytes) -> bytes:
+    req = json.loads(inp or b"{}")
+    prefix = req.get("prefix", "")
+    marker = req.get("marker", "")
+    limit = int(req.get("limit", 1000))
+    idx = h.kv.get("entries", {})
+    keys = sorted(k for k in idx
+                  if k.startswith(prefix) and k > marker)
+    page = keys[:limit]
+    return json.dumps({
+        "entries": [{"key": k, **idx[k]} for k in page],
+        "truncated": len(keys) > limit,
+        "next_marker": page[-1] if page and len(keys) > limit else "",
+    }).encode()
+
+
+@register_cls("rgw_index", "set_manifest")
+def _idx_set_manifest(h: ClsHandle, inp: bytes) -> bytes:
+    req = json.loads(inp)
+    ent = h.kv.get("entries", {}).get(req["key"])
+    if ent is None:
+        raise ClsError(f"ENOENT: {req['key']}")
+    ent["manifest"] = req["manifest"]
+    ent["part_sizes"] = req["part_sizes"]
+    return b"{}"
+
+
+@register_cls("rgw_index", "stat")
+def _idx_stat(h: ClsHandle, inp: bytes) -> bytes:
+    key = json.loads(inp)["key"]
+    ent = h.kv.get("entries", {}).get(key)
+    if ent is None:
+        raise ClsError(f"ENOENT: {key}")
+    return json.dumps(ent).encode()
+
+
+class Gateway:
+    """One S3-facing endpoint over an IoCtx (the radosgw process)."""
+
+    #: striping geometry for object payloads (RGW head+tail analog)
+    STRIPE_UNIT = 1 << 16
+    STRIPE_COUNT = 4
+    OBJECT_SIZE = 1 << 20
+
+    def __init__(self, ioctx: IoCtx):
+        self.io = ioctx
+        self._striper = RadosStriper(
+            ioctx, stripe_unit=self.STRIPE_UNIT,
+            stripe_count=self.STRIPE_COUNT,
+            object_size=self.OBJECT_SIZE)
+
+    # -- naming --------------------------------------------------------------
+
+    @staticmethod
+    def _index_obj(bucket: str) -> str:
+        return f".bucket.index.{bucket}"
+
+    @staticmethod
+    def _data_obj(bucket: str, key: str) -> str:
+        return f".bucket.data.{bucket}/{key}"
+
+    @staticmethod
+    def _upload_obj(bucket: str, key: str, upload_id: str,
+                    part: int | None = None) -> str:
+        base = f".bucket.multipart.{bucket}/{key}/{upload_id}"
+        return base if part is None else f"{base}/part.{part:05d}"
+
+    def _clock(self) -> float:
+        return getattr(self.io.rados.cluster, "now", 0.0) or time.time()
+
+    def _etag(self, data: bytes) -> str:
+        from ..osd.tinstore import _crc32c
+        return f"{_crc32c(data):08x}"
+
+    # -- buckets -------------------------------------------------------------
+
+    def create_bucket(self, bucket: str) -> None:
+        if not bucket or "/" in bucket:
+            raise GatewayError(f"bad bucket name {bucket!r}")
+        roots = self._root_read()
+        if bucket in roots:
+            raise GatewayError(f"BucketAlreadyExists: {bucket}")
+        self.io.write_full(self._index_obj(bucket), b"index")
+        roots.append(bucket)
+        self._root_write(roots)
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._check_bucket(bucket)
+        listing = self.list_objects(bucket, limit=1)
+        if listing["entries"]:
+            raise GatewayError(f"BucketNotEmpty: {bucket}")
+        self.io.remove(self._index_obj(bucket))
+        roots = self._root_read()
+        roots.remove(bucket)
+        self._root_write(roots)
+
+    def list_buckets(self) -> list[str]:
+        return sorted(self._root_read())
+
+    def _root_read(self) -> list[str]:
+        try:
+            return json.loads(self.io.read(_BUCKETS_ROOT))
+        except KeyError:
+            return []
+
+    def _root_write(self, roots: list[str]) -> None:
+        self.io.write_full(_BUCKETS_ROOT, json.dumps(sorted(roots)).encode())
+
+    def _check_bucket(self, bucket: str) -> None:
+        try:
+            self.io.stat(self._index_obj(bucket))
+        except KeyError:
+            raise NoSuchBucket(bucket) from None
+
+    # -- objects -------------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> str:
+        """PUT: payload through the striper, then the index entry via
+        the cls (atomic at the index object). Returns the ETag."""
+        self._check_bucket(bucket)
+        if not key:
+            raise GatewayError("empty key")
+        data = bytes(data)
+        soid = self._data_obj(bucket, key)
+        self._wipe_striped(soid)
+        self._striper.write(soid, data)
+        etag = self._etag(data)
+        self.io.execute(self._index_obj(bucket), "rgw_index", "add",
+                        json.dumps({"key": key, "size": len(data),
+                                    "etag": etag,
+                                    "mtime": self._clock()}).encode())
+        return etag
+
+    def get_object(self, bucket: str, key: str,
+                   offset: int = 0, length: int | None = None) -> bytes:
+        self._check_bucket(bucket)
+        ent = self._stat_entry(bucket, key)
+        if "manifest" in ent:
+            return self._read_manifest(bucket, key, ent, offset, length)
+        soid = self._data_obj(bucket, key)
+        try:
+            if length is None:
+                length = max(0, ent["size"] - offset)
+            return self._striper.read(soid, length=length, offset=offset)
+        except KeyError:
+            raise NoSuchKey(f"{bucket}/{key}") from None
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        self._check_bucket(bucket)
+        return self._stat_entry(bucket, key)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._check_bucket(bucket)
+        ent = self._stat_entry(bucket, key)
+        if "manifest" in ent:
+            for part_soid in ent["manifest"]:
+                self._wipe_striped(part_soid)
+        else:
+            self._wipe_striped(self._data_obj(bucket, key))
+        self.io.execute(self._index_obj(bucket), "rgw_index", "rm",
+                        json.dumps({"key": key}).encode())
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "", limit: int = 1000) -> dict:
+        """ListObjectsV2 shape: {entries, truncated, next_marker}."""
+        self._check_bucket(bucket)
+        out = self.io.execute(
+            self._index_obj(bucket), "rgw_index", "list",
+            json.dumps({"prefix": prefix, "marker": marker,
+                        "limit": limit}).encode())
+        return json.loads(out)
+
+    def _stat_entry(self, bucket: str, key: str) -> dict:
+        try:
+            return json.loads(self.io.execute(
+                self._index_obj(bucket), "rgw_index", "stat",
+                json.dumps({"key": key}).encode()))
+        except ClsError:
+            raise NoSuchKey(f"{bucket}/{key}") from None
+
+    def _wipe_striped(self, soid: str) -> None:
+        try:
+            self._striper.remove(soid)
+        except KeyError:
+            pass
+
+    # -- multipart -----------------------------------------------------------
+
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        self._check_bucket(bucket)
+        upload_id = f"u{abs(hash((bucket, key, self._clock()))):016x}"
+        self.io.write_full(self._upload_obj(bucket, key, upload_id),
+                           json.dumps({"parts": {}}).encode())
+        return upload_id
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        if part_number < 1:
+            raise GatewayError("part numbers start at 1")
+        meta_obj = self._upload_obj(bucket, key, upload_id)
+        try:
+            meta = json.loads(self.io.read(meta_obj))
+        except KeyError:
+            raise GatewayError(f"NoSuchUpload: {upload_id}") from None
+        soid = self._upload_obj(bucket, key, upload_id, part_number)
+        self._wipe_striped(soid)
+        self._striper.write(soid, bytes(data))
+        etag = self._etag(bytes(data))
+        meta["parts"][str(part_number)] = {"size": len(data),
+                                           "etag": etag}
+        self.io.write_full(meta_obj, json.dumps(meta).encode())
+        return etag
+
+    def complete_multipart(self, bucket: str, key: str,
+                           upload_id: str) -> str:
+        """Assemble by MANIFEST (no data rewrite): the index entry
+        records the part objects; GET stitches them on read."""
+        meta_obj = self._upload_obj(bucket, key, upload_id)
+        try:
+            meta = json.loads(self.io.read(meta_obj))
+        except KeyError:
+            raise GatewayError(f"NoSuchUpload: {upload_id}") from None
+        parts = sorted(((int(n), p) for n, p in meta["parts"].items()))
+        if not parts:
+            raise GatewayError("no parts uploaded")
+        manifest = [self._upload_obj(bucket, key, upload_id, n)
+                    for n, _ in parts]
+        sizes = [p["size"] for _, p in parts]
+        etag = self._etag("".join(p["etag"] for _, p in parts).encode()) \
+            + f"-{len(parts)}"
+        self.io.execute(self._index_obj(bucket), "rgw_index", "add",
+                        json.dumps({"key": key, "size": sum(sizes),
+                                    "etag": etag,
+                                    "mtime": self._clock()}).encode())
+        self.io.execute(self._index_obj(bucket), "rgw_index",
+                        "set_manifest",
+                        json.dumps({"key": key, "manifest": manifest,
+                                    "part_sizes": sizes}).encode())
+        self.io.remove(meta_obj)
+        return etag
+
+    def abort_multipart(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        meta_obj = self._upload_obj(bucket, key, upload_id)
+        try:
+            meta = json.loads(self.io.read(meta_obj))
+        except KeyError:
+            raise GatewayError(f"NoSuchUpload: {upload_id}") from None
+        for n in meta["parts"]:
+            self._wipe_striped(
+                self._upload_obj(bucket, key, upload_id, int(n)))
+        self.io.remove(meta_obj)
+
+    def _read_manifest(self, bucket: str, key: str, ent: dict,
+                       offset: int, length: int | None) -> bytes:
+        total = ent["size"]
+        if length is None:
+            length = max(0, total - offset)
+        end = min(offset + length, total)
+        out = bytearray()
+        pos = 0
+        for soid, size in zip(ent["manifest"], ent["part_sizes"]):
+            pstart, pend = pos, pos + size
+            lo, hi = max(offset, pstart), min(end, pend)
+            if lo < hi:
+                out += self._striper.read(soid, length=hi - lo,
+                                          offset=lo - pstart)
+            pos = pend
+            if pos >= end:
+                break
+        return bytes(out)

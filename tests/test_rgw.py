@@ -1,0 +1,154 @@
+"""RGW-lite gateway tests (refs: src/rgw/rgw_op.cc PutObj/GetObj/
+DeleteObj/ListBucket; cls/rgw bucket index; rgw_multi.cc multipart).
+The gateway rides librados + striper, so EC fan-out, COW snapshots,
+and recovery apply to S3 data with no special cases — the failure
+test proves it end-to-end."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.osd.cluster import SimCluster
+from ceph_tpu.rgw import Gateway, GatewayError, NoSuchBucket, NoSuchKey
+
+
+def mk(**kw):
+    kw.setdefault("n_osds", 8)
+    kw.setdefault("pg_num", 4)
+    c = SimCluster(**kw)
+    return c, Gateway(Rados(c).open_ioctx())
+
+
+class TestBuckets:
+    def test_create_list_delete(self):
+        c, gw = mk()
+        gw.create_bucket("alpha")
+        gw.create_bucket("beta")
+        assert gw.list_buckets() == ["alpha", "beta"]
+        gw.delete_bucket("alpha")
+        assert gw.list_buckets() == ["beta"]
+
+    def test_duplicate_and_missing(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        with pytest.raises(GatewayError, match="BucketAlreadyExists"):
+            gw.create_bucket("b")
+        with pytest.raises(NoSuchBucket):
+            gw.put_object("nope", "k", b"x")
+        with pytest.raises(GatewayError, match="bad bucket"):
+            gw.create_bucket("a/b")
+
+    def test_delete_nonempty_refused(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "k", b"x")
+        with pytest.raises(GatewayError, match="BucketNotEmpty"):
+            gw.delete_bucket("b")
+        gw.delete_object("b", "k")
+        gw.delete_bucket("b")
+
+
+class TestObjects:
+    def test_put_get_head_delete_roundtrip(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 256, 5000, np.uint8).tobytes()
+        etag = gw.put_object("b", "docs/a.bin", data)
+        assert gw.get_object("b", "docs/a.bin") == data
+        head = gw.head_object("b", "docs/a.bin")
+        assert head["size"] == 5000 and head["etag"] == etag
+        gw.delete_object("b", "docs/a.bin")
+        with pytest.raises(NoSuchKey):
+            gw.get_object("b", "docs/a.bin")
+
+    def test_overwrite_shrinks_cleanly(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "k", b"A" * 100_000)   # multi-stripe
+        gw.put_object("b", "k", b"short")
+        assert gw.get_object("b", "k") == b"short"
+
+    def test_range_get(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 256, 200_000, np.uint8).tobytes()
+        gw.put_object("b", "big", data)           # stripes + objects
+        assert gw.get_object("b", "big", offset=65_000,
+                             length=1000) == data[65_000:66_000]
+
+    def test_list_prefix_and_pagination(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        for i in range(10):
+            gw.put_object("b", f"logs/{i:02d}", b"x")
+        gw.put_object("b", "other", b"y")
+        out = gw.list_objects("b", prefix="logs/", limit=4)
+        assert [e["key"] for e in out["entries"]] == \
+            ["logs/00", "logs/01", "logs/02", "logs/03"]
+        assert out["truncated"]
+        out2 = gw.list_objects("b", prefix="logs/",
+                               marker=out["next_marker"], limit=100)
+        assert [e["key"] for e in out2["entries"]] == \
+            [f"logs/{i:02d}" for i in range(4, 10)]
+        assert not out2["truncated"]
+
+    def test_data_survives_osd_failure(self):
+        c, gw = mk(down_out_interval=30.0)
+        gw.create_bucket("b")
+        rng = np.random.default_rng(3)
+        blobs = {f"k{i}": rng.integers(0, 256, 30_000,
+                                       np.uint8).tobytes()
+                 for i in range(6)}
+        for k, v in blobs.items():
+            gw.put_object("b", k, v)
+        c.kill_osd(c.pgs[0].acting[0])
+        c.tick(40.0)
+        for _ in range(120):
+            if not c.backfills:
+                break
+            c.tick(6.0)
+        for k, v in blobs.items():
+            assert gw.get_object("b", k) == v
+        assert len(gw.list_objects("b")["entries"]) == 6
+
+
+class TestMultipart:
+    def test_multipart_roundtrip(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        rng = np.random.default_rng(4)
+        parts = [rng.integers(0, 256, 70_000, np.uint8).tobytes()
+                 for _ in range(3)]
+        uid = gw.initiate_multipart("b", "assembled")
+        for i, p in enumerate(parts, start=1):
+            gw.upload_part("b", "assembled", uid, i, p)
+        etag = gw.complete_multipart("b", "assembled", uid)
+        assert etag.endswith("-3")
+        whole = b"".join(parts)
+        assert gw.get_object("b", "assembled") == whole
+        assert gw.head_object("b", "assembled")["size"] == len(whole)
+        # range read across a part boundary
+        assert gw.get_object("b", "assembled", offset=69_000,
+                             length=2000) == whole[69_000:71_000]
+        gw.delete_object("b", "assembled")
+        with pytest.raises(NoSuchKey):
+            gw.get_object("b", "assembled")
+
+    def test_abort_cleans_parts(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        uid = gw.initiate_multipart("b", "k")
+        gw.upload_part("b", "k", uid, 1, b"p" * 10_000)
+        gw.abort_multipart("b", "k", uid)
+        with pytest.raises(GatewayError, match="NoSuchUpload"):
+            gw.upload_part("b", "k", uid, 2, b"q")
+        with pytest.raises(NoSuchKey):
+            gw.get_object("b", "k")
+
+    def test_unknown_upload_refused(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        with pytest.raises(GatewayError, match="NoSuchUpload"):
+            gw.complete_multipart("b", "k", "u0000000000000000")
