@@ -126,6 +126,43 @@ def emit(note: str | None = None) -> None:
     extra["backend"] = snap["backend"]
     extra["tpu_ok"] = snap["tpu_ok"]
     extra["elapsed_s"] = round(time.monotonic() - T0, 1)
+    prov = os.environ.get("BENCH_PROVENANCE")
+    if prov:
+        extra["provenance"] = prov
+    try:
+        # the full config #5 run is recorded once by tools/crush_10m.py
+        # (it takes ~an hour on the CPU fallback — far past this
+        # harness's deadline); fold it in so the artifact carries the
+        # measured-not-extrapolated figure
+        with open(os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "CRUSH_10M.json")) as f:
+            extra["crush_10m"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if not snap["tpu_ok"]:
+        # the tunnel was down for this run: merge the last good
+        # mid-round TPU capture (tools/tpu_probe.py commits it the
+        # moment a probe succeeds) so a round-end dead tunnel doesn't
+        # erase TPU evidence gathered hours earlier. Clearly labeled
+        # as cached — the headline stays the LIVE measurement.
+        try:
+            cache = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "BENCH_mid.json")
+            with open(cache) as f:
+                cached = json.load(f)
+            if cached.get("extra", {}).get("tpu_ok"):
+                extra["cached_tpu"] = {
+                    "metric": cached.get("metric"),
+                    "value": cached.get("value"),
+                    "provenance": cached["extra"].get(
+                        "provenance", "mid-round capture"),
+                    "encode_gbps_by_impl": cached["extra"].get(
+                        "encode_gbps_by_impl"),
+                    "decode_gbps_by_impl": cached["extra"].get(
+                        "decode_gbps_by_impl"),
+                }
+        except (OSError, ValueError, KeyError):
+            pass
     if note:
         extra["note"] = note
     if snap["errors"]:
@@ -330,10 +367,13 @@ def bench_encode_impls(impls):
     return results
 
 
-def bench_decode():
+def bench_decode(impls):
     """Degraded-read decode: rebuild 2 erased shards from k survivors
     (erasures {0, 9}), static decode matrix — the ErasureCodeBench
-    --workload decode analog."""
+    --workload decode analog. Scans every impl exactly like encode
+    (decode IS the same GF matmul after submatrix inversion — r3's
+    mxu-pinned number recorded the slowest lowering as "decode");
+    `decode_gbps` is the best impl's slope."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -347,29 +387,39 @@ def bench_decode():
     survivors = [i for i in range(K + M) if i not in erasures][:K]
     D = decode_matrix(matrix, erasures, K, survivors)
 
-    # gate: decode oracle-encoded survivors, compare rebuilt shards
+    # gate inputs: oracle-encoded survivors and expected rebuilt shards
     rng = np.random.default_rng(12)
     small = rng.integers(0, 256, size=(2, K, 8192), dtype=np.uint8)
-    fn = make_encoder(D, "mxu", bucket_batch=False)
     full = [np.concatenate([small[b], encode_ref(matrix, small[b])], axis=0)
             for b in range(2)]
     surv = np.stack([f[survivors] for f in full])
     want = np.stack([f[erasures] for f in full])
-    got = np.asarray(fn(surv))
-    if not (got == want).all():
-        raise AssertionError("decode output != oracle")
 
     pool = jax.jit(
         lambda key: jax.random.bits(key, (POOL, SUB, K, CHUNK), jnp.uint8)
     )(jax.random.key(8))
     pool.block_until_ready()
-    run = _pipeline(fn, pool)
     bytes_per_iter = SUB * K * CHUNK  # k survivor chunks read per object
-    gbps, t1, t2 = _slope(run, bytes_per_iter)
-    log(f"decode mxu (2 erasures): t({N1})={t1:.3f}s t({N2})={t2:.3f}s "
-        f"slope {gbps:.2f} GB/s in")
-    STATE["extra"]["decode_gbps"] = round(gbps, 3)
-    return gbps
+
+    results = STATE["extra"].setdefault("decode_gbps_by_impl", {})
+    for impl in impls:
+        try:
+            fn = make_encoder(D, impl, bucket_batch=False)
+            got = np.asarray(fn(surv))
+            if not (got == want).all():
+                raise AssertionError(f"impl {impl} decode != oracle")
+            run = _pipeline(fn, pool)
+            gbps, t1, t2 = _slope(run, bytes_per_iter)
+            results[impl] = round(gbps, 3)
+            log(f"decode {impl} (2 erasures): t({N1})={t1:.3f}s "
+                f"t({N2})={t2:.3f}s slope {gbps:.2f} GB/s in")
+        except Exception as e:    # noqa: BLE001 — isolate per impl
+            fail(f"decode impl {impl}", e)
+    if results:
+        best = max(results, key=results.get)
+        STATE["extra"]["decode_gbps"] = results[best]
+        STATE["extra"]["decode_best_impl"] = best
+    return results
 
 
 def bench_cpu_native():
@@ -622,7 +672,7 @@ def main() -> None:
 
         skip = set(os.environ.get("BENCH_SKIP", "").split(","))
         _section("encode", skip, bench_encode_impls, impls)
-        _section("decode", skip, bench_decode)
+        _section("decode", skip, bench_decode, impls)
         _section("cpu", skip, bench_cpu_native)
         _section("crush", skip, bench_crush)
         _section("recovery", skip, bench_recovery)

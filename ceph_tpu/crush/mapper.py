@@ -425,12 +425,32 @@ class VectorMapper:
         key = (rule_id, result_max)
         fn = self._jitted.get(key)
         if fn is None:
-            fn = jax.jit(functools.partial(self._do_rule_impl, rule_id,
-                                           result_max))
+            def impl(tables, xs, weights,
+                     _rid=rule_id, _rm=result_max, _self=self):
+                # the map tables enter as RUNTIME inputs (a dict
+                # pytree), NOT closed-over trace constants: closing
+                # over the device arrays let XLA constant-fold the
+                # bucket-table gathers at compile time — compile cost
+                # scaled with lane count and capped the CPU fallback
+                # at 100k-lane sub-batches (r3). A shallow view with
+                # tracer-valued t_* attrs routes every method access
+                # through the arguments instead.
+                import copy as _copy
+                view = _copy.copy(_self)
+                view.__dict__.update(tables)
+                return VectorMapper._do_rule_impl(view, _rid, _rm,
+                                                  xs, weights)
+            fn = jax.jit(impl)
             self._jitted[key] = fn
         xs = jnp.asarray(xs).astype(jnp.uint32)
         weights = jnp.asarray(weights, jnp.int32)
-        return fn(xs, weights)
+        return fn(self._table_args(), xs, weights)
+
+    def _table_args(self) -> dict:
+        """Every device-resident map table, keyed by attribute name —
+        the runtime-input pytree for the jitted rule."""
+        return {k: v for k, v in self.__dict__.items()
+                if k.startswith("t_")}
 
 
 def full_weights(n_devices: int) -> np.ndarray:

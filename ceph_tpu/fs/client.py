@@ -243,10 +243,20 @@ class FsClient:
         sparent, sname = self._parent_and_name(src)
         dparent, dname = self._parent_and_name(dst)
         ent = self._walk(self._split(src))
+        if sparent["ino"] == dparent["ino"] and sname == dname:
+            # POSIX: same-path rename is a no-op. Without this the
+            # dst link rewrites the dentry and the src unlink then
+            # REMOVES it — the file vanishes and its data orphans.
+            return
         try:
             dent = self._walk(self._split(dst))
             if dent["type"] == "dir":
                 raise FsError(f"EEXIST: {dst} is a directory")
+            if ent["type"] == "dir":
+                # replacing an existing FILE with a directory is
+                # ENOTDIR in POSIX (rename(2)); silently swapping the
+                # types would strand the file's data object
+                raise NotADir(dst)
             old_ino = dent["ino"]
         except FileNotFoundError:
             old_ino = None

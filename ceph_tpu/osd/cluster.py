@@ -83,9 +83,13 @@ class SimCluster:
             # the backend's hinfo CRC layer (verify-on-read + EIO
             # reconstruct), which must see rotten bytes to repair them;
             # TinStore still verifies every object at mount/fsck
+            # cache_bytes is deliberately TINY: sim datasets are small,
+            # and a cache several times smaller than the working set
+            # keeps the chaos/recovery suites exercising the eviction +
+            # device-read path, not an accidental RAM mirror
             self.cluster.store_factory = lambda o: TinStore(
                 _os.path.join(self.store_dir, f"osd.{o}"),
-                verify_reads=False)
+                verify_reads=False, cache_bytes=32 << 10)
         self.profile = profile
         # pool type switch (ref: pg_pool_t TYPE_REPLICATED vs
         # TYPE_ERASURE; PrimaryLogPG drives either through PGBackend):

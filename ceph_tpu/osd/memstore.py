@@ -81,6 +81,19 @@ class Transaction:
         self.ops.append(("omap_set", cid, oid, dict(kv)))
         return self
 
+    def omap_rmkeys(self, cid: str, oid: str, keys):
+        """Remove specific omap keys (ref: src/os/ObjectStore.h
+        OP_OMAP_RMKEYS) — without this, KV entries could only grow or
+        die with the object."""
+        self.ops.append(("omap_rmkeys", cid, oid,
+                         [bytes(k) for k in keys]))
+        return self
+
+    def omap_clear(self, cid: str, oid: str):
+        """Drop every omap key (ref: OP_OMAP_CLEAR)."""
+        self.ops.append(("omap_clear", cid, oid))
+        return self
+
 
 class MemStore:
     """All state in RAM; crash-consistency is trivially atomic because
@@ -177,6 +190,17 @@ class MemStore:
                 o.xattrs.pop(op[3], None)
         elif kind == "omap_set":
             self._obj(op[1], op[2], create=True).omap.update(op[3])
+        elif kind == "omap_rmkeys":
+            # tolerant like rmattr: a missing object/key is a no-op so
+            # the all-or-nothing apply contract can't break mid-batch
+            o = self.collections[op[1]].get(op[2])
+            if o is not None:
+                for k in op[3]:
+                    o.omap.pop(k, None)
+        elif kind == "omap_clear":
+            o = self.collections[op[1]].get(op[2])
+            if o is not None:
+                o.omap.clear()
         else:
             raise ValueError(f"unknown op {kind!r}")
 

@@ -39,11 +39,13 @@ Key design points, and what they re-validate from the in-process sim:
   then recover the lost slot onto the CRUSH replacement — every step
   as frames.
 
-Scope: monitor-leader failover and mid-paxos monitor death stay with
-the in-process monitor layer (mon/monitor.py, which models quorum
-loss); this tier's job is proving the wire transport under daemon
-death. Secure mode composes: pass secret= to run the whole cluster
-over AES-GCM sessions.
+Scope: this tier proves the wire transport under daemon death AND
+the monitor control plane on the same wire — rank election over ping
+liveness, serialized propose/accept quorum commits with rebase-on-
+conflict, leader death and revived-leader resync (MMonSyncReq) all
+run as frames (the in-process mon/monitor.py layer remains the
+synchronous model used by the sim tier). Secure mode composes: pass
+secret= to run the whole cluster over AES-GCM sessions.
 """
 
 from __future__ import annotations
@@ -725,7 +727,13 @@ class MonDaemon:
         self.msgr = Messenger(self.name, secret=cluster.secret)
         self.osdmap = osdmap
         self._accepts: dict[int, set[str]] = {}
-        self._pending: dict[int, bytes] = {}   # proposed, not committed
+        # Serialized proposal pipe (one in flight at a time): queued
+        # mutate closures rebase onto the LATEST committed map before
+        # proposing, so two in-flight proposals can never collide on
+        # an epoch key or silently drop each other's mutations.
+        self._mutations: list = []
+        self._inflight: tuple[int, bytes, list] | None = None
+        self._map_src = rank     # rank whose commit authored osdmap
         self._reporters: dict[int, set[str]] = {}
         self._lock = threading.RLock()
         self._peer_pong: dict[int, float] = {}
@@ -765,6 +773,12 @@ class MonDaemon:
         return self.rank == min(self._alive_ranks())
 
     def _on_ping(self, peer: str, msg: MOSDPing) -> None:
+        if peer.startswith("mon."):
+            # a ping from a monitor proves it alive RIGHT NOW — record
+            # it so a revived lower rank is seen leading within one of
+            # ITS heartbeats instead of one of ours (shrinks the
+            # dual-leader window to the revive→first-ping gap)
+            self._peer_pong[int(peer[4:])] = time.monotonic()
         try:
             self.msgr.send(peer, MOSDPingReply(msg.stamp))
         except (KeyError, OSError, ConnectionError):
@@ -775,7 +789,14 @@ class MonDaemon:
             self._peer_pong[int(peer[4:])] = time.monotonic()
 
     def _mon_hb_loop(self) -> None:
-        while not self._stop.wait(self.c.hb_interval):
+        # ping FIRST, wait after: a freshly revived monitor must
+        # announce itself before the first interval elapses, or the
+        # old leader keeps leading a full heartbeat longer than needed
+        while not self._stop.is_set():
+            if getattr(self.c, "mons", None) is None:
+                # cluster constructor still building the quorum
+                self._stop.wait(0.02)
+                continue
             for mon in self.c.mons:
                 if mon.rank == self.rank or mon._stop.is_set():
                     continue
@@ -784,43 +805,85 @@ class MonDaemon:
                                    MOSDPing(time.monotonic()))
                 except (KeyError, OSError, ConnectionError):
                     pass
-            # re-propose uncommitted proposals: a mutation proposed
-            # while the quorum was short must COMMIT once peers return
-            # (the reporters already consumed their one report), and a
-            # superseded proposal is pruned
+            # drive the proposal pipe: retransmit the in-flight
+            # proposal (its frames may have died with a connection —
+            # a mutation proposed while the quorum was short must
+            # still commit once peers return) and start the next
+            # queued batch when the pipe is idle
             with self._lock:
-                if self.osdmap is not None:
-                    for e in [e for e in self._pending
-                              if e <= self.osdmap.epoch]:
-                        del self._pending[e]
-                pending = list(self._pending.items())
-            if pending and self.is_leader():
-                for epoch, blob in pending:
-                    for mon in self.c.mons:
-                        if mon is not self and not mon._stop.is_set():
-                            try:
-                                self.msgr.send(mon.name,
-                                               MMonPropose(epoch, blob))
-                            except (KeyError, OSError,
-                                    ConnectionError):
-                                pass
+                inflight = self._inflight
+            if inflight is not None:
+                self._send_propose(inflight[0], inflight[1])
+            else:
+                self._try_propose()
+            if self._stop.wait(self.c.hb_interval):
+                return
 
     # -- peer side -----------------------------------------------------------
 
     def _on_propose(self, peer: str, msg: MMonPropose) -> None:
+        src = int(peer[4:]) if peer.startswith("mon.") else 1 << 30
+        superseded = False
         with self._lock:
             if self.osdmap is None or msg.epoch > self.osdmap.epoch:
-                self.osdmap = OSDMap.decode(msg.map_bytes)
-            elif not (msg.epoch == self.osdmap.epoch
-                      and msg.map_bytes == self.osdmap.encode()):
-                # REJECTED (stale or competing-at-same-epoch): acking
-                # it would let the losing proposer count a false
-                # majority and broadcast a conflicting map
-                return
+                superseded = self._adopt_map(msg.epoch,
+                                             msg.map_bytes, src)
+            elif msg.epoch == self.osdmap.epoch \
+                    and msg.map_bytes != self.osdmap.encode():
+                # same-epoch content conflict (two leaders inside the
+                # boot-grace window proposed from the same base):
+                # deterministic tiebreak — the LOWER-rank author wins
+                # on every mon, so the quorum converges on ONE body
+                # for the epoch instead of splitting. The loser's
+                # proposal gets no ack (a false majority would let it
+                # broadcast a conflicting map); its mutations rebase
+                # and re-propose at a higher epoch.
+                if src < self._map_src:
+                    superseded = self._adopt_map(msg.epoch,
+                                                 msg.map_bytes, src)
+                else:
+                    return
+            elif msg.epoch < self.osdmap.epoch:
+                return          # stale proposer; no ack
         try:
             self.msgr.send(peer, MMonAccept(msg.epoch))
         except (KeyError, OSError, ConnectionError):
             pass
+        if superseded:
+            # our own in-flight proposal just lost to this adoption.
+            # Its proposer saw US adopt a competing map the same way,
+            # so it may abort its own commit→broadcast step — if
+            # NOBODY broadcasts, every subscriber is stranded on the
+            # old epoch forever (the r3 revived-leader deadlock).
+            # Broadcast the winner, then rebase our lost mutations.
+            with self._lock:
+                cur = self.osdmap.epoch if self.osdmap else None
+            if cur is not None:
+                self._broadcast(cur)
+            self._try_propose()
+
+    def _adopt_map(self, epoch: int, blob: bytes, src: int) -> bool:
+        """Caller holds the lock. Returns True if the adoption
+        superseded our own in-flight proposal — whose mutations are
+        REQUEUED for a rebase onto the winning map (each mutate
+        closure re-checks its precondition, so an already-applied
+        mutation rebases to a no-op). A competing commit must never
+        silently drop the losing mutation: a lost MOSDBoot would
+        leave a revived OSD down forever (it boots exactly once)."""
+        self.osdmap = OSDMap.decode(blob)
+        self._map_src = src
+        if self._inflight is not None:
+            # ANY adoption invalidates the in-flight proposal: its
+            # candidate was built from a base older than what we just
+            # adopted, so committing it would erase the adopted
+            # mutations (even when inflight epoch > adopted epoch —
+            # epoch numbers say nothing about whose base is newer).
+            # Requeue + rebase instead.
+            self._mutations = self._inflight[2] + self._mutations
+            self._accepts.pop(self._inflight[0], None)
+            self._inflight = None
+            return True
+        return False
 
     def _on_sync_req(self, peer: str, msg) -> None:
         """A revived monitor asks for the current map; answer with a
@@ -838,36 +901,63 @@ class MonDaemon:
 
     def _on_accept(self, peer: str, msg: MMonAccept) -> None:
         with self._lock:
+            if self._inflight is None or self._inflight[0] != msg.epoch:
+                return          # superseded / already committed
             got = self._accepts.setdefault(msg.epoch, set())
             got.add(peer)
-            # commit + broadcast exactly once, on the TRANSITION to a
-            # peer majority — only NOW does the proposer's own map
-            # advance (propose-then-commit: a quorum-less leader's
-            # mutation must never become its local state, or a later
-            # store sync would make it durable without a majority)
-            if len(got) + 1 != (len(self.c.mons) // 2) + 1:
+            # commit + broadcast once, on reaching a peer majority —
+            # only NOW does the proposer's own map advance
+            # (propose-then-commit: a quorum-less leader's mutation
+            # must never become its local state, or a later store
+            # sync would make it durable without a majority)
+            if len(got) + 1 < (len(self.c.mons) // 2) + 1:
                 return
-            blob = self._pending.pop(msg.epoch, None)
-            if blob is None:
-                return                 # not ours / already committed
-            if self.osdmap is not None \
-                    and msg.epoch <= self.osdmap.epoch:
-                return                 # a competing commit won
-            self.osdmap = OSDMap.decode(blob)
-            self._broadcast(msg.epoch)
+            epoch, blob, _ = self._inflight
+            self._inflight = None
+            self._accepts.pop(epoch, None)
+            if self.osdmap is not None and epoch <= self.osdmap.epoch:
+                # a competing commit advanced us past our own epoch
+                # while the accepts were in flight: the adopted winner
+                # is the agreed map; make sure subscribers have it
+                # (mutations were requeued by _adopt_map)
+                epoch = self.osdmap.epoch
+            else:
+                self.osdmap = OSDMap.decode(blob)
+                self._map_src = self.rank
+        self._broadcast(epoch)
+        self._try_propose()
 
     # -- leader side ---------------------------------------------------------
 
     def _commit(self, mutate) -> None:
-        """Propose `mutate(candidate)` to the peers; the map advances
-        only when a majority accepts (see _on_accept)."""
+        """Queue `mutate` on the serialized proposal pipe; the map
+        advances only when a majority accepts (see _on_accept)."""
         with self._lock:
+            self._mutations.append(mutate)
+        self._try_propose()
+
+    def _try_propose(self) -> None:
+        """Start the next proposal batch if the pipe is idle: rebase
+        every queued mutation onto the LATEST committed map, propose
+        the combined candidate. A batch whose mutations all rebase to
+        no-ops (the winner already carried them) is dropped."""
+        with self._lock:
+            if self._inflight is not None or not self._mutations \
+                    or self.osdmap is None:
+                return
             candidate = OSDMap.decode(self.osdmap.encode())
-            mutate(candidate)
-            epoch = candidate.epoch
-            blob = candidate.encode()
-            self._pending[epoch] = blob
-            self._accepts.setdefault(epoch, set())
+            batch = self._mutations
+            self._mutations = []
+            for mutate in batch:
+                mutate(candidate)
+            if candidate.epoch == self.osdmap.epoch:
+                return
+            epoch, blob = candidate.epoch, candidate.encode()
+            self._inflight = (epoch, blob, batch)
+            self._accepts[epoch] = set()
+        self._send_propose(epoch, blob)
+
+    def _send_propose(self, epoch: int, blob: bytes) -> None:
         for mon in self.c.mons:
             if mon is not self and not mon._stop.is_set():
                 try:
@@ -902,8 +992,11 @@ class MonDaemon:
                    f"({self.c.min_reporters} reporters)")
 
         def mutate(m: OSDMap) -> None:
-            m.mark_down(osd)
-            m.mark_out(osd)
+            # precondition re-checked so a rebase onto a map that
+            # already carries the mark is a no-op, not a double bump
+            if m.osd_up[osd]:
+                m.mark_down(osd)
+                m.mark_out(osd)
         self._commit(mutate)
 
     def _on_boot(self, peer: str, msg: MOSDBoot) -> None:
@@ -915,7 +1008,8 @@ class MonDaemon:
         def mutate(m: OSDMap) -> None:
             if not m.osd_up[osd]:
                 m.mark_up(osd)
-            m.mark_in(osd)
+            if m.osd_weight[osd] == 0:
+                m.mark_in(osd)
         self._commit(mutate)
 
     def kill(self) -> None:
@@ -1075,9 +1169,12 @@ class StandaloneCluster:
         if self.store_kind == "tin":
             import os
             from .tinstore import TinStore
+            # small cache on purpose: wire-tier datasets outgrow it,
+            # proving the device-read path under real traffic
             return TinStore(os.path.join(self.store_dir,
                                          f"osd.{osd_id}"),
-                            verify_reads=False)
+                            verify_reads=False,
+                            cache_bytes=64 << 10)
         return MemStore()
 
     def _wire_peers(self) -> None:

@@ -198,6 +198,7 @@ class Gateway:
             raise GatewayError("empty key")
         data = bytes(data)
         soid = self._data_obj(bucket, key)
+        self._wipe_replaced(bucket, key)
         self._wipe_striped(soid)
         self._striper.write(soid, data)
         etag = self._etag(data)
@@ -260,6 +261,21 @@ class Gateway:
         except KeyError:
             pass
 
+    def _wipe_replaced(self, bucket: str, key: str) -> None:
+        """Overwrite cleanup shared by every writer that replaces an
+        index entry (put_object AND complete_multipart): the index
+        'add' drops any existing manifest wholesale, so a replaced
+        multipart object's part payloads must be wiped NOW or they
+        orphan forever; a replaced plain object's data object is wiped
+        by the writer that owns its soid."""
+        try:
+            old = self._stat_entry(bucket, key)
+        except NoSuchKey:
+            return
+        if "manifest" in old:
+            for part_soid in old["manifest"]:
+                self._wipe_striped(part_soid)
+
     # -- multipart -----------------------------------------------------------
 
     def initiate_multipart(self, bucket: str, key: str) -> str:
@@ -304,6 +320,11 @@ class Gateway:
         sizes = [p["size"] for _, p in parts]
         etag = self._etag("".join(p["etag"] for _, p in parts).encode()) \
             + f"-{len(parts)}"
+        # replacing an existing entry: wipe a previous upload's
+        # manifest parts AND a previous plain object's data (the new
+        # entry is manifest-backed, so the plain soid would orphan)
+        self._wipe_replaced(bucket, key)
+        self._wipe_striped(self._data_obj(bucket, key))
         self.io.execute(self._index_obj(bucket), "rgw_index", "add",
                         json.dumps({"key": key, "size": sum(sizes),
                                     "etag": etag,

@@ -83,6 +83,27 @@ class TestNamespace:
         fs.rename("/b/g", "/b/h")
         assert fs.read("/b/h") == b"payload"
 
+    def test_rename_same_path_is_noop(self):
+        # POSIX: rename(p, p) must not touch anything (r3 advisory:
+        # the dst link + src unlink pair DELETED the file)
+        c, fs = mk()
+        fs.mkdir("/a")
+        fs.create("/a/f", b"keep me")
+        ino = fs.stat("/a/f")["ino"]
+        fs.rename("/a/f", "/a/f")
+        assert fs.stat("/a/f")["ino"] == ino
+        assert fs.read("/a/f") == b"keep me"
+
+    def test_rename_dir_over_file_refused(self):
+        # POSIX ENOTDIR: a directory must not replace a file
+        c, fs = mk()
+        fs.mkdir("/d")
+        fs.create("/f", b"data")
+        with pytest.raises(NotADir):
+            fs.rename("/d", "/f")
+        assert fs.read("/f") == b"data"
+        assert fs.stat("/d")["type"] == "dir"
+
 
 class TestData:
     def test_write_read_offsets_and_truncate(self):

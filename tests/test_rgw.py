@@ -147,6 +147,45 @@ class TestMultipart:
         with pytest.raises(NoSuchKey):
             gw.get_object("b", "k")
 
+    def test_put_over_multipart_wipes_parts(self):
+        # r3 advisory: a plain PUT replacing a multipart object must
+        # wipe the manifest's part objects or they orphan forever
+        c, gw = mk()
+        gw.create_bucket("b")
+        uid = gw.initiate_multipart("b", "k")
+        gw.upload_part("b", "k", uid, 1, b"x" * 50_000)
+        gw.upload_part("b", "k", uid, 2, b"y" * 50_000)
+        gw.complete_multipart("b", "k", uid)
+        parts = gw.head_object("b", "k")["manifest"]
+        assert parts
+        gw.put_object("b", "k", b"small replacement")
+        assert gw.get_object("b", "k") == b"small replacement"
+        for soid in parts:
+            with pytest.raises(KeyError):
+                gw._striper.read(soid, length=1)
+
+    def test_complete_over_existing_objects_wipes_old(self):
+        # complete_multipart replaces the index entry exactly like
+        # put_object does — a previous upload's parts and a previous
+        # plain object's data must not orphan (r4 review)
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "k", b"plain " * 5_000)
+        plain_soid = gw._data_obj("b", "k")
+        u1 = gw.initiate_multipart("b", "k")
+        gw.upload_part("b", "k", u1, 1, b"a" * 60_000)
+        gw.complete_multipart("b", "k", u1)
+        parts1 = gw.head_object("b", "k")["manifest"]
+        with pytest.raises(KeyError):
+            gw._striper.read(plain_soid, length=1)   # plain data wiped
+        u2 = gw.initiate_multipart("b", "k")
+        gw.upload_part("b", "k", u2, 1, b"b" * 60_000)
+        gw.complete_multipart("b", "k", u2)
+        for soid in parts1:                           # u1 parts wiped
+            with pytest.raises(KeyError):
+                gw._striper.read(soid, length=1)
+        assert gw.get_object("b", "k") == b"b" * 60_000
+
     def test_unknown_upload_refused(self):
         c, gw = mk()
         gw.create_bucket("b")

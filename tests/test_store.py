@@ -78,6 +78,52 @@ class TestStoreContract:
         with pytest.raises(KeyError):
             store.getattr("c", "o", "hinfo")
 
+    def test_omap_rmkeys_and_clear(self, store):
+        """OP_OMAP_RMKEYS / OP_OMAP_CLEAR (ref: src/os/ObjectStore.h):
+        KV entries must be removable without killing the object."""
+        store.queue_transaction(
+            Transaction().create_collection("c").touch("c", "o")
+            .omap_set("c", "o", {b"a": b"1", b"b": b"2", b"c": b"3"}))
+        store.queue_transaction(
+            Transaction().omap_rmkeys("c", "o", [b"a", b"missing"]))
+        reopen(store)
+        obj = store.collections["c"]["o"]
+        assert dict(obj.omap) == {b"b": b"2", b"c": b"3"}
+        store.queue_transaction(Transaction().omap_clear("c", "o"))
+        reopen(store)
+        assert dict(store.collections["c"]["o"].omap) == {}
+        assert store.exists("c", "o")
+
+    def test_remove_then_write_in_one_txn(self, store):
+        # ops apply IN ORDER: a write after a remove starts from an
+        # empty object — the old bytes must not resurrect (r4 review:
+        # TinStore staging read pre-txn state)
+        store.queue_transaction(
+            Transaction().create_collection("c")
+            .write("c", "o", 0, b"AAAAAAAA"))
+        store.queue_transaction(
+            Transaction().remove("c", "o").write("c", "o", 0, b"BB"))
+        assert bytes(store.read("c", "o")) == b"BB"
+        assert store.stat("c", "o") == 2
+        reopen(store)
+        assert bytes(store.read("c", "o")) == b"BB"
+
+    def test_rmcoll_then_recreate_in_one_txn(self, store):
+        store.queue_transaction(
+            Transaction().create_collection("c")
+            .write("c", "o", 0, b"old bytes"))
+        store.queue_transaction(
+            Transaction().remove_collection("c").create_collection("c")
+            .write("c", "o", 3, b"xy"))
+        assert bytes(store.read("c", "o")) == b"\x00\x00\x00xy"
+
+    def test_omap_rmkeys_missing_object_is_noop(self, store):
+        store.queue_transaction(Transaction().create_collection("c"))
+        store.queue_transaction(
+            Transaction().omap_rmkeys("c", "ghost", [b"k"])
+            .omap_clear("c", "ghost"))
+        assert not store.exists("c", "ghost")
+
     def test_collections_listing(self, store):
         store.queue_transaction(
             Transaction().create_collection("b").create_collection("a")
@@ -226,9 +272,125 @@ class TestTinStoreDurability:
         st.queue_transaction(Transaction().write("c", "o3", 0, b"ghi"))
         st.crash()
         rep = TinStore.fsck(str(tmp_path / "s"))
-        assert rep == {"objects": 3, "bad_objects": [],
-                       "wal_records": 2, "torn_tail": False,
-                       "errors": []}
+        assert rep["objects"] == 3 and rep["wal_records"] == 2
+        assert not rep["bad_objects"] and not rep["errors"]
+        assert not rep["torn_tail"] and not rep["extent_errors"]
+        # 3 objects × one 4 KiB allocation unit each, all accounted
+        assert rep["used_bytes"] == 3 * 4096
+        assert rep["device_bytes"] >= rep["used_bytes"]
+
+
+class TestTinStoreBlockPlane:
+    """The block-device plane (ref: src/os/bluestore/BlueStore.cc
+    _do_read cache path, BitmapAllocator): bounded cache, extent
+    allocator reuse, metadata-only checkpoints."""
+
+    def test_bounded_cache_serves_4x_dataset(self, tmp_path):
+        # 64 objects x 16 KiB = 1 MiB working set through a 256 KiB
+        # cache: every byte must serve exactly, the budget must hold,
+        # and eviction must force device reads
+        budget = 256 << 10
+        st = TinStore(str(tmp_path / "s"), cache_bytes=budget)
+        rng = np.random.default_rng(7)
+        objs = {f"o{i:02d}": rng.integers(0, 256, 16384,
+                                          np.uint8).tobytes()
+                for i in range(64)}
+        t = Transaction().create_collection("c")
+        for name, data in objs.items():
+            t.write("c", name, 0, data)
+        st.queue_transaction(t)
+        for _ in range(2):
+            for name, want in objs.items():
+                assert bytes(st.read("c", name)) == want
+                assert st.cache_stats()["bytes"] <= budget
+        assert st.cache_stats()["misses"] > 0
+        st.crash()
+        st.remount()
+        for name, want in objs.items():
+            assert bytes(st.read("c", name)) == want
+            assert st.cache_stats()["bytes"] <= budget
+
+    def test_checkpoint_is_metadata_only(self, tmp_path):
+        # 4 MiB of object data; the checkpoint must stay tiny (extent
+        # refs, not bytes) — the r3 O(store) serialize is gone
+        st = TinStore(str(tmp_path / "s"))
+        big = bytes(range(256)) * (4 << 12)
+        st.queue_transaction(
+            Transaction().create_collection("c")
+            .write("c", "big", 0, big))
+        st.checkpoint()
+        ckpt = os.path.getsize(os.path.join(str(tmp_path / "s"), "ckpt"))
+        assert ckpt < 16 << 10, f"checkpoint {ckpt}B should be metadata-only"
+        st.crash()
+        st.remount()
+        assert bytes(st.read("c", "big")) == big
+
+    def test_extent_reuse_bounds_device_growth(self, tmp_path):
+        # repeated COW overwrites recycle freed extents: the device
+        # must not grow linearly with write count
+        st = TinStore(str(tmp_path / "s"))
+        data = bytes(range(256)) * 64          # 16 KiB
+        st.queue_transaction(
+            Transaction().create_collection("c")
+            .write("c", "a", 0, data))
+        for _ in range(16):
+            st.queue_transaction(Transaction().write("c", "a", 0, data))
+        dev = os.path.getsize(os.path.join(str(tmp_path / "s"),
+                                           "block.dev"))
+        # steady state: live extent + one COW scratch extent
+        assert dev <= 2 * len(data) + 4096, f"device grew to {dev}"
+        rep = TinStore.fsck(str(tmp_path / "s"))
+        assert rep["used_bytes"] == 16384 and not rep["extent_errors"]
+
+    def test_remove_returns_space(self, tmp_path):
+        st = TinStore(str(tmp_path / "s"))
+        data = bytes(64 << 10)
+        t = Transaction().create_collection("c")
+        for i in range(4):
+            t.write("c", f"o{i}", 0, data)
+        st.queue_transaction(t)
+        used0 = st._alloc.used_bytes()
+        t = Transaction()
+        for i in range(4):
+            t.remove("c", f"o{i}")
+        st.queue_transaction(t)
+        assert st._alloc.used_bytes() == 0 and used0 == 4 * (64 << 10)
+        # freed space is reused, not appended after
+        st.queue_transaction(Transaction().write("c", "n", 0, data))
+        assert st._alloc.used_bytes() == 64 << 10
+        dev = os.path.getsize(os.path.join(str(tmp_path / "s"),
+                                           "block.dev"))
+        assert dev <= 4 * (64 << 10)
+
+    def test_derived_allocator_survives_crash(self, tmp_path):
+        # allocations are not persisted: after SIGKILL the allocator
+        # rebuilds from the extent map and audits cleanly
+        st = TinStore(str(tmp_path / "s"))
+        rng = np.random.default_rng(3)
+        t = Transaction().create_collection("c")
+        for i in range(10):
+            t.write("c", f"o{i}", 0,
+                    rng.integers(0, 256, 5000 + 117 * i,
+                                 np.uint8).tobytes())
+        st.queue_transaction(t)
+        st.queue_transaction(
+            Transaction().remove("c", "o3").remove("c", "o7"))
+        used = st._alloc.used_bytes()
+        st.crash()
+        st.remount()
+        assert st._alloc.used_bytes() == used
+        rep = TinStore.fsck(str(tmp_path / "s"))
+        assert not rep["extent_errors"] and rep["used_bytes"] == used
+
+    def test_omap_rmkeys_survive_crash_replay(self, tmp_path):
+        st = TinStore(str(tmp_path / "s"))
+        st.queue_transaction(
+            Transaction().create_collection("c").touch("c", "o")
+            .omap_set("c", "o", {b"a": b"1", b"b": b"2"}))
+        st.queue_transaction(Transaction().omap_rmkeys("c", "o", [b"a"]))
+        st.crash()                 # rmkeys lives only in the WAL tail
+        st.remount()
+        assert dict(st.collections["c"]["o"].omap) == {b"b": b"2"}
 
 
 class TestTinStoreCluster:

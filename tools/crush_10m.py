@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""CRUSH config #5, run IN FULL: 10M placements on a 10k-OSD map.
+
+BASELINE row 5 / VERDICT r3 item 6: the 10M figure had only ever been
+extrapolated from capped sub-batches; this tool records the real run,
+however long it takes, into CRUSH_10M.json — bench.py folds the result
+into its round-end emission (`extra.crush_placements_per_s_10M`).
+
+Ref: src/crush/mapper.c crush_do_rule; src/tools/crushtool.cc --test
+(the --num-rep batch mapping loop this measures the analog of).
+
+Usage: [BATCH=10000] [TOTAL=10000000] python tools/crush_10m.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from ceph_tpu.crush.map import build_hierarchy, ec_rule  # noqa: E402
+from ceph_tpu.crush.mapper import VectorMapper, full_weights  # noqa: E402
+
+OUT = Path(__file__).resolve().parent.parent / "CRUSH_10M.json"
+BATCH = int(os.environ.get("BATCH", 10_000))
+TOTAL = int(os.environ.get("TOTAL", 10_000_000))
+K, M = 8, 3
+
+
+def main() -> None:
+    import jax
+    m = build_hierarchy(10_000, osds_per_host=10, hosts_per_rack=25)
+    ec_rule(m, rule_id=1, choose_type=1)
+    vm = VectorMapper(m)
+    weights = full_weights(10_000)
+    backend = jax.default_backend()
+    xs0 = np.arange(BATCH, dtype=np.uint32)
+    t0 = time.perf_counter()
+    np.asarray(vm.do_rule(1, xs0, weights, K + M))
+    compile_s = time.perf_counter() - t0
+    print(f"compile+first batch: {compile_s:.1f}s "
+          f"(backend={backend})", flush=True)
+    t0 = time.perf_counter()
+    done = 0
+    res = None
+    while done < TOTAL:
+        xs = np.arange(done, done + BATCH, dtype=np.uint32)
+        res = vm.do_rule(1, xs, weights, K + M)
+        done += BATCH
+        if done % 1_000_000 == 0:
+            dt = time.perf_counter() - t0
+            print(f"{done/1e6:.0f}M placed, {done/dt:.0f}/s "
+                  f"({dt:.0f}s elapsed)", flush=True)
+    filled = int((np.asarray(res) >= 0).sum(axis=1).min())
+    dt = time.perf_counter() - t0
+    payload = {
+        "crush_placements_per_s_10M": round(done / dt, 1),
+        "n_placements": done,
+        "numrep": K + M,
+        "min_filled_last_batch": filled,
+        "elapsed_s": round(dt, 1),
+        "batch": BATCH,
+        "backend": backend,
+        "n_osds": 10_000,
+        "note": "full config #5 run, no extrapolation",
+    }
+    OUT.write_text(json.dumps(payload, indent=1) + "\n")
+    print(json.dumps(payload), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Opportunistic TPU capture loop (VERDICT r3 item 1).
+
+The TPU tunnel has been dead at every round end (rounds 1-3: every
+round-end probe hung).  This tool runs from the *start* of the round in
+the background:
+
+  1. Once: a root-cause probe matrix -- each row varies one environment
+     knob (JAX_PLATFORMS=axon vs tpu, axon sitecustomize on/off) and a
+     faulthandler dump shows where a hung probe sits.  Results land in
+     ``TPU_PROBE_LOG.md`` so BENCH_METHODOLOGY can cite them.
+  2. Then: probe every PROBE_INTERVAL seconds.  The moment a probe
+     succeeds, run the full ``bench.py`` and commit the artifact as
+     ``BENCH_mid.json`` (provenance-labelled).  bench.py merges this
+     cached last-good TPU capture into its round-end emission when live
+     TPU is down again.
+
+Ref (behavioral parity target): ceph_erasure_code_benchmark.cc ::
+ErasureCodeBench::run -- the reference benches on real hardware; this
+chases the same on a flaky tunnel.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+LOG = REPO / "TPU_PROBE_LOG.md"
+ARTIFACT = REPO / "BENCH_mid.json"
+PROBE_INTERVAL = 240.0
+PROBE_TIMEOUT = 90.0
+MAX_RUNTIME = float(os.environ.get("TPU_PROBE_MAX_RUNTIME", 10.5 * 3600))
+
+PROBE_SRC = (
+    # the dump timer MUST be a daemon thread or it blocks interpreter
+    # exit on success and a healthy probe reads as a hang
+    "import faulthandler, threading, sys; "
+    "t = threading.Timer({dump_at}, lambda: faulthandler.dump_traceback(file=sys.stderr)); "
+    "t.daemon = True; t.start(); "
+    "import jax; ds = jax.devices(); "
+    "print('PLATFORM=' + ds[0].platform + ' N=' + str(len(ds)))"
+)
+
+
+def _log(line: str) -> None:
+    stamp = time.strftime("%H:%M:%S")
+    with LOG.open("a") as f:
+        f.write(f"- `{stamp}` {line}\n")
+    print(f"[{stamp}] {line}", flush=True)
+
+
+def run_probe(env_overrides: dict[str, str], timeout: float, dump: bool = False):
+    """Returns (ok, detail). detail is platform string or failure reason."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    src = PROBE_SRC.format(dump_at=max(10.0, timeout - 20.0) if dump else 10 ** 6)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", src],
+            capture_output=True, text=True, timeout=timeout, env=env, cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        if dump and e.stderr:
+            err = e.stderr if isinstance(e.stderr, str) else e.stderr.decode("utf-8", "replace")
+            tail = " | stack-tail: " + " / ".join(
+                ln.strip() for ln in err.strip().splitlines()[-8:]
+            )[:600]
+        return False, f"hung > {timeout:.0f}s{tail}"
+    except Exception as e:  # noqa: BLE001
+        return False, f"spawn error: {e!r}"
+    if r.returncode == 0 and "PLATFORM=" in r.stdout:
+        plat = r.stdout.split("PLATFORM=")[1].split()[0]
+        if plat in ("tpu", "axon"):
+            return True, r.stdout.strip()
+        return False, f"wrong platform: {r.stdout.strip()}"
+    tail = " | ".join(r.stderr.strip().splitlines()[-3:])[:300]
+    return False, f"rc={r.returncode} {tail}"
+
+
+def probe_matrix() -> None:
+    """One-shot root-cause matrix. Each row isolates one knob."""
+    no_axon_path = ":".join(
+        p for p in os.environ.get("PYTHONPATH", "").split(":") if "axon" not in p
+    )
+    rows = [
+        ("default (JAX_PLATFORMS=axon, axon_site on path)", {}, True),
+        ("JAX_PLATFORMS=tpu, axon_site on path", {"JAX_PLATFORMS": "tpu"}, True),
+        ("JAX_PLATFORMS=tpu, axon_site STRIPPED", {"JAX_PLATFORMS": "tpu", "PYTHONPATH": no_axon_path}, True),
+        ("JAX_PLATFORMS=axon, no remote compile", {"PALLAS_AXON_REMOTE_COMPILE": "0"}, True),
+        ("cpu control (should always pass)", {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}, False),
+    ]
+    _log(f"probe matrix start ({len(rows)} rows, timeout {PROBE_TIMEOUT:.0f}s each)")
+    for name, overrides, dump in rows:
+        ok, detail = run_probe(overrides, PROBE_TIMEOUT, dump=dump)
+        _log(f"matrix [{name}]: {'OK' if ok else 'FAIL'} -- {detail}")
+    _log("probe matrix done")
+
+
+def capture_bench() -> bool:
+    _log("TPU alive -> running full bench.py (this can take a while)")
+    env = dict(os.environ)
+    env["BENCH_PROVENANCE"] = f"mid-round capture {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+    try:
+        r = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            capture_output=True, text=True, timeout=3600, env=env, cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        _log("bench.py hung > 3600s; killed. Will keep probing.")
+        return False
+    line = (r.stdout.strip().splitlines() or [""])[-1]
+    try:
+        payload = json.loads(line)
+    except Exception:  # noqa: BLE001
+        _log(f"bench.py produced no parseable JSON (rc={r.returncode}); stderr tail: "
+             + " | ".join(r.stderr.strip().splitlines()[-3:])[:300])
+        return False
+    tpu_ok = bool(payload.get("extra", {}).get("tpu_ok"))
+    ARTIFACT.write_text(json.dumps(payload, indent=1) + "\n")
+    _log(f"bench.py done: tpu_ok={tpu_ok} metric={payload.get('metric')} value={payload.get('value')}")
+    if tpu_ok:
+        subprocess.run(["git", "add", str(ARTIFACT), str(LOG)], cwd=str(REPO))
+        subprocess.run(
+            ["git", "commit", "-m", "Mid-round TPU bench capture (tunnel alive)"],
+            cwd=str(REPO), capture_output=True,
+        )
+        _log("artifact committed")
+    return tpu_ok
+
+
+def main() -> None:
+    LOG.write_text(
+        "# TPU probe log (round 4)\n\n"
+        "Opportunistic capture loop per VERDICT r3 item 1. Rows below are\n"
+        "appended live; the matrix section records the root-cause isolation.\n\n"
+    )
+    probe_matrix()
+    deadline = time.monotonic() + MAX_RUNTIME
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        ok, detail = run_probe({}, PROBE_TIMEOUT, dump=(attempt % 10 == 1))
+        _log(f"probe #{attempt}: {'OK ' + detail if ok else detail}")
+        if ok and capture_bench():
+            _log("capture complete; continuing low-rate probes to refresh")
+            time.sleep(1800)
+            continue
+        time.sleep(PROBE_INTERVAL)
+    _log("probe loop: max runtime reached; exiting")
+
+
+if __name__ == "__main__":
+    main()
