@@ -674,10 +674,13 @@ def main() -> None:
         _section("encode", skip, bench_encode_impls, impls)
         _section("decode", skip, bench_decode, impls)
         _section("cpu", skip, bench_cpu_native)
-        _section("crush", skip, bench_crush)
         _section("recovery", skip, bench_recovery)
         _section("lrc", skip, bench_lrc_repair)
         _section("clay", skip, bench_clay_repair)
+        # crush runs LAST: its kernel crashed the TPU worker process in
+        # the first live capture (2026-07-30), and a dead worker fails
+        # every section after it — ordering contains the blast radius
+        _section("crush", skip, bench_crush)
     except BaseException as e:    # noqa: BLE001 — the line must print
         fail("main", e)
     emit()

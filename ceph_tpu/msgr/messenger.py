@@ -233,6 +233,7 @@ class Messenger:
         self._in_seq: dict[str, int] = {}      # last delivered seq
         self._conns: dict[str, _Conn] = {}
         self._addr_of: dict[str, tuple] = {}
+        self._blocked: set[str] = set()        # partition injection
         self._stopping = False
         self._listener = socket.create_server((host, 0))
         self.addr = self._listener.getsockname()
@@ -283,6 +284,9 @@ class Messenger:
                 return
             nlen = struct.unpack("<H", self._recv_exact(sock, 2))[0]
             peer = self._recv_exact(sock, nlen).decode()
+            if peer in self._blocked:
+                sock.close()      # partitioned: refuse the dial
+                return
             peer_inst = self._recv_exact(sock, 8)
             # symmetric handshake: both sides exchange their last-seen
             # sequence so BOTH replay their unacked queues — an
@@ -365,6 +369,8 @@ class Messenger:
         """Dial + handshake + replay. Callers hold the peer lock, so
         only one connect per peer runs and replay order is exact."""
         with self._plock(peer):
+            if peer in self._blocked:
+                raise ConnectionError(f"partitioned from {peer}")
             conn = self._conns.get(peer)
             if conn is not None and conn.alive:
                 return conn  # someone beat us to it
@@ -471,6 +477,23 @@ class Messenger:
     def add_peer(self, peer: str, addr) -> None:
         self._addr_of[peer] = tuple(addr)
 
+    def set_blocked(self, peers) -> None:
+        """Partition injection (the ms_inject_socket_failures analog,
+        ref: src/msg/Messenger.h ms_inject_* debug knobs): frames
+        to/from these peer NAMES stop flowing — live connections are
+        killed, new dials raise, inbound handshakes are refused.
+        Queued messages stay unacked and replay on heal, which is
+        exactly a real partition's semantics: the network drops
+        frames, the lossless session replays them afterwards."""
+        with self._lock:
+            self._blocked = set(peers)
+            dead = [(p, c) for p, c in self._conns.items()
+                    if p in self._blocked]
+            for p, _ in dead:
+                del self._conns[p]
+        for _, c in dead:
+            c.close()
+
     def _plock(self, peer: str) -> threading.RLock:
         with self._lock:
             lk = self._peer_locks.get(peer)
@@ -492,6 +515,8 @@ class Messenger:
                 self._unacked.setdefault(peer, deque()).append(
                     (seq, msg.type_id, payload))
                 conn = self._conns.get(peer)
+                if peer in self._blocked:
+                    return   # partitioned: queued, replays on heal
             try:
                 if conn is None or not conn.alive:
                     conn = self._connect(peer)

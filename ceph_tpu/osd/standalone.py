@@ -13,9 +13,9 @@ the Messenger — nothing reaches around the wire:
   OSDs that own them; reads pull helper shards back the same way)
 * liveness:        MOSDPing / MOSDPingReply     (ref: MOSDPing.h)
 * failure reports: MOSDFailure -> monitor       (ref: MOSDFailure.h)
-* map commits:     MMonPropose / MMonAccept     (Paxos-lite: leader
-  proposes, commits on majority accept — ref: src/mon/Paxos.cc
-  collapsed to one phase for an alive-leader quorum)
+* map commits:     MMonCollect / MMonLast / MMonBegin / MMonAcceptPn /
+  MMonCommit / MMonNack — multi-phase Paxos with rank-stamped proposal
+  numbers (ref: src/mon/Paxos.cc collect/last/begin/accept/commit)
 * map fan-out:     MOSDMap epoch + full encoded OSDMap (MOSDMap.h)
 * boot:            MOSDBoot                     (ref: MOSDBoot.h)
 
@@ -41,11 +41,14 @@ Key design points, and what they re-validate from the in-process sim:
 
 Scope: this tier proves the wire transport under daemon death AND
 the monitor control plane on the same wire — rank election over ping
-liveness, serialized propose/accept quorum commits with rebase-on-
-conflict, leader death and revived-leader resync (MMonSyncReq) all
-run as frames (the in-process mon/monitor.py layer remains the
-synchronous model used by the sim tier). Secure mode composes: pass
-secret= to run the whole cluster over AES-GCM sessions.
+liveness, multi-phase Paxos map commits whose safety holds under
+network partitions and dual-leader windows (pn arbitration, not
+election correctness — see MonDaemon), leader death, revived-leader
+resync (collect doubles as store sync), and injected partitions
+(Messenger.set_blocked / StandaloneCluster.partition) all run as
+frames. The in-process mon/monitor.py layer remains the synchronous
+model used by the sim tier. Secure mode composes: pass secret= to
+run the whole cluster over AES-GCM sessions.
 """
 
 from __future__ import annotations
@@ -199,6 +202,131 @@ class MOSDMapMsg(MMonPropose):
 @register_message
 class MMonSyncReq(MMonAccept):
     type_id = 0x3B          # payload: requester's current epoch
+
+
+# Multi-phase Paxos frames (ref: src/mon/Paxos.cc collect/last/begin/
+# accept/commit; OP_COLLECT..OP_COMMIT in Paxos.h). Proposal numbers
+# are rank-stamped (pn = n*256 + rank) so they are globally unique and
+# totally ordered across proposers.
+
+@register_message
+class MMonCollect(Message):
+    type_id = 0x3C
+
+    def __init__(self, pn: int):
+        self.pn = pn
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).u64(self.pn).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonCollect":
+        d.start(1)
+        m = cls(d.u64())
+        d.finish()
+        return m
+
+
+@register_message
+class MMonLast(Message):
+    """Peon's collect reply: its promise for `pn`, any accepted-but-
+    uncommitted value, and its committed map (epoch 0 = none) so a
+    stale or fresh leader catches up from the quorum it gathers."""
+
+    type_id = 0x3D
+
+    def __init__(self, pn: int, accepted_pn: int, accepted_epoch: int,
+                 accepted_blob: bytes, committed_epoch: int,
+                 committed_blob: bytes):
+        self.pn = pn
+        self.accepted_pn = accepted_pn
+        self.accepted_epoch = accepted_epoch
+        self.accepted_blob = accepted_blob
+        self.committed_epoch = committed_epoch
+        self.committed_blob = committed_blob
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).u64(self.pn).u64(self.accepted_pn)
+         .u32(self.accepted_epoch).blob(self.accepted_blob)
+         .u32(self.committed_epoch).blob(self.committed_blob).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonLast":
+        d.start(1)
+        m = cls(d.u64(), d.u64(), d.u32(), d.blob(), d.u32(), d.blob())
+        d.finish()
+        return m
+
+
+@register_message
+class MMonBegin(Message):
+    type_id = 0x3E
+
+    def __init__(self, pn: int, epoch: int, map_bytes: bytes):
+        self.pn, self.epoch, self.map_bytes = pn, epoch, map_bytes
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).u64(self.pn).u32(self.epoch)
+         .blob(self.map_bytes).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonBegin":
+        d.start(1)
+        m = cls(d.u64(), d.u32(), d.blob())
+        d.finish()
+        return m
+
+
+@register_message
+class MMonAcceptPn(Message):
+    type_id = 0x3F
+
+    def __init__(self, pn: int, epoch: int):
+        self.pn, self.epoch = pn, epoch
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).u64(self.pn).u32(self.epoch).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonAcceptPn":
+        d.start(1)
+        m = cls(d.u64(), d.u32())
+        d.finish()
+        return m
+
+
+@register_message
+class MMonCommit(MMonPropose):
+    type_id = 0x40          # same shape: epoch + encoded map
+
+
+@register_message
+class MMonNack(Message):
+    """Refusal carrying the REFUSED pn, the refuser's promise and its
+    committed state: the rejected proposer adopts the committed map
+    and, if the nack is for its CURRENT round (stale replayed nacks
+    must not abort a later healthy round), abandons and re-collects
+    at a higher pn (the Paxos 'learn you lost' path)."""
+
+    type_id = 0x41
+
+    def __init__(self, nacked: int, promised: int, committed_epoch: int,
+                 committed_blob: bytes):
+        self.nacked = nacked
+        self.promised = promised
+        self.committed_epoch = committed_epoch
+        self.committed_blob = committed_blob
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).u64(self.nacked).u64(self.promised)
+         .u32(self.committed_epoch).blob(self.committed_blob).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonNack":
+        d.start(1)
+        m = cls(d.u64(), d.u64(), d.u32(), d.blob())
+        d.finish()
+        return m
 
 
 # -- request/reply plumbing --------------------------------------------------
@@ -647,6 +775,18 @@ class OSDDaemon:
         while not self._stop.wait(self.c.hb_interval):
             beat += 1
             if beat % 4 == 0 and self.osdmap is not None \
+                    and not self.osdmap.osd_up[self.osd_id]:
+                # the map says we're down but we're clearly running:
+                # re-assert boot until a committed map shows us up
+                # (ref: OSD::start_boot retry — a single MOSDBoot can
+                # be consumed by a monitor that loses leadership, or
+                # race the down-mark commit; retrying self-heals both)
+                for mon_name in self.c.mon_names():
+                    try:
+                        self.msgr.send(mon_name, MOSDBoot(self.osd_id))
+                    except (KeyError, OSError, ConnectionError):
+                        pass
+            if beat % 4 == 0 and self.osdmap is not None \
                     and self._lock.acquire(blocking=False):
                 try:
                     # retry deferred recoveries (a reconcile is cheap
@@ -712,12 +852,22 @@ class MonDaemon:
     """Monitor endpoint. The lowest rank BELIEVED ALIVE leads (rank
     election over real ping frames — ref: src/mon/Elector.cc's
     lowest-rank-wins outcome, with liveness standing in for the
-    propose/ack rounds); commits go through a one-phase majority
-    round to the peer monitors (Paxos-lite over real frames), then
-    fan out as MOSDMap broadcasts. A dead leader is detected by the
-    next rank within the heartbeat grace and leadership moves — OSD
-    reports are broadcast to every monitor and handled by whoever
-    currently leads, so failover needs no client coordination."""
+    propose/ack rounds); map commits go through MULTI-PHASE Paxos over
+    real frames (ref: src/mon/Paxos.cc collect/last/begin/accept/
+    commit): a leader first COLLECTs a majority of promises at a
+    rank-stamped proposal number — learning the quorum's committed
+    state and re-driving any accepted-but-uncommitted value — and only
+    then BEGINs new values; peons accept only at or above their
+    promised pn. Safety does not rest on the election: two monitors
+    that both believe they lead (boot grace, partition) arbitrate by
+    pn, and a value accepted by a majority is visible to every later
+    collect quorum (intersection), so a committed epoch can never be
+    displaced. A minority-side leader never gets its collect majority,
+    so it can neither commit nor adopt uncommitted state as durable.
+    OSD reports are broadcast to every monitor and QUEUED by all of
+    them; whoever currently leads proposes (a queued mutation whose
+    precondition the committed map already satisfies rebases to a
+    no-op), so leadership moves drop nothing."""
 
     def __init__(self, rank: int, cluster: "StandaloneCluster",
                  osdmap: OSDMap | None = None):
@@ -725,15 +875,23 @@ class MonDaemon:
         self.c = cluster
         self.name = f"mon.{rank}"
         self.msgr = Messenger(self.name, secret=cluster.secret)
-        self.osdmap = osdmap
-        self._accepts: dict[int, set[str]] = {}
-        # Serialized proposal pipe (one in flight at a time): queued
-        # mutate closures rebase onto the LATEST committed map before
-        # proposing, so two in-flight proposals can never collide on
-        # an epoch key or silently drop each other's mutations.
+        self.osdmap = osdmap            # the COMMITTED map, only
+        # -- acceptor state (the peon role) --
+        self._promised = 0              # highest pn promised
+        self._accepted: tuple[int, int, bytes] | None = None
+        #                               # (pn, epoch, blob) uncommitted
+        # -- proposer state (the leader role) --
+        self._pn = 0                    # pn held after collect quorum
+        self._pn_seen = 0               # highest pn observed anywhere
+        self._collecting: list | None = None   # [pn, responders, best]
+        self._inflight: tuple[int, int, bytes, list] | None = None
+        #                               # (pn, epoch, blob, mutations)
+        self._accepts: set[str] = set()
+        # Serialized proposal pipe (one begin in flight at a time):
+        # queued mutate closures rebase onto the LATEST committed map
+        # before proposing, so in-flight proposals can never collide
+        # on an epoch key or silently drop each other's mutations.
         self._mutations: list = []
-        self._inflight: tuple[int, bytes, list] | None = None
-        self._map_src = rank     # rank whose commit authored osdmap
         self._reporters: dict[int, set[str]] = {}
         self._lock = threading.RLock()
         self._peer_pong: dict[int, float] = {}
@@ -746,8 +904,12 @@ class MonDaemon:
         m = self.msgr
         m.register_handler(MOSDFailure.type_id, self._on_failure)
         m.register_handler(MOSDBoot.type_id, self._on_boot)
-        m.register_handler(MMonPropose.type_id, self._on_propose)
-        m.register_handler(MMonAccept.type_id, self._on_accept)
+        m.register_handler(MMonCollect.type_id, self._on_collect)
+        m.register_handler(MMonLast.type_id, self._on_last)
+        m.register_handler(MMonBegin.type_id, self._on_begin)
+        m.register_handler(MMonAcceptPn.type_id, self._on_accept)
+        m.register_handler(MMonCommit.type_id, self._on_commit)
+        m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
@@ -805,145 +967,299 @@ class MonDaemon:
                                    MOSDPing(time.monotonic()))
                 except (KeyError, OSError, ConnectionError):
                     pass
-            # drive the proposal pipe: retransmit the in-flight
-            # proposal (its frames may have died with a connection —
-            # a mutation proposed while the quorum was short must
-            # still commit once peers return) and start the next
-            # queued batch when the pipe is idle
-            with self._lock:
-                inflight = self._inflight
-            if inflight is not None:
-                self._send_propose(inflight[0], inflight[1])
+            # drive the Paxos machine: a leader retransmits its
+            # outstanding collect/begin (their frames may have died
+            # with a connection — both are idempotent at the peon),
+            # collects when it holds no pn, proposes when the pipe is
+            # idle. A NON-leader abandons proposer state so it can't
+            # duel the real leader's pn (its mutations requeue and
+            # re-propose if leadership ever returns).
+            if self.is_leader():
+                with self._lock:
+                    col = self._collecting
+                    infl = self._inflight
+                    active = self._pn != 0
+                if col is not None:
+                    self._send_peers(MMonCollect(col[0]))
+                elif infl is not None:
+                    self._send_peers(MMonBegin(*infl[:3]))
+                elif not active:
+                    self._start_collect()
+                else:
+                    self._try_propose()
             else:
-                self._try_propose()
+                with self._lock:
+                    if self._collecting is not None \
+                            or self._inflight is not None or self._pn:
+                        self._abandon_locked()
+                    # prune queued mutations the committed map already
+                    # carries: a mon that never leads must not hoard
+                    # no-op closures forever
+                    if self._mutations and self.osdmap is not None:
+                        base = self.osdmap
+                        raw = base.encode()
+                        keep = []
+                        for mutate in self._mutations:
+                            cand = OSDMap.decode(raw)
+                            mutate(cand)
+                            if cand.epoch != base.epoch:
+                                keep.append(mutate)
+                        self._mutations = keep
             if self._stop.wait(self.c.hb_interval):
                 return
 
-    # -- peer side -----------------------------------------------------------
+    # -- shared helpers ------------------------------------------------------
 
-    def _on_propose(self, peer: str, msg: MMonPropose) -> None:
-        src = int(peer[4:]) if peer.startswith("mon.") else 1 << 30
-        superseded = False
+    def _majority(self) -> int:
+        return len(self.c.mons) // 2 + 1
+
+    def _send_peers(self, msg: Message) -> None:
+        for mon in self.c.mons:
+            if mon is not self and not mon._stop.is_set():
+                try:
+                    self.msgr.send(mon.name, msg)
+                except (KeyError, OSError, ConnectionError):
+                    pass
+
+    def _committed_pair(self) -> tuple[int, bytes]:
+        """Caller holds the lock. (0, b'') = no committed map yet."""
+        if self.osdmap is None:
+            return 0, b""
+        return self.osdmap.epoch, self.osdmap.encode()
+
+    def _fold_committed_locked(self, epoch: int, blob: bytes) -> None:
+        """Adopt a COMMITTED map learned from a peer (Last/Nack/
+        Commit frames carry one). Commit adoption is always safe —
+        a majority durably accepted it — and monotonic by epoch."""
+        if epoch and (self.osdmap is None or epoch > self.osdmap.epoch):
+            self.osdmap = OSDMap.decode(blob)
+        if self._accepted is not None and self.osdmap is not None \
+                and self._accepted[1] <= self.osdmap.epoch:
+            self._accepted = None    # superseded by a commit
+        if self._inflight is not None and self.osdmap is not None \
+                and self._inflight[1] <= self.osdmap.epoch:
+            # our in-flight value's epoch just committed (ours or a
+            # rival's body): the round is over — requeue its mutations
+            # for a rebase so late replayed accepts can't resurrect it
+            self._mutations = self._inflight[3] + self._mutations
+            self._inflight = None
+            self._accepts = set()
+
+    def _abandon_locked(self) -> None:
+        """Caller holds the lock. Drop proposer state; REQUEUE any
+        in-flight mutations at the front of the pipe (each mutate
+        closure re-checks its precondition, so one the winning leader
+        already committed rebases to a no-op). A lost round must never
+        silently drop a mutation: a lost MOSDBoot would leave a
+        revived OSD down forever (it boots exactly once)."""
+        if self._inflight is not None:
+            self._mutations = self._inflight[3] + self._mutations
+        self._inflight = None
+        self._collecting = None
+        self._accepts = set()
+        self._pn = 0
+
+    # -- acceptor (peon) side ------------------------------------------------
+
+    def _on_collect(self, peer: str, msg: MMonCollect) -> None:
+        reply: Message
         with self._lock:
-            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
-                superseded = self._adopt_map(msg.epoch,
-                                             msg.map_bytes, src)
-            elif msg.epoch == self.osdmap.epoch \
-                    and msg.map_bytes != self.osdmap.encode():
-                # same-epoch content conflict (two leaders inside the
-                # boot-grace window proposed from the same base):
-                # deterministic tiebreak — the LOWER-rank author wins
-                # on every mon, so the quorum converges on ONE body
-                # for the epoch instead of splitting. The loser's
-                # proposal gets no ack (a false majority would let it
-                # broadcast a conflicting map); its mutations rebase
-                # and re-propose at a higher epoch.
-                if src < self._map_src:
-                    superseded = self._adopt_map(msg.epoch,
-                                                 msg.map_bytes, src)
-                else:
-                    return
-            elif msg.epoch < self.osdmap.epoch:
-                return          # stale proposer; no ack
+            self._pn_seen = max(self._pn_seen, msg.pn)
+            if msg.pn >= self._promised:
+                self._promised = msg.pn
+                if self._pn and self._pn < msg.pn:
+                    # we were proposing at a lower pn: our begins can
+                    # no longer win — stand down, requeue mutations
+                    self._abandon_locked()
+                apn, aep, ablob = self._accepted or (0, 0, b"")
+                cep, cblob = self._committed_pair()
+                reply = MMonLast(msg.pn, apn, aep, ablob, cep, cblob)
+            else:
+                reply = MMonNack(msg.pn, self._promised,
+                                 *self._committed_pair())
         try:
-            self.msgr.send(peer, MMonAccept(msg.epoch))
+            self.msgr.send(peer, reply)
         except (KeyError, OSError, ConnectionError):
             pass
-        if superseded:
-            # our own in-flight proposal just lost to this adoption.
-            # Its proposer saw US adopt a competing map the same way,
-            # so it may abort its own commit→broadcast step — if
-            # NOBODY broadcasts, every subscriber is stranded on the
-            # old epoch forever (the r3 revived-leader deadlock).
-            # Broadcast the winner, then rebase our lost mutations.
-            with self._lock:
-                cur = self.osdmap.epoch if self.osdmap else None
-            if cur is not None:
-                self._broadcast(cur)
-            self._try_propose()
 
-    def _adopt_map(self, epoch: int, blob: bytes, src: int) -> bool:
-        """Caller holds the lock. Returns True if the adoption
-        superseded our own in-flight proposal — whose mutations are
-        REQUEUED for a rebase onto the winning map (each mutate
-        closure re-checks its precondition, so an already-applied
-        mutation rebases to a no-op). A competing commit must never
-        silently drop the losing mutation: a lost MOSDBoot would
-        leave a revived OSD down forever (it boots exactly once)."""
-        self.osdmap = OSDMap.decode(blob)
-        self._map_src = src
-        if self._inflight is not None:
-            # ANY adoption invalidates the in-flight proposal: its
-            # candidate was built from a base older than what we just
-            # adopted, so committing it would erase the adopted
-            # mutations (even when inflight epoch > adopted epoch —
-            # epoch numbers say nothing about whose base is newer).
-            # Requeue + rebase instead.
-            self._mutations = self._inflight[2] + self._mutations
-            self._accepts.pop(self._inflight[0], None)
-            self._inflight = None
-            return True
-        return False
+    def _on_begin(self, peer: str, msg: MMonBegin) -> None:
+        reply: Message
+        with self._lock:
+            self._pn_seen = max(self._pn_seen, msg.pn)
+            committed = self.osdmap.epoch if self.osdmap else 0
+            if msg.pn < self._promised or msg.epoch <= committed:
+                # promised a higher round, or the value's epoch is
+                # already committed (stale/replayed begin): refuse,
+                # teaching the proposer our promise + committed map
+                reply = MMonNack(msg.pn, self._promised,
+                                 *self._committed_pair())
+            else:
+                self._promised = msg.pn
+                if self._pn and self._pn < msg.pn:
+                    self._abandon_locked()
+                self._accepted = (msg.pn, msg.epoch, msg.map_bytes)
+                reply = MMonAcceptPn(msg.pn, msg.epoch)
+        try:
+            self.msgr.send(peer, reply)
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _on_commit(self, peer: str, msg: MMonCommit) -> None:
+        with self._lock:
+            fresh = self.osdmap is None \
+                or msg.epoch > self.osdmap.epoch
+            self._fold_committed_locked(msg.epoch, msg.map_bytes)
+        if fresh:
+            # peons broadcast too: if the committing leader dies
+            # between its commit fan-out and its subscriber fan-out,
+            # subscribers would otherwise strand on the old epoch
+            # until the next commit (subscribers dedup by epoch)
+            self._broadcast(msg.epoch)
 
     def _on_sync_req(self, peer: str, msg) -> None:
-        """A revived monitor asks for the current map; answer with a
-        propose-shaped frame it folds in by epoch (the mon store sync
-        role, ref: src/mon/Monitor.cc sync_start)."""
+        """A revived monitor asks for the current map; answer with the
+        COMMITTED map only (an accepted-but-uncommitted value must
+        never be served as durable state — the mon store sync role,
+        ref: src/mon/Monitor.cc sync_start)."""
         with self._lock:
-            if self.osdmap is None:
-                return
-            blob = self.osdmap.encode()
-            epoch = self.osdmap.epoch
-        try:
-            self.msgr.send(peer, MMonPropose(epoch, blob))
-        except (KeyError, OSError, ConnectionError):
-            pass
+            epoch, blob = self._committed_pair()
+        if epoch:
+            try:
+                self.msgr.send(peer, MMonCommit(epoch, blob))
+            except (KeyError, OSError, ConnectionError):
+                pass
 
-    def _on_accept(self, peer: str, msg: MMonAccept) -> None:
+    # -- proposer (leader) side ----------------------------------------------
+
+    def _next_pn_locked(self) -> int:
+        n = (self._pn_seen >> 8) + 1
+        pn = (n << 8) | self.rank
+        self._pn_seen = pn
+        return pn
+
+    def _start_collect(self) -> None:
         with self._lock:
-            if self._inflight is None or self._inflight[0] != msg.epoch:
-                return          # superseded / already committed
-            got = self._accepts.setdefault(msg.epoch, set())
-            got.add(peer)
-            # commit + broadcast once, on reaching a peer majority —
+            if self._collecting is not None:
+                return
+            pn = self._next_pn_locked()
+            # self-promise: we are one acceptor of our own round, and
+            # promising our own pn keeps a lower concurrent collector
+            # from splitting us off its quorum
+            self._promised = max(self._promised, pn)
+            self._collecting = [pn, set(), None]
+        self._send_peers(MMonCollect(pn))
+
+    def _on_last(self, peer: str, msg: MMonLast) -> None:
+        begin = None
+        with self._lock:
+            self._pn_seen = max(self._pn_seen, msg.accepted_pn)
+            col = self._collecting
+            if col is None or col[0] != msg.pn:
+                return           # stale round
+            col[1].add(peer)
+            self._fold_committed_locked(msg.committed_epoch,
+                                        msg.committed_blob)
+            committed = self.osdmap.epoch if self.osdmap else 0
+            if msg.accepted_pn and msg.accepted_epoch > committed \
+                    and (col[2] is None or msg.accepted_pn > col[2][0]):
+                col[2] = (msg.accepted_pn, msg.accepted_epoch,
+                          msg.accepted_blob)
+            if len(col[1]) + 1 < self._majority():
+                return
+            # collect quorum: we hold the round. Any value accepted by
+            # a majority is guaranteed visible here (quorum
+            # intersection) — re-drive the highest-pn uncommitted one
+            # under OUR pn before proposing anything new, or a
+            # committed-elsewhere value could be lost.
+            self._pn = col[0]
+            self._collecting = None
+            best = col[2]
+            if self._accepted is not None \
+                    and self._accepted[1] > committed \
+                    and (best is None or self._accepted[0] > best[0]):
+                best = self._accepted
+            if best is not None and best[1] > committed:
+                self._inflight = (self._pn, best[1], best[2], [])
+                self._accepts = set()
+                self._accepted = (self._pn, best[1], best[2])
+                begin = MMonBegin(self._pn, best[1], best[2])
+        if begin is not None:
+            self._send_peers(begin)
+        else:
+            self._try_propose()
+
+    def _on_accept(self, peer: str, msg: MMonAcceptPn) -> None:
+        committed = None
+        with self._lock:
+            if self._inflight is None or self._inflight[0] != msg.pn \
+                    or self._inflight[1] != msg.epoch:
+                return           # superseded / already committed
+            self._accepts.add(peer)
+            # commit once, on reaching a majority (self included) —
             # only NOW does the proposer's own map advance
             # (propose-then-commit: a quorum-less leader's mutation
             # must never become its local state, or a later store
             # sync would make it durable without a majority)
-            if len(got) + 1 < (len(self.c.mons) // 2) + 1:
+            if len(self._accepts) + 1 < self._majority():
                 return
-            epoch, blob, _ = self._inflight
+            pn, epoch, blob, muts = self._inflight
             self._inflight = None
-            self._accepts.pop(epoch, None)
+            self._accepts = set()
             if self.osdmap is not None and epoch <= self.osdmap.epoch:
-                # a competing commit advanced us past our own epoch
-                # while the accepts were in flight: the adopted winner
-                # is the agreed map; make sure subscribers have it
-                # (mutations were requeued by _adopt_map)
-                epoch = self.osdmap.epoch
+                # a newer commit folded in while the accepts were in
+                # flight (partition heal replays them late): NEVER
+                # regress the committed map — requeue for rebase
+                self._mutations = muts + self._mutations
             else:
                 self.osdmap = OSDMap.decode(blob)
-                self._map_src = self.rank
-        self._broadcast(epoch)
-        self._try_propose()
+                if self._accepted is not None \
+                        and self._accepted[1] <= epoch:
+                    self._accepted = None
+                committed = (epoch, blob)
+        if committed is not None:
+            self._send_peers(MMonCommit(*committed))
+            self._broadcast(committed[0])
+            self._try_propose()
 
-    # -- leader side ---------------------------------------------------------
+    def _on_nack(self, peer: str, msg: MMonNack) -> None:
+        """We lost a round (higher promise out there) or proposed a
+        stale epoch: adopt the refuser's committed map, stand down,
+        and let the next heartbeat re-collect at a higher pn if we
+        still lead. A nack for some EARLIER round (replayed across a
+        heal) still teaches the committed map but must not abort the
+        current healthy round."""
+        with self._lock:
+            self._pn_seen = max(self._pn_seen, msg.promised)
+            self._fold_committed_locked(msg.committed_epoch,
+                                        msg.committed_blob)
+            current = msg.nacked and (
+                (self._collecting is not None
+                 and self._collecting[0] == msg.nacked)
+                or (self._inflight is not None
+                    and self._inflight[0] == msg.nacked)
+                or self._pn == msg.nacked)
+            if current:
+                self._abandon_locked()
 
     def _commit(self, mutate) -> None:
         """Queue `mutate` on the serialized proposal pipe; the map
         advances only when a majority accepts (see _on_accept)."""
         with self._lock:
             self._mutations.append(mutate)
-        self._try_propose()
+        if self.is_leader():
+            self._try_propose()
 
     def _try_propose(self) -> None:
-        """Start the next proposal batch if the pipe is idle: rebase
-        every queued mutation onto the LATEST committed map, propose
-        the combined candidate. A batch whose mutations all rebase to
-        no-ops (the winner already carried them) is dropped."""
+        """Start the next begin batch if the pipe is idle and we hold
+        a collected pn: rebase every queued mutation onto the LATEST
+        committed map, propose the combined candidate. A batch whose
+        mutations all rebase to no-ops (the committed map already
+        carries them) is dropped."""
+        begin = None
         with self._lock:
-            if self._inflight is not None or not self._mutations \
-                    or self.osdmap is None:
+            if self._inflight is not None or not self._pn \
+                    or self._collecting is not None \
+                    or not self._mutations or self.osdmap is None:
                 return
             candidate = OSDMap.decode(self.osdmap.encode())
             batch = self._mutations
@@ -953,21 +1269,15 @@ class MonDaemon:
             if candidate.epoch == self.osdmap.epoch:
                 return
             epoch, blob = candidate.epoch, candidate.encode()
-            self._inflight = (epoch, blob, batch)
-            self._accepts[epoch] = set()
-        self._send_propose(epoch, blob)
-
-    def _send_propose(self, epoch: int, blob: bytes) -> None:
-        for mon in self.c.mons:
-            if mon is not self and not mon._stop.is_set():
-                try:
-                    self.msgr.send(mon.name, MMonPropose(epoch, blob))
-                except (KeyError, OSError, ConnectionError):
-                    pass
+            self._inflight = (self._pn, epoch, blob, batch)
+            self._accepts = set()
+            self._accepted = (self._pn, epoch, blob)  # self-accept
+            begin = MMonBegin(self._pn, epoch, blob)
+        self._send_peers(begin)
 
     def _broadcast(self, epoch: int) -> None:
         with self._lock:
-            if self.osdmap.epoch != epoch:
+            if self.osdmap is None or self.osdmap.epoch != epoch:
                 return
             blob = self.osdmap.encode()
         for peer in self.c.map_subscribers():
@@ -977,8 +1287,13 @@ class MonDaemon:
                 pass
 
     def _on_failure(self, peer: str, msg: MOSDFailure) -> None:
-        if not self.is_leader() or self.osdmap is None:
-            return          # reports reach every mon; the leader acts
+        # EVERY mon queues the mutation (reports are broadcast to all):
+        # only the current leader proposes, so whoever leads when the
+        # pipe drains carries it — a report consumed by a monitor that
+        # loses leadership a beat later is not lost, and a duplicate
+        # rebases to a no-op against the committed map.
+        if self.osdmap is None:
+            return
         with self._lock:
             osd = msg.failed
             if not self.osdmap.osd_up[osd]:
@@ -1000,7 +1315,7 @@ class MonDaemon:
         self._commit(mutate)
 
     def _on_boot(self, peer: str, msg: MOSDBoot) -> None:
-        if not self.is_leader() or self.osdmap is None:
+        if self.osdmap is None:
             return
         osd = msg.failed
         self.c.log(f"{self.name}: osd.{osd} boots")
@@ -1211,6 +1526,35 @@ class StandaloneCluster:
                 fresh.msgr.send(mon_name, MOSDBoot(osd))
             except (KeyError, OSError, ConnectionError):
                 pass
+
+    def _endpoints(self) -> list:
+        eps = [(m.name, m.msgr) for m in self.mons
+               if not m._stop.is_set()]
+        eps += [(d.name, d.msgr) for d in self.osds.values()
+                if not d._stop.is_set()]
+        eps += [(c.msgr.name, c.msgr) for c in self.clients]
+        return eps
+
+    def partition(self, *groups) -> None:
+        """Install a network partition (the ms_inject_socket_failures
+        role, SURVEY §4): endpoints named in different groups cannot
+        exchange frames — enforced at BOTH ends of every cross-group
+        pair. Endpoints in no group stay fully connected (so a
+        mon-only split leaves OSD traffic alone, like a switch fault
+        between the mon racks)."""
+        sets = [set(g) for g in groups]
+        named = set().union(*sets) if sets else set()
+        self.log(f"partition: {[sorted(s) for s in sets]}")
+        for name, msgr in self._endpoints():
+            mine = next((s for s in sets if name in s), None)
+            msgr.set_blocked(named - mine if mine is not None
+                             else set())
+
+    def heal_partition(self) -> None:
+        """Remove every injected block; queued frames replay."""
+        self.log("partition: healed")
+        for _, msgr in self._endpoints():
+            msgr.set_blocked(set())
 
     def kill_mon(self, rank: int) -> None:
         """SIGKILL a monitor; the quorum machinery and leadership

@@ -189,6 +189,138 @@ class TestMonitorFailover:
         finally:
             c.shutdown()
 
+    def test_partition_minority_leader_cannot_commit(self):
+        """Multi-phase Paxos safety, live (ref: src/mon/Paxos.cc):
+        a partitioned minority leader never wins a collect quorum, so
+        its committed map CANNOT advance — no commit without majority
+        — while the majority side keeps committing. On heal the
+        minority adopts the committed history (NACK/replayed-commit
+        teach it) instead of displacing it."""
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            cl.write(corpus(30, n=6))
+            c.partition({"mon.0"}, {"mon.1", "mon.2"})
+            c._wait(lambda: c.mons[1].is_leader(), 10,
+                    "mon.1 leads the majority side")
+            e0 = c.mons[0].osdmap.epoch
+            # mon.0 still BELIEVES it leads (the dual-leader window is
+            # real and allowed); pn arbitration is what protects us
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(c.pg_num)}
+            victim = next(o for o in c.osd_ids() if o not in primaries)
+            c.kill_osd(victim)
+            # reports reach BOTH sides (OSDs are unpartitioned); only
+            # the majority side can turn them into a commit
+            c._wait(lambda: not c.mons[1].osdmap.osd_up[victim]
+                    and not c.mons[2].osdmap.osd_up[victim], 20,
+                    "majority side commits the down mark")
+            import time as _t
+            _t.sleep(3 * c.hb_grace)   # give mon.0 every chance to try
+            assert c.mons[0].osdmap.epoch == e0, \
+                "minority leader advanced its committed map"
+            assert c.mons[0].osdmap.osd_up[victim]
+            c.heal_partition()
+            c._wait(lambda: c.mons[0].osdmap.epoch
+                    >= c.mons[1].osdmap.epoch
+                    and not c.mons[0].osdmap.osd_up[victim], 15,
+                    "healed minority adopts the committed history")
+        finally:
+            c.shutdown()
+
+    def test_partition_heal_no_dual_commit(self):
+        """The r3 one-phase protocol could let a healed lower-rank
+        leader re-propose an epoch the majority had already committed
+        and win by rank tiebreak — displacing committed history. With
+        pn-arbitrated Paxos the committed epoch survives the heal:
+        all monitors converge on the majority's map, byte-identical,
+        and the cluster still commits NEW epochs afterwards."""
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            objs = corpus(31, n=6)
+            cl.write(objs)
+            c.partition({"mon.0"}, {"mon.1", "mon.2"})
+            c._wait(lambda: c.mons[1].is_leader(), 10, "mon.1 leads")
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(c.pg_num)}
+            victim = next(o for o in c.osd_ids() if o not in primaries)
+            c.kill_osd(victim)
+            c._wait(lambda: not c.mons[1].osdmap.osd_up[victim], 20,
+                    "down committed on the majority side")
+            committed_epoch = c.mons[1].osdmap.epoch
+            c.heal_partition()
+            # rank 0 resumes leadership — and must NOT roll back or
+            # rewrite the committed epoch it missed
+            c._wait(lambda: c.mons[0].is_leader(), 10,
+                    "mon.0 resumes leadership")
+
+            def converged():
+                maps = {m.osdmap.encode() for m in c.mons
+                        if m.osdmap is not None}
+                return len(maps) == 1 \
+                    and c.mons[0].osdmap.epoch >= committed_epoch \
+                    and not c.mons[0].osdmap.osd_up[victim]
+            c._wait(converged, 15, "all monitors byte-identical, "
+                                   "committed mark intact")
+            # the healed quorum still commits new epochs
+            c.revive_osd(victim)
+            c._wait(lambda: all(d.osdmap.osd_up[victim]
+                                for d in c.osds.values()
+                                if not d._stop.is_set()),
+                    20, "revived osd marked up after heal")
+            c.wait_for_clean(timeout=40)
+            for name, want in objs.items():
+                assert cl.read(name) == want
+        finally:
+            c.shutdown()
+
+    def test_seeded_partition_schedule_converges(self):
+        """Thrasher-style (ref: qa/tasks/ceph_manager.py): a seeded
+        random schedule of monitor splits with OSD kill/revive churn
+        under each; after every heal the monitors must converge
+        byte-identically and data must read back exact."""
+        rng = np.random.default_rng(0xCE9)
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            objs = corpus(32, n=10)
+            cl.write(objs)
+            mons = ["mon.0", "mon.1", "mon.2"]
+            for rnd in range(3):
+                lone = mons[int(rng.integers(0, 3))]
+                rest = {m for m in mons if m != lone}
+                c.partition({lone}, rest)
+                primaries = {
+                    cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                    for ps in range(c.pg_num)}
+                victim = next(
+                    o for o in c.osd_ids() if o not in primaries
+                    and not c.osds[o]._stop.is_set())
+                c.kill_osd(victim)
+                c.wait_for_down(victim, timeout=25)
+                c.heal_partition()
+                c.revive_osd(victim)
+                c._wait(lambda v=victim: all(
+                    d.osdmap.osd_up[v] for d in c.osds.values()
+                    if not d._stop.is_set()), 25,
+                    f"round {rnd}: revived osd back up")
+
+                def converged():
+                    maps = {m.osdmap.encode() for m in c.mons
+                            if m.osdmap is not None}
+                    return len(maps) == 1
+                c._wait(converged, 20,
+                        f"round {rnd}: monitors byte-identical")
+                c.wait_for_clean(timeout=40)
+            for name, want in objs.items():
+                assert cl.read(name) == want
+        finally:
+            c.shutdown()
+
     def test_revived_leader_syncs_before_leading(self):
         c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
         try:
