@@ -752,8 +752,12 @@ class ECBackend(PGBackend):
             # not-yet-replayed delete's leftover) is lagging, not
             # corrupt — same staleness excuse the replicated scrub and
             # shallow scrub apply
+            # "__"-prefixed objects are PG-internal bookkeeping (e.g.
+            # the standalone tier's __pg_meta__ omap blob): no hinfo,
+            # not client data — the scrub audits client objects only
             names = [n for n in store.list_objects(cid)
-                     if self.shard_applied[s]
+                     if not n.startswith("__")
+                     and self.shard_applied[s]
                      >= self.object_versions.get(n, 0)]
             by_len: dict[int, list[str]] = {}
             for n in names:

@@ -90,6 +90,10 @@ class PGPool:
     is_erasure: bool = False
     pgp_num: int | None = None
     ec_profile: dict = field(default_factory=dict)
+    # pool snapshots (ref: pg_pool_t::snap_seq/snaps — monitor-owned,
+    # distributed to OSDs/clients inside the map): sid -> snap name
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if self.pgp_num is None:
@@ -141,11 +145,16 @@ class OSDMap:
         e.list([bool(u) for u in self.osd_up],
                lambda en, u: en.boolean(u))
         def enc_pool(en, p: PGPool):
-            en.start(1, 1)
+            # v2 appends snap_seq + snaps; compat 1 (old readers skip
+            # the tail via the section length)
+            en.start(2, 1)
             en.i32(p.pool_id).u32(p.pg_num).u32(p.size).u32(p.min_size)
             en.i32(p.crush_rule).boolean(p.is_erasure).u32(p.pgp_num)
             en.mapping(p.ec_profile, lambda e2, k: e2.string(k),
                        lambda e2, v: e2.string(str(v)))
+            en.u64(p.snap_seq)
+            en.mapping(p.snaps, lambda e2, k: e2.u64(k),
+                       lambda e2, v: e2.string(v))
             en.finish()
         e.list([self.pools[k] for k in sorted(self.pools)], enc_pool)
         e.mapping(self.pg_temp,
@@ -173,11 +182,15 @@ class OSDMap:
         m.osd_weight = np.asarray(weights, dtype=np.int32)
         m.osd_up = np.asarray(ups, dtype=bool)
         def dec_pool(dd) -> PGPool:
-            dd.start(1)
+            pv = dd.start(2)
             p = PGPool(dd.i32(), dd.u32(), dd.u32(), dd.u32(), dd.i32(),
                        dd.boolean(), dd.u32(),
                        dd.mapping(lambda e2: e2.string(),
                                   lambda e2: e2.string()))
+            if pv >= 2:
+                p.snap_seq = dd.u64()
+                p.snaps = dd.mapping(lambda e2: e2.u64(),
+                                     lambda e2: e2.string())
             dd.finish()
             return p
         for p in d.list(dec_pool):
@@ -242,6 +255,26 @@ class OSDMap:
 
     def mark_in(self, osd: int, weight: float = 1.0) -> None:
         self.osd_weight[osd] = int(weight * 0x10000)
+        self._bump()
+
+    def pool_mksnap(self, pool_id: int, name: str) -> None:
+        """Take a named pool snapshot (ref: OSDMonitor pool mksnap ->
+        pg_pool_t::add_snap). Idempotent by NAME so the same request
+        queued on several monitors commits exactly one snap."""
+        p = self.pools[pool_id]
+        if name in p.snaps.values():
+            return
+        p.snap_seq += 1
+        p.snaps[p.snap_seq] = name
+        self._bump()
+
+    def pool_rmsnap(self, pool_id: int, name: str) -> None:
+        p = self.pools[pool_id]
+        sids = [s for s, n in p.snaps.items() if n == name]
+        if not sids:
+            return
+        for s in sids:
+            del p.snaps[s]
         self._bump()
 
     def set_pg_temp(self, pg: tuple[int, int], acting: list[int]) -> None:

@@ -329,6 +329,30 @@ class MMonNack(Message):
         return m
 
 
+@register_message
+class MPoolOp(Message):
+    """Client pool mutation — mksnap/rmsnap by NAME (ref: MPoolOp.h,
+    OSDMonitor::prepare_pool_op). Broadcast to every monitor like
+    MOSDBoot; name-idempotence makes the queue-everywhere pattern
+    commit exactly one snap. The client observes the result through
+    its map subscription (pg_pool_t.snaps rides the OSDMap)."""
+
+    type_id = 0x42
+
+    def __init__(self, kind: str, snap_name: str):
+        self.kind, self.snap_name = kind, snap_name
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).string(self.kind).string(self.snap_name).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPoolOp":
+        d.start(1)
+        m = cls(d.string(), d.string())
+        d.finish()
+        return m
+
+
 # -- request/reply plumbing --------------------------------------------------
 
 class _Rpc:
@@ -429,6 +453,48 @@ class RemoteStore:
 
 # -- daemons -----------------------------------------------------------------
 
+class _PgClsView:
+    """SimCluster-shaped facade over ONE PG at its primary so object
+    classes (objclass.py ClsHandle) run unchanged at the wire tier
+    (ref: PrimaryLogPG::do_osd_ops OP_CALL — the method executes at
+    the object's primary; its writes ride the normal fan-out path,
+    COW and PG log included)."""
+
+    def __init__(self, daemon: "OSDDaemon", ps: int, be):
+        self._d, self._ps, self._be = daemon, ps, be
+        self.pgs = {ps: be}
+
+    def locate(self, name: str) -> int:
+        return self._ps
+
+    def read(self, name: str):
+        return self._be.read_object(
+            name, dead_osds=set(self._d.suspect))
+
+    def write(self, objects: dict) -> None:
+        d = self._d
+        d._snap_guard(self._ps, self._be, objects)
+        self._be.write_objects(
+            {k: bytes(np.asarray(v, np.uint8).tobytes())
+             if not isinstance(v, (bytes, bytearray)) else bytes(v)
+             for k, v in objects.items()},
+            dead_osds=set(d.suspect))
+        # the cls branch of _client_op persists once after cls_call
+
+    def remove(self, names) -> None:
+        names = [names] if isinstance(names, str) else list(names)
+        # removal mutates the head too: preserve the newest snap's
+        # clone first or a cls-driven delete (refcount hitting zero)
+        # would destroy snapshot history
+        self._d._snap_guard(self._ps, self._be, names)
+        self._be.remove_objects(names,
+                                dead_osds=set(self._d.suspect))
+
+    @property
+    def obj_kv(self) -> dict:
+        return self._d.obj_kv.setdefault(self._ps, {})
+
+
 class OSDDaemon:
     """One OSD endpoint: local store + the PGs it primaries."""
 
@@ -441,6 +507,11 @@ class OSDDaemon:
         self.rpc = _Rpc(self.msgr, MStoreReply.type_id)
         self.osdmap: OSDMap | None = None
         self.backends: dict[int, object] = {}     # ps -> PGBackend
+        # per-PG snapshot + object-class state; rides _persist_meta so
+        # a primary takeover restores it with the rest of the PG
+        self.snapsets: dict[int, dict[str, list]] = {}
+        self.births: dict[int, dict[str, int]] = {}
+        self.obj_kv: dict[int, dict[str, dict]] = {}
         self.suspect: set[int] = set()            # osd ids (local view)
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
@@ -535,9 +606,12 @@ class OSDDaemon:
         """Ship the PG's metadata to every live shard as omap (the
         pg_log-rides-with-the-transaction discipline, ref:
         PGLog entries inside ObjectStore::Transaction)."""
+        import json as _json
         be = self.backends[ps]
         e = Encoder()
-        e.start(1, 1)
+        # v2 appends snapsets/births/cls-kv (compat 1: a v1 reader
+        # skips the tail via the section length)
+        e.start(2, 1)
         e.mapping(be.object_sizes, Encoder.string,
                   lambda en, v: en.u64(v))
         e.mapping(be.object_versions, Encoder.string,
@@ -545,6 +619,14 @@ class OSDDaemon:
         e.blob(be.pg_log.encode())
         e.list(be.shard_applied, lambda en, v: en.u64(v))
         e.list(be.acting, lambda en, v: en.i32(v))
+        e.mapping(self.snapsets.get(ps, {}), Encoder.string,
+                  lambda en, v: en.list(
+                      v, lambda e2, t: e2.u64(t[0]).u64(t[1])))
+        e.mapping(self.births.get(ps, {}), Encoder.string,
+                  lambda en, v: en.u64(v))
+        e.mapping(self.obj_kv.get(ps, {}), Encoder.string,
+                  lambda en, v: en.blob(
+                      _json.dumps(v, sort_keys=True).encode()))
         e.finish()
         blob = e.bytes()
         for s, osd in enumerate(be.acting):
@@ -584,7 +666,7 @@ class OSDDaemon:
         for blob in blobs:
             try:
                 d = Decoder(blob)
-                d.start(1)
+                d.start(2)
                 d.mapping(Decoder.string, Decoder.u64)
                 d.mapping(Decoder.string, Decoder.u64)
                 head = PGLog.decode(d.blob()).head
@@ -604,13 +686,22 @@ class OSDDaemon:
         be = self._make_backend(ps, acting)
         if blob is None:
             return be            # virgin PG: nothing written yet
+        import json as _json
         d = Decoder(blob)
-        d.start(1)
+        v = d.start(2)
         be.object_sizes = d.mapping(Decoder.string, Decoder.u64)
         be.object_versions = d.mapping(Decoder.string, Decoder.u64)
         be.pg_log = PGLog.decode(d.blob())
         applied = d.list(Decoder.u64)
         meta_acting = d.list(Decoder.i32)
+        if v >= 2:
+            self.snapsets[ps] = d.mapping(
+                Decoder.string,
+                lambda dd: dd.list(lambda e2: (e2.u64(), e2.u64())))
+            self.births[ps] = d.mapping(Decoder.string, Decoder.u64)
+            self.obj_kv[ps] = {
+                k: _json.loads(b) for k, b in d.mapping(
+                    Decoder.string, Decoder.blob).items()}
         d.finish()
         # adopt the RECORDED acting so the reconcile pass recovers any
         # slot whose OSD has since changed (collections for the new
@@ -644,12 +735,19 @@ class OSDDaemon:
         for ps in range(self.c.pg_num):
             acting = self._acting(ps)
             if not acting or acting[0] != self.osd_id:
-                self.backends.pop(ps, None)   # not ours (anymore)
+                if self.backends.pop(ps, None) is not None:
+                    # not ours (anymore): the new primary restores
+                    # snap/cls state from the PG metadata
+                    self.snapsets.pop(ps, None)
+                    self.births.pop(ps, None)
+                    self.obj_kv.pop(ps, None)
                 continue
             be = self.backends.get(ps)
             if be is None:
                 be = self._restore_backend(ps, acting)
                 self.backends[ps] = be
+            if be.acting == acting:
+                self._snap_trim(ps, be)   # snaps may have left the map
             if be.acting != acting:
                 # a changed slot whose old OSD is still up is a MOVE
                 # (CRUSH re-slotted a live member: copy the shard
@@ -722,7 +820,103 @@ class OSDDaemon:
         except (KeyError, OSError, ConnectionError):
             pass
 
+    SNAP_SEP = "@@snap."
+
+    def _check_snapc(self, snapc: int) -> None:
+        """Mutating client ops carry the client's snap context (ref:
+        MOSDOp's SnapContext): if the client knows a newer snap_seq
+        than this primary's map, executing now would skip the COW for
+        that snap — refuse so the client retries after the map
+        broadcast lands (there is no cross-connection ordering
+        between mon→osd maps and client→osd ops)."""
+        if snapc > self.osdmap.pools[1].snap_seq:
+            raise RuntimeError(
+                f"map lag: op snapc {snapc} > pool snap_seq "
+                f"{self.osdmap.pools[1].snap_seq} "
+                f"(epoch {self.osdmap.epoch})")
+
+    def _snap_guard(self, ps: int, be, names) -> None:
+        """Write-path COW (ref: PrimaryLogPG::make_writeable): before
+        the FIRST mutation of a head after each pool snap, preserve
+        its bytes as a clone object in the SAME PG (the reference
+        keeps clones in the head's PG too — same hash, different snap
+        id; the name suffix stands in for the snapid field)."""
+        seq = self.osdmap.pools[1].snap_seq
+        births = self.births.setdefault(ps, {})
+        sets_ = self.snapsets.setdefault(ps, {})
+        for name in sorted(names):
+            if self.SNAP_SEP in name:
+                continue            # clones never re-clone
+            if name not in be.object_sizes:
+                # creation: remember the snap era it was born in, so
+                # reads at older snaps correctly say "didn't exist"
+                births[name] = seq
+                continue
+            if births.get(name, 0) >= seq:
+                continue            # born after the newest snap
+            ss = sets_.setdefault(name, [])
+            if ss and ss[-1][0] >= seq:
+                continue            # newest snap already preserved
+            data = be.read_object(name, dead_osds=set(self.suspect))
+            clone = f"{name}{self.SNAP_SEP}{seq:08x}"
+            be.write_objects({clone: bytes(np.asarray(data, np.uint8)
+                                           .tobytes())},
+                             dead_osds=set(self.suspect))
+            ss.append((seq, births.get(name, 0)))
+
+    def _snap_resolve(self, ps: int, be, name: str, sid: int):
+        """State of `name` as of snap `sid`: the OLDEST clone with
+        seq >= sid that existed at the snap, else the unmodified head
+        (ref: PrimaryLogPG find_object_context SnapSet resolution)."""
+        if sid not in self.osdmap.pools[1].snaps:
+            raise KeyError(f"no snap {sid}")
+        ss = self.snapsets.get(ps, {}).get(name, [])
+        cands = [seq for seq, birth in ss if seq >= sid and birth < sid]
+        if cands:
+            clone = f"{name}{self.SNAP_SEP}{min(cands):08x}"
+            return be.read_object(clone, dead_osds=set(self.suspect))
+        if name in be.object_sizes \
+                and self.births.get(ps, {}).get(name, 0) < sid:
+            return be.read_object(name, dead_osds=set(self.suspect))
+        raise KeyError(f"{name!r} did not exist at snap {sid}")
+
+    def _snap_trim(self, ps: int, be) -> None:
+        """Drop clones no live snap reads anymore (the snaptrim role,
+        ref: PrimaryLogPG::trim_object) — driven off the committed
+        map's pool.snaps on every map change. Failure-tolerant: a
+        refused removal keeps the clone for the next trim."""
+        live = self.osdmap.pools[1].snaps
+        sets_ = self.snapsets.get(ps)
+        if not sets_:
+            return
+        changed = False
+        for name, ss in list(sets_.items()):
+            keep: list[tuple[int, int]] = []
+            prev = 0
+            for c, birth in ss:  # ascending; clone c covers snaps
+                # (prev_kept, c], minus snaps older than its birth era
+                if any(prev < s <= c and s > birth for s in live):
+                    keep.append((c, birth))
+                    prev = c
+                    continue
+                try:
+                    be.remove_objects(
+                        [f"{name}{self.SNAP_SEP}{c:08x}"],
+                        dead_osds=set(self.suspect))
+                    changed = True
+                except (KeyError, ConnectionError, OSError):
+                    keep.append((c, birth))
+                    prev = c
+            if keep:
+                sets_[name] = keep
+            else:
+                del sets_[name]
+                changed = True
+        if changed:
+            self._persist_meta(ps)
+
     def _client_op(self, kind: str, body: bytes) -> bytes:
+        import json as _json
         d = Decoder(body)
         ps = d.u32()
         be = self.backends.get(ps)
@@ -730,7 +924,9 @@ class OSDDaemon:
             raise RuntimeError(f"not primary for pg 1.{ps} "
                                f"(epoch {self.osdmap.epoch})")
         if kind == "write":
+            self._check_snapc(d.u64())
             objs = d.mapping(Decoder.string, Decoder.blob)
+            self._snap_guard(ps, be, objs)
             try:
                 be.write_objects(objs, dead_osds=set(self.suspect))
             except (ConnectionError, OSError):
@@ -744,6 +940,38 @@ class OSDDaemon:
             name = d.string()
             data = be.read_object(name, dead_osds=set(self.suspect))
             return np.asarray(data, np.uint8).tobytes()
+        if kind == "snap_read":
+            name, sid = d.string(), d.u64()
+            data = self._snap_resolve(ps, be, name, sid)
+            return np.asarray(data, np.uint8).tobytes()
+        if kind == "rollback":
+            # rados rollback: write the snap's state back onto the
+            # head — itself COW-protected, so the pre-rollback head
+            # is preserved if a newer snap needs it
+            self._check_snapc(d.u64())
+            name, sid = d.string(), d.u64()
+            data = self._snap_resolve(ps, be, name, sid)
+            self._snap_guard(ps, be, [name])
+            be.write_objects(
+                {name: np.asarray(data, np.uint8).tobytes()},
+                dead_osds=set(self.suspect))
+            self._persist_meta(ps)
+            return b""
+        if kind == "deep_scrub":
+            res = be.deep_scrub(dead_osds=set(self.suspect))
+            return _json.dumps(res, sort_keys=True).encode()
+        if kind == "repair":
+            res = be.repair_pg(dead_osds=set(self.suspect))
+            self._persist_meta(ps)
+            return _json.dumps(res, sort_keys=True).encode()
+        if kind == "cls":
+            from .objclass import cls_call
+            self._check_snapc(d.u64())
+            name, cname, method = d.string(), d.string(), d.string()
+            out = cls_call(_PgClsView(self, ps, be), name, cname,
+                           method, d.blob())
+            self._persist_meta(ps)   # kv mutations ride the metadata
+            return out
         raise ValueError(f"unknown client op {kind!r}")
 
     def _mark_suspects(self, be) -> None:
@@ -840,6 +1068,9 @@ class OSDDaemon:
         fresh.msgr = Messenger(self.name, secret=self.c.secret)
         fresh.rpc = _Rpc(fresh.msgr, MStoreReply.type_id)
         fresh.backends = {}
+        fresh.snapsets = {}
+        fresh.births = {}
+        fresh.obj_kv = {}
         fresh.suspect = set()
         fresh._last_pong = {}
         fresh._reported = set()
@@ -911,6 +1142,7 @@ class MonDaemon:
         m.register_handler(MMonCommit.type_id, self._on_commit)
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
+        m.register_handler(MPoolOp.type_id, self._on_pool_op)
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
         self._hb = threading.Thread(target=self._mon_hb_loop,
@@ -1045,6 +1277,21 @@ class MonDaemon:
             self._inflight = None
             self._accepts = set()
 
+    def _abandon_below_locked(self, pn: int) -> None:
+        """Caller holds the lock, having just promised `pn`. ANY of
+        our proposer rounds below it — held pn, outstanding collect,
+        in-flight begin — can no longer win and must die NOW: a
+        collect completed after the higher promise would let us
+        begin/self-accept BELOW our own promise, downgrading the
+        accepted-pn of a value a later quorum relies on (acceptor
+        monotonicity is what the safety argument rests on)."""
+        if (self._pn and self._pn < pn) \
+                or (self._collecting is not None
+                    and self._collecting[0] < pn) \
+                or (self._inflight is not None
+                    and self._inflight[0] < pn):
+            self._abandon_locked()
+
     def _abandon_locked(self) -> None:
         """Caller holds the lock. Drop proposer state; REQUEUE any
         in-flight mutations at the front of the pipe (each mutate
@@ -1067,10 +1314,7 @@ class MonDaemon:
             self._pn_seen = max(self._pn_seen, msg.pn)
             if msg.pn >= self._promised:
                 self._promised = msg.pn
-                if self._pn and self._pn < msg.pn:
-                    # we were proposing at a lower pn: our begins can
-                    # no longer win — stand down, requeue mutations
-                    self._abandon_locked()
+                self._abandon_below_locked(msg.pn)
                 apn, aep, ablob = self._accepted or (0, 0, b"")
                 cep, cblob = self._committed_pair()
                 reply = MMonLast(msg.pn, apn, aep, ablob, cep, cblob)
@@ -1095,8 +1339,7 @@ class MonDaemon:
                                  *self._committed_pair())
             else:
                 self._promised = msg.pn
-                if self._pn and self._pn < msg.pn:
-                    self._abandon_locked()
+                self._abandon_below_locked(msg.pn)
                 self._accepted = (msg.pn, msg.epoch, msg.map_bytes)
                 reply = MMonAcceptPn(msg.pn, msg.epoch)
         try:
@@ -1156,6 +1399,11 @@ class MonDaemon:
             col = self._collecting
             if col is None or col[0] != msg.pn:
                 return           # stale round
+            if col[0] < self._promised:
+                # we promised a rival's higher pn mid-collect: this
+                # round is dead (belt to _abandon_below_locked)
+                self._abandon_locked()
+                return
             col[1].add(peer)
             self._fold_committed_locked(msg.committed_epoch,
                                         msg.committed_blob)
@@ -1327,6 +1575,20 @@ class MonDaemon:
                 m.mark_in(osd)
         self._commit(mutate)
 
+    def _on_pool_op(self, peer: str, msg: MPoolOp) -> None:
+        if self.osdmap is None:
+            return
+        kind, snap = msg.kind, msg.snap_name
+        self.c.log(f"{self.name}: pool op {kind} {snap!r} from {peer}")
+
+        def mutate(m: OSDMap) -> None:
+            # both are name-idempotent: a duplicate rebases to a no-op
+            if kind == "mksnap":
+                m.pool_mksnap(1, snap)
+            elif kind == "rmsnap":
+                m.pool_rmsnap(1, snap)
+        self._commit(mutate)
+
     def kill(self) -> None:
         self._stop.set()
         self.msgr.shutdown()
@@ -1372,10 +1634,23 @@ class Client:
                 if rep.ok:
                     return rep.blob
                 last = rep.err
+                if rep.err.startswith("ClsError:"):
+                    # a class method REFUSED the op (EBUSY-style):
+                    # deterministic, retrying can't change the answer
+                    from .objclass import ClsError
+                    raise ClsError(rep.err[9:])
             except (ConnectionError, KeyError, OSError) as err:
                 last = str(err)
             time.sleep(retry_sleep)   # map may be in flight; retarget
+        if str(last).startswith("KeyError:"):
+            raise KeyError(str(last)[9:])
         raise ConnectionError(f"op {kind} pg 1.{ps} failed: {last}")
+
+    def _snapc(self) -> int:
+        """The client's snap context (ref: MOSDOp SnapContext): every
+        mutating op carries the newest snap_seq this client has seen
+        so a map-lagging primary refuses rather than skipping COW."""
+        return self.osdmap.pools[1].snap_seq
 
     def write(self, objects: dict[str, bytes]) -> None:
         by_pg: dict[int, dict[str, bytes]] = {}
@@ -1384,13 +1659,69 @@ class Client:
             by_pg.setdefault(ps, {})[name] = bytes(data)
         for ps, group in by_pg.items():
             self._op("write", ps,
-                     lambda e, g=group: e.mapping(
+                     lambda e, g=group: e.u64(self._snapc()).mapping(
                          g, Encoder.string, Encoder.blob))
 
     def read(self, name: str) -> bytes:
         ps = self.osdmap.object_to_pg(1, name)[1]
         return self._op("read", ps,
                         lambda e: e.string(name))
+
+    # -- pool snapshots over the wire ----------------------------------------
+
+    def _pool_op(self, kind: str, snap: str) -> None:
+        for mon in self.c.mon_names():
+            try:
+                self.msgr.send(mon, MPoolOp(kind, snap))
+            except (KeyError, OSError, ConnectionError):
+                pass
+
+    def snap_create(self, name: str, timeout: float = 15.0) -> int:
+        """Named pool snapshot: monitor-quorum-committed (the snap
+        rides pg_pool_t in the OSDMap), observed via this client's
+        map subscription. Returns the snap id."""
+        self._pool_op("mksnap", name)
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and name in self.osdmap.pools[1].snaps.values(),
+            timeout, f"snap {name!r} committed")
+        return next(s for s, n in self.osdmap.pools[1].snaps.items()
+                    if n == name)
+
+    def snap_remove(self, name: str, timeout: float = 15.0) -> None:
+        self._pool_op("rmsnap", name)
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and name not in self.osdmap.pools[1].snaps.values(),
+            timeout, f"snap {name!r} removed")
+
+    def snap_read(self, name: str, sid: int) -> bytes:
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        return self._op("snap_read", ps,
+                        lambda e: e.string(name).u64(sid), retries=6)
+
+    def snap_rollback(self, name: str, sid: int) -> None:
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        self._op("rollback", ps,
+                 lambda e: e.u64(self._snapc()).string(name).u64(sid),
+                 retries=6)
+
+    # -- scrub / repair / object classes over the wire -----------------------
+
+    def deep_scrub(self, ps: int) -> dict:
+        import json as _json
+        return _json.loads(self._op("deep_scrub", ps, lambda e: None))
+
+    def repair_pg(self, ps: int) -> dict:
+        import json as _json
+        return _json.loads(self._op("repair", ps, lambda e: None))
+
+    def cls_exec(self, name: str, cls: str, method: str,
+                 inp: bytes = b"") -> bytes:
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        return self._op("cls", ps,
+                        lambda e: e.u64(self._snapc()).string(name)
+                        .string(cls).string(method).blob(inp))
 
     def shutdown(self) -> None:
         self.msgr.shutdown()
@@ -1563,12 +1894,25 @@ class StandaloneCluster:
         self.mons[rank].kill()
 
     def revive_mon(self, rank: int) -> None:
-        """Restart a monitor: fresh endpoint, then a store sync from
-        the surviving peers BEFORE it may lead — a stale-map leader
-        could commit an epoch the cluster already passed."""
+        """Restart a monitor: fresh endpoint, DURABLE Paxos state.
+        The reference mon's acceptor state (promised pn, accepted-but-
+        uncommitted value) and committed map live in its on-disk store
+        and survive a restart — modeled here by carrying them from the
+        killed daemon. Forgetting an acceptance would let two bodies
+        commit for one epoch: the accept quorum that committed X must
+        still REMEMBER X when a later collect quorum intersects it.
+        A store sync from surviving peers then catches the committed
+        map up BEFORE it may lead."""
         self.log(f"revive mon.{rank}")
         old = self.mons[rank]
-        fresh = MonDaemon(rank, self, osdmap=None)
+        peers_epoch = max(
+            (m.osdmap.epoch for m in self.mons
+             if m is not old and not m._stop.is_set()
+             and m.osdmap is not None), default=0)
+        fresh = MonDaemon(rank, self, osdmap=old.osdmap)
+        fresh._promised = old._promised
+        fresh._accepted = old._accepted
+        fresh._pn_seen = old._pn_seen
         self.mons[rank] = fresh
         self._wire_peers()
         for mon in self.mons:
@@ -1577,12 +1921,13 @@ class StandaloneCluster:
                     fresh.msgr.send(mon.name, MMonSyncReq(0))
                 except (KeyError, OSError, ConnectionError):
                     pass
-        # wait for the sync to land (peers answer with their map);
-        # if no peer is alive there is no quorum anyway and the
-        # revived mon stays follower-without-map until one appears
+        # wait for the sync to land (peers answer with their committed
+        # map); if no peer is alive there is no quorum anyway and the
+        # revived mon stays where its own store left it
         if any(not m._stop.is_set() for m in self.mons
                if m is not fresh):
-            self._wait(lambda: fresh.osdmap is not None, 10,
+            self._wait(lambda: fresh.osdmap is not None
+                       and fresh.osdmap.epoch >= peers_epoch, 10,
                        f"mon.{rank} store sync")
         del old
 
