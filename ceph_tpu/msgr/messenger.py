@@ -36,6 +36,17 @@ and correctness-first. Implemented faithfully:
   (never reused under one key); a tampered frame fails the GCM tag
   and kills the session exactly like a crc mismatch — replay heals.
 
+* COMPRESSION (ref: ProtocolV2 compression handshake +
+  src/compressor/): endpoints offer an algorithm at handshake;
+  active only when both offer the same one (a mismatch downgrades to
+  plain — compression is an optimization, unlike the security mode).
+  Per-message: payloads under a min size or that don't shrink ship
+  plain, flagged in the type field's high bit. Composes with both
+  modes — compression happens before the crc/seal covers the bytes,
+  a garbled compressed body kills the session like a crc mismatch,
+  and in secure mode the negotiated byte is bound into the auth
+  proof so an active tamperer cannot strip it.
+
 Threading model: one reader thread per connection + locked writers
 (the reference runs epoll worker threads; blocking threads keep this
 deterministic and dependency-free).
@@ -57,6 +68,20 @@ MODE_CRC = 0
 MODE_SECURE = 1
 _GCM_TAG = 16
 _NONCE = 12
+
+# on-wire compression (ref: src/msg/async/ProtocolV2.cc compression
+# handshake + src/compressor/): negotiated per connection, composes
+# with BOTH crc and secure mode (the payload is compressed before the
+# crc/seal covers it, so integrity always checks the wire bytes).
+# The frame's type field carries the per-message flag in its high bit
+# — small or incompressible payloads ship plain on a compressed
+# connection, exactly the reference's min-size behavior.
+COMP_NONE = 0
+COMP_ZLIB = 1
+_COMP_IDS = {None: COMP_NONE, "zlib": COMP_ZLIB}
+_COMP_FLAG = 0x8000
+_COMPRESS_MIN = 128          # don't bloat tiny frames
+_DECOMP_MAX = 1 << 26        # decompression-bomb ceiling (= frame cap)
 
 _MSG_TYPES: dict[int, type] = {}
 
@@ -112,17 +137,22 @@ _PREFIX_CLI = b"cli\x00"
 
 def _auth_proof(secret: bytes, role: bytes, nonce_c: bytes,
                 nonce_s: bytes, name: str,
-                seen_c: int, seen_s: int) -> bytes:
-    """The proofs bind EVERY plaintext handshake field — name and both
-    last-seen sequence numbers — not just the nonces: an unauth'd
-    peer_seen would let an active tamperer inflate it and silently
-    flush the victim's unacked replay queue."""
+                seen_c: int, seen_s: int, offers: bytes) -> bytes:
+    """The proofs bind EVERY plaintext handshake field — name, both
+    last-seen sequence numbers, and both sides' RAW compression
+    offers — not just the nonces: an unauth'd peer_seen would let an
+    active tamperer inflate it and silently flush the victim's
+    unacked replay queue. The offers must be bound raw (client's,
+    server's — not the derived result): a tamperer flipping both
+    offer bytes to 'none' would leave the negotiated RESULT matching
+    on both sides, so only the offers themselves expose the strip."""
     import hashlib
     import hmac
     return hmac.new(secret,
                     role + nonce_c + nonce_s + name.encode()
                     + seen_c.to_bytes(8, "little")
-                    + seen_s.to_bytes(8, "little"),
+                    + seen_s.to_bytes(8, "little")
+                    + offers,
                     hashlib.sha256).digest()
 
 
@@ -133,6 +163,9 @@ def register_message(cls):
         raise ValueError(f"message type {tid} already registered")
     if tid == ACK_TYPE:
         raise ValueError("type 0 is reserved for ACK")
+    if tid >= _COMP_FLAG:
+        raise ValueError("type ids above 0x7FFF collide with the "
+                         "compression flag bit")
     _MSG_TYPES[tid] = cls
     return cls
 
@@ -159,17 +192,31 @@ class _Conn:
     """One live socket + replay state toward one peer."""
 
     def __init__(self, sock: socket.socket, box: _SecureBox | None = None,
-                 peer_inst: bytes = b""):
+                 peer_inst: bytes = b"", comp: int = COMP_NONE,
+                 stats: dict | None = None,
+                 stats_lock: threading.Lock | None = None):
         self.sock = sock
         self.wlock = threading.Lock()
         self.alive = True
         self.box = box
+        self.comp = comp            # negotiated compression algo id
+        self.stats = stats if stats is not None else {}
+        self.stats_lock = stats_lock or threading.Lock()
         # which peer INCARNATION this conn authenticated: frames from
         # a conn whose incarnation is no longer current must never
         # reach the session state (see _read_loop)
         self.peer_inst = peer_inst
 
     def send_frame(self, seq: int, type_id: int, payload: bytes) -> None:
+        if self.comp == COMP_ZLIB and len(payload) >= _COMPRESS_MIN:
+            import zlib
+            packed = zlib.compress(payload, 1)
+            if len(packed) < len(payload):   # only when it helps
+                payload = packed
+                type_id |= _COMP_FLAG
+                with self.stats_lock:
+                    self.stats["tx_compressed"] = \
+                        self.stats.get("tx_compressed", 0) + 1
         plain = struct.pack("<QH", seq, type_id) + payload
         if self.box is None:
             frame = struct.pack("<I", len(plain)) + plain
@@ -202,14 +249,24 @@ class Messenger:
     after the automatic reconnect (send() never silently drops)."""
 
     def __init__(self, name: str, host: str = "127.0.0.1",
-                 secret: bytes | None = None):
+                 secret: bytes | None = None,
+                 compress: str | None = None):
         """`secret` switches the endpoint to SECURE mode: every
         connection mutually authenticates against the shared secret
         and encrypts frames with a per-connection AES-GCM key. A
         secure endpoint refuses crc peers and vice versa (strict
-        negotiation — no downgrade path)."""
+        negotiation — no downgrade path). `compress` ("zlib") offers
+        per-connection compression: active only when BOTH endpoints
+        offer the same algorithm (an optimization, so a mismatch
+        downgrades to plain rather than refusing); in secure mode the
+        negotiated byte is bound into the auth proof so it cannot be
+        tampered down."""
         self.name = name
         self.secret = secret
+        self.compress = compress
+        self._comp_id = _COMP_IDS[compress]
+        self.stats: dict[str, int] = {}
+        self._stats_lock = threading.Lock()
         self.mode = MODE_SECURE if secret is not None else MODE_CRC
         # instance cookie (ref: ProtocolV2 client/server cookies +
         # RESET_SESSION): a rebooted process reuses its NAME but not
@@ -299,6 +356,11 @@ class Messenger:
                 # silently downgrading an endpoint that demands secure
                 sock.close()
                 return
+            peer_comp = self._recv_exact(sock, 1)[0]
+            # compression is an optimization: on iff both offer the
+            # same algorithm, else plain (no refusal)
+            comp = self._comp_id if peer_comp == self._comp_id \
+                else COMP_NONE
             nonce_c = b""
             if self.mode == MODE_SECURE:
                 nonce_c = self._recv_exact(sock, 16)
@@ -314,19 +376,20 @@ class Messenger:
                 last_seen = 0 if fresh_inst \
                     else self._in_seq.get(peer, 0)
             sock.sendall(struct.pack("<Q", last_seen)
-                         + bytes([self.mode]))
+                         + bytes([self.mode]) + bytes([self._comp_id]))
             if self.mode == MODE_SECURE:
                 import os as _os
                 nonce_s = _os.urandom(16)
+                offers = bytes([peer_comp, self._comp_id])
                 sock.sendall(nonce_s + _auth_proof(
                     self.secret, b"srv",
                     peer_inst + nonce_c, self.instance_nonce + nonce_s,
-                    self.name, peer_seen, last_seen))
+                    self.name, peer_seen, last_seen, offers))
                 proof_c = self._recv_exact(sock, 32)
                 want = _auth_proof(
                     self.secret, b"cli",
                     peer_inst + nonce_c, self.instance_nonce + nonce_s,
-                    peer, peer_seen, last_seen)
+                    peer, peer_seen, last_seen, offers)
                 import hmac as _hmac
                 if not _hmac.compare_digest(proof_c, want):
                     raise ConnectionError(f"auth failure from {peer}")
@@ -337,7 +400,8 @@ class Messenger:
             sock.close()
             return
         self._check_incarnation(peer, peer_inst)   # post-validation
-        conn = _Conn(sock, box, peer_inst=peer_inst)
+        conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
+                     stats=self.stats, stats_lock=self._stats_lock)
         # adopt+replay must be one atomic step under the peer lock:
         # published-but-not-yet-replayed is a window where a concurrent
         # send() (which holds only the peer lock) could emit a NEW
@@ -387,7 +451,8 @@ class Messenger:
                 import os as _os
                 nonce_c = _os.urandom(16)
             sock.sendall(struct.pack("<Q", my_seen)
-                         + bytes([self.mode]) + nonce_c)
+                         + bytes([self.mode]) + bytes([self._comp_id])
+                         + nonce_c)
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
                 raise ConnectionError(f"bad banner from {peer}")
@@ -400,27 +465,32 @@ class Messenger:
                 raise ConnectionError(
                     f"mode mismatch with {peer}: "
                     f"ours={self.mode} theirs={peer_mode}")
+            peer_comp = self._recv_exact(sock, 1)[0]
+            comp = self._comp_id if peer_comp == self._comp_id \
+                else COMP_NONE
             box = None
             if self.mode == MODE_SECURE:
                 nonce_s = self._recv_exact(sock, 16)
                 proof_s = self._recv_exact(sock, 32)
                 import hmac as _hmac
+                offers = bytes([self._comp_id, peer_comp])
                 want = _auth_proof(
                     self.secret, b"srv",
                     self.instance_nonce + nonce_c, peer_inst + nonce_s,
-                    peer, my_seen, peer_seen)
+                    peer, my_seen, peer_seen, offers)
                 if not _hmac.compare_digest(proof_s, want):
                     sock.close()
                     raise ConnectionError(f"auth failure from {peer}")
                 sock.sendall(_auth_proof(
                     self.secret, b"cli",
                     self.instance_nonce + nonce_c, peer_inst + nonce_s,
-                    self.name, my_seen, peer_seen))
+                    self.name, my_seen, peer_seen, offers))
                 box = _SecureBox(
                     _derive_key(self.secret, nonce_c, nonce_s),
                     tx_prefix=_PREFIX_CLI, rx_prefix=_PREFIX_SRV)
             self._check_incarnation(peer, peer_inst)  # post-validation
-            conn = _Conn(sock, box, peer_inst=peer_inst)
+            conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
+                         stats=self.stats, stats_lock=self._stats_lock)
             if not self._adopt(peer, conn, inbound=False):
                 # a crossing dial won (we're the non-designated side):
                 # the WINNING connection carries the session now — put
@@ -575,6 +645,29 @@ class Messenger:
                     body = conn.box.open(body, raw_len)
                 seq, tid = struct.unpack("<QH", body[:10])
                 payload = body[10:]
+                if tid & _COMP_FLAG:
+                    import zlib
+                    try:
+                        o = zlib.decompressobj()
+                        payload = o.decompress(payload, _DECOMP_MAX)
+                        if o.unconsumed_tail:
+                            raise ConnectionError(
+                                "decompressed frame exceeds cap")
+                        if not o.eof or o.unused_data:
+                            # a TRUNCATED stream decompresses without
+                            # error — delivering the partial payload
+                            # would ack-and-lose the message
+                            raise ConnectionError(
+                                "compressed frame truncated")
+                    except zlib.error:
+                        # garbled compressed body: kill the session
+                        # exactly like a crc mismatch; replay heals
+                        raise ConnectionError(
+                            "compressed frame corrupt")
+                    tid &= _COMP_FLAG - 1
+                    with self._stats_lock:
+                        self.stats["rx_compressed"] = \
+                            self.stats.get("rx_compressed", 0) + 1
                 # incarnation fencing: a conn authenticated against a
                 # peer incarnation that is no longer current must not
                 # touch session state — a dying incarnation's buffered

@@ -503,7 +503,8 @@ class OSDDaemon:
         self.c = cluster
         self.name = f"osd.{osd_id}"
         self.store = cluster.make_store(osd_id)
-        self.msgr = Messenger(self.name, secret=cluster.secret)
+        self.msgr = Messenger(self.name, secret=cluster.secret,
+                              compress=cluster.compress)
         self.rpc = _Rpc(self.msgr, MStoreReply.type_id)
         self.osdmap: OSDMap | None = None
         self.backends: dict[int, object] = {}     # ps -> PGBackend
@@ -1065,7 +1066,8 @@ class OSDDaemon:
         self.store.remount()
         fresh = OSDDaemon.__new__(OSDDaemon)
         fresh.__dict__.update(self.__dict__)
-        fresh.msgr = Messenger(self.name, secret=self.c.secret)
+        fresh.msgr = Messenger(self.name, secret=self.c.secret,
+                               compress=self.c.compress)
         fresh.rpc = _Rpc(fresh.msgr, MStoreReply.type_id)
         fresh.backends = {}
         fresh.snapsets = {}
@@ -1105,7 +1107,8 @@ class MonDaemon:
         self.rank = rank
         self.c = cluster
         self.name = f"mon.{rank}"
-        self.msgr = Messenger(self.name, secret=cluster.secret)
+        self.msgr = Messenger(self.name, secret=cluster.secret,
+                              compress=cluster.compress)
         self.osdmap = osdmap            # the COMMITTED map, only
         # -- acceptor state (the peon role) --
         self._promised = 0              # highest pn promised
@@ -1600,7 +1603,8 @@ class Client:
 
     def __init__(self, cluster: "StandaloneCluster", name: str = "client"):
         self.c = cluster
-        self.msgr = Messenger(name, secret=cluster.secret)
+        self.msgr = Messenger(name, secret=cluster.secret,
+                              compress=cluster.compress)
         self.rpc = _Rpc(self.msgr, MOSDOpReply.type_id)
         self.osdmap: OSDMap | None = None
         self._lock = threading.Lock()
@@ -1735,6 +1739,7 @@ class StandaloneCluster:
                  pg_num: int = 4, store: str = "mem",
                  store_dir: str | None = None,
                  secret: bytes | None = None,
+                 compress: str | None = None,
                  hb_interval: float = 0.25, hb_grace: float = 1.2,
                  min_reporters: int = 2, op_timeout: float = 8.0,
                  chunk_size: int = 256, verbose: bool | None = None):
@@ -1746,6 +1751,7 @@ class StandaloneCluster:
         from ..ec.interface import profile_from_string
         from ..ec.registry import factory
         self.secret = secret
+        self.compress = compress
         self.hb_interval, self.hb_grace = hb_interval, hb_grace
         self.min_reporters = min_reporters
         self.op_timeout = op_timeout

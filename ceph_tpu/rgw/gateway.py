@@ -21,9 +21,10 @@ maps onto this framework:
   objects of their own; complete writes a MANIFEST the GET path
   follows (RGW's multipart manifest), so completion is O(parts), not
   a data rewrite.
-* VERSIONING/S3-AUTH are out of scope: snapshots already provide
-  point-in-time reads at the pool layer, and the wire's AES-GCM +
-  shared-secret handshake is this framework's authn story.
+* S3-AUTH lives in auth.py (SigV4-shaped canonical requests, HMAC
+  key-derivation chain, skew window, replay cache) as a verifying
+  front over this gateway. VERSIONING stays out of scope: snapshots
+  already provide point-in-time reads at the pool layer.
 
 Everything routes through librados/striper, so EC encode fan-out,
 snapshots' COW, scrub, recovery, and PG splits all apply to gateway

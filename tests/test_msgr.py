@@ -362,3 +362,98 @@ class TestIncarnation:
         finally:
             a.shutdown()
             b.shutdown()
+
+
+class TestCompression:
+    """Per-connection compression negotiation (ref: ProtocolV2
+    compression handshake, src/compressor/): the {crc, secure} x
+    {plain, compressed} matrix, mismatch downgrade, and tamper."""
+
+    SECRET = b"0123456789abcdef0123456789abcdef"
+    BIG = "x" * 4096          # compressible payload over the min size
+
+    def _pair(self, secret=None, comp_a="zlib", comp_b="zlib"):
+        a = Messenger("osd.0", secret=secret, compress=comp_a)
+        b = Messenger("osd.1", secret=secret, compress=comp_b)
+        a.add_peer("osd.1", b.addr)
+        b.add_peer("osd.0", a.addr)
+        return a, b
+
+    @pytest.mark.parametrize("secret", [None, SECRET],
+                             ids=["crc", "secure"])
+    def test_roundtrip_compressed(self, secret):
+        a, b = self._pair(secret=secret)
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.note))
+            for i in range(4):
+                a.send("osd.1", Ping(i, note=self.BIG))
+            assert wait_for(lambda: len(got) == 4)
+            assert all(n == self.BIG for n in got)
+            assert a.stats.get("tx_compressed", 0) >= 4
+            assert b.stats.get("rx_compressed", 0) >= 4
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    @pytest.mark.parametrize("secret", [None, SECRET],
+                             ids=["crc", "secure"])
+    def test_small_frames_ship_plain(self, secret):
+        a, b = self._pair(secret=secret)
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(7))       # tiny: below _COMPRESS_MIN
+            assert wait_for(lambda: got == [7])
+            assert a.stats.get("tx_compressed", 0) == 0
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_mismatch_downgrades_to_plain(self):
+        # unlike the security mode, an asymmetric offer must NOT
+        # refuse the connection — compression is an optimization
+        a, b = self._pair(comp_a="zlib", comp_b=None)
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.note))
+            a.send("osd.1", Ping(1, note=self.BIG))
+            assert wait_for(lambda: len(got) == 1)
+            assert got[0] == self.BIG
+            assert a.stats.get("tx_compressed", 0) == 0
+            assert b.stats.get("rx_compressed", 0) == 0
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_tampered_compressed_frame_kills_session_then_heals(self):
+        from ceph_tpu.msgr.messenger import _COMP_FLAG
+        a, b = self._pair()
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(1, note=self.BIG))
+            assert wait_for(lambda: got == [1])
+            # a frame flagged compressed whose body is NOT valid zlib:
+            # crc is correct, so only the decompressor can object
+            conn = next(iter(a._conns.values()))
+            body = struct.pack("<QH", 99, Ping.type_id | _COMP_FLAG) \
+                + b"not-zlib-data"
+            frame = struct.pack("<I", len(body)) + body
+            import zlib as _z
+            from ceph_tpu.msgr.messenger import _crc
+            frame += struct.pack("<I", _crc(frame))
+            with conn.wlock:
+                conn.sock.sendall(frame)
+            assert wait_for(lambda: not conn.alive)
+            assert got == [1]              # nothing dispatched
+            a.send("osd.1", Ping(2, note=self.BIG))
+            assert a.flush("osd.1", timeout=15)
+            assert wait_for(lambda: got == [1, 2])
+        finally:
+            a.shutdown()
+            b.shutdown()

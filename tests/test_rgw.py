@@ -191,3 +191,127 @@ class TestMultipart:
         gw.create_bucket("b")
         with pytest.raises(GatewayError, match="NoSuchUpload"):
             gw.complete_multipart("b", "k", "u0000000000000000")
+
+
+class TestS3Auth:
+    """S3 request authentication (ref: src/rgw/rgw_auth_s3.cc AWSv4
+    canonical request + signing-key chain + skew grace): signed
+    round-trips, every rejection mode, and replay."""
+
+    def _authed(self, clock=None):
+        import time as _t
+        c, gw = mk()
+        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
+        users = UserStore()
+        access, secret = users.create_user("alice")
+        agw = AuthedGateway(gw, users, clock=clock or _t.time)
+        return c, agw, S3Client(agw, access, secret,
+                                clock=clock or _t.time), (access, secret)
+
+    def test_signed_roundtrip_full_surface(self):
+        c, agw, s3, _ = self._authed()
+        s3.create_bucket("b")
+        etag = s3.put_object("b", "k", b"hello s3 auth" * 100)
+        assert s3.get_object("b", "k") == b"hello s3 auth" * 100
+        assert s3.head_object("b", "k")["etag"] == etag
+        assert [e["key"] for e in s3.list_objects("b")["entries"]] \
+            == ["k"]
+        # ranged GET rides signed params
+        assert s3.get_object("b", "k", offset=6, length=2) == b"s3"
+        # multipart, signed end to end
+        uid = s3.initiate_multipart("b", "big")
+        s3.upload_part("b", "big", uid, 1, b"A" * 70000)
+        s3.upload_part("b", "big", uid, 2, b"B" * 50000)
+        s3.complete_multipart("b", "big", uid)
+        got = s3.get_object("b", "big")
+        assert got == b"A" * 70000 + b"B" * 50000
+        s3.delete_object("b", "big")
+        s3.delete_object("b", "k")
+        s3.delete_bucket("b")
+        assert s3.list_buckets() == []
+
+    def test_wrong_secret_rejected(self):
+        from ceph_tpu.rgw import S3Client, SignatureDoesNotMatch
+        c, agw, s3, (access, secret) = self._authed()
+        s3.create_bucket("b")
+        evil = S3Client(agw, access, "not-the-secret")
+        with pytest.raises(SignatureDoesNotMatch):
+            evil.put_object("b", "k", b"forged")
+
+    def test_unknown_access_key_rejected(self):
+        from ceph_tpu.rgw import AccessDenied, S3Client
+        c, agw, s3, _ = self._authed()
+        ghost = S3Client(agw, "AKDOESNOTEXIST", "whatever")
+        with pytest.raises(AccessDenied, match="InvalidAccessKeyId"):
+            ghost.list_buckets()
+
+    def test_clock_skew_rejected_before_signature_math(self):
+        import time as _t
+        from ceph_tpu.rgw import RequestTimeTooSkewed, S3Client
+        c, agw, s3, (access, secret) = self._authed()
+        drifted = S3Client(agw, access, secret,
+                           clock=lambda: _t.time() - 1200.0)
+        with pytest.raises(RequestTimeTooSkewed):
+            drifted.list_buckets()
+
+    def test_replay_rejected(self):
+        import time as _t
+        from ceph_tpu.rgw import AccessDenied
+        from ceph_tpu.rgw.auth import amz_date, sign
+        c, agw, s3, (access, secret) = self._authed()
+        s3.create_bucket("b")
+        # capture one signed request verbatim, then re-send it
+        date = amz_date(_t.time())
+        nonce = "cafecafecafecafe"
+        sig = sign(secret, date, "put_object", "b", "k", nonce, {},
+                   b"pay once")
+        agw.call(access, date, sig, "put_object", bucket="b", key="k",
+                 nonce=nonce, payload=b"pay once")
+        with pytest.raises(AccessDenied, match="replay"):
+            agw.call(access, date, sig, "put_object", bucket="b",
+                     key="k", nonce=nonce, payload=b"pay once")
+        # but the SAME logical op with a fresh nonce signs differently
+        # and goes through (a legit duplicate isn't a replay)
+        s3.put_object("b", "k", b"pay once")
+
+    def test_tampered_params_break_the_signature(self):
+        import time as _t
+        from ceph_tpu.rgw import SignatureDoesNotMatch
+        from ceph_tpu.rgw.auth import amz_date, sign
+        c, agw, s3, (access, secret) = self._authed()
+        s3.create_bucket("b")
+        s3.put_object("b", "secret-doc", b"classified")
+        date = amz_date(_t.time())
+        sig = sign(secret, date, "get_object", "b", "public-doc",
+                   "n0", {}, b"")
+        # swap the signed key for another: signature must not cover it
+        with pytest.raises(SignatureDoesNotMatch):
+            agw.call(access, date, sig, "get_object", bucket="b",
+                     key="secret-doc", nonce="n0")
+        # swap the OP with everything else intact: also rejected
+        with pytest.raises(SignatureDoesNotMatch):
+            agw.call(access, date, sig, "delete_object", bucket="b",
+                     key="public-doc", nonce="n0")
+
+    def test_cross_user_bucket_isolation(self):
+        from ceph_tpu.rgw import AccessDenied, S3Client
+        c, agw, alice, _ = self._authed()
+        bob_ak, bob_sk = agw._users.create_user("bob")
+        bob = S3Client(agw, bob_ak, bob_sk)
+        alice.create_bucket("alices")
+        alice.put_object("alices", "doc", b"hers")
+        # bob's signature is valid under HIS key — but the bucket
+        # belongs to alice: authorization must refuse every op
+        for attempt in (
+                lambda: bob.get_object("alices", "doc"),
+                lambda: bob.put_object("alices", "doc", b"overwrite"),
+                lambda: bob.delete_object("alices", "doc"),
+                lambda: bob.delete_bucket("alices"),
+                lambda: bob.list_objects("alices")):
+            with pytest.raises(AccessDenied, match="another user"):
+                attempt()
+        # and alice's bucket doesn't leak into bob's listing
+        bob.create_bucket("bobs")
+        assert bob.list_buckets() == ["bobs"]
+        assert alice.list_buckets() == ["alices"]
+        assert alice.get_object("alices", "doc") == b"hers"
