@@ -311,20 +311,24 @@ def _pipeline(enc_fn, pool_arr):
     return lambda n: int(jax.device_get(pipe(pool_arr, n)))
 
 
-def _slope(run, bytes_per_iter):
-    """Time run(N1) and run(N2) (warmed, best of REPS); return
+def _slope(run, bytes_per_iter, n1=None, n2=None, reps=None):
+    """Time run(n1) and run(n2) (warmed, best of reps); return
     (GB/s, t1, t2). If jitter leaves no usable slope (t2 <= t1), fall
-    back to the latency-inclusive rate bytes*N2/t2 — a strict lower
+    back to the latency-inclusive rate bytes*n2/t2 — a strict lower
     bound on real throughput — rather than publishing a negative or
-    inflated number."""
-    for n in (N1, N2):
+    inflated number. (bytes_per_iter may be any unit — bench_crush
+    passes placements and scales the returned "GB/s" by 1e9.)"""
+    n1 = N1 if n1 is None else n1
+    n2 = N2 if n2 is None else n2
+    reps = REPS if reps is None else reps
+    for n in (n1, n2):
         run(n)  # compile + warm both program sizes
-    t1 = min(_timed(run, N1) for _ in range(REPS))
-    t2 = min(_timed(run, N2) for _ in range(REPS))
+    t1 = min(_timed(run, n1) for _ in range(reps))
+    t2 = min(_timed(run, n2) for _ in range(reps))
     if t2 > t1 * 1.02:
-        gbps = bytes_per_iter * (N2 - N1) / (t2 - t1) / 1e9
+        gbps = bytes_per_iter * (n2 - n1) / (t2 - t1) / 1e9
     else:
-        gbps = bytes_per_iter * N2 / t2 / 1e9
+        gbps = bytes_per_iter * n2 / t2 / 1e9
         log(f"slope unusable (t1={t1:.3f}s t2={t2:.3f}s); reporting "
             f"latency-inclusive lower bound")
     return gbps, t1, t2
@@ -459,72 +463,62 @@ def bench_cpu_native():
 def bench_crush(n_objects=int(os.environ.get("BENCH_CRUSH_OBJECTS",
                                              "1000000")),
                 n_osds=10_000):
-    """BASELINE config #5 geometry: place n_objects PGs on an
-    n_osds-OSD CRUSH map (EC rule, indep), vectorized mapper. The full
-    10M run is config #5 verbatim; the default 1M keeps the driver
-    bench under budget and the rate extrapolates linearly (per-lane
-    cost is batch-independent — measured at 10M, see BASELINE.md)."""
-    import numpy as np
-
+    """BASELINE config #5 geometry: place PGs on an n_osds-OSD CRUSH
+    map (EC rule, indep), vectorized mapper. The rate is a slope over
+    two scan sizes whose larger leg places ~n_objects
+    (BENCH_CRUSH_OBJECTS trims/extends it); the verbatim 10M run is
+    appended when the measured rate fits the deadline."""
     from ceph_tpu.crush.map import build_hierarchy, ec_rule
     from ceph_tpu.crush.mapper import VectorMapper, full_weights
 
     m = build_hierarchy(n_osds, osds_per_host=10, hosts_per_rack=25)
     ec_rule(m, rule_id=1, choose_type=1)
     weights = full_weights(n_osds)
-    # Sub-batch sizing. CPU fallback: XLA's constant folding on the
-    # bucket-table gathers scales with lane count at compile time —
-    # smaller sub-batches keep the section inside the deadline (rate is
-    # lane-count independent). TPU: the 2026-07-30 live capture crashed
-    # the worker at 1M lanes ("kernel fault") — every (B, S) temporary
-    # in the unrolled descend x numrep while-loop body is B*S*4 bytes,
-    # and at 1M lanes the body's working set plausibly exceeded HBM.
-    # Empirical confirmation (2026-07-31 live): tools/crush_10m.py at
-    # 10k-lane batches ran the full 10M on the chip at ~3.3M
-    # placements/s with NO worker crash. Start at 32k lanes and halve
-    # on a runtime error (the axon worker restarts between attempts).
-    sub = 32_768 if STATE["tpu_ok"] else 100_000
-    n_objects = n_objects if STATE["tpu_ok"] else min(n_objects, 500_000)
+    # Lane sizing: the 1M-lane sub-batch crashed the TPU worker in both
+    # live captures ("kernel fault" — working-set pressure from the
+    # unrolled descend x numrep loop body); 10k lanes ran the full 10M
+    # on the chip with no crash (tools/crush_10m.py, 2026-07-31). The
+    # whole batch loop runs inside ONE jitted lax.scan with
+    # device-generated seeds and an XOR digest carry (scan_rule):
+    # per-dispatch tunnel RTT (~2s observed) otherwise dominates.
+    sub = 10_000
+    if STATE["tpu_ok"]:
+        nb2 = max(20, min(1000, n_objects // sub))
+    else:
+        nb2 = max(4, min(10, n_objects // sub))
+    nb1 = max(1, nb2 // 10)
 
     while True:
         try:
             vm = VectorMapper(m)
-            xs0 = np.arange(sub, dtype=np.uint32)
-            np.asarray(vm.do_rule(1, xs0, weights, K + M))  # compile+warm
-            t0 = time.perf_counter()
-            done = 0
-            # full sub-batches only (variable tails would recompile);
-            # the rate divides by the count actually placed
-            while done < n_objects:
-                xs = np.arange(done, done + sub, dtype=np.uint32)
-                res = vm.do_rule(1, xs, weights, K + M)
-                done += sub
-            np.asarray(res)  # sync on the last batch
+            run = lambda nb: vm.scan_rule(1, weights, K + M, 0, sub, nb)
+            rate, t1, t2 = _slope(run, sub * 1e9, n1=nb1, n2=nb2,
+                                  reps=2)   # *1e9: units are placements
             break
         except Exception as e:    # noqa: BLE001 — retry ladder
-            if not STATE["tpu_ok"] or sub <= 8_192:
+            if not STATE["tpu_ok"] or sub <= 2_500:
                 raise
             log(f"crush: sub-batch {sub} failed ({type(e).__name__}); "
                 f"halving and retrying")
             sub //= 2
             time.sleep(20.0)      # give a restarted worker time to boot
-    dt = time.perf_counter() - t0
-    rate = done / dt
-    log(f"crush: {done} placements x{K + M} on {n_osds} OSDs "
-        f"in {dt:.2f}s = {rate / 1e6:.2f} M placements/s")
+    log(f"crush: slope over {sub * (nb2 - nb1)} placements x{K + M} on "
+        f"{n_osds} OSDs (t({nb1})={t1:.2f}s t({nb2})={t2:.2f}s) = "
+        f"{rate / 1e6:.2f} M placements/s")
     STATE["extra"]["crush_placements_per_s"] = round(rate)
-    # BASELINE config #5 is 10M objects verbatim: extend to the full
-    # run when the measured rate says it fits the deadline comfortably
+    # BASELINE config #5 is 10M objects verbatim: run it in full when
+    # the measured rate says it fits the deadline comfortably
     full = 10_000_000
-    if done < full and (full - done) / rate < 150:
+    if full / rate < 150:
+        t0 = time.perf_counter()
+        done = 0
         while done < full:
-            xs = np.arange(done, done + sub, dtype=np.uint32)
-            res = vm.do_rule(1, xs, weights, K + M)
-            done += sub
-        np.asarray(res)
+            vm.scan_rule(1, weights, K + M, done, sub, nb2)
+            done += sub * nb2
         dt = time.perf_counter() - t0
         log(f"crush full config#5: {done} placements in {dt:.2f}s = "
-            f"{done / dt / 1e6:.2f} M placements/s")
+            f"{done / dt / 1e6:.2f} M placements/s (incl. "
+            f"{done // (sub * nb2)} dispatch RTTs)")
         STATE["extra"]["crush_placements_per_s_10M"] = round(done / dt)
     return rate
 

@@ -452,6 +452,50 @@ class VectorMapper:
         return {k: v for k, v in self.__dict__.items()
                 if k.startswith("t_")}
 
+    def scan_rule(self, rule_id: int, weights, result_max: int,
+                  start: int, sub: int, n_batches: int):
+        """Place n_batches consecutive sub-batches of `sub` PGs inside
+        ONE device program (lax.scan), seeds generated on device.
+
+        Per-dispatch round trips dominate do_rule on a tunneled TPU
+        (~2s/dispatch observed 2026-07-31: a 1000-batch 10M run
+        dispatched in 3s and drained for >30min), so throughput
+        benching must put the whole loop on device — same shape as
+        bench.py's digest-synced scan pipeline. Returns (digest, last)
+        where digest is an int32 XOR fold over every placement (the
+        data dependency that keeps all batches live) and last is the
+        final (sub, result_max) placement batch for spot validation.
+        """
+        key = ("scan", rule_id, result_max, sub, n_batches)
+        fn = self._jitted.get(key)
+        if fn is None:
+            def impl(tables, weights, start, _rid=rule_id,
+                     _rm=result_max, _sub=sub, _nb=n_batches,
+                     _self=self):
+                import copy as _copy
+                view = _copy.copy(_self)
+                view.__dict__.update(tables)
+
+                def body(carry, i):
+                    acc, _last = carry
+                    xs = (jnp.arange(_sub, dtype=jnp.uint32)
+                          + (start + i * _sub).astype(jnp.uint32))
+                    res = VectorMapper._do_rule_impl(
+                        view, _rid, _rm, xs, weights)
+                    d = jnp.bitwise_xor.reduce(
+                        jnp.bitwise_xor.reduce(res, axis=0))
+                    return (acc ^ d, res), None
+                init = (jnp.int32(0),
+                        jnp.zeros((_sub, _rm), jnp.int32))
+                (acc, last), _ = jax.lax.scan(
+                    body, init, jnp.arange(_nb, dtype=jnp.int32))
+                return acc, last
+            fn = jax.jit(impl)
+            self._jitted[key] = fn
+        weights = jnp.asarray(weights, jnp.int32)
+        acc, last = fn(self._table_args(), weights, jnp.int32(start))
+        return int(jax.device_get(acc)), last
+
 
 def full_weights(n_devices: int) -> np.ndarray:
     return np.full(n_devices, 0x10000, dtype=np.int32)

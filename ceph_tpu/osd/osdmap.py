@@ -126,6 +126,13 @@ class OSDMap:
         # balancer overrides (ref: OSDMap pg_upmap_items + _apply_upmap)
         self.pg_upmap_items: dict[tuple[int, int],
                                   list[tuple[int, int]]] = {}
+        # centralized config KV (role of the ConfigMonitor store, ref:
+        # src/mon/ConfigMonitor.cc — `ceph config set` lands here).
+        # Re-design: rather than a second PaxosService, the KV rides
+        # the same replicated value the monitors already run Paxos
+        # over; daemons apply it at their config system's "mon" layer
+        # on every map commit (defaults < file < mon < override).
+        self.config_kv: dict[str, str] = {}
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
@@ -135,9 +142,10 @@ class OSDMap:
         """Versioned wire form: epoch, crush map, per-OSD runtime state,
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
-        # v2 appends pg_upmap_items; compat stays 1 (a v1 reader skips
-        # the tail via the section length — the ENCODE_START contract)
-        e = Encoder().start(2, 1)
+        # v2 appends pg_upmap_items, v3 config_kv; compat stays 1 (an
+        # old reader skips the tail via the section length — the
+        # ENCODE_START contract)
+        e = Encoder().start(3, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -167,13 +175,15 @@ class OSDMap:
                   lambda en, k: en.i32(k[0]).u32(k[1]),
                   lambda en, v: en.list(
                       v, lambda e2, ft: e2.i32(ft[0]).i32(ft[1])))
+        e.mapping(self.config_kv, lambda en, k: en.string(k),
+                  lambda en, v: en.string(v))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        v = d.start(2)
+        v = d.start(3)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -203,6 +213,9 @@ class OSDMap:
             m.pg_upmap_items = d.mapping(
                 lambda dd: (dd.i32(), dd.u32()),
                 lambda dd: dd.list(lambda e2: (e2.i32(), e2.i32())))
+        if v >= 3:
+            m.config_kv = d.mapping(lambda dd: dd.string(),
+                                    lambda dd: dd.string())
         d.finish()
         return m
 
@@ -228,6 +241,24 @@ class OSDMap:
     def mark_out(self, osd: int) -> None:
         self.osd_weight[osd] = 0
         self.clean_pg_upmaps()
+        self._bump()
+
+    def config_set(self, key: str, value: str) -> None:
+        """Centralized `ceph config set` (ref: ConfigMonitor::
+        prepare_command): idempotent — an unchanged value does not
+        bump the epoch, so a replayed/duplicate op rebases to a
+        no-op on the monitors' proposal pipe."""
+        value = str(value)
+        if self.config_kv.get(key) == value:
+            return
+        self.config_kv[key] = value
+        self._bump()
+
+    def config_rm(self, key: str) -> None:
+        """Centralized `ceph config rm` — idempotent like config_set."""
+        if key not in self.config_kv:
+            return
+        del self.config_kv[key]
         self._bump()
 
     def set_pg_upmap_items(self, pg: tuple[int, int],

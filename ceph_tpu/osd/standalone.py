@@ -353,6 +353,32 @@ class MPoolOp(Message):
         return m
 
 
+@register_message
+class MConfigOp(Message):
+    """Centralized config mutation — `ceph config set/rm` (ref:
+    MMonCommand routed to ConfigMonitor::prepare_command). Broadcast
+    to every monitor like MPoolOp; value-idempotence (OSDMap.config_set
+    bumps nothing when unchanged) makes queue-everywhere commit exactly
+    one change. Daemons observe it through their map subscription and
+    apply it at their config's "mon" layer."""
+
+    type_id = 0x43
+
+    def __init__(self, kind: str, key: str, value: str = ""):
+        self.kind, self.key, self.value = kind, key, value
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).string(self.kind).string(self.key) \
+            .string(self.value).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MConfigOp":
+        d.start(1)
+        m = cls(d.string(), d.string(), d.string())
+        d.finish()
+        return m
+
+
 # -- request/reply plumbing --------------------------------------------------
 
 class _Rpc:
@@ -519,6 +545,19 @@ class OSDDaemon:
         self._last_pong: dict[int, float] = {}
         self._reported: set[int] = set()
         self._stop = threading.Event()
+        # per-daemon layered config (ref: md_config_t per daemon). The
+        # cluster's tuned knobs act as the conf-file layer; the
+        # centralized KV riding the committed OSDMap lands at the
+        # "mon" layer on every map fold (_apply_central_config), so
+        # the full precedence chain default < file < mon < override
+        # is live on a running daemon and observers fire on commit.
+        from ..utils.config import Config
+        self.config = Config()
+        self.config.load_file({
+            "osd_heartbeat_interval": cluster.hb_interval,
+            "osd_heartbeat_grace": cluster.hb_grace,
+        })
+        self._cfg_applied: dict[str, str] = {}
         self._start()
 
     def _start(self) -> None:
@@ -727,7 +766,32 @@ class OSDDaemon:
                         self._last_pong[osd] = now
                     self._reported.discard(osd)
                     self.suspect.discard(osd)
+            self._apply_central_config()
             self._reconcile()
+
+    def _apply_central_config(self) -> None:
+        """Land the committed map's config KV at this daemon's "mon"
+        config layer (ConfigMonitor -> md_config_t flow): sets fire
+        observers only on resolved-value change, removed keys fall
+        back to the file/default layers, unknown keys are logged and
+        skipped (a newer cluster may ship options this daemon doesn't
+        declare — the reference warns and continues the same way)."""
+        kv = self.osdmap.config_kv
+        for key, value in kv.items():
+            if self._cfg_applied.get(key) == value:
+                continue
+            try:
+                self.config.set(key, value, level="mon")
+            except (KeyError, ValueError) as e:
+                self.c.log(f"{self.name}: central config "
+                           f"{key}={value!r} ignored: {e}")
+            self._cfg_applied[key] = value
+        for key in [k for k in self._cfg_applied if k not in kv]:
+            try:
+                self.config.rm(key, level="mon")
+            except KeyError:
+                pass
+            del self._cfg_applied[key]
 
     def _reconcile(self) -> None:
         """Map changed: adopt/recover the PGs this daemon primaries
@@ -1001,7 +1065,10 @@ class OSDDaemon:
 
     def _heartbeat_loop(self) -> None:
         beat = 0
-        while not self._stop.wait(self.c.hb_interval):
+        # interval/grace resolve through the daemon config each beat,
+        # so a committed `config set osd_heartbeat_*` retunes a RUNNING
+        # daemon (the md_config_obs_t role, no restart)
+        while not self._stop.wait(self.config["osd_heartbeat_interval"]):
             beat += 1
             if beat % 4 == 0 and self.osdmap is not None \
                     and not self.osdmap.osd_up[self.osd_id]:
@@ -1041,7 +1108,8 @@ class OSDDaemon:
                     self.msgr.send(f"osd.{osd}", MOSDPing(now))
                 except (KeyError, OSError, ConnectionError):
                     pass
-                if now - self._last_pong[osd] > self.c.hb_grace \
+                if now - self._last_pong[osd] \
+                        > self.config["osd_heartbeat_grace"] \
                         and osd not in self._reported:
                     self._reported.add(osd)
                     self.suspect.add(osd)
@@ -1146,6 +1214,7 @@ class MonDaemon:
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
         m.register_handler(MPoolOp.type_id, self._on_pool_op)
+        m.register_handler(MConfigOp.type_id, self._on_config_op)
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
         self._hb = threading.Thread(target=self._mon_hb_loop,
@@ -1592,6 +1661,25 @@ class MonDaemon:
                 m.pool_rmsnap(1, snap)
         self._commit(mutate)
 
+    def _on_config_op(self, peer: str, msg: MConfigOp) -> None:
+        """Centralized config mutation (the ConfigMonitor role): the
+        KV rides the same Paxos-committed value as the map, so a
+        `config set` is durable exactly when a majority accepted it
+        and every daemon observes it through its map subscription."""
+        if self.osdmap is None:
+            return
+        kind, key, value = msg.kind, msg.key, msg.value
+        self.c.log(f"{self.name}: config {kind} {key}={value!r} "
+                   f"from {peer}")
+
+        def mutate(m: OSDMap) -> None:
+            # value-idempotent: a duplicate rebases to a no-op
+            if kind == "set":
+                m.config_set(key, value)
+            elif kind == "rm":
+                m.config_rm(key)
+        self._commit(mutate)
+
     def kill(self) -> None:
         self._stop.set()
         self.msgr.shutdown()
@@ -1673,12 +1761,17 @@ class Client:
 
     # -- pool snapshots over the wire ----------------------------------------
 
-    def _pool_op(self, kind: str, snap: str) -> None:
+    def _mon_cast(self, msg: Message) -> None:
+        """Broadcast to every monitor (queue-everywhere: whoever leads
+        proposes; idempotent mutations commit exactly once)."""
         for mon in self.c.mon_names():
             try:
-                self.msgr.send(mon, MPoolOp(kind, snap))
+                self.msgr.send(mon, msg)
             except (KeyError, OSError, ConnectionError):
                 pass
+
+    def _pool_op(self, kind: str, snap: str) -> None:
+        self._mon_cast(MPoolOp(kind, snap))
 
     def snap_create(self, name: str, timeout: float = 15.0) -> int:
         """Named pool snapshot: monitor-quorum-committed (the snap
@@ -1709,6 +1802,31 @@ class Client:
         self._op("rollback", ps,
                  lambda e: e.u64(self._snapc()).string(name).u64(sid),
                  retries=6)
+
+    # -- centralized config over the wire ------------------------------------
+
+    def config_set(self, key: str, value, timeout: float = 15.0) -> None:
+        """`ceph config set` — quorum-committed, observed through the
+        map subscription (ref: ConfigMonitor::prepare_command)."""
+        value = str(value)
+        self._mon_cast(MConfigOp("set", key, value))
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and self.osdmap.config_kv.get(key) == value,
+            timeout, f"config {key}={value!r} committed")
+
+    def config_rm(self, key: str, timeout: float = 15.0) -> None:
+        self._mon_cast(MConfigOp("rm", key))
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and key not in self.osdmap.config_kv,
+            timeout, f"config {key} removed")
+
+    def config_get(self, key: str) -> str | None:
+        """The committed central value (None = not centrally set)."""
+        if self.osdmap is None:
+            return None
+        return self.osdmap.config_kv.get(key)
 
     # -- scrub / repair / object classes over the wire -----------------------
 

@@ -123,7 +123,9 @@ class TestWireForms:
         om.mark_out(3)
         om.set_pg_temp((1, 2), [5, 6, 7, 8, 9, 10])
         om.set_primary_temp((1, 2), 6)
+        om.config_set("osd_heartbeat_grace", "5.0")
         om2 = OSDMap.decode(om.encode())
+        assert om2.config_kv == {"osd_heartbeat_grace": "5.0"}
         assert om2.epoch == om.epoch
         assert np.array_equal(om2.osd_weight, om.osd_weight)
         assert np.array_equal(om2.osd_up, om.osd_up)
@@ -134,6 +136,26 @@ class TestWireForms:
         for ps in range(8):
             assert (om.pg_to_up_acting_osds(1, ps)
                     == om2.pg_to_up_acting_osds(1, ps))
+
+    def test_osdmap_config_kv_idempotent_mutators(self):
+        """config_set/config_rm bump the epoch only on real change —
+        the invariant the monitors' rebase-to-no-op pipe rests on
+        (ref: ConfigMonitor::prepare_command no-op detection)."""
+        m = build_hierarchy(8, osds_per_host=2, hosts_per_rack=2)
+        ec_rule(m, 1, choose_type=1)
+        om = OSDMap(m)
+        e0 = om.epoch
+        om.config_set("debug_level", "5")
+        assert om.epoch == e0 + 1
+        om.config_set("debug_level", "5")      # unchanged: no bump
+        assert om.epoch == e0 + 1
+        om.config_set("debug_level", "7")
+        assert om.epoch == e0 + 2
+        om.config_rm("nope")                   # absent: no bump
+        assert om.epoch == e0 + 2
+        om.config_rm("debug_level")
+        assert om.epoch == e0 + 3
+        assert om.config_kv == {}
 
     def test_pglog_roundtrip_preserves_missing_semantics(self):
         log = PGLog(max_entries=4)

@@ -281,6 +281,68 @@ class TestStandaloneObjectOps:
                     json.dumps({"owner": "holder"}).encode())
 
 
+class TestCentralConfig:
+    """Centralized config over the wire (the ConfigMonitor role, ref:
+    src/mon/ConfigMonitor.cc): `config set` is quorum-committed (the
+    KV rides the Paxos value with the map), every daemon lands it at
+    its config's "mon" layer on the commit broadcast, observers fire,
+    and removal falls back down the precedence chain."""
+
+    def test_config_set_reaches_every_daemon_and_observers_fire(
+            self, cluster):
+        cl = cluster.client()
+        fired = []
+        d0 = next(iter(cluster.osds.values()))
+        d0.config.observe("osd_scrub_auto_repair",
+                          lambda k, v: fired.append((k, v)))
+        cl.config_set("osd_scrub_auto_repair", "true")
+        cluster._wait(
+            lambda: all(d.config["osd_scrub_auto_repair"] is True
+                        for d in cluster.osds.values()
+                        if not d._stop.is_set()),
+            15, "central config resolved on every daemon")
+        assert fired == [("osd_scrub_auto_repair", True)]  # coerced
+        assert cl.config_get("osd_scrub_auto_repair") == "true"
+        # removal: daemons fall back to the default layer
+        cl.config_rm("osd_scrub_auto_repair")
+        cluster._wait(
+            lambda: all(d.config["osd_scrub_auto_repair"] is False
+                        for d in cluster.osds.values()
+                        if not d._stop.is_set()),
+            15, "central config removal resolved")
+
+    def test_unknown_key_commits_but_daemons_skip_it(self, cluster):
+        cl = cluster.client()
+        cl.config_set("some_future_option", "42")
+        assert cl.config_get("some_future_option") == "42"
+        # daemons logged + skipped; the cluster still serves I/O
+        objs = corpus(60, n=6)
+        cl.write(objs)
+        for name, want in objs.items():
+            assert cl.read(name) == want
+
+    def test_config_survives_leader_failover(self):
+        c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            cl.config_set("debug_level", "9")
+            c.kill_mon(0)
+            c._wait(lambda: c.mons[1].is_leader(), 10,
+                    "mon.1 leadership")
+            # committed value survives the leader's death...
+            assert cl.config_get("debug_level") == "9"
+            # ...and the new leader commits further changes
+            cl.config_set("debug_level", "11")
+            c._wait(
+                lambda: all(d.config["debug_level"] == 11
+                            for d in c.osds.values()
+                            if not d._stop.is_set()),
+                15, "post-failover config resolved on daemons")
+        finally:
+            c.shutdown()
+
+
 class TestMonitorFailover:
     """Monitor election + leader failover over the wire (ref:
     src/mon/Elector.cc lowest-rank outcome; src/mon/Monitor.cc sync).
