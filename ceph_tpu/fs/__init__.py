@@ -1,3 +1,5 @@
-from .client import FsClient, FsError, IsADir, NotADir, NotEmpty
+from .client import (FsBusy, FsClient, FsError, FsFile, IsADir, NotADir,
+                     NotEmpty)
 
-__all__ = ["FsClient", "FsError", "IsADir", "NotADir", "NotEmpty"]
+__all__ = ["FsBusy", "FsClient", "FsError", "FsFile", "IsADir", "NotADir",
+           "NotEmpty"]

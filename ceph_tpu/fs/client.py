@@ -21,8 +21,17 @@ Mapping onto this framework:
 * The MDS ITSELF — a metadata-caching server process — collapses to
   these object-class methods: metadata mutations are already atomic
   at the dirfrag object, so the sim needs no extra daemon between
-  client and OSD. Locking/caps/multiple-active-MDS are out of scope
-  (single-writer semantics, like a one-client mount).
+  client and OSD.
+* FILE CAPABILITIES (ref: src/mds/Locker.cc issue/revoke; client
+  caps Fr/Fw in src/client/Client.cc) map onto the cls `lock` class
+  on a per-inode caps anchor (`.fs.caps.{ino}`): `open(path, "r")`
+  acquires a SHARED lock (the Fr cap), `open(path, "w"/"rw")` an
+  EXCLUSIVE one (Fw); conflicting opens fail with FsBusy instead of
+  the reference's asynchronous revoke (fail-fast-lite), bare
+  write/truncate/unlink refuse while another client holds caps, and
+  `break_caps` is the operator eviction path for a dead holder
+  (`ceph tell mds.N client evict` role). Multiple-active-MDS stays
+  out of scope.
 
 Everything rides librados/striper: EC fan-out, snapshots' COW,
 recovery, scrub, and PG splits apply to file data and dirfrags with
@@ -54,6 +63,10 @@ class IsADir(FsError, IsADirectoryError):
 
 class NotEmpty(FsError, OSError):
     pass
+
+
+class FsBusy(FsError, OSError):
+    """A conflicting capability is held by another client."""
 
 
 # -- dirfrag object class (CDir dentry ops) ----------------------------------
@@ -109,14 +122,19 @@ def _meta_alloc(h: ClsHandle, inp: bytes) -> bytes:
 
 
 class FsClient:
-    """A mounted filesystem handle (the libcephfs Client role)."""
+    """A mounted filesystem handle (the libcephfs Client role).
+
+    `name` identifies this mount as a capability owner (the client
+    session id the MDS would track); two FsClients with different
+    names contend for caps, same-name re-opens are re-entrant."""
 
     STRIPE_UNIT = 1 << 16
     STRIPE_COUNT = 4
     OBJECT_SIZE = 1 << 20
 
-    def __init__(self, ioctx: IoCtx):
+    def __init__(self, ioctx: IoCtx, name: str = "fsclient"):
         self.io = ioctx
+        self.name = name
         self._striper = RadosStriper(
             ioctx, stripe_unit=self.STRIPE_UNIT,
             stripe_count=self.STRIPE_COUNT,
@@ -137,6 +155,12 @@ class FsClient:
     @staticmethod
     def _data_obj(ino: int) -> str:
         return f".fs.data.{ino}"
+
+    @staticmethod
+    def _caps_obj(ino: int) -> str:
+        # the per-inode capability anchor: one UNSTRIPED object whose
+        # cls-lock KV is the caps ledger (the Locker's per-inode state)
+        return f".fs.caps.{ino}"
 
     def _clock(self) -> float:
         import time
@@ -217,12 +241,17 @@ class FsClient:
         ent = self._walk(self._split(path))
         if ent["type"] == "dir":
             raise IsADir(path)
+        self._check_caps(ent["ino"], write=True, what=f"unlink {path}")
         self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
                         "unlink", json.dumps({"name": name}).encode())
         try:
             self._striper.remove(self._data_obj(ent["ino"]))
         except KeyError:
             pass                     # never written
+        try:
+            self.io.remove(self._caps_obj(ent["ino"]))
+        except KeyError:
+            pass                     # never opened
 
     def rmdir(self, path: str) -> None:
         parent, name = self._parent_and_name(path)
@@ -273,11 +302,88 @@ class FsClient:
 
     # -- data ops ------------------------------------------------------------
 
+    # -- capabilities (Locker/caps-lite) -------------------------------------
+
+    def _caps_state(self, ino: int) -> dict:
+        try:
+            raw = self.io.execute(self._caps_obj(ino), "lock",
+                                  "get_info")
+        except (KeyError, ClsError):
+            return {"type": None, "holders": []}
+        return json.loads(raw)
+
+    def _check_caps(self, ino: int, write: bool, what: str) -> None:
+        """Fail-fast conflict check for capability-less ops: an op by
+        this client is refused while ANOTHER client holds conflicting
+        caps (the reference would instead revoke asynchronously)."""
+        st = self._caps_state(ino)
+        others = [h for h in st["holders"] if h != self.name]
+        if not others:
+            return
+        if write or st["type"] == "exclusive":
+            raise FsBusy(f"{what}: caps held by {others} "
+                         f"({st['type']})")
+
+    def open(self, path: str, mode: str = "r") -> "FsFile":
+        """Acquire caps and return a handle: "r" -> shared (Fr),
+        "w"/"rw" -> exclusive (Fw, creating the file if absent).
+        A conflicting holder raises FsBusy — the fail-fast analog of
+        the MDS delaying the open until revoke completes."""
+        if mode not in ("r", "w", "rw"):
+            raise ValueError(f"bad mode {mode!r}")
+        writable = "w" in mode
+        try:
+            ent = self._walk(self._split(path))
+        except FileNotFoundError:
+            if not writable:
+                raise
+            self.create(path)
+            ent = self._walk(self._split(path))
+        if ent["type"] != "file":
+            raise IsADir(path)
+        caps = self._caps_obj(ent["ino"])
+        try:
+            self.io.stat(caps)
+        except KeyError:
+            self.io.write_full(caps, b"caps")
+        try:
+            self.io.execute(caps, "lock", "lock", json.dumps(
+                {"owner": self.name,
+                 "type": "exclusive" if writable else "shared"}
+            ).encode())
+        except ClsError as e:
+            raise FsBusy(f"open {path} ({mode}): {e}") from None
+        return FsFile(self, path, ent["ino"], mode)
+
+    def caps_info(self, path: str) -> dict:
+        """{'type', 'holders'} for the path's inode (session ls role)."""
+        ent = self._walk(self._split(path))
+        return self._caps_state(ent["ino"])
+
+    def break_caps(self, path: str, owner: str) -> None:
+        """Operator eviction of a dead holder's caps (ref: cls_lock
+        break_lock; `ceph tell mds.N client evict` role)."""
+        ent = self._walk(self._split(path))
+        try:
+            self.io.execute(self._caps_obj(ent["ino"]), "lock",
+                            "break_lock",
+                            json.dumps({"owner": owner}).encode())
+        except (KeyError, ClsError):
+            pass                     # no caps object / not a holder
+
+    def _release_caps(self, ino: int) -> None:
+        try:
+            self.io.execute(self._caps_obj(ino), "lock", "unlock",
+                            json.dumps({"owner": self.name}).encode())
+        except (KeyError, ClsError):
+            pass                     # already broken/unlinked
+
     def write(self, path: str, data: bytes, offset: int = 0) -> None:
         parent, name = self._parent_and_name(path)
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._check_caps(ent["ino"], write=True, what=f"write {path}")
         self._striper.write(self._data_obj(ent["ino"]), bytes(data),
                             offset=offset)
         new_size = max(ent["size"], offset + len(data))
@@ -293,6 +399,7 @@ class FsClient:
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._check_caps(ent["ino"], write=False, what=f"read {path}")
         if ent["size"] == 0:
             return b""
         if length is None:
@@ -305,6 +412,8 @@ class FsClient:
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._check_caps(ent["ino"], write=True,
+                         what=f"truncate {path}")
         if ent["size"] == 0 and size > 0:
             # sparse grow of a never-written file: materialize zeros
             self._striper.write(self._data_obj(ent["ino"]), b"\x00")
@@ -316,3 +425,47 @@ class FsClient:
                                     "fields": {"size": size,
                                                "mtime": self._clock()}
                                     }).encode())
+
+
+class FsFile:
+    """An open file handle holding capabilities until close() — the
+    Fh + caps pairing of the reference client. Read requires Fr
+    (any mode), write/truncate require Fw (mode with "w"); close
+    releases the caps exactly once. Context-manager friendly."""
+
+    def __init__(self, client: FsClient, path: str, ino: int,
+                 mode: str):
+        self.client, self.path, self.ino = client, path, ino
+        self.mode = mode
+        self._open = True
+
+    def _alive(self) -> None:
+        if not self._open:
+            raise ValueError(f"I/O on closed file {self.path}")
+
+    def read(self, length: int | None = None, offset: int = 0) -> bytes:
+        self._alive()
+        return self.client.read(self.path, length=length, offset=offset)
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        self._alive()
+        if "w" not in self.mode:
+            raise FsBusy(f"{self.path}: opened read-only (no Fw cap)")
+        self.client.write(self.path, data, offset=offset)
+
+    def truncate(self, size: int) -> None:
+        self._alive()
+        if "w" not in self.mode:
+            raise FsBusy(f"{self.path}: opened read-only (no Fw cap)")
+        self.client.truncate(self.path, size)
+
+    def close(self) -> None:
+        if self._open:
+            self._open = False
+            self.client._release_caps(self.ino)
+
+    def __enter__(self) -> "FsFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

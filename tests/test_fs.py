@@ -147,3 +147,102 @@ class TestData:
             assert fs.read(path) == want
         assert sorted(fs.readdir("/proj")) == \
             [f"d{i}" for i in range(5)]
+
+
+class TestCaps:
+    """File capabilities (ref: src/mds/Locker.cc issue/revoke;
+    cls_lock as the caps ledger). Two mounts = two cap owners."""
+
+    def _two_mounts(self):
+        c, fs_a = mk()
+        fs_b = FsClient(fs_a.io, name="mount-b")
+        return c, fs_a, fs_b
+
+    def test_open_write_read_roundtrip_via_handles(self):
+        c, fs = mk()
+        fs.mkdir("/d")
+        with fs.open("/d/f", "w") as f:
+            f.write(b"cap-protected bytes")
+        with fs.open("/d/f", "r") as f:
+            assert f.read() == b"cap-protected bytes"
+        # handles released their caps: no holders remain
+        assert fs.caps_info("/d/f")["holders"] == []
+
+    def test_exclusive_blocks_other_mounts_until_close(self):
+        c, fs_a, fs_b = self._two_mounts()
+        fs_a.create("/f", b"v1")
+        from ceph_tpu.fs import FsBusy
+        h = fs_a.open("/f", "w")
+        # another mount: open (either mode), bare write AND bare read
+        # all refuse while the exclusive cap is out
+        with pytest.raises(FsBusy):
+            fs_b.open("/f", "w")
+        with pytest.raises(FsBusy):
+            fs_b.open("/f", "r")
+        with pytest.raises(FsBusy):
+            fs_b.write("/f", b"v2")
+        with pytest.raises(FsBusy):
+            fs_b.read("/f")
+        h.close()
+        fs_b.write("/f", b"v2")       # cap released: flows again
+        assert fs_b.read("/f") == b"v2"
+
+    def test_shared_readers_coexist_and_block_writers(self):
+        c, fs_a, fs_b = self._two_mounts()
+        fs_a.create("/f", b"stable")
+        from ceph_tpu.fs import FsBusy
+        ra = fs_a.open("/f", "r")
+        rb = fs_b.open("/f", "r")     # two Fr holders coexist
+        assert rb.read() == b"stable"
+        with pytest.raises(FsBusy):
+            fs_b.open("/f", "w")      # writer excluded by readers
+        with pytest.raises(FsBusy):
+            fs_a.write("/f", b"x")    # other mount still holds Fr
+        with pytest.raises(FsBusy):
+            fs_b.unlink("/f")
+        ra.close()
+        rb.close()
+        with fs_b.open("/f", "w") as f:
+            f.write(b"now writable")
+        assert fs_a.read("/f") == b"now writable"
+
+    def test_read_only_handle_has_no_fw(self):
+        c, fs = mk()
+        fs.create("/f", b"x")
+        from ceph_tpu.fs import FsBusy
+        with fs.open("/f", "r") as f:
+            with pytest.raises(FsBusy):
+                f.write(b"nope")
+            with pytest.raises(FsBusy):
+                f.truncate(0)
+
+    def test_break_caps_evicts_dead_holder(self):
+        c, fs_a, fs_b = self._two_mounts()
+        fs_a.create("/f", b"v")
+        from ceph_tpu.fs import FsBusy
+        fs_a.open("/f", "w")          # holder "dies" without close()
+        with pytest.raises(FsBusy):
+            fs_b.open("/f", "w")
+        assert fs_b.caps_info("/f")["holders"] == ["fsclient"]
+        fs_b.break_caps("/f", "fsclient")
+        with fs_b.open("/f", "w") as f:
+            f.write(b"recovered")
+        assert fs_b.read("/f") == b"recovered"
+
+    def test_open_w_creates_missing_file(self):
+        c, fs = mk()
+        fs.mkdir("/d")
+        with fs.open("/d/new", "w") as f:
+            f.write(b"created by open")
+        assert fs.stat("/d/new")["size"] == 15
+
+    def test_unlink_clears_caps_object(self):
+        c, fs = mk()
+        fs.create("/f", b"x")
+        with fs.open("/f", "r"):
+            pass
+        ino = fs.stat("/f")["ino"]
+        fs.unlink("/f")
+        # caps anchor removed with the file
+        with pytest.raises(KeyError):
+            fs.io.stat(f".fs.caps.{ino}")
