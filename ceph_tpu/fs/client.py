@@ -126,7 +126,10 @@ class FsClient:
 
     `name` identifies this mount as a capability owner (the client
     session id the MDS would track); two FsClients with different
-    names contend for caps, same-name re-opens are re-entrant."""
+    names contend for caps. Each open handle is its own locker
+    ('{name}#{seq}'), so shared handles of one mount coexist and
+    close independently; exclusive conflicts — including same-mount
+    upgrades — fail fast with FsBusy."""
 
     STRIPE_UNIT = 1 << 16
     STRIPE_COUNT = 4
@@ -277,6 +280,13 @@ class FsClient:
             # dst link rewrites the dentry and the src unlink then
             # REMOVES it — the file vanishes and its data orphans.
             return
+        if ent["type"] == "file":
+            # a held capability pins the NAME too: renaming a file
+            # out from under an open handle would strand its caps
+            # (the MDS takes the dentry lock before rename the same
+            # way)
+            self._check_caps(ent["ino"], write=True,
+                             what=f"rename {src}")
         try:
             dent = self._walk(self._split(dst))
             if dent["type"] == "dir":
@@ -286,6 +296,8 @@ class FsClient:
                 # ENOTDIR in POSIX (rename(2)); silently swapping the
                 # types would strand the file's data object
                 raise NotADir(dst)
+            self._check_caps(dent["ino"], write=True,
+                             what=f"rename over {dst}")
             old_ino = dent["ino"]
         except FileNotFoundError:
             old_ino = None
@@ -295,29 +307,45 @@ class FsClient:
         self.io.execute(self._dir_obj(sparent["ino"]), "fs_dir",
                         "unlink", json.dumps({"name": sname}).encode())
         if old_ino is not None and old_ino != ent["ino"]:
-            try:
-                self._striper.remove(self._data_obj(old_ino))
-            except KeyError:
-                pass
+            for obj, rm in ((self._data_obj(old_ino),
+                             self._striper.remove),
+                            (self._caps_obj(old_ino), self.io.remove)):
+                try:
+                    rm(obj)
+                except KeyError:
+                    pass
 
     # -- data ops ------------------------------------------------------------
 
     # -- capabilities (Locker/caps-lite) -------------------------------------
 
+    @staticmethod
+    def _holder_mount(holder: str) -> str:
+        """Holder strings are '{mount}#{handle-seq}' (the owner+cookie
+        pairing of cls_lock in the reference — the cookie makes each
+        handle its own locker, so closing one of a mount's two handles
+        releases only its own cap)."""
+        return holder.split("#", 1)[0]
+
     def _caps_state(self, ino: int) -> dict:
+        caps = self._caps_obj(ino)
         try:
-            raw = self.io.execute(self._caps_obj(ino), "lock",
-                                  "get_info")
+            self.io.stat(caps)   # get_info on a missing object would
+        except KeyError:         # materialize its KV as a side effect
+            return {"type": None, "holders": []}
+        try:
+            raw = self.io.execute(caps, "lock", "get_info")
         except (KeyError, ClsError):
             return {"type": None, "holders": []}
         return json.loads(raw)
 
     def _check_caps(self, ino: int, write: bool, what: str) -> None:
         """Fail-fast conflict check for capability-less ops: an op by
-        this client is refused while ANOTHER client holds conflicting
+        this client is refused while ANOTHER mount holds conflicting
         caps (the reference would instead revoke asynchronously)."""
         st = self._caps_state(ino)
-        others = [h for h in st["holders"] if h != self.name]
+        others = [h for h in st["holders"]
+                  if self._holder_mount(h) != self.name]
         if not others:
             return
         if write or st["type"] == "exclusive":
@@ -346,35 +374,45 @@ class FsClient:
             self.io.stat(caps)
         except KeyError:
             self.io.write_full(caps, b"caps")
+        # one locker PER HANDLE (owner#seq — the owner+cookie pairing):
+        # closing one of this mount's two read handles must release
+        # only its own cap, not the sibling's
+        self._handle_seq = getattr(self, "_handle_seq", 0) + 1
+        holder = f"{self.name}#{self._handle_seq}"
         try:
             self.io.execute(caps, "lock", "lock", json.dumps(
-                {"owner": self.name,
+                {"owner": holder,
                  "type": "exclusive" if writable else "shared"}
             ).encode())
         except ClsError as e:
             raise FsBusy(f"open {path} ({mode}): {e}") from None
-        return FsFile(self, path, ent["ino"], mode)
+        return FsFile(self, path, ent["ino"], mode, holder)
 
     def caps_info(self, path: str) -> dict:
         """{'type', 'holders'} for the path's inode (session ls role)."""
         ent = self._walk(self._split(path))
         return self._caps_state(ent["ino"])
 
-    def break_caps(self, path: str, owner: str) -> None:
+    def break_caps(self, path: str, holder: str) -> None:
         """Operator eviction of a dead holder's caps (ref: cls_lock
-        break_lock; `ceph tell mds.N client evict` role)."""
+        break_lock; `ceph tell mds.N client evict` role). `holder` is
+        a full '{mount}#{seq}' string as listed by caps_info; a bare
+        mount name evicts every one of that mount's handles."""
         ent = self._walk(self._split(path))
-        try:
-            self.io.execute(self._caps_obj(ent["ino"]), "lock",
-                            "break_lock",
-                            json.dumps({"owner": owner}).encode())
-        except (KeyError, ClsError):
-            pass                     # no caps object / not a holder
+        victims = [h for h in self._caps_state(ent["ino"])["holders"]
+                   if h == holder or self._holder_mount(h) == holder]
+        for v in victims:
+            try:
+                self.io.execute(self._caps_obj(ent["ino"]), "lock",
+                                "break_lock",
+                                json.dumps({"owner": v}).encode())
+            except (KeyError, ClsError):
+                pass                 # no caps object / already gone
 
-    def _release_caps(self, ino: int) -> None:
+    def _release_caps(self, ino: int, holder: str) -> None:
         try:
             self.io.execute(self._caps_obj(ino), "lock", "unlock",
-                            json.dumps({"owner": self.name}).encode())
+                            json.dumps({"owner": holder}).encode())
         except (KeyError, ClsError):
             pass                     # already broken/unlinked
 
@@ -431,17 +469,32 @@ class FsFile:
     """An open file handle holding capabilities until close() — the
     Fh + caps pairing of the reference client. Read requires Fr
     (any mode), write/truncate require Fw (mode with "w"); close
-    releases the caps exactly once. Context-manager friendly."""
+    releases exactly this handle's cap (holder = mount#seq), never a
+    sibling handle's. Context-manager friendly.
+
+    Handles are PATH-pinned (a lite deviation from the reference's
+    ino-addressed Fh): before every I/O the path is re-resolved and
+    must still name the inode the caps were granted on — a rename or
+    unlink+recreate underneath turns the handle stale and raises
+    FsError instead of silently writing a DIFFERENT inode under the
+    old inode's caps (which would let two exclusive writers coexist).
+    Caps checks in rename/unlink make that impossible across mounts;
+    the guard catches the same mount doing it to itself."""
 
     def __init__(self, client: FsClient, path: str, ino: int,
-                 mode: str):
+                 mode: str, holder: str):
         self.client, self.path, self.ino = client, path, ino
-        self.mode = mode
+        self.mode, self.holder = mode, holder
         self._open = True
 
     def _alive(self) -> None:
         if not self._open:
             raise ValueError(f"I/O on closed file {self.path}")
+        ent = self.client._walk(self.client._split(self.path))
+        if ent["ino"] != self.ino:
+            raise FsError(
+                f"{self.path}: stale handle (inode {self.ino} -> "
+                f"{ent['ino']}; the name was replaced underneath)")
 
     def read(self, length: int | None = None, offset: int = 0) -> bytes:
         self._alive()
@@ -450,19 +503,21 @@ class FsFile:
     def write(self, data: bytes, offset: int = 0) -> None:
         self._alive()
         if "w" not in self.mode:
-            raise FsBusy(f"{self.path}: opened read-only (no Fw cap)")
+            raise PermissionError(
+                f"{self.path}: opened read-only (no Fw cap)")
         self.client.write(self.path, data, offset=offset)
 
     def truncate(self, size: int) -> None:
         self._alive()
         if "w" not in self.mode:
-            raise FsBusy(f"{self.path}: opened read-only (no Fw cap)")
+            raise PermissionError(
+                f"{self.path}: opened read-only (no Fw cap)")
         self.client.truncate(self.path, size)
 
     def close(self) -> None:
         if self._open:
             self._open = False
-            self.client._release_caps(self.ino)
+            self.client._release_caps(self.ino, self.holder)
 
     def __enter__(self) -> "FsFile":
         return self

@@ -207,13 +207,14 @@ class TestCaps:
         assert fs_a.read("/f") == b"now writable"
 
     def test_read_only_handle_has_no_fw(self):
+        # a local mode error, NOT a cross-client cap conflict: plain
+        # PermissionError (FsBusy would invite a useless break_caps)
         c, fs = mk()
         fs.create("/f", b"x")
-        from ceph_tpu.fs import FsBusy
         with fs.open("/f", "r") as f:
-            with pytest.raises(FsBusy):
+            with pytest.raises(PermissionError):
                 f.write(b"nope")
-            with pytest.raises(FsBusy):
+            with pytest.raises(PermissionError):
                 f.truncate(0)
 
     def test_break_caps_evicts_dead_holder(self):
@@ -223,8 +224,8 @@ class TestCaps:
         fs_a.open("/f", "w")          # holder "dies" without close()
         with pytest.raises(FsBusy):
             fs_b.open("/f", "w")
-        assert fs_b.caps_info("/f")["holders"] == ["fsclient"]
-        fs_b.break_caps("/f", "fsclient")
+        assert fs_b.caps_info("/f")["holders"] == ["fsclient#1"]
+        fs_b.break_caps("/f", "fsclient")   # bare mount name: evict all
         with fs_b.open("/f", "w") as f:
             f.write(b"recovered")
         assert fs_b.read("/f") == b"recovered"
@@ -246,3 +247,57 @@ class TestCaps:
         # caps anchor removed with the file
         with pytest.raises(KeyError):
             fs.io.stat(f".fs.caps.{ino}")
+
+    def test_sibling_handles_release_independently(self):
+        # review r4: closing one of a mount's two read handles must
+        # not release the sibling's cap (per-handle lockers)
+        c, fs_a, fs_b = self._two_mounts()
+        fs_a.create("/f", b"v")
+        from ceph_tpu.fs import FsBusy
+        h1 = fs_a.open("/f", "r")
+        h2 = fs_a.open("/f", "r")
+        h1.close()
+        with pytest.raises(FsBusy):
+            fs_b.open("/f", "w")      # h2 still holds Fr
+        assert h2.read() == b"v"      # and still works
+        h2.close()
+        with fs_b.open("/f", "w") as f:
+            f.write(b"w")
+
+    def test_rename_refuses_while_caps_held(self):
+        c, fs_a, fs_b = self._two_mounts()
+        fs_a.create("/src", b"s")
+        fs_a.create("/dst", b"d")
+        from ceph_tpu.fs import FsBusy
+        h = fs_b.open("/dst", "w")
+        with pytest.raises(FsBusy):
+            fs_a.rename("/src", "/dst")   # dst pinned by B's Fw
+        h.close()
+        hs = fs_b.open("/src", "r")
+        with pytest.raises(FsBusy):
+            fs_a.rename("/src", "/elsewhere")  # src pinned by B's Fr
+        hs.close()
+        fs_a.rename("/src", "/dst")
+        assert fs_a.read("/dst") == b"s"
+
+    def test_rename_over_file_cleans_caps_anchor(self):
+        c, fs = mk()
+        fs.create("/a", b"a")
+        fs.create("/b", b"b")
+        with fs.open("/b", "r"):
+            pass                      # materializes .fs.caps for b
+        old_ino = fs.stat("/b")["ino"]
+        fs.rename("/a", "/b")
+        with pytest.raises(KeyError):
+            fs.io.stat(f".fs.caps.{old_ino}")
+
+    def test_stale_handle_detected_after_recreate(self):
+        c, fs = mk()
+        fs.create("/f", b"v1")
+        h = fs.open("/f", "w")
+        fs.unlink("/f")               # own mount: allowed
+        fs.create("/f", b"v2")        # new inode under the old name
+        from ceph_tpu.fs import FsError
+        with pytest.raises(FsError, match="stale handle"):
+            h.write(b"misdirected")
+        assert fs.read("/f") == b"v2"  # new file untouched
