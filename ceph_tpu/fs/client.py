@@ -416,11 +416,22 @@ class FsClient:
         except (KeyError, ClsError):
             pass                     # already broken/unlinked
 
-    def write(self, path: str, data: bytes, offset: int = 0) -> None:
+    @staticmethod
+    def _expect(ent: dict, path: str, expect_ino: int | None) -> None:
+        """Stale-handle guard, enforced on the SAME walked entry the
+        I/O uses (no second resolve, no check-then-act window)."""
+        if expect_ino is not None and ent["ino"] != expect_ino:
+            raise FsError(
+                f"{path}: stale handle (inode {expect_ino} -> "
+                f"{ent['ino']}; the name was replaced underneath)")
+
+    def write(self, path: str, data: bytes, offset: int = 0,
+              _expect_ino: int | None = None) -> None:
         parent, name = self._parent_and_name(path)
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True, what=f"write {path}")
         self._striper.write(self._data_obj(ent["ino"]), bytes(data),
                             offset=offset)
@@ -433,10 +444,11 @@ class FsClient:
                                     }).encode())
 
     def read(self, path: str, length: int | None = None,
-             offset: int = 0) -> bytes:
+             offset: int = 0, _expect_ino: int | None = None) -> bytes:
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=False, what=f"read {path}")
         if ent["size"] == 0:
             return b""
@@ -445,11 +457,13 @@ class FsClient:
         return self._striper.read(self._data_obj(ent["ino"]),
                                   length=length, offset=offset)
 
-    def truncate(self, path: str, size: int) -> None:
+    def truncate(self, path: str, size: int,
+                 _expect_ino: int | None = None) -> None:
         parent, name = self._parent_and_name(path)
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
+        self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True,
                          what=f"truncate {path}")
         if ent["size"] == 0 and size > 0:
@@ -473,13 +487,14 @@ class FsFile:
     sibling handle's. Context-manager friendly.
 
     Handles are PATH-pinned (a lite deviation from the reference's
-    ino-addressed Fh): before every I/O the path is re-resolved and
-    must still name the inode the caps were granted on — a rename or
-    unlink+recreate underneath turns the handle stale and raises
-    FsError instead of silently writing a DIFFERENT inode under the
-    old inode's caps (which would let two exclusive writers coexist).
-    Caps checks in rename/unlink make that impossible across mounts;
-    the guard catches the same mount doing it to itself."""
+    ino-addressed Fh): each I/O's single path resolve must still name
+    the inode the caps were granted on (enforced on the same walked
+    entry the I/O uses) — a rename or unlink+recreate underneath
+    turns the handle stale and raises FsError instead of silently
+    writing a DIFFERENT inode under the old inode's caps (which would
+    let two exclusive writers coexist). Caps checks in rename/unlink
+    make that impossible across mounts; the guard catches the same
+    mount doing it to itself."""
 
     def __init__(self, client: FsClient, path: str, ino: int,
                  mode: str, holder: str):
@@ -490,29 +505,26 @@ class FsFile:
     def _alive(self) -> None:
         if not self._open:
             raise ValueError(f"I/O on closed file {self.path}")
-        ent = self.client._walk(self.client._split(self.path))
-        if ent["ino"] != self.ino:
-            raise FsError(
-                f"{self.path}: stale handle (inode {self.ino} -> "
-                f"{ent['ino']}; the name was replaced underneath)")
 
     def read(self, length: int | None = None, offset: int = 0) -> bytes:
         self._alive()
-        return self.client.read(self.path, length=length, offset=offset)
+        return self.client.read(self.path, length=length, offset=offset,
+                                _expect_ino=self.ino)
 
     def write(self, data: bytes, offset: int = 0) -> None:
         self._alive()
         if "w" not in self.mode:
             raise PermissionError(
                 f"{self.path}: opened read-only (no Fw cap)")
-        self.client.write(self.path, data, offset=offset)
+        self.client.write(self.path, data, offset=offset,
+                          _expect_ino=self.ino)
 
     def truncate(self, size: int) -> None:
         self._alive()
         if "w" not in self.mode:
             raise PermissionError(
                 f"{self.path}: opened read-only (no Fw cap)")
-        self.client.truncate(self.path, size)
+        self.client.truncate(self.path, size, _expect_ino=self.ino)
 
     def close(self) -> None:
         if self._open:

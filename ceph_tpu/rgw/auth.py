@@ -145,7 +145,9 @@ class AuthedGateway:
     _OPS = ("create_bucket", "delete_bucket", "list_buckets",
             "put_object", "get_object", "head_object", "delete_object",
             "list_objects", "initiate_multipart", "upload_part",
-            "complete_multipart", "abort_multipart")
+            "complete_multipart", "abort_multipart",
+            "put_bucket_versioning", "get_bucket_versioning",
+            "list_object_versions")
 
     def __init__(self, gateway: Gateway, users: UserStore,
                  clock=time.time):
@@ -215,6 +217,12 @@ class AuthedGateway:
             return out
         if op == "list_objects":
             return gw.list_objects(bucket, **params)
+        if op == "put_bucket_versioning":
+            return gw.set_bucket_versioning(bucket, params["enabled"])
+        if op == "get_bucket_versioning":
+            return gw.get_bucket_versioning(bucket)
+        if op == "list_object_versions":
+            return gw.list_object_versions(bucket, **params)
         if op == "put_object":
             return gw.put_object(bucket, key, payload)
         if op == "upload_part":
@@ -262,15 +270,30 @@ class S3Client:
         return self._call("put_object", bucket, key, payload=data)
 
     def get_object(self, bucket, key, offset: int = 0,
-                   length: int | None = None):
+                   length: int | None = None,
+                   version_id: str | None = None):
         return self._call("get_object", bucket, key, offset=offset,
-                          length=length)
+                          length=length, version_id=version_id)
 
-    def head_object(self, bucket, key):
-        return self._call("head_object", bucket, key)
+    def head_object(self, bucket, key, version_id: str | None = None):
+        return self._call("head_object", bucket, key,
+                          version_id=version_id)
 
-    def delete_object(self, bucket, key):
-        return self._call("delete_object", bucket, key)
+    def delete_object(self, bucket, key,
+                      version_id: str | None = None):
+        return self._call("delete_object", bucket, key,
+                          version_id=version_id)
+
+    def put_bucket_versioning(self, bucket, enabled: bool):
+        return self._call("put_bucket_versioning", bucket,
+                          enabled=enabled)
+
+    def get_bucket_versioning(self, bucket):
+        return self._call("get_bucket_versioning", bucket)
+
+    def list_object_versions(self, bucket, prefix: str = ""):
+        return self._call("list_object_versions", bucket,
+                          prefix=prefix)
 
     def list_objects(self, bucket, prefix: str = "", marker: str = "",
                      limit: int = 1000):

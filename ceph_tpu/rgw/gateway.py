@@ -23,8 +23,18 @@ maps onto this framework:
   a data rewrite.
 * S3-AUTH lives in auth.py (SigV4-shaped canonical requests, HMAC
   key-derivation chain, skew window, replay cache) as a verifying
-  front over this gateway. VERSIONING stays out of scope: snapshots
-  already provide point-in-time reads at the pool layer.
+  front over this gateway.
+* VERSIONING (ref: rgw_bucket_dir_entry instance entries +
+  RGWRados olh/instance objects; S3 bucket versioning semantics).
+  Bucket state Off -> Enabled <-> Suspended via the index cls; a
+  versioned PUT appends an instance entry whose payload lives at its
+  own soid (.v.{vid}); unversioned DELETE writes a delete marker;
+  DELETE with versionId permanently removes that instance + payload;
+  Suspended writes/overwrites the "null" version; GET/HEAD accept
+  version_id; ListObjectVersions reports history newest-first with
+  is_latest and markers. Objects predating versioning materialize as
+  the null version on first versioned write (payload stays at the
+  legacy soid).
 
 Everything routes through librados/striper, so EC encode fan-out,
 snapshots' COW, scrub, recovery, and PG splits all apply to gateway
@@ -111,6 +121,136 @@ def _idx_stat(h: ClsHandle, inp: bytes) -> bytes:
     return json.dumps(ent).encode()
 
 
+# -- versioning (cls_rgw bucket-index instance entries, ref:
+#    rgw_bucket_dir_entry instances + RGWRados::Bucket::UpdateIndex;
+#    S3 semantics: PUT appends a version, unversioned DELETE writes a
+#    delete marker, Suspended writes/overwrites the "null" version) --
+
+def _idx_current_view(ent: dict) -> dict:
+    """The entries{} (latest-view) projection of a version entry."""
+    view = {"size": ent["size"], "etag": ent["etag"],
+            "mtime": ent["mtime"], "vid": ent["vid"]}
+    for f in ("soid", "manifest", "part_sizes"):
+        if f in ent:
+            view[f] = ent[f]
+    return view
+
+
+@register_cls("rgw_index", "set_versioning")
+def _idx_set_versioning(h: ClsHandle, inp: bytes) -> bytes:
+    status = json.loads(inp)["status"]
+    if status not in ("Enabled", "Suspended"):
+        raise ClsError(f"bad versioning status {status!r}")
+    h.kv["versioning"] = status
+    return b"{}"
+
+
+@register_cls("rgw_index", "get_versioning")
+def _idx_get_versioning(h: ClsHandle, inp: bytes) -> bytes:
+    # "Off" = never enabled (S3: unversioned bucket); once enabled a
+    # bucket can only flip Enabled <-> Suspended
+    return json.dumps({"status": h.kv.get("versioning", "Off")}).encode()
+
+
+@register_cls("rgw_index", "alloc_vid")
+def _idx_alloc_vid(h: ClsHandle, inp: bytes) -> bytes:
+    n = h.kv.get("next_vid", 1)
+    h.kv["next_vid"] = n + 1
+    return json.dumps({"vid": f"v{n:08d}"}).encode()
+
+
+@register_cls("rgw_index", "put_version")
+def _idx_put_version(h: ClsHandle, inp: bytes) -> bytes:
+    """Append a version entry (newest LAST) and refresh the latest
+    view. A 'null' vid replaces any existing null entry (Suspended
+    semantics); the replaced entry is returned so the caller can wipe
+    its payload. If the key predates versioning, its legacy entry is
+    first materialized as the null version (payload at legacy_soid)."""
+    req = json.loads(inp)
+    key, ent = req["key"], req["ent"]
+    versions = h.kv.setdefault("versions", {})
+    entries = h.kv.setdefault("entries", {})
+    lst = versions.setdefault(key, [])
+    if not lst and key in entries and "vid" not in entries[key]:
+        legacy = dict(entries[key])
+        legacy.update(vid="null", delete_marker=False,
+                      soid=req["legacy_soid"])
+        lst.append(legacy)
+    replaced = None
+    if ent["vid"] == "null":
+        for i, v in enumerate(lst):
+            if v["vid"] == "null":
+                replaced = lst.pop(i)
+                break
+    lst.append(ent)
+    if ent.get("delete_marker"):
+        entries.pop(key, None)
+    else:
+        entries[key] = _idx_current_view(ent)
+    return json.dumps({"replaced": replaced}).encode()
+
+
+@register_cls("rgw_index", "rm_version")
+def _idx_rm_version(h: ClsHandle, inp: bytes) -> bytes:
+    """Remove ONE version (S3 DELETE with versionId) and recompute
+    the latest view from what remains. Returns the removed entry so
+    the caller wipes its payload."""
+    req = json.loads(inp)
+    key, vid = req["key"], req["vid"]
+    versions = h.kv.get("versions", {})
+    lst = versions.get(key, [])
+    removed = None
+    for i, v in enumerate(lst):
+        if v["vid"] == vid:
+            removed = lst.pop(i)
+            break
+    if removed is None:
+        raise ClsError(f"NoSuchVersion: {key}@{vid}")
+    entries = h.kv.setdefault("entries", {})
+    if not lst:
+        versions.pop(key, None)
+        entries.pop(key, None)
+    elif lst[-1].get("delete_marker"):
+        entries.pop(key, None)
+    else:
+        entries[key] = _idx_current_view(lst[-1])
+    return json.dumps(removed).encode()
+
+
+@register_cls("rgw_index", "has_versions")
+def _idx_has_versions(h: ClsHandle, inp: bytes) -> bytes:
+    key = json.loads(inp)["key"]
+    return json.dumps(
+        {"any": bool(h.kv.get("versions", {}).get(key))}).encode()
+
+
+@register_cls("rgw_index", "stat_version")
+def _idx_stat_version(h: ClsHandle, inp: bytes) -> bytes:
+    req = json.loads(inp)
+    for v in h.kv.get("versions", {}).get(req["key"], []):
+        if v["vid"] == req["vid"]:
+            return json.dumps(v).encode()
+    raise ClsError(f"NoSuchVersion: {req['key']}@{req['vid']}")
+
+
+@register_cls("rgw_index", "list_versions")
+def _idx_list_versions(h: ClsHandle, inp: bytes) -> bytes:
+    """ListObjectVersions shape: per key newest-first, is_latest on
+    the newest, delete markers included."""
+    req = json.loads(inp or b"{}")
+    prefix = req.get("prefix", "")
+    versions = h.kv.get("versions", {})
+    out = []
+    for key in sorted(k for k in versions if k.startswith(prefix)):
+        for i, v in enumerate(reversed(versions[key])):
+            out.append({"key": key, "vid": v["vid"],
+                        "is_latest": i == 0,
+                        "delete_marker": bool(v.get("delete_marker")),
+                        "size": v["size"], "etag": v["etag"],
+                        "mtime": v["mtime"]})
+    return json.dumps({"versions": out}).encode()
+
+
 class Gateway:
     """One S3-facing endpoint over an IoCtx (the radosgw process)."""
 
@@ -166,6 +306,11 @@ class Gateway:
         listing = self.list_objects(bucket, limit=1)
         if listing["entries"]:
             raise GatewayError(f"BucketNotEmpty: {bucket}")
+        if self.list_object_versions(bucket)["versions"]:
+            # S3: noncurrent versions and delete markers also block
+            # bucket deletion — their payloads would orphan
+            raise GatewayError(f"BucketNotEmpty: {bucket} "
+                               f"(noncurrent versions remain)")
         self.io.remove(self._index_obj(bucket))
         roots = self._root_read()
         roots.remove(bucket)
@@ -189,33 +334,132 @@ class Gateway:
         except KeyError:
             raise NoSuchBucket(bucket) from None
 
+    # -- versioning ----------------------------------------------------------
+
+    @staticmethod
+    def _vdata_obj(bucket: str, key: str, vid: str) -> str:
+        return f".rgw.data.{bucket}.{key}.v.{vid}"
+
+    def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
+        """PutBucketVersioning: Enabled / Suspended (a bucket that was
+        ever versioned cannot return to Off — S3 semantics)."""
+        self._check_bucket(bucket)
+        self.io.execute(self._index_obj(bucket), "rgw_index",
+                        "set_versioning", json.dumps(
+                            {"status": "Enabled" if enabled
+                             else "Suspended"}).encode())
+
+    def get_bucket_versioning(self, bucket: str) -> str:
+        self._check_bucket(bucket)
+        return self._versioning(bucket)
+
+    def _versioning(self, bucket: str) -> str:
+        out = self.io.execute(self._index_obj(bucket), "rgw_index",
+                              "get_versioning")
+        return json.loads(out)["status"]
+
+    def _alloc_vid(self, bucket: str) -> str:
+        out = self.io.execute(self._index_obj(bucket), "rgw_index",
+                              "alloc_vid")
+        return json.loads(out)["vid"]
+
+    def _put_version(self, bucket: str, key: str, ent: dict) -> None:
+        """Record a version entry; wipe whatever payload a replaced
+        null version owned (Suspended-overwrite semantics)."""
+        out = self.io.execute(
+            self._index_obj(bucket), "rgw_index", "put_version",
+            json.dumps({"key": key, "ent": ent,
+                        "legacy_soid": self._data_obj(bucket, key)}
+                       ).encode())
+        replaced = json.loads(out)["replaced"]
+        if replaced is not None:
+            self._wipe_version_payload(replaced, keep=ent.get("soid"))
+
+    def _next_vid(self, bucket: str, status: str) -> str:
+        """Fresh vid under Enabled; the null slot under Suspended."""
+        return self._alloc_vid(bucket) if status == "Enabled" else "null"
+
+    def _record_version(self, bucket: str, key: str, vid: str,
+                        **fields) -> str:
+        """Shared versioned-write tail: record the entry (mtime
+        stamped, live unless delete_marker overridden), return the
+        vid. `fields` supplies size/etag/soid/manifest/..."""
+        ent = {"vid": vid, "mtime": self._clock(),
+               "delete_marker": False, **fields}
+        self._put_version(bucket, key, ent)
+        return vid
+
+    def _wipe_version_payload(self, ent: dict,
+                              keep: str | None = None) -> None:
+        if "manifest" in ent:
+            for part_soid in ent["manifest"]:
+                self._wipe_striped(part_soid)
+        elif ent.get("soid") and ent["soid"] != keep:
+            self._wipe_striped(ent["soid"])
+
+    def list_object_versions(self, bucket: str,
+                             prefix: str = "") -> dict:
+        """ListObjectVersions: every version + delete marker, per key
+        newest-first with is_latest on the newest."""
+        self._check_bucket(bucket)
+        out = self.io.execute(self._index_obj(bucket), "rgw_index",
+                              "list_versions",
+                              json.dumps({"prefix": prefix}).encode())
+        return json.loads(out)
+
     # -- objects -------------------------------------------------------------
 
     def put_object(self, bucket: str, key: str, data: bytes) -> str:
         """PUT: payload through the striper, then the index entry via
-        the cls (atomic at the index object). Returns the ETag."""
+        the cls (atomic at the index object). Returns the ETag.
+        Versioned buckets append a new version (Enabled) or replace
+        the null version (Suspended) instead of overwriting."""
         self._check_bucket(bucket)
         if not key:
             raise GatewayError("empty key")
         data = bytes(data)
+        etag = self._etag(data)
+        status = self._versioning(bucket)
+        if status != "Off":
+            vid = self._next_vid(bucket, status)
+            soid = self._vdata_obj(bucket, key, vid)
+            self._wipe_striped(soid)     # null overwrite-in-place
+            self._striper.write(soid, data)
+            self._record_version(bucket, key, vid, soid=soid,
+                                 size=len(data), etag=etag)
+            return etag
         soid = self._data_obj(bucket, key)
         self._wipe_replaced(bucket, key)
         self._wipe_striped(soid)
         self._striper.write(soid, data)
-        etag = self._etag(data)
         self.io.execute(self._index_obj(bucket), "rgw_index", "add",
                         json.dumps({"key": key, "size": len(data),
                                     "etag": etag,
                                     "mtime": self._clock()}).encode())
         return etag
 
+    def _stat_version(self, bucket: str, key: str, vid: str) -> dict:
+        try:
+            return json.loads(self.io.execute(
+                self._index_obj(bucket), "rgw_index", "stat_version",
+                json.dumps({"key": key, "vid": vid}).encode()))
+        except ClsError:
+            raise NoSuchKey(f"{bucket}/{key}@{vid}") from None
+
     def get_object(self, bucket: str, key: str,
-                   offset: int = 0, length: int | None = None) -> bytes:
+                   offset: int = 0, length: int | None = None,
+                   version_id: str | None = None) -> bytes:
         self._check_bucket(bucket)
-        ent = self._stat_entry(bucket, key)
+        if version_id is not None:
+            ent = self._stat_version(bucket, key, version_id)
+            if ent.get("delete_marker"):
+                raise NoSuchKey(f"{bucket}/{key}@{version_id} "
+                                f"is a delete marker")
+        else:
+            ent = self._stat_entry(bucket, key)
         if "manifest" in ent:
             return self._read_manifest(bucket, key, ent, offset, length)
-        soid = self._data_obj(bucket, key)
+        soid = ent.get("soid") or self._data_obj(bucket, key)
         try:
             if length is None:
                 length = max(0, ent["size"] - offset)
@@ -223,12 +467,59 @@ class Gateway:
         except KeyError:
             raise NoSuchKey(f"{bucket}/{key}") from None
 
-    def head_object(self, bucket: str, key: str) -> dict:
+    def head_object(self, bucket: str, key: str,
+                    version_id: str | None = None) -> dict:
         self._check_bucket(bucket)
+        if version_id is not None:
+            ent = self._stat_version(bucket, key, version_id)
+            if ent.get("delete_marker"):
+                # S3 fails HEAD on a marker too (405 +
+                # x-amz-delete-marker); succeeding here while GET
+                # refuses would split the surface
+                raise NoSuchKey(f"{bucket}/{key}@{version_id} "
+                                f"is a delete marker")
+            return ent
         return self._stat_entry(bucket, key)
 
-    def delete_object(self, bucket: str, key: str) -> None:
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str | None = None) -> dict:
+        """DELETE. Unversioned bucket: remove key + payload. Versioned,
+        no version_id: write a delete marker (payloads stay). With
+        version_id: permanently remove THAT version and its payload.
+        Returns {'delete_marker': bool, 'version_id': str|None}."""
         self._check_bucket(bucket)
+        status = self._versioning(bucket)
+        if version_id is not None:
+            if status == "Off":
+                raise NoSuchKey(f"{bucket}/{key}@{version_id}")
+            try:
+                removed = json.loads(self.io.execute(
+                    self._index_obj(bucket), "rgw_index", "rm_version",
+                    json.dumps({"key": key,
+                                "vid": version_id}).encode()))
+            except ClsError:
+                raise NoSuchKey(f"{bucket}/{key}@{version_id}") \
+                    from None
+            self._wipe_version_payload(removed)
+            return {"delete_marker": bool(removed.get("delete_marker")),
+                    "version_id": version_id}
+        if status != "Off":
+            # a marker needs SOMETHING to mark: a current entry or
+            # existing version history (S3 would even mark a
+            # never-seen key; refusing those keeps delete-of-nothing
+            # an error, consistent with the unversioned path)
+            try:
+                self._stat_entry(bucket, key)
+            except NoSuchKey:
+                out = json.loads(self.io.execute(
+                    self._index_obj(bucket), "rgw_index",
+                    "has_versions", json.dumps({"key": key}).encode()))
+                if not out["any"]:
+                    raise
+            vid = self._record_version(
+                bucket, key, self._next_vid(bucket, status),
+                size=0, etag="", delete_marker=True)
+            return {"delete_marker": True, "version_id": vid}
         ent = self._stat_entry(bucket, key)
         if "manifest" in ent:
             for part_soid in ent["manifest"]:
@@ -237,6 +528,7 @@ class Gateway:
             self._wipe_striped(self._data_obj(bucket, key))
         self.io.execute(self._index_obj(bucket), "rgw_index", "rm",
                         json.dumps({"key": key}).encode())
+        return {"delete_marker": False, "version_id": None}
 
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", limit: int = 1000) -> dict:
@@ -321,6 +613,18 @@ class Gateway:
         sizes = [p["size"] for _, p in parts]
         etag = self._etag("".join(p["etag"] for _, p in parts).encode()) \
             + f"-{len(parts)}"
+        status = self._versioning(bucket)
+        if status != "Off":
+            # versioned completion: the manifest IS the version's
+            # payload (part objects are unique per upload_id, so
+            # history never collides); nothing existing is wiped
+            # except a replaced null version under Suspended
+            self._record_version(
+                bucket, key, self._next_vid(bucket, status),
+                size=sum(sizes), etag=etag, manifest=manifest,
+                part_sizes=sizes)
+            self.io.remove(meta_obj)
+            return etag
         # replacing an existing entry: wipe a previous upload's
         # manifest parts AND a previous plain object's data (the new
         # entry is manifest-backed, so the plain soid would orphan)

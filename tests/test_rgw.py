@@ -315,3 +315,154 @@ class TestS3Auth:
         assert bob.list_buckets() == ["bobs"]
         assert alice.list_buckets() == ["alices"]
         assert alice.get_object("alices", "doc") == b"hers"
+
+
+class TestVersioning:
+    """S3 bucket versioning (ref: rgw_bucket_dir_entry instances;
+    S3 Enabled/Suspended semantics, delete markers, null versions)."""
+
+    def _vb(self):
+        c, gw = mk()
+        gw.create_bucket("vb")
+        gw.set_bucket_versioning("vb", True)
+        return c, gw
+
+    def test_status_transitions(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        assert gw.get_bucket_versioning("b") == "Off"
+        gw.set_bucket_versioning("b", True)
+        assert gw.get_bucket_versioning("b") == "Enabled"
+        gw.set_bucket_versioning("b", False)
+        assert gw.get_bucket_versioning("b") == "Suspended"
+
+    def test_puts_append_versions_and_get_by_vid(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"version one")
+        gw.put_object("vb", "k", b"version two")
+        gw.put_object("vb", "k", b"version three")
+        assert gw.get_object("vb", "k") == b"version three"
+        vs = gw.list_object_versions("vb")["versions"]
+        assert [v["is_latest"] for v in vs] == [True, False, False]
+        vids = [v["vid"] for v in vs]          # newest first
+        assert gw.get_object("vb", "k", version_id=vids[2]) \
+            == b"version one"
+        assert gw.get_object("vb", "k", version_id=vids[1]) \
+            == b"version two"
+        assert gw.head_object("vb", "k",
+                              version_id=vids[2])["size"] == 11
+
+    def test_unversioned_delete_writes_marker_and_undelete(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"precious")
+        res = gw.delete_object("vb", "k")
+        assert res["delete_marker"] is True
+        with pytest.raises(NoSuchKey):
+            gw.get_object("vb", "k")           # current view gone
+        vs = gw.list_object_versions("vb")["versions"]
+        assert vs[0]["delete_marker"] and vs[0]["is_latest"]
+        # the old payload is still there by vid
+        assert gw.get_object("vb", "k",
+                             version_id=vs[1]["vid"]) == b"precious"
+        # removing the MARKER by vid undeletes (S3 undelete recipe)
+        gw.delete_object("vb", "k", version_id=res["version_id"])
+        assert gw.get_object("vb", "k") == b"precious"
+
+    def test_delete_specific_version_permanent(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"one")
+        gw.put_object("vb", "k", b"two")
+        vs = gw.list_object_versions("vb")["versions"]
+        old_vid = vs[1]["vid"]
+        gw.delete_object("vb", "k", version_id=old_vid)
+        with pytest.raises(NoSuchKey):
+            gw.get_object("vb", "k", version_id=old_vid)
+        assert gw.get_object("vb", "k") == b"two"   # latest untouched
+        # deleting the LAST version removes the key entirely
+        cur = gw.list_object_versions("vb")["versions"]
+        gw.delete_object("vb", "k", version_id=cur[0]["vid"])
+        with pytest.raises(NoSuchKey):
+            gw.get_object("vb", "k")
+        assert gw.list_object_versions("vb")["versions"] == []
+
+    def test_suspended_null_version_replaces(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"enabled era")
+        gw.set_bucket_versioning("vb", False)    # suspend
+        gw.put_object("vb", "k", b"null one")
+        gw.put_object("vb", "k", b"null two")    # replaces null one
+        assert gw.get_object("vb", "k") == b"null two"
+        vs = gw.list_object_versions("vb")["versions"]
+        assert [v["vid"] == "null" for v in vs] == [True, False]
+        assert len(vs) == 2                      # enabled-era + null
+        assert gw.get_object("vb", "k",
+                             version_id=vs[1]["vid"]) == b"enabled era"
+
+    def test_legacy_object_materializes_as_null(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "k", b"pre-versioning")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "k", b"post-versioning")
+        vs = gw.list_object_versions("b")["versions"]
+        assert [v["vid"] for v in vs][-1] == "null"   # oldest = legacy
+        assert gw.get_object("b", "k", version_id="null") \
+            == b"pre-versioning"
+        assert gw.get_object("b", "k") == b"post-versioning"
+
+    def test_multipart_versions(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"plain old")
+        uid = gw.initiate_multipart("vb", "k")
+        gw.upload_part("vb", "k", uid, 1, b"P" * 70000)
+        gw.upload_part("vb", "k", uid, 2, b"Q" * 50000)
+        gw.complete_multipart("vb", "k", uid)
+        assert gw.get_object("vb", "k") == b"P" * 70000 + b"Q" * 50000
+        vs = gw.list_object_versions("vb")["versions"]
+        assert gw.get_object("vb", "k",
+                             version_id=vs[1]["vid"]) == b"plain old"
+        # deleting the multipart VERSION wipes its parts, not history
+        gw.delete_object("vb", "k", version_id=vs[0]["vid"])
+        assert gw.get_object("vb", "k") == b"plain old"
+
+    def test_delete_bucket_blocked_by_noncurrent(self):
+        c, gw = self._vb()
+        gw.put_object("vb", "k", b"v")
+        gw.delete_object("vb", "k")              # marker: list empty
+        assert gw.list_objects("vb")["entries"] == []
+        with pytest.raises(GatewayError, match="BucketNotEmpty"):
+            gw.delete_bucket("vb")
+        vs = gw.list_object_versions("vb")["versions"]
+        for v in vs:
+            gw.delete_object("vb", "k", version_id=v["vid"])
+        gw.delete_bucket("vb")                   # now truly empty
+
+    def test_versioning_over_signed_surface(self):
+        import time as _t
+        c, gw = mk()
+        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
+        users = UserStore()
+        access, secret = users.create_user("alice")
+        agw = AuthedGateway(gw, users)
+        s3 = S3Client(agw, access, secret)
+        s3.create_bucket("b")
+        s3.put_bucket_versioning("b", True)
+        assert s3.get_bucket_versioning("b") == "Enabled"
+        s3.put_object("b", "k", b"one")
+        s3.put_object("b", "k", b"two")
+        vs = s3.list_object_versions("b")["versions"]
+        assert s3.get_object("b", "k",
+                             version_id=vs[1]["vid"]) == b"one"
+        res = s3.delete_object("b", "k")
+        assert res["delete_marker"] is True
+        # version_id is inside the signed canonical request: a
+        # tampered vid must not verify
+        from ceph_tpu.rgw.auth import SignatureDoesNotMatch, amz_date, sign
+        date = amz_date(_t.time())
+        sig = sign(secret, date, "get_object", "b", "k", "n1",
+                   {"offset": 0, "length": None,
+                    "version_id": vs[1]["vid"]}, b"")
+        with pytest.raises(SignatureDoesNotMatch):
+            agw.call(access, date, sig, "get_object", bucket="b",
+                     key="k", nonce="n1", payload=b"", offset=0,
+                     length=None, version_id=vs[0]["vid"])
