@@ -54,7 +54,8 @@ class SimCluster:
                  n_mons: int = 3,
                  hosts_per_rack: int | None = None,
                  store: str = "mem",
-                 store_dir: str | None = None):
+                 store_dir: str | None = None,
+                 store_compression: str | None = None):
         if hosts_per_rack is None:
             hosts_per_rack = max(4, n_osds)  # one big rack by default
         crush = build_hierarchy(n_osds, osds_per_host=osds_per_host,
@@ -71,6 +72,18 @@ class SimCluster:
         # really recovers from WAL+checkpoint — measured, not assumed)
         if store not in ("mem", "tin"):
             raise ValueError(f"store={store!r} not in ('mem', 'tin')")
+        if store_compression is not None:
+            from .tinstore import TinStore
+            if store != "tin":
+                raise ValueError("store_compression requires "
+                                 "store='tin' (MemStore never "
+                                 "compresses — a silent no-op would "
+                                 "fake a compressed-path test)")
+            if store_compression not in TinStore.COMPRESSION_ALGS:
+                raise ValueError(
+                    f"unknown store_compression "
+                    f"{store_compression!r}; use one of "
+                    f"{TinStore.COMPRESSION_ALGS}")
         self.store_kind = store
         self.store_dir = store_dir
         if store == "tin":
@@ -89,7 +102,11 @@ class SimCluster:
             # device-read path, not an accidental RAM mirror
             self.cluster.store_factory = lambda o: TinStore(
                 _os.path.join(self.store_dir, f"osd.{o}"),
-                verify_reads=False, cache_bytes=32 << 10)
+                verify_reads=False, cache_bytes=32 << 10,
+                compression=store_compression,
+                # sim-scale blobs are far below the production 4 KiB
+                # floor; compress anything that plausibly shrinks
+                compression_min_blob=64)
         self.profile = profile
         # pool type switch (ref: pg_pool_t TYPE_REPLICATED vs
         # TYPE_ERASURE; PrimaryLogPG drives either through PGBackend):

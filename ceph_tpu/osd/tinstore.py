@@ -39,6 +39,15 @@ ref: src/os/ObjectStore.h Transaction/queue_transaction):
   r3 whole-store serialize is gone. Replay seq-skips records the
   checkpoint covers, so a crash between rename and reset
   double-applies nothing.
+* INLINE COMPRESSION (opt-in). With `compression=` ("zlib"/"lzma"),
+  blobs >= compression_min_blob that shrink to at most
+  compression_required_ratio of raw are stored COMPRESSED (the
+  BlueStore bluestore_compression_* decision, mode=aggressive): the
+  device holds the compressed stream in a smaller extent, metadata
+  carries (calg, clen, ccrc) alongside the logical crc, reads verify
+  the stored bytes, inflate (bounded by the logical size — a bomb
+  fails, it doesn't OOM), then verify the logical crc. Blobs that
+  don't earn their keep stay raw; reads are transparent either way.
 * VERIFY-ON-READ. Each object's crc32c (native C kernel, parity with
   ceph_crc32c) is computed when its bytes are staged and re-checked
   when a read misses the cache (and on every read of cached bytes);
@@ -78,7 +87,7 @@ from .memstore import MemStore, Transaction, _Object  # noqa: F401 — _Object
 
 _REC_MAGIC = 0x544E4952    # "RINT" little-endian: record
 _REC_HDR = struct.Struct("<IQI")     # magic, seq, body_len
-_CKPT_VERSION = 2
+_CKPT_VERSION = 3   # v3: per-object compression triple (calg, clen, ccrc)
 _ALLOC_UNIT = 4096
 
 
@@ -192,6 +201,14 @@ def _encode_meta_op(e: Encoder, op: tuple) -> None:
         e.string(kind)
         e.string(op[1]).string(op[2])
         e.u64(op[3]).u64(op[4]).u64(op[5]).u32(op[6])
+    elif kind == "setextc":
+        # compressed extent: a DISTINCT kind (not extra fields on
+        # setext) so stores written before compression existed replay
+        # unchanged
+        e.string(kind)
+        e.string(op[1]).string(op[2])
+        e.u64(op[3]).u64(op[4]).u64(op[5]).u32(op[6])
+        e.string(op[7]).u64(op[8]).u32(op[9])
     else:
         _encode_op(e, op)
 
@@ -201,6 +218,10 @@ def _decode_meta_op(d: Decoder) -> tuple:
     if kind == "setext":
         return (kind, d.string(), d.string(),
                 d.u64(), d.u64(), d.u64(), d.u32())
+    if kind == "setextc":
+        return (kind, d.string(), d.string(),
+                d.u64(), d.u64(), d.u64(), d.u32(),
+                d.string(), d.u64(), d.u32())
     if kind in ("mkcoll", "rmcoll"):
         return (kind, d.string())
     if kind in ("touch", "remove", "omap_clear"):
@@ -359,15 +380,25 @@ class _BufferCache:
 
 
 class _TinObject:
-    """Metadata record: where the bytes live, how big, their crc."""
+    """Metadata record: where the bytes live, how big, their crc.
+    Compressed blobs (calg != "") additionally carry the STORED
+    length (clen) and a crc over the stored bytes (ccrc) — the
+    BlueStore per-blob compressed_length + csum-on-stored-data pair;
+    `crc` is always over the LOGICAL bytes."""
 
-    __slots__ = ("size", "doff", "dlen", "crc", "xattrs", "omap")
+    __slots__ = ("size", "doff", "dlen", "crc", "xattrs", "omap",
+                 "calg", "clen", "ccrc")
 
     def __init__(self, size=0, doff=0, dlen=0, crc=0,
-                 xattrs=None, omap=None):
+                 xattrs=None, omap=None, calg="", clen=0, ccrc=0):
         self.size, self.doff, self.dlen, self.crc = size, doff, dlen, crc
         self.xattrs: dict[str, bytes] = xattrs if xattrs is not None else {}
         self.omap: dict[bytes, bytes] = omap if omap is not None else {}
+        self.calg, self.clen, self.ccrc = calg, clen, ccrc
+
+    @property
+    def stored_len(self) -> int:
+        return self.clen if self.calg else self.size
 
 
 # -- collections view (test/scrub poke surface) -------------------------------
@@ -392,8 +423,11 @@ class _ObjProxy:
         self._st._cache.drop((self._cid, self._oid))
         if o.size == 0:
             return np.zeros(0, dtype=np.uint8)
+        # the STORED bytes (compressed blobs expose the compressed
+        # stream): pokes are device-plane damage either way, caught
+        # by ccrc (compressed) or crc (raw) on the next read
         return np.memmap(self._st._dev_path, dtype=np.uint8, mode="r+",
-                         offset=o.doff, shape=(o.size,))
+                         offset=o.doff, shape=(o.stored_len,))
 
     @property
     def xattrs(self) -> dict[str, bytes]:
@@ -444,15 +478,34 @@ class TinStore:
     allocator, metadata WAL + checkpoints, bounded LRU buffer cache,
     crc32c verify-on-read. Interface == MemStore."""
 
+    COMPRESSION_ALGS = ("zlib", "lzma")
+
     def __init__(self, path: str, o_dsync: bool = False,
                  verify_reads: bool = True,
                  wal_max_bytes: int = 64 << 20,
-                 cache_bytes: int = 64 << 20):
+                 cache_bytes: int = 64 << 20,
+                 compression: str | None = None,
+                 compression_min_blob: int = 4096,
+                 compression_required_ratio: float = 0.875):
+        if compression is not None \
+                and compression not in self.COMPRESSION_ALGS:
+            raise ValueError(f"unknown compression {compression!r}; "
+                             f"use one of {self.COMPRESSION_ALGS}")
         self.path = path
         self.o_dsync = o_dsync
         self.verify_reads = verify_reads
         self.wal_max_bytes = wal_max_bytes
         self.cache_bytes = cache_bytes
+        # inline compression (ref: BlueStore _do_write compression
+        # decision: bluestore_compression_{algorithm,min_blob_size,
+        # required_ratio}): blobs >= min_blob that shrink to at most
+        # required_ratio of raw are stored compressed; everything
+        # else stays raw. Reads are transparent either way.
+        self.compression = compression
+        self.compression_min_blob = compression_min_blob
+        self.compression_required_ratio = compression_required_ratio
+        self.compress_stats = {"compressed_blobs": 0, "raw_blobs": 0,
+                               "logical_bytes": 0, "stored_bytes": 0}
         self._lock = threading.RLock()
         self._meta: dict[str, dict[str, _TinObject]] | None = None
         self._alloc = ExtentAllocator()
@@ -648,6 +701,8 @@ class TinStore:
                     e.u64(o.size).u64(o.doff).u64(o.dlen).u32(o.crc)
                     e.mapping(o.xattrs, Encoder.string, Encoder.blob)
                     e.mapping(o.omap, Encoder.blob, Encoder.blob)
+                    # v3: compression triple
+                    e.string(o.calg).u64(o.clen).u32(o.ccrc)
             e.finish()
             body = e.bytes()
             body += struct.pack("<I", _crc32c(body))
@@ -674,7 +729,7 @@ class TinStore:
             raise TinStoreCorruption(f"{self._ckpt_path}: file seal "
                                      f"crc mismatch")
         d = Decoder(raw[:-4])
-        d.start(_CKPT_VERSION)
+        v = d.start(_CKPT_VERSION)
         seq = d.u64()
         self.committed_txns = d.u64()
         for _ in range(d.u32()):
@@ -685,8 +740,12 @@ class TinStore:
                 size, doff, dlen, ocrc = d.u64(), d.u64(), d.u64(), d.u32()
                 xattrs = d.mapping(Decoder.string, Decoder.blob)
                 omap = d.mapping(Decoder.blob, Decoder.blob)
+                if v >= 3:
+                    calg, clen, ccrc = d.string(), d.u64(), d.u32()
+                else:
+                    calg, clen, ccrc = "", 0, 0
                 coll[oid] = _TinObject(size, doff, dlen, ocrc,
-                                       xattrs, omap)
+                                       xattrs, omap, calg, clen, ccrc)
         d.finish()
         return seq
 
@@ -774,17 +833,57 @@ class TinStore:
             return self._object_bytes(cid, oid)
         return np.zeros(0, dtype=np.uint8)
 
+    @staticmethod
+    def _compress(alg: str, raw: bytes) -> bytes:
+        if alg == "zlib":
+            import zlib
+            return zlib.compress(raw, 3)
+        import lzma
+        return lzma.compress(raw, preset=0)
+
+    @staticmethod
+    def _decompress(alg: str, stored: bytes, logical_size: int) -> bytes:
+        """Bounded decompress: never inflate past the metadata's
+        logical size (a corrupt/bombed blob fails, it doesn't OOM)."""
+        if alg == "zlib":
+            import zlib
+            dec = zlib.decompressobj()
+        else:
+            import lzma
+            dec = lzma.LZMADecompressor()
+        out = dec.decompress(stored, logical_size + 1)
+        return out
+
     def _stage(self, staged, new_extents, cid, oid,
                arr: np.ndarray) -> tuple:
         """COW the object's new bytes into a fresh extent; return the
-        setext metadata op. Nothing commits until the WAL record."""
-        doff, dlen = self._alloc.alloc(len(arr))
+        setext/setextc metadata op. Nothing commits until the WAL
+        record. Compression happens HERE (the _do_write decision):
+        the device and the crc-on-stored-bytes see compressed data,
+        the cache and the logical crc see raw data."""
+        stored = arr.tobytes()
+        calg = ""
+        if self.compression is not None \
+                and len(arr) >= self.compression_min_blob:
+            comp = self._compress(self.compression, stored)
+            if len(comp) <= self.compression_required_ratio * len(arr):
+                stored, calg = comp, self.compression
+        doff, dlen = self._alloc.alloc(len(stored))
         if self._alloc.device_size > os.fstat(self._dev_fd).st_size:
             os.ftruncate(self._dev_fd, self._alloc.device_size)
-        if len(arr):
-            os.pwrite(self._dev_fd, arr.tobytes(), doff)
+        if stored:
+            os.pwrite(self._dev_fd, stored, doff)
         new_extents.append((doff, dlen))
         staged[(cid, oid)] = arr
+        st = self.compress_stats
+        st["logical_bytes"] += len(arr)
+        st["stored_bytes"] += len(stored)
+        if calg:
+            st["compressed_blobs"] += 1
+            return ("setextc", cid, oid, doff, dlen, len(arr),
+                    _crc32c(arr), calg, len(stored),
+                    _crc32c(np.frombuffer(stored, np.uint8)))
+        st["raw_blobs"] += 1
         return ("setext", cid, oid, doff, dlen, len(arr), _crc32c(arr))
 
     def _validate(self, txn: Transaction) -> None:
@@ -820,12 +919,16 @@ class TinStore:
                 self._cache.drop_coll(op[1])
         elif kind == "touch":
             meta[op[1]].setdefault(op[2], _TinObject())
-        elif kind == "setext":
-            _, cid, oid, doff, dlen, size, crc = op
+        elif kind in ("setext", "setextc"):
+            _, cid, oid, doff, dlen, size, crc = op[:7]
             o = meta[cid].setdefault(oid, _TinObject())
             if live and o.dlen and (o.doff, o.dlen) != (doff, dlen):
                 self._alloc.free(o.doff, o.dlen)
             o.doff, o.dlen, o.size, o.crc = doff, dlen, size, crc
+            if kind == "setextc":
+                o.calg, o.clen, o.ccrc = op[7], op[8], op[9]
+            else:
+                o.calg, o.clen, o.ccrc = "", 0, 0
         elif kind == "remove":
             o = meta[op[1]].pop(op[2], None)
             if live:
@@ -868,7 +971,25 @@ class TinStore:
             return arr
         if o.size == 0:
             return np.zeros(0, dtype=np.uint8)
-        raw = os.pread(self._dev_fd, o.size, o.doff)
+        raw = os.pread(self._dev_fd, o.stored_len, o.doff)
+        if o.calg:
+            # verify the STORED bytes first (device-plane damage is
+            # caught before the decompressor sees it), then inflate
+            # and verify the logical crc
+            if self.verify_reads \
+                    and _crc32c(np.frombuffer(raw, np.uint8)) != o.ccrc:
+                raise TinStoreCorruption(
+                    f"{cid}/{oid}: stored-bytes crc mismatch "
+                    f"(compressed blob, verify-on-read)")
+            try:
+                raw = self._decompress(o.calg, raw, o.size)
+            except Exception as e:   # noqa: BLE001 — corrupt stream
+                raise TinStoreCorruption(
+                    f"{cid}/{oid}: decompress failed: {e}") from None
+            if len(raw) != o.size:
+                raise TinStoreCorruption(
+                    f"{cid}/{oid}: decompressed {len(raw)} bytes, "
+                    f"expected {o.size}")
         arr = np.frombuffer(raw, dtype=np.uint8)
         if self.verify_reads:
             self._verify(cid, oid, arr, o.crc)
@@ -1009,8 +1130,28 @@ class TinStore:
                                 f"{cid}/{oid}: {e}")
                             continue
                     if o.size and dev_fd is not None:
-                        raw = os.pread(dev_fd, o.size, o.doff)
-                        if _crc32c(np.frombuffer(raw, np.uint8)) != o.crc:
+                        raw = os.pread(dev_fd, o.stored_len, o.doff)
+                        sarr = np.frombuffer(raw, np.uint8)
+                        if o.calg:
+                            # stored-bytes seal first, then inflate
+                            # and audit the logical crc too
+                            if _crc32c(sarr) != o.ccrc:
+                                report["bad_objects"].append(
+                                    f"{cid}/{oid}")
+                                continue
+                            try:
+                                raw = TinStore._decompress(
+                                    o.calg, raw, o.size)
+                            except Exception:  # noqa: BLE001
+                                report["bad_objects"].append(
+                                    f"{cid}/{oid}")
+                                continue
+                            if len(raw) != o.size:
+                                report["bad_objects"].append(
+                                    f"{cid}/{oid}")
+                                continue
+                            sarr = np.frombuffer(raw, np.uint8)
+                        if _crc32c(sarr) != o.crc:
                             report["bad_objects"].append(f"{cid}/{oid}")
         finally:
             if dev_fd is not None:

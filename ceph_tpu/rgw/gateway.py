@@ -219,9 +219,15 @@ def _idx_rm_version(h: ClsHandle, inp: bytes) -> bytes:
 
 @register_cls("rgw_index", "has_versions")
 def _idx_has_versions(h: ClsHandle, inp: bytes) -> bytes:
-    key = json.loads(inp)["key"]
-    return json.dumps(
-        {"any": bool(h.kv.get("versions", {}).get(key))}).encode()
+    """O(1) membership probe: key given -> that key has history;
+    no key -> ANY key does (the delete_bucket emptiness check)."""
+    key = json.loads(inp or b"{}").get("key")
+    versions = h.kv.get("versions", {})
+    if key is None:
+        any_v = any(bool(v) for v in versions.values())
+    else:
+        any_v = bool(versions.get(key))
+    return json.dumps({"any": any_v}).encode()
 
 
 @register_cls("rgw_index", "stat_version")
@@ -306,7 +312,9 @@ class Gateway:
         listing = self.list_objects(bucket, limit=1)
         if listing["entries"]:
             raise GatewayError(f"BucketNotEmpty: {bucket}")
-        if self.list_object_versions(bucket)["versions"]:
+        out = json.loads(self.io.execute(
+            self._index_obj(bucket), "rgw_index", "has_versions"))
+        if out["any"]:
             # S3: noncurrent versions and delete markers also block
             # bucket deletion — their payloads would orphan
             raise GatewayError(f"BucketNotEmpty: {bucket} "
@@ -338,7 +346,17 @@ class Gateway:
 
     @staticmethod
     def _vdata_obj(bucket: str, key: str, vid: str) -> str:
-        return f".rgw.data.{bucket}.{key}.v.{vid}"
+        # A namespace of its own, collision-free by construction:
+        # '.bucket.vdata.' is disjoint from _data_obj/_upload_obj
+        # prefixes; '/' joins bucket to key exactly like _data_obj
+        # ('.'-joining would let ('b.k','x') and ('b','k.x') share a
+        # soid — bucket names may contain '.'); and within the
+        # namespace (key, vid) -> f"{key}.v.{vid}" is injective
+        # because vids match ^(null|v\d{8})$ — suffixes of equal vids
+        # force equal keys, and 'null' vs 'v\d{8}' differ in both
+        # length-tail and final character, so no key can absorb the
+        # difference.
+        return f".bucket.vdata.{bucket}/{key}.v.{vid}"
 
     def set_bucket_versioning(self, bucket: str, enabled: bool) -> None:
         """PutBucketVersioning: Enabled / Suspended (a bucket that was
