@@ -86,9 +86,12 @@ class Objecter:
                        for _, _, d in payload)
         return 0  # reads are charged on the reply side in the reference
 
-    def _submit(self, kind: str, ps: int, payload) -> object:
+    def _submit(self, kind: str, ps: int, payload,
+                snapc: int = 0) -> object:
         """Send one PG-targeted op; retarget + resend on staleness
-        (the while loop is _op_submit's resend-on-new-map path)."""
+        (the while loop is _op_submit's resend-on-new-map path).
+        `snapc` is the newest snap id the caller's SnapContext names
+        (selfmanaged-snap pools; 0 = no snaps follow this writer)."""
         from ..osd.cluster import StaleMap
         cost = self._payload_bytes(kind, payload)
         if cost and not self.op_throttle.get_or_fail(cost):
@@ -103,7 +106,8 @@ class Objecter:
                 try:
                     with self._dispatch_lock:
                         return self.cluster.client_rpc(
-                            primary, self._epoch, kind, ps, payload)
+                            primary, self._epoch, kind, ps, payload,
+                            snapc=snapc)
                 except StaleMap:
                     self._refresh()
             raise ObjecterError(
@@ -113,18 +117,20 @@ class Objecter:
             if cost:
                 self.op_throttle.put(cost)
 
-    def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
+    def write(self, objects: dict[str, bytes | np.ndarray],
+              snapc: int = 0) -> None:
         by_pg: dict[int, dict] = {}
         for name, data in objects.items():
             ps, _ = self._calc_target(name)
             by_pg.setdefault(ps, {})[name] = data
         for ps, group in by_pg.items():
-            self._submit("write", ps, group)
+            self._submit("write", ps, group, snapc=snapc)
 
     def write_at(self, name: str, offset: int,
-                 data: bytes | np.ndarray) -> None:
+                 data: bytes | np.ndarray, snapc: int = 0) -> None:
         ps, _ = self._calc_target(name)
-        self._submit("write_ranges", ps, [(name, offset, data)])
+        self._submit("write_ranges", ps, [(name, offset, data)],
+                     snapc=snapc)
 
     def _by_pg(self, names: list[str]) -> dict[int, list[str]]:
         by_pg: dict[int, list[str]] = {}
@@ -133,10 +139,10 @@ class Objecter:
             by_pg.setdefault(ps, []).append(name)
         return by_pg
 
-    def remove(self, names: list[str] | str) -> None:
+    def remove(self, names: list[str] | str, snapc: int = 0) -> None:
         names_l = [names] if isinstance(names, str) else list(names)
         for ps, group in self._by_pg(names_l).items():
-            self._submit("remove", ps, group)
+            self._submit("remove", ps, group, snapc=snapc)
 
     def read(self, names: list[str] | str) -> dict[str, np.ndarray]:
         single = isinstance(names, str)

@@ -50,12 +50,13 @@ class IoCtx:
 
     # -- object ops (librados C API names) ----------------------------------
 
-    def write_full(self, name: str, data: bytes | np.ndarray) -> None:
-        self._ob.write({name: data})
+    def write_full(self, name: str, data: bytes | np.ndarray,
+                   snapc: int = 0) -> None:
+        self._ob.write({name: data}, snapc=snapc)
 
     def write(self, name: str, data: bytes | np.ndarray,
-              offset: int = 0) -> None:
-        self._ob.write_at(name, offset, data)
+              offset: int = 0, snapc: int = 0) -> None:
+        self._ob.write_at(name, offset, data, snapc=snapc)
 
     def read(self, name: str, length: int | None = None,
              offset: int = 0, snap: int | None = None) -> bytes:
@@ -70,8 +71,8 @@ class IoCtx:
             return arr[offset:].tobytes()
         return arr[offset:offset + length].tobytes()
 
-    def remove(self, name: str) -> None:
-        self._ob.remove(name)
+    def remove(self, name: str, snapc: int = 0) -> None:
+        self._ob.remove(name, snapc=snapc)
 
     def stat(self, name: str) -> int:
         """Object size in bytes (rados_stat's pmtime is meaningless in
@@ -97,6 +98,19 @@ class IoCtx:
 
     def snap_list(self) -> list[int]:
         return sorted(self.rados.cluster.snaps)
+
+    # -- selfmanaged snaps (rados_ioctx_selfmanaged_snap_*) -----------------
+
+    def selfmanaged_snap_create(self) -> int:
+        return self.rados.cluster.selfmanaged_snap_create()
+
+    def selfmanaged_snap_remove(self, snap_id: int) -> int:
+        return self.rados.cluster.selfmanaged_snap_remove(snap_id)
+
+    def snap_changed(self, name: str, snap_id: int) -> bool:
+        """Fast-diff primitive: head diverged from its state at the
+        snap? (metadata-only; ref: librbd fast-diff / object map)"""
+        return self.rados.cluster.snap_changed(name, snap_id)
 
     # -- watch / notify (rados_watch3/rados_notify2) ------------------------
 
@@ -163,13 +177,14 @@ class RadosStriper:
             yield q, ooff, pos, take
             pos += take
 
-    def _read_meta(self, soid: str) -> tuple[int, int]:
+    def _read_meta(self, soid: str,
+                   snap: int | None = None) -> tuple[int, int]:
         """(logical size, high-water-mark size). The hwm tracks the
         LARGEST size the stream ever had, so remove() can find pieces
         a later truncate-shrink left behind (zeroed but extant). Old
         8-byte metas (pre-hwm) read back hwm == size."""
         try:
-            raw = bytes(self.io.read(self._meta(soid)))
+            raw = bytes(self.io.read(self._meta(soid), snap=snap))
         except KeyError:
             raise KeyError(f"no striped object {soid!r}")
         size = int.from_bytes(raw[:8], "little")
@@ -177,33 +192,35 @@ class RadosStriper:
             else size
         return size, max(size, hwm)
 
-    def _write_meta(self, soid: str, size: int, hwm: int) -> None:
+    def _write_meta(self, soid: str, size: int, hwm: int,
+                    snapc: int = 0) -> None:
         self.io.write_full(self._meta(soid),
                            size.to_bytes(8, "little")
-                           + hwm.to_bytes(8, "little"))
+                           + hwm.to_bytes(8, "little"), snapc=snapc)
 
-    def size(self, soid: str) -> int:
-        return self._read_meta(soid)[0]
+    def size(self, soid: str, snap: int | None = None) -> int:
+        return self._read_meta(soid, snap=snap)[0]
 
     def write(self, soid: str, data: bytes | np.ndarray,
-              offset: int = 0) -> None:
+              offset: int = 0, snapc: int = 0) -> None:
         arr = np.frombuffer(bytes(data), dtype=np.uint8) \
             if isinstance(data, (bytes, bytearray, memoryview)) \
             else np.asarray(data, np.uint8).reshape(-1)
         for q, ooff, lpos, ln in self._extents(offset, len(arr)):
             piece = arr[lpos - offset:lpos - offset + ln]
-            self.io.write(self._obj(soid, q), piece, offset=ooff)
+            self.io.write(self._obj(soid, q), piece, offset=ooff,
+                          snapc=snapc)
         try:
             cur, hwm = self._read_meta(soid)
         except KeyError:
             cur = hwm = 0
         new = max(cur, offset + len(arr))
         if new != cur:
-            self._write_meta(soid, new, max(hwm, new))
+            self._write_meta(soid, new, max(hwm, new), snapc=snapc)
 
     def read(self, soid: str, length: int | None = None,
-             offset: int = 0) -> bytes:
-        total = self.size(soid)
+             offset: int = 0, snap: int | None = None) -> bytes:
+        total = self.size(soid, snap=snap)
         if length is None:
             length = max(0, total - offset)
         length = min(length, max(0, total - offset))
@@ -215,8 +232,8 @@ class RadosStriper:
             name = self._obj(soid, q)
             if name not in cache:
                 try:
-                    cache[name] = np.frombuffer(self.io.read(name),
-                                                dtype=np.uint8)
+                    cache[name] = np.frombuffer(
+                        self.io.read(name, snap=snap), dtype=np.uint8)
                 except KeyError:
                     cache[name] = np.zeros(0, dtype=np.uint8)
             obj = cache[name]
@@ -225,7 +242,7 @@ class RadosStriper:
         return out.tobytes()
 
     def truncate(self, soid: str, new_size: int,
-                 zero_chunk: int = 1 << 20) -> None:
+                 zero_chunk: int = 1 << 20, snapc: int = 0) -> None:
         """Shrink (or grow) the logical stream. A shrink ZEROES the
         discarded range before dropping the size, so a later re-grow
         reads zeros there, not resurrected bytes (the block-device
@@ -237,11 +254,12 @@ class RadosStriper:
             pos = new_size
             while pos < old:
                 n = min(zero_chunk, old - pos)
-                self.write(soid, b"\x00" * n, offset=pos)
+                self.write(soid, b"\x00" * n, offset=pos, snapc=snapc)
                 pos += n
-        self._write_meta(soid, new_size, max(hwm, new_size))
+        self._write_meta(soid, new_size, max(hwm, new_size),
+                         snapc=snapc)
 
-    def remove(self, soid: str) -> None:
+    def remove(self, soid: str, snapc: int = 0) -> None:
         # walk to the HIGH-WATER mark, not the current size: a
         # truncate-shrink keeps (zeroed) pieces past the new boundary
         # that a size-bounded walk would leak forever
@@ -249,7 +267,7 @@ class RadosStriper:
         qs = {q for q, _, _, _ in self._extents(0, max(hwm, 1))}
         for q in sorted(qs):
             try:
-                self.io.remove(self._obj(soid, q))
+                self.io.remove(self._obj(soid, q), snapc=snapc)
             except KeyError:
                 pass  # sparse stripe: unit never written
-        self.io.remove(self._meta(soid))
+        self.io.remove(self._meta(soid), snapc=snapc)

@@ -193,6 +193,14 @@ class SimCluster:
         # hash to their own PG rather than the head's), metadata here
         self.snap_seq = 0
         self.snaps: dict[int, float] = {}          # id -> ctime
+        # self-managed snaps (ref: pg_pool_t FLAG_SELFMANAGED_SNAPS;
+        # librados selfmanaged_snap_create + per-op SnapContext): ids
+        # share the pool seq space, but COW is driven by the snapc the
+        # CLIENT sends with each write, not the pool's own snap list —
+        # how RBD gets per-image snapshots out of a shared pool. The
+        # two modes are mutually exclusive per pool, as upstream.
+        self.sm_snaps: set[int] = set()
+        self.selfmanaged = False
         # head -> [(clone seq, birth era)]: a clone covers snaps s
         # with birth < s <= seq (the birth rides with the clone so an
         # object born BETWEEN snaps never phantom-exists at the older
@@ -293,7 +301,7 @@ class SimCluster:
     # -- client I/O ---------------------------------------------------------
 
     def _apply_write(self, ps: int, kind: str, payload,
-                     dead: set[int]) -> None:
+                     dead: set[int], snapc: int = 0) -> None:
         """One PG write (full objects or ranges) with the invariants
         every write path must keep: dead OSDs receive nothing (PGLog
         records the gap), and objects written during a backfill are
@@ -307,9 +315,14 @@ class SimCluster:
             names = {n for n, _, _ in payload}
         # snapshot copy-on-write (PrimaryLogPG::make_writeable): any
         # mutation of a head whose newest clone predates the newest
-        # snap first preserves the current state as a clone
+        # snap first preserves the current state as a clone. Pool-snap
+        # pools use the pool's own seq; selfmanaged pools use the seq
+        # the client's SnapContext carries (a writer that knows no
+        # snaps preserves nothing — librados semantics).
         if self.snaps:
-            self._preserve_clones(names)
+            self._preserve_clones(names, self.snap_seq)
+        elif snapc and self.sm_snaps:
+            self._preserve_clones(names, min(snapc, self.snap_seq))
         if kind == "write":
             be.write_objects(payload, dead_osds=dead)
         elif kind == "remove":
@@ -332,7 +345,8 @@ class SimCluster:
     def _dead_osds(self) -> set[int]:
         return {o for o in range(len(self.alive)) if not self.alive[o]}
 
-    def write(self, objects: dict[str, bytes | np.ndarray]) -> None:
+    def write(self, objects: dict[str, bytes | np.ndarray],
+              snapc: int = 0) -> None:
         # dead processes get no sub-writes; their shards fall behind in
         # the PG log and catch up on revive (ref: a down OSD misses
         # MOSDECSubOpWrite fan-out; PGLog records the gap)
@@ -340,7 +354,8 @@ class SimCluster:
         for name, data in objects.items():
             by_pg.setdefault(self.locate(name), {})[name] = data
         for ps, group in by_pg.items():
-            self._apply_write(ps, "write", group, self._dead_osds())
+            self._apply_write(ps, "write", group, self._dead_osds(),
+                              snapc=snapc)
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)
@@ -470,10 +485,11 @@ class SimCluster:
     def _clone_name(cls, name: str, seq: int) -> str:
         return f"{name}{cls._SNAP_SEP}{seq:08x}"
 
-    def _preserve_clones(self, names) -> None:
+    def _preserve_clones(self, names, eff_seq: int) -> None:
         """COW step: for each head about to mutate, if its state hasn't
-        been preserved since the newest snap, write the current bytes
-        as a clone object and record it in the SnapSet."""
+        been preserved since snap era `eff_seq` (the newest pool snap,
+        or the newest snap the client's SnapContext names), write the
+        current bytes as a clone object and record it in the SnapSet."""
         dead = self._dead_osds()
         for name in sorted(names):
             if self._SNAP_SEP in name:
@@ -483,37 +499,72 @@ class SimCluster:
             if name not in be.object_sizes:
                 # creation: remember the snap era it was born in, so
                 # reads at older snaps correctly say "didn't exist"
-                self.object_births[name] = self.snap_seq
+                self.object_births[name] = eff_seq
                 continue
-            if self.object_births.get(name, 0) >= self.snap_seq:
+            if self.object_births.get(name, 0) >= eff_seq:
                 # born AFTER the newest snap: no snap contains it, so
                 # preserving a clone would make it phantom-exist there
                 continue
             ss = self.snapsets.setdefault(name, [])
-            if ss and ss[-1][0] >= self.snap_seq:
+            if ss and ss[-1][0] >= eff_seq:
                 continue            # newest snap already has its clone
             data = be.read_object(name, dead_osds=dead)
-            clone = self._clone_name(name, self.snap_seq)
+            clone = self._clone_name(name, eff_seq)
             cps = self.locate(clone)
             self._apply_write(cps, "write", {clone: data}, dead)
-            ss.append((self.snap_seq,
+            ss.append((eff_seq,
                        self.object_births.get(name, 0)))
 
     def snap_create(self) -> int:
         """Take a pool snapshot (ref: OSDMonitor pool mksnap ->
         pg_pool_t::add_snap): monitor-quorum-gated seq bump; data is
         preserved lazily by the write-path COW."""
+        if self.selfmanaged:
+            raise ValueError("pool uses selfmanaged snaps; pool "
+                             "snapshots refused (ref: pg_pool_t "
+                             "FLAG_SELFMANAGED_SNAPS exclusivity)")
         if not self._mon_commit(f"pool 1 mksnap {self.snap_seq + 1}"):
             raise ValueError("no monitor quorum; snap refused")
         self.snap_seq += 1
         self.snaps[self.snap_seq] = self.now
         return self.snap_seq
 
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a self-managed snap id (ref: librados
+        selfmanaged_snap_create -> OSDMonitor pool selfmanaged mksnap).
+        No pool-wide COW follows from this alone: clones are made only
+        for writes whose SnapContext names the id (`snapc=` on the
+        write path) — per-image snapshots for RBD."""
+        if self.snaps:
+            raise ValueError("pool already has pool snapshots; "
+                             "selfmanaged snaps refused")
+        if not self._mon_commit(
+                f"pool 1 selfmanaged mksnap {self.snap_seq + 1}"):
+            raise ValueError("no monitor quorum; snap refused")
+        self.selfmanaged = True
+        self.snap_seq += 1
+        self.sm_snaps.add(self.snap_seq)
+        return self.snap_seq
+
+    def selfmanaged_snap_remove(self, sid: int) -> int:
+        """Delete a self-managed snap + snaptrim (ref: librados
+        selfmanaged_snap_remove). Returns clones trimmed."""
+        if sid not in self.sm_snaps:
+            raise KeyError(f"no selfmanaged snap {sid}")
+        if not self._mon_commit(f"pool 1 selfmanaged rmsnap {sid}"):
+            raise ValueError("no monitor quorum; snap removal refused")
+        self.sm_snaps.discard(sid)
+        return self._snap_trim()
+
+    def _live_snaps(self):
+        """Snap ids any clone may still serve (pool + selfmanaged)."""
+        return set(self.snaps) | self.sm_snaps
+
     def snap_read(self, name: str, sid: int) -> np.ndarray:
         """Read an object's state as of snap `sid`: the OLDEST clone
         with seq >= sid, else the unmodified head (ref: PrimaryLogPG
         find_object_context snap resolution via SnapSet.clones)."""
-        if sid not in self.snaps:
+        if sid not in self.snaps and sid not in self.sm_snaps:
             raise KeyError(f"no snap {sid}")
         cands = [seq for seq, birth in self.snapsets.get(name, [])
                  if seq >= sid and birth < sid]   # alive AT the snap
@@ -542,19 +593,38 @@ class SimCluster:
         del self.snaps[sid]
         return self._snap_trim()
 
+    def snap_changed(self, name: str, sid: int) -> bool:
+        """Has `name`'s head diverged from its state at snap `sid`?
+        Metadata-only (SnapSet + birth eras — the object-map/fast-diff
+        role, ref: librbd fast-diff via cls_rbd object map; the slow
+        path lists per-object snaps): no data is read or compared."""
+        if sid not in self.snaps and sid not in self.sm_snaps:
+            raise KeyError(f"no snap {sid}")
+        exists_now = name in self.pgs[self.locate(name)].object_sizes
+        covered = any(seq >= sid and birth < sid
+                      for seq, birth in self.snapsets.get(name, []))
+        if covered:
+            return True      # a clone was preserved => head mutated
+        if not exists_now:
+            return False     # didn't exist then (no covering clone),
+                             # doesn't exist now
+        # head unchanged since before the snap iff it was born earlier
+        return self.object_births.get(name, 0) >= sid
+
     def _snap_trim(self) -> int:
         """Drop clones no live snap reads anymore. Idempotent and
         failure-tolerant: a clone whose removal is refused mid-chaos
         (degraded PG) stays in the SnapSet and is retried on the next
         trim — the snap deletion itself never half-applies."""
         trimmed = 0
+        live = self._live_snaps()
         for name, ss in list(self.snapsets.items()):
             keep: list[tuple[int, int]] = []
             prev = 0
             for c, birth in ss:      # ascending; clone c covers snaps
                 # (prev_kept, c], minus snaps older than its birth era
                 if any(prev < s <= c and s > birth
-                       for s in self.snaps):
+                       for s in live):
                     keep.append((c, birth))
                     prev = c
                     continue
@@ -611,13 +681,14 @@ class SimCluster:
         from .objclass import cls_call
         return cls_call(self, name, cls, method, inp)
 
-    def remove(self, names: list[str] | str) -> None:
+    def remove(self, names: list[str] | str, snapc: int = 0) -> None:
         names = [names] if isinstance(names, str) else list(names)
         by_pg: dict[int, list[str]] = {}
         for name in names:
             by_pg.setdefault(self.locate(name), []).append(name)
         for ps, group in by_pg.items():
-            self._apply_write(ps, "remove", group, self._dead_osds())
+            self._apply_write(ps, "remove", group, self._dead_osds(),
+                              snapc=snapc)
 
     # -- client RPC (the primary-OSD session an Objecter talks to) ----------
 
@@ -625,7 +696,7 @@ class SimCluster:
         self.pg_changed_epoch[ps] = self.osdmap.epoch
 
     def client_rpc(self, target_osd: int, epoch: int, kind: str, ps: int,
-                   payload):
+                   payload, snapc: int = 0):
         """One client op addressed to `target_osd` as pg `ps`'s
         primary, carrying the client's map `epoch`. Raises StaleMap
         when the op's epoch predates the PG's last serving-set change,
@@ -636,10 +707,11 @@ class SimCluster:
         with self.op_tracker.create_op(
                 f"client_rpc {kind} pg 1.{ps} -> osd.{target_osd}") as op:
             return self._client_rpc_tracked(op, target_osd, epoch, kind,
-                                            ps, payload)
+                                            ps, payload, snapc)
 
     def _client_rpc_tracked(self, op, target_osd: int, epoch: int,
-                            kind: str, ps: int, payload):
+                            kind: str, ps: int, payload,
+                            snapc: int = 0):
         if epoch < self.pg_changed_epoch.get(ps, 0):
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} remapped at epoch "
@@ -666,7 +738,7 @@ class SimCluster:
         op.mark_event("reached_pg")  # map checks + peering gate passed
         dead = self._dead_osds()
         if kind in ("write", "write_ranges", "remove"):
-            self._apply_write(ps, kind, payload, dead)
+            self._apply_write(ps, kind, payload, dead, snapc=snapc)
             op.mark_event("commit_sent")
             return None
         if kind == "read":

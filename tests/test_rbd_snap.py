@@ -1,0 +1,337 @@
+"""RBD snapshots + clone layering over self-managed rados snaps
+(refs: src/librbd/Operations.cc snap_*, src/librbd/io/CopyupRequest.cc,
+src/cls/rbd children bookkeeping, librados selfmanaged_snap_* +
+per-op SnapContext in src/osdc/Objecter.cc)."""
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.client.rbd import RBD, Image, ImageBusy, ImageHasSnapshots
+from ceph_tpu.osd.cluster import SimCluster
+
+
+def make_rbd(**kw):
+    kw.setdefault("n_osds", 8)
+    kw.setdefault("pg_num", 4)
+    c = SimCluster(**kw)
+    io = Rados(c).open_ioctx()
+    return c, io, RBD(io, stripe_unit=256, stripe_count=2,
+                      object_size=1024)
+
+
+class TestSelfmanagedSnaps:
+    """The rados-level machinery RBD snapshots ride on."""
+
+    def test_mode_exclusivity(self):
+        c, io, _ = make_rbd()
+        io.selfmanaged_snap_create()
+        with pytest.raises(ValueError, match="selfmanaged"):
+            io.snap_create()
+        c2 = SimCluster(n_osds=8, pg_num=4)
+        io2 = Rados(c2).open_ioctx()
+        io2.snap_create()
+        with pytest.raises(ValueError, match="pool snapshots"):
+            io2.selfmanaged_snap_create()
+
+    def test_snapc_drives_cow_per_writer(self):
+        """Only writes that NAME the snap in their SnapContext
+        preserve clones — the per-image isolation property."""
+        c, io, _ = make_rbd()
+        io.write_full("a", b"A1")
+        io.write_full("b", b"B1")
+        sid = io.selfmanaged_snap_create()
+        io.write_full("a", b"A2", snapc=sid)   # snap-aware writer
+        io.write_full("b", b"B2")              # snap-oblivious writer
+        assert io.read("a", snap=sid) == b"A1"
+        assert io.read("a") == b"A2"
+        assert "a" in c.snapsets and "b" not in c.snapsets
+
+    def test_remove_with_snapc_preserves_clone(self):
+        c, io, _ = make_rbd()
+        io.write_full("a", b"keep me")
+        sid = io.selfmanaged_snap_create()
+        io.remove("a", snapc=sid)
+        with pytest.raises(KeyError):
+            io.read("a")
+        assert io.read("a", snap=sid) == b"keep me"
+
+    def test_snap_remove_trims_clones(self):
+        c, io, _ = make_rbd()
+        io.write_full("a", b"v1")
+        sid = io.selfmanaged_snap_create()
+        io.write_full("a", b"v2", snapc=sid)
+        assert c.snapsets.get("a")
+        trimmed = io.selfmanaged_snap_remove(sid)
+        assert trimmed == 1 and "a" not in c.snapsets
+        with pytest.raises(KeyError):
+            io.read("a", snap=sid)
+
+    def test_snap_changed_metadata_diff(self):
+        c, io, _ = make_rbd()
+        io.write_full("mut", b"x")
+        io.write_full("still", b"y")
+        sid = io.selfmanaged_snap_create()
+        io.write_full("mut", b"x2", snapc=sid)
+        io.write_full("born", b"z", snapc=sid)
+        assert io.snap_changed("mut", sid) is True
+        assert io.snap_changed("still", sid) is False
+        assert io.snap_changed("born", sid) is True
+        assert io.snap_changed("never-existed", sid) is False
+
+
+class TestImageSnapshots:
+    def test_snap_read_and_isolation_between_images(self):
+        c, io, rbd = make_rbd()
+        a = rbd.create("a", 4096)
+        b = rbd.create("b", 4096)
+        a.write(0, b"alpha-v1".ljust(512, b"."))
+        b.write(0, b"beta-v1")
+        a.snap_create("s1")
+        a.write(0, b"alpha-v2".ljust(512, b"!"))
+        b.write(0, b"beta-v2")       # b has no snaps: no COW for b
+        assert a.read(0, 8) == b"alpha-v2"
+        a.set_snap("s1")
+        assert a.read(0, 8) == b"alpha-v1"
+        assert a.size() == 4096
+        with pytest.raises(ValueError, match="read-only"):
+            a.write(0, b"nope")
+        a.set_snap(None)
+        assert b.read(0, 7) == b"beta-v2"
+        # no clone objects exist for b's pieces
+        assert not any(n.startswith("rbd_data.b.") for n in c.snapsets)
+
+    def test_snap_records_size(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vol", 2048)
+        img.write(0, b"D" * 2048)
+        img.snap_create("small")
+        img.resize(8192)
+        img.write(4096, b"E" * 100)
+        img.set_snap("small")
+        assert img.size() == 2048
+        assert img.read(0, 2048) == b"D" * 2048
+        img.set_snap(None)
+        assert img.size() == 8192
+
+    def test_rollback(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vm", 2048)
+        img.write(0, b"golden".ljust(2048, b"g"))
+        img.snap_create("gold")
+        img.write(0, b"corrupted".ljust(2048, b"#"))
+        img.snap_rollback("gold")
+        assert img.read(0, 6) == b"golden"
+        assert img.read(0, 2048) == b"golden".ljust(2048, b"g")
+
+    def test_rollback_preserves_newer_snap(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vm", 1024)
+        img.write(0, b"one".ljust(64, b"1"))
+        img.snap_create("s1")
+        img.write(0, b"two".ljust(64, b"2"))
+        img.snap_create("s2")
+        img.snap_rollback("s1")
+        assert img.read(0, 3) == b"one"
+        img.set_snap("s2")
+        assert img.read(0, 3) == b"two"
+
+    def test_snap_remove_and_remove_guard(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vm", 1024)
+        img.write(0, b"data")
+        img.snap_create("s1")
+        with pytest.raises(ImageHasSnapshots):
+            rbd.remove("vm")
+        img.snap_remove("s1")
+        rbd.remove("vm")
+        assert rbd.list() == []
+
+    def test_duplicate_snap_name_refused(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vm", 1024)
+        img.snap_create("s1")
+        with pytest.raises(FileExistsError):
+            img.snap_create("s1")
+
+
+class TestCloneLayering:
+    def _parent_with_snap(self, rbd, size=4096):
+        p = rbd.create("parent", size)
+        p.write(0, b"PARENT-DATA-".ljust(1024, b"P"))
+        p.write(2048, b"TAIL".ljust(512, b"T"))
+        p.snap_create("base")
+        p.snap_protect("base")
+        return p
+
+    def test_clone_requires_protected_snap(self):
+        c, io, rbd = make_rbd()
+        p = rbd.create("parent", 1024)
+        p.snap_create("s")
+        with pytest.raises(ValueError, match="protected"):
+            rbd.clone("parent", "s", "child")
+
+    def test_clone_reads_fall_through_to_parent(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        assert child.size() == 4096
+        assert child.read(0, 12) == b"PARENT-DATA-"
+        assert child.read(2048, 4) == b"TAIL"
+        assert child.read(3584, 512) == b"\x00" * 512  # sparse in both
+
+    def test_parent_changes_after_snap_invisible_to_child(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        p.write(0, b"parent-moved-on".ljust(1024, b"x"))
+        assert child.read(0, 12) == b"PARENT-DATA-"
+
+    def test_copy_up_on_partial_write(self):
+        """A write smaller than a stripe piece must materialize the
+        piece from the parent first, preserving surrounding bytes."""
+        c, io, rbd = make_rbd()
+        self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        child.write(4, b"####")
+        got = child.read(0, 12)
+        assert got == b"PARE####ATA-", got
+        # parent untouched
+        p = Image(rbd, "parent")
+        assert p.read(0, 12) == b"PARENT-DATA-"
+
+    def test_child_write_does_not_leak_to_parent_or_sibling(self):
+        c, io, rbd = make_rbd()
+        self._parent_with_snap(rbd)
+        c1 = rbd.clone("parent", "base", "c1")
+        c2 = rbd.clone("parent", "base", "c2")
+        c1.write(0, b"CHILD-ONE".ljust(256, b"1"))
+        assert c2.read(0, 12) == b"PARENT-DATA-"
+        assert Image(rbd, "parent").read(0, 9) != b"CHILD-ONE"
+
+    def test_grandchild_chain(self):
+        c, io, rbd = make_rbd()
+        self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        child.write(256, b"CHILDLAYER".ljust(256, b"c"))
+        child.snap_create("mid")
+        child.snap_protect("mid")
+        gc = rbd.clone("child", "mid", "grandchild")
+        assert gc.read(0, 12) == b"PARENT-DATA-"   # via child via parent
+        assert gc.read(256, 10) == b"CHILDLAYER"   # via child
+        gc.write(512, b"GC".ljust(64, b"g"))
+        assert gc.read(512, 2) == b"GC"
+        assert Image(rbd, "child").read(512, 2) != b"GC"
+
+    def test_unprotect_refused_while_children_exist(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        rbd.clone("parent", "base", "child")
+        with pytest.raises(ImageBusy, match="child"):
+            p.snap_unprotect("base")
+        assert rbd.list_children("parent", "base") == ["child"]
+
+    def test_protected_snap_remove_refused(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        with pytest.raises(ImageBusy, match="protected"):
+            p.snap_remove("base")
+
+    def test_flatten_severs_parent(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        child.write(4, b"####")
+        before = child.read(0, 4096)
+        child.flatten()
+        assert child.parent_info() is None
+        assert child.read(0, 4096) == before
+        # chain is broken: parent snap can now be unprotected+removed
+        p.snap_unprotect("base")
+        p.snap_remove("base")
+        assert rbd.list() == ["child", "parent"]
+        rbd.remove("parent")
+        assert child.read(0, 4) == b"PARE"   # survives parent removal
+
+    def test_clone_remove_deregisters(self):
+        c, io, rbd = make_rbd()
+        p = self._parent_with_snap(rbd)
+        rbd.clone("parent", "base", "child")
+        rbd.remove("child")
+        assert rbd.list_children("parent", "base") == []
+        p.snap_unprotect("base")   # now legal
+
+    def test_shrink_copy_up_boundary_piece(self):
+        """A clone shrink's zero-writes can create a missing boundary
+        piece; its sub-extents BELOW the new size must come from the
+        parent, not become authoritative zeros."""
+        c, io, rbd = make_rbd()
+        p = rbd.create("parent", 4096)
+        p.write(0, b"Q" * 4096)          # fully populated parent
+        p.snap_create("base")
+        p.snap_protect("base")
+        child = rbd.clone("parent", "base", "child")
+        child.write(3584, b"Z" * 512)    # only a high piece exists
+        child.resize(900)                # shrink mid-piece
+        assert child.read(768, 132) == b"Q" * 132
+
+    def test_snapshot_keeps_its_own_overlap(self):
+        """A later head shrink must not retroactively narrow the
+        parent overlap a snapshot recorded (per-snap parent info)."""
+        c, io, rbd = make_rbd()
+        p = rbd.create("parent", 4096)
+        p.write(0, b"W" * 4096)
+        p.snap_create("base")
+        p.snap_protect("base")
+        child = rbd.clone("parent", "base", "child")
+        child.snap_create("cs")
+        child.resize(1024)               # narrows HEAD overlap only
+        child.set_snap("cs")
+        assert child.read(2048, 4) == b"WWWW"
+        child.set_snap(None)
+        child.resize(4096)
+        assert child.read(2048, 4) == b"\x00" * 4
+
+    def test_shrink_narrows_overlap(self):
+        c, io, rbd = make_rbd()
+        self._parent_with_snap(rbd)
+        child = rbd.clone("parent", "base", "child")
+        child.resize(1024)
+        child.resize(4096)
+        # [1024, 4096) must NOT resurrect parent bytes past overlap
+        assert child.read(2048, 4) == b"\x00\x00\x00\x00"
+        assert child.read(0, 12) == b"PARENT-DATA-"
+
+
+class TestDiff:
+    def test_diff_since_snap(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vol", 4096)
+        img.write(0, b"A" * 4096)
+        img.snap_create("s1")
+        img.write(1024, b"B" * 100)   # dirties one 256-byte piece's object
+        runs = img.diff_iterate(from_snap="s1")
+        assert runs, "a write after the snap must show in the diff"
+        covered = set()
+        for off, ln in runs:
+            covered.update(range(off, off + ln))
+        assert 1024 in covered and 1100 in covered
+        # most of the image is NOT in the diff
+        assert len(covered) < 4096
+
+    def test_diff_allocated_extents(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vol", 4096)
+        img.write(512, b"X" * 10)
+        runs = img.diff_iterate()
+        covered = set()
+        for off, ln in runs:
+            covered.update(range(off, off + ln))
+        assert 512 in covered
+        assert 3500 not in covered   # untouched piece
+
+    def test_diff_clean_image_empty(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("vol", 4096)
+        img.write(0, b"A" * 4096)
+        img.snap_create("s1")
+        assert img.diff_iterate(from_snap="s1") == []
