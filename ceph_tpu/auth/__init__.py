@@ -1,0 +1,5 @@
+from .cephx import (AuthError, AuthService, Caps, ClientAuth, KeyServer,
+                    ServiceVerifier)
+
+__all__ = ["AuthError", "AuthService", "Caps", "ClientAuth",
+           "KeyServer", "ServiceVerifier"]

@@ -177,6 +177,21 @@ class RadosStriper:
             yield q, ooff, pos, take
             pos += take
 
+    def piece_extents(self, q: int, upto: int):
+        """Logical (offset, len) extents mapping to piece object q,
+        clamped to [0, upto) — the inverse of the _extents walk. Lives
+        here so ONE class owns the striping geometry (RBD clone
+        copy-up and diff depend on it)."""
+        rows = self.osz // self.su
+        units_per_set = self.sc * rows
+        obj_set, obj_in_set = divmod(q, self.sc)
+        for row in range(rows):
+            unit = obj_set * units_per_set + row * self.sc + obj_in_set
+            loff = unit * self.su
+            if loff >= upto:
+                break
+            yield loff, min(self.su, upto - loff)
+
     def _read_meta(self, soid: str,
                    snap: int | None = None) -> tuple[int, int]:
         """(logical size, high-water-mark size). The hwm tracks the

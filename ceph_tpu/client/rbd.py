@@ -426,32 +426,30 @@ class Image:
             overlap = p["overlap"]
         out = bytearray(length)
         exists: dict[int, bool] = {}
+        # coalesce consecutive same-source extents into ranged reads:
+        # a striped read otherwise issues one parent/child call PER
+        # stripe unit, each re-reading headers down the parent chain
+        runs: list[list] = []       # [from_child, start, len]
         for q, ooff, lpos, ln in self._striper._extents(offset, length):
             if q not in exists:
                 exists[q] = self._piece_exists(q)
-            rel = lpos - offset
-            if exists[q]:
-                piece = self._plain_read(lpos, ln)
-                out[rel:rel + len(piece)] = piece
-            elif lpos < overlap:
-                take = min(ln, overlap - lpos)
-                got = parent.read(lpos, take)
-                out[rel:rel + len(got)] = got
+            src = exists[q]
+            if not src and lpos >= overlap:
+                continue            # missing piece past overlap: zeros
+            take = ln if src else min(ln, overlap - lpos)
+            if runs and runs[-1][0] == src \
+                    and runs[-1][1] + runs[-1][2] == lpos:
+                runs[-1][2] += take
+            else:
+                runs.append([src, lpos, take])
+        for from_child, start, ln in runs:
+            got = self._plain_read(start, ln) if from_child \
+                else parent.read(start, ln)
+            out[start - offset:start - offset + len(got)] = got
         return bytes(out)
 
     def _piece_extents(self, q: int, upto: int):
-        """Logical (offset, len) extents mapping to piece q, clamped
-        to [0, upto) — the inverse of the striper's _extents walk."""
-        st = self._striper
-        rows = st.osz // st.su
-        units_per_set = st.sc * rows
-        obj_set, obj_in_set = divmod(q, st.sc)
-        for row in range(rows):
-            unit = obj_set * units_per_set + row * st.sc + obj_in_set
-            loff = unit * st.su
-            if loff >= upto:
-                break
-            yield loff, min(st.su, upto - loff)
+        return self._striper.piece_extents(q, upto)
 
     def _copy_up(self, hdr: dict, offset: int, length: int) -> None:
         """Materialize every missing piece the write will touch from
@@ -499,7 +497,10 @@ class Image:
         """Changed extents since `from_snap` (None: allocated extents),
         at stripe-piece granularity, as (offset, length) sorted merged
         runs. Uses the OSD's metadata-only snap_changed — the
-        fast-diff role; no data is read."""
+        fast-diff role; no data is read. Always computed against the
+        live HEAD — a set_snap read mode is ignored for the duration
+        (mixing at-snap existence probes with head sizing would yield
+        an extent set that is neither view)."""
         hdr = self._hdr()
         size = hdr["size"]
         if not size:
@@ -508,18 +509,22 @@ class Image:
             else None
         changed: list[tuple[int, int]] = []
         pieces = {q for q, _, _, _ in self._striper._extents(0, size)}
-        for q in sorted(pieces):
-            name = self._striper._obj(self._soid, q)
-            if from_sid is not None:
-                # snap_changed returns False for never-written names;
-                # it raises only for an UNKNOWN snap id — a real
-                # header/pool desync that must surface, not be
-                # swallowed as "empty diff"
-                dirty = self.rbd.io.snap_changed(name, from_sid)
-            else:
-                dirty = self._piece_exists(q)
-            if dirty:
-                changed.extend(self._piece_extents(q, size))
+        prev_at_snap, self._at_snap = self._at_snap, None
+        try:
+            for q in sorted(pieces):
+                name = self._striper._obj(self._soid, q)
+                if from_sid is not None:
+                    # snap_changed returns False for never-written
+                    # names; it raises only for an UNKNOWN snap id — a
+                    # real header/pool desync that must surface, not
+                    # be swallowed as "empty diff"
+                    dirty = self.rbd.io.snap_changed(name, from_sid)
+                else:
+                    dirty = self._piece_exists(q)
+                if dirty:
+                    changed.extend(self._piece_extents(q, size))
+        finally:
+            self._at_snap = prev_at_snap
         changed.sort()
         # merge adjacent runs for a compact diff
         merged: list[tuple[int, int]] = []

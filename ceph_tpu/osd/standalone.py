@@ -379,6 +379,20 @@ class MConfigOp(Message):
         return m
 
 
+@register_message
+class MAuthOp(_Blob):
+    """cephx traffic (ref: MAuth/MAuthReply): kind selects the auth
+    method (hello / authenticate / tickets against a monitor;
+    authorize against an OSD); blob is the JSON request with byte
+    fields hex-armored."""
+    type_id = 0x44
+
+
+@register_message
+class MAuthReply(_Blob):
+    type_id = 0x45
+
+
 # -- request/reply plumbing --------------------------------------------------
 
 class _Rpc:
@@ -424,22 +438,32 @@ class RemoteStore:
 
     path = None
 
-    def __init__(self, rpc: _Rpc, peer: str, timeout: float = 10.0):
+    def __init__(self, rpc: _Rpc, peer: str, timeout: float = 10.0,
+                 authorize=None):
         self._rpc = rpc
         self._peer = peer
         self._timeout = timeout
+        self._authorize = authorize   # cephx: establish session, retry
 
     def _call(self, kind: str, body: bytes = b"") -> bytes:
-        rep = self._rpc.call(
-            self._peer,
-            lambda rid: MStoreOp(rid, True, kind, body),
-            timeout=self._timeout)
-        if not rep.ok:
-            if rep.err.startswith("KeyError"):
-                raise KeyError(rep.err[9:] or rep.err)
-            raise ConnectionError(f"store op {kind} on {self._peer}: "
-                                  f"{rep.err}")
-        return rep.blob
+        for attempt in range(2):
+            rep = self._rpc.call(
+                self._peer,
+                lambda rid: MStoreOp(rid, True, kind, body),
+                timeout=self._timeout)
+            if rep.ok:
+                return rep.blob
+            if (rep.err == "EPERM:unauthenticated"
+                    and self._authorize is not None and attempt == 0):
+                # first store op to this peer since (re)boot: run the
+                # osd->osd cephx round, then retry once
+                self._authorize(self._peer)
+                continue
+            break
+        if rep.err.startswith("KeyError"):
+            raise KeyError(rep.err[9:] or rep.err)
+        raise ConnectionError(f"store op {kind} on {self._peer}: "
+                              f"{rep.err}")
 
     @staticmethod
     def _co(cid: str, oid: str = "", extra=None) -> bytes:
@@ -558,6 +582,17 @@ class OSDDaemon:
             "osd_heartbeat_grace": cluster.hb_grace,
         })
         self._cfg_applied: dict[str, str] = {}
+        # cephx (ref: OSD::ms_verify_authorizer): rotating secrets are
+        # fetched at boot (stand-in: exported straight from the
+        # cluster's KeyServer); per-peer sessions are established by
+        # MAuthOp("authorize") and die with the process
+        self._authed: dict[str, dict] = {}
+        self.verifier = None
+        self._cauth = None
+        if cluster.key_server is not None:
+            from ..auth import ServiceVerifier
+            self.verifier = ServiceVerifier(
+                "osd", cluster.key_server.export_rotating("osd"))
         self._start()
 
     def _start(self) -> None:
@@ -569,13 +604,45 @@ class OSDDaemon:
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
         m.register_handler(MOSDMapMsg.type_id, self._on_map)
+        if self.verifier is not None:
+            from ..auth import ClientAuth
+            m.register_handler(MAuthOp.type_id, self._on_auth)
+            # this daemon's own principal, for osd->osd store traffic
+            # (sessions and rpc die with the process: built in _start
+            # so a revive gets fresh ones)
+            self.auth_rpc = _Rpc(self.msgr, MAuthReply.type_id)
+            self._cauth = ClientAuth(
+                _WireAuth(self.c, self.auth_rpc), self.name,
+                self.c.osd_secrets[self.osd_id])
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     daemon=True)
         self._hb.start()
 
+    def _authorize_peer(self, peer: str) -> None:
+        """osd->osd cephx (ref: OSD heartbeat/cluster messengers carry
+        cephx authorizers too): used by RemoteStore on first contact."""
+        _wire_authorize(self._cauth, self.auth_rpc, peer, "osd")
+
     # -- store service (the SubOp executor) ---------------------------------
 
+    _STORE_READ_KINDS = frozenset(
+        {"read", "stat", "getattr", "exists", "ls", "omap_get"})
+
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
+        # the store plane is ticket-gated exactly like the client op
+        # plane — without this, MOSDOp's EPERM gate would be decorative
+        # (any peer could reach shard bytes via raw MStoreOp frames)
+        if self.verifier is not None:
+            deny = self._auth_gate(
+                peer,
+                "r" if msg.kind in self._STORE_READ_KINDS else "w")
+            if deny is not None:
+                try:
+                    self.msgr.send(peer, MStoreReply(
+                        msg.req_id, False, msg.kind, err=deny))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+                return
         try:
             with self._store_lock:
                 blob = self._store_op(msg.kind, msg.blob)
@@ -627,7 +694,9 @@ class OSDDaemon:
             if osd_id == self.osd_id:
                 return self.store
             return RemoteStore(self.rpc, f"osd.{osd_id}",
-                               timeout=self.c.op_timeout)
+                               timeout=self.c.op_timeout,
+                               authorize=self._authorize_peer
+                               if self.verifier is not None else None)
         return ShardSet(store_factory=factory)
 
     def _acting(self, ps: int) -> list[int]:
@@ -697,8 +766,10 @@ class OSDDaemon:
             if osd == self.osd_id or osd in self.suspect:
                 continue
             try:
-                blobs.append(RemoteStore(self.rpc, f"osd.{osd}",
-                                         timeout=2.0).omap_get(
+                blobs.append(RemoteStore(
+                    self.rpc, f"osd.{osd}", timeout=2.0,
+                    authorize=self._authorize_peer
+                    if self.verifier is not None else None).omap_get(
                     shard_cid(pgid, s), "__pg_meta__", PG_META_KEY))
             except (KeyError, ConnectionError, OSError):
                 continue
@@ -872,7 +943,58 @@ class OSDDaemon:
 
     # -- client ops ----------------------------------------------------------
 
+    _READ_KINDS = frozenset({"read", "snap_read"})
+
+    def _on_auth(self, peer: str, msg: MAuthOp) -> None:
+        """Session establishment (ref: CephxAuthorizeHandler via
+        ms_verify_authorizer): verify the presented service ticket,
+        bind (entity, caps) to the transport peer, prove possession
+        of the rotating secret back (mutual auth)."""
+        import json as _json
+        try:
+            got = self.verifier.verify(_json.loads(msg.blob.decode()))
+            self._authed[peer] = {"entity": got["entity"],
+                                  "caps": got["caps"]}
+            rep = MAuthReply(msg.req_id, True, "authorize",
+                             _json.dumps({"reply_mac":
+                                          got["reply_mac"].hex()})
+                             .encode())
+        except Exception as e:   # noqa: BLE001 — reply, don't die
+            rep = MAuthReply(msg.req_id, False, "authorize",
+                             err=f"{type(e).__name__}:{e}")
+        try:
+            self.msgr.send(peer, rep)
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _auth_gate(self, peer: str, need: str) -> str | None:
+        """None = allowed; else the EPERM reply string. ONE gate for
+        both the client-op and store planes — RemoteStore._call and
+        Client._op string-match these exact errors for their
+        re-authorize retries (ref: OSDCap is_capable)."""
+        sess = self._authed.get(peer)
+        if sess is None:
+            return "EPERM:unauthenticated"
+        caps = sess["caps"].get("osd")
+        # this tier serves ONE pool, named "default" (pool id 1), so
+        # pool-scoped grants (`allow rw pool=default`) resolve here
+        if caps is None or not caps.allows(need, pool="default"):
+            return (f"EPERM:denied need {need} "
+                    f"(entity {sess['entity']})")
+        return None
+
     def _on_client_op(self, peer: str, msg: MOSDOp) -> None:
+        if self.verifier is not None:
+            need = "x" if msg.kind == "cls" else \
+                ("r" if msg.kind in self._READ_KINDS else "w")
+            deny = self._auth_gate(peer, need)
+            if deny is not None:
+                try:
+                    self.msgr.send(peer, MOSDOpReply(
+                        msg.req_id, False, msg.kind, err=deny))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+                return
         try:
             with self._lock:
                 blob = self._client_op(msg.kind, msg.blob)
@@ -1145,6 +1267,15 @@ class OSDDaemon:
         fresh._last_pong = {}
         fresh._reported = set()
         fresh._stop = threading.Event()
+        # auth sessions die with the process; rotating secrets are
+        # re-fetched at boot (a revived daemon must not honor tickets
+        # from before a rotation it slept through). _start() rebuilds
+        # the daemon's own ClientAuth + auth rpc on the new messenger.
+        fresh._authed = {}
+        if fresh.verifier is not None:
+            from ..auth import ServiceVerifier
+            fresh.verifier = ServiceVerifier(
+                "osd", self.c.key_server.export_rotating("osd"))
         fresh._start()
         return fresh
 
@@ -1213,6 +1344,21 @@ class MonDaemon:
         m.register_handler(MMonCommit.type_id, self._on_commit)
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
+        # cephx service (ref: AuthMonitor + CephxServiceHandler).
+        # Every monitor serves auth against the shared KeyServer (its
+        # state is cluster bootstrap config here; KeyServer paxos
+        # replication is out of this tier's scope, disclosed).
+        self.auth_svc = None
+        self.verifier = None
+        self._authed: dict[str, dict] = {}
+        if cluster.key_server is not None:
+            from ..auth import AuthService, ServiceVerifier
+            self.auth_svc = AuthService(cluster.key_server)
+            # the monitor is itself a ticket-gated service: admin ops
+            # (pool snaps, central config) need a mon ticket with w
+            self.verifier = ServiceVerifier(
+                "mon", cluster.key_server.export_rotating("mon"))
+            m.register_handler(MAuthOp.type_id, self._on_auth)
         m.register_handler(MPoolOp.type_id, self._on_pool_op)
         m.register_handler(MConfigOp.type_id, self._on_config_op)
         m.register_handler(MOSDPing.type_id, self._on_ping)
@@ -1431,6 +1577,42 @@ class MonDaemon:
             # until the next commit (subscribers dedup by epoch)
             self._broadcast(msg.epoch)
 
+    def _on_auth(self, peer: str, msg: MAuthOp) -> None:
+        """cephx endpoint (ref: AuthMonitor::prep_auth): hello /
+        authenticate mint the auth ticket; tickets mints per-service
+        tickets. Byte fields travel hex-armored in JSON."""
+        import json as _json
+        try:
+            req = _json.loads(msg.blob.decode())
+            svc = self.auth_svc
+            if msg.kind == "authorize":
+                got = self.verifier.verify(req)
+                self._authed[peer] = {"entity": got["entity"],
+                                      "caps": got["caps"]}
+                out = {"reply_mac": got["reply_mac"].hex()}
+            elif msg.kind == "hello":
+                sc = svc.hello(req["entity"], bytes.fromhex(req["cc"]))
+                out = {"sc": sc.hex()}
+            elif msg.kind == "authenticate":
+                out = svc.authenticate(req["entity"],
+                                       bytes.fromhex(req["cc"]),
+                                       bytes.fromhex(req["proof"]))
+            elif msg.kind == "tickets":
+                out = svc.get_service_tickets(
+                    req["ticket"], bytes.fromhex(req["nonce"]),
+                    bytes.fromhex(req["mac"]), req["services"])
+            else:
+                raise ValueError(f"unknown auth op {msg.kind!r}")
+            rep = MAuthReply(msg.req_id, True, msg.kind,
+                             _json.dumps(out).encode())
+        except Exception as e:   # noqa: BLE001 — reply, don't die
+            rep = MAuthReply(msg.req_id, False, msg.kind,
+                             err=f"{type(e).__name__}:{e}")
+        try:
+            self.msgr.send(peer, rep)
+        except (KeyError, OSError, ConnectionError):
+            pass
+
     def _on_sync_req(self, peer: str, msg) -> None:
         """A revived monitor asks for the current map; answer with the
         COMMITTED map only (an accepted-but-uncommitted value must
@@ -1647,8 +1829,30 @@ class MonDaemon:
                 m.mark_in(osd)
         self._commit(mutate)
 
+    def _mon_admin_denied(self, peer: str, what: str) -> bool:
+        """Admin-plane gate (ref: MonCap check in
+        Monitor::_allowed_command): with cephx on, pool/config
+        mutations from peers without a mon session carrying w are
+        DROPPED (these frames are fire-and-forget broadcasts; the
+        client's commit-wait surfaces the refusal as a timeout).
+        Daemon-internal traffic (failure reports, boots, paxos) stays
+        ungated at this tier — it rides the transport-level shared
+        secret when one is configured."""
+        if self.verifier is None:
+            return False
+        sess = self._authed.get(peer)
+        caps = sess["caps"].get("mon") if sess else None
+        if caps is None or not caps.allows("w"):
+            self.c.log(f"{self.name}: DROP {what} from {peer} "
+                       f"(mon caps: "
+                       f"{'none' if sess is None else 'no w'})")
+            return True
+        return False
+
     def _on_pool_op(self, peer: str, msg: MPoolOp) -> None:
         if self.osdmap is None:
+            return
+        if self._mon_admin_denied(peer, f"pool op {msg.kind}"):
             return
         kind, snap = msg.kind, msg.snap_name
         self.c.log(f"{self.name}: pool op {kind} {snap!r} from {peer}")
@@ -1668,6 +1872,8 @@ class MonDaemon:
         and every daemon observes it through its map subscription."""
         if self.osdmap is None:
             return
+        if self._mon_admin_denied(peer, f"config {msg.kind} {msg.key}"):
+            return
         kind, key, value = msg.kind, msg.key, msg.value
         self.c.log(f"{self.name}: config {kind} {key}={value!r} "
                    f"from {peer}")
@@ -1685,11 +1891,97 @@ class MonDaemon:
         self.msgr.shutdown()
 
 
+class _WireAuth:
+    """ClientAuth's transport: the three monitor-side auth methods as
+    MAuthOp frames against whichever monitor answers (ref: MonClient
+    hunting across monitors). The last answering monitor is sticky so
+    a hello/authenticate pair lands on the SAME AuthService (each
+    monitor keeps its own outstanding-challenge table)."""
+
+    def __init__(self, cluster: "StandaloneCluster", rpc: _Rpc):
+        self.c = cluster
+        self.rpc = rpc
+        self._sticky: str | None = None
+
+    def _call(self, method: str, payload: dict) -> dict:
+        import json as _json
+        from ..auth import AuthError
+        last = None
+        mons = self.c.mon_names()
+        if self._sticky in mons:
+            mons.remove(self._sticky)
+            mons.insert(0, self._sticky)
+        for mon in mons:
+            try:
+                rep = self.rpc.call(
+                    mon, lambda rid: MAuthOp(
+                        rid, True, method,
+                        _json.dumps(payload).encode()),
+                    timeout=5.0)
+            except (ConnectionError, KeyError, OSError) as e:
+                last = str(e)
+                if self._sticky == mon:
+                    self._sticky = None
+                continue            # hunt the next monitor
+            if rep.ok:
+                self._sticky = mon
+                return _json.loads(rep.blob.decode())
+            raise AuthError(rep.err)   # auth refusal is terminal
+        raise ConnectionError(f"no monitor answered auth: {last}")
+
+    def hello(self, entity: str, cc: bytes) -> bytes:
+        return bytes.fromhex(
+            self._call("hello", {"entity": entity, "cc": cc.hex()})["sc"])
+
+    def authenticate(self, entity: str, cc: bytes, proof: bytes) -> dict:
+        return self._call("authenticate",
+                          {"entity": entity, "cc": cc.hex(),
+                           "proof": proof.hex()})
+
+    def get_service_tickets(self, ticket: dict, nonce: bytes,
+                            mac: bytes, services: list) -> dict:
+        return self._call("tickets",
+                          {"ticket": ticket, "nonce": nonce.hex(),
+                           "mac": mac.hex(), "services": services})
+
+
+def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
+    """Present a `service` ticket to `peer` over MAuthOp("authorize"),
+    verify the daemon's mutual-auth proof; refresh the ticket once if
+    its sealing secret rotated out. Shared by clients (osd + mon
+    sessions) and by OSDs authorizing to peer OSDs."""
+    import json as _json
+    from ..auth import AuthError
+    for attempt in range(2):
+        az = cauth.authorizer_for(service)
+        try:
+            rep = rpc.call(
+                peer, lambda rid: MAuthOp(rid, True, "authorize",
+                                          _json.dumps(az).encode()),
+                timeout=5.0)
+        except (ConnectionError, KeyError, OSError):
+            return   # peer unreachable; the caller's op loop retargets
+        if rep.ok:
+            got = _json.loads(rep.blob.decode())
+            if not cauth.verify_reply(
+                    service, az, bytes.fromhex(got["reply_mac"])):
+                raise AuthError(
+                    f"{peer} failed mutual auth (does not hold the "
+                    "rotating secret)")
+            return
+        if "rotated out" in rep.err and attempt == 0:
+            cauth.fetch_tickets([service])
+            continue
+        raise AuthError(rep.err)
+
+
 class Client:
     """librados over the wire: locate the PG from the cached map, talk
     to its primary, retry on map change / primary death."""
 
-    def __init__(self, cluster: "StandaloneCluster", name: str = "client"):
+    def __init__(self, cluster: "StandaloneCluster", name: str = "client",
+                 entity: str = "client.admin",
+                 secret: bytes | None = None):
         self.c = cluster
         self.msgr = Messenger(name, secret=cluster.secret,
                               compress=cluster.compress)
@@ -1697,6 +1989,27 @@ class Client:
         self.osdmap: OSDMap | None = None
         self._lock = threading.Lock()
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
+        self._cauth = None
+        if cluster.key_server is not None:
+            from ..auth import ClientAuth
+            self.auth_rpc = _Rpc(self.msgr, MAuthReply.type_id)
+            self._cauth = ClientAuth(
+                _WireAuth(cluster, self.auth_rpc), entity,
+                cluster.admin_secret if secret is None else secret)
+
+    def _authorize(self, osd_name: str) -> None:
+        _wire_authorize(self._cauth, self.auth_rpc, osd_name, "osd")
+
+    def _ensure_mon_sessions(self) -> None:
+        """Authorize with every live monitor before an admin broadcast
+        (pool/config ops are dropped from unauthenticated peers).
+        Re-run per call: monitor restarts silently void sessions, and
+        admin ops are rare enough that one authorize round-trip per
+        monitor is noise."""
+        if self._cauth is None:
+            return
+        for mon in self.c.mon_names():
+            _wire_authorize(self._cauth, self.auth_rpc, mon, "mon")
 
     def _on_map(self, peer: str, msg: MOSDMapMsg) -> None:
         with self._lock:
@@ -1726,11 +2039,24 @@ class Client:
                 if rep.ok:
                     return rep.blob
                 last = rep.err
+                if rep.err == "EPERM:unauthenticated":
+                    # first contact with this daemon (or it restarted):
+                    # establish the cephx session and retry the op
+                    self._authorize(self._primary(ps))
+                    continue
+                if rep.err.startswith("EPERM:denied"):
+                    # caps refusal is deterministic; retrying is
+                    # useless. NB: raised outside the except clause
+                    # below — PermissionError IS an OSError and must
+                    # not be swallowed as a transport hiccup.
+                    raise PermissionError(rep.err)
                 if rep.err.startswith("ClsError:"):
                     # a class method REFUSED the op (EBUSY-style):
                     # deterministic, retrying can't change the answer
                     from .objclass import ClsError
                     raise ClsError(rep.err[9:])
+            except PermissionError:
+                raise
             except (ConnectionError, KeyError, OSError) as err:
                 last = str(err)
             time.sleep(retry_sleep)   # map may be in flight; retarget
@@ -1771,6 +2097,7 @@ class Client:
                 pass
 
     def _pool_op(self, kind: str, snap: str) -> None:
+        self._ensure_mon_sessions()
         self._mon_cast(MPoolOp(kind, snap))
 
     def snap_create(self, name: str, timeout: float = 15.0) -> int:
@@ -1809,6 +2136,7 @@ class Client:
         """`ceph config set` — quorum-committed, observed through the
         map subscription (ref: ConfigMonitor::prepare_command)."""
         value = str(value)
+        self._ensure_mon_sessions()
         self._mon_cast(MConfigOp("set", key, value))
         self.c._wait(
             lambda: self.osdmap is not None
@@ -1816,6 +2144,7 @@ class Client:
             timeout, f"config {key}={value!r} committed")
 
     def config_rm(self, key: str, timeout: float = 15.0) -> None:
+        self._ensure_mon_sessions()
         self._mon_cast(MConfigOp("rm", key))
         self.c._wait(
             lambda: self.osdmap is not None
@@ -1857,7 +2186,7 @@ class StandaloneCluster:
                  pg_num: int = 4, store: str = "mem",
                  store_dir: str | None = None,
                  secret: bytes | None = None,
-                 compress: str | None = None,
+                 compress: str | None = None, cephx: bool = False,
                  hb_interval: float = 0.25, hb_grace: float = 1.2,
                  min_reporters: int = 2, op_timeout: float = 8.0,
                  chunk_size: int = 256, verbose: bool | None = None):
@@ -1870,6 +2199,29 @@ class StandaloneCluster:
         from ..ec.registry import factory
         self.secret = secret
         self.compress = compress
+        # cephx realm (ref: AuthMonitor bootstrap + client.admin
+        # keyring): entity secrets + rotating service secrets live in
+        # one KeyServer every monitor serves from
+        self.key_server = None
+        self.admin_secret = None
+        if cephx:
+            from ..auth import KeyServer
+            ks = KeyServer()
+            self.key_server = ks
+            self.admin_secret = ks.create_entity(
+                "client.admin",
+                caps={"mon": "allow *", "osd": "allow rwx"})
+            ks.current_secret("auth")
+            ks.current_secret("osd")
+            ks.current_secret("mon")
+            # every OSD daemon is itself a cephx principal (ref: the
+            # osd.N keyring bootstrap-osd creates): shard fan-out and
+            # peer meta reads authorize with osd service tickets
+            self.osd_secrets = {
+                o: ks.create_entity(f"osd.{o}",
+                                    caps={"mon": "allow rw",
+                                          "osd": "allow rwx"})
+                for o in range(n_osds)}
         self.hb_interval, self.hb_grace = hb_interval, hb_grace
         self.min_reporters = min_reporters
         self.op_timeout = op_timeout
@@ -1956,14 +2308,36 @@ class StandaloneCluster:
                 if name_a != name_b:
                     msgr_a.add_peer(name_b, msgr_b.addr)
 
-    def client(self) -> Client:
-        cl = Client(self, f"client.{len(self.clients)}")
+    def client(self, entity: str = "client.admin",
+               secret: bytes | None = None) -> Client:
+        cl = Client(self, f"client.{len(self.clients)}",
+                    entity=entity, secret=secret)
         self.clients.append(cl)
         self._wire_peers()
         # subscribe: any mon will answer with the current map
         self.mons[0]._broadcast(self.mons[0].osdmap.epoch)
         self._wait(lambda: cl.osdmap is not None, 10, "client map")
         return cl
+
+    # -- cephx administration -------------------------------------------------
+
+    def create_entity(self, name: str,
+                      caps: dict[str, str]) -> bytes:
+        """`ceph auth get-or-create` role: mint an entity keyring."""
+        return self.key_server.create_entity(name, caps)
+
+    def rotate_service_secrets(self, service: str = "osd") -> None:
+        """Rotate + push to live daemons (ref: the monitor's periodic
+        rotating-secret refresh daemons pick up via MAuth). Old
+        tickets stay valid through the keep-window; beyond it daemons
+        answer 'rotated out' and clients re-fetch."""
+        self.key_server.rotate(service)
+        rot = self.key_server.export_rotating(service)
+        daemons = list(self.osds.values()) if service == "osd" \
+            else self.mons if service == "mon" else []
+        for d in daemons:
+            if d.verifier is not None and not d._stop.is_set():
+                d.verifier.refresh(rot)
 
     # -- fault injection ------------------------------------------------------
 

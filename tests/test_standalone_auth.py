@@ -1,0 +1,155 @@
+"""cephx over the wire tier: monitor-issued tickets, OSD session
+authorization, caps enforcement, secret rotation (refs:
+src/auth/cephx/CephxProtocol.cc, src/mon/AuthMonitor.cc,
+OSD::ms_verify_authorizer, OSDCap::is_capable)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.auth import AuthError
+from ceph_tpu.osd.standalone import StandaloneCluster
+
+
+@pytest.fixture
+def cluster():
+    c = StandaloneCluster(n_osds=3, pg_num=2, op_timeout=3.0,
+                          cephx=True)
+    try:
+        c.wait_for_clean(timeout=20)
+        yield c
+    finally:
+        c.shutdown()
+
+
+def corpus(seed, n=6):
+    rng = np.random.default_rng(seed)
+    return {f"authobj-{seed}-{i}":
+            rng.integers(0, 256, 300, np.uint8).tobytes()
+            for i in range(n)}
+
+
+class TestCephxWire:
+    def test_admin_io_authenticates_transparently(self, cluster):
+        """First op hits EPERM:unauthenticated, the client runs the
+        full ticket dance over MAuthOp frames, the op retries and
+        succeeds — and the data is bytes-exact."""
+        cl = cluster.client()
+        objs = corpus(1)
+        cl.write(objs)
+        for name, want in objs.items():
+            assert cl.read(name) == want
+        # sessions actually exist on the daemons
+        assert any(d._authed for d in cluster.osds.values())
+
+    def test_wrong_secret_cannot_login(self, cluster):
+        cl = cluster.client(secret=b"\x00" * 32)
+        with pytest.raises(AuthError, match="bad proof"):
+            cl.write(corpus(2))
+
+    def test_unknown_entity_rejected(self, cluster):
+        cl = cluster.client(entity="client.ghost",
+                            secret=b"\x01" * 32)
+        with pytest.raises(AuthError, match="unknown entity"):
+            cl.write(corpus(3))
+
+    def test_readonly_caps_enforced(self, cluster):
+        admin = cluster.client()
+        objs = corpus(4)
+        admin.write(objs)
+        ro_secret = cluster.create_entity(
+            "client.reader", caps={"mon": "allow r",
+                                   "osd": "allow r"})
+        ro = cluster.client(entity="client.reader", secret=ro_secret)
+        name = next(iter(objs))
+        assert ro.read(name) == objs[name]
+        with pytest.raises(PermissionError, match="denied need w"):
+            ro.write({name: b"overwrite attempt"})
+        # the object is untouched
+        assert admin.read(name) == objs[name]
+
+    def test_revived_osd_requires_reauth_and_serves(self, cluster):
+        """Auth sessions die with the daemon process; after revive the
+        client transparently re-authorizes and I/O still works."""
+        cl = cluster.client()
+        objs = corpus(5)
+        cl.write(objs)
+        victim = cluster.osd_ids()[0]
+        cluster.kill_osd(victim)
+        cluster.revive_osd(victim)
+        assert cluster.osds[victim]._authed == {}
+        more = corpus(6)
+        cl.write(more)
+        for name, want in {**objs, **more}.items():
+            assert cl.read(name) == want
+
+    def test_pool_scoped_caps_match_the_pool(self, cluster):
+        """`allow rw pool=default` works against the tier's pool;
+        `allow rw pool=other` does not."""
+        admin = cluster.client()
+        objs = corpus(8)
+        admin.write(objs)
+        ok_secret = cluster.create_entity(
+            "client.pooled", caps={"mon": "allow r",
+                                   "osd": "allow rw pool=default"})
+        pooled = cluster.client(entity="client.pooled",
+                                secret=ok_secret)
+        name = next(iter(objs))
+        assert pooled.read(name) == objs[name]
+        bad_secret = cluster.create_entity(
+            "client.wrongpool", caps={"mon": "allow r",
+                                      "osd": "allow rw pool=other"})
+        wrong = cluster.client(entity="client.wrongpool",
+                               secret=bad_secret)
+        with pytest.raises(PermissionError):
+            wrong.read(name)
+
+    def test_mon_admin_plane_gated(self, cluster):
+        """Pool snapshots need a mon ticket with w: the read-only
+        entity's mksnap broadcast is dropped (commit-wait times out);
+        the admin's goes through."""
+        admin = cluster.client()
+        admin.write(corpus(9))
+        ro_secret = cluster.create_entity(
+            "client.monro", caps={"mon": "allow r",
+                                  "osd": "allow r"})
+        ro = cluster.client(entity="client.monro", secret=ro_secret)
+        with pytest.raises(TimeoutError):
+            ro.snap_create("sneaky", timeout=2.0)
+        sid = admin.snap_create("legit")
+        assert sid >= 1
+
+    def test_store_plane_rejects_unauthenticated_frames(self, cluster):
+        """Raw MStoreOp frames from a peer with no session bounce with
+        EPERM — the data plane can't be reached around the op gate."""
+        from ceph_tpu.osd.standalone import (MStoreReply, RemoteStore,
+                                             _Rpc)
+        admin = cluster.client()
+        admin.write(corpus(10))
+        # a FRESH endpoint that has never authorized anything: its
+        # raw store frames must bounce (sessions are per-peer; the
+        # admin's session must not bleed onto this messenger)
+        cl = cluster.client()
+        target = f"osd.{cluster.osd_ids()[0]}"
+        rs = RemoteStore(_Rpc(cl.msgr, MStoreReply.type_id), target,
+                         timeout=3.0)  # no authorize callback
+        import re
+        with pytest.raises(ConnectionError,
+                           match=re.escape("EPERM:unauthenticated")):
+            rs.list_objects("meta")
+
+    def test_rotation_keep_window_then_refresh(self, cluster):
+        cl = cluster.client()
+        objs = corpus(7)
+        cl.write(objs)                       # sessions established
+        # rotate within the keep-window: existing tickets stay valid
+        cluster.rotate_service_secrets("osd")
+        name = next(iter(objs))
+        assert cl.read(name) == objs[name]
+        # rotate past the window: daemons refuse old tickets; a fresh
+        # client (new sessions forced) must transparently re-fetch
+        cluster.rotate_service_secrets("osd")
+        cluster.rotate_service_secrets("osd")
+        cl2 = cluster.client()
+        assert cl2.read(name) == objs[name]
+        cl2.write({name: b"post-rotation write"})
+        assert cl.read(name) == b"post-rotation write"
