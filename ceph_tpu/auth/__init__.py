@@ -1,5 +1,6 @@
 from .cephx import (AuthError, AuthService, Caps, ClientAuth, KeyServer,
-                    ServiceVerifier)
+                    NeedChallenge, ServiceVerifier, local_authorize)
 
 __all__ = ["AuthError", "AuthService", "Caps", "ClientAuth",
-           "KeyServer", "ServiceVerifier"]
+           "KeyServer", "NeedChallenge", "ServiceVerifier",
+           "local_authorize"]

@@ -52,6 +52,17 @@ class AuthError(Exception):
     pass
 
 
+class NeedChallenge(AuthError):
+    """The daemon demands a fresh server challenge be bound into the
+    authorizer MAC before it will accept it (ref: the cephx server
+    challenge added for CVE-2018-1128 — without it a captured
+    authorizer replays)."""
+
+    def __init__(self, challenge_hex: str):
+        super().__init__("server challenge required")
+        self.challenge = challenge_hex
+
+
 def _hmac(key: bytes, *parts: bytes) -> bytes:
     h = hmac.new(key, digestmod=sha256)
     for p in parts:
@@ -172,7 +183,15 @@ class KeyServer:
         if not lst:
             self.rotate(service)
             lst = self.rotating[service]
-        sid, key, _exp = lst[0]
+        sid, key, exp = lst[0]
+        # auto-rotate once the newest secret has served a full ttl
+        # (ref: the monitor's rotating-secret timer): without this a
+        # long-lived realm seals new tickets under an aging secret
+        # until EVERYTHING expires at once and auth bricks
+        minted = exp - self.ttl * ROTATING_KEEP
+        if self.now() >= minted + self.ttl:
+            self.rotate(service)
+            sid, key, exp = self.rotating[service][0]
         return sid, key
 
     def secret_by_id(self, service: str, sid: int) -> bytes:
@@ -327,16 +346,23 @@ class ClientAuth:
                               "expires": sk["expires"],
                               "ticket": entry["ticket"]}
 
-    def authorizer_for(self, service: str) -> dict:
+    def authorizer_for(self, service: str,
+                       server_challenge: str | None = None) -> dict:
         """(ticket, nonce, mac) to present to a daemon; refreshes the
-        service ticket when missing or expired."""
+        service ticket when missing or expired. When the daemon has
+        issued a server challenge (NeedChallenge), it is bound into
+        the MAC — the anti-replay round."""
         ent = self._svc.get(service)
         if ent is None or self.now() > ent["expires"] - 1.0:
             self.fetch_tickets([service])
             ent = self._svc[service]
         nonce = os.urandom(16)
-        return {"ticket": ent["ticket"], "nonce": _b(nonce),
-                "mac": _b(_hmac(ent["key"], nonce))}
+        az = {"ticket": ent["ticket"], "nonce": _b(nonce),
+              "mac": _b(_hmac(ent["key"], nonce,
+                              _ub(server_challenge or "")))}
+        if server_challenge is not None:
+            az["server_challenge"] = server_challenge
+        return az
 
     def verify_reply(self, service: str, authorizer: dict,
                      reply_mac: bytes) -> bool:
@@ -349,7 +375,17 @@ class ClientAuth:
 
 class ServiceVerifier:
     """Daemon-side authorizer check (ref: CephxAuthorizeHandler +
-    the rotating secrets a daemon refreshes from the monitor)."""
+    the rotating secrets a daemon refreshes from the monitor).
+
+    Replay defense: the first authorize from a peer is answered with
+    NeedChallenge carrying a single-use server challenge; only an
+    authorizer whose MAC binds that challenge is accepted (producing
+    it requires the sealed session key, which a frame-capturing
+    attacker never has). Peer identity here is the transport's —
+    binding challenges to the right connection is the messenger's
+    secure mode's job, as upstream."""
+
+    MAX_CHALLENGES = 1024
 
     def __init__(self, service: str,
                  rotating: list[tuple[int, str, float]],
@@ -358,14 +394,16 @@ class ServiceVerifier:
         self.now = now_fn
         self._secrets = {sid: (_ub(key), exp)
                          for sid, key, exp in rotating}
+        self._challenges: dict[str, str] = {}   # peer -> hex
 
     def refresh(self, rotating: list[tuple[int, str, float]]) -> None:
         self._secrets = {sid: (_ub(key), exp)
                          for sid, key, exp in rotating}
 
-    def verify(self, authorizer: dict) -> dict:
-        """Returns {entity, caps, session_key, reply_mac} or raises
-        AuthError. reply_mac completes mutual auth."""
+    def verify(self, authorizer: dict, peer: str = "") -> dict:
+        """Returns {entity, caps, session_key, reply_mac}, raises
+        NeedChallenge for the anti-replay round, or AuthError.
+        reply_mac completes mutual auth."""
         tk = authorizer["ticket"]
         ent = self._secrets.get(tk["secret_id"])
         if ent is None:
@@ -374,16 +412,38 @@ class ServiceVerifier:
                 "(rotated out; client must refresh tickets)")
         rot, exp = ent
         if self.now() > exp:
-            raise AuthError(f"{self.service} secret expired")
+            raise AuthError(f"{self.service} secret expired "
+                            "(rotated out of this daemon's window)")
         t = _unseal(rot, _ub(tk["blob"]))
         if self.now() > t["expires"]:
             raise AuthError("service ticket expired")
+        chal = authorizer.get("server_challenge")
+        outstanding = self._challenges.get(peer)
+        if chal is None or outstanding is None or chal != outstanding:
+            while len(self._challenges) >= self.MAX_CHALLENGES:
+                self._challenges.pop(next(iter(self._challenges)))
+            fresh = os.urandom(16).hex()
+            self._challenges[peer] = fresh
+            raise NeedChallenge(fresh)
         key = _ub(t["session_key"])
         nonce = _ub(authorizer["nonce"])
-        if not hmac.compare_digest(_hmac(key, nonce),
+        if not hmac.compare_digest(_hmac(key, nonce, _ub(chal)),
                                    _ub(authorizer["mac"])):
             raise AuthError("bad authorizer MAC")
+        self._challenges.pop(peer, None)    # single use
         return {"entity": t["entity"],
                 "caps": {s: Caps(c) for s, c in t["caps"].items()},
                 "session_key": key,
                 "reply_mac": _hmac(key, nonce, b"server")}
+
+
+def local_authorize(cauth: "ClientAuth", verifier: ServiceVerifier,
+                    service: str, peer: str = "local") -> dict:
+    """In-process client<->daemon authorize handshake including the
+    challenge round — what the wire tier does over MAuthOp frames."""
+    az = cauth.authorizer_for(service)
+    try:
+        return verifier.verify(az, peer)
+    except NeedChallenge as nc:
+        az = cauth.authorizer_for(service, server_challenge=nc.challenge)
+        return verifier.verify(az, peer)

@@ -133,6 +133,14 @@ class OSDMap:
         # over; daemons apply it at their config system's "mon" layer
         # on every map commit (defaults < file < mon < override).
         self.config_kv: dict[str, str] = {}
+        # monitor membership (role of the MonMap, ref: src/mon/
+        # MonMap.h + MonmapMonitor.cc). Re-design, same pattern as
+        # config_kv: rather than a second PaxosService with its own
+        # epoch, the member list rides the one replicated value the
+        # monitors run Paxos over — membership changes ARE map
+        # commits, so quorum math moves atomically with the commit
+        # that changes it.
+        self.mon_members: list[int] = [0, 1, 2]
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
@@ -142,10 +150,10 @@ class OSDMap:
         """Versioned wire form: epoch, crush map, per-OSD runtime state,
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
-        # v2 appends pg_upmap_items, v3 config_kv; compat stays 1 (an
-        # old reader skips the tail via the section length — the
-        # ENCODE_START contract)
-        e = Encoder().start(3, 1)
+        # v2 appends pg_upmap_items, v3 config_kv, v4 mon_members;
+        # compat stays 1 (an old reader skips the tail via the section
+        # length — the ENCODE_START contract)
+        e = Encoder().start(4, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -177,13 +185,14 @@ class OSDMap:
                       v, lambda e2, ft: e2.i32(ft[0]).i32(ft[1])))
         e.mapping(self.config_kv, lambda en, k: en.string(k),
                   lambda en, v: en.string(v))
+        e.list(self.mon_members, lambda e2, r: e2.i32(r))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        v = d.start(3)
+        v = d.start(4)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -216,6 +225,8 @@ class OSDMap:
         if v >= 3:
             m.config_kv = d.mapping(lambda dd: dd.string(),
                                     lambda dd: dd.string())
+        if v >= 4:
+            m.mon_members = d.list(lambda dd: dd.i32())
         d.finish()
         return m
 
@@ -252,6 +263,22 @@ class OSDMap:
         if self.config_kv.get(key) == value:
             return
         self.config_kv[key] = value
+        self._bump()
+
+    def mon_join(self, rank: int) -> None:
+        """Admit a monitor to the quorum (ref: MonmapMonitor handling
+        MMonJoin). Idempotent: a duplicate rebases to a no-op."""
+        if rank in self.mon_members:
+            return
+        self.mon_members = sorted(self.mon_members + [rank])
+        self._bump()
+
+    def mon_leave(self, rank: int) -> None:
+        """Remove a monitor from the quorum (`ceph mon remove`) —
+        idempotent like mon_join."""
+        if rank not in self.mon_members:
+            return
+        self.mon_members = [r for r in self.mon_members if r != rank]
         self._bump()
 
     def config_rm(self, key: str) -> None:

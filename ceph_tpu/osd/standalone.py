@@ -379,6 +379,65 @@ class MConfigOp(Message):
         return m
 
 
+def _daemon_authorize(verifier, req: dict, peer: str, req_id: int,
+                      authed: dict, export_fn) -> "MAuthReply":
+    """Shared daemon-side MAuthOp('authorize') handling (OSDs and
+    monitors): run the challenge round, auto-refresh rotating secrets
+    once when the presented secret_id is newer than this daemon's
+    window (the fetch-from-mon-on-newer-sid behavior), bind the
+    session on success."""
+    import json as _json
+
+    from ..auth import AuthError, NeedChallenge
+
+    def _try() -> "MAuthReply":
+        got = verifier.verify(req, peer=peer)
+        authed[peer] = {"entity": got["entity"], "caps": got["caps"]}
+        return MAuthReply(req_id, True, "authorize",
+                          _json.dumps({"reply_mac":
+                                       got["reply_mac"].hex()})
+                          .encode())
+    try:
+        try:
+            return _try()
+        except NeedChallenge:
+            raise
+        except AuthError as e:
+            if "rotated out" in str(e):
+                verifier.refresh(export_fn())
+                return _try()
+            raise
+    except NeedChallenge as nc:
+        return MAuthReply(req_id, False, "authorize",
+                          err=f"EAGAIN:challenge:{nc.challenge}")
+    except Exception as e:   # noqa: BLE001 — reply, don't die
+        return MAuthReply(req_id, False, "authorize",
+                          err=f"{type(e).__name__}:{e}")
+
+
+@register_message
+class MMonJoin(Message):
+    """Monitor membership change request (ref: MMonJoin.h; `ceph mon
+    add/remove`): rank + direction. Queued like any map mutation;
+    the leader commits it through Paxos, so quorum math changes
+    atomically with the committed map."""
+
+    type_id = 0x46
+
+    def __init__(self, rank: int, join: bool):
+        self.rank, self.join = rank, join
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).i32(self.rank).boolean(self.join).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MMonJoin":
+        d.start(1)
+        m = cls(d.i32(), d.boolean())
+        d.finish()
+        return m
+
+
 @register_message
 class MAuthOp(_Blob):
     """cephx traffic (ref: MAuth/MAuthReply): kind selects the auth
@@ -947,21 +1006,15 @@ class OSDDaemon:
 
     def _on_auth(self, peer: str, msg: MAuthOp) -> None:
         """Session establishment (ref: CephxAuthorizeHandler via
-        ms_verify_authorizer): verify the presented service ticket,
-        bind (entity, caps) to the transport peer, prove possession
-        of the rotating secret back (mutual auth)."""
+        ms_verify_authorizer): verify the presented service ticket
+        (challenge round first — anti-replay), bind (entity, caps) to
+        the transport peer, prove possession of the rotating secret
+        back (mutual auth)."""
         import json as _json
-        try:
-            got = self.verifier.verify(_json.loads(msg.blob.decode()))
-            self._authed[peer] = {"entity": got["entity"],
-                                  "caps": got["caps"]}
-            rep = MAuthReply(msg.req_id, True, "authorize",
-                             _json.dumps({"reply_mac":
-                                          got["reply_mac"].hex()})
-                             .encode())
-        except Exception as e:   # noqa: BLE001 — reply, don't die
-            rep = MAuthReply(msg.req_id, False, "authorize",
-                             err=f"{type(e).__name__}:{e}")
+        rep = _daemon_authorize(
+            self.verifier, _json.loads(msg.blob.decode()), peer,
+            msg.req_id, self._authed,
+            lambda: self.c.key_server.export_rotating("osd"))
         try:
             self.msgr.send(peer, rep)
         except (KeyError, OSError, ConnectionError):
@@ -1344,6 +1397,7 @@ class MonDaemon:
         m.register_handler(MMonCommit.type_id, self._on_commit)
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
+        m.register_handler(MMonJoin.type_id, self._on_mon_join)
         # cephx service (ref: AuthMonitor + CephxServiceHandler).
         # Every monitor serves auth against the shared KeyServer (its
         # state is cluster bootstrap config here; KeyServer paxos
@@ -1367,14 +1421,23 @@ class MonDaemon:
                                     daemon=True)
         self._hb.start()
 
-    # -- election (rank + liveness) ------------------------------------------
+    # -- election (rank + liveness, gated on monmap membership) --------------
+
+    def _members(self) -> list[int]:
+        """Quorum membership from the COMMITTED map (the monmap role).
+        Before any map is known (cluster bootstrap), every constructed
+        monitor is presumed a member."""
+        if self.osdmap is not None:
+            return self.osdmap.mon_members
+        return [m.rank for m in self.c.mons]
 
     def _alive_ranks(self) -> set[int]:
+        mem = set(self._members())
         now = time.monotonic()
-        alive = {self.rank}
+        alive = {self.rank} & mem
         for mon in self.c.mons:
             r = mon.rank
-            if r == self.rank:
+            if r == self.rank or r not in mem:
                 continue
             last = self._peer_pong.get(r, self._boot)
             if now - last <= self.c.hb_grace:
@@ -1382,7 +1445,11 @@ class MonDaemon:
         return alive
 
     def is_leader(self) -> bool:
-        return self.rank == min(self._alive_ranks())
+        """Lowest alive MEMBER leads; a removed monitor can never lead
+        (nor count itself toward any quorum) even while its process
+        is still running."""
+        alive = self._alive_ranks()
+        return bool(alive) and self.rank == min(alive)
 
     def _on_ping(self, peer: str, msg: MOSDPing) -> None:
         if peer.startswith("mon."):
@@ -1461,7 +1528,7 @@ class MonDaemon:
     # -- shared helpers ------------------------------------------------------
 
     def _majority(self) -> int:
-        return len(self.c.mons) // 2 + 1
+        return len(self._members()) // 2 + 1
 
     def _send_peers(self, msg: Message) -> None:
         for mon in self.c.mons:
@@ -1577,20 +1644,45 @@ class MonDaemon:
             # until the next commit (subscribers dedup by epoch)
             self._broadcast(msg.epoch)
 
+    def _on_mon_join(self, peer: str, msg: MMonJoin) -> None:
+        """Membership change (ref: MonmapMonitor::prepare_join): queue
+        the idempotent mutation; whoever leads commits it. Quorum math
+        (_members/_majority/election) follows the COMMITTED map, so
+        the change takes effect exactly at commit — Paxos
+        reconfiguration by committing the new config through the old
+        quorum."""
+        if self.osdmap is None:
+            return
+        rank, join = msg.rank, msg.join
+        self.c.log(f"{self.name}: mon.{rank} "
+                   f"{'joins' if join else 'leaves'} (from {peer})")
+
+        def mutate(m: OSDMap) -> None:
+            if join:
+                m.mon_join(rank)
+            else:
+                m.mon_leave(rank)
+        self._commit(mutate)
+
     def _on_auth(self, peer: str, msg: MAuthOp) -> None:
         """cephx endpoint (ref: AuthMonitor::prep_auth): hello /
         authenticate mint the auth ticket; tickets mints per-service
         tickets. Byte fields travel hex-armored in JSON."""
         import json as _json
+        if msg.kind == "authorize":
+            rep = _daemon_authorize(
+                self.verifier, _json.loads(msg.blob.decode()), peer,
+                msg.req_id, self._authed,
+                lambda: self.c.key_server.export_rotating("mon"))
+            try:
+                self.msgr.send(peer, rep)
+            except (KeyError, OSError, ConnectionError):
+                pass
+            return
         try:
             req = _json.loads(msg.blob.decode())
             svc = self.auth_svc
-            if msg.kind == "authorize":
-                got = self.verifier.verify(req)
-                self._authed[peer] = {"entity": got["entity"],
-                                      "caps": got["caps"]}
-                out = {"reply_mac": got["reply_mac"].hex()}
-            elif msg.kind == "hello":
+            if msg.kind == "hello":
                 sc = svc.hello(req["entity"], bytes.fromhex(req["cc"]))
                 out = {"sc": sc.hex()}
             elif msg.kind == "authenticate":
@@ -1653,6 +1745,9 @@ class MonDaemon:
             col = self._collecting
             if col is None or col[0] != msg.pn:
                 return           # stale round
+            if int(peer[4:]) not in self._members():
+                return           # non-member promise must not count
+                                 # toward a collect quorum
             if col[0] < self._promised:
                 # we promised a rival's higher pn mid-collect: this
                 # round is dead (belt to _abandon_below_locked)
@@ -1696,6 +1791,8 @@ class MonDaemon:
             if self._inflight is None or self._inflight[0] != msg.pn \
                     or self._inflight[1] != msg.epoch:
                 return           # superseded / already committed
+            if int(peer[4:]) not in self._members():
+                return           # non-member accept must not count
             self._accepts.add(peer)
             # commit once, on reaching a majority (self included) —
             # only NOW does the proposer's own map advance
@@ -1947,13 +2044,17 @@ class _WireAuth:
 
 def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
     """Present a `service` ticket to `peer` over MAuthOp("authorize"),
-    verify the daemon's mutual-auth proof; refresh the ticket once if
-    its sealing secret rotated out. Shared by clients (osd + mon
-    sessions) and by OSDs authorizing to peer OSDs."""
+    running the daemon's anti-replay challenge round, then verify its
+    mutual-auth proof; refresh the ticket once if its sealing secret
+    rotated out. Shared by clients (osd + mon sessions) and by OSDs
+    authorizing to peer OSDs."""
     import json as _json
     from ..auth import AuthError
-    for attempt in range(2):
-        az = cauth.authorizer_for(service)
+    server_challenge = None
+    refreshed = False
+    for _ in range(4):
+        az = cauth.authorizer_for(service,
+                                  server_challenge=server_challenge)
         try:
             rep = rpc.call(
                 peer, lambda rid: MAuthOp(rid, True, "authorize",
@@ -1969,10 +2070,15 @@ def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
                     f"{peer} failed mutual auth (does not hold the "
                     "rotating secret)")
             return
-        if "rotated out" in rep.err and attempt == 0:
+        if rep.err.startswith("EAGAIN:challenge:"):
+            server_challenge = rep.err.rsplit(":", 1)[1]
+            continue
+        if "rotated out" in rep.err and not refreshed:
             cauth.fetch_tickets([service])
+            refreshed, server_challenge = True, None
             continue
         raise AuthError(rep.err)
+    raise AuthError(f"authorize to {peer} did not converge")
 
 
 class Client:
@@ -2390,6 +2496,65 @@ class StandaloneCluster:
         election carry on without it (2 of 3 still commit)."""
         self.log(f"SIGKILL mon.{rank}")
         self.mons[rank].kill()
+
+    # -- monitor membership (`ceph mon add/remove`) ---------------------------
+
+    def add_mon(self, timeout: float = 20.0) -> int:
+        """Grow the quorum: boot a new monitor, store-sync it, commit
+        its membership through the OLD quorum (ref: `ceph mon add` +
+        MonmapMonitor::prepare_join). Returns the new rank."""
+        rank = len(self.mons)
+        self.log(f"add mon.{rank}")
+        fresh = MonDaemon(rank, self)
+        self.mons.append(fresh)
+        self._wire_peers()
+        for mon in self.mons:
+            if mon is not fresh and not mon._stop.is_set():
+                try:
+                    fresh.msgr.send(mon.name, MMonSyncReq(0))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+        self._wait(lambda: fresh.osdmap is not None, timeout,
+                   f"mon.{rank} bootstrap sync")
+        self._cast_mon_join(MMonJoin(rank, True))
+        self._wait(
+            lambda: any(not m._stop.is_set() and m.osdmap is not None
+                        and rank in m.osdmap.mon_members
+                        for m in self.mons), timeout,
+            f"mon.{rank} membership committed")
+        return rank
+
+    def _cast_mon_join(self, msg: MMonJoin) -> None:
+        """Deliver a membership change to EVERY live monitor. A
+        messenger has no loopback (a daemon is never its own peer),
+        so a single-source broadcast can't reach the sender itself —
+        fatal when the current LEADER is the one being removed (only
+        the leader proposes). All live pairs cross-send instead, so
+        each monitor, leader included, hears it from someone."""
+        live = [m for m in self.mons if not m._stop.is_set()]
+        for src in live:
+            for dst in live:
+                if src is dst:
+                    continue
+                try:
+                    src.msgr.send(dst.name, msg)
+                except (KeyError, OSError, ConnectionError):
+                    pass
+
+    def remove_mon(self, rank: int, timeout: float = 20.0) -> None:
+        """Shrink the quorum: commit the member's departure through
+        the current quorum, then stop its process (ref: `ceph mon
+        remove`). A removed-but-running monitor can no longer lead or
+        vote — membership rides the committed map."""
+        self.log(f"remove mon.{rank}")
+        target = self.mons[rank]
+        self._cast_mon_join(MMonJoin(rank, False))
+        self._wait(
+            lambda: any(not m._stop.is_set() and m.osdmap is not None
+                        and rank not in m.osdmap.mon_members
+                        for m in self.mons), timeout,
+            f"mon.{rank} removal committed")
+        target.kill()
 
     def revive_mon(self, rank: int) -> None:
         """Restart a monitor: fresh endpoint, DURABLE Paxos state.

@@ -5,7 +5,8 @@ MonCap/OSDCap grammar)."""
 import pytest
 
 from ceph_tpu.auth import (AuthError, AuthService, Caps, ClientAuth,
-                           KeyServer, ServiceVerifier)
+                           KeyServer, NeedChallenge, ServiceVerifier,
+                           local_authorize)
 
 
 class FakeClock:
@@ -35,7 +36,11 @@ class TestHandshake:
         client.login()
         client.fetch_tickets(["osd"])
         az = client.authorizer_for("osd")
-        got = osd.verify(az)
+        with pytest.raises(NeedChallenge) as nc:
+            osd.verify(az, peer="c1")        # anti-replay round first
+        az = client.authorizer_for(
+            "osd", server_challenge=nc.value.challenge)
+        got = osd.verify(az, peer="c1")
         assert got["entity"] == "client.admin"
         assert got["caps"]["osd"].allows("w")
         assert client.verify_reply("osd", az, got["reply_mac"])
@@ -72,21 +77,48 @@ class TestHandshake:
         blob[20] ^= 0xFF
         az["ticket"]["blob"] = bytes(blob).hex()
         with pytest.raises(AuthError, match="tampered|authentication"):
-            osd.verify(az)
+            osd.verify(az, peer="c1")
 
     def test_forged_mac_rejected(self):
         clock, ks, auth, client, osd = setup_realm()
         az = client.authorizer_for("osd")
+        with pytest.raises(NeedChallenge) as nc:
+            osd.verify(az, peer="c1")
+        az = client.authorizer_for(
+            "osd", server_challenge=nc.value.challenge)
         az["mac"] = "00" * 32
         with pytest.raises(AuthError, match="MAC"):
-            osd.verify(az)
+            osd.verify(az, peer="c1")
+
+    def test_captured_authorizer_replay_rejected(self):
+        """The CVE-2018-1128 scenario: a frame-capturing attacker
+        replays a once-valid authorizer. The challenge round makes
+        every accepted authorizer single-use and challenge-bound, so
+        the replay is refused from any peer — including the one the
+        original was accepted on."""
+        clock, ks, auth, client, osd = setup_realm()
+        az = client.authorizer_for("osd")
+        with pytest.raises(NeedChallenge) as nc:
+            osd.verify(az, peer="victim")
+        az = client.authorizer_for(
+            "osd", server_challenge=nc.value.challenge)
+        assert osd.verify(az, peer="victim")["entity"] == "client.admin"
+        # same frame, same peer: the challenge was consumed
+        with pytest.raises(NeedChallenge):
+            osd.verify(az, peer="victim")
+        # same frame, attacker's connection: different outstanding
+        # challenge, MAC can't match it
+        with pytest.raises(NeedChallenge):
+            osd.verify(az, peer="attacker")
+        with pytest.raises((AuthError, NeedChallenge)):
+            osd.verify(az, peer="attacker")
 
     def test_osd_never_sees_entity_secret(self):
         """The ticket blob carries a per-session key, not the entity
         secret — compromise of one OSD leaks no long-term keys."""
         clock, ks, auth, client, osd = setup_realm()
         az = client.authorizer_for("osd")
-        got = osd.verify(az)
+        got = local_authorize(client, osd, "osd")
         assert got["session_key"] != client.secret
         assert client.secret.hex() not in az["ticket"]["blob"]
 
@@ -95,38 +127,37 @@ class TestExpiryAndRotation:
     def test_expired_ticket_rejected_then_refreshed(self):
         clock, ks, auth, client, osd = setup_realm(ttl=100.0)
         az = client.authorizer_for("osd")
-        osd.verify(az)
+        local_authorize(client, osd, "osd")
         clock.t += 200.0             # past ticket ttl
         with pytest.raises(AuthError, match="expired"):
-            osd.verify(az)
+            osd.verify(az, peer="x")
         # authorizer_for auto-refreshes (client re-logs-in under the
-        # still-valid entity secret)
+        # still-valid entity secret); the KeyServer auto-rotated past
+        # the aged secret, so the daemon refreshes its window too (the
+        # wire tier does this on unknown-sid automatically)
         client.session_key = None    # old session expired too
-        az2 = client.authorizer_for("osd")
-        assert osd.verify(az2)["entity"] == "client.admin"
+        osd.refresh(ks.export_rotating("osd"))
+        got = local_authorize(client, osd, "osd")
+        assert got["entity"] == "client.admin"
 
     def test_rotation_window(self):
         """Tickets under the previous rotating secret still verify;
         after the secret rotates out, they're refused."""
         clock, ks, auth, client, osd = setup_realm()
-        az = client.authorizer_for("osd")
+        client.fetch_tickets(["osd"])   # ticket under the first sid
         ks.rotate("osd")
         ks.rotate("osd")
         osd.refresh(ks.export_rotating("osd"))
-        assert osd.verify(az)["entity"] == "client.admin"  # still in keep-window
+        got = local_authorize(client, osd, "osd")   # still in window
+        assert got["entity"] == "client.admin"
         ks.rotate("osd")             # now rotated out (keep = 3)
         osd.refresh(ks.export_rotating("osd"))
         with pytest.raises(AuthError, match="rotated out"):
-            osd.verify(az)
-        az2 = client.authorizer_for("osd")   # stale ticket in client cache
-        # client-side ticket still under old sid: daemon tells it to
-        # refresh; fetch anew
-        try:
-            osd.verify(az2)
-        except AuthError:
-            client.fetch_tickets(["osd"])
-            az2 = client.authorizer_for("osd")
-        assert osd.verify(az2)["entity"] == "client.admin"
+            local_authorize(client, osd, "osd")
+        # daemon told the client to refresh: fetch anew and retry
+        client.fetch_tickets(["osd"])
+        got = local_authorize(client, osd, "osd")
+        assert got["entity"] == "client.admin"
 
     def test_expired_auth_ticket_triggers_relogin(self):
         """A long-lived client whose AUTH ticket aged out re-logins
@@ -136,8 +167,9 @@ class TestExpiryAndRotation:
         client.login()
         clock.t += 200.0             # auth ticket now expired
         client.fetch_tickets(["osd"])    # must re-login internally
-        az = client.authorizer_for("osd")
-        assert osd.verify(az)["entity"] == "client.admin"
+        osd.refresh(ks.export_rotating("osd"))   # window moved with time
+        got = local_authorize(client, osd, "osd")
+        assert got["entity"] == "client.admin"
 
     def test_new_tickets_use_current_secret(self):
         clock, ks, auth, client, osd = setup_realm()
@@ -147,7 +179,8 @@ class TestExpiryAndRotation:
         az = client.authorizer_for("osd")
         assert az["ticket"]["secret_id"] != sid0
         osd.refresh(ks.export_rotating("osd"))
-        assert osd.verify(az)["entity"] == "client.admin"
+        got = local_authorize(client, osd, "osd")
+        assert got["entity"] == "client.admin"
 
 
 class TestCaps:
@@ -181,7 +214,7 @@ class TestCaps:
         cl = ClientAuth(auth, "client.ro", s, now_fn=clock)
         osd = ServiceVerifier("osd", ks.export_rotating("osd"),
                               now_fn=clock)
-        got = osd.verify(cl.authorizer_for("osd"))
+        got = local_authorize(cl, osd, "osd")
         assert got["caps"]["osd"].allows("r", pool="default")
         assert not got["caps"]["osd"].allows("w", pool="default")
         assert not got["caps"]["osd"].allows("r", pool="other")

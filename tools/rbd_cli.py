@@ -1,0 +1,258 @@
+"""rbd — block-image administration CLI.
+
+Recreation of the reference's `rbd` command surface (ref:
+src/tools/rbd/ — create/ls/info/rm/resize, snap create/ls/protect/
+unprotect/rollback/rm, clone/flatten/children, export/import,
+diff/export-diff) over this framework's librbd-shaped layer
+(`ceph_tpu/client/rbd.py`) on a hermetic SimCluster whose state
+persists across invocations via an objectstore export file — so the
+CLI behaves statefully like the real tool:
+
+  python tools/rbd_cli.py --state /tmp/rbd.img create vm1 --size 8M
+  python tools/rbd_cli.py --state /tmp/rbd.img snap create vm1@gold
+  python tools/rbd_cli.py --state /tmp/rbd.img snap protect vm1@gold
+  python tools/rbd_cli.py --state /tmp/rbd.img clone vm1@gold vm2
+  python tools/rbd_cli.py --state /tmp/rbd.img ls -l
+  python tools/rbd_cli.py --state /tmp/rbd.img import ./disk.raw vm3
+  python tools/rbd_cli.py --state /tmp/rbd.img export vm2 ./out.raw
+  python tools/rbd_cli.py --state /tmp/rbd.img diff vm2 --from-snap s1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_size(s: str) -> int:
+    s = s.strip().upper()
+    mult = 1
+    for suf, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if s.endswith(suf):
+            mult, s = m, s[:-1]
+            break
+    return int(float(s) * mult)
+
+
+def split_at_snap(spec: str) -> tuple[str, str]:
+    """'image@snap' -> (image, snap); errors without an @."""
+    if "@" not in spec:
+        raise SystemExit(f"rbd: expected image@snap, got {spec!r}")
+    img, snap = spec.split("@", 1)
+    return img, snap
+
+
+class State:
+    """The CLI's cluster-in-a-file: object payloads + pool snap state
+    pickle-exported per invocation (the `rbd` tool's statefulness
+    against a real cluster, without a daemon)."""
+
+    def __init__(self, path: str | None):
+        from ceph_tpu.client.rados import Rados
+        from ceph_tpu.client.rbd import RBD
+        from ceph_tpu.osd.cluster import SimCluster
+        self.path = path
+        self.cluster = SimCluster(n_osds=6, pg_num=4)
+        self.io = Rados(self.cluster).open_ioctx()
+        self.rbd = RBD(self.io)
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                snap = pickle.load(f)
+            c = self.cluster
+            self.io.rados  # keep import shape obvious
+            for name, data in snap["objects"].items():
+                c.write({name: data})
+            c.snap_seq = snap["snap_seq"]
+            c.sm_snaps = set(snap["sm_snaps"])
+            c.selfmanaged = bool(snap["sm_snaps"]) or snap["selfmanaged"]
+            c.snapsets = {k: [tuple(x) for x in v]
+                          for k, v in snap["snapsets"].items()}
+            c.object_births = dict(snap["births"])
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        c = self.cluster
+        objects = {}
+        for ps in range(c.pg_num):
+            for name in c.pgs[ps].list_pg_objects():
+                objects[name] = bytes(c.pgs[ps].read_object(
+                    name, dead_osds=set()))
+        snap = {"objects": objects, "snap_seq": c.snap_seq,
+                "sm_snaps": sorted(c.sm_snaps),
+                "selfmanaged": c.selfmanaged,
+                "snapsets": {k: [list(x) for x in v]
+                             for k, v in c.snapsets.items()},
+                "births": dict(c.object_births)}
+        with open(self.path, "wb") as f:
+            pickle.dump(snap, f)
+
+
+def cmd_create(st: State, a) -> None:
+    st.rbd.create(a.image, parse_size(a.size))
+    print(f"created {a.image} ({parse_size(a.size)} bytes)")
+
+
+def cmd_ls(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    for name in st.rbd.list():
+        if not a.long:
+            print(name)
+            continue
+        img = Image(st.rbd, name)
+        hdr = img._hdr()
+        parent = hdr["parent"]
+        extra = f" parent={parent['image']}@{parent['snap_name']}" \
+            if parent else ""
+        print(f"{name}\t{hdr['size']}\tsnaps={len(hdr['snaps'])}{extra}")
+
+
+def cmd_info(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    img = Image(st.rbd, a.image)
+    hdr = img._hdr()
+    out = {"name": a.image, "size": hdr["size"],
+           "snaps": hdr["snaps"], "parent": hdr["parent"]}
+    print(json.dumps(out, indent=1, sort_keys=True))
+
+
+def cmd_rm(st: State, a) -> None:
+    st.rbd.remove(a.image)
+    print(f"removed {a.image}")
+
+
+def cmd_resize(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    Image(st.rbd, a.image).resize(parse_size(a.size))
+    print(f"resized {a.image} -> {parse_size(a.size)}")
+
+
+def cmd_snap(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    if a.snap_op == "ls":
+        img = Image(st.rbd, a.spec)
+        for s in img.snap_list():
+            prot = " (protected)" if s["protected"] else ""
+            print(f"{s['id']}\t{s['name']}\t{s['size']}{prot}")
+        return
+    image, snap = split_at_snap(a.spec)
+    img = Image(st.rbd, image)
+    if a.snap_op == "create":
+        sid = img.snap_create(snap)
+        print(f"created {image}@{snap} (id {sid})")
+    elif a.snap_op == "protect":
+        img.snap_protect(snap)
+        print(f"protected {image}@{snap}")
+    elif a.snap_op == "unprotect":
+        img.snap_unprotect(snap)
+        print(f"unprotected {image}@{snap}")
+    elif a.snap_op == "rollback":
+        img.snap_rollback(snap)
+        print(f"rolled back {image} to @{snap}")
+    elif a.snap_op == "rm":
+        img.snap_remove(snap)
+        print(f"removed {image}@{snap}")
+
+
+def cmd_clone(st: State, a) -> None:
+    image, snap = split_at_snap(a.parent)
+    st.rbd.clone(image, snap, a.child)
+    print(f"cloned {image}@{snap} -> {a.child}")
+
+
+def cmd_flatten(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    Image(st.rbd, a.image).flatten()
+    print(f"flattened {a.image}")
+
+
+def cmd_children(st: State, a) -> None:
+    image, snap = split_at_snap(a.spec)
+    for c in st.rbd.list_children(image, snap):
+        print(c)
+
+
+def cmd_export(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    img = Image(st.rbd, a.image)
+    if a.snap:
+        img.set_snap(a.snap)
+    data = img.read(0, img.size())
+    with open(a.dest, "wb") as f:
+        f.write(data)
+    print(f"exported {a.image}"
+          + (f"@{a.snap}" if a.snap else "")
+          + f" -> {a.dest} ({len(data)} bytes)")
+
+
+def cmd_import(st: State, a) -> None:
+    with open(a.src, "rb") as f:
+        data = f.read()
+    img = st.rbd.create(a.image, len(data))
+    if data:
+        img.write(0, data)
+    print(f"imported {a.src} -> {a.image} ({len(data)} bytes)")
+
+
+def cmd_diff(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    img = Image(st.rbd, a.image)
+    runs = img.diff_iterate(from_snap=a.from_snap)
+    for off, ln in runs:
+        print(f"{off}\t{ln}")
+    total = sum(ln for _, ln in runs)
+    print(f"# {len(runs)} extent(s), {total} bytes", file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="rbd", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--state", help="cluster state file (persists "
+                    "images across invocations)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("create"); p.add_argument("image")
+    p.add_argument("--size", required=True)
+    p = sub.add_parser("ls"); p.add_argument("-l", "--long",
+                                             action="store_true")
+    p = sub.add_parser("info"); p.add_argument("image")
+    p = sub.add_parser("rm"); p.add_argument("image")
+    p = sub.add_parser("resize"); p.add_argument("image")
+    p.add_argument("--size", required=True)
+    p = sub.add_parser("snap")
+    p.add_argument("snap_op", choices=["create", "ls", "protect",
+                                       "unprotect", "rollback", "rm"])
+    p.add_argument("spec", help="image@snap (image alone for ls)")
+    p = sub.add_parser("clone"); p.add_argument("parent")
+    p.add_argument("child")
+    p = sub.add_parser("flatten"); p.add_argument("image")
+    p = sub.add_parser("children"); p.add_argument("spec")
+    p = sub.add_parser("export"); p.add_argument("image")
+    p.add_argument("dest"); p.add_argument("--snap")
+    p = sub.add_parser("import"); p.add_argument("src")
+    p.add_argument("image")
+    p = sub.add_parser("diff"); p.add_argument("image")
+    p.add_argument("--from-snap", dest="from_snap")
+
+    a = ap.parse_args(argv)
+    st = State(a.state)
+    try:
+        {"create": cmd_create, "ls": cmd_ls, "info": cmd_info,
+         "rm": cmd_rm, "resize": cmd_resize, "snap": cmd_snap,
+         "clone": cmd_clone, "flatten": cmd_flatten,
+         "children": cmd_children, "export": cmd_export,
+         "import": cmd_import, "diff": cmd_diff}[a.cmd](st, a)
+    except (KeyError, FileExistsError, FileNotFoundError,
+            ValueError) as e:
+        raise SystemExit(f"rbd: {type(e).__name__}: {e}")
+    st.save()
+
+
+if __name__ == "__main__":
+    main()
