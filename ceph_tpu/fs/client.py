@@ -78,7 +78,10 @@ def _dir_link(h: ClsHandle, inp: bytes) -> bytes:
     if req["name"] in dents and not req.get("replace", False):
         raise ClsError(f"EEXIST: {req['name']}")
     dents[req["name"]] = req["ent"]
-    return b"{}"
+    # the dentry count rides back so the client can decide to split
+    # this frag (CDir::should_split checks size at the MDS the same
+    # way — on the structure that just grew)
+    return json.dumps({"count": len(dents)}).encode()
 
 
 @register_cls("fs_dir", "unlink")
@@ -87,7 +90,33 @@ def _dir_unlink(h: ClsHandle, inp: bytes) -> bytes:
     dents = h.kv.setdefault("dentries", {})
     if name not in dents:
         raise ClsError(f"ENOENT: {name}")
-    return json.dumps(dents.pop(name)).encode()
+    ent = dents.pop(name)
+    return json.dumps({"ent": ent, "count": len(dents)}).encode()
+
+
+@register_cls("fs_dir", "get_bits")
+def _dir_get_bits(h: ClsHandle, inp: bytes) -> bytes:
+    return json.dumps({"bits": h.kv.get("frag_bits", 0)}).encode()
+
+
+@register_cls("fs_dir", "set_bits")
+def _dir_set_bits(h: ClsHandle, inp: bytes) -> bytes:
+    h.kv["frag_bits"] = json.loads(inp)["bits"]
+    return b"{}"
+
+
+@register_cls("fs_dir", "load")
+def _dir_load(h: ClsHandle, inp: bytes) -> bytes:
+    """Replace this frag's whole dentry table in one op (the bulk
+    move of a split/merge; frag_bits in the same KV is untouched)."""
+    h.kv["dentries"] = json.loads(inp)
+    return b"{}"
+
+
+@register_cls("fs_dir", "clear")
+def _dir_clear(h: ClsHandle, inp: bytes) -> bytes:
+    h.kv.pop("dentries", None)
+    return b"{}"
 
 
 @register_cls("fs_dir", "lookup")
@@ -135,9 +164,22 @@ class FsClient:
     STRIPE_COUNT = 4
     OBJECT_SIZE = 1 << 20
 
-    def __init__(self, ioctx: IoCtx, name: str = "fsclient"):
+    def __init__(self, ioctx: IoCtx, name: str = "fsclient",
+                 frag_split_threshold: int = 128,
+                 frag_merge_threshold: int | None = None,
+                 max_frag_bits: int = 6):
         self.io = ioctx
         self.name = name
+        # directory fragmentation knobs (ref: mds_bal_split_size /
+        # mds_bal_merge_size + fragtree_t). Simplification disclosed:
+        # fragmentation is UNIFORM per directory (all frags at one
+        # bit-depth), where the reference's fragtree can split frags
+        # unevenly.
+        self.frag_split_threshold = frag_split_threshold
+        self.frag_merge_threshold = (frag_split_threshold // 8
+                                     if frag_merge_threshold is None
+                                     else frag_merge_threshold)
+        self.max_frag_bits = max_frag_bits
         self._striper = RadosStriper(
             ioctx, stripe_unit=self.STRIPE_UNIT,
             stripe_count=self.STRIPE_COUNT,
@@ -173,6 +215,139 @@ class FsClient:
         out = self.io.execute(_META_OBJ, "fs_meta", "alloc_ino")
         return json.loads(out)["ino"]
 
+    # -- directory fragmentation (CDir::split/merge, fragtree_t) -------------
+
+    def _frag_obj(self, ino: int, frag: int, bits: int) -> str:
+        return f"{self._dir_obj(ino)}.f{frag:x}b{bits}"
+
+    def _dir_bits(self, ino: int) -> int:
+        raw = self.io.execute(self._dir_obj(ino), "fs_dir", "get_bits")
+        return json.loads(raw)["bits"]
+
+    @staticmethod
+    def _frag_of(name: str, bits: int) -> int:
+        import zlib
+        return zlib.crc32(name.encode()) & ((1 << bits) - 1) \
+            if bits else 0
+
+    def _dentry_obj(self, ino: int, name: str,
+                    bits: int | None = None) -> str:
+        """The object holding `name`'s dentry under the dir's current
+        fragmentation (bits 0 = the base dirfrag itself)."""
+        if bits is None:
+            bits = self._dir_bits(ino)
+        if bits == 0:
+            return self._dir_obj(ino)
+        return self._frag_obj(ino, self._frag_of(name, bits), bits)
+
+    def _frag_objs(self, ino: int, bits: int) -> list[str]:
+        if bits == 0:
+            return [self._dir_obj(ino)]
+        return [self._frag_obj(ino, f, bits) for f in range(1 << bits)]
+
+    def _list_all(self, ino: int, bits: int | None = None) -> dict:
+        """Merged dentries across every frag (CDir::get_dentries over
+        the fragtree)."""
+        if bits is None:
+            bits = self._dir_bits(ino)
+        out: dict = {}
+        for obj in self._frag_objs(ino, bits):
+            try:
+                out.update(json.loads(
+                    self.io.execute(obj, "fs_dir", "list")))
+            except (ClsError, KeyError):
+                pass    # frag object missing: empty frag
+        return out
+
+    def _link(self, ino: int, name: str, ent: dict,
+              replace: bool = False) -> None:
+        obj = self._dentry_obj(ino, name)
+        raw = self.io.execute(obj, "fs_dir", "link",
+                              json.dumps({"name": name, "ent": ent,
+                                          "replace": replace}).encode())
+        if json.loads(raw)["count"] > self.frag_split_threshold:
+            self._split_dir(ino)
+
+    def _unlink(self, ino: int, name: str) -> None:
+        obj = self._dentry_obj(ino, name)
+        raw = self.io.execute(obj, "fs_dir", "unlink",
+                              json.dumps({"name": name}).encode())
+        # this frag's remaining count is a LOWER bound on the dir
+        # total: above the merge threshold the full 2^bits listing in
+        # _maybe_merge can't fire and is skipped at zero extra I/O
+        if json.loads(raw)["count"] <= self.frag_merge_threshold:
+            self._maybe_merge(ino)
+
+    def _reload_level(self, ino: int, bits: int, dents: dict) -> None:
+        """Write `dents` out as fragmentation level `bits` (bulk load
+        per frag), without touching frag_bits."""
+        groups: dict[int, dict] = {}
+        for name, ent in dents.items():
+            groups.setdefault(self._frag_of(name, bits), {})[name] = ent
+        for f, obj in enumerate(self._frag_objs(ino, bits)):
+            if bits:
+                self.io.write_full(obj, b"dirfrag")
+            self.io.execute(obj, "fs_dir", "load",
+                            json.dumps(groups.get(f, {})).encode())
+
+    def _split_dir(self, ino: int) -> None:
+        """One level deeper (CDir::split). Crash ordering: new frags
+        are fully materialized BEFORE frag_bits flips (readers keep
+        the old layout until the single-object commit point), then the
+        old level is cleared; a crash in between leaves unreachable
+        stale copies that the next split/merge rewrites."""
+        bits = self._dir_bits(ino)
+        if bits >= self.max_frag_bits:
+            return
+        dents = self._list_all(ino, bits)
+        self._reload_level(ino, bits + 1, dents)
+        self.io.execute(self._dir_obj(ino), "fs_dir", "set_bits",
+                        json.dumps({"bits": bits + 1}).encode())
+        self._drop_level(ino, bits)
+
+    def _maybe_merge(self, ino: int) -> None:
+        """Shallower — as many levels as the shrink warrants — when
+        the whole dir dropped below the merge threshold (CDir::merge;
+        upstream's mds_bal_merge_size)."""
+        while True:
+            bits = self._dir_bits(ino)
+            if bits == 0:
+                return
+            dents = self._list_all(ino, bits)
+            if len(dents) > self.frag_merge_threshold:
+                return
+            self._reload_level(ino, bits - 1, dents)
+            self.io.execute(self._dir_obj(ino), "fs_dir", "set_bits",
+                            json.dumps({"bits": bits - 1}).encode())
+            self._drop_level(ino, bits)
+
+    def _drop_level(self, ino: int, bits: int) -> None:
+        if bits == 0:
+            self.io.execute(self._dir_obj(ino), "fs_dir", "clear")
+            return
+        for obj in self._frag_objs(ino, bits):
+            try:
+                self.io.remove(obj)
+            except KeyError:
+                pass
+
+    def frag_info(self, path: str) -> dict:
+        """Observability: the dir's fragmentation state (`ceph tell
+        mds dirfrag ls` role)."""
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        bits = self._dir_bits(ent["ino"])
+        per = {}
+        for obj in self._frag_objs(ent["ino"], bits):
+            try:
+                per[obj] = len(json.loads(
+                    self.io.execute(obj, "fs_dir", "list")))
+            except (ClsError, KeyError):
+                per[obj] = 0
+        return {"bits": bits, "frags": 1 << bits if bits else 1,
+                "dentries": sum(per.values()), "per_frag": per}
+
     # -- path walk (MDCache::path_traverse) ----------------------------------
 
     @staticmethod
@@ -188,10 +363,11 @@ class FsClient:
             if cur["type"] != "dir":
                 raise NotADir("/" + "/".join(parts[:i]))
             try:
-                raw = self.io.execute(self._dir_obj(cur["ino"]),
-                                      "fs_dir", "lookup",
-                                      json.dumps({"name": name}).encode())
-            except ClsError:
+                raw = self.io.execute(
+                    self._dentry_obj(cur["ino"], name),
+                    "fs_dir", "lookup",
+                    json.dumps({"name": name}).encode())
+            except (ClsError, KeyError):
                 raise FileNotFoundError(
                     "/" + "/".join(parts[:i + 1])) from None
             cur = json.loads(raw)
@@ -214,8 +390,7 @@ class FsClient:
         self.io.write_full(self._dir_obj(ino), b"dirfrag")
         ent = {"ino": ino, "type": "dir", "size": 0,
                "mtime": self._clock()}
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir", "link",
-                        json.dumps({"name": name, "ent": ent}).encode())
+        self._link(parent["ino"], name, ent)
 
     def create(self, path: str, data: bytes = b"") -> None:
         """create + write in one call (the O_CREAT|O_WRONLY shape)."""
@@ -223,8 +398,7 @@ class FsClient:
         ino = self._alloc_ino()
         ent = {"ino": ino, "type": "file", "size": 0,
                "mtime": self._clock()}
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir", "link",
-                        json.dumps({"name": name, "ent": ent}).encode())
+        self._link(parent["ino"], name, ent)
         if data:
             self.write(path, data)
 
@@ -235,9 +409,7 @@ class FsClient:
         ent = self._walk(self._split(path))
         if ent["type"] != "dir":
             raise NotADir(path)
-        raw = self.io.execute(self._dir_obj(ent["ino"]),
-                              "fs_dir", "list")
-        return json.loads(raw)
+        return self._list_all(ent["ino"])
 
     def unlink(self, path: str) -> None:
         parent, name = self._parent_and_name(path)
@@ -245,8 +417,7 @@ class FsClient:
         if ent["type"] == "dir":
             raise IsADir(path)
         self._check_caps(ent["ino"], write=True, what=f"unlink {path}")
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
-                        "unlink", json.dumps({"name": name}).encode())
+        self._unlink(parent["ino"], name)
         try:
             self._striper.remove(self._data_obj(ent["ino"]))
         except KeyError:
@@ -263,8 +434,10 @@ class FsClient:
             raise NotADir(path)
         if self.readdir(path):
             raise NotEmpty(path)
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
-                        "unlink", json.dumps({"name": name}).encode())
+        bits = self._dir_bits(ent["ino"])
+        self._unlink(parent["ino"], name)
+        if bits:
+            self._drop_level(ent["ino"], bits)
         self.io.remove(self._dir_obj(ent["ino"]))
 
     def rename(self, src: str, dst: str) -> None:
@@ -301,11 +474,8 @@ class FsClient:
             old_ino = dent["ino"]
         except FileNotFoundError:
             old_ino = None
-        self.io.execute(self._dir_obj(dparent["ino"]), "fs_dir", "link",
-                        json.dumps({"name": dname, "ent": ent,
-                                    "replace": True}).encode())
-        self.io.execute(self._dir_obj(sparent["ino"]), "fs_dir",
-                        "unlink", json.dumps({"name": sname}).encode())
+        self._link(dparent["ino"], dname, ent, replace=True)
+        self._unlink(sparent["ino"], sname)
         if old_ino is not None and old_ino != ent["ino"]:
             for obj, rm in ((self._data_obj(old_ino),
                              self._striper.remove),
@@ -436,8 +606,8 @@ class FsClient:
         self._striper.write(self._data_obj(ent["ino"]), bytes(data),
                             offset=offset)
         new_size = max(ent["size"], offset + len(data))
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
-                        "update",
+        self.io.execute(self._dentry_obj(parent["ino"], name),
+                        "fs_dir", "update",
                         json.dumps({"name": name,
                                     "fields": {"size": new_size,
                                                "mtime": self._clock()}
@@ -471,8 +641,8 @@ class FsClient:
             self._striper.write(self._data_obj(ent["ino"]), b"\x00")
         if ent["size"] > 0 or size > 0:
             self._striper.truncate(self._data_obj(ent["ino"]), size)
-        self.io.execute(self._dir_obj(parent["ino"]), "fs_dir",
-                        "update",
+        self.io.execute(self._dentry_obj(parent["ino"], name),
+                        "fs_dir", "update",
                         json.dumps({"name": name,
                                     "fields": {"size": size,
                                                "mtime": self._clock()}

@@ -1,0 +1,133 @@
+"""Directory fragmentation: split/merge + routed dentry ops (refs:
+src/mds/CDir.cc split/merge, fragtree_t, mds_bal_split_size/
+mds_bal_merge_size)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.fs.client import FsClient, NotEmpty
+from ceph_tpu.osd.cluster import SimCluster
+
+
+def mkfs(split=6, merge=None, **kw):
+    kw.setdefault("n_osds", 8)
+    kw.setdefault("pg_num", 4)
+    c = SimCluster(**kw)
+    io = Rados(c).open_ioctx()
+    return c, FsClient(io, frag_split_threshold=split,
+                       frag_merge_threshold=merge)
+
+
+class TestDirfragSplit:
+    def test_split_on_growth_and_all_ops_still_route(self):
+        c, fs = mkfs(split=6)
+        fs.mkdir("/big")
+        names = [f"file{i:03d}" for i in range(40)]
+        for n in names:
+            fs.create(f"/big/{n}", data=n.encode())
+        info = fs.frag_info("/big")
+        assert info["bits"] >= 1, "directory must have split"
+        assert info["dentries"] == 40
+        # every dentry still resolves through the frag routing
+        assert sorted(fs.readdir("/big")) == names
+        for n in names:
+            assert fs.read(f"/big/{n}") == n.encode()
+            assert fs.stat(f"/big/{n}")["type"] == "file"
+
+    def test_split_distributes_over_frags(self):
+        c, fs = mkfs(split=4)
+        fs.mkdir("/d")
+        for i in range(30):
+            fs.create(f"/d/entry-{i}")
+        info = fs.frag_info("/d")
+        nonempty = [v for v in info["per_frag"].values() if v]
+        assert len(nonempty) >= 2, \
+            f"dentries should spread over frags: {info}"
+        assert sum(info["per_frag"].values()) == 30
+
+    def test_unfragmented_small_dir_stays_flat(self):
+        c, fs = mkfs(split=100)
+        fs.mkdir("/small")
+        for i in range(10):
+            fs.create(f"/small/f{i}")
+        assert fs.frag_info("/small")["bits"] == 0
+
+    def test_merge_on_shrink(self):
+        c, fs = mkfs(split=6, merge=2)
+        fs.mkdir("/shrink")
+        names = [f"n{i:02d}" for i in range(20)]
+        for n in names:
+            fs.create(f"/shrink/{n}")
+        assert fs.frag_info("/shrink")["bits"] >= 1
+        for n in names[:-1]:
+            fs.unlink(f"/shrink/{n}")
+        info = fs.frag_info("/shrink")
+        assert info["bits"] == 0, f"should have merged flat: {info}"
+        assert sorted(fs.readdir("/shrink")) == [names[-1]]
+        assert fs.read(f"/shrink/{names[-1]}") == b""
+
+    def test_rename_within_and_across_fragmented_dirs(self):
+        c, fs = mkfs(split=4)
+        fs.mkdir("/a")
+        fs.mkdir("/b")
+        for i in range(20):
+            fs.create(f"/a/f{i}", data=f"payload{i}".encode())
+        assert fs.frag_info("/a")["bits"] >= 1
+        fs.rename("/a/f3", "/a/f3-renamed")
+        assert fs.read("/a/f3-renamed") == b"payload3"
+        fs.rename("/a/f4", "/b/moved")
+        assert fs.read("/b/moved") == b"payload4"
+        with pytest.raises(FileNotFoundError):
+            fs.stat("/a/f4")
+
+    def test_rmdir_fragmented_dir_after_empty(self):
+        c, fs = mkfs(split=4, merge=0)   # merge=0: frags persist
+        fs.mkdir("/victim")
+        for i in range(20):
+            fs.create(f"/victim/x{i}")
+        assert fs.frag_info("/victim")["bits"] >= 1
+        with pytest.raises(NotEmpty):
+            fs.rmdir("/victim")
+        for i in range(20):
+            fs.unlink(f"/victim/x{i}")
+        fs.rmdir("/victim")
+        with pytest.raises(FileNotFoundError):
+            fs.readdir("/victim")
+        # no leaked frag objects
+        assert not [o for o in fs.io.list_objects()
+                    if o.startswith(".fs.dir.") and "f" in o.split(".")[-1]
+                    and o not in (".fs.dir.1",)], \
+            "fragment objects must not leak after rmdir"
+
+    def test_write_updates_size_through_frag(self):
+        c, fs = mkfs(split=4)
+        fs.mkdir("/sz")
+        for i in range(20):
+            fs.create(f"/sz/f{i}")
+        assert fs.frag_info("/sz")["bits"] >= 1
+        fs.write("/sz/f7", b"0123456789")
+        assert fs.stat("/sz/f7")["size"] == 10
+        fs.truncate("/sz/f7", 4)
+        assert fs.stat("/sz/f7")["size"] == 4
+        assert fs.read("/sz/f7") == b"0123"
+
+    def test_deep_split_then_ec_recovery_still_reads(self):
+        """Fragments are plain rados objects: shard loss + recovery
+        must leave a fragmented tree fully readable."""
+        c, fs = mkfs(split=4, n_osds=8)
+        fs.mkdir("/deep")
+        for i in range(25):
+            fs.create(f"/deep/g{i}", data=np.full(64, i, np.uint8)
+                      .tobytes())
+        victim = 0
+        c.kill_osd(victim)
+        # degraded reads first, then a real revive + recovery pass
+        assert sorted(fs.readdir("/deep")) == sorted(
+            f"g{i}" for i in range(25))
+        c.revive_osd(victim)
+        assert sorted(fs.readdir("/deep")) == sorted(
+            f"g{i}" for i in range(25))
+        for i in range(25):
+            assert fs.read(f"/deep/g{i}") == np.full(
+                64, i, np.uint8).tobytes()

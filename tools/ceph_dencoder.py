@@ -1,0 +1,199 @@
+"""ceph-dencoder — wire-format encode/decode/round-trip checker.
+
+Recreation of the reference's ceph-dencoder (ref: src/tools/
+ceph-dencoder/ — `ceph-dencoder type <T> ... encode decode dump_json`,
+used by qa to pin encoding compatibility): for each versioned wire
+type this framework defines, build a representative instance, run
+encode -> decode -> re-encode, demand byte equality (encode
+determinism — the property upstream pins with corpus archives), and
+dump a JSON view.
+
+  python tools/ceph_dencoder.py list
+  python tools/ceph_dencoder.py roundtrip OSDMap
+  python tools/ceph_dencoder.py roundtrip all
+  python tools/ceph_dencoder.py dump PGLog
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mk_crush():
+    from ceph_tpu.crush.map import build_hierarchy, ec_rule
+    m = build_hierarchy(12, osds_per_host=2, hosts_per_rack=3)
+    ec_rule(m, rule_id=1, choose_type=1)
+    return m
+
+
+def _mk_osdmap():
+    from ceph_tpu.osd.osdmap import OSDMap, PGPool
+    m = OSDMap(_mk_crush())
+    m.add_pool(PGPool(1, pg_num=8, size=3, min_size=2, crush_rule=1,
+                      is_erasure=True))
+    m.mark_down(3)
+    m.pg_temp[(1, 2)] = [4, 5, 6]
+    m.pg_upmap_items[(1, 1)] = [(0, 7)]
+    m.config_set("osd_max_backfills", "2")
+    m.pool_mksnap(1, "s1")
+    m.mon_join(3)
+    return m
+
+
+def _mk_pglog():
+    from ceph_tpu.osd.pglog import PGLog
+    log = PGLog(max_entries=4)
+    for i in range(6):          # overflow: exercises tail advance
+        log.append(f"obj{i}")
+    return log
+
+
+def _mk_hashinfo():
+    from ceph_tpu.osd.stripe import HashInfo
+    return HashInfo(3, 4096, [0x1234, 0x5678, 0x9ABC])
+
+
+def _mk_txn():
+    from ceph_tpu.osd.memstore import Transaction
+    return (Transaction()
+            .create_collection("1.2s0")
+            .write("1.2s0", "obj", 0, b"payload bytes")
+            .setattr("1.2s0", "obj", "hinfo_key", b"\x01\x02")
+            .omap_set("1.2s0", "obj", {b"k": b"v"})
+            .omap_rmkeys("1.2s0", "obj", [b"dead"])
+            .truncate("1.2s0", "obj", 8))
+
+
+def _mk_message():
+    from ceph_tpu.osd.standalone import MOSDOp
+    return MOSDOp(42, True, "write", b"pg-op payload")
+
+
+def _enc_message(o) -> bytes:
+    from ceph_tpu.utils.encoding import Encoder
+    e = Encoder()
+    o.encode_payload(e)
+    return o.type_id.to_bytes(2, "little") + e.bytes()
+
+
+def _dec_message(b: bytes):
+    from ceph_tpu.msgr.messenger import _MSG_TYPES
+    from ceph_tpu.utils.encoding import Decoder
+    tid = int.from_bytes(b[:2], "little")
+    return _MSG_TYPES[tid].decode_payload(Decoder(b[2:]))
+
+
+TYPES = {
+    "CrushMap": {
+        "make": _mk_crush,
+        "enc": lambda o: o.encode(),
+        "dec": lambda b: __import__(
+            "ceph_tpu.crush.map", fromlist=["CrushMap"]
+        ).CrushMap.decode(b),
+        "dump": lambda o: {"buckets": len(o.buckets),
+                           "rules": sorted(o.rules),
+                           "devices": o.n_devices},
+    },
+    "OSDMap": {
+        "make": _mk_osdmap,
+        "enc": lambda o: o.encode(),
+        "dec": lambda b: __import__(
+            "ceph_tpu.osd.osdmap", fromlist=["OSDMap"]
+        ).OSDMap.decode(b),
+        "dump": lambda o: {"epoch": o.epoch,
+                           "pools": sorted(o.pools),
+                           "mon_members": o.mon_members,
+                           "config_kv": o.config_kv,
+                           "pg_temp": {f"{k[0]}.{k[1]}": v
+                                       for k, v in o.pg_temp.items()},
+                           "snaps": o.pools[1].snaps},
+    },
+    "PGLog": {
+        "make": _mk_pglog,
+        "enc": lambda o: o.encode(),
+        "dec": lambda b: __import__(
+            "ceph_tpu.osd.pglog", fromlist=["PGLog"]
+        ).PGLog.decode(b),
+        "dump": lambda o: {"entries": len(o), "head": o.head,
+                           "tail": o.tail},
+    },
+    "HashInfo": {
+        "make": _mk_hashinfo,
+        "enc": lambda o: o.to_bytes(),
+        "dec": lambda b: __import__(
+            "ceph_tpu.osd.stripe", fromlist=["HashInfo"]
+        ).HashInfo.from_bytes(b),
+        "dump": lambda o: {"shards": o.n_shards,
+                           "hashes": o.cumulative_shard_hashes,
+                           "total_chunk_size": o.total_chunk_size},
+    },
+    "Transaction": {
+        "make": _mk_txn,
+        "enc": lambda o: __import__(
+            "ceph_tpu.osd.tinstore", fromlist=["_encode_txn"]
+        )._encode_txn(o),
+        "dec": lambda b: __import__(
+            "ceph_tpu.osd.tinstore", fromlist=["_decode_txn"]
+        )._decode_txn(b),
+        "dump": lambda o: {"ops": [op[0] for op in o.ops]},
+    },
+    "Message": {
+        # the typed-frame payload codec (transport framing adds
+        # crc/len/seq around this)
+        "make": _mk_message,
+        "enc": _enc_message,
+        "dec": _dec_message,
+        "dump": lambda o: {"type_id": o.type_id, "kind": o.kind,
+                           "req_id": o.req_id},
+    },
+}
+
+
+def roundtrip(name: str) -> bool:
+    t = TYPES[name]
+    obj = t["make"]()
+    b1 = t["enc"](obj)
+    obj2 = t["dec"](b1)
+    b2 = t["enc"](obj2)
+    ok = b1 == b2
+    digest = hashlib.sha256(b1).hexdigest()[:16]
+    status = "OK " if ok else "FAIL"
+    print(f"{status} {name}: {len(b1)} bytes, sha256 {digest}"
+          + ("" if ok else "  ** re-encode differs! **"))
+    return ok
+
+
+def main(argv=None) -> None:
+    args = sys.argv[1:] if argv is None else argv
+    if not args or args[0] == "list":
+        for name in TYPES:
+            print(name)
+        return
+    cmd, name = args[0], (args[1] if len(args) > 1 else "all")
+    if name != "all" and name not in TYPES:
+        raise SystemExit(f"dencoder: unknown type {name!r} "
+                         f"(have: {', '.join(TYPES)})")
+    if cmd == "roundtrip":
+        names = list(TYPES) if name == "all" else [name]
+        bad = [n for n in names if not roundtrip(n)]
+        if bad:
+            raise SystemExit(f"dencoder: round-trip failed: {bad}")
+        return
+    if cmd == "dump":
+        if name == "all":
+            raise SystemExit("dencoder: dump needs one type name")
+        t = TYPES[name]
+        obj = t["dec"](t["enc"](t["make"]()))
+        print(json.dumps(t["dump"](obj), indent=1, sort_keys=True,
+                         default=str))
+        return
+    raise SystemExit(f"dencoder: unknown command {cmd!r}")
+
+
+if __name__ == "__main__":
+    main()
