@@ -128,6 +128,22 @@ def _dir_lookup(h: ClsHandle, inp: bytes) -> bytes:
     return json.dumps(ent).encode()
 
 
+@register_cls("fs_dir", "route")
+def _dir_route(h: ClsHandle, inp: bytes) -> bytes:
+    """Combined bits+lookup on the BASE dirfrag: an unfragmented dir
+    (the common case) answers the dentry in ONE round-trip; a
+    fragmented one returns its bits so the client re-aims at the frag
+    — the MDS client piggybacks the fragtree on traversal the same
+    way instead of refetching it per hop."""
+    name = json.loads(inp)["name"]
+    bits = h.kv.get("frag_bits", 0)
+    if bits:
+        return json.dumps({"bits": bits}).encode()
+    ent = h.kv.get("dentries", {}).get(name)
+    return json.dumps({"bits": 0, "found": ent is not None,
+                       "ent": ent}).encode()
+
+
 @register_cls("fs_dir", "list")
 def _dir_list(h: ClsHandle, inp: bytes) -> bytes:
     return json.dumps(h.kv.get("dentries", {})).encode()
@@ -209,7 +225,10 @@ class FsClient:
 
     def _clock(self) -> float:
         import time
-        return getattr(self.io.rados.cluster, "now", 0.0) or time.time()
+        # virtual sim clock when present — 0.0 included (see the
+        # gateway's _clock: `or` would mix wall-clock into it)
+        now = getattr(self.io.rados.cluster, "now", None)
+        return time.time() if now is None else now
 
     def _alloc_ino(self) -> int:
         out = self.io.execute(_META_OBJ, "fs_meta", "alloc_ino")
@@ -363,14 +382,23 @@ class FsClient:
             if cur["type"] != "dir":
                 raise NotADir("/" + "/".join(parts[:i]))
             try:
+                r = json.loads(self.io.execute(
+                    self._dir_obj(cur["ino"]), "fs_dir", "route",
+                    json.dumps({"name": name}).encode()))
+                if r["bits"] == 0:
+                    if not r["found"]:
+                        raise ClsError("ENOENT")
+                    cur = r["ent"]
+                    continue
                 raw = self.io.execute(
-                    self._dentry_obj(cur["ino"], name),
+                    self._dentry_obj(cur["ino"], name,
+                                     bits=r["bits"]),
                     "fs_dir", "lookup",
                     json.dumps({"name": name}).encode())
+                cur = json.loads(raw)
             except (ClsError, KeyError):
                 raise FileNotFoundError(
                     "/" + "/".join(parts[:i + 1])) from None
-            cur = json.loads(raw)
         return cur
 
     def _parent_and_name(self, path: str) -> tuple[dict, str]:

@@ -121,6 +121,25 @@ def _idx_stat(h: ClsHandle, inp: bytes) -> bytes:
     return json.dumps(ent).encode()
 
 
+# -- lifecycle configuration (cls-held, ref: RGWLC + cls_rgw lc ops) --
+
+@register_cls("rgw_index", "set_lc")
+def _idx_set_lc(h: ClsHandle, inp: bytes) -> bytes:
+    h.kv["lifecycle"] = json.loads(inp)
+    return b"{}"
+
+
+@register_cls("rgw_index", "get_lc")
+def _idx_get_lc(h: ClsHandle, inp: bytes) -> bytes:
+    return json.dumps(h.kv.get("lifecycle", [])).encode()
+
+
+@register_cls("rgw_index", "del_lc")
+def _idx_del_lc(h: ClsHandle, inp: bytes) -> bytes:
+    h.kv.pop("lifecycle", None)
+    return b"{}"
+
+
 # -- versioning (cls_rgw bucket-index instance entries, ref:
 #    rgw_bucket_dir_entry instances + RGWRados::Bucket::UpdateIndex;
 #    S3 semantics: PUT appends a version, unversioned DELETE writes a
@@ -289,7 +308,11 @@ class Gateway:
         return base if part is None else f"{base}/part.{part:05d}"
 
     def _clock(self) -> float:
-        return getattr(self.io.rados.cluster, "now", 0.0) or time.time()
+        # the sim cluster's VIRTUAL clock when present — 0.0 included
+        # (an `or time.time()` here would silently mix wall-clock
+        # mtimes into virtual time and break age-based lifecycle)
+        now = getattr(self.io.rados.cluster, "now", None)
+        return time.time() if now is None else now
 
     def _etag(self, data: bytes) -> str:
         from ..osd.tinstore import _crc32c
@@ -591,7 +614,11 @@ class Gateway:
 
     def initiate_multipart(self, bucket: str, key: str) -> str:
         self._check_bucket(bucket)
-        upload_id = f"u{abs(hash((bucket, key, self._clock()))):016x}"
+        # random, not clock-derived: two initiates within one virtual
+        # clock tick must not collide (upstream upload ids are opaque
+        # unique strings too)
+        import os as _os
+        upload_id = f"u{_os.urandom(8).hex()}"
         self.io.write_full(self._upload_obj(bucket, key, upload_id),
                            json.dumps({"parts": {}}).encode())
         return upload_id
@@ -689,3 +716,129 @@ class Gateway:
             if pos >= end:
                 break
         return bytes(out)
+
+    # -- lifecycle (ref: src/rgw/rgw_lc.cc RGWLC::process; S3
+    #    Put/Get/DeleteBucketLifecycleConfiguration) -----------------------
+
+    _LC_DAY = 86400.0
+
+    def put_bucket_lifecycle(self, bucket: str,
+                             rules: list[dict]) -> None:
+        """Install lifecycle rules. Each rule: {id, prefix?, status
+        Enabled|Disabled, expiration_days? and/or noncurrent_days?}
+        — the S3 Expiration / NoncurrentVersionExpiration actions."""
+        self._check_bucket(bucket)
+        if not rules:
+            raise GatewayError("MalformedXML: empty rule list")
+        seen = set()
+        for r in rules:
+            rid = r.get("id")
+            if not rid or rid in seen:
+                raise GatewayError(
+                    f"InvalidArgument: missing/duplicate rule id {rid!r}")
+            seen.add(rid)
+            if r.get("status", "Enabled") not in ("Enabled", "Disabled"):
+                raise GatewayError(
+                    f"MalformedXML: bad status in rule {rid!r}")
+            days = r.get("expiration_days")
+            ncdays = r.get("noncurrent_days")
+            if days is None and ncdays is None:
+                raise GatewayError(
+                    f"InvalidRequest: rule {rid!r} has no action")
+            for v in (days, ncdays):
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool) or v < 1):
+                    raise GatewayError(
+                        f"InvalidArgument: days must be a positive "
+                        f"int in rule {rid!r}")
+        self.io.execute(self._index_obj(bucket), "rgw_index",
+                        "set_lc", json.dumps(rules).encode())
+
+    def get_bucket_lifecycle(self, bucket: str) -> list[dict]:
+        self._check_bucket(bucket)
+        return json.loads(self.io.execute(
+            self._index_obj(bucket), "rgw_index", "get_lc"))
+
+    def delete_bucket_lifecycle(self, bucket: str) -> None:
+        self._check_bucket(bucket)
+        self.io.execute(self._index_obj(bucket), "rgw_index", "del_lc")
+
+    def _list_all_entries(self, bucket: str, prefix: str) -> list[dict]:
+        out, marker = [], ""
+        while True:
+            page = self.list_objects(bucket, prefix=prefix,
+                                     marker=marker, limit=1000)
+            out.extend(page["entries"])
+            if not page.get("truncated"):
+                return out
+            marker = page["next_marker"]
+
+    def lc_process(self, bucket: str | None = None) -> dict:
+        """One lifecycle worker pass (upstream's RGWLC runs this on a
+        schedule; here the driver/test calls it — same model as scrub).
+        Applies Enabled rules against the gateway clock and returns
+        {bucket: {expired: [keys], noncurrent_expired: [(key, vid)],
+        markers_cleaned: [keys]}}."""
+        buckets = [bucket] if bucket is not None else self.list_buckets()
+        now = self._clock()
+        report: dict = {}
+        for b in buckets:
+            rules = [r for r in self.get_bucket_lifecycle(b)
+                     if r.get("status", "Enabled") == "Enabled"]
+            if not rules:
+                continue
+            rep = {"expired": [], "noncurrent_expired": [],
+                   "markers_cleaned": []}
+            versioned = self._versioning(b) != "Off"
+            for r in rules:
+                prefix = r.get("prefix", "")
+                days = r.get("expiration_days")
+                if days is not None:
+                    for ent in self._list_all_entries(b, prefix):
+                        if now - ent["mtime"] >= days * self._LC_DAY:
+                            # versioned: becomes a delete marker;
+                            # unversioned: gone for real (S3 semantics)
+                            self.delete_object(b, ent["key"])
+                            rep["expired"].append(ent["key"])
+                ncdays = r.get("noncurrent_days")
+                if ncdays is not None and versioned:
+                    vs = self.list_object_versions(b, prefix=prefix)
+                    # versions arrive per key newest-first: a version
+                    # became NONCURRENT when its successor was written,
+                    # so its retention clock starts at the PREVIOUS
+                    # (newer) entry's mtime — S3 guarantees
+                    # NoncurrentDays of retention from succession, not
+                    # from the version's own creation (ref: rgw_lc.cc
+                    # effective_mtime of the next entry)
+                    prev_by_key: dict[str, float] = {}
+                    for v in vs["versions"]:
+                        since = prev_by_key.get(v["key"])
+                        prev_by_key[v["key"]] = v["mtime"]
+                        if v.get("is_latest") or since is None:
+                            continue
+                        if now - since >= ncdays * self._LC_DAY:
+                            self.delete_object(b, v["key"],
+                                               version_id=v["vid"])
+                            rep["noncurrent_expired"].append(
+                                (v["key"], v["vid"]))
+                if days is not None and versioned:
+                    # expired-object-delete-marker cleanup, scoped to
+                    # THIS rule's prefix (the cleanup is part of the
+                    # Expiration action, not bucket-wide — ref: S3
+                    # ExpiredObjectDeleteMarker): a key whose only
+                    # remaining version is its latest delete marker
+                    # serves nothing
+                    by_key: dict[str, list] = {}
+                    for v in self.list_object_versions(
+                            b, prefix=prefix)["versions"]:
+                        by_key.setdefault(v["key"], []).append(v)
+                    for key, kvs in by_key.items():
+                        if len(kvs) == 1 \
+                                and kvs[0].get("delete_marker") \
+                                and kvs[0].get("is_latest"):
+                            self.delete_object(b, key,
+                                               version_id=kvs[0]["vid"])
+                            rep["markers_cleaned"].append(key)
+            if any(rep.values()):
+                report[b] = rep
+        return report

@@ -1,0 +1,196 @@
+"""RGW bucket lifecycle (refs: src/rgw/rgw_lc.cc RGWLC::process; S3
+Put/Get/DeleteBucketLifecycleConfiguration, Expiration /
+NoncurrentVersionExpiration / ExpiredObjectDeleteMarker)."""
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.osd.cluster import SimCluster
+from ceph_tpu.rgw import Gateway, GatewayError, NoSuchKey
+
+DAY = 86400.0
+
+
+def mk(**kw):
+    kw.setdefault("n_osds", 8)
+    kw.setdefault("pg_num", 4)
+    c = SimCluster(**kw)
+    return c, Gateway(Rados(c).open_ioctx())
+
+
+class TestLifecycleConfig:
+    def test_put_get_delete_roundtrip(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        rules = [{"id": "wipe-tmp", "prefix": "tmp/",
+                  "status": "Enabled", "expiration_days": 7}]
+        gw.put_bucket_lifecycle("b", rules)
+        assert gw.get_bucket_lifecycle("b") == rules
+        gw.delete_bucket_lifecycle("b")
+        assert gw.get_bucket_lifecycle("b") == []
+
+    def test_validation(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        with pytest.raises(GatewayError, match="empty"):
+            gw.put_bucket_lifecycle("b", [])
+        with pytest.raises(GatewayError, match="duplicate|missing"):
+            gw.put_bucket_lifecycle("b", [
+                {"id": "x", "expiration_days": 1},
+                {"id": "x", "expiration_days": 2}])
+        with pytest.raises(GatewayError, match="no action"):
+            gw.put_bucket_lifecycle("b", [{"id": "x"}])
+        with pytest.raises(GatewayError, match="positive"):
+            gw.put_bucket_lifecycle("b", [{"id": "x",
+                                           "expiration_days": 0}])
+        with pytest.raises(GatewayError, match="status"):
+            gw.put_bucket_lifecycle("b", [{"id": "x", "status": "On",
+                                           "expiration_days": 1}])
+
+
+class TestExpiration:
+    def test_prefix_scoped_expiration(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "tmp/a", b"old-a")
+        gw.put_object("b", "tmp/b", b"old-b")
+        gw.put_object("b", "keep/c", b"keeper")
+        gw.put_bucket_lifecycle("b", [
+            {"id": "tmp", "prefix": "tmp/", "status": "Enabled",
+             "expiration_days": 3}])
+        c.now += 2 * DAY
+        assert gw.lc_process() == {}          # not old enough yet
+        c.now += 2 * DAY                      # age 4d > 3d
+        rep = gw.lc_process()
+        assert sorted(rep["b"]["expired"]) == ["tmp/a", "tmp/b"]
+        with pytest.raises(NoSuchKey):
+            gw.get_object("b", "tmp/a")
+        assert gw.get_object("b", "keep/c") == b"keeper"
+        # payload really gone, not just unindexed
+        assert not [o for o in gw.io.list_objects()
+                    if "tmp/a" in o]
+
+    def test_disabled_rule_is_inert(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "x", b"data")
+        gw.put_bucket_lifecycle("b", [
+            {"id": "off", "status": "Disabled", "expiration_days": 1}])
+        c.now += 10 * DAY
+        assert gw.lc_process() == {}
+        assert gw.get_object("b", "x") == b"data"
+
+    def test_fresh_writes_reset_age(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "x", b"v1")
+        gw.put_bucket_lifecycle("b", [
+            {"id": "e", "status": "Enabled", "expiration_days": 5}])
+        c.now += 4 * DAY
+        gw.put_object("b", "x", b"v2")        # overwrite refreshes mtime
+        c.now += 3 * DAY                      # 7d since v1, 3d since v2
+        assert gw.lc_process() == {}
+        assert gw.get_object("b", "x") == b"v2"
+
+
+class TestVersionedLifecycle:
+    def test_expiration_writes_delete_marker(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "doc", b"v1")
+        gw.put_bucket_lifecycle("b", [
+            {"id": "e", "status": "Enabled", "expiration_days": 2}])
+        c.now += 3 * DAY
+        rep = gw.lc_process()
+        assert rep["b"]["expired"] == ["doc"]
+        with pytest.raises(NoSuchKey):
+            gw.get_object("b", "doc")         # current view: marker
+        vs = gw.list_object_versions("b")["versions"]
+        assert any(v["delete_marker"] for v in vs)
+        assert any(not v["delete_marker"] for v in vs)  # v1 retained
+
+    def test_noncurrent_expiration_permanent(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "doc", b"v1")
+        c.now += 1 * DAY
+        gw.put_object("b", "doc", b"v2")      # v1 becomes noncurrent
+        gw.put_bucket_lifecycle("b", [
+            {"id": "nc", "status": "Enabled", "noncurrent_days": 3}])
+        c.now += 4 * DAY                      # v1 noncurrent+old
+        rep = gw.lc_process()
+        assert [k for k, _ in rep["b"]["noncurrent_expired"]] == ["doc"]
+        vs = gw.list_object_versions("b")["versions"]
+        assert len(vs) == 1 and vs[0]["is_latest"]
+        assert gw.get_object("b", "doc") == b"v2"
+
+    def test_noncurrent_clock_starts_at_succession(self):
+        """S3 retains a noncurrent version NoncurrentDays AFTER it
+        became noncurrent — age from the successor's mtime, not the
+        version's own creation time."""
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "doc", b"v1")
+        c.now += 10 * DAY
+        gw.put_object("b", "doc", b"v2")      # v1 noncurrent NOW
+        gw.put_bucket_lifecycle("b", [
+            {"id": "nc", "status": "Enabled", "noncurrent_days": 5}])
+        assert gw.lc_process() == {}          # 0d noncurrent: retained
+        c.now += 4 * DAY
+        assert gw.lc_process() == {}          # 4d < 5d: retained
+        c.now += 2 * DAY                      # 6d noncurrent
+        rep = gw.lc_process()
+        assert [k for k, _ in rep["b"]["noncurrent_expired"]] == ["doc"]
+
+    def test_marker_cleanup_scoped_to_rule_prefix(self):
+        """ExpiredObjectDeleteMarker cleanup is part of the Expiration
+        action and honors its prefix — a lone marker OUTSIDE the
+        rule's prefix must be left alone."""
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "logs/x", b"data")
+        vid = [v["vid"] for v in
+               gw.list_object_versions("b")["versions"]][0]
+        gw.delete_object("b", "logs/x")               # marker
+        gw.delete_object("b", "logs/x", version_id=vid)  # lone marker
+        gw.put_bucket_lifecycle("b", [
+            {"id": "tmp-only", "prefix": "tmp/", "status": "Enabled",
+             "expiration_days": 1}])
+        c.now += 5 * DAY
+        rep = gw.lc_process()
+        assert "logs/x" not in rep.get("b", {}).get(
+            "markers_cleaned", [])
+        vs = gw.list_object_versions("b")["versions"]
+        assert len(vs) == 1 and vs[0]["delete_marker"]
+
+    def test_bool_days_rejected(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        with pytest.raises(GatewayError, match="positive"):
+            gw.put_bucket_lifecycle("b", [
+                {"id": "x", "expiration_days": True}])
+
+    def test_expired_delete_marker_cleanup(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "doc", b"v1")
+        gw.put_bucket_lifecycle("b", [
+            {"id": "all", "status": "Enabled", "expiration_days": 1,
+             "noncurrent_days": 1}])
+        c.now += 2 * DAY
+        rep1 = gw.lc_process()   # expire -> delete marker lands; v1's
+        #                          noncurrent retention clock STARTS now
+        assert rep1["b"]["expired"] == ["doc"]
+        assert rep1["b"]["noncurrent_expired"] == []   # 0d noncurrent
+        c.now += 2 * DAY
+        rep2 = gw.lc_process()   # v1 noncurrent 2d >= 1d: expired;
+        #                          lone marker cleaned in the same pass
+        assert [k for k, _ in rep2["b"]["noncurrent_expired"]] == ["doc"]
+        assert rep2["b"]["markers_cleaned"] == ["doc"]
+        assert gw.list_object_versions("b")["versions"] == []
+        assert gw.lc_process() == {}   # third pass: nothing left
