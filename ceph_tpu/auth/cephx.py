@@ -291,6 +291,7 @@ class ClientAuth:
 
     def __init__(self, auth: AuthService, entity: str, secret: bytes,
                  now_fn=_time.time):
+        import threading
         self.auth = auth
         self.entity = entity
         self.secret = secret
@@ -298,8 +299,18 @@ class ClientAuth:
         self.session_key: bytes | None = None
         self._auth_ticket: dict | None = None
         self._svc: dict[str, dict] = {}   # service -> {key, expires, ticket}
+        # one ClientAuth is shared by a daemon's dispatch threads AND
+        # its background ticket prewarm: ticket state must refresh
+        # atomically, and an authorizer must verify the daemon's reply
+        # against the key that BUILT it, not whatever key a concurrent
+        # refresh installed meanwhile (see authorizer_with_key)
+        self._lock = threading.RLock()
 
     def login(self) -> None:
+        with self._lock:
+            self._login_locked()
+
+    def _login_locked(self) -> None:
         # one retry when the challenge went missing between hello and
         # authenticate (the answering monitor died in between, or an
         # overloaded auth service evicted it) — a fresh hello gets a
@@ -320,8 +331,12 @@ class ClientAuth:
         self._auth_ticket = got["ticket"]
 
     def fetch_tickets(self, services: list[str]) -> None:
+        with self._lock:
+            self._fetch_tickets_locked(services)
+
+    def _fetch_tickets_locked(self, services: list[str]) -> None:
         if self.session_key is None:
-            self.login()
+            self._login_locked()
         for attempt in range(2):
             nonce = os.urandom(16)
             try:
@@ -336,7 +351,7 @@ class ClientAuth:
                 # path; a genuine refusal stays terminal
                 if attempt == 0 and ("expired" in str(e)
                                      or "rotated out" in str(e)):
-                    self.login()
+                    self._login_locked()
                     continue
                 raise
         for svc, entry in got.items():
@@ -348,27 +363,49 @@ class ClientAuth:
 
     def authorizer_for(self, service: str,
                        server_challenge: str | None = None) -> dict:
-        """(ticket, nonce, mac) to present to a daemon; refreshes the
-        service ticket when missing or expired. When the daemon has
-        issued a server challenge (NeedChallenge), it is bound into
-        the MAC — the anti-replay round."""
-        ent = self._svc.get(service)
-        if ent is None or self.now() > ent["expires"] - 1.0:
+        return self.authorizer_with_key(service, server_challenge)[0]
+
+    def authorizer_with_key(self, service: str,
+                            server_challenge: str | None = None
+                            ) -> tuple[dict, bytes]:
+        """((ticket, nonce, mac), session_key) to present to a daemon;
+        refreshes the service ticket when missing or expired. The key
+        is returned ALONGSIDE so the caller can verify the daemon's
+        mutual-auth reply against the key that built this authorizer
+        even if a concurrent refresh swaps the cached ticket. A server
+        challenge (NeedChallenge) is bound into the MAC — the
+        anti-replay round."""
+        for _ in range(2):
+            with self._lock:
+                ent = self._svc.get(service)
+                if ent is not None \
+                        and self.now() <= ent["expires"] - 1.0:
+                    # fast path: cached valid ticket, zero I/O under
+                    # the lock — concurrent callers for other
+                    # services never wait behind a monitor hunt
+                    nonce = os.urandom(16)
+                    az = {"ticket": ent["ticket"], "nonce": _b(nonce),
+                          "mac": _b(_hmac(ent["key"], nonce,
+                                          _ub(server_challenge or "")))}
+                    if server_challenge is not None:
+                        az["server_challenge"] = server_challenge
+                    return az, ent["key"]
+            # slow path OUTSIDE the fast-path lock window: the fetch
+            # takes the lock itself around state updates; two racing
+            # refreshes are idempotent
             self.fetch_tickets([service])
-            ent = self._svc[service]
-        nonce = os.urandom(16)
-        az = {"ticket": ent["ticket"], "nonce": _b(nonce),
-              "mac": _b(_hmac(ent["key"], nonce,
-                              _ub(server_challenge or "")))}
-        if server_challenge is not None:
-            az["server_challenge"] = server_challenge
-        return az
+        raise AuthError(f"could not obtain a {service!r} ticket")
 
     def verify_reply(self, service: str, authorizer: dict,
-                     reply_mac: bytes) -> bool:
+                     reply_mac: bytes,
+                     key: bytes | None = None) -> bool:
         """Mutual auth: did the daemon prove it unsealed our ticket
-        (i.e. holds the rotating secret)?"""
-        key = self._svc[service]["key"]
+        (i.e. holds the rotating secret)? Pass the key returned by
+        authorizer_with_key when other threads may refresh tickets
+        concurrently."""
+        if key is None:
+            with self._lock:
+                key = self._svc[service]["key"]
         want = _hmac(key, _ub(authorizer["nonce"]), b"server")
         return hmac.compare_digest(want, reply_mac)
 

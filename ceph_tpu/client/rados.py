@@ -40,6 +40,16 @@ class Rados:
         return self.cluster.health()
 
 
+def sim_clock(ioctx: "IoCtx") -> float:
+    """The sim cluster's VIRTUAL clock when present — 0.0 included
+    (an `or time.time()` would silently mix wall-clock into virtual
+    time and break age math); wall time only without a sim cluster.
+    Shared by every service layer (RGW mtimes, FS mtimes)."""
+    import time
+    now = getattr(ioctx.rados.cluster, "now", None)
+    return time.time() if now is None else now
+
+
 class IoCtx:
     """Per-pool I/O context (IoCtxImpl)."""
 

@@ -224,11 +224,8 @@ class FsClient:
         return f".fs.caps.{ino}"
 
     def _clock(self) -> float:
-        import time
-        # virtual sim clock when present — 0.0 included (see the
-        # gateway's _clock: `or` would mix wall-clock into it)
-        now = getattr(self.io.rados.cluster, "now", None)
-        return time.time() if now is None else now
+        from ..client.rados import sim_clock
+        return sim_clock(self.io)
 
     def _alloc_ino(self) -> int:
         out = self.io.execute(_META_OBJ, "fs_meta", "alloc_ino")

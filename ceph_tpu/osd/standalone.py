@@ -673,6 +673,23 @@ class OSDDaemon:
             self._cauth = ClientAuth(
                 _WireAuth(self.c, self.auth_rpc), self.name,
                 self.c.osd_secrets[self.osd_id])
+            # pre-warm tickets OFF the dispatch path: peer store reads
+            # happen inside map/op dispatch, and a monitor hunt there
+            # (seconds, worse across a partition) stalls the dispatch
+            # thread — pings queue up behind it and peers mark this
+            # daemon down, cascading into fake failures. The reference
+            # likewise fetches rotating secrets/tickets on its own
+            # monc thread, not in fast dispatch.
+            def _prewarm():
+                for _ in range(10):
+                    if self._stop.is_set():
+                        return
+                    try:
+                        self._cauth.fetch_tickets(["osd"])
+                        return
+                    except Exception:   # noqa: BLE001 — mons booting
+                        self._stop.wait(0.5)
+            threading.Thread(target=_prewarm, daemon=True).start()
         self._hb = threading.Thread(target=self._heartbeat_loop,
                                     daemon=True)
         self._hb.start()
@@ -2010,11 +2027,14 @@ class _WireAuth:
             mons.insert(0, self._sticky)
         for mon in mons:
             try:
+                # short per-monitor timeout: a dead/partitioned
+                # monitor must cost the hunt ~2s, not stall a caller
+                # (possibly a daemon dispatch thread) for 5+
                 rep = self.rpc.call(
                     mon, lambda rid: MAuthOp(
                         rid, True, method,
                         _json.dumps(payload).encode()),
-                    timeout=5.0)
+                    timeout=2.0)
             except (ConnectionError, KeyError, OSError) as e:
                 last = str(e)
                 if self._sticky == mon:
@@ -2053,19 +2073,26 @@ def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
     server_challenge = None
     refreshed = False
     for _ in range(4):
-        az = cauth.authorizer_for(service,
-                                  server_challenge=server_challenge)
+        # key snapshot: the reply must verify against the key that
+        # built THIS authorizer — a concurrent ticket refresh (the
+        # daemon prewarm thread, another dispatch thread) must not
+        # turn a correct daemon reply into a fake mutual-auth failure
+        az, key = cauth.authorizer_with_key(
+            service, server_challenge=server_challenge)
         try:
+            # short timeout: this can run from a daemon's dispatch
+            # thread (peer store reads); a dead peer must not stall it
             rep = rpc.call(
                 peer, lambda rid: MAuthOp(rid, True, "authorize",
                                           _json.dumps(az).encode()),
-                timeout=5.0)
+                timeout=2.0)
         except (ConnectionError, KeyError, OSError):
             return   # peer unreachable; the caller's op loop retargets
         if rep.ok:
             got = _json.loads(rep.blob.decode())
             if not cauth.verify_reply(
-                    service, az, bytes.fromhex(got["reply_mac"])):
+                    service, az, bytes.fromhex(got["reply_mac"]),
+                    key=key):
                 raise AuthError(
                     f"{peer} failed mutual auth (does not hold the "
                     "rotating secret)")

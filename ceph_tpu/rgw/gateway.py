@@ -308,11 +308,8 @@ class Gateway:
         return base if part is None else f"{base}/part.{part:05d}"
 
     def _clock(self) -> float:
-        # the sim cluster's VIRTUAL clock when present — 0.0 included
-        # (an `or time.time()` here would silently mix wall-clock
-        # mtimes into virtual time and break age-based lifecycle)
-        now = getattr(self.io.rados.cluster, "now", None)
-        return time.time() if now is None else now
+        from ..client.rados import sim_clock
+        return sim_clock(self.io)
 
     def _etag(self, data: bytes) -> str:
         from ..osd.tinstore import _crc32c

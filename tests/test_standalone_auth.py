@@ -137,6 +137,64 @@ class TestCephxWire:
                            match=re.escape("EPERM:unauthenticated")):
             rs.list_objects("meta")
 
+    def test_auth_survives_thrash_rotation_and_partition(self):
+        """cephx under chaos: OSD kill/revive, repeated secret
+        rotation, and a monitor partition — client I/O keeps flowing
+        through transparent re-auth, and every byte survives."""
+        import numpy as np
+        c = StandaloneCluster(n_osds=4, pg_num=2, op_timeout=3.0,
+                              cephx=True)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            rng = np.random.default_rng(42)
+            data: dict[str, bytes] = {}
+            for rnd in range(3):
+                objs = {f"chaos-{rnd}-{i}":
+                        rng.integers(0, 256, 256, np.uint8).tobytes()
+                        for i in range(4)}
+                cl.write(objs)
+                data.update(objs)
+                victim = rnd % 4
+                c.kill_osd(victim)           # sessions at victim die
+                c.rotate_service_secrets("osd")
+                if rnd == 1:
+                    # a partitioned minority monitor must not break
+                    # the auth plane (clients hunt the majority side)
+                    c.partition({"mon.2"}, {"mon.0", "mon.1"})
+                # write once the quorum has marked the death (the
+                # established tier pattern: availability DURING
+                # detection is its own suite; this test is about auth
+                # riding failure + rotation + partition)
+                c._wait(lambda: any(
+                    not m._stop.is_set() and m.osdmap is not None
+                    and not m.osdmap.osd_up[victim]
+                    for m in c.mons), 25, f"osd.{victim} marked down")
+                more = {f"chaos-{rnd}-deg-{i}":
+                        rng.integers(0, 256, 256, np.uint8).tobytes()
+                        for i in range(2)}
+                cl.write(more)               # degraded + rotated
+                data.update(more)
+                if rnd == 1:
+                    c.heal_partition()
+                c.revive_osd(victim)         # fresh verifier, no
+                #                              sessions: forces re-auth
+                # recover before the next injection (the qa thrasher's
+                # wait_for_clean between disruptions): with k=2 m=1 a
+                # second loss during recovery would legitimately drop
+                # below min_size — that's durability math, not auth
+                c.wait_for_clean(timeout=40)
+            for k, want in data.items():
+                assert cl.read(k) == want
+            # a brand-new client after 3 rotations: the boot-era
+            # tickets are long rotated out; the full login + fetch
+            # chain must still converge
+            cl2 = c.client()
+            probe = next(iter(data))
+            assert cl2.read(probe) == data[probe]
+        finally:
+            c.shutdown()
+
     def test_rotation_keep_window_then_refresh(self, cluster):
         cl = cluster.client()
         objs = corpus(7)
