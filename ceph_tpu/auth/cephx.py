@@ -300,17 +300,23 @@ class ClientAuth:
         self._auth_ticket: dict | None = None
         self._svc: dict[str, dict] = {}   # service -> {key, expires, ticket}
         # one ClientAuth is shared by a daemon's dispatch threads AND
-        # its background ticket prewarm: ticket state must refresh
-        # atomically, and an authorizer must verify the daemon's reply
-        # against the key that BUILT it, not whatever key a concurrent
-        # refresh installed meanwhile (see authorizer_with_key)
+        # its background ticket prewarm. Two locks, two jobs:
+        # _lock guards STATE only (never held across network I/O), so
+        # authorizer_with_key's cached fast path can't stall behind a
+        # monitor hunt; _io_lock serializes the refresh I/O itself
+        # (login + ticket fetch) so concurrent refreshers don't
+        # stampede the monitors. Ordering: _io_lock may take _lock,
+        # never the reverse.
         self._lock = threading.RLock()
+        self._io_lock = threading.Lock()
 
     def login(self) -> None:
-        with self._lock:
-            self._login_locked()
+        with self._io_lock:
+            self._login_io()
 
-    def _login_locked(self) -> None:
+    def _login_io(self) -> None:
+        """Caller holds _io_lock. Network rounds WITHOUT _lock; the
+        session state installs atomically at the end."""
         # one retry when the challenge went missing between hello and
         # authenticate (the answering monitor died in between, or an
         # overloaded auth service evicted it) — a fresh hello gets a
@@ -327,39 +333,44 @@ class ClientAuth:
                 raise
             break
         sk = _unseal(self.secret, _ub(got["enc_session_key"]))
-        self.session_key = _ub(sk["session_key"])
-        self._auth_ticket = got["ticket"]
+        with self._lock:
+            self.session_key = _ub(sk["session_key"])
+            self._auth_ticket = got["ticket"]
 
     def fetch_tickets(self, services: list[str]) -> None:
-        with self._lock:
-            self._fetch_tickets_locked(services)
-
-    def _fetch_tickets_locked(self, services: list[str]) -> None:
-        if self.session_key is None:
-            self._login_locked()
-        for attempt in range(2):
-            nonce = os.urandom(16)
-            try:
-                got = self.auth.get_service_tickets(
-                    self._auth_ticket, nonce,
-                    _hmac(self.session_key, nonce), services)
-                break
-            except AuthError as e:
-                # the AUTH ticket itself aged out (expired, or its
-                # sealing secret rotated out): re-login under the
-                # entity secret and retry — the long-lived-client
-                # path; a genuine refusal stays terminal
-                if attempt == 0 and ("expired" in str(e)
-                                     or "rotated out" in str(e)):
-                    self._login_locked()
-                    continue
-                raise
-        for svc, entry in got.items():
-            sk = _unseal(self.session_key,
-                         _ub(entry["enc_session_key"]))
-            self._svc[svc] = {"key": _ub(sk["session_key"]),
+        with self._io_lock:
+            with self._lock:
+                need_login = self.session_key is None
+            if need_login:
+                self._login_io()
+            for attempt in range(2):
+                with self._lock:
+                    ticket = self._auth_ticket
+                    skey = self.session_key
+                nonce = os.urandom(16)
+                try:
+                    got = self.auth.get_service_tickets(
+                        ticket, nonce, _hmac(skey, nonce), services)
+                    break
+                except AuthError as e:
+                    # the AUTH ticket itself aged out (expired, or its
+                    # sealing secret rotated out): re-login under the
+                    # entity secret and retry — the long-lived-client
+                    # path; a genuine refusal stays terminal
+                    if attempt == 0 and ("expired" in str(e)
+                                         or "rotated out" in str(e)):
+                        self._login_io()
+                        continue
+                    raise
+            # unseal with the session key that REQUESTED the tickets
+            fresh = {}
+            for svc, entry in got.items():
+                sk = _unseal(skey, _ub(entry["enc_session_key"]))
+                fresh[svc] = {"key": _ub(sk["session_key"]),
                               "expires": sk["expires"],
                               "ticket": entry["ticket"]}
+            with self._lock:
+                self._svc.update(fresh)
 
     def authorizer_for(self, service: str,
                        server_challenge: str | None = None) -> dict:

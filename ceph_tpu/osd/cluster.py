@@ -1258,6 +1258,38 @@ class SimCluster:
             "pg_states": states,
         }
 
+    def df(self) -> dict:
+        """`ceph df` (ref: src/mon/PGMap.cc dump_cluster_stats +
+        dump_pool_stats_full): logical bytes, raw bytes after EC/
+        replication amplification, object + snapshot-clone counts."""
+        objects = clones = 0
+        logical = 0
+        for ps in range(self.pg_num):
+            be = self.pgs[ps]
+            for name in be.list_pg_objects():
+                sz = be.stat_object(name)
+                if self._SNAP_SEP in name:
+                    clones += 1
+                else:
+                    objects += 1
+                logical += sz
+        k = self.pool_size - self.m
+        raw = logical * self.pool_size // max(1, k) if self.is_erasure \
+            else logical * self.pool_size
+        return {
+            "pools": {"default": {
+                "id": 1, "objects": objects, "snap_clones": clones,
+                "bytes_used": logical, "bytes_raw": raw,
+                "amplification": round(raw / logical, 2) if logical
+                else (self.pool_size / k if self.is_erasure
+                      else float(self.pool_size)),
+            }},
+            "cluster": {"osds": len(self.alive),
+                        "osds_in": int((self.osdmap.osd_weight > 0)
+                                       .sum()),
+                        "bytes_used_raw": raw},
+        }
+
     def verify_all(self, expected: dict[str, np.ndarray]) -> int:
         """Read every object back and byte-compare; returns count."""
         ok = 0

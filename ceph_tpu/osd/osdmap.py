@@ -141,6 +141,11 @@ class OSDMap:
         # commits, so quorum math moves atomically with the commit
         # that changes it.
         self.mon_members: list[int] = [0, 1, 2]
+        # OSDs an ADMINISTRATOR marked out (`ceph osd out`): sticky
+        # across daemon restarts, unlike the failure path's auto-out
+        # which a boot reverses (ref: osd_state AUTOOUT vs admin
+        # weight changes in OSDMonitor)
+        self.osd_admin_out: set[int] = set()
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
@@ -150,10 +155,10 @@ class OSDMap:
         """Versioned wire form: epoch, crush map, per-OSD runtime state,
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
-        # v2 appends pg_upmap_items, v3 config_kv, v4 mon_members;
-        # compat stays 1 (an old reader skips the tail via the section
-        # length — the ENCODE_START contract)
-        e = Encoder().start(4, 1)
+        # v2 appends pg_upmap_items, v3 config_kv, v4 mon_members,
+        # v5 osd_admin_out; compat stays 1 (an old reader skips the
+        # tail via the section length — the ENCODE_START contract)
+        e = Encoder().start(5, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -186,13 +191,14 @@ class OSDMap:
         e.mapping(self.config_kv, lambda en, k: en.string(k),
                   lambda en, v: en.string(v))
         e.list(self.mon_members, lambda e2, r: e2.i32(r))
+        e.list(sorted(self.osd_admin_out), lambda e2, o: e2.i32(o))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        v = d.start(4)
+        v = d.start(5)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -227,6 +233,8 @@ class OSDMap:
                                     lambda dd: dd.string())
         if v >= 4:
             m.mon_members = d.list(lambda dd: dd.i32())
+        if v >= 5:
+            m.osd_admin_out = set(d.list(lambda dd: dd.i32()))
         d.finish()
         return m
 

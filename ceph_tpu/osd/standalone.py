@@ -137,18 +137,25 @@ class MOSDPingReply(MOSDPing):
 
 @register_message
 class MOSDFailure(Message):
+    """A failure report — or its CANCELLATION when `alive` (ref:
+    MOSDFailure FLAG_ALIVE: the reporter heard the peer again and
+    retracts; without retraction a transient stall's stale report
+    could later combine with one more false report into a spurious
+    down-mark)."""
+
     type_id = 0x36
 
-    def __init__(self, failed: int):
+    def __init__(self, failed: int, alive: bool = False):
         self.failed = failed
+        self.alive = alive
 
     def encode_payload(self, e: Encoder) -> None:
-        e.start(1, 1).i32(self.failed).finish()
+        e.start(2, 1).i32(self.failed).boolean(self.alive).finish()
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "MOSDFailure":
-        d.start(1)
-        m = cls(d.i32())
+        v = d.start(2)
+        m = cls(d.i32(), d.boolean() if v >= 2 else False)
         d.finish()
         return m
 
@@ -434,6 +441,34 @@ class MMonJoin(Message):
     def decode_payload(cls, d: Decoder) -> "MMonJoin":
         d.start(1)
         m = cls(d.i32(), d.boolean())
+        d.finish()
+        return m
+
+
+@register_message
+class MOsdAdmin(Message):
+    """`ceph osd out/in/reweight` over the wire (ref: OSDMonitor
+    prepare_command OSD_OUT/OSD_IN/OSD_REWEIGHT): admin-plane
+    broadcast, quorum-committed like pool/config ops. weight is
+    16.16 fixed-point over 0x10000 (the reference's convention)."""
+
+    type_id = 0x47
+
+    def __init__(self, kind: str, osd: int, weight: float = 1.0):
+        if not 0.0 <= weight <= 1.0:
+            # the reference clamps reweight to [0,1]; refusing at
+            # construction beats a struct.error deep in the codec
+            raise ValueError(f"osd weight {weight} outside [0, 1]")
+        self.kind, self.osd, self.weight = kind, osd, weight
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).string(self.kind).i32(self.osd)
+         .u32(int(self.weight * 0x10000)).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOsdAdmin":
+        d.start(1)
+        m = cls(d.string(), d.i32(), d.u32() / 0x10000)
         d.finish()
         return m
 
@@ -1300,9 +1335,9 @@ class OSDDaemon:
                     self.msgr.send(f"osd.{osd}", MOSDPing(now))
                 except (KeyError, OSError, ConnectionError):
                     pass
-                if now - self._last_pong[osd] \
-                        > self.config["osd_heartbeat_grace"] \
-                        and osd not in self._reported:
+                stale = now - self._last_pong[osd] \
+                    > self.config["osd_heartbeat_grace"]
+                if stale and osd not in self._reported:
                     self._reported.add(osd)
                     self.suspect.add(osd)
                     # broadcast to EVERY monitor: whoever currently
@@ -1312,6 +1347,25 @@ class OSDDaemon:
                     for mon_name in self.c.mon_names():
                         try:
                             self.msgr.send(mon_name, MOSDFailure(osd))
+                        except (KeyError, OSError, ConnectionError):
+                            pass
+                elif not stale and osd in self._reported:
+                    # the peer answered our PINGS again before any
+                    # down-mark committed: clear the heartbeat
+                    # suspicion and retract OUR report at the
+                    # monitors — a transient stall (scheduler hiccup,
+                    # load) must not degrade the peer forever. Gated
+                    # on _reported, not suspect: store-RPC-failure
+                    # suspicion (_mark_suspects) is different
+                    # evidence that ping liveness does not refute.
+                    self.suspect.discard(osd)
+                    self._reported.discard(osd)
+                    self.c.log(f"{self.name}: osd.{osd} answered "
+                               "again; retracting failure report")
+                    for mon_name in self.c.mon_names():
+                        try:
+                            self.msgr.send(mon_name,
+                                           MOSDFailure(osd, alive=True))
                         except (KeyError, OSError, ConnectionError):
                             pass
 
@@ -1415,6 +1469,7 @@ class MonDaemon:
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
         m.register_handler(MMonJoin.type_id, self._on_mon_join)
+        m.register_handler(MOsdAdmin.type_id, self._on_osd_admin)
         # cephx service (ref: AuthMonitor + CephxServiceHandler).
         # Every monitor serves auth against the shared KeyServer (its
         # state is cluster bootstrap config here; KeyServer paxos
@@ -1661,6 +1716,57 @@ class MonDaemon:
             # until the next commit (subscribers dedup by epoch)
             self._broadcast(msg.epoch)
 
+    def _on_osd_admin(self, peer: str, msg: MOsdAdmin) -> None:
+        """`ceph osd out/in/reweight` (ref: OSDMonitor::
+        prepare_command): idempotent weight mutations through the
+        same Paxos pipe as everything else; cephx-gated like every
+        admin broadcast."""
+        if self.osdmap is None:
+            return
+        if self._mon_admin_denied(peer, f"osd {msg.kind} {msg.osd}"):
+            return
+        kind, osd, weight = msg.kind, msg.osd, msg.weight
+        if not 0 <= osd < len(self.osdmap.osd_weight):
+            # bounds-check BEFORE queueing: an IndexError inside the
+            # proposal pipe would drop co-queued mutations, and a
+            # negative id would numpy-wrap onto the wrong OSD
+            self.c.log(f"{self.name}: REJECT osd admin {kind} "
+                       f"osd.{osd} (no such osd)")
+            return
+        self.c.log(f"{self.name}: osd admin {kind} osd.{osd}")
+
+        def mutate(m: OSDMap) -> None:
+            w = int(weight * 0x10000)
+            if kind == "out":
+                # ADMIN out is sticky: a later boot must not reverse
+                # it the way it reverses the failure path's auto-out
+                if m.osd_weight[osd] != 0:
+                    m.mark_out(osd)
+                    m.osd_admin_out.add(osd)
+                elif osd not in m.osd_admin_out:
+                    m.osd_admin_out.add(osd)
+                    m._bump()
+            elif kind == "in" and (m.osd_weight[osd] == 0
+                                   or osd in m.osd_admin_out):
+                m.osd_admin_out.discard(osd)
+                if m.osd_weight[osd] == 0:
+                    m.mark_in(osd, weight)
+                else:
+                    m._bump()
+            elif kind == "reweight" and m.osd_weight[osd] != w:
+                if w == 0:
+                    # weight-to-zero must behave like `osd out`:
+                    # mark_out also clears pg_upmap entries that
+                    # would keep pinning slots to the drained OSD
+                    # (upmap redirection bypasses CRUSH's zero-weight
+                    # rejection), and it's sticky like out
+                    m.mark_out(osd)
+                    m.osd_admin_out.add(osd)
+                else:
+                    m.osd_weight[osd] = w
+                    m._bump()
+        self._commit(mutate)
+
     def _on_mon_join(self, peer: str, msg: MMonJoin) -> None:
         """Membership change (ref: MonmapMonitor::prepare_join): queue
         the idempotent mutation; whoever leads commits it. Quorum math
@@ -1880,8 +1986,22 @@ class MonDaemon:
             candidate = OSDMap.decode(self.osdmap.encode())
             batch = self._mutations
             self._mutations = []
+            kept = []
             for mutate in batch:
-                mutate(candidate)
+                try:
+                    mutate(candidate)
+                    kept.append(mutate)
+                except Exception as e:   # noqa: BLE001 — one poison
+                    # mutation must not destroy its co-queued batch
+                    # (nor the proposal pipe): drop it and rebuild
+                    # the candidate (it may be HALF-mutated), then
+                    # replay the survivors
+                    self.c.log(f"{self.name}: DROP mutation "
+                               f"({type(e).__name__}: {e})")
+                    candidate = OSDMap.decode(self.osdmap.encode())
+                    for ok_mut in kept:
+                        ok_mut(candidate)
+            batch = kept
             if candidate.epoch == self.osdmap.epoch:
                 return
             epoch, blob = candidate.epoch, candidate.encode()
@@ -1912,6 +2032,10 @@ class MonDaemon:
             return
         with self._lock:
             osd = msg.failed
+            if msg.alive:
+                # retraction: the reporter heard the peer again
+                self._reporters.get(osd, set()).discard(peer)
+                return
             if not self.osdmap.osd_up[osd]:
                 return
             rep = self._reporters.setdefault(osd, set())
@@ -1939,7 +2063,9 @@ class MonDaemon:
         def mutate(m: OSDMap) -> None:
             if not m.osd_up[osd]:
                 m.mark_up(osd)
-            if m.osd_weight[osd] == 0:
+            # boot reverses the failure path's auto-out, NEVER an
+            # administrator's sticky `osd out` (ref: AUTOOUT flag)
+            if m.osd_weight[osd] == 0 and osd not in m.osd_admin_out:
                 m.mark_in(osd)
         self._commit(mutate)
 
@@ -2262,6 +2388,37 @@ class Client:
         self._op("rollback", ps,
                  lambda e: e.u64(self._snapc()).string(name).u64(sid),
                  retries=6)
+
+    # -- osd administration over the wire ------------------------------------
+
+    def osd_out(self, osd: int, timeout: float = 15.0) -> None:
+        """`ceph osd out N`: weight to 0, committed through quorum;
+        CRUSH steers the OSD's slots elsewhere and backfill follows."""
+        self._ensure_mon_sessions()
+        self._mon_cast(MOsdAdmin("out", osd))
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and self.osdmap.osd_weight[osd] == 0,
+            timeout, f"osd.{osd} marked out")
+
+    def osd_in(self, osd: int, weight: float = 1.0,
+               timeout: float = 15.0) -> None:
+        self._ensure_mon_sessions()
+        self._mon_cast(MOsdAdmin("in", osd, weight))
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and self.osdmap.osd_weight[osd] > 0,
+            timeout, f"osd.{osd} marked in")
+
+    def osd_reweight(self, osd: int, weight: float,
+                     timeout: float = 15.0) -> None:
+        self._ensure_mon_sessions()
+        self._mon_cast(MOsdAdmin("reweight", osd, weight))
+        want = int(weight * 0x10000)
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and self.osdmap.osd_weight[osd] == want,
+            timeout, f"osd.{osd} reweighted")
 
     # -- centralized config over the wire ------------------------------------
 

@@ -281,6 +281,65 @@ class TestStandaloneObjectOps:
                     json.dumps({"owner": "holder"}).encode())
 
 
+class TestOsdAdmin:
+    def test_out_moves_data_in_brings_it_back(self, cluster):
+        """`ceph osd out` steers the OSD's slots to other OSDs
+        (weight 0 in the committed map, backfill follows); `osd in`
+        restores it. All data bytes-exact throughout."""
+        cl = cluster.client()
+        objs = corpus(40)
+        cl.write(objs)
+        victim = cluster.osd_ids()[0]
+        cl.osd_out(victim)
+        live_map = next(m.osdmap for m in cluster.mons
+                        if m.osdmap is not None)
+        assert live_map.osd_weight[victim] == 0
+        # the OSD is OUT but alive: reads must stay exact while CRUSH
+        # steers around it
+        for name, want in objs.items():
+            assert cl.read(name) == want
+        cl.osd_in(victim)
+        assert next(m.osdmap for m in cluster.mons
+                    if m.osdmap is not None).osd_weight[victim] > 0
+        for name, want in objs.items():
+            assert cl.read(name) == want
+
+    def test_reweight_commits(self, cluster):
+        cl = cluster.client()
+        victim = cluster.osd_ids()[1]
+        cl.osd_reweight(victim, 0.5)
+        live_map = next(m.osdmap for m in cluster.mons
+                        if m.osdmap is not None)
+        assert live_map.osd_weight[victim] == 0x8000
+        with pytest.raises(ValueError, match="outside"):
+            cl.osd_reweight(victim, 1.5)
+
+    def test_admin_out_sticky_across_restart(self, cluster):
+        """`ceph osd out` must survive the OSD's own restart: boot
+        reverses only the failure path's auto-out, never an admin
+        drain (ref: AUTOOUT flag vs admin weight)."""
+        cl = cluster.client()
+        victim = cluster.osd_ids()[2]
+        cl.osd_out(victim)
+        cluster.kill_osd(victim)
+        cluster.revive_osd(victim)
+        # the revived daemon is UP again, but must stay OUT
+        cluster._wait(
+            lambda: any(not m._stop.is_set() and m.osdmap is not None
+                        and m.osdmap.osd_up[victim]
+                        for m in cluster.mons), 20,
+            f"osd.{victim} back up")
+        live_map = next(m.osdmap for m in cluster.mons
+                        if m.osdmap is not None and
+                        m.osdmap.osd_up[victim])
+        assert live_map.osd_weight[victim] == 0, \
+            "boot reversed an admin out"
+        # explicit `osd in` lifts the drain
+        cl.osd_in(victim)
+        assert next(m.osdmap for m in cluster.mons
+                    if m.osdmap is not None).osd_weight[victim] > 0
+
+
 class TestCentralConfig:
     """Centralized config over the wire (the ConfigMonitor role, ref:
     src/mon/ConfigMonitor.cc): `config set` is quorum-committed (the

@@ -114,6 +114,23 @@ def cmd_pg_stat(c, args) -> None:
               f"objects {len(be.object_sizes)}")
 
 
+def cmd_df(c, args) -> None:
+    """`ceph df` — logical vs raw usage with EC/replication
+    amplification (ref: src/mon/PGMap.cc dump_pool_stats_full)."""
+    d = c.df()
+    if args.json:
+        print(json.dumps(d, sort_keys=True))
+        return
+    cl = d["cluster"]
+    print(f"  cluster: {cl['osds']} osds ({cl['osds_in']} in), "
+          f"{cl['bytes_used_raw']} B raw used")
+    print("  POOL     ID  OBJECTS  CLONES  USED(B)  RAW(B)  AMP")
+    for name, p in d["pools"].items():
+        print(f"  {name:<8} {p['id']:<3} {p['objects']:<8} "
+              f"{p['snap_clones']:<7} {p['bytes_used']:<8} "
+              f"{p['bytes_raw']:<7} {p['amplification']}x")
+
+
 def cmd_perf_dump(c, args) -> None:
     print(json.dumps({"cluster": c.perf.dump()}, indent=None if args.json
                      else 2, sort_keys=True))
@@ -163,6 +180,7 @@ def main(argv=None) -> None:
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("status")
     sub.add_parser("health")
+    sub.add_parser("df")
     pg = sub.add_parser("pg")
     pg.add_argument("pg_cmd", choices=["stat"])
     perf = sub.add_parser("perf")
@@ -181,6 +199,8 @@ def main(argv=None) -> None:
         cmd_status(c, args)
     elif args.cmd == "health":
         cmd_health(c, args)
+    elif args.cmd == "df":
+        cmd_df(c, args)
     elif args.cmd == "pg":
         cmd_pg_stat(c, args)
     elif args.cmd == "perf":
