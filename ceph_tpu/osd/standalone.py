@@ -1951,6 +1951,12 @@ class MonDaemon:
         heal) still teaches the committed map but must not abort the
         current healthy round."""
         with self._lock:
+            if int(peer[4:]) not in self._members():
+                # a non-member (e.g. a freshly booted, not-yet-joined
+                # monitor whose promised pn a rogue collect raised)
+                # must not abort a member round — same filter as
+                # _on_last/_on_accept
+                return
             self._pn_seen = max(self._pn_seen, msg.promised)
             self._fold_committed_locked(msg.committed_epoch,
                                         msg.committed_blob)

@@ -75,6 +75,30 @@ class TestMonMembership:
         assert cl.snap_create("post-leader-removal",
                               timeout=20.0) >= 1
 
+    def test_membership_change_commits_through_partition_majority(
+            self, cluster):
+        """`mon add` while a member is partitioned away: the change
+        commits through the majority side; the isolated monitor folds
+        it on heal (quorum intersection)."""
+        c = cluster
+        c.partition({"mon.2"}, {"mon.0", "mon.1"})
+        rank = c.add_mon(timeout=25)    # via majority {0, 1}
+        assert rank == 3
+        # partition() blocks only endpoints existing when installed:
+        # re-apply with the new monitor in the majority group so
+        # mon.2 stays genuinely isolated from EVERYONE
+        c.partition({"mon.2"}, {"mon.0", "mon.1", "mon.3"})
+        maj_map = next(m.osdmap for m in c.mons[:2]
+                       if m.osdmap is not None)
+        assert rank in maj_map.mon_members
+        cl = c.client()
+        cl.write({"post-join": b"committed through 0/1/3"})
+        assert cl.read("post-join") == b"committed through 0/1/3"
+        c.heal_partition()
+        c._wait(lambda: c.mons[2].osdmap is not None
+                and rank in c.mons[2].osdmap.mon_members, 25,
+                "isolated monitor folds the membership commit")
+
     def test_new_mon_serves_auth_and_maps(self):
         """A joined monitor is a full citizen: it syncs the map and
         (cephx) serves tickets."""

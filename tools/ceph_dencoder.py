@@ -42,6 +42,7 @@ def _mk_osdmap():
     m.config_set("osd_max_backfills", "2")
     m.pool_mksnap(1, "s1")
     m.mon_join(3)
+    m.osd_admin_out = {3, 7}     # v5 section
     return m
 
 
@@ -72,6 +73,11 @@ def _mk_txn():
 def _mk_message():
     from ceph_tpu.osd.standalone import MOSDOp
     return MOSDOp(42, True, "write", b"pg-op payload")
+
+
+def _mk_failure():
+    from ceph_tpu.osd.standalone import MOSDFailure
+    return MOSDFailure(5, alive=True)     # v2: the retraction flag
 
 
 def _enc_message(o) -> bytes:
@@ -150,6 +156,13 @@ TYPES = {
         "dec": _dec_message,
         "dump": lambda o: {"type_id": o.type_id, "kind": o.kind,
                            "req_id": o.req_id},
+    },
+    "MOSDFailure": {
+        "make": _mk_failure,
+        "enc": _enc_message,
+        "dec": _dec_message,
+        "dump": lambda o: {"type_id": o.type_id, "failed": o.failed,
+                           "alive": o.alive},
     },
 }
 
