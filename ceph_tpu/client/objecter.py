@@ -39,7 +39,12 @@ class Objecter:
         # under one lock (the reference Objecter likewise holds its
         # rwlock across _op_submit). The throttle is taken OUTSIDE the
         # lock so backpressure applies to concurrent callers.
-        self._dispatch_lock = threading.Lock()
+        # RLock: IoCtx's direct cluster accessors (stat, listings,
+        # snap ops, cls execute) serialize through this same lock so
+        # aio worker threads can't race them on thread-unsafe PG
+        # state; reentrancy lets a cls method or watch callback call
+        # back into the client without deadlocking
+        self._dispatch_lock = threading.RLock()
         # client-side backpressure (ref: Objecter's op_throttle_bytes /
         # objecter_inflight_op_bytes): payload bytes are charged before
         # dispatch and released after the reply; a flood of writes

@@ -17,17 +17,88 @@ remap lands correctly without caller involvement.
 
 from __future__ import annotations
 
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
 import numpy as np
 
 from .objecter import Objecter
 
 
+class Completion:
+    """An in-flight async op (the rados_completion_t role, ref:
+    src/librados/AioCompletionImpl.h): wait_for_complete blocks,
+    is_complete polls, get_return_value yields the op's result (and
+    re-raises its failure — librados returns the negative errno the
+    same way)."""
+
+    def __init__(self, callback=None):
+        self._ev = threading.Event()
+        self._cb = callback
+        self._result = None
+        self._exc: BaseException | None = None
+        self._done = False
+
+    def _finish(self, result, exc) -> None:
+        self._result, self._exc = result, exc
+        self._done = True       # value readable (e.g. FROM the cb)
+        if self._cb is not None:
+            try:
+                self._cb(self)
+            except Exception:   # noqa: BLE001 — a broken user callback
+                pass            # must not kill the completion thread
+        # signaled only AFTER the callback ran — librados order: a
+        # wait_for_complete/aio_flush returning guarantees callbacks
+        # finished too (aggregates built in callbacks are whole)
+        self._ev.set()
+
+    def is_complete(self) -> bool:
+        return self._ev.is_set()
+
+    def wait_for_complete(self, timeout: float | None = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def get_return_value(self):
+        if not self._done:
+            self._ev.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
 class Rados:
     """Cluster handle (the RadosClient role)."""
 
-    def __init__(self, cluster):
+    def __init__(self, cluster, aio_threads: int = 4):
         self.cluster = cluster
         self._objecter = Objecter(cluster)
+        # the finisher/op thread pool behind aio_* (ref: librados'
+        # Objecter op threads + the AioCompletion finisher): ops run
+        # here, completions fire from here; created LAZILY so sync-only
+        # handles never spawn threads. The Objecter serializes
+        # dispatch under its own (reentrant) lock, so concurrency is
+        # safe; aio buys PIPELINING of staging/callback work.
+        self._aio_threads = aio_threads
+        self._aio: ThreadPoolExecutor | None = None
+        self._aio_lock = threading.Lock()
+        self._aio_inflight: set = set()
+
+    def _aio_pool(self) -> ThreadPoolExecutor:
+        with self._aio_lock:
+            if self._aio is None:
+                self._aio = ThreadPoolExecutor(
+                    max_workers=self._aio_threads,
+                    thread_name_prefix="rados-aio")
+            return self._aio
+
+    def shutdown(self) -> None:
+        """rados_shutdown: drain in-flight aio and join the worker
+        threads. The handle stays usable for SYNC ops afterwards; a
+        later aio op lazily rebuilds the pool."""
+        with self._aio_lock:
+            pool, self._aio = self._aio, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
     def open_ioctx(self, pool: str = "default") -> "IoCtx":
         # the sim carries one pool (id 1); named lookup mirrors
@@ -76,7 +147,8 @@ class IoCtx:
         if snap is None:
             arr = self._ob.read(name)
         else:
-            arr = self.rados.cluster.snap_read(name, snap)
+            with self._ob._dispatch_lock:
+                arr = self.rados.cluster.snap_read(name, snap)
         if length is None:
             return arr[offset:].tobytes()
         return arr[offset:offset + length].tobytes()
@@ -86,58 +158,130 @@ class IoCtx:
 
     def stat(self, name: str) -> int:
         """Object size in bytes (rados_stat's pmtime is meaningless in
-        virtual time)."""
-        ps = self.rados.cluster.locate(name)
-        return self.rados.cluster.pgs[ps].stat_object(name)
+        virtual time). Serialized with in-flight aio — PG state is
+        not thread-safe (see Objecter._dispatch_lock)."""
+        with self._ob._dispatch_lock:
+            ps = self.rados.cluster.locate(name)
+            return self.rados.cluster.pgs[ps].stat_object(name)
 
     def list_objects(self) -> list[str]:
-        c = self.rados.cluster
-        return sorted(n for ps in range(c.pg_num)
-                      for n in c.pgs[ps].list_pg_objects())
+        with self._ob._dispatch_lock:
+            c = self.rados.cluster
+            return sorted(n for ps in range(c.pg_num)
+                          for n in c.pgs[ps].list_pg_objects())
+
+    # -- async ops (rados_aio_*, ref: librados.cc rados_aio_write/
+    #    rados_aio_read/rados_aio_flush over AioCompletionImpl) -------------
+
+    def _aio_submit(self, fn, callback) -> Completion:
+        comp = Completion(callback)
+        r = self.rados
+        pool = r._aio_pool()
+        with r._aio_lock:
+            r._aio_inflight.add(comp)
+
+        def run():
+            try:
+                comp._finish(fn(), None)
+            except BaseException as e:   # noqa: BLE001 — surfaces via
+                comp._finish(None, e)    # get_return_value, as errno
+            finally:
+                with r._aio_lock:
+                    r._aio_inflight.discard(comp)
+        pool.submit(run)
+        return comp
+
+    def aio_write_full(self, name: str, data: bytes,
+                       callback=None, snapc: int = 0) -> Completion:
+        data = bytes(data)   # snapshot the buffer at submit time
+        return self._aio_submit(
+            lambda: self.write_full(name, data, snapc=snapc) or len(data),
+            callback)
+
+    def aio_write(self, name: str, data: bytes, offset: int = 0,
+                  callback=None, snapc: int = 0) -> Completion:
+        data = bytes(data)
+        return self._aio_submit(
+            lambda: self.write(name, data, offset=offset,
+                               snapc=snapc) or len(data),
+            callback)
+
+    def aio_read(self, name: str, length: int | None = None,
+                 offset: int = 0, callback=None) -> Completion:
+        return self._aio_submit(
+            lambda: self.read(name, length=length, offset=offset),
+            callback)
+
+    def aio_remove(self, name: str, callback=None,
+                   snapc: int = 0) -> Completion:
+        return self._aio_submit(
+            lambda: self.remove(name, snapc=snapc), callback)
+
+    def aio_flush(self, comps: list[Completion] | None = None) -> None:
+        """Barrier: wait until outstanding aio completes (ref:
+        rados_aio_flush). With a list, waits those; with None, every
+        op in flight at the moment of the call (ops submitted AFTER
+        the flush began are not covered, as upstream)."""
+        if comps is None:
+            with self.rados._aio_lock:
+                comps = list(self.rados._aio_inflight)
+        for c in comps:
+            c.wait_for_complete()
 
     # -- pool snapshots (rados_ioctx_snap_*) --------------------------------
 
     def snap_create(self) -> int:
-        return self.rados.cluster.snap_create()
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.snap_create()
 
     def snap_remove(self, snap_id: int) -> int:
-        return self.rados.cluster.snap_remove(snap_id)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.snap_remove(snap_id)
 
     def snap_rollback(self, name: str, snap_id: int) -> None:
-        self.rados.cluster.snap_rollback(name, snap_id)
+        with self._ob._dispatch_lock:
+            self.rados.cluster.snap_rollback(name, snap_id)
 
     def snap_list(self) -> list[int]:
-        return sorted(self.rados.cluster.snaps)
+        with self._ob._dispatch_lock:
+            return sorted(self.rados.cluster.snaps)
 
     # -- selfmanaged snaps (rados_ioctx_selfmanaged_snap_*) -----------------
 
     def selfmanaged_snap_create(self) -> int:
-        return self.rados.cluster.selfmanaged_snap_create()
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.selfmanaged_snap_create()
 
     def selfmanaged_snap_remove(self, snap_id: int) -> int:
-        return self.rados.cluster.selfmanaged_snap_remove(snap_id)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.selfmanaged_snap_remove(snap_id)
 
     def snap_changed(self, name: str, snap_id: int) -> bool:
         """Fast-diff primitive: head diverged from its state at the
         snap? (metadata-only; ref: librbd fast-diff / object map)"""
-        return self.rados.cluster.snap_changed(name, snap_id)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.snap_changed(name, snap_id)
 
     # -- watch / notify (rados_watch3/rados_notify2) ------------------------
 
     def watch(self, name: str, callback) -> int:
-        return self.rados.cluster.watch(name, callback)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.watch(name, callback)
 
     def unwatch(self, name: str, cookie: int) -> None:
-        self.rados.cluster.unwatch(name, cookie)
+        with self._ob._dispatch_lock:
+            self.rados.cluster.unwatch(name, cookie)
 
     def notify(self, name: str, payload: bytes = b"") -> dict:
-        return self.rados.cluster.notify(name, payload)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.notify(name, payload)
 
     # -- object classes (rados_exec) ----------------------------------------
 
     def execute(self, name: str, cls: str, method: str,
                 inp: bytes = b"") -> bytes:
-        return self.rados.cluster.cls_exec(name, cls, method, inp)
+        with self._ob._dispatch_lock:
+            return self.rados.cluster.cls_exec(name, cls, method, inp)
 
 
 class RadosStriper:
