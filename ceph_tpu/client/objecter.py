@@ -63,19 +63,25 @@ class Objecter:
     # -- map view -----------------------------------------------------------
 
     def _refresh(self) -> None:
-        """Pull the current OSDMap (the MOSDMap subscription analog)."""
-        om = self.cluster.osdmap
-        self._epoch = om.epoch
-        self._primaries = {
-            ps: om.pg_to_up_acting_osds(1, ps)[3]
-            for ps in range(self.cluster.pg_num)}
+        """Pull the current OSDMap (the MOSDMap subscription analog).
+        Under the (reentrant) dispatch lock: the map + pg_num are
+        mutated multi-step by splits/autoscale on the driving thread,
+        and aio workers must neither read torn state here nor
+        interleave the epoch/primaries update pair."""
+        with self._dispatch_lock:
+            om = self.cluster.osdmap
+            self._epoch = om.epoch
+            self._primaries = {
+                ps: om.pg_to_up_acting_osds(1, ps)[3]
+                for ps in range(self.cluster.pg_num)}
         self.perf.inc("map_refresh")
 
     def _calc_target(self, name: str) -> tuple[int, int]:
         """object -> (ps, primary osd) from the CACHED map view
         (Objecter::_calc_target)."""
-        ps = self.cluster.osdmap.object_to_pg(1, name)[1]
-        return ps, self._primaries.get(ps, -1)
+        with self._dispatch_lock:
+            ps = self.cluster.osdmap.object_to_pg(1, name)[1]
+            return ps, self._primaries.get(ps, -1)
 
     # -- op submission ------------------------------------------------------
 

@@ -83,14 +83,6 @@ class Rados:
         self._aio_lock = threading.Lock()
         self._aio_inflight: set = set()
 
-    def _aio_pool(self) -> ThreadPoolExecutor:
-        with self._aio_lock:
-            if self._aio is None:
-                self._aio = ThreadPoolExecutor(
-                    max_workers=self._aio_threads,
-                    thread_name_prefix="rados-aio")
-            return self._aio
-
     def shutdown(self) -> None:
         """rados_shutdown: drain in-flight aio and join the worker
         threads. The handle stays usable for SYNC ops afterwards; a
@@ -176,9 +168,6 @@ class IoCtx:
     def _aio_submit(self, fn, callback) -> Completion:
         comp = Completion(callback)
         r = self.rados
-        pool = r._aio_pool()
-        with r._aio_lock:
-            r._aio_inflight.add(comp)
 
         def run():
             try:
@@ -188,7 +177,21 @@ class IoCtx:
             finally:
                 with r._aio_lock:
                     r._aio_inflight.discard(comp)
-        pool.submit(run)
+        # pool-get + inflight-add + submit under ONE lock window: a
+        # concurrent shutdown() between them would otherwise leave a
+        # registered-but-never-run completion that hangs aio_flush
+        # forever (shutdown swaps the pool out under the same lock)
+        with r._aio_lock:
+            if r._aio is None:
+                r._aio = ThreadPoolExecutor(
+                    max_workers=r._aio_threads,
+                    thread_name_prefix="rados-aio")
+            r._aio_inflight.add(comp)
+            try:
+                r._aio.submit(run)
+            except RuntimeError:
+                r._aio_inflight.discard(comp)
+                raise
         return comp
 
     def aio_write_full(self, name: str, data: bytes,

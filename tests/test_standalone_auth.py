@@ -195,6 +195,32 @@ class TestCephxWire:
         finally:
             c.shutdown()
 
+    def test_cephx_on_tinstore_survives_sigkill(self, tmp_path):
+        """Cross-feature: ticket auth over a PERSISTENT store — a
+        SIGKILLed+revived OSD remounts from WAL, re-fetches rotating
+        secrets, and serves the same bytes to re-authenticated
+        clients."""
+        import numpy as np
+        c = StandaloneCluster(n_osds=3, pg_num=2, op_timeout=3.0,
+                              cephx=True, store="tin",
+                              store_dir=str(tmp_path))
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client()
+            rng = np.random.default_rng(7)
+            objs = {f"tin-{i}":
+                    rng.integers(0, 256, 400, np.uint8).tobytes()
+                    for i in range(8)}
+            cl.write(objs)
+            victim = c.osd_ids()[0]
+            c.kill_osd(victim)       # REAL process death: RAM dropped
+            c.revive_osd(victim)     # WAL remount + fresh verifier
+            c.wait_for_clean(timeout=40)
+            for name, want in objs.items():
+                assert cl.read(name) == want
+        finally:
+            c.shutdown()
+
     def test_rotation_keep_window_then_refresh(self, cluster):
         cl = cluster.client()
         objs = corpus(7)
