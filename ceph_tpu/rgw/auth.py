@@ -296,9 +296,10 @@ class S3Client:
                           prefix=prefix)
 
     def list_objects(self, bucket, prefix: str = "", marker: str = "",
-                     limit: int = 1000):
+                     limit: int = 1000, delimiter: str = ""):
         return self._call("list_objects", bucket, prefix=prefix,
-                          marker=marker, limit=limit)
+                          marker=marker, limit=limit,
+                          delimiter=delimiter)
 
     def initiate_multipart(self, bucket, key):
         return self._call("initiate_multipart", bucket, key)

@@ -86,18 +86,59 @@ def _idx_rm(h: ClsHandle, inp: bytes) -> bytes:
 
 @register_cls("rgw_index", "list")
 def _idx_list(h: ClsHandle, inp: bytes) -> bytes:
+    """ListObjectsV2 shape incl. `delimiter` rollup: keys sharing
+    prefix..delimiter collapse into common_prefixes (the S3 "folder"
+    view; ref: cls_rgw bucket listing + RGWListBucket::execute)."""
     req = json.loads(inp or b"{}")
     prefix = req.get("prefix", "")
     marker = req.get("marker", "")
+    delim = req.get("delimiter", "")
     limit = int(req.get("limit", 1000))
     idx = h.kv.get("entries", {})
-    keys = sorted(k for k in idx
-                  if k.startswith(prefix) and k > marker)
-    page = keys[:limit]
+    if not delim:
+        keys = sorted(k for k in idx
+                      if k.startswith(prefix) and k > marker)
+        page = keys[:limit]
+        return json.dumps({
+            "entries": [{"key": k, **idx[k]} for k in page],
+            "truncated": len(keys) > limit,
+            "next_marker": page[-1] if page and len(keys) > limit
+            else "",
+        }).encode()
+    # S3 marker semantics: keys strictly after the marker, THEN the
+    # rollup — except that a marker which IS a rolled-up prefix (our
+    # next_marker after a delimiter page) skips everything under it,
+    # or pagination would re-emit the prefix forever. A plain-key
+    # marker inside a prefix still surfaces that prefix for the
+    # remaining keys, as S3 does.
+    entries, prefixes, taken = [], [], 0
+    last = ""
+    more = False
+    marker_is_prefix = bool(marker) and marker.endswith(delim)
+    for k in sorted(k for k in idx if k.startswith(prefix)):
+        if k <= marker:
+            continue
+        if marker_is_prefix and k.startswith(marker):
+            continue         # under an already-listed rollup page
+        rest = k[len(prefix):]
+        cut = rest.find(delim)
+        rolled = prefix + rest[:cut + len(delim)] if cut >= 0 else k
+        if cut >= 0 and prefixes and prefixes[-1] == rolled:
+            last = rolled        # absorbed into the current rollup
+            continue
+        if taken >= limit:
+            more = True
+            break
+        if cut >= 0:
+            prefixes.append(rolled)
+        else:
+            entries.append({"key": k, **idx[k]})
+        taken += 1
+        last = rolled
     return json.dumps({
-        "entries": [{"key": k, **idx[k]} for k in page],
-        "truncated": len(keys) > limit,
-        "next_marker": page[-1] if page and len(keys) > limit else "",
+        "entries": entries, "common_prefixes": prefixes,
+        "truncated": more,
+        "next_marker": last if more else "",
     }).encode()
 
 
@@ -569,13 +610,16 @@ class Gateway:
         return {"delete_marker": False, "version_id": None}
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     marker: str = "", limit: int = 1000) -> dict:
-        """ListObjectsV2 shape: {entries, truncated, next_marker}."""
+                     marker: str = "", limit: int = 1000,
+                     delimiter: str = "") -> dict:
+        """ListObjectsV2 shape: {entries, truncated, next_marker} plus
+        common_prefixes when a delimiter rolls up "folders"."""
         self._check_bucket(bucket)
         out = self.io.execute(
             self._index_obj(bucket), "rgw_index", "list",
             json.dumps({"prefix": prefix, "marker": marker,
-                        "limit": limit}).encode())
+                        "limit": limit,
+                        "delimiter": delimiter}).encode())
         return json.loads(out)
 
     def _stat_entry(self, bucket: str, key: str) -> dict:
