@@ -114,7 +114,13 @@ def _idx_list(h: ClsHandle, inp: bytes) -> bytes:
     entries, prefixes, taken = [], [], 0
     last = ""
     more = False
-    marker_is_prefix = bool(marker) and marker.endswith(delim)
+    # a rolled-up-prefix marker is always STRICTLY longer than the
+    # listing prefix (rollup appends at least one char + delim), so
+    # marker == prefix can only be a real zero-byte "folder marker"
+    # object ('a/' listed as an ENTRY under prefix='a/') — treating
+    # it as a rollup would silently skip the whole subtree
+    marker_is_prefix = bool(marker) and marker.endswith(delim) \
+        and marker != prefix
     for k in sorted(k for k in idx if k.startswith(prefix)):
         if k <= marker:
             continue

@@ -252,6 +252,22 @@ class TestDelimiterListing:
         assert "docs/" in out["common_prefixes"]
         assert "logs/" in out["common_prefixes"]
 
+    def test_folder_marker_object_does_not_hide_subtree(self):
+        """A zero-byte 'dir/' marker object (S3-console style) listed
+        as an entry must not make the next page skip the subtree —
+        the marker==prefix case is a key marker, not a rollup."""
+        c, gw = mk()
+        gw.create_bucket("b")
+        for k in ("a/", "a/b", "a/c"):
+            gw.put_object("b", k, b"")
+        p1 = gw.list_objects("b", prefix="a/", delimiter="/", limit=1)
+        assert [e["key"] for e in p1["entries"]] == ["a/"]
+        assert p1["truncated"]
+        p2 = gw.list_objects("b", prefix="a/", delimiter="/",
+                             marker=p1["next_marker"])
+        assert [e["key"] for e in p2["entries"]] == ["a/b", "a/c"]
+        assert not p2["truncated"]
+
     def test_delimiter_over_signed_surface(self):
         """The SigV4 client exposes delimiter too — the folder view
         must be reachable WITHOUT bypassing auth."""

@@ -131,6 +131,34 @@ def cmd_df(c, args) -> None:
               f"{p['bytes_raw']:<7} {p['amplification']}x")
 
 
+def cmd_osd_df(c, args) -> None:
+    """`ceph osd df` — per-OSD weight, up/in state, and PG slot
+    counts (ref: OSDMonitor 'osd df' via PGMap per-osd stats)."""
+    n = len(c.alive)
+    slots = {o: 0 for o in range(n)}
+    for ps in range(c.pg_num):
+        for osd in c.pgs[ps].acting:
+            if 0 <= osd < n:
+                slots[osd] += 1
+    rows = []
+    for o in range(n):
+        rows.append({"osd": o,
+                     "weight": round(float(c.osdmap.osd_weight[o])
+                                     / 0x10000, 4),
+                     "up": bool(c.osdmap.osd_up[o]),
+                     "in": bool(c.osdmap.osd_weight[o] > 0),
+                     "pg_slots": slots[o]})
+    if args.json:
+        print(json.dumps(rows))
+        return
+    print("  OSD  WEIGHT  UP     IN     PG-SLOTS")
+    for r in rows:
+        print(f"  {r['osd']:<4} {r['weight']:<7} "
+              f"{str(r['up']):<6} {str(r['in']):<6} {r['pg_slots']}")
+    mean = sum(slots.values()) / max(1, n)
+    print(f"  mean pg-slots/osd: {mean:.1f}")
+
+
 def cmd_perf_dump(c, args) -> None:
     print(json.dumps({"cluster": c.perf.dump()}, indent=None if args.json
                      else 2, sort_keys=True))
@@ -181,6 +209,7 @@ def main(argv=None) -> None:
     sub.add_parser("status")
     sub.add_parser("health")
     sub.add_parser("df")
+    sub.add_parser("osd-df")
     pg = sub.add_parser("pg")
     pg.add_argument("pg_cmd", choices=["stat"])
     perf = sub.add_parser("perf")
@@ -201,6 +230,8 @@ def main(argv=None) -> None:
         cmd_health(c, args)
     elif args.cmd == "df":
         cmd_df(c, args)
+    elif args.cmd == "osd-df":
+        cmd_osd_df(c, args)
     elif args.cmd == "pg":
         cmd_pg_stat(c, args)
     elif args.cmd == "perf":
