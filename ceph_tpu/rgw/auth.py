@@ -147,7 +147,7 @@ class AuthedGateway:
             "list_objects", "initiate_multipart", "upload_part",
             "complete_multipart", "abort_multipart",
             "put_bucket_versioning", "get_bucket_versioning",
-            "list_object_versions")
+            "list_object_versions", "copy_object")
 
     def __init__(self, gateway: Gateway, users: UserStore,
                  clock=time.time):
@@ -225,6 +225,18 @@ class AuthedGateway:
             return gw.list_object_versions(bucket, **params)
         if op == "put_object":
             return gw.put_object(bucket, key, payload)
+        if op == "copy_object":
+            # the signed (bucket, key) is the DESTINATION; the source
+            # bucket needs its own ownership check — authenticated
+            # users must not read each other's buckets via copy
+            src_owner = self._owner.get(params["src_bucket"])
+            if src_owner is not None and src_owner != uid:
+                raise AccessDenied(
+                    f"source bucket {params['src_bucket']!r} is "
+                    "owned by another user")
+            return gw.copy_object(
+                params["src_bucket"], params["src_key"], bucket, key,
+                src_version_id=params.get("src_version_id"))
         if op == "upload_part":
             return gw.upload_part(bucket, key, params["upload_id"],
                                   params["part_number"], payload)
@@ -274,6 +286,12 @@ class S3Client:
                    version_id: str | None = None):
         return self._call("get_object", bucket, key, offset=offset,
                           length=length, version_id=version_id)
+
+    def copy_object(self, src_bucket, src_key, dst_bucket, dst_key,
+                    src_version_id: str | None = None):
+        return self._call("copy_object", dst_bucket, dst_key,
+                          src_bucket=src_bucket, src_key=src_key,
+                          src_version_id=src_version_id)
 
     def head_object(self, bucket, key, version_id: str | None = None):
         return self._call("head_object", bucket, key,

@@ -615,6 +615,27 @@ class Gateway:
                         json.dumps({"key": key}).encode())
         return {"delete_marker": False, "version_id": None}
 
+    def copy_object(self, src_bucket: str, src_key: str,
+                    dst_bucket: str, dst_key: str,
+                    src_version_id: str | None = None) -> str:
+        """CopyObject (ref: rgw_op.cc RGWCopyObj; S3
+        x-amz-copy-source): server-side copy — the client never
+        carries the bytes. The destination is a normal PUT (fresh
+        payload objects, fresh mtime, versioning semantics of the
+        DESTINATION bucket apply); the source may be a specific
+        version. Returns the new ETag."""
+        self._check_bucket(src_bucket)
+        self._check_bucket(dst_bucket)
+        if src_bucket == dst_bucket and src_key == dst_key \
+                and src_version_id is None:
+            # S3 rejects an in-place copy with no changes
+            raise GatewayError(
+                "InvalidRequest: copy onto itself without a source "
+                "version changes nothing")
+        data = self.get_object(src_bucket, src_key,
+                               version_id=src_version_id)
+        return self.put_object(dst_bucket, dst_key, data)
+
     def list_objects(self, bucket: str, prefix: str = "",
                      marker: str = "", limit: int = 1000,
                      delimiter: str = "") -> dict:

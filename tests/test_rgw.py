@@ -466,3 +466,153 @@ class TestVersioning:
             agw.call(access, date, sig, "get_object", bucket="b",
                      key="k", nonce="n1", payload=b"", offset=0,
                      length=None, version_id=vs[0]["vid"])
+
+
+class TestDelimiterListing:
+    """ListObjectsV2 delimiter rollup (ref: RGWListBucket::execute
+    common-prefix aggregation)."""
+
+    def _seed(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        for k in ("docs/a.txt", "docs/b.txt", "docs/sub/c.txt",
+                  "logs/1.log", "logs/2.log", "top.txt"):
+            gw.put_object("b", k, b"x")
+        return gw
+
+    def test_folder_view(self):
+        gw = self._seed()
+        out = gw.list_objects("b", delimiter="/")
+        assert [e["key"] for e in out["entries"]] == ["top.txt"]
+        assert out["common_prefixes"] == ["docs/", "logs/"]
+        assert not out["truncated"]
+
+    def test_prefix_plus_delimiter_descends_one_level(self):
+        gw = self._seed()
+        out = gw.list_objects("b", prefix="docs/", delimiter="/")
+        assert [e["key"] for e in out["entries"]] == \
+            ["docs/a.txt", "docs/b.txt"]
+        assert out["common_prefixes"] == ["docs/sub/"]
+
+    def test_delimiter_pagination(self):
+        gw = self._seed()
+        page1 = gw.list_objects("b", delimiter="/", limit=1)
+        assert page1["truncated"]
+        seen = list(page1["common_prefixes"]) \
+            + [e["key"] for e in page1["entries"]]
+        marker = page1["next_marker"]
+        while marker:
+            page = gw.list_objects("b", delimiter="/", limit=1,
+                                   marker=marker)
+            seen += list(page["common_prefixes"]) \
+                + [e["key"] for e in page["entries"]]
+            marker = page["next_marker"]
+        assert sorted(seen) == ["docs/", "logs/", "top.txt"]
+
+    def test_no_delimiter_unchanged(self):
+        gw = self._seed()
+        out = gw.list_objects("b", prefix="docs/")
+        assert len(out["entries"]) == 3
+        assert "common_prefixes" not in out
+
+    def test_plain_key_marker_still_surfaces_prefix(self):
+        """S3 semantics: a marker that is a plain key INSIDE a prefix
+        does not hide the prefix — the remaining keys under it still
+        roll up (only a rolled-prefix marker skips the whole run)."""
+        gw = self._seed()
+        out = gw.list_objects("b", marker="docs/a.txt", delimiter="/")
+        assert "docs/" in out["common_prefixes"]
+        assert "logs/" in out["common_prefixes"]
+
+    def test_folder_marker_object_does_not_hide_subtree(self):
+        """A zero-byte 'dir/' marker object (S3-console style) listed
+        as an entry must not make the next page skip the subtree —
+        the marker==prefix case is a key marker, not a rollup."""
+        c, gw = mk()
+        gw.create_bucket("b")
+        for k in ("a/", "a/b", "a/c"):
+            gw.put_object("b", k, b"")
+        p1 = gw.list_objects("b", prefix="a/", delimiter="/", limit=1)
+        assert [e["key"] for e in p1["entries"]] == ["a/"]
+        assert p1["truncated"]
+        p2 = gw.list_objects("b", prefix="a/", delimiter="/",
+                             marker=p1["next_marker"])
+        assert [e["key"] for e in p2["entries"]] == ["a/b", "a/c"]
+        assert not p2["truncated"]
+
+    def test_delimiter_over_signed_surface(self):
+        """The SigV4 client exposes delimiter too — the folder view
+        must be reachable WITHOUT bypassing auth."""
+        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
+        gw = self._seed()
+        users = UserStore()
+        access, secret = users.create_user("lister")
+        cl = S3Client(AuthedGateway(gw, users), access, secret)
+        out = cl.list_objects("b", delimiter="/")
+        assert out["common_prefixes"] == ["docs/", "logs/"]
+
+
+class TestCopyObject:
+    """Server-side copy (ref: rgw_op.cc RGWCopyObj; S3
+    x-amz-copy-source incl. versioned sources)."""
+
+    def test_copy_across_buckets(self):
+        c, gw = mk()
+        gw.create_bucket("src")
+        gw.create_bucket("dst")
+        gw.put_object("src", "a", b"copy me" * 100)
+        etag = gw.copy_object("src", "a", "dst", "b")
+        assert gw.get_object("dst", "b") == b"copy me" * 100
+        assert gw.head_object("dst", "b")["etag"] == etag
+        # source untouched; payloads independent
+        gw.delete_object("src", "a")
+        assert gw.get_object("dst", "b") == b"copy me" * 100
+
+    def test_copy_specific_version(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.set_bucket_versioning("b", True)
+        gw.put_object("b", "doc", b"v1")
+        v1 = [v["vid"] for v in
+              gw.list_object_versions("b")["versions"]][0]
+        gw.put_object("b", "doc", b"v2")
+        gw.copy_object("b", "doc", "b", "restored",
+                       src_version_id=v1)
+        assert gw.get_object("b", "restored") == b"v1"
+
+    def test_self_copy_rejected(self):
+        c, gw = mk()
+        gw.create_bucket("b")
+        gw.put_object("b", "k", b"x")
+        with pytest.raises(GatewayError, match="itself"):
+            gw.copy_object("b", "k", "b", "k")
+
+    def test_copy_into_versioned_dst_appends(self):
+        c, gw = mk()
+        gw.create_bucket("src")
+        gw.create_bucket("dst")
+        gw.set_bucket_versioning("dst", True)
+        gw.put_object("dst", "k", b"old")
+        gw.put_object("src", "k", b"new")
+        gw.copy_object("src", "k", "dst", "k")
+        assert gw.get_object("dst", "k") == b"new"
+        assert len(gw.list_object_versions("dst")["versions"]) == 2
+
+    def test_signed_copy_and_cross_user_denied(self):
+        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
+        from ceph_tpu.rgw.auth import AccessDenied
+        c, gw = mk()
+        users = UserStore()
+        a_ak, a_sk = users.create_user("alice")
+        b_ak, b_sk = users.create_user("bob")
+        agw = AuthedGateway(gw, users)
+        alice = S3Client(agw, a_ak, a_sk)
+        bob = S3Client(agw, b_ak, b_sk)
+        alice.create_bucket("alices")
+        bob.create_bucket("bobs")
+        alice.put_object("alices", "secret", b"classified")
+        with pytest.raises(AccessDenied, match="source bucket"):
+            bob.copy_object("alices", "secret", "bobs", "stolen")
+        alice.create_bucket("alices2")
+        alice.copy_object("alices", "secret", "alices2", "copy")
+        assert alice.get_object("alices2", "copy") == b"classified"

@@ -194,87 +194,3 @@ class TestVersionedLifecycle:
         assert rep2["b"]["markers_cleaned"] == ["doc"]
         assert gw.list_object_versions("b")["versions"] == []
         assert gw.lc_process() == {}   # third pass: nothing left
-
-
-class TestDelimiterListing:
-    """ListObjectsV2 delimiter rollup (ref: RGWListBucket::execute
-    common-prefix aggregation)."""
-
-    def _seed(self):
-        c, gw = mk()
-        gw.create_bucket("b")
-        for k in ("docs/a.txt", "docs/b.txt", "docs/sub/c.txt",
-                  "logs/1.log", "logs/2.log", "top.txt"):
-            gw.put_object("b", k, b"x")
-        return gw
-
-    def test_folder_view(self):
-        gw = self._seed()
-        out = gw.list_objects("b", delimiter="/")
-        assert [e["key"] for e in out["entries"]] == ["top.txt"]
-        assert out["common_prefixes"] == ["docs/", "logs/"]
-        assert not out["truncated"]
-
-    def test_prefix_plus_delimiter_descends_one_level(self):
-        gw = self._seed()
-        out = gw.list_objects("b", prefix="docs/", delimiter="/")
-        assert [e["key"] for e in out["entries"]] == \
-            ["docs/a.txt", "docs/b.txt"]
-        assert out["common_prefixes"] == ["docs/sub/"]
-
-    def test_delimiter_pagination(self):
-        gw = self._seed()
-        page1 = gw.list_objects("b", delimiter="/", limit=1)
-        assert page1["truncated"]
-        seen = list(page1["common_prefixes"]) \
-            + [e["key"] for e in page1["entries"]]
-        marker = page1["next_marker"]
-        while marker:
-            page = gw.list_objects("b", delimiter="/", limit=1,
-                                   marker=marker)
-            seen += list(page["common_prefixes"]) \
-                + [e["key"] for e in page["entries"]]
-            marker = page["next_marker"]
-        assert sorted(seen) == ["docs/", "logs/", "top.txt"]
-
-    def test_no_delimiter_unchanged(self):
-        gw = self._seed()
-        out = gw.list_objects("b", prefix="docs/")
-        assert len(out["entries"]) == 3
-        assert "common_prefixes" not in out
-
-    def test_plain_key_marker_still_surfaces_prefix(self):
-        """S3 semantics: a marker that is a plain key INSIDE a prefix
-        does not hide the prefix — the remaining keys under it still
-        roll up (only a rolled-prefix marker skips the whole run)."""
-        gw = self._seed()
-        out = gw.list_objects("b", marker="docs/a.txt", delimiter="/")
-        assert "docs/" in out["common_prefixes"]
-        assert "logs/" in out["common_prefixes"]
-
-    def test_folder_marker_object_does_not_hide_subtree(self):
-        """A zero-byte 'dir/' marker object (S3-console style) listed
-        as an entry must not make the next page skip the subtree —
-        the marker==prefix case is a key marker, not a rollup."""
-        c, gw = mk()
-        gw.create_bucket("b")
-        for k in ("a/", "a/b", "a/c"):
-            gw.put_object("b", k, b"")
-        p1 = gw.list_objects("b", prefix="a/", delimiter="/", limit=1)
-        assert [e["key"] for e in p1["entries"]] == ["a/"]
-        assert p1["truncated"]
-        p2 = gw.list_objects("b", prefix="a/", delimiter="/",
-                             marker=p1["next_marker"])
-        assert [e["key"] for e in p2["entries"]] == ["a/b", "a/c"]
-        assert not p2["truncated"]
-
-    def test_delimiter_over_signed_surface(self):
-        """The SigV4 client exposes delimiter too — the folder view
-        must be reachable WITHOUT bypassing auth."""
-        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
-        gw = self._seed()
-        users = UserStore()
-        access, secret = users.create_user("lister")
-        cl = S3Client(AuthedGateway(gw, users), access, secret)
-        out = cl.list_objects("b", delimiter="/")
-        assert out["common_prefixes"] == ["docs/", "logs/"]
