@@ -1001,12 +1001,25 @@ class OSDDaemon:
                 # bytes); only a dead old OSD is a LOSS (decode-rebuild
                 # from helpers). Conflating them would overrun m.
                 lost, moves = [], []
+                n_osds = len(self.osdmap.osd_up)
                 for s, (o, n) in enumerate(zip(be.acting, acting)):
                     if o == n:
                         continue
-                    if self.osdmap.osd_up[o] and o not in self.suspect:
+                    if not _valid_osd(n, n_osds):
+                        # CRUSH couldn't fill this slot in the current
+                        # (degraded) epoch — acting carries the
+                        # ITEM_NONE sentinel. Addressing "osd.<2^31>"
+                        # would KeyError mid-dispatch; leave the slot
+                        # where it is and retry on a better map.
+                        continue
+                    if _valid_osd(o, n_osds) \
+                            and self.osdmap.osd_up[o] \
+                            and o not in self.suspect:
                         moves.append((s, o, n))
                     else:
+                        # dead old holder — or a hole: a slot born
+                        # unfillable has no old bytes anywhere and
+                        # must decode-rebuild, not copy
                         lost.append(s)
                 try:
                     for s, o, n in moves:
@@ -1017,7 +1030,8 @@ class OSDDaemon:
                         exclude = {
                             s for s, o in enumerate(be.acting)
                             if s not in lost
-                            and (o in self.suspect
+                            and (not _valid_osd(o, n_osds)
+                                 or o in self.suspect
                                  or not self.osdmap.osd_up[o])}
                         be.recover_shards(lost, replacement_osds=repl,
                                           helper_exclude=exclude)
@@ -1267,8 +1281,11 @@ class OSDDaemon:
         raise ValueError(f"unknown client op {kind!r}")
 
     def _mark_suspects(self, be) -> None:
+        n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
+            else 0
         for osd in set(be.acting):
-            if osd == self.osd_id or osd in self.suspect:
+            if osd == self.osd_id or osd in self.suspect \
+                    or not _valid_osd(osd, n_osds):
                 continue
             try:
                 self.rpc.call(f"osd.{osd}",
@@ -2194,6 +2211,16 @@ class _WireAuth:
                            "mac": mac.hex(), "services": services})
 
 
+def _valid_osd(osd: int, n_osds: int) -> bool:
+    """False for CRUSH_ITEM_NONE holes / out-of-range ids: a degraded
+    epoch's acting set can carry the 2^31-1 sentinel where no OSD
+    could be chosen, and addressing "osd.<sentinel>" (or indexing
+    osd_up with it) must never happen (shared by reconcile, suspect
+    probing, and client primary lookup; peering.py applies the same
+    predicate to its own sets)."""
+    return 0 <= osd < n_osds
+
+
 def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
     """Present a `service` ticket to `peer` over MAuthOp("authorize"),
     running the daemon's anti-replay challenge round, then verify its
@@ -2283,8 +2310,11 @@ class Client:
 
     def _primary(self, ps: int) -> str:
         acting = self.osdmap.pg_to_up_acting_osds(1, ps)[2]
-        if not acting:
-            raise ConnectionError(f"pg 1.{ps} has no acting set")
+        if not acting or not _valid_osd(acting[0],
+                                        len(self.osdmap.osd_up)):
+            # empty, or an ITEM_NONE hole in a degraded epoch: no
+            # serviceable primary — retry on the next map
+            raise ConnectionError(f"pg 1.{ps} has no acting primary")
         return f"osd.{acting[0]}"
 
     def _op(self, kind: str, ps: int, body_fn, timeout=None,
