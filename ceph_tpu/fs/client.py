@@ -113,6 +113,21 @@ def _dir_load(h: ClsHandle, inp: bytes) -> bytes:
     return b"{}"
 
 
+@register_cls("fs_dir", "set_quota")
+def _dir_set_quota(h: ClsHandle, inp: bytes) -> bytes:
+    q = json.loads(inp)
+    if q:
+        h.kv["quota"] = q
+    else:
+        h.kv.pop("quota", None)
+    return b"{}"
+
+
+@register_cls("fs_dir", "get_quota")
+def _dir_get_quota(h: ClsHandle, inp: bytes) -> bytes:
+    return json.dumps(h.kv.get("quota", {})).encode()
+
+
 @register_cls("fs_dir", "clear")
 def _dir_clear(h: ClsHandle, inp: bytes) -> bytes:
     h.kv.pop("dentries", None)
@@ -364,6 +379,88 @@ class FsClient:
         return {"bits": bits, "frags": 1 << bits if bits else 1,
                 "dentries": sum(per.values()), "per_frag": per}
 
+    # -- directory quotas (ref: the vxattrs ceph.quota.max_bytes /
+    #    ceph.quota.max_files, enforced by Client::check_quota_condition
+    #    against the quota realm's rstats) --------------------------------
+
+    class QuotaExceeded(FsError, OSError):
+        pass
+
+    def set_quota(self, path: str, max_bytes: int | None = None,
+                  max_files: int | None = None) -> None:
+        """`setfattr -n ceph.quota.*`: attach (or clear, with both
+        None) a quota to a directory."""
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        q = {}
+        for name, v in (("max_bytes", max_bytes),
+                        ("max_files", max_files)):
+            if v is not None:
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 1:
+                    raise FsError(f"quota {name} must be a positive "
+                                  f"int, got {v!r}")
+                q[name] = v
+        self.io.execute(self._dir_obj(ent["ino"]), "fs_dir",
+                        "set_quota", json.dumps(q).encode())
+
+    def get_quota(self, path: str) -> dict:
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        return json.loads(self.io.execute(
+            self._dir_obj(ent["ino"]), "fs_dir", "get_quota"))
+
+    def du(self, path: str) -> dict:
+        """{bytes, files} under a directory (recursive; the rstats
+        role, computed on demand — disclosed simplification vs the
+        MDS's incrementally-maintained rstats)."""
+        ent = self._walk(self._split(path))
+        if ent["type"] != "dir":
+            raise NotADir(path)
+        return self._du_ino(ent["ino"])
+
+    def _du_ino(self, ino: int) -> dict:
+        total = {"bytes": 0, "files": 0}
+        for name, ent in self._list_all(ino).items():
+            if ent["type"] == "dir":
+                sub = self._du_ino(ent["ino"])
+                total["bytes"] += sub["bytes"]
+                # a directory IS an entry (rentries counts subdirs
+                # toward max_files in the reference's rstats)
+                total["files"] += sub["files"] + 1
+            else:
+                total["bytes"] += ent["size"]
+                total["files"] += 1
+        return total
+
+    def _check_quota(self, chain: list[int], add_bytes: int = 0,
+                     add_files: int = 0) -> None:
+        """Check every quota realm on the (pre-collected) ancestor
+        chain; any quota the growth would breach refuses with EDQUOT
+        (Client::check_quota_condition walks realms upward the same
+        way). The chain comes from the op's own _walk — no second
+        path resolution."""
+        if add_bytes <= 0 and add_files <= 0:
+            return
+        for ino in chain:
+            q = json.loads(self.io.execute(
+                self._dir_obj(ino), "fs_dir", "get_quota"))
+            if not q:
+                continue
+            use = self._du_ino(ino)
+            if "max_bytes" in q \
+                    and use["bytes"] + add_bytes > q["max_bytes"]:
+                raise self.QuotaExceeded(
+                    f"EDQUOT: {use['bytes']} + {add_bytes} bytes "
+                    f"exceeds max_bytes={q['max_bytes']}")
+            if "max_files" in q \
+                    and use["files"] + add_files > q["max_files"]:
+                raise self.QuotaExceeded(
+                    f"EDQUOT: {use['files']} + {add_files} files "
+                    f"exceeds max_files={q['max_files']}")
+
     # -- path walk (MDCache::path_traverse) ----------------------------------
 
     @staticmethod
@@ -371,10 +468,16 @@ class FsClient:
         path = posixpath.normpath("/" + path)
         return [p for p in path.split("/") if p]
 
-    def _walk(self, parts: list[str]) -> dict:
+    def _walk(self, parts: list[str],
+              chain: list[int] | None = None) -> dict:
         """Resolve to the dentry of the LAST part; root pseudo-dentry
-        for []. Raises FileNotFoundError / NotADir on the way."""
+        for []. Raises FileNotFoundError / NotADir on the way. When
+        `chain` is given, the inos of every DIRECTORY on the path
+        (root included, the target too if it is a dir) are appended —
+        the quota realm chain, collected for free during the walk."""
         cur = {"ino": ROOT_INO, "type": "dir", "size": 0, "mtime": 0.0}
+        if chain is not None:
+            chain.append(ROOT_INO)
         for i, name in enumerate(parts):
             if cur["type"] != "dir":
                 raise NotADir("/" + "/".join(parts[:i]))
@@ -386,23 +489,27 @@ class FsClient:
                     if not r["found"]:
                         raise ClsError("ENOENT")
                     cur = r["ent"]
-                    continue
-                raw = self.io.execute(
-                    self._dentry_obj(cur["ino"], name,
-                                     bits=r["bits"]),
-                    "fs_dir", "lookup",
-                    json.dumps({"name": name}).encode())
-                cur = json.loads(raw)
+                else:
+                    raw = self.io.execute(
+                        self._dentry_obj(cur["ino"], name,
+                                         bits=r["bits"]),
+                        "fs_dir", "lookup",
+                        json.dumps({"name": name}).encode())
+                    cur = json.loads(raw)
             except (ClsError, KeyError):
                 raise FileNotFoundError(
                     "/" + "/".join(parts[:i + 1])) from None
+            if chain is not None and cur["type"] == "dir":
+                chain.append(cur["ino"])
         return cur
 
-    def _parent_and_name(self, path: str) -> tuple[dict, str]:
+    def _parent_and_name(self, path: str,
+                         chain: list[int] | None = None
+                         ) -> tuple[dict, str]:
         parts = self._split(path)
         if not parts:
             raise FsError("operation on /")
-        parent = self._walk(parts[:-1])
+        parent = self._walk(parts[:-1], chain=chain)
         if parent["type"] != "dir":
             raise NotADir(posixpath.dirname("/" + "/".join(parts)))
         return parent, parts[-1]
@@ -410,7 +517,9 @@ class FsClient:
     # -- metadata ops --------------------------------------------------------
 
     def mkdir(self, path: str) -> None:
-        parent, name = self._parent_and_name(path)
+        chain: list[int] = []
+        parent, name = self._parent_and_name(path, chain=chain)
+        self._check_quota(chain, add_files=1)
         ino = self._alloc_ino()
         self.io.write_full(self._dir_obj(ino), b"dirfrag")
         ent = {"ino": ino, "type": "dir", "size": 0,
@@ -419,7 +528,9 @@ class FsClient:
 
     def create(self, path: str, data: bytes = b"") -> None:
         """create + write in one call (the O_CREAT|O_WRONLY shape)."""
-        parent, name = self._parent_and_name(path)
+        chain: list[int] = []
+        parent, name = self._parent_and_name(path, chain=chain)
+        self._check_quota(chain, add_files=1)
         ino = self._alloc_ino()
         ent = {"ino": ino, "type": "file", "size": 0,
                "mtime": self._clock()}
@@ -470,14 +581,31 @@ class FsClient:
         the SAME inode — data never moves (the MDS rename property).
         An existing dst file is replaced (POSIX); a dst dir must not
         exist."""
-        sparent, sname = self._parent_and_name(src)
-        dparent, dname = self._parent_and_name(dst)
+        schain: list[int] = []
+        sparent, sname = self._parent_and_name(src, chain=schain)
+        dchain: list[int] = []
+        dparent, dname = self._parent_and_name(dst, chain=dchain)
         ent = self._walk(self._split(src))
         if sparent["ino"] == dparent["ino"] and sname == dname:
             # POSIX: same-path rename is a no-op. Without this the
             # dst link rewrites the dentry and the src unlink then
             # REMOVES it — the file vanishes and its data orphans.
             return
+        if sparent["ino"] != dparent["ino"]:
+            # a CROSS-directory move must satisfy the destination's
+            # quota realms (the reference checks quota on cross-realm
+            # rename) — a subtree brings its whole recursive usage
+            if ent["type"] == "dir":
+                use = self._du_ino(ent["ino"])
+                mv_bytes, mv_files = use["bytes"], use["files"] + 1
+            else:
+                mv_bytes, mv_files = ent["size"], 1
+            # ancestors COMMON to src and dst see no net change from
+            # the move — charging them would spuriously EDQUOT an
+            # exactly-full shared realm
+            common = set(schain)
+            self._check_quota([i for i in dchain if i not in common],
+                              add_bytes=mv_bytes, add_files=mv_files)
         if ent["type"] == "file":
             # a held capability pins the NAME too: renaming a file
             # out from under an open handle would strand its caps
@@ -628,6 +756,11 @@ class FsClient:
             raise IsADir(path)
         self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True, what=f"write {path}")
+        chain: list[int] = []
+        self._parent_and_name(path, chain=chain)
+        self._check_quota(chain,
+                          add_bytes=max(0, offset + len(data)
+                                        - ent["size"]))
         self._striper.write(self._data_obj(ent["ino"]), bytes(data),
                             offset=offset)
         new_size = max(ent["size"], offset + len(data))
@@ -661,6 +794,10 @@ class FsClient:
         self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True,
                          what=f"truncate {path}")
+        chain: list[int] = []
+        self._parent_and_name(path, chain=chain)
+        self._check_quota(chain,
+                          add_bytes=max(0, size - ent["size"]))
         if ent["size"] == 0 and size > 0:
             # sparse grow of a never-written file: materialize zeros
             self._striper.write(self._data_obj(ent["ino"]), b"\x00")

@@ -131,3 +131,112 @@ class TestDirfragSplit:
         for i in range(25):
             assert fs.read(f"/deep/g{i}") == np.full(
                 64, i, np.uint8).tobytes()
+
+
+class TestQuotas:
+    """Directory quotas (ref: ceph.quota.max_bytes/max_files vxattrs;
+    Client::check_quota_condition walking quota realms upward)."""
+
+    def test_byte_quota_blocks_growth(self):
+        c, fs = mkfs()
+        fs.mkdir("/proj")
+        fs.set_quota("/proj", max_bytes=1000)
+        fs.create("/proj/a", data=b"x" * 600)
+        with pytest.raises(fs.QuotaExceeded, match="max_bytes"):
+            fs.create("/proj/b", data=b"y" * 600)
+        # partial file landed under quota? create counts the file
+        # first, then write checks bytes — the file exists empty
+        fs.write("/proj/b", b"y" * 300)       # fits
+        assert fs.read("/proj/b") == b"y" * 300
+        with pytest.raises(fs.QuotaExceeded):
+            fs.write("/proj/b", b"z" * 200, offset=300)
+
+    def test_file_quota_blocks_creates(self):
+        c, fs = mkfs()
+        fs.mkdir("/few")
+        fs.set_quota("/few", max_files=2)
+        fs.create("/few/one")
+        fs.create("/few/two")
+        with pytest.raises(fs.QuotaExceeded, match="max_files"):
+            fs.create("/few/three")
+        fs.unlink("/few/one")
+        fs.create("/few/three")               # freed a slot
+
+    def test_nested_quota_inner_stricter(self):
+        c, fs = mkfs()
+        fs.mkdir("/outer")
+        fs.mkdir("/outer/inner")
+        fs.set_quota("/outer", max_bytes=10_000)
+        fs.set_quota("/outer/inner", max_bytes=100)
+        with pytest.raises(fs.QuotaExceeded):
+            fs.create("/outer/inner/big", data=b"b" * 200)
+        fs.create("/outer/big", data=b"b" * 5_000)   # outer allows
+
+    def test_quota_scoped_to_subtree(self):
+        c, fs = mkfs()
+        fs.mkdir("/limited")
+        fs.mkdir("/free")
+        fs.set_quota("/limited", max_bytes=10)
+        fs.create("/free/huge", data=b"h" * 10_000)  # unaffected
+
+    def test_truncate_grow_checked_shrink_frees(self):
+        c, fs = mkfs()
+        fs.mkdir("/q")
+        fs.set_quota("/q", max_bytes=500)
+        fs.create("/q/f", data=b"d" * 400)
+        with pytest.raises(fs.QuotaExceeded):
+            fs.truncate("/q/f", 600)
+        fs.truncate("/q/f", 100)
+        fs.create("/q/g", data=b"g" * 300)    # shrink freed room
+
+    def test_clear_and_introspect(self):
+        c, fs = mkfs()
+        fs.mkdir("/d")
+        fs.set_quota("/d", max_bytes=50, max_files=5)
+        assert fs.get_quota("/d") == {"max_bytes": 50, "max_files": 5}
+        fs.create("/d/a", data=b"1234")
+        assert fs.du("/d") == {"bytes": 4, "files": 1}
+        fs.set_quota("/d")                    # both None: clear
+        assert fs.get_quota("/d") == {}
+        fs.create("/d/big", data=b"B" * 10_000)   # no longer limited
+
+    def test_rename_into_quota_dir_enforced(self):
+        """A cross-directory move must satisfy the destination's
+        quota — renaming a big file into a tiny realm is EDQUOT."""
+        c, fs = mkfs()
+        fs.mkdir("/free")
+        fs.mkdir("/limited")
+        fs.set_quota("/limited", max_bytes=10)
+        fs.create("/free/huge", data=b"h" * 10_000)
+        with pytest.raises(fs.QuotaExceeded):
+            fs.rename("/free/huge", "/limited/huge")
+        assert fs.read("/free/huge") == b"h" * 10_000  # unmoved
+        # moving WITHIN one realm never re-charges the shared ancestor
+        fs.mkdir("/cap")
+        fs.set_quota("/cap", max_bytes=600)
+        fs.mkdir("/cap/a")
+        fs.mkdir("/cap/b")
+        fs.create("/cap/a/f", data=b"f" * 500)
+        fs.rename("/cap/a/f", "/cap/b/f")      # net-zero for /cap
+        assert fs.read("/cap/b/f") == b"f" * 500
+
+    def test_mkdir_counts_toward_max_files(self):
+        """Directories are entries (rentries): max_files limits them
+        too."""
+        c, fs = mkfs()
+        fs.mkdir("/d")
+        fs.set_quota("/d", max_files=2)
+        fs.mkdir("/d/sub1")
+        fs.create("/d/f1")
+        with pytest.raises(fs.QuotaExceeded, match="max_files"):
+            fs.mkdir("/d/sub2")
+        with pytest.raises(fs.QuotaExceeded, match="max_files"):
+            fs.create("/d/f2")
+
+    def test_quota_validation(self):
+        c, fs = mkfs()
+        fs.mkdir("/d")
+        with pytest.raises(Exception, match="positive"):
+            fs.set_quota("/d", max_bytes=0)
+        with pytest.raises(Exception, match="positive"):
+            fs.set_quota("/d", max_files=True)
