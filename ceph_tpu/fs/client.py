@@ -600,6 +600,16 @@ class FsClient:
                 mv_bytes, mv_files = use["bytes"], use["files"] + 1
             else:
                 mv_bytes, mv_files = ent["size"], 1
+            # a replace-rename frees the dst file it overwrites: the
+            # NET growth is what quota enforces (POSIX replace into an
+            # exactly-full realm must not spuriously EDQUOT)
+            try:
+                dent0 = self._walk(self._split(dst))
+                if dent0["type"] == "file":
+                    mv_bytes -= dent0["size"]
+                    mv_files -= 1
+            except FileNotFoundError:
+                pass
             # ancestors COMMON to src and dst see no net change from
             # the move — charging them would spuriously EDQUOT an
             # exactly-full shared realm
@@ -750,14 +760,13 @@ class FsClient:
 
     def write(self, path: str, data: bytes, offset: int = 0,
               _expect_ino: int | None = None) -> None:
-        parent, name = self._parent_and_name(path)
+        chain: list[int] = []
+        parent, name = self._parent_and_name(path, chain=chain)
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
         self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True, what=f"write {path}")
-        chain: list[int] = []
-        self._parent_and_name(path, chain=chain)
         self._check_quota(chain,
                           add_bytes=max(0, offset + len(data)
                                         - ent["size"]))
@@ -787,15 +796,14 @@ class FsClient:
 
     def truncate(self, path: str, size: int,
                  _expect_ino: int | None = None) -> None:
-        parent, name = self._parent_and_name(path)
+        chain: list[int] = []
+        parent, name = self._parent_and_name(path, chain=chain)
         ent = self._walk(self._split(path))
         if ent["type"] != "file":
             raise IsADir(path)
         self._expect(ent, path, _expect_ino)
         self._check_caps(ent["ino"], write=True,
                          what=f"truncate {path}")
-        chain: list[int] = []
-        self._parent_and_name(path, chain=chain)
         self._check_quota(chain,
                           add_bytes=max(0, size - ent["size"]))
         if ent["size"] == 0 and size > 0:

@@ -240,3 +240,15 @@ class TestQuotas:
             fs.set_quota("/d", max_bytes=0)
         with pytest.raises(Exception, match="positive"):
             fs.set_quota("/d", max_files=True)
+
+    def test_replace_rename_charges_net_growth(self):
+        """POSIX replace-rename into an exactly-full realm must not
+        spuriously EDQUOT: the replaced file's size is credited."""
+        c, fs = mkfs()
+        fs.mkdir("/free")
+        fs.mkdir("/limited")
+        fs.set_quota("/limited", max_bytes=1000)
+        fs.create("/limited/f", data=b"a" * 900)
+        fs.create("/free/g", data=b"b" * 900)
+        fs.rename("/free/g", "/limited/f")     # net 0: allowed
+        assert fs.read("/limited/f") == b"b" * 900

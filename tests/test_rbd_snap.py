@@ -335,3 +335,74 @@ class TestDiff:
         img.write(0, b"A" * 4096)
         img.snap_create("s1")
         assert img.diff_iterate(from_snap="s1") == []
+
+
+class TestExportDiff:
+    """Incremental backup round-trip (ref: rbd export-diff /
+    import-diff stream semantics)."""
+
+    def test_full_then_incremental_chain(self):
+        c, io, rbd = make_rbd()
+        src = rbd.create("src", 4096)
+        src.write(0, b"base-" * 100)
+        # full export-diff -> fresh replica
+        dst = rbd.create("dst", 4096)
+        dst.import_diff(src.export_diff())
+        assert dst.read(0, 4096) == src.read(0, 4096)
+        # snapshot BOTH sides to anchor the incremental chain
+        src.snap_create("s1")
+        dst.snap_create("s1")
+        src.write(1024, b"delta-one!" * 10)
+        src.write(3000, b"tail")
+        inc = src.export_diff(from_snap="s1")
+        dst.import_diff(inc)
+        assert dst.read(0, 4096) == src.read(0, 4096)
+        # the incremental carries only changed pieces, not the image
+        assert len(inc) < 4096
+
+    def test_import_refuses_broken_chain(self):
+        c, io, rbd = make_rbd()
+        src = rbd.create("a", 2048)
+        src.write(0, b"x" * 2048)
+        src.snap_create("anchor")
+        src.write(0, b"y" * 100)
+        inc = src.export_diff(from_snap="anchor")
+        dst = rbd.create("b", 2048)     # has NO 'anchor' snap
+        with pytest.raises(KeyError, match="anchor"):
+            dst.import_diff(inc)
+
+    def test_diff_resizes_destination(self):
+        c, io, rbd = make_rbd()
+        src = rbd.create("grow", 1024)
+        src.write(0, b"1" * 1024)
+        src.snap_create("s")
+        src.resize(4096)
+        src.write(2048, b"2" * 512)
+        dst = rbd.create("copy", 1024)
+        dst.import_diff(src.export_diff())     # full, at new size
+        assert dst.size() == 4096
+        assert dst.read(0, 4096) == src.read(0, 4096)
+
+    def test_full_export_of_clone_includes_parent_data(self):
+        """A full export-diff of a CLONE must serialize the parent-
+        inherited bytes too — the replica has no parent to fall back
+        to."""
+        c, io, rbd = make_rbd()
+        p = rbd.create("parent", 2048)
+        p.write(0, b"P" * 2048)
+        p.snap_create("base")
+        p.snap_protect("base")
+        child = rbd.clone("parent", "base", "child")
+        child.write(256, b"C" * 128)           # one child-owned piece
+        dst = rbd.create("replica", 2048)
+        dst.import_diff(child.export_diff())
+        assert dst.read(0, 2048) == child.read(0, 2048)
+
+    def test_export_diff_rejects_at_snap_mode(self):
+        c, io, rbd = make_rbd()
+        img = rbd.create("x", 1024)
+        img.write(0, b"d" * 100)
+        img.snap_create("s")
+        img.set_snap("s")
+        with pytest.raises(ValueError, match="live head"):
+            img.export_diff()

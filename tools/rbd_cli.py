@@ -199,6 +199,25 @@ def cmd_import(st: State, a) -> None:
     print(f"imported {a.src} -> {a.image} ({len(data)} bytes)")
 
 
+def cmd_export_diff(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    img = Image(st.rbd, a.image)
+    blob = img.export_diff(from_snap=a.from_snap)
+    with open(a.dest, "wb") as f:
+        f.write(blob)
+    print(f"export-diff {a.image}"
+          + (f" (from @{a.from_snap})" if a.from_snap else " (full)")
+          + f" -> {a.dest} ({len(blob)} bytes)")
+
+
+def cmd_import_diff(st: State, a) -> None:
+    from ceph_tpu.client.rbd import Image
+    with open(a.src, "rb") as f:
+        blob = f.read()
+    written = Image(st.rbd, a.image).import_diff(blob)
+    print(f"import-diff {a.src} -> {a.image} ({written} bytes applied)")
+
+
 def cmd_diff(st: State, a) -> None:
     from ceph_tpu.client.rbd import Image
     img = Image(st.rbd, a.image)
@@ -239,6 +258,11 @@ def main(argv=None) -> None:
     p.add_argument("image")
     p = sub.add_parser("diff"); p.add_argument("image")
     p.add_argument("--from-snap", dest="from_snap")
+    p = sub.add_parser("export-diff"); p.add_argument("image")
+    p.add_argument("dest"); p.add_argument("--from-snap",
+                                           dest="from_snap")
+    p = sub.add_parser("import-diff"); p.add_argument("src")
+    p.add_argument("image")
 
     a = ap.parse_args(argv)
     st = State(a.state)
@@ -247,7 +271,9 @@ def main(argv=None) -> None:
          "rm": cmd_rm, "resize": cmd_resize, "snap": cmd_snap,
          "clone": cmd_clone, "flatten": cmd_flatten,
          "children": cmd_children, "export": cmd_export,
-         "import": cmd_import, "diff": cmd_diff}[a.cmd](st, a)
+         "import": cmd_import, "diff": cmd_diff,
+         "export-diff": cmd_export_diff,
+         "import-diff": cmd_import_diff}[a.cmd](st, a)
     except (KeyError, FileExistsError, FileNotFoundError,
             ValueError) as e:
         raise SystemExit(f"rbd: {type(e).__name__}: {e}")
