@@ -510,9 +510,11 @@ class Image:
         if hdr["parent"] and from_snap is None:
             # a FULL export of a clone must include parent-inherited
             # data (diff_iterate reports child-materialized pieces
-            # only); one whole-image run through the clone-aware read
-            # path captures everything
-            runs = [(0, hdr["size"])] if hdr["size"] else []
+            # only): union the allocated pieces of EVERY layer down
+            # the parent chain (clipped to each overlap) instead of
+            # serializing the whole image — sparse clones stay sparse
+            # in the stream
+            runs = self._exported_runs(hdr, hdr["size"])
         else:
             runs = self.diff_iterate(from_snap=from_snap)
         e = Encoder().start(1, 1)
@@ -522,6 +524,32 @@ class Image:
         for off, ln in runs:
             e.u64(off).blob(self.read(off, ln))
         return e.finish().bytes()
+
+    def _exported_runs(self, hdr: dict, upto: int) -> list[tuple]:
+        """Merged (offset, len) runs where data may exist for this
+        image view: own allocated pieces plus, for clones, the parent
+        chain's allocated pieces clipped to the overlap."""
+        runs: list[tuple[int, int]] = []
+        if upto:
+            pieces = {q for q, _, _, _ in
+                      self._striper._extents(0, upto)}
+            for q in sorted(pieces):
+                if self._piece_exists(q):
+                    runs.extend(self._piece_extents(q, upto))
+        p = hdr["parent"]
+        if p is not None:
+            parent = self._parent_image(hdr)
+            ov = min(p["overlap"], upto)
+            runs.extend(parent._exported_runs(parent._hdr(), ov))
+        runs.sort()
+        merged: list[tuple[int, int]] = []
+        for off, ln in runs:
+            if merged and off <= merged[-1][0] + merged[-1][1]:
+                end = max(merged[-1][0] + merged[-1][1], off + ln)
+                merged[-1] = (merged[-1][0], end - merged[-1][0])
+            else:
+                merged.append((off, ln))
+        return merged
 
     def import_diff(self, blob: bytes) -> int:
         """Apply an export-diff stream: the from-snap (when the stream

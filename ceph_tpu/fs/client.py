@@ -591,6 +591,12 @@ class FsClient:
             # dst link rewrites the dentry and the src unlink then
             # REMOVES it — the file vanishes and its data orphans.
             return
+        # ONE dst resolution serves both the quota credit and the
+        # replace/EEXIST checks below
+        try:
+            dent = self._walk(self._split(dst))
+        except FileNotFoundError:
+            dent = None
         if sparent["ino"] != dparent["ino"]:
             # a CROSS-directory move must satisfy the destination's
             # quota realms (the reference checks quota on cross-realm
@@ -603,13 +609,9 @@ class FsClient:
             # a replace-rename frees the dst file it overwrites: the
             # NET growth is what quota enforces (POSIX replace into an
             # exactly-full realm must not spuriously EDQUOT)
-            try:
-                dent0 = self._walk(self._split(dst))
-                if dent0["type"] == "file":
-                    mv_bytes -= dent0["size"]
-                    mv_files -= 1
-            except FileNotFoundError:
-                pass
+            if dent is not None and dent["type"] == "file":
+                mv_bytes -= dent["size"]
+                mv_files -= 1
             # ancestors COMMON to src and dst see no net change from
             # the move — charging them would spuriously EDQUOT an
             # exactly-full shared realm
@@ -623,8 +625,7 @@ class FsClient:
             # way)
             self._check_caps(ent["ino"], write=True,
                              what=f"rename {src}")
-        try:
-            dent = self._walk(self._split(dst))
+        if dent is not None:
             if dent["type"] == "dir":
                 raise FsError(f"EEXIST: {dst} is a directory")
             if ent["type"] == "dir":
@@ -635,7 +636,7 @@ class FsClient:
             self._check_caps(dent["ino"], write=True,
                              what=f"rename over {dst}")
             old_ino = dent["ino"]
-        except FileNotFoundError:
+        else:
             old_ino = None
         self._link(dparent["ino"], dname, ent, replace=True)
         self._unlink(sparent["ino"], sname)

@@ -2832,9 +2832,12 @@ class StandaloneCluster:
         raise TimeoutError(f"standalone: {what} not reached "
                            f"in {timeout}s")
 
-    def wait_for_down(self, osd: int, timeout: float = 15.0) -> None:
+    def wait_for_down(self, osd: int, timeout: float = 30.0) -> None:
         """Emergent failure detection: pings miss -> reports -> quorum
-        commit -> everyone's map shows the OSD down."""
+        commit -> everyone's map shows the OSD down. The default
+        budget allows for a loaded host (thread starvation stretches
+        every stage; the suite flaked at 15s under full-suite load
+        while passing x3 idle)."""
         self._wait(
             lambda: all(d.osdmap is not None
                         and not d.osdmap.osd_up[osd]
