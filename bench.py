@@ -78,6 +78,12 @@ T0 = time.monotonic()
 STATE = {"extra": {}, "errors": [], "backend": None, "tpu_ok": False}
 _EMITTED = threading.Event()       # wakes the watchdog's sleep
 _EMIT_LOCK = threading.Lock()      # serializes the one emission
+# Child mode (round 5): the 03:17Z r4 capture lost crush AND wedged the
+# tunnel for everything after it when the TPU worker crashed mid-section.
+# Risky sections therefore run in SUBPROCESSES with their own JAX client:
+# a worker crash kills only the child; the parent retries with a fresh
+# client (and a smaller working set) inside the same live window.
+CHILD_SECTION = os.environ.get("BENCH_SECTION_ONLY") or None
 
 
 def log(msg: str) -> None:
@@ -113,6 +119,17 @@ def emit(note: str | None = None) -> None:
             return
         _EMITTED.set()
         snap = _snapshot_state()
+    if CHILD_SECTION:
+        # child-mode line: consumed by the parent bench, not the driver
+        print(json.dumps({
+            "child": CHILD_SECTION,
+            "tpu_ok": snap["tpu_ok"],
+            "backend": snap["backend"],
+            "extra": snap["extra"],
+            "errors": snap["errors"],
+            "note": note,
+        }), flush=True)
+        return
     extra = snap["extra"]
     enc = extra.get("encode_gbps_by_impl") or {}
     ok = bool(enc) and snap["tpu_ok"]
@@ -481,7 +498,7 @@ def bench_crush(n_objects=int(os.environ.get("BENCH_CRUSH_OBJECTS",
     # whole batch loop runs inside ONE jitted lax.scan with
     # device-generated seeds and an XOR digest carry (scan_rule):
     # per-dispatch tunnel RTT (~2s observed) otherwise dominates.
-    sub = 10_000
+    sub = int(os.environ.get("BENCH_CRUSH_SUB", "10000"))
     if STATE["tpu_ok"]:
         nb2 = max(20, min(1000, n_objects // sub))
     else:
@@ -506,6 +523,9 @@ def bench_crush(n_objects=int(os.environ.get("BENCH_CRUSH_OBJECTS",
         f"{n_osds} OSDs (t({nb1})={t1:.2f}s t({nb2})={t2:.2f}s) = "
         f"{rate / 1e6:.2f} M placements/s")
     STATE["extra"]["crush_placements_per_s"] = round(rate)
+    STATE["extra"]["crush_config"] = {
+        "sub": sub, "n_batches": nb2, "n_osds": n_osds,
+        "numrep": K + M}
     # BASELINE config #5 is 10M objects verbatim: run it in full when
     # the measured rate says it fits the deadline comfortably
     full = 10_000_000
@@ -700,24 +720,19 @@ def bench_lrc_repair(k=8, m=4, l=4):
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
     e2e_gbps = B * chunk / best / 1e9
-    # device-resident slope: the local-group repair is ONE static GF
-    # matrix applied to the l helper chunks — bench it exactly like
-    # encode (device-generated pool, scan pipeline, digest sync), so
-    # the number measures the kernel, not the tunnel (r4: the first
-    # TPU capture recorded 0.004 GB/s because every timed call staged
-    # ~32 MiB of numpy through the tunnel)
-    from ceph_tpu.gf.numpy_ref import decode_matrix
-    from ceph_tpu.ops.rs_kernels import make_encoder
-    plan, _, _ = coder._repair_plan({lost}, set(avail))
-    layer, _missing = plan[0]
-    rs = layer.coder
-    surv_local = [layer.local_id(p) for p in helpers][:rs.k]
-    D = decode_matrix(rs.matrix, [layer.local_id(lost)], rs.k, surv_local)
-    fn = make_encoder(D, rs.impl, bucket_batch=False)
-    got = np.asarray(fn(full[:, helpers[:rs.k]]))[:, 0]
+    # device-resident slope through the SAME fused path ECBackend
+    # recovery launches (coder.batch_decoder — r5: the layered plan
+    # collapses to one static GF matrix via ec/linearize), benched
+    # exactly like encode (device-generated pool, scan pipeline,
+    # digest sync) so the number measures the kernel, not the tunnel
+    fn = coder.batch_decoder([lost], helpers)
+    if fn is None:
+        raise AssertionError("lrc batch_decoder unavailable for "
+                             f"lost={lost} helpers={helpers}")
+    got = np.asarray(fn(full[:, helpers]))[:, 0]
     if not (got == full[:, lost]).all():
         raise AssertionError("lrc device repair fn != original")
-    pool = _device_pool((SUB, len(surv_local), chunk), 31)
+    pool = _device_pool((SUB, len(helpers), chunk), 31)
     run = _pipeline(fn, pool)
     gbps, t1, t2 = _slope(run, SUB * chunk)   # rebuilt bytes/iter
     res = {"repair_gbps": round(gbps, 3), "helper_chunks": ratio,
@@ -766,22 +781,18 @@ def bench_clay_repair(k=8, m=4, d=11):
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
     e2e_gbps = B * chunk / best / 1e9
-    # device-resident slope on the MSR repair matrix-apply (see the
-    # LRC section comment): the whole repair is one cached GF matrix D
-    # over the stacked repair-plane sub-chunks
-    from ceph_tpu.ops.rs_kernels import make_encoder
+    # device-resident slope through the SAME fused path ECBackend
+    # recovery launches (coder.batch_decoder: full helper stack in,
+    # repair-plane selection ON DEVICE, one matrix-apply out)
     helpers = sorted(need)
-    D, planes = coder.repair_plan_matrix(lost, helpers)
-    beta = len(planes)
-    s = chunk // sub_count
-    fn = make_encoder(D, getattr(coder, "impl", "mxu"), bucket_batch=False)
-    stacked = np.stack([coder._split(full[:, h])[:, planes, :]
-                        for h in helpers], axis=1)
-    stacked = stacked.reshape(B, len(helpers) * beta, s)
-    got = np.asarray(fn(stacked)).reshape(B, chunk)
+    fn = coder.batch_decoder([lost], helpers)
+    if fn is None:
+        raise AssertionError("clay batch_decoder unavailable for "
+                             f"lost={lost} helpers={helpers}")
+    got = np.asarray(fn(full[:, helpers]))[:, 0]
     if not (got == full[:, lost]).all():
         raise AssertionError("clay device repair fn != original")
-    pool = _device_pool((SUB, len(helpers) * beta, s), 32)
+    pool = _device_pool((SUB, len(helpers), chunk), 32)
     run = _pipeline(fn, pool)
     gbps, t1, t2 = _slope(run, SUB * chunk)   # rebuilt bytes/iter
     res = {"repair_gbps": round(gbps, 3),
@@ -800,6 +811,123 @@ def bench_clay_repair(k=8, m=4, d=11):
 
 
 _TRANSIENT = ("remote_compile", "HTTP 500", "DEADLINE_EXCEEDED")
+
+# keys that prove a child section actually measured something
+_SECTION_DONE_KEYS = {
+    "recovery": ("recovery_objects_per_s",),
+    "crush": ("crush_placements_per_s",),
+    "lrc": ("lrc_repair_k8m4l4",),
+    "clay": ("clay_repair_k8m4d11",),
+}
+
+# per-attempt env overrides: attempt 1 shrinks the working set (the
+# known crash modes are compile/working-set pressure, not flakes)
+_SECTION_LADDER = {
+    "recovery": ({}, {"BENCH_RECOVERY_BATCH": "2"}),
+    "crush": ({}, {"BENCH_CRUSH_SUB": "5000"}),
+}
+
+
+def _section_isolated(name: str, skip: set, fn, *, timeout: float,
+                      **kw):
+    """Run a crash-prone section in a subprocess with its own JAX
+    client (TPU path only — the CPU fallback cannot crash a worker and
+    subprocessing it would just pay jit cache misses twice). A child
+    that dies, hangs, or comes back CPU-only is retried once with a
+    smaller working set; its measured extras merge into STATE."""
+    if not STATE["tpu_ok"]:
+        return _section(name, skip, fn, **kw)
+    if name in skip:
+        log(f"section {name}: skipped via BENCH_SKIP")
+        return None
+    ladder = _SECTION_LADDER.get(name, ({},))
+    for attempt, overrides in enumerate(ladder):
+        budget = DEADLINE - (time.monotonic() - T0) - 45.0
+        if budget < 90.0:
+            fail(f"section {name}", "no deadline budget left for child")
+            return None
+        child_timeout = min(timeout, budget)
+        env = dict(os.environ)
+        env.update(overrides)
+        env["BENCH_SECTION_ONLY"] = name
+        env["BENCH_TPU_WAIT"] = "120"
+        env["BENCH_DEADLINE"] = str(int(child_timeout - 15.0))
+        log(f"section {name}: child attempt {attempt} "
+            f"(timeout {child_timeout:.0f}s, overrides {overrides})")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=child_timeout,
+                env=env)
+            sys.stderr.write(r.stderr)
+            payload = None
+            for line in reversed(r.stdout.strip().splitlines() or []):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict):   # a noisy child can print
+                    payload = cand           # bare JSON scalars too
+                    break
+            if payload is None:
+                raise RuntimeError(f"child rc={r.returncode}, no JSON")
+        except subprocess.TimeoutExpired:
+            fail(f"section {name}",
+                 f"child hung > {child_timeout:.0f}s (worker wedge?)")
+            time.sleep(45.0)   # let a crashed worker restart
+            continue
+        except Exception as e:   # noqa: BLE001 — isolate the child
+            fail(f"section {name}", e)
+            time.sleep(30.0)
+            continue
+        for err in payload.get("errors", []):
+            STATE["errors"].append(f"[child {name}] {err}"[:300])
+        if not payload.get("tpu_ok"):
+            fail(f"section {name}",
+                 f"child fell back to {payload.get('backend')}; "
+                 f"not merging CPU numbers into a TPU artifact")
+            time.sleep(30.0)
+            continue
+        merged = []
+        for k, v in payload.get("extra", {}).items():
+            if k not in STATE["extra"]:
+                STATE["extra"][k] = v
+                merged.append(k)
+        done = all(k in STATE["extra"]
+                   for k in _SECTION_DONE_KEYS.get(name, ()))
+        log(f"section {name}: child merged {merged} done={done}")
+        if done:
+            return True
+    return None
+
+
+def _child_main(name: str) -> None:
+    """BENCH_SECTION_ONLY mode: acquire a backend, run ONE section,
+    print the child JSON line (see emit)."""
+    _watchdog()
+    global SUB, N2
+    try:
+        plat = acquire_backend()
+        STATE["backend"] = plat
+        STATE["tpu_ok"] = plat not in (None, "cpu")
+        if plat == "cpu":
+            _force_cpu()
+            SUB = min(SUB, 4)
+            N2 = min(N2, 10)
+        import jax
+        log(f"child[{name}] backend={jax.default_backend()}")
+        fns = {"encode": lambda: bench_encode_impls(["mxu", "bitlinear"]),
+               "decode": lambda: bench_decode(["mxu", "bitlinear"]),
+               "cpu": bench_cpu_native,
+               "lrc": bench_lrc_repair,
+               "clay": bench_clay_repair,
+               "recovery": bench_recovery,
+               "crush": bench_crush}
+        _section(name, set(), fns[name])
+    except BaseException as e:   # noqa: BLE001 — the line must print
+        fail(f"child {name}", e)
+    emit()
+    sys.exit(0)
 
 
 def _section(name: str, skip: set, fn, *a, **kw):
@@ -826,6 +954,9 @@ def _section(name: str, skip: set, fn, *a, **kw):
 
 
 def main() -> None:
+    if CHILD_SECTION:
+        _child_main(CHILD_SECTION)
+        return
     _watchdog()
     global SUB, N2
     try:
@@ -853,14 +984,13 @@ def main() -> None:
         _section("cpu", skip, bench_cpu_native)
         _section("lrc", skip, bench_lrc_repair)
         _section("clay", skip, bench_clay_repair)
-        # recovery next-to-last: its fused compile is the one that can
-        # crash the remote compile helper (see bench_recovery) — only
-        # crush is downstream of it
-        _section("recovery", skip, bench_recovery)
-        # crush runs LAST: its kernel crashed the TPU worker process in
-        # the first live capture (2026-07-30), and a dead worker fails
-        # every section after it — ordering contains the blast radius
-        _section("crush", skip, bench_crush)
+        # recovery + crush are the two sections that have crashed the
+        # remote compile helper / TPU worker in live captures; they run
+        # LAST and in SUBPROCESSES (fresh JAX client each) so a crash
+        # costs one child, not the window (r4: the 03:17Z crush crash
+        # wedged the tunnel and forfeited both numbers)
+        _section_isolated("recovery", skip, bench_recovery, timeout=600.0)
+        _section_isolated("crush", skip, bench_crush, timeout=450.0)
     except BaseException as e:    # noqa: BLE001 — the line must print
         fail("main", e)
     emit()

@@ -215,32 +215,60 @@ class AuthService:
     AuthMonitor)."""
 
     MAX_PENDING = 256
+    MAX_PENDING_PER_ENTITY = 8
+    PENDING_TTL = 60.0
 
     def __init__(self, ks: KeyServer):
         self.ks = ks
-        # (entity, client_challenge) -> server challenge: keyed by the
-        # PAIR so concurrent logins of one entity (two clients sharing
-        # client.admin) can't clobber each other's outstanding
-        # challenge
-        self._pending: dict[tuple[str, str], bytes] = {}
+        # (entity, client_challenge) -> (server challenge, issued-at):
+        # keyed by the PAIR so concurrent logins of one entity (two
+        # clients sharing client.admin) can't clobber each other's
+        # outstanding challenge. Eviction is per-entity + by age — a
+        # spammer repeating hello() for one known entity name only
+        # evicts its OWN challenges, never another entity's in-flight
+        # login (the r4 advisor's bounded-DoS finding)
+        self._pending: dict[tuple[str, str], tuple[bytes, float]] = {}
+
+    def _expire_pending(self, now: float) -> None:
+        dead = [k for k, (_, ts) in self._pending.items()
+                if now - ts > self.PENDING_TTL]
+        for k in dead:
+            del self._pending[k]
 
     # step 2
     def hello(self, entity: str, client_challenge: bytes) -> bytes:
         self.ks.entity_secret(entity)          # unknown entity -> err
+        now = self.ks.now()
+        self._expire_pending(now)
+        mine = [k for k in self._pending if k[0] == entity]
+        while len(mine) >= self.MAX_PENDING_PER_ENTITY:
+            self._pending.pop(mine.pop(0), None)
+        if len(self._pending) >= self.MAX_PENDING:
+            # global pressure: evict the oldest challenge of the
+            # entity holding the MOST pending entries (under attack
+            # that is an attacker name at its per-entity cap; a
+            # legitimate login holds 1). Hard-rejecting here would
+            # itself be a login DoS for uninvolved entities.
+            by_entity: dict[str, list] = {}
+            for k in self._pending:
+                by_entity.setdefault(k[0], []).append(k)
+            heaviest = max(by_entity.values(), key=len)
+            self._pending.pop(heaviest[0], None)
         sc = os.urandom(16)
-        while len(self._pending) >= self.MAX_PENDING:
-            self._pending.pop(next(iter(self._pending)))
-        self._pending[(entity, client_challenge.hex())] = sc
+        self._pending[(entity, client_challenge.hex())] = (sc, now)
         return sc
 
     # steps 3-4
     def authenticate(self, entity: str, client_challenge: bytes,
                      proof: bytes) -> dict:
         secret = self.ks.entity_secret(entity)
-        sc = self._pending.pop(
+        entry = self._pending.pop(
             (entity, client_challenge.hex()), None)  # single-use
-        if sc is None:
+        if entry is None:
             raise AuthError("no outstanding challenge (replay?)")
+        sc, issued = entry
+        if self.ks.now() - issued > self.PENDING_TTL:
+            raise AuthError("challenge expired")
         want = _hmac(secret, sc, client_challenge)
         if not hmac.compare_digest(want, proof):
             raise AuthError(f"bad proof for {entity!r}")

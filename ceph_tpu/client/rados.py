@@ -309,6 +309,16 @@ class RadosStriper:
         self.su = stripe_unit
         self.sc = stripe_count
         self.osz = object_size
+        # the size/hwm metadata update is a read-modify-write spanning
+        # two ops; concurrent aio writers to one striped object could
+        # interleave and lose a size extension. RLock: truncate holds
+        # it across its own RMW while its zeroing calls write()
+        self._meta_locks: dict[str, threading.RLock] = {}
+        self._meta_locks_guard = threading.Lock()
+
+    def _meta_lock(self, soid: str) -> threading.RLock:
+        with self._meta_locks_guard:
+            return self._meta_locks.setdefault(soid, threading.RLock())
 
     def _obj(self, soid: str, q: int) -> str:
         return f"{soid}.{q:016x}"
@@ -382,13 +392,14 @@ class RadosStriper:
             piece = arr[lpos - offset:lpos - offset + ln]
             self.io.write(self._obj(soid, q), piece, offset=ooff,
                           snapc=snapc)
-        try:
-            cur, hwm = self._read_meta(soid)
-        except KeyError:
-            cur = hwm = 0
-        new = max(cur, offset + len(arr))
-        if new != cur:
-            self._write_meta(soid, new, max(hwm, new), snapc=snapc)
+        with self._meta_lock(soid):
+            try:
+                cur, hwm = self._read_meta(soid)
+            except KeyError:
+                cur = hwm = 0
+            new = max(cur, offset + len(arr))
+            if new != cur:
+                self._write_meta(soid, new, max(hwm, new), snapc=snapc)
 
     def read(self, soid: str, length: int | None = None,
              offset: int = 0, snap: int | None = None) -> bytes:
@@ -421,15 +432,17 @@ class RadosStriper:
         contract; the reference trims/zeroes objects)."""
         if new_size < 0:
             raise ValueError(f"truncate to {new_size} < 0")
-        old, hwm = self._read_meta(soid)
-        if new_size < old:
-            pos = new_size
-            while pos < old:
-                n = min(zero_chunk, old - pos)
-                self.write(soid, b"\x00" * n, offset=pos, snapc=snapc)
-                pos += n
-        self._write_meta(soid, new_size, max(hwm, new_size),
-                         snapc=snapc)
+        with self._meta_lock(soid):
+            old, hwm = self._read_meta(soid)
+            if new_size < old:
+                pos = new_size
+                while pos < old:
+                    n = min(zero_chunk, old - pos)
+                    self.write(soid, b"\x00" * n, offset=pos,
+                               snapc=snapc)
+                    pos += n
+            self._write_meta(soid, new_size, max(hwm, new_size),
+                             snapc=snapc)
 
     def remove(self, soid: str, snapc: int = 0) -> None:
         # walk to the HIGH-WATER mark, not the current size: a
@@ -443,3 +456,5 @@ class RadosStriper:
             except KeyError:
                 pass  # sparse stripe: unit never written
         self.io.remove(self._meta(soid), snapc=snapc)
+        with self._meta_locks_guard:
+            self._meta_locks.pop(soid, None)

@@ -1781,6 +1781,10 @@ class MonDaemon:
                     m.osd_admin_out.add(osd)
                 else:
                     m.osd_weight[osd] = w
+                    # a positive admin reweight is an explicit 'in':
+                    # clear the sticky admin-out flag so a later
+                    # failure auto-out can be reversed by boot again
+                    m.osd_admin_out.discard(osd)
                     m._bump()
         self._commit(mutate)
 

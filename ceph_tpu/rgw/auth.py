@@ -36,7 +36,7 @@ import json
 import os
 import time
 
-from .gateway import Gateway, GatewayError
+from .gateway import Gateway, GatewayError, NoSuchBucket
 
 ALGO = "CEPH-TPU-HMAC-SHA256"
 REGION = "tpu"
@@ -160,10 +160,18 @@ class AuthedGateway:
         self._last_prune = 0.0
         # bucket -> owning uid, for buckets created THROUGH this
         # authed front (the rgw_bucket owner field's role). A bucket
-        # owned by another uid is denied outright; a bucket this
-        # front never saw created passes through to the gateway's
-        # own existence checks.
+        # owned by another uid — or with NO recorded owner (created
+        # on the raw Gateway, outside this auth layer) — is denied
+        # outright: unknown ownership must not read as world-access.
         self._owner: dict[str, str] = {}
+
+    def adopt_bucket(self, bucket: str, uid: str) -> None:
+        """Admin-plane ownership link for a bucket created outside
+        this auth layer (the radosgw-admin `bucket link` role) —
+        without it, unknown-owner buckets are denied to everyone."""
+        if bucket not in self._gw.list_buckets():
+            raise NoSuchBucket(bucket)
+        self._owner[bucket] = uid
 
     def call(self, access_key: str, date: str, signature: str,
              op: str, bucket: str = "", key: str = "",
@@ -198,9 +206,11 @@ class AuthedGateway:
             raise AccessDenied(f"unknown op {op!r}")
         if op not in ("list_buckets", "create_bucket"):
             owner = self._owner.get(bucket)
-            if owner is not None and owner != uid:
+            if owner != uid:
                 raise AccessDenied(
-                    f"bucket {bucket!r} is owned by another user")
+                    f"bucket {bucket!r} is owned by another user"
+                    if owner is not None else
+                    f"bucket {bucket!r} has no recorded owner")
         # 5. dispatch (explicit binding per op: the signed bucket/key
         # must never re-bind to a different parameter slot)
         gw = self._gw
@@ -229,11 +239,15 @@ class AuthedGateway:
             # the signed (bucket, key) is the DESTINATION; the source
             # bucket needs its own ownership check — authenticated
             # users must not read each other's buckets via copy
+            # unknown-owner sources (buckets made on the raw Gateway,
+            # outside this auth layer) are DENIED, not world-readable
             src_owner = self._owner.get(params["src_bucket"])
-            if src_owner is not None and src_owner != uid:
+            if src_owner != uid:
                 raise AccessDenied(
                     f"source bucket {params['src_bucket']!r} is "
-                    "owned by another user")
+                    "owned by another user" if src_owner is not None
+                    else f"source bucket {params['src_bucket']!r} "
+                    "has no recorded owner")
             return gw.copy_object(
                 params["src_bucket"], params["src_key"], bucket, key,
                 src_version_id=params.get("src_version_id"))

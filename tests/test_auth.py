@@ -218,3 +218,72 @@ class TestCaps:
         assert got["caps"]["osd"].allows("r", pool="default")
         assert not got["caps"]["osd"].allows("w", pool="default")
         assert not got["caps"]["osd"].allows("r", pool="other")
+
+
+class TestChallengeFlood:
+    """Pending-challenge eviction must be per-entity + by age: an
+    unauthenticated peer spamming hello() for one known entity name
+    must not evict another entity's in-flight login (r4 advisor
+    finding; ref: CephxServiceHandler server challenge lifetime)."""
+
+    def test_spam_does_not_evict_other_entity(self):
+        import os as _os
+
+        from ceph_tpu.auth.cephx import _hmac
+        clock, ks, auth, client, osd = setup_realm()
+        bob_secret = ks.create_entity("client.bob",
+                                      caps={"mon": "allow r"})
+        # bob's login is in flight: hello done, authenticate pending
+        bob_cc = _os.urandom(16)
+        bob_sc = auth.hello("client.bob", bob_cc)
+        # attacker spams hello() with a known entity name far past
+        # every cap — only the attacker entity's challenges may churn
+        for _ in range(4 * AuthService.MAX_PENDING):
+            auth.hello("client.admin", _os.urandom(16))
+        got = auth.authenticate("client.bob", bob_cc,
+                                _hmac(bob_secret, bob_sc, bob_cc))
+        assert "ticket" in got
+
+    def test_per_entity_cap(self):
+        clock, ks, auth, client, osd = setup_realm()
+        for _ in range(3 * AuthService.MAX_PENDING_PER_ENTITY):
+            auth.hello("client.admin", b"x" * 16)
+        mine = [k for k in auth._pending if k[0] == "client.admin"]
+        assert len(mine) <= AuthService.MAX_PENDING_PER_ENTITY
+
+    def test_challenge_age_expiry(self):
+        import os as _os
+
+        from ceph_tpu.auth.cephx import _hmac
+        clock, ks, auth, client, osd = setup_realm()
+        secret = ks.entities["client.admin"]["secret"]
+        cc = _os.urandom(16)
+        sc = auth.hello("client.admin", cc)
+        clock.t += AuthService.PENDING_TTL + 1
+        with pytest.raises(AuthError, match="expired|replay"):
+            auth.authenticate("client.admin", cc,
+                              _hmac(secret, sc, cc))
+
+    def test_global_pressure_evicts_heaviest_entity(self):
+        """With the global table full of attacker entries across many
+        known entity names, a fresh entity's login must still get a
+        challenge (eviction targets the heaviest entity, never
+        hard-rejects uninvolved logins)."""
+        import os as _os
+
+        from ceph_tpu.auth.cephx import _hmac
+        clock, ks, auth, client, osd = setup_realm()
+        names = [f"osd.{i}" for i in range(64)]
+        for n in names:
+            ks.create_entity(n, caps={"osd": "allow *"})
+        for _ in range(8):
+            for n in names:
+                auth.hello(n, _os.urandom(16))
+        assert len(auth._pending) >= AuthService.MAX_PENDING
+        fresh_secret = ks.create_entity("client.fresh",
+                                        caps={"mon": "allow r"})
+        cc = _os.urandom(16)
+        sc = auth.hello("client.fresh", cc)     # must not raise
+        got = auth.authenticate("client.fresh", cc,
+                                _hmac(fresh_secret, sc, cc))
+        assert "ticket" in got

@@ -181,3 +181,31 @@ def test_log_trimmed_rejoin_purges_deleted_objects():
     st = c.cluster.osd(victim)
     assert not st.exists(shard_cid(be.pg, 1), "doomed")
     assert be.shallow_scrub()["errors"] == []
+
+
+class TestStriperConcurrency:
+    def test_concurrent_writers_keep_size(self):
+        """The size/hwm metadata update is a read-modify-write; two
+        aio-pool threads extending one striped object must not lose a
+        size extension (r4 advisor finding — serialized per-soid)."""
+        import threading
+        c, io = make_io(pg_num=2)
+        st = RadosStriper(io, stripe_unit=32, stripe_count=2,
+                          object_size=64)
+        n_threads, per = 8, 256
+        barrier = threading.Barrier(n_threads)
+
+        def writer(i):
+            barrier.wait()
+            st.write("shared", bytes([i]) * per, offset=i * per)
+
+        ts = [threading.Thread(target=writer, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert st.size("shared") == n_threads * per
+        got = st.read("shared")
+        for i in range(n_threads):
+            assert got[i * per:(i + 1) * per] == bytes([i]) * per

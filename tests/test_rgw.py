@@ -547,7 +547,9 @@ class TestDelimiterListing:
         gw = self._seed()
         users = UserStore()
         access, secret = users.create_user("lister")
-        cl = S3Client(AuthedGateway(gw, users), access, secret)
+        agw = AuthedGateway(gw, users)
+        agw.adopt_bucket("b", "lister")   # raw-seeded bucket: link it
+        cl = S3Client(agw, access, secret)
         out = cl.list_objects("b", delimiter="/")
         assert out["common_prefixes"] == ["docs/", "logs/"]
 
@@ -616,3 +618,29 @@ class TestCopyObject:
         alice.create_bucket("alices2")
         alice.copy_object("alices", "secret", "alices2", "copy")
         assert alice.get_object("alices2", "copy") == b"classified"
+
+    def test_unknown_owner_source_denied(self):
+        """A bucket created on the raw Gateway (no recorded owner)
+        must not be world-readable through authed copy_object (r4
+        advisor finding)."""
+        from ceph_tpu.rgw import AuthedGateway, S3Client, UserStore
+        from ceph_tpu.rgw.auth import AccessDenied
+        c, gw = mk()
+        gw.create_bucket("orphan")
+        gw.put_object("orphan", "k", b"no owner on file")
+        users = UserStore()
+        a_ak, a_sk = users.create_user("alice")
+        agw = AuthedGateway(gw, users)
+        alice = S3Client(agw, a_ak, a_sk)
+        alice.create_bucket("mine")
+        with pytest.raises(AccessDenied, match="no recorded owner"):
+            alice.copy_object("orphan", "k", "mine", "grab")
+        # every other op on an orphan bucket is denied too — unknown
+        # ownership must not read as world-access
+        for attempt in (
+                lambda: alice.get_object("orphan", "k"),
+                lambda: alice.put_object("orphan", "k2", b"sneak"),
+                lambda: alice.delete_object("orphan", "k"),
+                lambda: alice.list_objects("orphan")):
+            with pytest.raises(AccessDenied, match="no recorded owner"):
+                attempt()

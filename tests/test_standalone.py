@@ -304,6 +304,24 @@ class TestOsdAdmin:
         for name, want in objs.items():
             assert cl.read(name) == want
 
+    def test_positive_reweight_clears_admin_out(self, cluster):
+        """A nonzero `osd reweight` is an explicit 'in': it must clear
+        the sticky admin-out flag so a later failure auto-out can be
+        reversed by boot again (r4 advisor finding; ref: AUTOOUT flag
+        vs admin weight semantics)."""
+        cl = cluster.client()
+        victim = cluster.osd_ids()[0]
+        cl.osd_out(victim)
+        live_map = next(m.osdmap for m in cluster.mons
+                        if m.osdmap is not None)
+        assert victim in live_map.osd_admin_out
+        cl.osd_reweight(victim, 0.75)
+        live_map = next(m.osdmap for m in cluster.mons
+                        if m.osdmap is not None
+                        and m.osdmap.osd_weight[victim] > 0)
+        assert victim not in live_map.osd_admin_out
+        assert live_map.osd_weight[victim] == int(0.75 * 0x10000)
+
     def test_reweight_commits(self, cluster):
         cl = cluster.client()
         victim = cluster.osd_ids()[1]
