@@ -841,6 +841,7 @@ def _section_isolated(name: str, skip: set, fn, *, timeout: float,
         log(f"section {name}: skipped via BENCH_SKIP")
         return None
     ladder = _SECTION_LADDER.get(name, ({},))
+    merged_prev: list = []
     for attempt, overrides in enumerate(ladder):
         budget = DEADLINE - (time.monotonic() - T0) - 45.0
         if budget < 90.0:
@@ -888,11 +889,17 @@ def _section_isolated(name: str, skip: set, fn, *, timeout: float,
                  f"not merging CPU numbers into a TPU artifact")
             time.sleep(30.0)
             continue
+        # a retry attempt ran under DIFFERENT overrides: drop the
+        # previous attempt's partial keys so one artifact never mixes
+        # measurements from two configs
+        for k in merged_prev:
+            STATE["extra"].pop(k, None)
         merged = []
         for k, v in payload.get("extra", {}).items():
             if k not in STATE["extra"]:
                 STATE["extra"][k] = v
                 merged.append(k)
+        merged_prev = merged
         done = all(k in STATE["extra"]
                    for k in _SECTION_DONE_KEYS.get(name, ()))
         log(f"section {name}: child merged {merged} done={done}")

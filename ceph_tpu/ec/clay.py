@@ -392,6 +392,47 @@ class Clay(ErasureCode):
         D, _ = self._affine_repair(int(failed_chunk), tuple(helper_chunks))
         return D, self._repair_planes(int(failed_chunk))
 
+    # -- device fast path ---------------------------------------------------
+
+    def batch_decoder(self, erasures: Sequence[int],
+                      survivors: Sequence[int]):
+        """Fused single-chunk MSR repair: one jittable fn mapping the
+        full helper stack (B, d, sl) to the rebuilt chunk (B, 1, sl).
+        The repair-plane selection (each helper contributes only
+        beta = sl/(d-k+1) bytes of GF math) happens ON DEVICE, so the
+        whole repair is one launch — the bandwidth-optimal plan from
+        repair_plan_matrix without the host-side sub-chunk staging.
+        Multi-loss falls back (returns None → decode_chunks). Ref:
+        ErasureCodeClay::repair / minimum_to_decode sub-chunk ranges."""
+        erasures = tuple(int(e) for e in erasures)
+        survivors = tuple(int(s) for s in survivors)
+        if len(erasures) != 1 or len(survivors) != self.d \
+                or self.impl == "ref":   # ref = numpy oracle, no
+            return None                  # device path to fuse into
+        key = ("bd", erasures, survivors)
+        fn = self._affine_cache.get(key)
+        if fn is None:
+            from ..ops.rs_kernels import make_encoder
+            lost = erasures[0]
+            D, planes = self.repair_plan_matrix(lost, survivors)
+            mfn = make_encoder(D, self.impl)
+            P = self.sub_chunk_count
+            beta = len(planes)
+            planes_idx = np.asarray(planes)
+
+            def fn(stack):                      # (B, H, sl) u8
+                B, H_, sl = stack.shape
+                if sl % P:
+                    raise ValueError(
+                        f"shard length {sl} not divisible into "
+                        f"{P} sub-chunks")
+                s = sl // P
+                sub = stack.reshape(B, H_, P, s)[:, :, planes_idx, :]
+                out = mfn(sub.reshape(B, H_ * beta, s))  # (B, P, s)
+                return out.reshape(B, 1, sl)
+            self._affine_cache[key] = fn
+        return fn
+
     # -- data paths ---------------------------------------------------------
 
     def _apply(self, D: np.ndarray, stacked: np.ndarray) -> np.ndarray:

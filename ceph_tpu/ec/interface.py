@@ -84,12 +84,15 @@ class ErasureCode(abc.ABC):
     def batch_decoder(self, erasures: Sequence[int],
                       survivors: Sequence[int]):
         """Optional device fast path: a jitted fn mapping a survivor
-        stack (B, k, L) uint8 (rows in `survivors` order) to the
-        rebuilt chunks (B, len(erasures), L) in `erasures` order,
-        suitable for fusing into larger jitted pipelines (recovery
-        CRC+decode+CRC in one launch). Only the first k survivors are
-        consumed. Returns None when the codec has no static-matrix form
-        for this pattern; callers must then use decode_chunks."""
+        stack (B, H, L) uint8 (rows in `survivors` order, H =
+        len(survivors)) to the rebuilt chunks (B, len(erasures), L) in
+        `erasures` order, suitable for fusing into larger jitted
+        pipelines (recovery CRC+decode+CRC in one launch). How many
+        rows are consumed is codec-specific (RS: the first k; LRC: all
+        — the local plan may need fewer than k rows total; Clay: all d
+        helpers, repair planes selected on device). Returns None when
+        the codec has no static-matrix form for this pattern; callers
+        must then use decode_chunks."""
         return None
 
     # -- availability ------------------------------------------------------

@@ -77,9 +77,21 @@ class ECBackend(PGBackend):
         if len(acting) != self.k + self.m:
             raise ValueError(
                 f"acting set size {len(acting)} != k+m={self.k + self.m}")
-        if self.coder.get_chunk_mapping() != list(range(self.k + self.m)):
-            raise ValueError("non-identity chunk mappings not supported "
-                             "by this backend yet")
+        # chunk mapping (ref: ErasureCodeInterface::get_chunk_mapping):
+        # shard slot s holds the coder's chunk id s, and mapping[j]
+        # names the slot carrying DENSE row j (encode_chunks' k data
+        # rows then m parity rows). Identity for RS; LRC interleaves
+        # data and local/global parity positions.
+        self.chunk_mapping = [int(p) for p in
+                              self.coder.get_chunk_mapping()]
+        if sorted(self.chunk_mapping) != list(range(self.k + self.m)):
+            raise ValueError(
+                f"chunk mapping {self.chunk_mapping} is not a "
+                f"permutation of 0..{self.k + self.m - 1}")
+        self.data_slots = self.chunk_mapping[:self.k]
+        self._perm = np.asarray(self.chunk_mapping)
+        self._identity_mapping = \
+            self.chunk_mapping == list(range(self.k + self.m))
         # pool-wide stripe geometry; round the requested chunk size up
         # through the coder's own alignment rule (clay needs sub-chunk
         # multiples, everything needs CHUNK_ALIGNMENT)
@@ -96,6 +108,15 @@ class ECBackend(PGBackend):
 
     def _shard_len(self, object_size: int) -> int:
         return self.sinfo.object_size_to_shard_size(object_size)
+
+    def _slots_from_dense(self, dense: np.ndarray) -> np.ndarray:
+        """(B, n, L) dense rows (k data then m parity, encode order)
+        -> per-slot rows: slot chunk_mapping[j] carries dense row j."""
+        if self._identity_mapping:
+            return dense
+        out = np.empty_like(dense)
+        out[:, self._perm] = dense
+        return out
 
     _expected_shard_len = _shard_len  # shallow-scrub size rule
 
@@ -138,7 +159,8 @@ class ECBackend(PGBackend):
             sl = self._shard_len(olen)
             data_shards = self.sinfo.object_to_shards(batch)  # (B, k, sl)
             parity = np.asarray(self.coder.encode_chunks(data_shards))
-            shards = np.concatenate([data_shards, parity], axis=1)
+            shards = self._slots_from_dense(
+                np.concatenate([data_shards, parity], axis=1))
             crcs = self._batched_hinfo_crcs(shards.reshape(-1, sl))
             crcs = crcs.reshape(len(group), self.n)
             for bi, (name, arr) in enumerate(group):
@@ -176,7 +198,7 @@ class ECBackend(PGBackend):
         B = len(names)
         avail = self._fresh_for(
             names, [s for s in range(self.n) if self.acting[s] not in dead])
-        lost_data = [s for s in range(self.k) if s not in avail]
+        lost_data = [s for s in self.data_slots if s not in avail]
 
         def read_window(s: int, nm: str, off: int, ln: int) -> np.ndarray:
             buf = np.zeros(ln, dtype=np.uint8)
@@ -187,25 +209,28 @@ class ECBackend(PGBackend):
                 buf[:len(got)] = got
             return buf
 
+        # window rows are DENSE data order (row j <-> slot
+        # data_slots[j]) so shards_to_object can consume it directly
+        dense_of = {s: j for j, s in enumerate(self.data_slots)}
         window = np.zeros((B, self.k, clen), dtype=np.uint8)
-        for s in range(self.k):
+        for j, s in enumerate(self.data_slots):
             if s in lost_data:
                 continue
             for bi, nm in enumerate(names):
-                window[bi, s] = read_window(s, nm, c0, clen)
+                window[bi, j] = read_window(s, nm, c0, clen)
         if not lost_data:
             return window
         helpers = sorted(self.coder.minimum_to_decode(lost_data, avail))
         if getattr(self.coder, "positionwise", True):
             # surviving data helpers are already in `window`; only read
             # parity helpers from the stores
-            stacks = {s: window[:, s] if s < self.k else
+            stacks = {s: window[:, dense_of[s]] if s in dense_of else
                       np.stack([read_window(s, nm, c0, clen)
                                 for nm in names])
                       for s in helpers}
             rec = self.coder.decode_chunks(lost_data, stacks)
             for s in lost_data:
-                window[:, s] = np.asarray(rec[s])
+                window[:, dense_of[s]] = np.asarray(rec[s])
         else:
             # decode whole chunks at each object's OLD shard length
             # (the non-positionwise path always uses c0 == 0 windows)
@@ -220,7 +245,8 @@ class ECBackend(PGBackend):
                 rec = self.coder.decode_chunks(lost_data, stacks)
                 ln = min(sl, clen)
                 for s in lost_data:
-                    window[idxs, s, :ln] = np.asarray(rec[s])[:, :ln]
+                    window[idxs, dense_of[s], :ln] = \
+                        np.asarray(rec[s])[:, :ln]
         return window
 
     def write_ranges(self, ops: list[tuple[str, int, bytes | np.ndarray]],
@@ -287,7 +313,8 @@ class ECBackend(PGBackend):
                     logical[bi, off - s0:off - s0 + len(arr)] = arr
             dshards = si.object_to_shards(logical)       # (B, k, clen)
             parity = np.asarray(self.coder.encode_chunks(dshards))
-            shards = np.concatenate([dshards, parity], axis=1)  # (B, n, clen)
+            shards = self._slots_from_dense(
+                np.concatenate([dshards, parity], axis=1))  # (B, n, clen)
 
             # apply sub-range writes + recompute full-shard hinfo on the
             # LIVE shards only (down shards are rebuilt by recovery;
@@ -347,7 +374,7 @@ class ECBackend(PGBackend):
         dead = dead_osds or set()
         alive = [s for s in range(self.n)
                  if self.acting[s] not in dead]
-        want = list(range(self.k))
+        want = list(self.data_slots)
         out: dict[str, np.ndarray] = {}
         # batched like recovery: stack equal-shard-length groups and
         # decode each group in ONE launch
@@ -384,7 +411,7 @@ class ECBackend(PGBackend):
                 idx = [group.index(n) for n in clean_group]
                 sub = {s: stacks[s][idx] for s in need}
                 rec = self.coder.decode(want, sub)
-                shards = np.stack([rec[i] for i in range(self.k)],
+                shards = np.stack([rec[s] for s in self.data_slots],
                                   axis=1)
                 objs = self.sinfo.shards_to_object(shards)
                 for oi, name in enumerate(clean_group):
@@ -404,7 +431,7 @@ class ECBackend(PGBackend):
         corrupt bytes and then durably launder them — the repair would
         rewrite the flagged shard from corrupt data under a freshly
         matching CRC that no future scrub could catch."""
-        want = list(range(self.k))
+        want = list(self.data_slots)
         bad = set(bad)
         while True:
             ok_shards = [s for s in avail if s not in bad]
@@ -427,7 +454,7 @@ class ECBackend(PGBackend):
             if newly_bad:
                 continue  # re-plan without the newly found rot
             rec = self.coder.decode(want, stacks)
-            shards = np.stack([rec[i] for i in range(self.k)], axis=1)
+            shards = np.stack([rec[s] for s in self.data_slots], axis=1)
             obj = self.sinfo.shards_to_object(shards)[0]
             self._repair_shards(name, obj, sorted(bad), sl)
             return obj[:self.object_sizes[name]]
@@ -438,7 +465,8 @@ class ECBackend(PGBackend):
         (the read-error / `ceph pg repair` writeback)."""
         dshards = self.sinfo.object_to_shards(logical[None, :])
         parity = np.asarray(self.coder.encode_chunks(dshards))
-        full = np.concatenate([dshards, parity], axis=1)[0]  # (n, sl)
+        full = self._slots_from_dense(
+            np.concatenate([dshards, parity], axis=1))[0]  # (n, sl)
         crcs = self._batched_hinfo_crcs(full[slots])
         for ci, s in enumerate(slots):
             hinfo = HashInfo(1, sl, [int(crcs[ci])])

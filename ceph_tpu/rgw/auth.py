@@ -215,8 +215,10 @@ class AuthedGateway:
         # must never re-bind to a different parameter slot)
         gw = self._gw
         if op == "list_buckets":
+            # strict owner match: orphan buckets (no recorded owner)
+            # must not appear in anyone's listing either
             return [b for b in gw.list_buckets()
-                    if self._owner.get(b, uid) == uid]
+                    if self._owner.get(b) == uid]
         if op == "create_bucket":
             out = gw.create_bucket(bucket)
             self._owner[bucket] = uid

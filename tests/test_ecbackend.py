@@ -226,3 +226,139 @@ class TestReviewRegressions:
         be.recover_shards([1], replacement_osds={1: 8})
         assert be.read_object("empty").size == 0
         assert be.deep_scrub()["inconsistent"] == []
+
+
+class TestFusedLrcClayRecovery:
+    """LRC/Clay recovery must take the fused CRC+decode launch path
+    (batch_decoder), not the generic per-launch decode_chunks loop —
+    exactly the codecs whose repair efficiency is their reason to
+    exist (r4 verdict item 2; ref: ErasureCodeLrc::minimum_to_decode,
+    ErasureCodeClay::decode_layered)."""
+
+    def _assert_fused_recovery(self, profile, lose_slot, n_objs=6,
+                               size=1500):
+        from ceph_tpu.ec.registry import factory
+        coder = factory(profile)
+        n = coder.get_chunk_count()
+        cluster = ShardSet()
+        be = ECBackend(profile, "1.0", list(range(n)), cluster,
+                       chunk_size=256)
+        objs = write_corpus(be, n=n_objs, size=size)
+        survivors = [s for s in range(n) if s != lose_slot]
+        helper = sorted(be.coder.minimum_to_decode([lose_slot],
+                                                   survivors))
+        assert be.coder.batch_decoder([lose_slot], helper) is not None
+        # the generic path must NOT be taken: a decode_chunks call
+        # during recovery means the fused path regressed
+        def boom(*a, **kw):
+            raise AssertionError("generic decode_chunks path taken")
+        orig = be.coder.decode_chunks
+        be.coder.decode_chunks = boom
+        try:
+            cluster.stores.pop(lose_slot)
+            counters = be.recover_shards([lose_slot],
+                                         replacement_osds={lose_slot: 90})
+        finally:
+            be.coder.decode_chunks = orig
+        assert counters["objects"] == n_objs
+        assert counters["hinfo_failures"] == 0
+        got = be.read_objects(list(objs))
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data, err_msg=name)
+        return helper
+
+    def test_lrc_single_loss_fused_and_local(self):
+        helper = self._assert_fused_recovery("plugin=lrc k=8 m=4 l=4",
+                                             lose_slot=1)
+        # the fused plan still honors locality: l helpers, not k
+        assert len(helper) == 4
+
+    def test_lrc_parity_loss_fused(self):
+        self._assert_fused_recovery("plugin=lrc k=8 m=4 l=4",
+                                    lose_slot=0)
+
+    def test_clay_single_loss_fused_d_helpers(self):
+        helper = self._assert_fused_recovery(
+            "plugin=clay k=4 m=2 d=5 impl=bitlinear", lose_slot=2)
+        assert len(helper) == 5
+
+    def test_clay_multi_loss_falls_back(self):
+        """Two losses have no static single-chunk repair matrix: the
+        generic path must still recover bit-exact."""
+        profile = "plugin=clay k=4 m=2 d=5 impl=bitlinear"
+        from ceph_tpu.ec.registry import factory
+        n = factory(profile).get_chunk_count()
+        cluster = ShardSet()
+        be = ECBackend(profile, "1.0", list(range(n)), cluster,
+                       chunk_size=256)
+        objs = write_corpus(be, n=4, size=1200)
+        cluster.stores.pop(0)
+        cluster.stores.pop(3)
+        be.recover_shards([0, 3], replacement_osds={0: 70, 3: 71})
+        got = be.read_objects(list(objs))
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data)
+
+
+class TestNonIdentityChunkMapping:
+    """LRC's interleaved data/parity positions exercise
+    get_chunk_mapping end-to-end: write, degraded read, RMW overwrite,
+    EIO repair — all under a non-identity slot permutation (r4 verdict
+    item 6; ref: ErasureCodeInterface::get_chunk_mapping)."""
+
+    PROFILE = "plugin=lrc k=4 m=2 l=3"
+
+    def _mk(self):
+        from ceph_tpu.ec.registry import factory
+        n = factory(self.PROFILE).get_chunk_count()
+        cluster = ShardSet()
+        be = ECBackend(self.PROFILE, "1.0", list(range(n)), cluster,
+                       chunk_size=256)
+        assert be.chunk_mapping != list(range(be.n)), \
+            "profile no longer exercises a non-identity mapping"
+        return be, cluster
+
+    def test_write_read_roundtrip(self):
+        be, _ = self._mk()
+        objs = write_corpus(be, n=6, size=1100)
+        got = be.read_objects(list(objs))
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data, err_msg=name)
+
+    def test_degraded_read_data_slot_down(self):
+        be, cluster = self._mk()
+        objs = write_corpus(be, n=4, size=900)
+        # take down the slot carrying dense data row 0 (not slot 0 —
+        # under LRC's mapping they differ)
+        slot = be.data_slots[0]
+        got = be.read_objects(list(objs),
+                              dead_osds={be.acting[slot]})
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data, err_msg=name)
+
+    def test_rmw_overwrite_and_extend(self):
+        be, _ = self._mk()
+        rng = np.random.default_rng(9)
+        base = rng.integers(0, 256, 2000, np.uint8)
+        be.write_objects({"o": base})
+        patch = rng.integers(0, 256, 333, np.uint8)
+        be.write_at("o", 700, patch)
+        want = base.copy()
+        want[700:700 + 333] = patch
+        np.testing.assert_array_equal(be.read_objects(["o"])["o"], want)
+        tail = rng.integers(0, 256, 500, np.uint8)
+        be.write_at("o", 1900, tail)   # extends past the old end
+        want = np.concatenate([want[:1900], tail])
+        np.testing.assert_array_equal(be.read_objects(["o"])["o"], want)
+
+    def test_eio_repair_under_mapping(self):
+        be, cluster = self._mk()
+        objs = write_corpus(be, n=3, size=800)
+        slot = be.data_slots[1]
+        st = cluster.osd(be.acting[slot])
+        st.queue_transaction(Transaction().write(
+            shard_cid("1.0", slot), "obj1", 3, b"\xAA\xBB"))
+        got = be.read_objects(list(objs))
+        np.testing.assert_array_equal(got["obj1"], objs["obj1"])
+        assert be.eio_stats["read_eio"] >= 1
+        assert be.eio_stats["repaired"] >= 1

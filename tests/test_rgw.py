@@ -644,3 +644,5 @@ class TestCopyObject:
                 lambda: alice.list_objects("orphan")):
             with pytest.raises(AccessDenied, match="no recorded owner"):
                 attempt()
+        # and the orphan's name never shows in anyone's listing
+        assert alice.list_buckets() == ["mine"]
