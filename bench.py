@@ -587,26 +587,44 @@ def bench_recovery(objects=int(os.environ.get("BENCH_RECOVERY_OBJECTS",
     helper = sorted(be.coder.minimum_to_decode(dead, survivors))
     dev = _recovery_device_slope(be, objs, dead, helper, sl, fused_b)
     # -- end-to-end host path ----------------------------------------------
+    # COLD first call includes the fused program's jit compile (~6s on
+    # CPU, ~70s over the tunnel); the reference's objects/s has no
+    # compile in it (C++ compiled offline), so the steady-state WARM
+    # rate is the comparable number. Recover twice: the first call
+    # compiles + rebuilds, the second (different replacement OSDs, same
+    # shapes) hits every jit cache.
     for s in dead:
         cluster.stores.pop(be.acting[s], None)
-    repl = {s: 1000 + s for s in dead}
     t0 = time.perf_counter()
-    counters = be.recover_shards(dead, replacement_osds=repl,
+    counters = be.recover_shards(dead,
+                                 replacement_osds={s: 1000 + s
+                                                   for s in dead},
+                                 batch=fused_b)
+    cold_dt = time.perf_counter() - t0
+    for s in dead:
+        cluster.stores.pop(be.acting[s], None)
+    t0 = time.perf_counter()
+    counters = be.recover_shards(dead,
+                                 replacement_osds={s: 2000 + s
+                                                   for s in dead},
                                  batch=fused_b)
     dt = time.perf_counter() - t0
     e2e_rate = objects / dt
     e2e_gbps = counters["bytes"] / dt / 1e9
     log(f"recovery e2e: {counters['bytes'] >> 20} MiB rebuilt over "
         f"{objects} x {size >> 20} MiB objects ({lost} shards lost, "
-        f"fused batch {fused_b}) in {dt:.2f}s = {e2e_rate:.1f} "
-        f"objects/s, {e2e_gbps:.2f} GB/s")
+        f"fused batch {fused_b}) warm {dt:.2f}s = {e2e_rate:.1f} "
+        f"objects/s, {e2e_gbps:.2f} GB/s (cold incl. compile: "
+        f"{cold_dt:.2f}s = {objects / cold_dt:.1f} obj/s)")
     STATE["extra"]["recovery_objects_per_s"] = round(dev["objects_per_s"], 1)
     STATE["extra"]["recovery_rebuilt_gbps"] = dev["rebuilt_gbps"]
     STATE["extra"]["recovery_e2e"] = {
         "objects_per_s": round(e2e_rate, 1),
         "rebuilt_gbps": round(e2e_gbps, 3),
+        "cold_objects_per_s": round(objects / cold_dt, 1),
         "fused_batch": fused_b,
-        "timing": "host staging + tunnel included"}
+        "timing": "warm steady state (staging pipeline, no compile); "
+                  "cold includes jit compile"}
     return dev["objects_per_s"]
 
 

@@ -28,10 +28,12 @@ from the data shards (reconstructing the window from survivors when
 shards are down), overlays the new bytes, re-encodes the window in one
 batched launch, and emits per-shard sub-range writes.
 
-Object placement: shard i of an object lands on the OSD in slot i of
-the PG's acting set (the chunk->shard identity mapping); a lost OSD
-means one lost shard per object, which is exactly the recovery
-workload metric #2 in BASELINE.md measures (objects/s).
+Object placement: shard slot s of an object lands on the OSD in slot s
+of the PG's acting set and carries the coder's chunk id s; the coder's
+get_chunk_mapping() names which slots carry data vs parity (identity
+for RS, interleaved for LRC). A lost OSD means one lost shard per
+object, which is exactly the recovery workload metric #2 in BASELINE.md
+measures (objects/s).
 """
 
 from __future__ import annotations
@@ -556,9 +558,14 @@ class ECBackend(PGBackend):
         for hi, s in enumerate(helper):
             st = self._store(s)
             cid = shard_cid(self.pg, s)
-            for bi, name in enumerate(subgroup):
-                stack[bi, hi] = st.read(cid, name)
-                if want_hinfo:
+            batch_read = getattr(st, "read_batch", None)
+            if batch_read is not None:
+                batch_read(cid, subgroup, sl, out=stack[:, hi, :])
+            else:
+                for bi, name in enumerate(subgroup):
+                    stack[bi, hi] = st.read(cid, name)
+            if want_hinfo:
+                for bi, name in enumerate(subgroup):
                     hb = st.getattr(cid, name, HINFO_KEY)
                     exp[bi, hi] = HashInfo.from_bytes(hb).get_chunk_hash(0)
         return stack, exp
@@ -716,47 +723,101 @@ class ECBackend(PGBackend):
             self._writeback_rebuilt(lost, subgroup, rebuilt_all, crcs,
                                     sl, counters)
 
-        for sl, subgroup in jobs:
-            if dec_fn is None:
-                # generic path (clay/lrc): batched but not fused
-                stacks = {s: np.stack([self._store(s).read(
-                    shard_cid(self.pg, s), n) for n in subgroup])
-                    for s in helper}
-                bad_pairs: dict[str, set[int]] = {}
-                if verify_hinfo:
-                    for s in helper:
-                        crcs_s = self._batched_hinfo_crcs(stacks[s])
-                        for bi, name in enumerate(subgroup):
-                            hb = self._store(s).getattr(
-                                shard_cid(self.pg, s), name, HINFO_KEY)
-                            if HashInfo.from_bytes(hb).get_chunk_hash(0) \
-                                    != int(crcs_s[bi]):
-                                counters["hinfo_failures"] += 1
-                                bad_pairs.setdefault(name, set()).add(s)
-                rec = self.coder.decode_chunks(lost, stacks)
-                rebuilt_all = np.stack(
-                    [np.asarray(rec[s]) for s in lost], axis=1)
-                if bad_pairs:
-                    self._recover_fallback(lost, survivors, bad_pairs,
-                                           subgroup, rebuilt_all, counters)
-                crcs = self._batched_hinfo_crcs(
-                    rebuilt_all.reshape(-1, sl)).reshape(len(subgroup),
-                                                         len(lost))
-                self._writeback_rebuilt(lost, subgroup, rebuilt_all,
-                                        crcs, sl, counters)
-                continue
-            # fused path: stage, launch async, fetch one batch behind
-            with span("ecbackend.recover.stage"):
-                stack, exp = self._gather_helper_stack(
-                    helper, subgroup, sl, verify_hinfo)
-            with span("ecbackend.recover.launch"):
-                handles = self._fused_recover_fn(dec_fn, sl,
-                                                 verify_hinfo)(stack, exp)
-            pending.append((sl, subgroup, handles))
-            if len(pending) >= 2:
+        if dec_fn is not None and jobs:
+            # fused path, three-stage pipeline: a producer thread
+            # stages batch i+1 (store reads + hinfo parses, pure host
+            # work) WHILE batch i's launch computes on device and
+            # batch i-1's results write back — staging, compute and
+            # writeback all overlap (SURVEY §2.7 P5 both directions)
+            import queue as _queue
+            import threading as _threading
+            stageq: "_queue.Queue" = _queue.Queue(maxsize=2)
+            stage_err: list[BaseException] = []
+            stop = _threading.Event()
+
+            def _producer() -> None:
+                try:
+                    for sl_, subgroup_ in jobs:
+                        if stop.is_set():
+                            return
+                        with span("ecbackend.recover.stage"):
+                            stack_, exp_ = self._gather_helper_stack(
+                                helper, subgroup_, sl_, verify_hinfo)
+                        # bounded put that aborts if the consumer died
+                        # (a blocked put would pin staged batches and
+                        # leak this thread for the process lifetime)
+                        while not stop.is_set():
+                            try:
+                                stageq.put((sl_, subgroup_, stack_,
+                                            exp_), timeout=0.5)
+                                break
+                            except _queue.Full:
+                                continue
+                except BaseException as e:   # noqa: BLE001 — re-raised
+                    stage_err.append(e)      # in the consumer
+                finally:
+                    try:
+                        stageq.put_nowait(None)
+                    except _queue.Full:
+                        pass   # consumer is draining via `stop` anyway
+
+            t = _threading.Thread(target=_producer, daemon=True)
+            t.start()
+            try:
+                while True:
+                    item = stageq.get()
+                    if item is None:
+                        break
+                    sl, subgroup, stack, exp = item
+                    with span("ecbackend.recover.launch"):
+                        handles = self._fused_recover_fn(
+                            dec_fn, sl, verify_hinfo)(stack, exp)
+                    pending.append((sl, subgroup, handles))
+                    if len(pending) >= 2:
+                        complete(pending.pop(0))
+            finally:
+                stop.set()
+                while True:        # unblock a producer mid-put
+                    try:
+                        stageq.get_nowait()
+                    except _queue.Empty:
+                        break
+                t.join()
+            if stage_err:
+                raise stage_err[0]
+            while pending:
                 complete(pending.pop(0))
-        while pending:
-            complete(pending.pop(0))
+            self._mark_caught_up(lost, full_plan, provided)
+            return counters
+
+        # generic path (codecs without a static plan): batched per
+        # launch but not fused
+        for sl, subgroup in jobs:
+            stacks = {s: np.stack([self._store(s).read(
+                shard_cid(self.pg, s), n) for n in subgroup])
+                for s in helper}
+            bad_pairs: dict[str, set[int]] = {}
+            if verify_hinfo:
+                for s in helper:
+                    crcs_s = self._batched_hinfo_crcs(stacks[s])
+                    for bi, name in enumerate(subgroup):
+                        hb = self._store(s).getattr(
+                            shard_cid(self.pg, s), name, HINFO_KEY)
+                        if HashInfo.from_bytes(hb).get_chunk_hash(0) \
+                                != int(crcs_s[bi]):
+                            counters["hinfo_failures"] += 1
+                            bad_pairs.setdefault(name, set()).add(s)
+            rec = self.coder.decode_chunks(lost, stacks)
+            rebuilt_all = np.stack(
+                [np.asarray(rec[s]) for s in lost], axis=1)
+            if bad_pairs:
+                self._recover_fallback(lost, survivors, bad_pairs,
+                                       subgroup, rebuilt_all, counters)
+            crcs = self._batched_hinfo_crcs(
+                rebuilt_all.reshape(-1, sl)).reshape(len(subgroup),
+                                                     len(lost))
+            self._writeback_rebuilt(lost, subgroup, rebuilt_all,
+                                    crcs, sl, counters)
         self._mark_caught_up(lost, full_plan, provided)
         return counters
 

@@ -213,6 +213,22 @@ class MemStore:
             return o.data[offset:].copy()
         return o.data[offset:offset + length].copy()
 
+    def read_batch(self, cid: str, oids: list[str], length: int,
+                   out: np.ndarray | None = None) -> np.ndarray:
+        """(len(oids), length) stack of equal-length objects in one
+        copy each (the recovery staging path reads B objects per shard;
+        per-object read() would copy twice — once into the temporary,
+        once into the caller's stack). Pass `out` (any (len(oids),
+        length) uint8 view) to fill the caller's buffer directly."""
+        if out is None:
+            out = np.empty((len(oids), length), np.uint8)
+        for i, oid in enumerate(oids):
+            d = self._obj(cid, oid).data
+            n = min(len(d), length)
+            out[i, :n] = d[:n]
+            out[i, n:] = 0
+        return out
+
     def stat(self, cid: str, oid: str) -> int:
         return len(self._obj(cid, oid).data)
 
