@@ -735,6 +735,17 @@ class ECBackend(PGBackend):
             stage_err: list[BaseException] = []
             stop = _threading.Event()
 
+            def _put(item) -> None:
+                # bounded put that aborts if the consumer died (a
+                # blocked put would pin staged batches and leak this
+                # thread for the process lifetime)
+                while not stop.is_set():
+                    try:
+                        stageq.put(item, timeout=0.5)
+                        return
+                    except _queue.Full:
+                        continue
+
             def _producer() -> None:
                 try:
                     for sl_, subgroup_ in jobs:
@@ -743,23 +754,14 @@ class ECBackend(PGBackend):
                         with span("ecbackend.recover.stage"):
                             stack_, exp_ = self._gather_helper_stack(
                                 helper, subgroup_, sl_, verify_hinfo)
-                        # bounded put that aborts if the consumer died
-                        # (a blocked put would pin staged batches and
-                        # leak this thread for the process lifetime)
-                        while not stop.is_set():
-                            try:
-                                stageq.put((sl_, subgroup_, stack_,
-                                            exp_), timeout=0.5)
-                                break
-                            except _queue.Full:
-                                continue
+                        _put((sl_, subgroup_, stack_, exp_))
                 except BaseException as e:   # noqa: BLE001 — re-raised
                     stage_err.append(e)      # in the consumer
                 finally:
-                    try:
-                        stageq.put_nowait(None)
-                    except _queue.Full:
-                        pass   # consumer is draining via `stop` anyway
+                    # the sentinel MUST go through the same bounded
+                    # put: dropping it on a full queue would leave the
+                    # consumer blocked on get() forever
+                    _put(None)
 
             t = _threading.Thread(target=_producer, daemon=True)
             t.start()

@@ -224,9 +224,14 @@ class MemStore:
             out = np.empty((len(oids), length), np.uint8)
         for i, oid in enumerate(oids):
             d = self._obj(cid, oid).data
-            n = min(len(d), length)
-            out[i, :n] = d[:n]
-            out[i, n:] = 0
+            if len(d) != length:
+                # a stale/partially-written shard must fail LOUDLY
+                # here — zero-filling would hand the decoder garbage
+                # that writeback then stamps with matching CRCs
+                raise ValueError(
+                    f"read_batch: {oid!r} is {len(d)} bytes, "
+                    f"expected {length}")
+            out[i] = d
         return out
 
     def stat(self, cid: str, oid: str) -> int:
