@@ -19,6 +19,24 @@ from __future__ import annotations
 from collections import deque
 
 
+def divergent_names(local: "PGLog", auth: "PGLog") -> list[str]:
+    """Names whose entries in `local` the authoritative log does not
+    contain (ref: PGLog::merge_log divergent-entry handling): an entry
+    past auth.head, or one whose version names a DIFFERENT object in
+    the authoritative history, records a write that never committed
+    cluster-wide. The rejoining holder must roll those objects back to
+    (or re-copy) the authoritative state — serving them would
+    resurrect unacknowledged writes. Versions at or before auth.tail
+    are unverifiable (trimmed) and assumed converged — the backfill
+    path owns that window."""
+    auth_at = dict(auth._entries)
+    out: dict[str, None] = {}
+    for v, name in local._entries:
+        if v > auth.head or (v > auth.tail and auth_at.get(v) != name):
+            out.setdefault(name)
+    return list(out)
+
+
 class PGLog:
     """Append-only bounded mutation log for one PG."""
 

@@ -64,7 +64,7 @@ from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
 from .osdmap import OSDMap, PGPool
 from .pgbackend import ReplicatedBackend
-from .pglog import PGLog
+from .pglog import PGLog, divergent_names
 from .tinstore import _decode_txn, _encode_txn
 
 PG_META_KEY = b"pg_meta"
@@ -517,7 +517,16 @@ class _Rpc:
             ev: tuple[threading.Event, list] = (threading.Event(), [])
             self._pending[rid] = ev
         try:
-            self.msgr.send(peer, make_msg(rid))
+            try:
+                self.msgr.send(peer, make_msg(rid))
+            except KeyError:
+                # unknown endpoint (peer not wired yet / torn down):
+                # a TRANSPORT failure, never to be confused with an
+                # application-level KeyError reply ("no such omap
+                # key") — peering quorum counts only peers that
+                # actually ANSWERED
+                raise ConnectionError(
+                    f"rpc to {peer}: endpoint unknown") from None
             if not ev[0].wait(timeout):
                 raise ConnectionError(f"rpc to {peer} timed out")
             return ev[1][0]
@@ -657,6 +666,10 @@ class OSDDaemon:
         self.snapsets: dict[int, dict[str, list]] = {}
         self.births: dict[int, dict[str, int]] = {}
         self.obj_kv: dict[int, dict[str, dict]] = {}
+        # divergent names whose rewind was deferred (helpers not
+        # reachable during the restoring reconcile); retried on every
+        # later reconcile until clean
+        self._rewind_pending: dict[int, set[str]] = {}
         self.suspect: set[int] = set()            # osd ids (local view)
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
@@ -826,12 +839,31 @@ class OSDDaemon:
         """Ship the PG's metadata to every live shard as omap (the
         pg_log-rides-with-the-transaction discipline, ref:
         PGLog entries inside ObjectStore::Transaction)."""
+        be = self.backends[ps]
+        blob = self._encode_meta(ps)
+        for s, osd in enumerate(be.acting):
+            if osd in self.suspect:
+                continue
+            t = Transaction().omap_set(shard_cid(be.pg, s), "__pg_meta__",
+                                       {PG_META_KEY: blob})
+            try:
+                be.cluster.osd(osd).queue_transaction(t)
+            except (ConnectionError, OSError):
+                self.suspect.add(osd)
+
+    def _encode_meta(self, ps: int) -> bytes:
         import json as _json
         be = self.backends[ps]
         e = Encoder()
         # v2 appends snapsets/births/cls-kv (compat 1: a v1 reader
-        # skips the tail via the section length)
-        e.start(2, 1)
+        # skips the tail via the section length); v3 leads with the
+        # map epoch the blob was persisted under — takeover precedence
+        # is (epoch, head), NOT bare head, so a revived ex-primary's
+        # divergent log from an older interval can never win peering
+        # (ref: PeeringState find_best_info's last_epoch_started
+        # precedence)
+        e.start(3, 1)
+        e.u64(self.osdmap.epoch if self.osdmap is not None else 0)
         e.mapping(be.object_sizes, Encoder.string,
                   lambda en, v: en.u64(v))
         e.mapping(be.object_versions, Encoder.string,
@@ -848,55 +880,94 @@ class OSDDaemon:
                   lambda en, v: en.blob(
                       _json.dumps(v, sort_keys=True).encode()))
         e.finish()
-        blob = e.bytes()
-        for s, osd in enumerate(be.acting):
-            if osd in self.suspect:
-                continue
-            t = Transaction().omap_set(shard_cid(be.pg, s), "__pg_meta__",
-                                       {PG_META_KEY: blob})
-            try:
-                be.cluster.osd(osd).queue_transaction(t)
-            except (ConnectionError, OSError):
-                self.suspect.add(osd)
+        return e.bytes()
 
-    def _load_meta(self, ps: int, acting: list[int]) -> bytes | None:
+    @staticmethod
+    def _meta_rank(blob: bytes) -> tuple[int, int] | None:
+        """(epoch, head) precedence key of a persisted meta blob, or
+        None for a corrupt candidate. Epoch FIRST: a newer interval's
+        state beats any head from an older one — the divergent-log
+        guard (ref: find_best_info)."""
+        try:
+            d = Decoder(blob)
+            v = d.start(3)
+            epoch = d.u64() if v >= 3 else 0
+            d.mapping(Decoder.string, Decoder.u64)
+            d.mapping(Decoder.string, Decoder.u64)
+            head = PGLog.decode(d.blob()).head
+        except Exception:        # noqa: BLE001 — a corrupt candidate
+            return None          # must not block takeover
+        return (epoch, head)
+
+    def _load_meta(self, ps: int,
+                   acting: list[int]) -> tuple[bytes | None,
+                                               bytes | None, bool]:
         """Find the FRESHEST persisted PG metadata: gather the blob
         from the local shard AND every reachable acting member, decode
-        each, and keep the one with the highest pg_log head — a local
-        copy can be stale (e.g. this member was skipped by
-        _persist_meta while transiently suspect), and restoring stale
-        metadata would make recent writes unreadable."""
+        each, and keep the one with the highest (epoch, head) — a
+        local copy can be stale (skipped by _persist_meta while
+        transiently suspect) or DIVERGENT (this daemon died holding
+        writes that never committed; bare-head precedence would
+        resurrect them). Returns (best, best_local, quorum_ok): the
+        local winner rides along so the caller can rewind divergent
+        local entries against the authoritative log; quorum_ok says a
+        MAJORITY of the up acting members answered the gather —
+        restoring from fewer (only our own blob, peers not answering
+        yet after a revive) could adopt a divergent dead-interval log
+        as authoritative (ref: PeeringState GetInfo needs a quorum
+        before the PG may go active)."""
         pgid = f"1.{ps}"
-        blobs: list[bytes] = []
+        local_blobs: list[bytes] = []
+        remote_blobs: list[bytes] = []
+        heard = {self.osd_id}
         for s in range(len(acting)):
             obj = self.store.collections.get(
                 shard_cid(pgid, s), {}).get("__pg_meta__")
             if obj is not None and PG_META_KEY in obj.omap:
-                blobs.append(obj.omap[PG_META_KEY])
-        for s, osd in enumerate(acting):
-            if osd == self.osd_id or osd in self.suspect:
+                local_blobs.append(obj.omap[PG_META_KEY])
+        n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
+            else 0
+        for osd in dict.fromkeys(acting):   # each peer once, in order
+            if osd == self.osd_id or osd in self.suspect \
+                    or not _valid_osd(osd, n_osds):
                 continue
-            try:
-                blobs.append(RemoteStore(
-                    self.rpc, f"osd.{osd}", timeout=2.0,
-                    authorize=self._authorize_peer
-                    if self.verifier is not None else None).omap_get(
-                    shard_cid(pgid, s), "__pg_meta__", PG_META_KEY))
-            except (KeyError, ConnectionError, OSError):
-                continue
-        best, best_head = None, -1
-        for blob in blobs:
-            try:
-                d = Decoder(blob)
-                d.start(2)
-                d.mapping(Decoder.string, Decoder.u64)
-                d.mapping(Decoder.string, Decoder.u64)
-                head = PGLog.decode(d.blob()).head
-            except Exception:    # noqa: BLE001 — a corrupt candidate
-                continue         # must not block takeover
-            if head > best_head:
-                best, best_head = blob, head
-        return best
+            rs = RemoteStore(
+                self.rpc, f"osd.{osd}", timeout=2.0,
+                authorize=self._authorize_peer
+                if self.verifier is not None else None)
+            # a previous interval may have slotted this peer anywhere:
+            # ask for EVERY slot's blob, not just the one our acting
+            # assigns it (a slot-addressed miss reads as "no blob" and
+            # silently crowns a divergent local log)
+            for s in range(len(acting)):
+                try:
+                    remote_blobs.append(rs.omap_get(
+                        shard_cid(pgid, s), "__pg_meta__",
+                        PG_META_KEY))
+                    heard.add(osd)
+                except KeyError:
+                    heard.add(osd)   # answered: no blob at this slot
+                except (ConnectionError, OSError):
+                    break
+
+        def pick(blobs: list[bytes]) -> bytes | None:
+            best, best_rank = None, (-1, -1)
+            for blob in blobs:
+                rank = self._meta_rank(blob)
+                if rank is not None and rank > best_rank:
+                    best, best_rank = blob, rank
+            return best
+
+        up_members = {o for o in acting
+                      if _valid_osd(o, n_osds)
+                      and (o == self.osd_id or self.osdmap.osd_up[o])}
+        need = len(up_members) // 2 + 1
+        quorum_ok = len(heard & up_members) >= need
+        best_local = pick(local_blobs)
+        # remotes first: on an (epoch, head) TIE the majority side
+        # must win, never this daemon's own (possibly divergent) copy
+        best = pick(remote_blobs + local_blobs)
+        return best, best_local, quorum_ok
 
     def _restore_backend(self, ps: int, acting: list[int]):
         """Primary takeover: rebuild the PG from persisted metadata.
@@ -904,13 +975,26 @@ class OSDDaemon:
         recorded against — _reconcile then sees old != new and runs
         the recovery that re-creates the changed slots (the GetLog/
         GetMissing outcome)."""
-        blob = self._load_meta(ps, acting)
+        blob, local_blob, quorum_ok = self._load_meta(ps, acting)
+        if not quorum_ok:
+            # we could not hear a majority of the up acting members:
+            # restoring now could crown a divergent local log — or
+            # start a VIRGIN history whose first persist would beat
+            # the unreachable peers' real data on epoch precedence.
+            # Stay un-activated; the heartbeat reconcile retries
+            # until the gather reaches quorum.
+            self.c.log(f"{self.name}: pg 1.{ps} restore deferred "
+                       f"(info gather below quorum)")
+            return None
         be = self._make_backend(ps, acting)
+        be.restored_from_blob = blob is not None
         if blob is None:
             return be            # virgin PG: nothing written yet
         import json as _json
         d = Decoder(blob)
-        v = d.start(2)
+        v = d.start(3)
+        if v >= 3:
+            d.u64()              # persist epoch (used by _meta_rank)
         be.object_sizes = d.mapping(Decoder.string, Decoder.u64)
         be.object_versions = d.mapping(Decoder.string, Decoder.u64)
         be.pg_log = PGLog.decode(d.blob())
@@ -930,7 +1014,78 @@ class OSDDaemon:
         # set already exist — _make_backend created them above)
         be.acting = list(meta_acting)
         be.shard_applied = list(applied)
+        # divergent-log rewind (ref: PGLog::merge_log): this daemon's
+        # own persisted log may hold entries the authoritative blob
+        # does not — writes from a dead interval that never committed.
+        # Those objects must be rolled back to authoritative state,
+        # never served from the tainted local copy.
+        if local_blob is not None and local_blob != blob:
+            try:
+                ld = Decoder(local_blob)
+                lv = ld.start(3)
+                if lv >= 3:
+                    ld.u64()
+                ld.mapping(Decoder.string, Decoder.u64)   # sizes
+                ld.mapping(Decoder.string, Decoder.u64)   # versions
+                local_log = PGLog.decode(ld.blob())
+            except Exception:    # noqa: BLE001 — corrupt local blob:
+                local_log = None  # nothing credible to rewind
+            if local_log is not None:
+                div = divergent_names(local_log, be.pg_log)
+                if div:
+                    try:
+                        self._rewind_divergent(ps, be, div)
+                    except Exception as e:  # noqa: BLE001 — a failed
+                        # rewind must not block the takeover; retry on
+                        # the next reconcile
+                        self.c.log(f"{self.name}: pg 1.{ps} rewind "
+                                   f"errored ({e}); queued for retry")
+                        self._rewind_pending.setdefault(
+                            ps, set()).update(div)
         return be
+
+    def _rewind_divergent(self, ps: int, be, names: list[str]) -> None:
+        """Roll back writes only this daemon's dead interval logged
+        (ref: PGLog merge_log divergent handling + missing-set repair).
+        A name the authoritative history knows is ROLLED FORWARD from
+        the authoritative copies (rewriting every shard converges the
+        tainted one); a name it never committed is REMOVED from this
+        daemon's own store — serving or resurrecting it would
+        acknowledge a write the cluster never accepted. Leftovers are
+        scanned across ALL of the PG's local collections: the
+        takeover interval re-slotted the PG, so the divergent bytes
+        sit in whatever slot this daemon held in the DEAD interval,
+        not necessarily one the authoritative acting still assigns
+        to it."""
+        pending = self._rewind_pending.setdefault(ps, set())
+        for name in sorted(names):
+            if name in be.object_sizes:
+                try:
+                    data = be.read_objects(
+                        [name], dead_osds={self.osd_id})[name]
+                    be.write_objects(
+                        {name: bytes(np.asarray(data, np.uint8)
+                                     .tobytes())},
+                        dead_osds=set(self.suspect))
+                    pending.discard(name)
+                    self.c.log(f"{self.name}: pg 1.{ps} rewound "
+                               f"divergent {name!r} from "
+                               f"authoritative copies")
+                except Exception as e:   # noqa: BLE001 — retried on
+                    pending.add(name)    # the next reconcile
+                    self.c.log(f"{self.name}: pg 1.{ps} divergent "
+                               f"{name!r} rewind deferred: {e}")
+                continue
+            for s in range(be.n):
+                cid = shard_cid(be.pg, s)
+                if self.store.exists(cid, name):
+                    self.store.queue_transaction(
+                        Transaction().remove(cid, name))
+            pending.discard(name)
+            self.c.log(f"{self.name}: pg 1.{ps} discarded divergent "
+                       f"uncommitted {name!r}")
+        if not pending:
+            self._rewind_pending.pop(ps, None)
 
     def _on_map(self, peer: str, msg: MOSDMapMsg) -> None:
         with self._lock:
@@ -992,7 +1147,28 @@ class OSDDaemon:
             be = self.backends.get(ps)
             if be is None:
                 be = self._restore_backend(ps, acting)
+                if be is None:      # info gather below quorum:
+                    continue        # retried by the heartbeat tick
                 self.backends[ps] = be
+                if getattr(be, "restored_from_blob", False):
+                    # ACTIVATION (the last_epoch_started role): stamp
+                    # this interval's epoch onto the acting members
+                    # BEFORE recovery starts or I/O is served — a
+                    # member of the old interval rejoining mid-
+                    # takeover must find the new interval's claim on
+                    # the quorum, or its longer dead-interval log
+                    # would win the info gather and resurrect
+                    # uncommitted writes (ref: PeeringState::activate)
+                    try:
+                        self._persist_meta(ps)
+                    except Exception as e:  # noqa: BLE001
+                        self.c.log(f"{self.name}: pg 1.{ps} "
+                                   f"activation persist failed: {e}")
+            elif self._rewind_pending.get(ps):
+                # a deferred divergent rewind retries on every map
+                # change until its helpers are reachable
+                self._rewind_divergent(
+                    ps, be, sorted(self._rewind_pending[ps]))
             if be.acting == acting:
                 self._snap_trim(ps, be)   # snaps may have left the map
             if be.acting != acting:

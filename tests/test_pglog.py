@@ -180,3 +180,48 @@ class TestDeltaRejoin:
         assert h["pgs_degraded"] == 0
         for be in c.pgs.values():
             assert be.deep_scrub()["inconsistent"] == []
+
+
+class TestDivergentNames:
+    """PGLog::merge_log's divergent-entry classification (r4 verdict
+    item 5)."""
+
+    def _log(self, entries, head=None, tail=0):
+        from ceph_tpu.osd.pglog import PGLog
+        lg = PGLog()
+        for _, name in entries:
+            lg.append(name)
+        # rewrite versions to match the given entries exactly
+        lg._entries.clear()
+        for v, name in entries:
+            lg._entries.append((v, name))
+        lg.head = head if head is not None else max(
+            (v for v, _ in entries), default=0)
+        lg.tail = tail
+        return lg
+
+    def test_entries_past_auth_head_are_divergent(self):
+        from ceph_tpu.osd.pglog import divergent_names
+        auth = self._log([(1, "a"), (2, "b")])
+        local = self._log([(1, "a"), (2, "b"), (3, "ghost"),
+                           (4, "ghost2")])
+        assert sorted(divergent_names(local, auth)) == \
+            ["ghost", "ghost2"]
+
+    def test_conflicting_version_is_divergent(self):
+        from ceph_tpu.osd.pglog import divergent_names
+        auth = self._log([(1, "a"), (2, "x"), (3, "y")])
+        local = self._log([(1, "a"), (2, "b")])  # v2 names differ
+        assert divergent_names(local, auth) == ["b"]
+
+    def test_agreeing_histories_have_no_divergence(self):
+        from ceph_tpu.osd.pglog import divergent_names
+        auth = self._log([(1, "a"), (2, "b"), (3, "c")])
+        local = self._log([(1, "a"), (2, "b")])  # merely behind
+        assert divergent_names(local, auth) == []
+
+    def test_trimmed_window_assumed_converged(self):
+        from ceph_tpu.osd.pglog import divergent_names
+        auth = self._log([(5, "e"), (6, "f")], head=6, tail=4)
+        local = self._log([(3, "old"), (5, "e")])  # v3 predates tail
+        assert divergent_names(local, auth) == []
