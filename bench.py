@@ -828,6 +828,49 @@ def bench_clay_repair(k=8, m=4, d=11):
     return res
 
 
+def bench_wire(seconds=float(os.environ.get("BENCH_WIRE_SECONDS",
+                                            "4"))):
+    """Wire-tier throughput (VERDICT r4 item 8; ref: src/tools/rados/
+    rados.cc `rados bench`): tools/rados_bench.py against a standalone
+    cluster — N real-socket daemons, cephx auth, AES-GCM secure
+    frames. Runs in a CPU-pinned subprocess: it measures the messenger
+    stack on localhost, not the chip, and must not touch the tunnel."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "rados_bench.py")
+    out = {}
+    for workload in ("write", "seq"):
+        r = subprocess.run(
+            [sys.executable, tool, "--transport", "standalone",
+             "--seconds", str(seconds), "--object-size", "65536",
+             "--num-osds", "6", "--pg-num", "4", "--batch", "8",
+             "--json", workload],
+            capture_output=True, text=True, timeout=240, env=env)
+        if r.returncode != 0:
+            tail = " | ".join((r.stderr or "").strip()
+                              .splitlines()[-3:])[:200]
+            raise RuntimeError(
+                f"rados_bench {workload} rc={r.returncode}: {tail}")
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        d = json.loads(line)
+        if d.get("mb_per_s") is None:
+            raise RuntimeError(
+                f"rados_bench {workload} emitted no metrics: "
+                f"{line[:200]}")
+        out[workload] = {k: d.get(k) for k in
+                         ("mb_per_s", "ops_per_s", "objects_per_s",
+                          "p50_ms", "p95_ms")}
+        log(f"wire {workload}: {d.get('mb_per_s')} MB/s "
+            f"{d.get('objects_per_s')} obj/s p50={d.get('p50_ms')}ms")
+    out["config"] = {"transport": "standalone", "cephx": True,
+                     "secure": True, "object_size": 65536, "batch": 8,
+                     "n_osds": 6, "backend": "cpu (messenger bench)"}
+    STATE["extra"]["wire_rados_bench"] = out
+    return out
+
+
 _TRANSIENT = ("remote_compile", "HTTP 500", "DEADLINE_EXCEEDED")
 
 # keys that prove a child section actually measured something
@@ -1007,6 +1050,7 @@ def main() -> None:
         _section("encode", skip, bench_encode_impls, impls)
         _section("decode", skip, bench_decode, impls)
         _section("cpu", skip, bench_cpu_native)
+        _section("wire", skip, bench_wire)
         _section("lrc", skip, bench_lrc_repair)
         _section("clay", skip, bench_clay_repair)
         # recovery + crush are the two sections that have crashed the

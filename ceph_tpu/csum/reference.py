@@ -62,14 +62,27 @@ def crc32c_slice8_tables() -> np.ndarray:
 
 
 def _crc32c_update(reg: int, data: bytes | np.ndarray) -> int:
-    """Advance the raw CRC register over data (no init/final inversion)."""
-    t = crc32c_table()
-    arr = np.frombuffer(bytes(data), dtype=np.uint8) if not isinstance(
-        data, np.ndarray) else data.astype(np.uint8).ravel()
-    reg = np.uint32(reg)
-    for b in arr:
-        reg = (reg >> np.uint32(8)) ^ t[(reg ^ b) & np.uint32(0xFF)]
-    return int(reg)
+    """Advance the raw CRC register over data (no init/final inversion).
+    Plain python ints over a list table — ~10x the numpy-scalar loop
+    this replaced (numpy scalar ops pay per-op boxing; the reference
+    oracle is still O(n) per byte — bulk paths use csum/kernels)."""
+    t = _crc32c_pylist()
+    buf = bytes(data) if not isinstance(data, np.ndarray) \
+        else data.astype(np.uint8).ravel().tobytes()
+    reg = int(reg) & 0xFFFFFFFF
+    for b in buf:
+        reg = (reg >> 8) ^ t[(reg ^ b) & 0xFF]
+    return reg
+
+
+_PYLIST_CACHE: list[int] | None = None
+
+
+def _crc32c_pylist() -> list[int]:
+    global _PYLIST_CACHE
+    if _PYLIST_CACHE is None:
+        _PYLIST_CACHE = [int(x) for x in crc32c_table()]
+    return _PYLIST_CACHE
 
 
 def crc32c(data: bytes | np.ndarray, init: int = 0xFFFFFFFF,

@@ -184,8 +184,28 @@ class Message:
         raise NotImplementedError
 
 
+_crc32c_impl = None
+
+
 def _crc(data: bytes) -> int:
-    return int(ceph_crc32c(0xFFFFFFFF, data)) & 0xFFFFFFFF
+    # frame CRCs run per message on the hot wire path: use the native
+    # C codec's crc32c (bit-identical to ceph_crc32c — pinned by
+    # tests/test_native.py) instead of the per-byte python reference.
+    # Resolved LAZILY and only when the .so is ALREADY BUILT: import
+    # must never trigger a compile (parallel `make -B` races corrupt
+    # the .so for concurrent bench subprocesses).
+    global _crc32c_impl
+    if _crc32c_impl is None:
+        impl = ceph_crc32c
+        try:
+            from .. import native
+            if native.ready():
+                native.native_crc32c(0, b"probe")
+                impl = native.native_crc32c
+        except Exception:          # noqa: BLE001 — optional native lib
+            pass
+        _crc32c_impl = impl
+    return int(_crc32c_impl(0xFFFFFFFF, data)) & 0xFFFFFFFF
 
 
 class _Conn:
@@ -336,6 +356,12 @@ class Messenger:
     def _handshake_in(self, sock: socket.socket) -> None:
         box = None
         try:
+            # disable Nagle: frames go out as several small sends
+            # (header, then payload); coalescing them behind delayed
+            # ACKs costs tens of ms PER FRAME on the rpc path (the
+            # reference sets TCP_NODELAY on every messenger socket;
+            # ref: AsyncConnection socket options ms_tcp_nodelay)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
                 return
@@ -440,6 +466,7 @@ class Messenger:
                 return conn  # someone beat us to it
             addr = self._addr_of[peer]
             sock = socket.create_connection(tuple(addr), timeout=10)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.sendall(BANNER)
             name_b = self.name.encode()
             sock.sendall(struct.pack("<H", len(name_b)) + name_b

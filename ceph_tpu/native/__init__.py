@@ -68,6 +68,20 @@ def build(force: bool = False) -> str:
     return _SO
 
 
+def ready() -> bool:
+    """True when the .so exists and matches the current sources —
+    WITHOUT triggering a build (import-time callers must never run a
+    compile, nor race parallel `make -B` invocations)."""
+    stamp = os.path.join(_NATIVE_DIR, ".build_hash")
+    try:
+        if not os.path.exists(_SO) or not os.path.exists(stamp):
+            return False
+        with open(stamp) as f:
+            return f.read().strip() == _source_hash()
+    except OSError:
+        return False
+
+
 @lru_cache(maxsize=1)
 def lib() -> ctypes.CDLL:
     L = ctypes.CDLL(build())

@@ -165,19 +165,25 @@ class ECBackend(PGBackend):
                 np.concatenate([data_shards, parity], axis=1))
             crcs = self._batched_hinfo_crcs(shards.reshape(-1, sl))
             crcs = crcs.reshape(len(group), self.n)
-            for bi, (name, arr) in enumerate(group):
+            for name, _ in group:
                 self.object_sizes[name] = olen
-                for shard in live:
-                    chunk = shards[bi, shard, :]
+            # ONE combined transaction per shard for the whole batch
+            # (the sub-op fan-out unit; on the wire tier this is one
+            # MStoreOp frame per shard instead of one per object —
+            # the batched analog of MOSDECSubOpWrite carrying the
+            # whole RMW plan)
+            for shard in live:
+                cid = shard_cid(self.pg, shard)
+                t = Transaction()
+                for bi, (name, arr) in enumerate(group):
                     hinfo = HashInfo(1, sl, [int(crcs[bi, shard])])
                     # truncate clears any stale tail from a previous,
                     # larger version of the object
-                    t = (Transaction()
-                         .write(shard_cid(self.pg, shard), name, 0, chunk)
-                         .truncate(shard_cid(self.pg, shard), name, sl)
-                         .setattr(shard_cid(self.pg, shard), name,
-                                  HINFO_KEY, hinfo.to_bytes()))
-                    self._store(shard).queue_transaction(t)
+                    t.write(cid, name, 0, shards[bi, shard, :]) \
+                     .truncate(cid, name, sl) \
+                     .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
+                self._store(shard).queue_transaction(t)
+            for name, _ in group:
                 self._log_write(name, live)
 
     # -- write path (RMW partial-stripe) -------------------------------------
@@ -509,7 +515,8 @@ class ECBackend(PGBackend):
             del obj  # _read_eio already repaired in place
             repaired += len(slots)
         return {"checked": rep["checked"], "repaired": repaired,
-                "objects": len(by_name), "skipped": skipped}
+                "objects": len(by_name), "skipped": skipped,
+                "strays_removed": self._remove_strays(dead)}
 
     # -- recovery (the objects/s metric) -------------------------------------
 
@@ -848,6 +855,7 @@ class ECBackend(PGBackend):
             # not client data — the scrub audits client objects only
             names = [n for n in store.list_objects(cid)
                      if not n.startswith("__")
+                     and n in self.object_sizes
                      and self.shard_applied[s]
                      >= self.object_versions.get(n, 0)]
             by_len: dict[int, list[str]] = {}

@@ -242,6 +242,7 @@ class OSDMap:
 
     def _bump(self):
         self.epoch += 1
+        self.__dict__.pop("_placement_cache", None)
 
     def add_pool(self, pool: PGPool) -> None:
         if pool.crush_rule not in self.crush.rules:
@@ -424,7 +425,14 @@ class OSDMap:
     def pg_to_up_acting_osds(self, pool_id: int, ps: int):
         """Returns (up, up_primary, acting, acting_primary) — the full
         override pipeline: raw CRUSH -> drop down OSDs -> pg_temp /
-        primary_temp."""
+        primary_temp. Memoized per epoch: placement is pure in the map
+        state, and the wire tier recomputes it on every client op and
+        daemon dispatch (the CRUSH walk dominated the plain-mode rados
+        bench profile); any mutation clears the cache via _bump."""
+        cache = self.__dict__.setdefault("_placement_cache", {})
+        hit = cache.get((pool_id, ps))
+        if hit is not None:
+            return hit
         pool = self.pools[pool_id]
         raw = self._apply_upmap(pool_id, ps,
                                 self._raw_pg_to_osds(pool, ps))
@@ -433,7 +441,9 @@ class OSDMap:
         acting = self.pg_temp.get((pool_id, ps), up)
         acting_primary = self.primary_temp.get((pool_id, ps),
                                                self._primary_of(acting))
-        return up, up_primary, acting, acting_primary
+        out = (up, up_primary, acting, acting_primary)
+        cache[(pool_id, ps)] = out
+        return out
 
     def pg_to_acting_osds(self, pool_id: int, ps: int) -> list[int]:
         return self.pg_to_up_acting_osds(pool_id, ps)[2]

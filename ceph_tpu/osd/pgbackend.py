@@ -128,6 +128,27 @@ class PGBackend:
             lambda b: crc32c_blocks(b, init=0xFFFFFFFF, xorout=0),
             np.asarray(blocks, dtype=np.uint8)))
 
+    def _remove_strays(self, dead: set[int]) -> int:
+        """Remove per-slot leftover objects the PG's metadata no
+        longer knows: divergent dead-interval writes kept by a member
+        that rejoined as a NON-primary (only the restoring primary
+        runs the divergent-log rewind), or delete leftovers a trimmed
+        log can never replay. Ref: PrimaryLogPG's stray/unexpected
+        object handling on scrub repair."""
+        from .memstore import Transaction
+        removed = 0
+        for s in range(self.n):
+            if self.acting[s] in dead:
+                continue
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            for name in st.list_objects(cid):
+                if name.startswith("__") or name in self.object_sizes:
+                    continue
+                st.queue_transaction(Transaction().remove(cid, name))
+                removed += 1
+        return removed
+
     # -- contract (ref: PGBackend.h pure virtuals) ---------------------------
 
     def write_objects(self, objects, dead_osds=None) -> None:
@@ -571,7 +592,8 @@ class ReplicatedBackend(PGBackend):
                 self._rewrite_replica(name, s, good)
                 repaired += 1
         return {"checked": rep["checked"], "repaired": repaired,
-                "objects": len(by_name), "skipped": skipped}
+                "objects": len(by_name), "skipped": skipped,
+                "strays_removed": self._remove_strays(dead)}
 
     # -- recovery ------------------------------------------------------------
 
@@ -683,8 +705,13 @@ class ReplicatedBackend(PGBackend):
             # a replica that missed an object's last write is behind
             # (pending replay), not corrupt — the scrubber's "missing"
             # bucket; filter BEFORE reading so stale rows cost nothing
+            # strays (objects the PG metadata doesn't know — e.g. a
+            # non-primary rejoiner's divergent leftovers) may lack
+            # hinfo entirely: they are repair's to REMOVE, not the
+            # digest audit's to crash on
             names = [n for n in store.list_objects(cid)
-                     if self.shard_applied[s]
+                     if n in self.object_sizes
+                     and self.shard_applied[s]
                      >= self.object_versions.get(n, 0)]
             by_len: dict[int, list[str]] = {}
             for n in names:

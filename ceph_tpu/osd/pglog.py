@@ -19,6 +19,23 @@ from __future__ import annotations
 from collections import deque
 
 
+def share_history(local: "PGLog", auth: "PGLog") -> bool:
+    """True when the two logs demonstrably belong to one history: some
+    retained entry agrees, or local's retained window entirely
+    predates auth's trimmed tail (unverifiable => assume shared). A
+    local log with entries and NO agreement at all signals interval
+    DISCONTINUITY (e.g. the PG restarted virgin on fresh OSDs after a
+    full-acting-set outage) — a rewind there would delete the only
+    surviving copies, not roll back an uncommitted tail."""
+    if not len(local._entries):
+        return True
+    auth_at = dict(auth._entries)
+    for v, name in local._entries:
+        if v <= auth.tail or auth_at.get(v) == name:
+            return True
+    return False
+
+
 def divergent_names(local: "PGLog", auth: "PGLog") -> list[str]:
     """Names whose entries in `local` the authoritative log does not
     contain (ref: PGLog::merge_log divergent-entry handling): an entry

@@ -64,7 +64,7 @@ from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
 from .osdmap import OSDMap, PGPool
 from .pgbackend import ReplicatedBackend
-from .pglog import PGLog, divergent_names
+from .pglog import PGLog, divergent_names, share_history
 from .tinstore import _decode_txn, _encode_txn
 
 PG_META_KEY = b"pg_meta"
@@ -1032,7 +1032,19 @@ class OSDDaemon:
                 local_log = None  # nothing credible to rewind
             if local_log is not None:
                 div = divergent_names(local_log, be.pg_log)
-                if div:
+                if div and not share_history(local_log, be.pg_log):
+                    # no entry agreement at all: interval
+                    # DISCONTINUITY, not a stale tail — removing the
+                    # "divergent" objects could delete the only copies
+                    # (full-acting-set outage then virgin restart).
+                    # Keep the bytes; surface for the operator.
+                    self.c.log(f"{self.name}: pg 1.{ps} local history "
+                               f"shares no entries with the "
+                               f"authoritative log; leaving "
+                               f"{len(div)} object(s) untouched "
+                               f"(operator: ceph_objectstore_tool "
+                               f"export/inspect)")
+                elif div:
                     try:
                         self._rewind_divergent(ps, be, div)
                     except Exception as e:  # noqa: BLE001 — a failed

@@ -362,3 +362,24 @@ class TestNonIdentityChunkMapping:
         np.testing.assert_array_equal(got["obj1"], objs["obj1"])
         assert be.eio_stats["read_eio"] >= 1
         assert be.eio_stats["repaired"] >= 1
+
+
+class TestStraySweep:
+    def test_repair_removes_unknown_leftovers(self):
+        """Objects a store holds that the PG metadata doesn't know
+        (e.g. a non-primary rejoiner's divergent dead-interval
+        leftovers) are removed by `pg repair`, and deep scrub doesn't
+        crash on their missing hinfo (r5 review finding)."""
+        be, cluster = make_backend()
+        objs = write_corpus(be, n=5)
+        st = cluster.osd(2)
+        st.queue_transaction(Transaction().write(
+            shard_cid("1.0", 2), "ghost-leftover", 0, b"Z" * 64))
+        rep = be.deep_scrub()          # must not raise on the stray
+        assert rep["inconsistent"] == []
+        out = be.repair_pg()
+        assert out["strays_removed"] == 1
+        assert "ghost-leftover" not in st.list_objects(shard_cid("1.0", 2))
+        got = be.read_objects(list(objs))
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data)

@@ -225,3 +225,20 @@ class TestDivergentNames:
         auth = self._log([(5, "e"), (6, "f")], head=6, tail=4)
         local = self._log([(3, "old"), (5, "e")])  # v3 predates tail
         assert divergent_names(local, auth) == []
+
+    def test_share_history(self):
+        from ceph_tpu.osd.pglog import share_history
+        # stale tail: agreement on early entries
+        auth = self._log([(1, "a"), (2, "b"), (3, "c")])
+        local = self._log([(1, "a"), (2, "b"), (4, "ghost")], head=4)
+        assert share_history(local, auth)
+        # interval discontinuity: no agreement anywhere
+        virgin = self._log([(1, "post-outage")])
+        old = self._log([(1, "x"), (2, "y"), (3, "z")])
+        assert not share_history(old, virgin)
+        # local predates auth's trimmed tail: unverifiable => shared
+        trimmed = self._log([(9, "n")], head=9, tail=8)
+        ancient = self._log([(2, "m")], head=2)
+        assert share_history(ancient, trimmed)
+        # empty local always shares
+        assert share_history(self._log([]), auth)

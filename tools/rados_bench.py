@@ -57,24 +57,59 @@ def main(argv=None) -> None:
     ap.add_argument("--batch", type=int, default=8,
                     help="objects per client op (batched writes are "
                          "the TPU-native unit of work)")
+    ap.add_argument("--transport", choices=["sim", "standalone"],
+                    default="sim",
+                    help="sim: hermetic in-process SimCluster; "
+                         "standalone: REAL socket daemons with cephx "
+                         "auth + AES-GCM secure frames (the "
+                         "qa/standalone analog — measures the wire "
+                         "stack, ref: rados bench against a vstart "
+                         "cluster)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.seconds <= 0 or args.object_size <= 0 or args.batch <= 0:
         raise SystemExit("rados_bench: --seconds/--object-size/--batch "
                          "must be positive")
 
-    from ceph_tpu.client.rados import Rados
-    from ceph_tpu.osd.cluster import SimCluster
-
     profile = (args.profile or "plugin=tpu_rs k=4 m=2 impl=bitlinear") \
         if args.pool == "ec" else "replicated size=3"
-    try:
-        c = SimCluster(n_osds=args.num_osds, pg_num=args.pg_num,
-                       profile=profile, chunk_size=4096)
-    except ValueError as e:
-        raise SystemExit(f"rados_bench: {e}")
-    io = Rados(c).open_ioctx()
-    ob = io._ob
+    shutdown = None
+    if args.transport == "standalone":
+        import os as _os
+
+        from ceph_tpu.osd.standalone import StandaloneCluster
+        try:
+            c = StandaloneCluster(
+                n_osds=args.num_osds, pg_num=args.pg_num,
+                profile=profile, chunk_size=4096,
+                secret=_os.urandom(32), cephx=True, op_timeout=15.0)
+        except ValueError as e:
+            raise SystemExit(f"rados_bench: {e}")
+        c.wait_for_clean(timeout=30)
+        shutdown = c.shutdown
+        wire_client = c.client()
+
+        class _WireOb:   # the Objecter-shaped slice the loops use
+            @staticmethod
+            def write(objs):
+                wire_client.write({k: bytes(np.asarray(v, np.uint8)
+                                            .tobytes())
+                                   for k, v in objs.items()})
+
+            @staticmethod
+            def read(names):
+                return {n: wire_client.read(n) for n in names}
+        ob = _WireOb()
+    else:
+        from ceph_tpu.client.rados import Rados
+        from ceph_tpu.osd.cluster import SimCluster
+        try:
+            c = SimCluster(n_osds=args.num_osds, pg_num=args.pg_num,
+                           profile=profile, chunk_size=4096)
+        except ValueError as e:
+            raise SystemExit(f"rados_bench: {e}")
+        io = Rados(c).open_ioctx()
+        ob = io._ob
     rng = np.random.default_rng(0)
 
     def batch(i):
@@ -128,15 +163,22 @@ def main(argv=None) -> None:
     total_bytes = nobj * args.object_size
     out = {
         "workload": args.workload, "pool": args.pool,
+        "transport": args.transport,
         "object_size": args.object_size, "batch": args.batch,
         "seconds": round(dt, 3), "objects": nobj,
         "mb_per_s": round(total_bytes / dt / 1e6, 2),
         "ops_per_s": round(len(lat) / dt, 1),
         "objects_per_s": round(nobj / dt, 1),
         **percentiles(lat),
-        "note": "hermetic SimCluster: measures the framework pipeline, "
-                "not network storage",
+        "note": ("standalone wire cluster: real sockets, cephx auth, "
+                 "AES-GCM secure frames — measures the messenger+EC "
+                 "stack on localhost"
+                 if args.transport == "standalone" else
+                 "hermetic SimCluster: measures the framework "
+                 "pipeline, not network storage"),
     }
+    if shutdown is not None:
+        shutdown()
     if args.json:
         print(json.dumps(out))
     else:
