@@ -828,13 +828,15 @@ def bench_clay_repair(k=8, m=4, d=11):
     return res
 
 
-def bench_wire(seconds=float(os.environ.get("BENCH_WIRE_SECONDS",
-                                            "4"))):
+def bench_wire(seconds=None):
     """Wire-tier throughput (VERDICT r4 item 8; ref: src/tools/rados/
     rados.cc `rados bench`): tools/rados_bench.py against a standalone
     cluster — N real-socket daemons, cephx auth, AES-GCM secure
     frames. Runs in a CPU-pinned subprocess: it measures the messenger
     stack on localhost, not the chip, and must not touch the tunnel."""
+    if seconds is None:   # parse inside the section's isolation, not
+        seconds = float(  # at import (a malformed env var must fail
+            os.environ.get("BENCH_WIRE_SECONDS", "4"))  # ONE section)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
@@ -1040,9 +1042,10 @@ def main() -> None:
         import jax
         log(f"backend={jax.default_backend()} devices={jax.devices()}")
 
-        default_impls = "mxu,bitlinear,pallas" if STATE["tpu_ok"] \
-            else "mxu,bitlinear"   # pallas on CPU = interpret mode: not
-        #                            a kernel measurement, just minutes
+        # pallas is retired to experiment status (r4 on-chip: 11.2 vs
+        # 85.0 GB/s for plain-XLA mxu — docs/BENCH_METHODOLOGY.md
+        # "Kernel findings"); opt back in via BENCH_IMPLS=...,pallas
+        default_impls = "mxu,bitlinear"
         impls = [i for i in os.environ.get(
             "BENCH_IMPLS", default_impls).split(",") if i]
 

@@ -1,5 +1,14 @@
 """Pallas VPU kernel for batched GF(2^8) matrix-apply (encode/decode).
 
+STATUS (r5): EXPERIMENT, not the production path. On-chip slope
+measurement (r4 BENCH_mid.json) put this kernel at 11.2 GB/s encode vs
+85.0 GB/s for the plain-XLA `mxu` bit-plane lowering — XLA's own MXU
+tiling beats this hand VPU schedule 8x. Kept oracle-pinned and
+selectable (`impl=pallas`) as the repo's worked example of a Pallas
+kernel and as a baseline for any future hand-kernel attempt; excluded
+from the default bench impl set (docs/BENCH_METHODOLOGY.md "Kernel
+findings").
+
 The hand-scheduled replacement for the reference's CPU hot loop
 (ref: gf-complete gf_w8_split_4_8 SIMD region multiply called from
 jerasure_matrix_encode — SURVEY.md §2.1/§7.1). Where gf-complete keeps

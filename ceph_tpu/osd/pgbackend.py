@@ -135,18 +135,22 @@ class PGBackend:
         runs the divergent-log rewind), or delete leftovers a trimmed
         log can never replay. Ref: PrimaryLogPG's stray/unexpected
         object handling on scrub repair."""
-        from .memstore import Transaction
         removed = 0
         for s in range(self.n):
             if self.acting[s] in dead:
                 continue
             st = self._store(s)
             cid = shard_cid(self.pg, s)
-            for name in st.list_objects(cid):
-                if name.startswith("__") or name in self.object_sizes:
-                    continue
-                st.queue_transaction(Transaction().remove(cid, name))
-                removed += 1
+            strays = [n for n in st.list_objects(cid)
+                      if not n.startswith("__")
+                      and n not in self.object_sizes]
+            if not strays:
+                continue
+            t = Transaction()   # one combined txn (one wire frame)
+            for name in strays:
+                t.remove(cid, name)
+            st.queue_transaction(t)
+            removed += len(strays)
         return removed
 
     # -- contract (ref: PGBackend.h pure virtuals) ---------------------------

@@ -1037,13 +1037,11 @@ class OSDDaemon:
                     # DISCONTINUITY, not a stale tail — removing the
                     # "divergent" objects could delete the only copies
                     # (full-acting-set outage then virgin restart).
-                    # Keep the bytes; surface for the operator.
-                    self.c.log(f"{self.name}: pg 1.{ps} local history "
-                               f"shares no entries with the "
-                               f"authoritative log; leaving "
-                               f"{len(div)} object(s) untouched "
-                               f"(operator: ceph_objectstore_tool "
-                               f"export/inspect)")
+                    # QUARANTINE the bytes into a side collection:
+                    # out of the data path AND out of repair's stray
+                    # sweep (which would otherwise delete them on the
+                    # next routine `pg repair`).
+                    self._quarantine_divergent(ps, be, div)
                 elif div:
                     try:
                         self._rewind_divergent(ps, be, div)
@@ -1055,6 +1053,32 @@ class OSDDaemon:
                         self._rewind_pending.setdefault(
                             ps, set()).update(div)
         return be
+
+    def _quarantine_divergent(self, ps: int, be,
+                              names: list[str]) -> None:
+        """Move dead-interval objects that share NO history with the
+        authoritative log into `<pgid>.quarantine` on this daemon's
+        own store — preserved for the operator (ceph_objectstore_tool
+        export/inspect), invisible to reads, scrub, and the repair
+        stray sweep."""
+        pgid = f"1.{ps}"
+        qcid = f"{pgid}.quarantine"
+        moved = 0
+        for name in sorted(names):
+            for s in range(be.n):
+                cid = shard_cid(be.pg, s)
+                if not self.store.exists(cid, name):
+                    continue
+                data = self.store.read(cid, name)
+                self.store.queue_transaction(
+                    Transaction().create_collection(qcid)
+                    .write(qcid, f"{name}@s{s}", 0, data)
+                    .remove(cid, name))
+                moved += 1
+        self.c.log(f"{self.name}: pg {pgid} local history shares no "
+                   f"entries with the authoritative log; quarantined "
+                   f"{moved} shard object(s) to {qcid} (operator: "
+                   f"ceph_objectstore_tool export/inspect)")
 
     def _rewind_divergent(self, ps: int, be, names: list[str]) -> None:
         """Roll back writes only this daemon's dead interval logged

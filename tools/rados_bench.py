@@ -92,8 +92,7 @@ def main(argv=None) -> None:
         class _WireOb:   # the Objecter-shaped slice the loops use
             @staticmethod
             def write(objs):
-                wire_client.write({k: bytes(np.asarray(v, np.uint8)
-                                            .tobytes())
+                wire_client.write({k: np.asarray(v, np.uint8).tobytes()
                                    for k, v in objs.items()})
 
             @staticmethod
