@@ -1061,6 +1061,7 @@ class OSDDaemon:
         own store — preserved for the operator (ceph_objectstore_tool
         export/inspect), invisible to reads, scrub, and the repair
         stray sweep."""
+        from .pgbackend import HINFO_KEY
         pgid = f"1.{ps}"
         qcid = f"{pgid}.quarantine"
         moved = 0
@@ -1070,10 +1071,22 @@ class OSDDaemon:
                 if not self.store.exists(cid, name):
                     continue
                 data = self.store.read(cid, name)
-                self.store.queue_transaction(
-                    Transaction().create_collection(qcid)
-                    .write(qcid, f"{name}@s{s}", 0, data)
-                    .remove(cid, name))
+                qoid = f"{name}@s{s}"
+                t = (Transaction().create_collection(qcid)
+                     .write(qcid, qoid, 0, data)
+                     # truncate: a prior incident's longer quarantined
+                     # copy must not leave stale tail bytes under the
+                     # same oid
+                     .truncate(qcid, qoid, len(data))
+                     .remove(cid, name))
+                try:
+                    # preserve the integrity metadata with the bytes:
+                    # the operator verifies the export against hinfo
+                    hb = self.store.getattr(cid, name, HINFO_KEY)
+                    t.setattr(qcid, qoid, HINFO_KEY, hb)
+                except KeyError:
+                    pass   # a raw dead-interval write may lack hinfo
+                self.store.queue_transaction(t)
                 moved += 1
         self.c.log(f"{self.name}: pg {pgid} local history shares no "
                    f"entries with the authoritative log; quarantined "

@@ -1,0 +1,163 @@
+"""Cache tiering over SimCluster pools (ref: PrimaryLogPG
+maybe_handle_cache_detail / agent_work; qa cache-tier workflows).
+The cache pool is a small replicated cluster, the base an EC pool —
+the canonical fast-tier-over-EC deployment."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.cachetier import CacheTier
+from cluster_helpers import make_cluster
+
+
+def mk_tier(**kw):
+    base = make_cluster(n_osds=8, pg_num=4)
+    cache = make_cluster(n_osds=4, pg_num=2,
+                         profile="replicated size=2")
+    kw.setdefault("target_max_bytes", 64 * 1024)
+    tier = CacheTier(base, cache, **kw)
+    return tier, base, cache
+
+
+def blob(i, size=1000):
+    rng = np.random.default_rng(i)
+    return rng.integers(0, 256, size, np.uint8)
+
+
+class TestWritebackPath:
+    def test_write_lands_in_cache_only_until_flush(self):
+        tier, base, cache = mk_tier()
+        data = blob(1)
+        tier.write({"a": data})
+        np.testing.assert_array_equal(tier.read("a"), data)
+        with pytest.raises(KeyError):
+            base.read("a")          # writeback: base not written yet
+        assert tier.dirty_bytes == 1000
+        tier.flush()
+        np.testing.assert_array_equal(np.asarray(base.read("a")), data)
+        assert tier.dirty_bytes == 0
+        # still served from cache (clean hit)
+        np.testing.assert_array_equal(tier.read("a"), data)
+        assert tier.stats()["tier_hit"] >= 2
+
+    def test_overwrite_redirties(self):
+        tier, base, _ = mk_tier()
+        tier.write({"a": blob(1)})
+        tier.flush()
+        new = blob(2)
+        tier.write({"a": new})
+        assert tier.dirty_bytes == 1000
+        tier.flush()
+        np.testing.assert_array_equal(np.asarray(base.read("a")), new)
+
+
+class TestPromotionAndProxy:
+    def test_miss_proxies_then_promotes(self):
+        tier, base, cache = mk_tier(promote_after_hits=2)
+        data = blob(3)
+        base.write({"cold": data})
+        # first read: proxy (not cached)
+        np.testing.assert_array_equal(tier.read("cold"), data)
+        assert tier.stats()["tier_proxy_read"] == 1
+        assert tier.stats()["objects"] == 0
+        # second read within the period: promote
+        np.testing.assert_array_equal(tier.read("cold"), data)
+        assert tier.stats()["tier_promote"] == 1
+        assert tier.stats()["objects"] == 1
+        # third read is a cache hit
+        tier.read("cold")
+        assert tier.stats()["tier_hit"] == 1
+
+    def test_hit_set_decay_blocks_slow_scans(self):
+        tier, base, _ = mk_tier(promote_after_hits=2,
+                                hit_set_period=2)
+        base.write({"x": blob(4), "y": blob(5), "z": blob(6)})
+        # one touch each: the decay window expires between repeats,
+        # so a slow scan never accumulates enough hits to promote
+        for _ in range(3):
+            tier.read("x"), tier.read("y"), tier.read("z")
+        assert tier.stats()["tier_promote"] == 0
+
+    def test_missing_object_raises(self):
+        tier, _, _ = mk_tier()
+        with pytest.raises(KeyError):
+            tier.read("nope")
+
+
+class TestAgent:
+    def test_agent_flushes_dirty_over_ratio(self):
+        tier, base, _ = mk_tier(target_max_bytes=8000,
+                                dirty_ratio=0.4, full_ratio=1.0)
+        objs = {f"d{i}": blob(10 + i) for i in range(8)}  # 8000 dirty
+        tier.write(objs)
+        # agent must have flushed down to <= 3200 dirty
+        assert tier.dirty_bytes <= 3200
+        for name, data in objs.items():
+            got = tier.read(name) if name in tier._size \
+                else np.asarray(base.read(name))
+            np.testing.assert_array_equal(got, data, err_msg=name)
+
+    def test_agent_evicts_cold_clean_over_full_ratio(self):
+        tier, base, _ = mk_tier(target_max_bytes=8000,
+                                dirty_ratio=0.1, full_ratio=0.5)
+        objs = {f"e{i}": blob(20 + i) for i in range(8)}
+        tier.write(objs)
+        assert tier.cache_bytes <= 4000
+        # every byte still readable through the tier (refetch on miss)
+        for name, data in objs.items():
+            np.testing.assert_array_equal(tier.read(name), data)
+        assert tier.stats()["tier_evict"] >= 1
+
+    def test_flush_evict_all_drains(self):
+        tier, base, cache = mk_tier()
+        objs = {f"f{i}": blob(30 + i) for i in range(4)}
+        tier.write(objs)
+        tier.flush_evict_all()
+        assert tier.stats()["objects"] == 0
+        assert tier.cache_bytes == 0
+        for name, data in objs.items():
+            np.testing.assert_array_equal(np.asarray(base.read(name)),
+                                          data)
+
+
+class TestWhiteouts:
+    def test_delete_dirty_object_propagates_on_flush(self):
+        tier, base, _ = mk_tier()
+        tier.write({"w": blob(7)})
+        tier.flush()                      # now in base too
+        tier.write({"w": blob(8)})        # dirty again
+        tier.remove("w")
+        with pytest.raises(KeyError):
+            tier.read("w")                # whiteout hides base copy
+        np.asarray(base.read("w"))        # base still has old bytes
+        tier.flush()
+        with pytest.raises(KeyError):
+            base.read("w")                # delete reached the base
+        with pytest.raises(KeyError):
+            tier.read("w")
+
+    def test_delete_cache_only_object(self):
+        tier, base, _ = mk_tier()
+        tier.write({"c": blob(9)})
+        tier.remove("c")                  # never reached base
+        with pytest.raises(KeyError):
+            tier.read("c")
+        tier.flush()                      # no whiteout explosion
+        with pytest.raises(KeyError):
+            base.read("c")
+
+    def test_remove_unknown_raises(self):
+        tier, _, _ = mk_tier()
+        with pytest.raises(KeyError):
+            tier.remove("ghost")
+
+    def test_rewrite_after_whiteout(self):
+        tier, base, _ = mk_tier()
+        tier.write({"r": blob(11)})
+        tier.flush()
+        tier.remove("r")
+        fresh = blob(12)
+        tier.write({"r": fresh})          # write clears the whiteout
+        np.testing.assert_array_equal(tier.read("r"), fresh)
+        tier.flush()
+        np.testing.assert_array_equal(np.asarray(base.read("r")), fresh)
