@@ -215,6 +215,13 @@ class JerasureBitmatrix(ErasureCode):
     """liberation / blaum_roth / liber8tion coder: XOR schedules over
     chunk packets, batched on device."""
 
+    # a coding BYTE is the XOR of input bytes from OTHER packet rows
+    # (different intra-chunk offsets), so no per-byte-position GF(256)
+    # repair matrix exists: the derived batch_decoder must refuse
+    # immediately instead of paying 3 failing probe rounds per loss
+    # pattern, and the RMW window path must use whole-object decode
+    positionwise = False
+
     def init(self, profile: Mapping[str, str]) -> None:
         self.k = int(profile.get("k", 4))
         self.m = int(profile.get("m", 2))

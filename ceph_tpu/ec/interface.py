@@ -93,7 +93,30 @@ class ErasureCode(abc.ABC):
         helpers, repair planes selected on device). Returns None when
         the codec has no static-matrix form for this pattern; callers
         must then use decode_chunks."""
-        return None
+        if not getattr(self, "positionwise", True):
+            return None          # byte positions couple (clay
+        #                          overrides with its sub-chunk plan)
+        impl = getattr(self, "impl", None) or "mxu"
+        if impl == "ref":
+            return None          # numpy oracle: no device path
+        erasures = tuple(int(e) for e in erasures)
+        survivors = tuple(int(s) for s in survivors)
+        cache = self.__dict__.setdefault("_bd_cache", {})
+        fn = cache.get((erasures, survivors))
+        if fn is None:
+            from ..ops.rs_kernels import make_encoder
+            from .linearize import derive_repair_matrix
+            R = None
+            for seed in range(3):  # a random probe matrix is singular
+                try:               # ~0.4% of the time even when the
+                    R = derive_repair_matrix(   # helpers suffice
+                        self, erasures, survivors, seed=seed)
+                    break
+                except ValueError:
+                    continue
+            fn = make_encoder(R, impl) if R is not None else False
+            cache[(erasures, survivors)] = fn
+        return fn or None
 
     # -- availability ------------------------------------------------------
 

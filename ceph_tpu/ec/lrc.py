@@ -136,7 +136,6 @@ class Lrc(ErasureCode):
         self.data_positions = tuple(i for i, c in enumerate(mapping)
                                     if c == "D")
         self.layers: list[_Layer] = []
-        self._bd_cache: dict[tuple, object] = {}
         covered: set[int] = set(self.data_positions)
         written: set[int] = set(self.data_positions)
         for spec in layer_specs:
@@ -292,38 +291,15 @@ class Lrc(ErasureCode):
 
     # -- device fast path ---------------------------------------------------
 
-    def batch_decoder(self, erasures: Sequence[int],
-                      survivors: Sequence[int]):
-        """Static repair matrix for the fused recovery path: the whole
-        layered plan (possibly multi-stage — local then global) is one
-        GF matrix over exactly the given survivor rows, derived via
-        ec/linearize (the code is positionwise-linear, so the plan's
-        composition is too). Returns None when the survivors can't
-        produce the erasures (the planner would raise). Ref:
-        ErasureCodeLrc::minimum_to_decode layer walk — here the walk
-        collapses into one device launch."""
-        erasures = tuple(int(e) for e in erasures)
-        survivors = tuple(int(s) for s in survivors)
-        key = (erasures, survivors)
-        fn = self._bd_cache.get(key)
-        if fn is None:
-            from ..ops.rs_kernels import make_encoder
-            from .linearize import derive_repair_matrix
-            R = None
-            for seed in range(3):   # a random probe matrix is singular
-                try:                # ~0.4% of the time even when the
-                    R = derive_repair_matrix(self, erasures,  # helpers
-                                             survivors, seed=seed)  # suffice
-                    break
-                except ValueError:
-                    continue
-            if R is None:
-                self._bd_cache[key] = False
-                return None
-            impl = getattr(self.layers[0].coder, "impl", "mxu")
-            fn = make_encoder(R, impl)
-            self._bd_cache[key] = fn
-        return fn or None
+    @property
+    def impl(self) -> str:
+        """Device lowering for the base class's derived batch_decoder
+        (the layered plan collapses to ONE static GF matrix via
+        ec/linearize — positionwise-linear, so the multi-stage local/
+        global walk composes into a single device launch; ref:
+        ErasureCodeLrc::minimum_to_decode layer walk)."""
+        return getattr(self.layers[0].coder, "impl", "mxu") \
+            if self.layers else "mxu"
 
     # -- decode ------------------------------------------------------------
 

@@ -390,7 +390,9 @@ class Messenger:
             nonce_c = b""
             if self.mode == MODE_SECURE:
                 nonce_c = self._recv_exact(sock, 16)
-            sock.sendall(BANNER + self.instance_nonce)
+            me = self.name.encode()
+            sock.sendall(BANNER + self.instance_nonce
+                         + struct.pack("<H", len(me)) + me)
             # report seen=0 toward a NEW peer incarnation (its seq
             # space restarted) — but do NOT mutate session state yet:
             # an unauthenticated dialer must not be able to reset the
@@ -484,6 +486,20 @@ class Messenger:
                 sock.close()
                 raise ConnectionError(f"bad banner from {peer}")
             peer_inst = self._recv_exact(sock, 8)
+            # verify WHO answered: addresses are ephemeral localhost
+            # ports, and the OS can hand a dead daemon's port to the
+            # next daemon that binds — a ping meant for the corpse
+            # would then be cheerfully ponged by an unrelated live
+            # daemon, keeping the dead peer "alive" forever and
+            # stalling failure detection (ref: ProtocolV2 peer
+            # entity/addr validation aborting mismatched connections)
+            anlen = struct.unpack("<H", self._recv_exact(sock, 2))[0]
+            actual = self._recv_exact(sock, anlen).decode()
+            if actual != peer:
+                sock.close()
+                raise ConnectionError(
+                    f"dialed {peer} but reached {actual} "
+                    f"(stale address / reused port)")
             peer_seen = struct.unpack("<Q",
                                       self._recv_exact(sock, 8))[0]
             peer_mode = self._recv_exact(sock, 1)[0]
@@ -602,6 +618,14 @@ class Messenger:
         """Queue + transmit; survives connection death (replayed on
         the next reconnect). Raises only if the peer is unknown or the
         payload won't encode."""
+        if self._stopping:
+            # a shut-down messenger models a DEAD process: its
+            # lingering threads (a reconcile mid-flight at SIGKILL, a
+            # dispatch answering a late ping) must not re-dial out,
+            # replay queues, and resurrect the daemon on the wire —
+            # that keeps a killed OSD "alive" to its peers and stalls
+            # failure detection indefinitely
+            raise ConnectionError(f"{self.name}: messenger is shut down")
         e = Encoder()
         msg.encode_payload(e)
         payload = e.bytes()

@@ -111,6 +111,9 @@ class CacheTier:
             self.cache.write({name: data})
             self._account(name, int(data.size), dirty=False)
             self._touch[name] = self._tick
+            # reset recency: an evicted-then-missed object must earn
+            # promotion again, not bounce straight back in (churn)
+            self._hits.pop(name, None)
             self._agent()
         else:
             # below the promotion threshold: serve THROUGH the tier
@@ -125,7 +128,15 @@ class CacheTier:
         miss."""
         self._tick += 1
         names = [names] if isinstance(names, str) else list(names)
-        for name in names:
+        # validate the WHOLE batch before mutating anything (the
+        # recover_shards convention): a bad name mid-batch must not
+        # leave a half-applied delete the retry then trips over
+        for name in dict.fromkeys(names):
+            if name in self._whiteout or (
+                    name not in self._size
+                    and not self._exists_in_base(name)):
+                raise KeyError(f"no object {name!r}")
+        for name in dict.fromkeys(names):
             if name in self._whiteout:
                 # already logically deleted: delete must agree with
                 # read (which raises) — and not double-count stats
@@ -207,10 +218,10 @@ class CacheTier:
                 self._dirty_bytes -= self._size[n]
             self.perf.inc("tier_flush", len(names))
         if self._whiteout:
-            gone = [n for n in self._whiteout
-                    if self._exists_in_base(n)]
-            if gone:
-                self.base.remove(gone)
+            # invariant: whiteouts are only created for names verified
+            # in base, and only this tier deletes from base — no
+            # re-probe needed
+            self.base.remove(sorted(self._whiteout))
             self._whiteout.clear()
         return len(names)
 

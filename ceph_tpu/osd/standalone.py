@@ -670,6 +670,7 @@ class OSDDaemon:
         # reachable during the restoring reconcile); retried on every
         # later reconcile until clean
         self._rewind_pending: dict[int, set[str]] = {}
+        self._restore_backoff: dict[int, float] = {}
         self.suspect: set[int] = set()            # osd ids (local view)
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
@@ -932,7 +933,7 @@ class OSDDaemon:
                     or not _valid_osd(osd, n_osds):
                 continue
             rs = RemoteStore(
-                self.rpc, f"osd.{osd}", timeout=2.0,
+                self.rpc, f"osd.{osd}", timeout=1.0,
                 authorize=self._authorize_peer
                 if self.verifier is not None else None)
             # a previous interval may have slotted this peer anywhere:
@@ -948,6 +949,13 @@ class OSDDaemon:
                 except KeyError:
                     heard.add(osd)   # answered: no blob at this slot
                 except (ConnectionError, OSError):
+                    # unreachable: SUSPECT it (the store-op failure
+                    # convention) so the next gather skips it instead
+                    # of re-paying the timeout — an unpartitioned
+                    # reconcile must never be starved by timeout loops
+                    # against partitioned peers (that starves the
+                    # heartbeat thread and stalls failure detection)
+                    self.suspect.add(osd)
                     break
 
         def pick(blobs: list[bytes]) -> bytes | None:
@@ -1195,9 +1203,16 @@ class OSDDaemon:
                 continue
             be = self.backends.get(ps)
             if be is None:
+                now_m = time.monotonic()
+                if now_m < self._restore_backoff.get(ps, 0.0):
+                    continue        # recent below-quorum gather:
+                #                     don't re-pay its RPC timeouts
+                #                     on every map/heartbeat tick
                 be = self._restore_backend(ps, acting)
                 if be is None:      # info gather below quorum:
+                    self._restore_backoff[ps] = now_m + 2.0
                     continue        # retried by the heartbeat tick
+                self._restore_backoff.pop(ps, None)
                 self.backends[ps] = be
                 if getattr(be, "restored_from_blob", False):
                     # ACTIVATION (the last_epoch_started role): stamp

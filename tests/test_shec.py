@@ -132,3 +132,24 @@ def test_device_impl_matches_ref():
     data = rng.integers(0, 256, size=(2, 4, 256), dtype=np.uint8)
     np.testing.assert_array_equal(ref.encode_chunks(data),
                                   dev.encode_chunks(data))
+
+
+def test_batch_decoder_fused_path():
+    """SHEC inherits the derived static-matrix fast path (base-class
+    batch_decoder via ec/linearize): bit-exact vs decode_chunks for
+    single and double losses."""
+    import numpy as np
+    from ceph_tpu.ec.registry import factory
+    coder = factory("plugin=shec k=4 m=3 c=2")
+    n = coder.get_chunk_count()
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (3, 4, 512), np.uint8)
+    parity = np.asarray(coder.encode_chunks(data))
+    full = np.concatenate([data, parity], axis=1)
+    for lost in ([2], [0, 4]):
+        avail = [i for i in range(n) if i not in lost]
+        helpers = sorted(coder.minimum_to_decode(lost, avail))
+        fn = coder.batch_decoder(lost, helpers)
+        assert fn is not None
+        got = np.asarray(fn(full[:, helpers]))
+        np.testing.assert_array_equal(got, full[:, lost])
