@@ -719,3 +719,39 @@ class TestDivergentLogRewind:
         for name, want in objs.items():
             assert cl2.read(name) == want, name
         assert cl2.read("after-takeover") == b"new history"
+
+
+class TestQuarantine:
+    def test_quarantine_moves_bytes_with_hinfo(self, cluster):
+        """Interval-discontinuity leftovers move to <pgid>.quarantine
+        with their integrity xattr — preserved for the operator,
+        invisible to reads/scrub/stray-sweep (r5 review finding)."""
+        from ceph_tpu.osd.ecbackend import shard_cid
+        from ceph_tpu.osd.memstore import Transaction
+        from ceph_tpu.osd.pgbackend import HINFO_KEY
+        cl = cluster.client()
+        cl.write({"seed": b"x" * 200})
+        ps = cl.osdmap.object_to_pg(1, "seed")[1]
+        prim = cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+        pd = cluster.osds[prim]
+        pgid = f"1.{ps}"
+        with pd._lock:
+            be = pd.backends[ps]
+            slot = next(s for s, o in enumerate(be.acting)
+                        if o == prim)
+            cid = shard_cid(pgid, slot)
+            pd.store.queue_transaction(
+                Transaction().write(cid, "orphan", 0, b"Q" * 64)
+                .setattr(cid, "orphan", HINFO_KEY, b"\x01fakehinfo"))
+            pd._quarantine_divergent(ps, be, ["orphan"])
+        qcid = f"{pgid}.quarantine"
+        qoid = f"orphan@s{slot}"
+        assert not pd.store.exists(cid, "orphan")
+        assert pd.store.exists(qcid, qoid)
+        assert bytes(pd.store.read(qcid, qoid)) == b"Q" * 64
+        assert pd.store.getattr(qcid, qoid, HINFO_KEY) \
+            == b"\x01fakehinfo"
+        # repair's stray sweep must not touch the quarantine
+        with pd._lock:
+            be.repair_pg(dead_osds=set(pd.suspect))
+        assert pd.store.exists(qcid, qoid)
