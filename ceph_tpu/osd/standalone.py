@@ -38,6 +38,15 @@ Key design points, and what they re-validate from the in-process sim:
   down+out through its quorum and broadcasts the new epoch; primaries
   then recover the lost slot onto the CRUSH replacement — every step
   as frames.
+* Op ordering: client ops execute under ONE daemon lock — a TOTAL
+  order per primary, a strict superset of the reference's guarantee
+  (PrimaryLogPG::execute_ctx orders per object within a PG; ops on
+  different objects/PGs may interleave there). Every ordering the
+  reference promises holds here by construction; what this tier does
+  NOT model is the reference's cross-PG op CONCURRENCY (OSDShard
+  queues) — per-PG parallel dispatch is a scaling concern of the
+  CPU daemon, deliberately traded away in a tier whose batched data
+  plane does its parallelism inside device launches (SURVEY §2.7 P2).
 
 Scope: this tier proves the wire transport under daemon death AND
 the monitor control plane on the same wire — rank election over ping
