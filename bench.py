@@ -898,7 +898,8 @@ def _section_isolated(name: str, skip: set, fn, *, timeout: float,
     subprocessing it would just pay jit cache misses twice). A child
     that dies, hangs, or comes back CPU-only is retried once with a
     smaller working set; its measured extras merge into STATE."""
-    if not STATE["tpu_ok"]:
+    force = os.environ.get("BENCH_FORCE_ISOLATE") == "1"
+    if not STATE["tpu_ok"] and not force:
         return _section(name, skip, fn, **kw)
     if name in skip:
         log(f"section {name}: skipped via BENCH_SKIP")
@@ -916,6 +917,12 @@ def _section_isolated(name: str, skip: set, fn, *, timeout: float,
         env["BENCH_SECTION_ONLY"] = name
         env["BENCH_TPU_WAIT"] = "120"
         env["BENCH_DEADLINE"] = str(int(child_timeout - 15.0))
+        if not STATE["tpu_ok"]:
+            # forced-isolation exercise on a CPU host: pin the child
+            # to CPU outright instead of letting it probe the dead
+            # tunnel for 120s per attempt
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
         log(f"section {name}: child attempt {attempt} "
             f"(timeout {child_timeout:.0f}s, overrides {overrides})")
         try:
@@ -946,7 +953,11 @@ def _section_isolated(name: str, skip: set, fn, *, timeout: float,
             continue
         for err in payload.get("errors", []):
             STATE["errors"].append(f"[child {name}] {err}"[:300])
-        if not payload.get("tpu_ok"):
+        # a CPU child is acceptable ONLY when the parent itself is on
+        # CPU (the forced-isolation test path) — a TPU artifact must
+        # never absorb a fallback child's shrunk CPU numbers, forced
+        # or not
+        if not payload.get("tpu_ok") and STATE["tpu_ok"]:
             fail(f"section {name}",
                  f"child fell back to {payload.get('backend')}; "
                  f"not merging CPU numbers into a TPU artifact")
