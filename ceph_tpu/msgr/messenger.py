@@ -311,6 +311,11 @@ class Messenger:
         self._conns: dict[str, _Conn] = {}
         self._addr_of: dict[str, tuple] = {}
         self._blocked: set[str] = set()        # partition injection
+        # ms_inject_socket_failures analog: every Nth send kills the
+        # live socket first (0 = off); _inject_fired counts teardowns
+        self._inject_every = 0
+        self._inject_count = 0
+        self._inject_fired = 0
         self._stopping = False
         self._listener = socket.create_server((host, 0))
         self.addr = self._listener.getsockname()
@@ -591,8 +596,10 @@ class Messenger:
         self._addr_of[peer] = tuple(addr)
 
     def set_blocked(self, peers) -> None:
-        """Partition injection (the ms_inject_socket_failures analog,
-        ref: src/msg/Messenger.h ms_inject_* debug knobs): frames
+        """Partition injection (the ms_inject_delay/partition debug
+        role, ref: src/msg/Messenger.h ms_inject_* knobs; socket
+        failures have their own knob: set_inject_socket_failures):
+        frames
         to/from these peer NAMES stop flowing — live connections are
         killed, new dials raise, inbound handshakes are refused.
         Queued messages stay unacked and replay on heal, which is
@@ -629,6 +636,22 @@ class Messenger:
         e = Encoder()
         msg.encode_payload(e)
         payload = e.bytes()
+        # ms_inject_socket_failures (ref: src/msg/Messenger.h debug
+        # knob): every Nth send tears the live socket down FIRST, so
+        # this message and any unacked predecessors must survive
+        # through reconnect + replay under real traffic. The knob is
+        # snapshotted under the lock: a concurrent disable (every=0)
+        # must not hit the modulo mid-send
+        victim = None
+        with self._lock:
+            every = self._inject_every
+            if every:
+                self._inject_count += 1
+                if self._inject_count % every == 0:
+                    victim = self._conns.get(peer)
+        if victim is not None and victim.alive:
+            self._inject_fired += 1
+            victim.close()
         with self._plock(peer):
             with self._lock:
                 seq = self._out_seq.get(peer, 0) + 1
@@ -652,6 +675,16 @@ class Messenger:
                     if conn is not None \
                             and self._conns.get(peer) is conn:
                         del self._conns[peer]
+
+    def set_inject_socket_failures(self, every: int) -> None:
+        """Tear the live connection down on every Nth send (the
+        reference's ms_inject_socket_failures debug knob); 0 turns
+        injection off. Exactly-once delivery must hold regardless —
+        the lossless replay + receiver seq dedup absorb the chaos."""
+        if every < 0:
+            raise ValueError("every must be >= 0")
+        with self._lock:
+            self._inject_every = int(every)
 
     def flush(self, peer: str, timeout: float = 10.0) -> bool:
         """Block until the peer acked everything (or timeout). The

@@ -2943,8 +2943,20 @@ class StandaloneCluster:
         eps += [(c.msgr.name, c.msgr) for c in self.clients]
         return eps
 
+    def inject_socket_failures(self, every: int,
+                               osds=None) -> None:
+        """Enable ms_inject_socket_failures on the given OSD daemons
+        (default: all alive): every Nth send tears the live socket
+        down first, so the whole data+control plane runs through
+        reconnect+replay continuously. 0 disables."""
+        targets = osds if osds is not None else list(self.osds)
+        for o in targets:
+            d = self.osds[o]
+            if not d._stop.is_set():
+                d.msgr.set_inject_socket_failures(every)
+
     def partition(self, *groups) -> None:
-        """Install a network partition (the ms_inject_socket_failures
+        """Install a network partition (the partition-injection
         role, SURVEY §4): endpoints named in different groups cannot
         exchange frames — enforced at BOTH ends of every cross-group
         pair. Endpoints in no group stay fully connected (so a

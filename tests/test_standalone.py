@@ -755,3 +755,30 @@ class TestQuarantine:
         with pd._lock:
             be.repair_pg(dead_osds=set(pd.suspect))
         assert pd.store.exists(qcid, qoid)
+
+
+class TestSocketFailureInjection:
+    def test_io_survives_continuous_socket_teardown(self, cluster):
+        """ms_inject_socket_failures parity (ref: src/msg/Messenger.h
+        debug knobs; qa fault-injection tier): with every 5th send
+        tearing its socket down first, client I/O, shard fan-out, and
+        heartbeats all run through reconnect+replay — every byte must
+        survive, exactly once."""
+        cluster.inject_socket_failures(5)
+        try:
+            cl = cluster.client()
+            objs = corpus(91, n=16)
+            cl.write(objs)
+            for name, want in objs.items():
+                assert cl.read(name) == want, name
+            # injection really fired (not a vacuous pass)
+            fired = sum(d.msgr._inject_fired
+                        for d in cluster.osds.values()
+                        if not d._stop.is_set())
+            assert fired > 0
+            # the cluster stays healthy under sustained injection
+            cluster.wait_for_clean(timeout=30)
+        finally:
+            cluster.inject_socket_failures(0)
+        for name, want in objs.items():
+            assert cl.read(name) == want, name
