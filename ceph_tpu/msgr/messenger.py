@@ -316,6 +316,14 @@ class Messenger:
         self._inject_every = 0
         self._inject_count = 0
         self._inject_fired = 0
+        # ms_inject_delay analog: uniform [0, max_ms] sleep before
+        # every Nth transmit (0 = off) — injects timing skew and
+        # CROSS-peer reordering (within one peer the per-peer lock +
+        # seq assignment after the sleep keep frames in order); it
+        # stresses timeout boundaries, not the seq dedup
+        self._delay_every = 0
+        self._delay_max_ms = 0.0
+        self._delay_count = 0
         self._stopping = False
         self._listener = socket.create_server((host, 0))
         self.addr = self._listener.getsockname()
@@ -596,10 +604,9 @@ class Messenger:
         self._addr_of[peer] = tuple(addr)
 
     def set_blocked(self, peers) -> None:
-        """Partition injection (the ms_inject_delay/partition debug
-        role, ref: src/msg/Messenger.h ms_inject_* knobs; socket
-        failures have their own knob: set_inject_socket_failures):
-        frames
+        """Partition injection (ref: src/msg/Messenger.h ms_inject_*
+        debug-knob family; socket failures and delays have their own
+        knobs: set_inject_socket_failures / set_inject_delay): frames
         to/from these peer NAMES stop flowing — live connections are
         killed, new dials raise, inbound handshakes are refused.
         Queued messages stay unacked and replay on heal, which is
@@ -643,12 +650,22 @@ class Messenger:
         # snapshotted under the lock: a concurrent disable (every=0)
         # must not hit the modulo mid-send
         victim = None
+        delay_s = 0.0
         with self._lock:
             every = self._inject_every
             if every:
                 self._inject_count += 1
                 if self._inject_count % every == 0:
                     victim = self._conns.get(peer)
+            if self._delay_every:
+                self._delay_count += 1
+                if self._delay_count % self._delay_every == 0:
+                    import random
+                    delay_s = random.uniform(
+                        0, self._delay_max_ms) / 1e3
+        if delay_s:
+            import time as _time
+            _time.sleep(delay_s)
         if victim is not None and victim.alive:
             self._inject_fired += 1
             victim.close()
@@ -675,6 +692,17 @@ class Messenger:
                     if conn is not None \
                             and self._conns.get(peer) is conn:
                         del self._conns[peer]
+
+    def set_inject_delay(self, every: int, max_ms: float) -> None:
+        """Sleep uniform [0, max_ms] before every Nth transmit (the
+        ms_inject_delay_max/_probability debug role); every=0 turns it
+        off. Delays happen on the SENDER's dispatch path, exactly
+        where the reference's injection sits."""
+        if every < 0 or max_ms < 0:
+            raise ValueError("every and max_ms must be >= 0")
+        with self._lock:
+            self._delay_every = int(every)
+            self._delay_max_ms = float(max_ms)
 
     def set_inject_socket_failures(self, every: int) -> None:
         """Tear the live connection down on every Nth send (the

@@ -2955,6 +2955,17 @@ class StandaloneCluster:
             if not d._stop.is_set():
                 d.msgr.set_inject_socket_failures(every)
 
+    def inject_delays(self, every: int, max_ms: float,
+                      osds=None) -> None:
+        """Enable ms_inject_delay on the given OSD daemons (default:
+        all alive): uniform [0, max_ms] sleep before every Nth
+        transmit."""
+        targets = osds if osds is not None else list(self.osds)
+        for o in targets:
+            d = self.osds[o]
+            if not d._stop.is_set():
+                d.msgr.set_inject_delay(every, max_ms)
+
     def partition(self, *groups) -> None:
         """Install a network partition (the partition-injection
         role, SURVEY §4): endpoints named in different groups cannot
