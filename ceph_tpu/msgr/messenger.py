@@ -324,6 +324,7 @@ class Messenger:
         self._delay_every = 0
         self._delay_max_ms = 0.0
         self._delay_count = 0
+        self._delay_fired = 0
         self._stopping = False
         self._listener = socket.create_server((host, 0))
         self.addr = self._listener.getsockname()
@@ -665,6 +666,7 @@ class Messenger:
                         0, self._delay_max_ms) / 1e3
         if delay_s:
             import time as _time
+            self._delay_fired += 1
             _time.sleep(delay_s)
         if victim is not None and victim.alive:
             self._inject_fired += 1

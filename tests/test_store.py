@@ -591,3 +591,26 @@ class TestTinStoreCompression:
         for ps in range(c.pg_num):
             rep = c.pgs[ps].deep_scrub(dead_osds=c._dead_osds())
             assert rep["inconsistent"] == []
+
+
+def test_store_bench_tool_smoke():
+    """tools/store_bench.py (the fio_ceph_objectstore role) runs both
+    backends and emits sane JSON."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for extra in (["--store", "mem"], ["--store", "tin"]):
+        r = subprocess.run(
+            [sys.executable, "tools/store_bench.py", "--seconds", "0.5",
+             "--objects", "32", "--object-size", "8192", "--json",
+             *extra, "randwrite"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=repo)
+        assert r.returncode == 0, r.stderr[-400:]
+        d = json.loads(r.stdout.strip().splitlines()[-1])
+        assert d["iops"] > 0 and d["mb_per_s"] > 0
+        assert d["ops"] >= d["txn_ops"]

@@ -1,0 +1,140 @@
+"""store bench — direct ObjectStore transaction throughput.
+
+Recreation of the reference's FIO objectstore harness (ref:
+src/test/fio/fio_ceph_objectstore.cc — drives ObjectStore::
+queue_transaction directly, bypassing the OSD/PG layers, to measure
+the store itself; workloads mirror fio's write/randwrite/read/randread
+over fixed-size objects).
+
+Backends: mem (MemStore), tin (TinStore, optionally with inline
+compression and O_DSYNC) — the same pair the contract suite
+parameterizes (tests/test_store.py, the store_test.cc role).
+
+  python tools/store_bench.py --store mem write
+  python tools/store_bench.py --store tin --o-dsync randwrite
+  python tools/store_bench.py --store tin --compression zlib read
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_store(args):
+    if args.store == "mem":
+        from ceph_tpu.osd.memstore import MemStore
+        return MemStore(), None
+    from ceph_tpu.osd.tinstore import TinStore
+    tmp = tempfile.mkdtemp(prefix="store_bench_")
+    st = TinStore(os.path.join(tmp, "dev"), o_dsync=args.o_dsync,
+                  compression=args.compression)
+    return st, tmp
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("workload",
+                    choices=["write", "randwrite", "read", "randread"])
+    ap.add_argument("--store", choices=["mem", "tin"], default="mem")
+    ap.add_argument("--object-size", type=int, default=64 * 1024)
+    ap.add_argument("--objects", type=int, default=256,
+                    help="working-set size")
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--txn-ops", type=int, default=8,
+                    help="ops batched per transaction "
+                         "(the queue_transaction unit)")
+    ap.add_argument("--o-dsync", action="store_true",
+                    help="tin: O_DSYNC on the data device")
+    ap.add_argument("--compression", default=None,
+                    choices=[None, "zlib", "lzma"])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.object_size <= 0 or args.objects <= 0 \
+            or args.txn_ops <= 0 or args.seconds <= 0:
+        raise SystemExit("store_bench: sizes/counts/seconds must be "
+                         "positive")
+
+    from ceph_tpu.osd.memstore import Transaction
+    st, tmp = build_store(args)
+    cid = "bench"
+    st.queue_transaction(Transaction().create_collection(cid))
+    rng = np.random.default_rng(0)
+    payloads = [rng.integers(0, 256, args.object_size, np.uint8)
+                .tobytes() for _ in range(8)]
+
+    def name(i):
+        return f"o{i % args.objects:06d}"
+
+    # stage the working set (read workloads need it; write workloads
+    # get steady-state overwrite behavior instead of cold creates)
+    for i in range(args.objects):
+        st.queue_transaction(Transaction().write(
+            cid, name(i), 0, payloads[i % len(payloads)]))
+
+    order = (rng.permutation(args.objects)
+             if args.workload.startswith("rand") else None)
+    lat: list[float] = []
+    n_ops = 0
+    t_start = time.perf_counter()
+    t_end = t_start + args.seconds
+    i = 0
+    while time.perf_counter() < t_end:
+        if args.workload.endswith("write"):
+            t = Transaction()
+            for _ in range(args.txn_ops):
+                j = order[i % args.objects] if order is not None else i
+                t.write(cid, name(j), 0,
+                        payloads[i % len(payloads)])
+                i += 1
+            t0 = time.perf_counter()
+            st.queue_transaction(t)
+            lat.append(time.perf_counter() - t0)
+            n_ops += args.txn_ops
+        else:
+            t0 = time.perf_counter()
+            for _ in range(args.txn_ops):
+                j = order[i % args.objects] if order is not None else i
+                st.read(cid, name(j))
+                i += 1
+            lat.append(time.perf_counter() - t0)
+            n_ops += args.txn_ops
+    dt = time.perf_counter() - t_start
+
+    a = np.sort(np.asarray(lat))
+    pick = lambda q: float(a[min(len(a) - 1, int(q * len(a)))])  # noqa: E731
+    out = {
+        "store": args.store, "workload": args.workload,
+        "object_size": args.object_size, "txn_ops": args.txn_ops,
+        "o_dsync": bool(args.o_dsync),
+        "compression": args.compression,
+        "seconds": round(dt, 3), "ops": n_ops,
+        "iops": round(n_ops / dt, 1),
+        "mb_per_s": round(n_ops * args.object_size / dt / 1e6, 2),
+        "p50_ms": round(pick(0.5) * 1e3, 3),
+        "p99_ms": round(pick(0.99) * 1e3, 3),
+        "note": "direct ObjectStore queue_transaction/read loop — "
+                "no OSD/PG layers (the fio_ceph_objectstore role)",
+    }
+    if tmp is not None:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"  {k:>12}: {v}")
+
+
+if __name__ == "__main__":
+    main()
