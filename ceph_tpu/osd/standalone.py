@@ -680,6 +680,16 @@ class OSDDaemon:
         # later reconcile until clean
         self._rewind_pending: dict[int, set[str]] = {}
         self._restore_backoff: dict[int, float] = {}
+        # admin-socket observability (ref: OpTracker/TrackedOp +
+        # PerfCounters served by `ceph daemon osd.N <cmd>`)
+        from ..utils.op_tracker import OpTracker
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.op_tracker = OpTracker()
+        b = PerfCountersBuilder(f"osd.{osd_id}")
+        for key in ("op", "op_r", "op_w", "op_in_bytes",
+                    "op_out_bytes"):
+            b.add_u64_counter(key)
+        self.perf = b.create_perf_counters()
         self.suspect: set[int] = set()            # osd ids (local view)
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
@@ -1317,7 +1327,31 @@ class OSDDaemon:
 
     # -- client ops ----------------------------------------------------------
 
-    _READ_KINDS = frozenset({"read", "snap_read"})
+    _READ_KINDS = frozenset({"read", "snap_read", "admin"})
+
+    _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
+                   "dump_historic_ops_by_duration",
+                   "dump_ops_in_flight", "slow_ops")
+
+    def _admin_cmd(self, cmd: str) -> bytes:
+        """`ceph daemon osd.N <cmd>` over the wire (ref: the admin
+        socket commands src/common/admin_socket.cc registers from
+        OpTracker + PerfCounters)."""
+        import json as _json
+        if cmd == "perf dump":
+            out = {self.perf.name: self.perf.dump()}
+        elif cmd == "dump_historic_ops":
+            out = self.op_tracker.dump_historic_ops()
+        elif cmd == "dump_historic_ops_by_duration":
+            out = self.op_tracker.dump_historic_ops(by_duration=True)
+        elif cmd == "dump_ops_in_flight":
+            out = self.op_tracker.dump_ops_in_flight()
+        elif cmd == "slow_ops":
+            out = {"slow_ops": self.op_tracker.slow_ops()}
+        else:
+            raise ValueError(f"unknown admin command {cmd!r}; "
+                             f"known: {list(self._ADMIN_CMDS)}")
+        return _json.dumps(out, sort_keys=True).encode()
 
     def _on_auth(self, peer: str, msg: MAuthOp) -> None:
         """Session establishment (ref: CephxAuthorizeHandler via
@@ -1364,8 +1398,21 @@ class OSDDaemon:
                     pass
                 return
         try:
-            with self._lock:
-                blob = self._client_op(msg.kind, msg.blob)
+            if msg.kind == "admin":
+                d = Decoder(msg.blob)
+                blob = self._admin_cmd(d.string())
+            else:
+                with self.op_tracker.create_op(
+                        f"osd_op({msg.kind}) client={peer}") as op:
+                    with self._lock:
+                        op.mark_event("reached_pg")
+                        blob = self._client_op(msg.kind, msg.blob)
+                    op.mark_event("commit_sent")
+                self.perf.inc("op")
+                self.perf.inc("op_r" if msg.kind in self._READ_KINDS
+                              else "op_w")
+                self.perf.inc("op_in_bytes", len(msg.blob))
+                self.perf.inc("op_out_bytes", len(blob))
             rep = MOSDOpReply(msg.req_id, True, msg.kind, blob)
         except Exception as e:   # noqa: BLE001 — reply, don't die
             rep = MOSDOpReply(msg.req_id, False, msg.kind,
@@ -1662,6 +1709,14 @@ class OSDDaemon:
         # from before a rotation it slept through). _start() rebuilds
         # the daemon's own ClientAuth + auth rpc on the new messenger.
         fresh._authed = {}
+        from ..utils.op_tracker import OpTracker as _OT
+        from ..utils.perf_counters import PerfCountersBuilder as _PB
+        fresh.op_tracker = _OT()   # in-RAM observability dies with
+        _b = _PB(self.perf.name)   # the process, like a real restart
+        for _key in ("op", "op_r", "op_w", "op_in_bytes",
+                     "op_out_bytes"):
+            _b.add_u64_counter(_key)
+        fresh.perf = _b.create_perf_counters()
         if fresh.verifier is not None:
             from ..auth import ServiceVerifier
             fresh.verifier = ServiceVerifier(
@@ -2569,6 +2624,29 @@ class Client:
             # serviceable primary — retry on the next map
             raise ConnectionError(f"pg 1.{ps} has no acting primary")
         return f"osd.{acting[0]}"
+
+    def daemon(self, osd: int, cmd: str, timeout: float = 10.0):
+        """`ceph daemon osd.N <cmd>` — daemon-addressed admin command
+        (perf dump / dump_historic_ops / dump_ops_in_flight /
+        slow_ops), served from the target's OpTracker/PerfCounters."""
+        import json as _json
+        e = Encoder()
+        e.string(cmd)
+        target = f"osd.{osd}"
+        rep = self.rpc.call(
+            target, lambda rid: MOSDOp(rid, True, "admin", e.bytes()),
+            timeout=timeout)
+        if not rep.ok and rep.err == "EPERM:unauthenticated" \
+                and self._cauth is not None:
+            self._authorize(target)
+            rep = self.rpc.call(
+                target,
+                lambda rid: MOSDOp(rid, True, "admin", e.bytes()),
+                timeout=timeout)
+        if not rep.ok:
+            raise RuntimeError(f"admin {cmd!r} on osd.{osd}: "
+                               f"{rep.err}")
+        return _json.loads(rep.blob)
 
     def _op(self, kind: str, ps: int, body_fn, timeout=None,
             retries=30, retry_sleep=0.3) -> bytes:

@@ -804,3 +804,32 @@ class TestSocketFailureInjection:
             assert fired > 0, "no delay ever actually slept"
         finally:
             cluster.inject_delays(0, 0.0)
+
+
+class TestAdminSocket:
+    def test_daemon_perf_and_historic_ops(self, cluster):
+        """`ceph daemon osd.N perf dump / dump_historic_ops` over the
+        wire (ref: admin_socket.cc commands from PerfCounters +
+        OpTracker)."""
+        cl = cluster.client()
+        objs = corpus(93, n=6)
+        cl.write(objs)
+        for name in objs:
+            cl.read(name)
+        probe = next(iter(objs))
+        ps = cl.osdmap.object_to_pg(1, probe)[1]
+        prim = cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+        perf = cl.daemon(prim, "perf dump")
+        c = perf[f"osd.{prim}"]
+        assert c["op"] > 0 and c["op_w"] > 0 and c["op_r"] > 0
+        assert c["op_in_bytes"] > 0 and c["op_out_bytes"] > 0
+        hist = cl.daemon(prim, "dump_historic_ops")
+        assert hist["num_ops"] > 0
+        ev = hist["ops"][0]["type_data"]["events"]
+        names = [e["event"] for e in ev]
+        assert "reached_pg" in names and "done" in names
+        inflight = cl.daemon(prim, "dump_ops_in_flight")
+        assert inflight["num_ops"] == 0   # nothing mid-dispatch now
+        assert cl.daemon(prim, "slow_ops")["slow_ops"] == []
+        with pytest.raises(RuntimeError, match="unknown admin"):
+            cl.daemon(prim, "nope")
