@@ -682,14 +682,7 @@ class OSDDaemon:
         self._restore_backoff: dict[int, float] = {}
         # admin-socket observability (ref: OpTracker/TrackedOp +
         # PerfCounters served by `ceph daemon osd.N <cmd>`)
-        from ..utils.op_tracker import OpTracker
-        from ..utils.perf_counters import PerfCountersBuilder
-        self.op_tracker = OpTracker()
-        b = PerfCountersBuilder(f"osd.{osd_id}")
-        for key in ("op", "op_r", "op_w", "op_in_bytes",
-                    "op_out_bytes"):
-            b.add_u64_counter(key)
-        self.perf = b.create_perf_counters()
+        self._init_observability()
         self.suspect: set[int] = set()            # osd ids (local view)
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
@@ -1327,6 +1320,20 @@ class OSDDaemon:
 
     # -- client ops ----------------------------------------------------------
 
+    def _init_observability(self) -> None:
+        """Fresh OpTracker + PerfCounters — called at boot AND on
+        revive (in-RAM observability dies with the process, like a
+        real restart); ONE list of counter keys so the two paths
+        cannot drift."""
+        from ..utils.op_tracker import OpTracker
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.op_tracker = OpTracker()
+        b = PerfCountersBuilder(f"osd.{self.osd_id}")
+        for key in ("op", "op_r", "op_w", "op_in_bytes",
+                    "op_out_bytes"):
+            b.add_u64_counter(key)
+        self.perf = b.create_perf_counters()
+
     _READ_KINDS = frozenset({"read", "snap_read", "admin"})
 
     _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
@@ -1709,14 +1716,7 @@ class OSDDaemon:
         # from before a rotation it slept through). _start() rebuilds
         # the daemon's own ClientAuth + auth rpc on the new messenger.
         fresh._authed = {}
-        from ..utils.op_tracker import OpTracker as _OT
-        from ..utils.perf_counters import PerfCountersBuilder as _PB
-        fresh.op_tracker = _OT()   # in-RAM observability dies with
-        _b = _PB(self.perf.name)   # the process, like a real restart
-        for _key in ("op", "op_r", "op_w", "op_in_bytes",
-                     "op_out_bytes"):
-            _b.add_u64_counter(_key)
-        fresh.perf = _b.create_perf_counters()
+        fresh._init_observability()
         if fresh.verifier is not None:
             from ..auth import ServiceVerifier
             fresh.verifier = ServiceVerifier(
@@ -2644,6 +2644,8 @@ class Client:
                 lambda rid: MOSDOp(rid, True, "admin", e.bytes()),
                 timeout=timeout)
         if not rep.ok:
+            if rep.err.startswith("EPERM:denied"):
+                raise PermissionError(rep.err)   # the _op contract
             raise RuntimeError(f"admin {cmd!r} on osd.{osd}: "
                                f"{rep.err}")
         return _json.loads(rep.blob)

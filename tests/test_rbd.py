@@ -92,3 +92,27 @@ def test_shrink_then_regrow_reads_zeros():
     img.resize(10_000)
     assert img.read(5_000, 5_000) == b"\x00" * 5_000
     assert img.read(0, 5_000) == b"A" * 5_000
+
+
+def test_rbd_bench_cli_smoke(tmp_path):
+    """`rbd bench` (ref: src/tools/rbd/action/Bench.cc) emits sane
+    JSON for both io types through the saved-state CLI."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    state = str(tmp_path / "st")
+    run = lambda *args: subprocess.run(
+        [sys.executable, "tools/rbd_cli.py", "--state", state, *args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    r = run("create", "img", "--size", "2M")
+    assert r.returncode == 0, r.stderr[-300:]
+    for io_type in ("write", "read"):
+        r = run("bench", "img", "--io-type", io_type,
+                "--io-size", "64K", "--io-total", "512K")
+        assert r.returncode == 0, r.stderr[-300:]
+        d = json.loads(r.stdout.strip().splitlines()[-1])
+        assert d["ios"] == 8 and d["iops"] > 0

@@ -177,6 +177,65 @@ def cmd_children(st: State, a) -> None:
         print(c)
 
 
+def cmd_bench(st: State, a) -> None:
+    """`rbd bench --io-type write|read` (ref: src/tools/rbd/action/
+    Bench.cc): timed sequential or random I/O against the image
+    through the full stack (librbd-shaped Image -> striper ->
+    librados -> EC pool)."""
+    import time
+
+    import numpy as np
+    from ceph_tpu.client.rbd import Image
+    img = Image(st.rbd, a.image)
+    size = img.size()
+    io_size = parse_size(a.io_size)
+    io_total = parse_size(a.io_total)
+    if io_size <= 0 or io_total <= 0:
+        raise SystemExit("rbd bench: io-size/io-total must be positive")
+    if io_size > size:
+        raise SystemExit(f"rbd bench: io-size {io_size} exceeds image "
+                         f"size {size}")
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, io_size, np.uint8).tobytes()
+    n_ios = max(1, io_total // io_size)
+    offsets = (rng.integers(0, max(1, size - io_size), n_ios)
+               if a.pattern == "rand"
+               else np.arange(n_ios) * io_size % max(1, size - io_size + 1))
+    if a.io_type == "read":
+        # stage only the benched range (unwritten extents read back
+        # as zeros anyway; full-image staging on a big image would
+        # dwarf the timed loop)
+        hi = int(max(offsets)) + io_size
+        for off in range(0, min(hi, size), io_size):
+            img.write(off, payload[:min(io_size, size - off)])
+    # one untimed op per path: jit compile happens here, not in the
+    # measured window (the warm-rate convention; cold p99 was ~5s)
+    if a.io_type == "write":
+        img.write(0, payload)
+    else:
+        img.read(0, io_size)
+    lat = []
+    t_start = time.perf_counter()
+    for off in offsets:
+        t0 = time.perf_counter()
+        if a.io_type == "write":
+            img.write(int(off), payload)
+        else:
+            img.read(int(off), io_size)
+        lat.append(time.perf_counter() - t0)
+    dt = time.perf_counter() - t_start
+    arr = sorted(lat)
+    pick = lambda q: arr[min(len(arr) - 1, int(q * len(arr)))]  # noqa: E731
+    out = {"image": a.image, "io_type": a.io_type,
+           "pattern": a.pattern, "io_size": io_size, "ios": len(lat),
+           "seconds": round(dt, 3),
+           "iops": round(len(lat) / dt, 1),
+           "mb_per_s": round(len(lat) * io_size / dt / 1e6, 2),
+           "p50_ms": round(pick(0.5) * 1e3, 3),
+           "p99_ms": round(pick(0.99) * 1e3, 3)}
+    print(json.dumps(out, sort_keys=True))
+
+
 def cmd_export(st: State, a) -> None:
     from ceph_tpu.client.rbd import Image
     img = Image(st.rbd, a.image)
@@ -252,6 +311,13 @@ def main(argv=None) -> None:
     p.add_argument("child")
     p = sub.add_parser("flatten"); p.add_argument("image")
     p = sub.add_parser("children"); p.add_argument("spec")
+    p = sub.add_parser("bench"); p.add_argument("image")
+    p.add_argument("--io-type", dest="io_type", default="write",
+                   choices=["write", "read"])
+    p.add_argument("--io-size", dest="io_size", default="64K")
+    p.add_argument("--io-total", dest="io_total", default="4M")
+    p.add_argument("--io-pattern", dest="pattern", default="seq",
+                   choices=["seq", "rand"])
     p = sub.add_parser("export"); p.add_argument("image")
     p.add_argument("dest"); p.add_argument("--snap")
     p = sub.add_parser("import"); p.add_argument("src")
@@ -270,7 +336,8 @@ def main(argv=None) -> None:
         {"create": cmd_create, "ls": cmd_ls, "info": cmd_info,
          "rm": cmd_rm, "resize": cmd_resize, "snap": cmd_snap,
          "clone": cmd_clone, "flatten": cmd_flatten,
-         "children": cmd_children, "export": cmd_export,
+         "children": cmd_children, "bench": cmd_bench,
+         "export": cmd_export,
          "import": cmd_import, "diff": cmd_diff,
          "export-diff": cmd_export_diff,
          "import-diff": cmd_import_diff}[a.cmd](st, a)
