@@ -1338,7 +1338,7 @@ class OSDDaemon:
 
     _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
                    "dump_historic_ops_by_duration",
-                   "dump_ops_in_flight", "slow_ops")
+                   "dump_ops_in_flight", "slow_ops", "pg stat")
 
     def _admin_cmd(self, cmd: str) -> bytes:
         """`ceph daemon osd.N <cmd>` over the wire (ref: the admin
@@ -1355,6 +1355,22 @@ class OSDDaemon:
             out = self.op_tracker.dump_ops_in_flight()
         elif cmd == "slow_ops":
             out = {"slow_ops": self.op_tracker.slow_ops()}
+        elif cmd == "pg stat":
+            # pg_state strings for the PGs this daemon primaries,
+            # through the GetInfo/GetLog/GetMissing classifier (the
+            # `ceph pg stat` slice a primary can answer; ref:
+            # PeeringState pg_state_t names)
+            from .peering import peer as _peer
+            with self._lock:
+                if self.osdmap is None:
+                    out = {"pgs": {}}
+                else:
+                    alive = [bool(u) and o not in self.suspect
+                             for o, u in enumerate(self.osdmap.osd_up)]
+                    out = {"pgs": {
+                        f"1.{ps}": _peer(be, alive,
+                                         compute_missing=False).state
+                        for ps, be in sorted(self.backends.items())}}
         else:
             raise ValueError(f"unknown admin command {cmd!r}; "
                              f"known: {list(self._ADMIN_CMDS)}")

@@ -833,3 +833,14 @@ class TestAdminSocket:
         assert cl.daemon(prim, "slow_ops")["slow_ops"] == []
         with pytest.raises(RuntimeError, match="unknown admin"):
             cl.daemon(prim, "nope")
+
+    def test_daemon_pg_stat(self, cluster):
+        """`ceph daemon osd.N pg stat`: pg_state strings from the
+        peering classifier for the PGs the daemon primaries."""
+        cl = cluster.client()
+        cl.write(corpus(94, n=4))
+        seen = {}
+        for osd in cluster.osd_ids():
+            seen.update(cl.daemon(osd, "pg stat")["pgs"])
+        assert len(seen) == cluster.pg_num
+        assert all(s.startswith("active") for s in seen.values()), seen
