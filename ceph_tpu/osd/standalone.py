@@ -680,6 +680,12 @@ class OSDDaemon:
         # later reconcile until clean
         self._rewind_pending: dict[int, set[str]] = {}
         self._restore_backoff: dict[int, float] = {}
+        # scheduled scrub bookkeeping (per primaried pg; ref: the
+        # scrubber's per-PG schedule, osd_scrub_min_interval /
+        # osd_deep_scrub_interval)
+        self._last_scrub: dict[int, float] = {}
+        self._last_deep: dict[int, float] = {}
+        self.scrub_reports: dict[int, dict] = {}
         # admin-socket observability (ref: OpTracker/TrackedOp +
         # PerfCounters served by `ceph daemon osd.N <cmd>`)
         self._init_observability()
@@ -1212,6 +1218,9 @@ class OSDDaemon:
                     self.snapsets.pop(ps, None)
                     self.births.pop(ps, None)
                     self.obj_kv.pop(ps, None)
+                    self.scrub_reports.pop(ps, None)
+                    self._last_scrub.pop(ps, None)
+                    self._last_deep.pop(ps, None)
                 continue
             be = self.backends.get(ps)
             if be is None:
@@ -1338,7 +1347,8 @@ class OSDDaemon:
 
     _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
                    "dump_historic_ops_by_duration",
-                   "dump_ops_in_flight", "slow_ops", "pg stat")
+                   "dump_ops_in_flight", "slow_ops", "pg stat",
+                   "dump_scrubs")
 
     def _admin_cmd(self, cmd: str) -> bytes:
         """`ceph daemon osd.N <cmd>` over the wire (ref: the admin
@@ -1355,6 +1365,10 @@ class OSDDaemon:
             out = self.op_tracker.dump_ops_in_flight()
         elif cmd == "slow_ops":
             out = {"slow_ops": self.op_tracker.slow_ops()}
+        elif cmd == "dump_scrubs":
+            with self._lock:   # heartbeat inserts concurrently
+                out = {"scrubs": {f"1.{ps}": r for ps, r in
+                                  sorted(self.scrub_reports.items())}}
         elif cmd == "pg stat":
             # pg_state strings for the PGs this daemon primaries,
             # through the GetInfo/GetLog/GetMissing classifier (the
@@ -1626,6 +1640,67 @@ class OSDDaemon:
         if peer.startswith("osd."):
             self._last_pong[int(peer[4:])] = time.monotonic()
 
+    def _maybe_scheduled_scrub(self) -> None:
+        """Background scrub scheduling (ref: PG scrub scheduling off
+        osd_scrub_min_interval / osd_deep_scrub_interval; the sim
+        tier schedules in virtual time, this one on the heartbeat).
+        Per primaried PG: shallow at osd_scrub_interval, deep at
+        osd_deep_scrub_interval; results land in scrub_reports
+        (served by the `dump_scrubs` admin command) and auto_repair
+        honors osd_scrub_auto_repair."""
+        ival = float(self.config["osd_scrub_interval"])
+        deep_ival = float(self.config["osd_deep_scrub_interval"])
+        if ival <= 0 and deep_ival <= 0:
+            return
+        if not self._lock.acquire(blocking=False):
+            return                # never stall the heartbeat
+        try:
+            now = time.monotonic()
+            # at most ONE due PG per beat: a multi-PG deep sweep under
+            # the daemon lock would block client ops and defer this
+            # beat's pings past the grace window
+            for ps, be in list(self.backends.items()):
+                deep_due = deep_ival > 0 and \
+                    now - self._last_deep.get(ps, 0.0) >= deep_ival
+                shallow_due = ival > 0 and \
+                    now - self._last_scrub.get(ps, 0.0) >= ival
+                if not (deep_due or shallow_due):
+                    continue
+                # stamp the ATTEMPT first: a persistently failing
+                # scrub retries at its interval, not every beat
+                # (the _restore_backoff lesson)
+                self._last_scrub[ps] = now
+                if deep_due:
+                    self._last_deep[ps] = now
+                try:
+                    if deep_due:
+                        rep = be.deep_scrub(
+                            dead_osds=set(self.suspect))
+                        rep["kind"] = "deep"
+                        if rep["inconsistent"] and bool(
+                                self.config["osd_scrub_auto_repair"]):
+                            be.repair_pg(dead_osds=set(self.suspect))
+                            rep["auto_repaired"] = True
+                    else:
+                        rep = be.shallow_scrub(
+                            skip_slots={s for s, o in
+                                        enumerate(be.acting)
+                                        if o in self.suspect})
+                        rep["kind"] = "shallow"
+                    rep["at"] = now
+                    self.scrub_reports[ps] = rep
+                    bad = rep.get("inconsistent") or rep.get("errors")
+                    if bad:
+                        self.c.log(f"{self.name}: scheduled "
+                                   f"{rep['kind']} scrub pg 1.{ps}: "
+                                   f"{len(bad)} inconsistenc(ies)")
+                except Exception as e:   # noqa: BLE001 — scrub must
+                    self.c.log(f"{self.name}: scheduled scrub pg "
+                               f"1.{ps} failed: {e}")  # not kill hb
+                break
+        finally:
+            self._lock.release()
+
     def _heartbeat_loop(self) -> None:
         beat = 0
         # interval/grace resolve through the daemon config each beat,
@@ -1656,6 +1731,7 @@ class OSDDaemon:
                                f"failed: {e!r}")   # thread must not die
                 finally:
                     self._lock.release()
+            self._maybe_scheduled_scrub()
             now = time.monotonic()
             for osd in self.c.osd_ids():
                 if osd == self.osd_id:

@@ -65,6 +65,13 @@ OPTIONS: list[Option] = [
            "seconds down before auto-out"),
     Option("osd_scrub_auto_repair", bool, False,
            "repair inconsistencies found by deep scrub"),
+    Option("osd_scrub_interval", float, 0.0,
+           "seconds between scheduled shallow scrubs per PG on the "
+           "wire tier (0 = manual only; the osd_scrub_min_interval "
+           "role)"),
+    Option("osd_deep_scrub_interval", float, 0.0,
+           "seconds between scheduled deep scrubs per PG on the wire "
+           "tier (0 = manual only)"),
     Option("erasure_code_profile", str,
            "plugin=tpu_rs k=8 m=3 technique=reed_sol_van",
            "default EC profile for new EC pools"),

@@ -844,3 +844,46 @@ class TestAdminSocket:
             seen.update(cl.daemon(osd, "pg stat")["pgs"])
         assert len(seen) == cluster.pg_num
         assert all(s.startswith("active") for s in seen.values()), seen
+
+
+class TestScheduledScrub:
+    def test_background_scrub_detects_and_repairs(self, cluster):
+        """Scheduled scrubbing on the wire tier (osd_scrub_interval /
+        osd_deep_scrub_interval roles), driven through CENTRALIZED
+        config: background deep scrub finds injected corruption and
+        osd_scrub_auto_repair fixes it without any operator op."""
+        import json
+        import time
+        from ceph_tpu.osd.ecbackend import shard_cid
+        from ceph_tpu.osd.memstore import Transaction
+        cl = cluster.client()
+        objs = corpus(95, n=6)
+        cl.write(objs)
+        probe = next(iter(objs))
+        ps = cl.osdmap.object_to_pg(1, probe)[1]
+        acting = cl.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        prim = acting[0]
+        # corrupt a non-primary shard's bytes on disk
+        slot = 1
+        st = cluster.osds[acting[slot]].store
+        st.queue_transaction(Transaction().write(
+            shard_cid(f"1.{ps}", slot), probe, 0, b"\xEE\xDD"))
+        cl.config_set("osd_deep_scrub_interval", "0.5")
+        cl.config_set("osd_scrub_auto_repair", "true")
+        try:
+            cluster._wait(
+                lambda: (cl.daemon(prim, "dump_scrubs")["scrubs"]
+                         .get(f"1.{ps}", {}).get("kind") == "deep"),
+                30, "scheduled deep scrub ran")
+            # auto-repair converges: eventually a CLEAN deep report
+            cluster._wait(
+                lambda: (lambda r: r.get("kind") == "deep"
+                         and not r.get("inconsistent"))(
+                    cl.daemon(prim, "dump_scrubs")["scrubs"]
+                    .get(f"1.{ps}", {})),
+                30, "deep scrub clean after auto-repair")
+        finally:
+            cl.config_set("osd_deep_scrub_interval", "0")
+            cl.config_set("osd_scrub_auto_repair", "false")
+        for name, want in objs.items():
+            assert cl.read(name) == want, name
