@@ -645,12 +645,7 @@ class _PgClsView:
 
     def remove(self, names) -> None:
         names = [names] if isinstance(names, str) else list(names)
-        # removal mutates the head too: preserve the newest snap's
-        # clone first or a cls-driven delete (refcount hitting zero)
-        # would destroy snapshot history
-        self._d._snap_guard(self._ps, self._be, names)
-        self._be.remove_objects(names,
-                                dead_osds=set(self._d.suspect))
+        self._d._delete_objects(self._ps, self._be, names)
 
     @property
     def obj_kv(self) -> dict:
@@ -1554,6 +1549,23 @@ class OSDDaemon:
         if changed:
             self._persist_meta(ps)
 
+    def _delete_objects(self, ps: int, be, names: list[str]) -> None:
+        """ONE delete path for the wire op and the cls shim:
+        COW-preserve heads a live snap still needs (make_writeable
+        before the delete), logged remove, per-object side state
+        dropped. IDEMPOTENT: already-absent names are skipped — a
+        client retrying a delete whose reply was lost must see
+        success, not KeyError (write/read are naturally retry-safe;
+        delete earns it by tolerating ENOENT, the reference's rados
+        semantics for a replayed delete)."""
+        present = [n for n in names if n in be.object_sizes]
+        if present:
+            self._snap_guard(ps, be, present)
+            be.remove_objects(present, dead_osds=set(self.suspect))
+        for name in names:
+            self.obj_kv.get(ps, {}).pop(name, None)
+            self.births.get(ps, {}).pop(name, None)
+
     def _client_op(self, kind: str, body: bytes) -> bytes:
         import json as _json
         d = Decoder(body)
@@ -1573,6 +1585,12 @@ class OSDDaemon:
                 # retry once degraded; the client write must not bounce
                 self._mark_suspects(be)
                 be.write_objects(objs, dead_osds=set(self.suspect))
+            self._persist_meta(ps)
+            return b""
+        if kind == "remove":
+            self._check_snapc(d.u64())
+            names = d.list(Decoder.string)
+            self._delete_objects(ps, be, names)
             self._persist_meta(ps)
             return b""
         if kind == "read":
@@ -1656,16 +1674,22 @@ class OSDDaemon:
             return                # never stall the heartbeat
         try:
             now = time.monotonic()
-            # at most ONE due PG per beat: a multi-PG deep sweep under
-            # the daemon lock would block client ops and defer this
-            # beat's pings past the grace window
-            for ps, be in list(self.backends.items()):
+            # at most ONE PG per beat (a multi-PG deep sweep under the
+            # daemon lock would block client ops for its whole
+            # duration), and the MOST OVERDUE due PG wins — first-due
+            # in dict order would starve later PGs whenever the
+            # interval is shorter than n_pgs * heartbeat_interval
+            due = []
+            for ps, be in self.backends.items():
                 deep_due = deep_ival > 0 and \
                     now - self._last_deep.get(ps, 0.0) >= deep_ival
                 shallow_due = ival > 0 and \
                     now - self._last_scrub.get(ps, 0.0) >= ival
-                if not (deep_due or shallow_due):
-                    continue
+                if deep_due or shallow_due:
+                    due.append((self._last_scrub.get(ps, 0.0), ps,
+                                be, deep_due))
+            if due:
+                _, ps, be, deep_due = min(due)
                 # stamp the ATTEMPT first: a persistently failing
                 # scrub retries at its interval, not every beat
                 # (the _restore_backoff lesson)
@@ -1677,7 +1701,9 @@ class OSDDaemon:
                         rep = be.deep_scrub(
                             dead_osds=set(self.suspect))
                         rep["kind"] = "deep"
-                        if rep["inconsistent"] and bool(
+                        found = (rep["inconsistent"]
+                                 or rep.get("digest_mismatch"))
+                        if found and bool(
                                 self.config["osd_scrub_auto_repair"]):
                             be.repair_pg(dead_osds=set(self.suspect))
                             rep["auto_repaired"] = True
@@ -1689,7 +1715,8 @@ class OSDDaemon:
                         rep["kind"] = "shallow"
                     rep["at"] = now
                     self.scrub_reports[ps] = rep
-                    bad = rep.get("inconsistent") or rep.get("errors")
+                    bad = (rep.get("inconsistent") or rep.get("errors")
+                           or rep.get("digest_mismatch"))
                     if bad:
                         self.c.log(f"{self.name}: scheduled "
                                    f"{rep['kind']} scrub pg 1.{ps}: "
@@ -1697,7 +1724,6 @@ class OSDDaemon:
                 except Exception as e:   # noqa: BLE001 — scrub must
                     self.c.log(f"{self.name}: scheduled scrub pg "
                                f"1.{ps} failed: {e}")  # not kill hb
-                break
         finally:
             self._lock.release()
 
@@ -1731,7 +1757,6 @@ class OSDDaemon:
                                f"failed: {e!r}")   # thread must not die
                 finally:
                     self._lock.release()
-            self._maybe_scheduled_scrub()
             now = time.monotonic()
             for osd in self.c.osd_ids():
                 if osd == self.osd_id:
@@ -1780,6 +1805,9 @@ class OSDDaemon:
                                            MOSDFailure(osd, alive=True))
                         except (KeyError, OSError, ConnectionError):
                             pass
+            # scrub LAST: this beat's pings are already out, so a long
+            # deep scrub cannot push our liveness past peers' grace
+            self._maybe_scheduled_scrub()
 
     def kill(self) -> None:
         """SIGKILL: stop answering everything, drop RAM state."""
@@ -2775,6 +2803,13 @@ class Client:
                     # deterministic, retrying can't change the answer
                     from .objclass import ClsError
                     raise ClsError(rep.err[9:])
+                if rep.err.startswith("KeyError"):
+                    # no-such-object is deterministic at the primary
+                    # that answered: 30 retry sleeps cannot make a
+                    # deleted object reappear — break to the final
+                    # KeyError raise (an inline raise would be eaten
+                    # by the transport-retry except below)
+                    break
             except PermissionError:
                 raise
             except (ConnectionError, KeyError, OSError) as err:
@@ -2804,6 +2839,21 @@ class Client:
         ps = self.osdmap.object_to_pg(1, name)[1]
         return self._op("read", ps,
                         lambda e: e.string(name))
+
+    def remove(self, names) -> None:
+        """Delete objects (a LOGGED mutation: a shard down across
+        the delete replays it on rejoin instead of resurrecting a
+        stale copy — the pg_log_entry_t DELETE semantics the backend
+        already enforces)."""
+        names = [names] if isinstance(names, str) else list(names)
+        by_pg: dict[int, list[str]] = {}
+        for name in names:
+            ps = self.osdmap.object_to_pg(1, name)[1]
+            by_pg.setdefault(ps, []).append(name)
+        for ps, group in by_pg.items():
+            self._op("remove", ps,
+                     lambda e, g=group: e.u64(self._snapc()).list(
+                         g, Encoder.string))
 
     # -- pool snapshots over the wire ----------------------------------------
 

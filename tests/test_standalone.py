@@ -887,3 +887,46 @@ class TestScheduledScrub:
             cl.config_set("osd_scrub_auto_repair", "false")
         for name, want in objs.items():
             assert cl.read(name) == want, name
+
+
+class TestWireDelete:
+    def test_delete_and_delete_replay(self, cluster):
+        """Object deletion over the wire is a LOGGED mutation: a shard
+        down across the delete replays it on rejoin instead of
+        resurrecting a stale copy (pg_log_entry_t DELETE semantics,
+        now reachable from the wire client)."""
+        cl = cluster.client()
+        objs = corpus(96, n=8)
+        cl.write(objs)
+        victim_name = next(iter(objs))
+        ps = cl.osdmap.object_to_pg(1, victim_name)[1]
+        acting = cl.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        # kill a NON-primary holder, delete while it is down
+        holder = acting[1]
+        cluster.kill_osd(holder)
+        cluster.wait_for_down(holder, timeout=40)
+        cluster.wait_for_clean(timeout=40)
+        cl2 = cluster.client()
+        cl2.remove(victim_name)
+        with pytest.raises(Exception):
+            cl2.read(victim_name)
+        # revive: the delete must replay, not resurrect
+        cluster.revive_osd(holder)
+        cluster._wait(
+            lambda: all(d.osdmap.osd_up[holder]
+                        for d in cluster.osds.values()
+                        if not d._stop.is_set()), 15,
+            f"osd.{holder} back up")
+        cluster.wait_for_clean(timeout=40)
+        with pytest.raises(Exception):
+            cl2.read(victim_name)
+        # everything else still bit-exact
+        for name, want in objs.items():
+            if name != victim_name:
+                assert cl2.read(name) == want, name
+        # batch delete of the rest
+        rest = [n for n in objs if n != victim_name]
+        cl2.remove(rest)
+        for name in rest:
+            with pytest.raises(Exception):
+                cl2.read(name)
