@@ -209,3 +209,30 @@ class TestStriperConcurrency:
         got = st.read("shared")
         for i in range(n_threads):
             assert got[i * per:(i + 1) * per] == bytes([i]) * per
+
+
+def test_rados_cli_roundtrip(tmp_path):
+    """tools/rados_cli.py (the `rados` object CLI role): put/ls/stat/
+    get/rm compose across invocations, bytes exact."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    state = str(tmp_path / "st")
+    payload = bytes(range(256)) * 20
+    src = tmp_path / "in.bin"
+    src.write_bytes(payload)
+    run = lambda *args: subprocess.run(
+        [sys.executable, "tools/rados_cli.py", "--state", state, *args],
+        capture_output=True, timeout=180, env=env, cwd=repo)
+    assert run("put", "o1", str(src)).returncode == 0
+    out = run("ls")
+    assert out.returncode == 0 and out.stdout.strip() == b"o1"
+    got = run("get", "o1", "-")
+    assert got.returncode == 0 and got.stdout == payload
+    assert run("rm", "o1").returncode == 0
+    missing = run("get", "o1", "-")
+    assert missing.returncode != 0
+    assert b"no such object" in missing.stderr
