@@ -1503,7 +1503,14 @@ class OSDDaemon:
         seq >= sid that existed at the snap, else the unmodified head
         (ref: PrimaryLogPG find_object_context SnapSet resolution)."""
         if sid not in self.osdmap.pools[1].snaps:
-            raise KeyError(f"no snap {sid}")
+            if sid > self.osdmap.pools[1].snap_seq:
+                # the client knows a newer snap than this primary's
+                # map: TRANSIENT lag (mon->osd vs client->osd frames
+                # have no ordering) — retryable, like _check_snapc
+                raise RuntimeError(
+                    f"map lag: snap {sid} > pool snap_seq "
+                    f"{self.osdmap.pools[1].snap_seq}")
+            raise KeyError(f"no snap {sid}")   # genuinely removed
         ss = self.snapsets.get(ps, {}).get(name, [])
         cands = [seq for seq, birth in ss if seq >= sid and birth < sid]
         if cands:
@@ -1590,7 +1597,14 @@ class OSDDaemon:
         if kind == "remove":
             self._check_snapc(d.u64())
             names = d.list(Decoder.string)
-            self._delete_objects(ps, be, names)
+            try:
+                self._delete_objects(ps, be, names)
+            except (ConnectionError, OSError):
+                # a shard holder died mid-fan-out: suspect it and
+                # retry once degraded (the write path's rule;
+                # _delete_objects is idempotent so the retry is safe)
+                self._mark_suspects(be)
+                self._delete_objects(ps, be, names)
             self._persist_meta(ps)
             return b""
         if kind == "read":

@@ -32,8 +32,9 @@ class State:
         self.io = Rados(self.cluster).open_ioctx()
         if path and os.path.exists(path):
             with open(path, "rb") as f:
-                for name, data in pickle.load(f)["objects"].items():
-                    self.cluster.write({name: data})
+                objs = pickle.load(f)["objects"]
+            if objs:
+                self.cluster.write(objs)   # ONE batched restore
 
     def save(self) -> None:
         if not self.path:
@@ -83,8 +84,7 @@ def main(argv=None) -> None:
             for name in sorted(io.list_objects()):
                 print(name)
         elif a.cmd == "stat":
-            size = len(bytes(io.read(a.obj)))
-            print(f"{a.obj} mtime n/a, size {size}")
+            print(f"{a.obj} mtime n/a, size {io.stat(a.obj)}")
         elif a.cmd == "rm":
             for obj in a.obj:
                 io.remove(obj)
