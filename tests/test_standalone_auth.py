@@ -237,3 +237,24 @@ class TestCephxWire:
         assert cl2.read(name) == objs[name]
         cl2.write({name: b"post-rotation write"})
         assert cl.read(name) == b"post-rotation write"
+
+
+class TestAdminSocketCaps:
+    def test_admin_commands_respect_caps(self, cluster):
+        """Daemon admin commands ride the same caps gate as reads:
+        a reader entity may `perf dump`; a mon-only entity (no osd
+        caps) is refused with the _op PermissionError contract."""
+        admin = cluster.client()
+        admin.write(corpus(9, n=3))
+        osd = cluster.osd_ids()[0]
+        ro_secret = cluster.create_entity(
+            "client.obsv", caps={"mon": "allow r", "osd": "allow r"})
+        ro = cluster.client(entity="client.obsv", secret=ro_secret)
+        perf = ro.daemon(osd, "perf dump")
+        assert f"osd.{osd}" in perf
+        no_osd_secret = cluster.create_entity(
+            "client.monly", caps={"mon": "allow r"})
+        blocked = cluster.client(entity="client.monly",
+                                 secret=no_osd_secret)
+        with pytest.raises(PermissionError):
+            blocked.daemon(osd, "perf dump")
