@@ -1,0 +1,86 @@
+"""AEAD primitive with a stdlib fallback.
+
+AES-256-GCM via the `cryptography` wheel when importable; otherwise an
+encrypt-then-MAC construction from the stdlib (SHAKE-256 XOF keystream
+XOR — one C-speed sponge squeeze for the whole message, the
+Keccak-stream-cipher construction — and an HMAC-SHA256 tag over
+nonce+aad+ciphertext). The surface matches what cephx tickets and msgr
+secure mode need: (key, nonce, aad) sealing with a 16-byte tag,
+tamper -> InvalidTag.
+
+Every endpoint of the sim lives in one process, so both sides always
+resolve to the SAME implementation — there is no cross-implementation
+wire case. The fallback keeps the auth/secure planes runnable on
+images without the wheel; it is a legitimate AEAD composition but not
+a constant-time production cipher (this codebase is a simulation).
+"""
+
+from __future__ import annotations
+
+import hmac
+from hashlib import sha256, shake_256
+
+TAG_LEN = 16
+
+
+class InvalidTag(Exception):
+    """Decrypt failed authentication (tampered or wrong key)."""
+
+
+def _xor(key: bytes, nonce: bytes, data: bytes) -> bytes:
+    n = len(data)
+    ks = shake_256(len(key).to_bytes(4, "little") + key
+                   + b"ks" + nonce).digest(n)
+    x = int.from_bytes(data, "little") ^ int.from_bytes(ks, "little")
+    return x.to_bytes(n, "little")
+
+
+def _tag(key: bytes, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+    h = hmac.new(key, b"tag", sha256)
+    for p in (nonce, aad, ct):
+        h.update(len(p).to_bytes(4, "little"))
+        h.update(p)
+    return h.digest()[:TAG_LEN]
+
+
+class AEAD:
+    """AESGCM-shaped: encrypt/decrypt(nonce, data, aad)."""
+
+    def __init__(self, key: bytes):
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import \
+                AESGCM
+            self._gcm = AESGCM(key)
+            self._key = None
+        except ImportError:
+            self._gcm = None
+            self._key = bytes(key)
+
+    def encrypt(self, nonce: bytes, plain: bytes, aad: bytes) -> bytes:
+        if self._gcm is not None:
+            return self._gcm.encrypt(nonce, plain, aad)
+        ct = _xor(self._key, nonce, plain)
+        return ct + _tag(self._key, nonce, aad, ct)
+
+    def decrypt(self, nonce: bytes, blob: bytes, aad: bytes) -> bytes:
+        if self._gcm is not None:
+            from cryptography.exceptions import InvalidTag as _IT
+            try:
+                return self._gcm.decrypt(nonce, blob, aad)
+            except _IT:
+                raise InvalidTag from None
+        if len(blob) < TAG_LEN:
+            raise InvalidTag
+        ct, tag = blob[:-TAG_LEN], blob[-TAG_LEN:]
+        if not hmac.compare_digest(_tag(self._key, nonce, aad, ct),
+                                   tag):
+            raise InvalidTag
+        return _xor(self._key, nonce, ct)
+
+
+def hkdf_sha256(secret: bytes, salt: bytes, info: bytes) -> bytes:
+    """RFC 5869 HKDF-SHA256, L=32 (single expand block) — identical
+    output to cryptography's HKDF, so either path derives the same
+    session key."""
+    prk = hmac.new(salt, secret, sha256).digest()
+    return hmac.new(prk, info + b"\x01", sha256).digest()

@@ -72,19 +72,18 @@ def _hmac(key: bytes, *parts: bytes) -> bytes:
 
 
 def _seal(key: bytes, payload: dict) -> bytes:
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from .aead import AEAD
     nonce = os.urandom(12)
     plain = json.dumps(payload, sort_keys=True).encode()
-    return nonce + AESGCM(key).encrypt(nonce, plain, b"cephx-tkt")
+    return nonce + AEAD(key).encrypt(nonce, plain, b"cephx-tkt")
 
 
 def _unseal(key: bytes, blob: bytes) -> dict:
-    from cryptography.exceptions import InvalidTag
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from .aead import AEAD, InvalidTag
     if len(blob) < 12 + 16:
         raise AuthError("ticket blob truncated")
     try:
-        plain = AESGCM(key).decrypt(blob[:12], blob[12:], b"cephx-tkt")
+        plain = AEAD(key).decrypt(blob[:12], blob[12:], b"cephx-tkt")
     except InvalidTag:
         raise AuthError("ticket blob failed authentication (tampered "
                         "or wrong secret)")

@@ -93,8 +93,8 @@ class _SecureBox:
     never reused under the session key."""
 
     def __init__(self, key: bytes, tx_prefix: bytes, rx_prefix: bytes):
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-        self._gcm = AESGCM(key)
+        from ..auth.aead import AEAD
+        self._gcm = AEAD(key)
         self._tx_prefix = tx_prefix
         self._rx_prefix = rx_prefix
         self._tx_ctr = 0
@@ -105,7 +105,7 @@ class _SecureBox:
         return nonce + self._gcm.encrypt(nonce, plain, aad)
 
     def open(self, body: bytes, aad: bytes) -> bytes:
-        from cryptography.exceptions import InvalidTag
+        from ..auth.aead import InvalidTag
         if len(body) < _NONCE + _GCM_TAG:
             raise ConnectionError("secure frame too short")
         nonce, ct = body[:_NONCE], body[_NONCE:]
@@ -121,11 +121,9 @@ class _SecureBox:
 
 
 def _derive_key(secret: bytes, nonce_c: bytes, nonce_s: bytes) -> bytes:
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-    return HKDF(algorithm=hashes.SHA256(), length=32,
-                salt=nonce_c + nonce_s,
-                info=b"ceph_tpu msgr v2 secure session").derive(secret)
+    from ..auth.aead import hkdf_sha256
+    return hkdf_sha256(secret, salt=nonce_c + nonce_s,
+                       info=b"ceph_tpu msgr v2 secure session")
 
 
 #: fixed per-role nonce prefixes: deterministic direction separation

@@ -709,8 +709,9 @@ class ECBackend(PGBackend):
         def complete(entry) -> None:
             sl, subgroup, handles = entry
             rebuilt_d, rcrc_d, ok_d = handles
-            rebuilt_all, crcs, ok = jax.device_get(
-                (rebuilt_d, rcrc_d, ok_d))
+            with span("ecbackend.recover.fetch"):
+                rebuilt_all, crcs, ok = jax.device_get(
+                    (rebuilt_d, rcrc_d, ok_d))
             bad_pairs: dict[str, set[int]] = {}
             if verify_hinfo and not ok.all():
                 for bi, hi in zip(*np.nonzero(~ok)):
@@ -730,8 +731,9 @@ class ECBackend(PGBackend):
                         len(idxs), len(lost))
                 crcs = np.array(crcs)
                 crcs[idxs] = fix
-            self._writeback_rebuilt(lost, subgroup, rebuilt_all, crcs,
-                                    sl, counters)
+            with span("ecbackend.recover.writeback"):
+                self._writeback_rebuilt(lost, subgroup, rebuilt_all,
+                                        crcs, sl, counters)
 
         if dec_fn is not None and jobs:
             # fused path, three-stage pipeline: a producer thread

@@ -243,8 +243,31 @@ class MemStore:
     def exists(self, cid: str, oid: str) -> bool:
         return cid in self.collections and oid in self.collections[cid]
 
-    def list_objects(self, cid: str) -> list[str]:
-        return sorted(self.collections.get(cid, {}))
+    def list_objects(self, cid: str, start_after: str | None = None,
+                     limit: int | None = None) -> list[str]:
+        """Flat-dict listing: sorts the WHOLE collection per call —
+        O(n log n) in collection size no matter how small the page
+        (the linear baseline TinStore's KV-plane paginated iterator
+        replaces; store_bench's `list` workload measures the gap)."""
+        names = sorted(self.collections.get(cid, {}))
+        if start_after is not None:
+            import bisect
+            names = names[bisect.bisect_right(names, start_after):]
+        return names if limit is None else names[:limit]
 
     def list_collections(self) -> list[str]:
         return sorted(self.collections)
+
+    def omap_iter(self, cid: str, oid: str,
+                  start_after: bytes | None = None,
+                  limit: int | None = None) -> list[tuple[bytes, bytes]]:
+        """Ordered omap page — flat-dict cost: sorts the whole omap
+        per call (same linear baseline as list_objects)."""
+        om = self._obj(cid, oid).omap
+        keys = sorted(om)
+        if start_after is not None:
+            import bisect
+            keys = keys[bisect.bisect_right(keys, bytes(start_after)):]
+        if limit is not None:
+            keys = keys[:limit]
+        return [(k, om[k]) for k in keys]

@@ -18,27 +18,40 @@ ref: src/os/ObjectStore.h Transaction/queue_transaction):
   writes can't damage committed state. The freelist is not persisted;
   it is derived at mount from the live extent map (and fsck audits
   the same derivation for overlaps/bounds).
-* WRITE-AHEAD LOG — metadata only. Every queue_transaction first
-  pwrites its staged data extents, then appends ONE length-prefixed,
-  crc32c-sealed record of the METADATA mutation (data ops carry
-  extent references, not bytes) to `wal.log`, and only then applies
-  to the in-RAM metadata. A transaction is wholly in the WAL or
-  absent; a crash between data pwrite and WAL append leaves only
-  unreferenced extents, which the derived allocator reclaims at
+* KV METADATA PLANE. All metadata — collections, object records
+  (extent refs, sizes, crcs, xattrs), and omap — lives in TinDB
+  (`ceph_tpu/kv`), the ordered-KV store playing RocksDB's role under
+  BlueStore. Three prefixes:
+      "C" / cid                 -> b""            (collection exists)
+      "O" / cid NUL oid         -> object record  (versioned encode)
+      "M" / cid NUL oid NUL key -> omap value     (one entry per key)
+  Because the KV space is ORDERED, object listing and omap iteration
+  are prefix-bounded iterator walks — paginated listings cost
+  O(page), not O(collection) (the flat-dict linear scan this plane
+  replaces). Every queue_transaction first pwrites its staged data
+  extents, then submits ONE atomic TinDB batch (= one crc32c-sealed
+  WAL record in `wal.log`) carrying the metadata mutation, and only
+  then applies to the in-RAM mirror. A transaction is wholly in the
+  KV WAL or absent; a crash between data pwrite and KV submit leaves
+  only unreferenced extents, which the derived allocator reclaims at
   mount. `flush()` per commit = process-kill consistency;
   `o_dsync=True` adds fsync (machine-crash consistency).
+* RAM MIRROR. Object records (NOT omap) are mirrored in a dict for
+  O(1) hot-path reads (the BlueStore onode cache role); the mirror is
+  rebuilt from the KV plane at mount and is never the durability
+  story. Omap lives only in TinDB and is read through ordered
+  iterators.
 * BOUNDED BUFFER CACHE. Reads are served from an LRU byte cache with
   a hard byte budget (`cache_bytes`); misses pread the device. The
   serving plane is NOT a store-sized RAM mirror: datasets many times
   the cache budget serve correctly with eviction (BlueStore's
   2Q/buffer cache role, simplified to LRU).
-* METADATA CHECKPOINTS. When the WAL exceeds `wal_max_bytes`, the
-  metadata (extent refs, sizes, crcs, xattrs, omap) is serialized to
-  `ckpt.tmp` and atomically renamed over `ckpt`; the WAL resets.
-  Checkpoint cost is O(metadata), independent of data volume — the
-  r3 whole-store serialize is gone. Replay seq-skips records the
-  checkpoint covers, so a crash between rename and reset
-  double-applies nothing.
+* SEGMENT FLUSH (the checkpoint role). When the KV WAL exceeds
+  `wal_max_bytes` (or TinDB's memtable budget fills), the memtable is
+  flushed to a sorted immutable segment, the MANIFEST swaps
+  atomically, and the WAL resets. Flush cost is O(memtable) —
+  independent of both data volume and total metadata volume; leveled
+  compaction folds segments down in the background of the write path.
 * INLINE COMPRESSION (opt-in). With `compression=` ("zlib"/"lzma"),
   blobs >= compression_min_blob that shrink to at most
   compression_required_ratio of raw are stored COMPRESSED (the
@@ -56,16 +69,28 @@ ref: src/os/ObjectStore.h Transaction/queue_transaction):
   a writable memmap view — in-place pokes are REAL on-disk
   corruption (they bypass WAL and crc, and invalidate the cache so
   the next read sees the damage).
-* RECOVERY. mount() = load newest valid checkpoint (metadata),
-  replay WAL records in seq order (each crc-checked; a torn tail
-  record is truncated away), then derive the allocator from the
-  surviving extent map.
-* FSCK. TinStore.fsck(path) re-reads everything offline: checkpoint
-  seal, WAL chain, extent-map audit (overlaps, device bounds), and
-  every object's data crc straight from the device.
+* RECOVERY. mount() = TinDB mount (manifest -> segments -> WAL
+  replay, torn tail truncated, mid-log damage fatal), then rebuild
+  the RAM mirror from the "C"/"O" prefixes and derive the allocator
+  from the surviving extent map.
+* LEGACY FORWARD REPLAY. Stores written by the pre-KV TinStore
+  (`ckpt` checkpoint + metadata-op WAL) are detected at mount (no
+  MANIFEST) and migrated forward: legacy checkpoint + WAL are
+  replayed in memory, the resulting state is written as TinDB's
+  first segment, and the MANIFEST lands with covered_seq set past
+  every legacy record — so the legacy WAL (same record framing) is
+  seq-skipped, never misparsed. Crash before the MANIFEST: the
+  legacy store is intact and migration re-runs. Crash after: the KV
+  store is live. Either way nothing is lost.
+* FSCK. TinStore.fsck(path) re-reads everything offline: the KV
+  plane (manifest seal, segment seals + ordering, WAL chain) via
+  TinDB.fsck, a cross-check of KV against the block plane (omap
+  entries must have an object record, object records a collection,
+  extents in-bounds and disjoint), and every object's data crc
+  straight from the device. Legacy stores get the legacy audit.
 
 Process-kill semantics for the chaos tests: crash() drops RAM state
-and file handles with NO checkpoint (what SIGKILL leaves behind);
+and file handles with NO flush (what SIGKILL leaves behind);
 remount() recovers purely from disk. SimCluster(store="tin") routes
 kill/revive through these, so thrash survival is a measured property
 of the WAL + block plane, not an axiom of the sim.
@@ -81,13 +106,14 @@ from collections.abc import Mapping
 
 import numpy as np
 
+from ..kv import TinDB, TinDBCorruption, host_crc32c
+from ..kv.tindb import Segment, scan_wal, write_segment
 from ..utils.encoding import Decoder, Encoder, EncodingError
 from .memstore import MemStore, Transaction, _Object  # noqa: F401 — _Object
 #                      re-exported for store-agnostic test helpers
 
-_REC_MAGIC = 0x544E4952    # "RINT" little-endian: record
-_REC_HDR = struct.Struct("<IQI")     # magic, seq, body_len
-_CKPT_VERSION = 3   # v3: per-object compression triple (calg, clen, ccrc)
+_CKPT_VERSION = 3   # final LEGACY checkpoint version (pre-KV stores)
+_OBJ_VERSION = 1    # "O"-record encode version
 _ALLOC_UNIT = 4096
 
 
@@ -95,33 +121,18 @@ class TinStoreCorruption(IOError):
     """Checksum/structure mismatch on the read path (-EIO analog)."""
 
 
-_crc_impl = None
-
-
 def _crc32c(data) -> int:
     """Whole-buffer crc32c, raw-register convention (seed 0xFFFFFFFF,
-    no final inversion) — native C fast path, pure-python fallback."""
-    global _crc_impl
-    if _crc_impl is None:
-        try:
-            from ..native import lib
-            L = lib()
-
-            def _crc_impl(b, _L=L):
-                return int(_L.ec_crc32c(0xFFFFFFFF, b, len(b)))
-        except Exception:          # no toolchain: correctness over speed
-            from ..csum.reference import ceph_crc32c
-
-            def _crc_impl(b):
-                return int(ceph_crc32c(0xFFFFFFFF, b))
+    no final inversion) — shared with the KV plane's seals."""
     b = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
-    return _crc_impl(b)
+    return host_crc32c(b)
 
 
 # -- wire transaction (de)serialization --------------------------------------
 # Full-data form: MStoreOp frames ship entire Transactions between
-# daemons (a peer can't dereference our device offsets). The WAL uses
-# the separate metadata-op codec below.
+# daemons (a peer can't dereference our device offsets). The metadata
+# plane uses TinDB batches; the meta-op codec below survives only for
+# legacy (pre-KV) store migration.
 
 def _encode_op(e: Encoder, op: tuple) -> None:
     kind = op[0]
@@ -189,11 +200,10 @@ def _decode_txn(body: bytes) -> Transaction:
     return txn
 
 
-# -- WAL metadata-op (de)serialization ---------------------------------------
-# Data ops are rewritten to ("setext", cid, oid, doff, dlen, size, crc)
-# before logging: the bytes are already on the device, the WAL carries
-# only the reference (BlueStore's big-write path: data to fresh blobs,
-# metadata through the kv journal).
+# -- LEGACY metadata-op (de)serialization -------------------------------------
+# The pre-KV TinStore WAL carried these records; the codec survives so
+# mount() can forward-replay old stores into the KV plane (and so the
+# tests can fabricate legacy stores to prove that path).
 
 def _encode_meta_op(e: Encoder, op: tuple) -> None:
     kind = op[0]
@@ -319,7 +329,6 @@ class ExtentAllocator:
     def free(self, off: int, length: int) -> None:
         if length <= 0:
             return
-        end = off + length
         # insert sorted, coalesce neighbors
         import bisect
         idx = bisect.bisect_left(self._free, [off, length])
@@ -332,7 +341,6 @@ class ExtentAllocator:
             else:
                 merged.append(seg)
         self._free = merged
-        del end
 
 
 class _BufferCache:
@@ -380,28 +388,96 @@ class _BufferCache:
 
 
 class _TinObject:
-    """Metadata record: where the bytes live, how big, their crc.
+    """RAM-mirror record: where the bytes live, how big, their crc.
     Compressed blobs (calg != "") additionally carry the STORED
     length (clen) and a crc over the stored bytes (ccrc) — the
     BlueStore per-blob compressed_length + csum-on-stored-data pair;
-    `crc` is always over the LOGICAL bytes."""
+    `crc` is always over the LOGICAL bytes. Omap is NOT mirrored —
+    it lives only in the KV plane; `has_omap` is a write-path hint
+    (True may be stale after rmkeys/clear; False is always exact)."""
 
-    __slots__ = ("size", "doff", "dlen", "crc", "xattrs", "omap",
-                 "calg", "clen", "ccrc")
+    __slots__ = ("size", "doff", "dlen", "crc", "xattrs",
+                 "calg", "clen", "ccrc", "has_omap")
 
     def __init__(self, size=0, doff=0, dlen=0, crc=0,
-                 xattrs=None, omap=None, calg="", clen=0, ccrc=0):
+                 xattrs=None, calg="", clen=0, ccrc=0,
+                 has_omap=False):
         self.size, self.doff, self.dlen, self.crc = size, doff, dlen, crc
         self.xattrs: dict[str, bytes] = xattrs if xattrs is not None else {}
-        self.omap: dict[bytes, bytes] = omap if omap is not None else {}
         self.calg, self.clen, self.ccrc = calg, clen, ccrc
+        self.has_omap = has_omap
 
     @property
     def stored_len(self) -> int:
         return self.clen if self.calg else self.size
 
+    def copy(self) -> "_TinObject":
+        return _TinObject(self.size, self.doff, self.dlen, self.crc,
+                          dict(self.xattrs), self.calg, self.clen,
+                          self.ccrc, self.has_omap)
+
+
+def _encode_obj(o: _TinObject) -> bytes:
+    """The "O" KV record (versioned like every on-disk structure)."""
+    e = Encoder()
+    e.start(_OBJ_VERSION, _OBJ_VERSION)
+    e.u64(o.size).u64(o.doff).u64(o.dlen).u32(o.crc)
+    e.string(o.calg).u64(o.clen).u32(o.ccrc)
+    e.mapping(o.xattrs, Encoder.string, Encoder.blob)
+    e.finish()
+    return e.bytes()
+
+
+def _decode_obj(b: bytes) -> _TinObject:
+    d = Decoder(b)
+    d.start(_OBJ_VERSION)
+    size, doff, dlen, crc = d.u64(), d.u64(), d.u64(), d.u32()
+    calg, clen, ccrc = d.string(), d.u64(), d.u32()
+    xattrs = d.mapping(Decoder.string, Decoder.blob)
+    d.finish()
+    return _TinObject(size, doff, dlen, crc, xattrs, calg, clen, ccrc)
+
+
+def _okey(cid: str, oid: str) -> bytes:
+    return cid.encode() + b"\x00" + oid.encode()
+
+
+def _mkey(cid: str, oid: str, key: bytes) -> bytes:
+    return cid.encode() + b"\x00" + oid.encode() + b"\x00" + bytes(key)
+
 
 # -- collections view (test/scrub poke surface) -------------------------------
+
+class _OmapView(Mapping):
+    """Ordered read view of one object's omap, served straight from
+    the KV plane's prefix-bounded iterator (keys ascend)."""
+
+    __slots__ = ("_st", "_cid", "_oid")
+
+    def __init__(self, st: "TinStore", cid: str, oid: str):
+        self._st, self._cid, self._oid = st, cid, oid
+
+    def __getitem__(self, key: bytes) -> bytes:
+        v = self._st._db.get("M", _mkey(self._cid, self._oid, key))
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def __iter__(self):
+        pre = _okey(self._cid, self._oid) + b"\x00"
+        for k, _v in self._st._db.iterate(
+                "M", start=pre, end=pre[:-1] + b"\x01"):
+            yield k[len(pre):]
+
+    def items(self):
+        pre = _okey(self._cid, self._oid) + b"\x00"
+        for k, v in self._st._db.iterate(
+                "M", start=pre, end=pre[:-1] + b"\x01"):
+            yield k[len(pre):], v
+
+    def __len__(self):
+        return sum(1 for _ in self)
+
 
 class _ObjProxy:
     """MemStore-_Object-shaped view of one object. `.data` is a
@@ -434,8 +510,9 @@ class _ObjProxy:
         return self._meta().xattrs
 
     @property
-    def omap(self) -> dict[bytes, bytes]:
-        return self._meta().omap
+    def omap(self) -> _OmapView:
+        self._meta()                 # KeyError propagates
+        return _OmapView(self._st, self._cid, self._oid)
 
 
 class _CollView(Mapping):
@@ -475,8 +552,9 @@ class _CollectionsView(Mapping):
 
 class TinStore:
     """File-backed ObjectStore: block-plane data device + extent
-    allocator, metadata WAL + checkpoints, bounded LRU buffer cache,
-    crc32c verify-on-read. Interface == MemStore."""
+    allocator, TinDB ordered-KV metadata plane (WAL + segments +
+    manifest), bounded LRU buffer cache, crc32c verify-on-read.
+    Interface == MemStore."""
 
     COMPRESSION_ALGS = ("zlib", "lzma")
 
@@ -484,6 +562,8 @@ class TinStore:
                  verify_reads: bool = True,
                  wal_max_bytes: int = 64 << 20,
                  cache_bytes: int = 64 << 20,
+                 kv_memtable_bytes: int = 4 << 20,
+                 kv_fanout: int = 4,
                  compression: str | None = None,
                  compression_min_blob: int = 4096,
                  compression_required_ratio: float = 0.875):
@@ -496,6 +576,8 @@ class TinStore:
         self.verify_reads = verify_reads
         self.wal_max_bytes = wal_max_bytes
         self.cache_bytes = cache_bytes
+        self.kv_memtable_bytes = kv_memtable_bytes
+        self.kv_fanout = kv_fanout
         # inline compression (ref: BlueStore _do_write compression
         # decision: bluestore_compression_{algorithm,min_blob_size,
         # required_ratio}): blobs >= min_blob that shrink to at most
@@ -510,8 +592,7 @@ class TinStore:
         self._meta: dict[str, dict[str, _TinObject]] | None = None
         self._alloc = ExtentAllocator()
         self._cache = _BufferCache(cache_bytes)
-        self._seq = 0              # last committed WAL seq
-        self._wal_f = None
+        self._db: TinDB | None = None
         self._dev_fd: int | None = None
         self.committed_txns = 0
         os.makedirs(path, exist_ok=True)
@@ -525,6 +606,7 @@ class TinStore:
 
     @property
     def _ckpt_path(self) -> str:
+        """LEGACY (pre-KV) checkpoint path — only read for migration."""
         return os.path.join(self.path, "ckpt")
 
     @property
@@ -533,21 +615,70 @@ class TinStore:
 
     # -- lifecycle -----------------------------------------------------------
 
+    @staticmethod
+    def _is_legacy(path: str) -> bool:
+        """Pre-KV layout: no MANIFEST, but a checkpoint and/or WAL
+        already exists (a fresh empty directory is NOT legacy)."""
+        if os.path.exists(os.path.join(path, "MANIFEST")):
+            return False
+        if os.path.exists(os.path.join(path, "ckpt")):
+            return True
+        wal = os.path.join(path, "wal.log")
+        try:
+            return os.path.getsize(wal) > 0
+        except OSError:
+            return False
+
     def mount(self) -> None:
-        """Load checkpoint metadata, replay WAL tail, derive the
-        allocator from the surviving extent map, open the device."""
+        """Mount the KV metadata plane (migrating a legacy store
+        forward first), rebuild the RAM mirror, derive the allocator
+        from the surviving extent map, open the device."""
         with self._lock:
-            self._meta = {}
             self._cache = _BufferCache(self.cache_bytes)
-            self._seq = 0
-            self.committed_txns = 0
             self._dev_fd = os.open(self._dev_path,
                                    os.O_RDWR | os.O_CREAT, 0o644)
-            base_seq = self._load_checkpoint()
-            self._seq = base_seq
-            self._replay_wal(base_seq)
-            self._derive_allocator()
-            self._wal_f = open(self._wal_path, "ab")
+            try:
+                if self._is_legacy(self.path):
+                    self._migrate_legacy()
+                try:
+                    self._db = TinDB(
+                        self.path, o_dsync=self.o_dsync,
+                        memtable_max_bytes=self.kv_memtable_bytes,
+                        fanout=self.kv_fanout, wal_name="wal.log")
+                except TinDBCorruption as e:
+                    raise TinStoreCorruption(str(e)) from None
+                self._meta = {}
+                self._load_mirror()
+                self._derive_allocator()
+            except Exception:
+                os.close(self._dev_fd)
+                self._dev_fd = None
+                self._meta = None
+                raise
+
+    def _load_mirror(self) -> None:
+        """RAM mirror (collections + object records + has_omap hints)
+        rebuilt from the KV plane — O(metadata), the onode-cache warm
+        load. Omap VALUES stay in the DB."""
+        meta = self._meta
+        for k, _v in self._db.iterate("C"):
+            meta.setdefault(k.decode(), {})
+        for k, v in self._db.iterate("O"):
+            cid_b, oid_b = k.split(b"\x00", 1)
+            try:
+                obj = _decode_obj(v)
+            except EncodingError as e:
+                raise TinStoreCorruption(
+                    f"bad object record {k!r}: {e}") from None
+            meta.setdefault(cid_b.decode(), {})[oid_b.decode()] = obj
+        for k, _v in self._db.iterate("M"):
+            cid_b, oid_b, _mk = k.split(b"\x00", 2)
+            o = meta.get(cid_b.decode(), {}).get(oid_b.decode())
+            if o is not None:
+                o.has_omap = True
+        cnt = self._db.get("S", b"committed_txns")
+        self.committed_txns = (struct.unpack("<Q", cnt)[0]
+                               if cnt is not None else 0)
 
     def _derive_allocator(self) -> None:
         dev_size = os.fstat(self._dev_fd).st_size
@@ -570,15 +701,11 @@ class TinStore:
         return self._meta is None
 
     def crash(self) -> None:
-        """SIGKILL semantics: drop RAM state and handles, NO flush, NO
-        checkpoint. Only bytes already written to the files survive."""
+        """SIGKILL semantics: drop RAM state and handles, NO flush.
+        Only bytes already written to the files survive."""
         with self._lock:
-            if self._wal_f is not None:
-                try:
-                    self._wal_f.close()   # data already flushed per-commit;
-                except OSError:           # close() loses nothing extra
-                    pass
-                self._wal_f = None
+            if self._db is not None:
+                self._db.crash()
             if self._dev_fd is not None:
                 try:
                     os.close(self._dev_fd)
@@ -593,11 +720,10 @@ class TinStore:
         self.mount()
 
     def umount(self) -> None:
-        """Clean shutdown: checkpoint then release handles."""
+        """Clean shutdown: flush the memtable then release handles."""
         with self._lock:
-            self.checkpoint()
-            self._wal_f.close()
-            self._wal_f = None
+            self._alive()
+            self._db.umount()
             os.close(self._dev_fd)
             self._dev_fd = None
             self._meta = None
@@ -609,145 +735,168 @@ class TinStore:
                                f"(crashed/umounted; remount() first)")
         return self._meta
 
-    # -- WAL -----------------------------------------------------------------
+    # -- legacy (pre-KV) store migration -------------------------------------
 
-    def _append_record(self, body: bytes) -> None:
-        self._seq += 1
-        hdr = _REC_HDR.pack(_REC_MAGIC, self._seq, len(body))
-        rec = hdr + body
-        rec += struct.pack("<I", _crc32c(rec))
-        self._wal_f.write(rec)
-        self._wal_f.flush()                      # survives process kill
-        if self.o_dsync:
-            os.fsync(self._wal_f.fileno())       # survives machine crash
-
-    def _scan_wal(self):
-        """Yield (seq, body) for every valid record; returns via
-        StopIteration the (good_bytes, torn_tail, error) triple."""
+    def _migrate_legacy(self) -> None:
+        """Forward replay: legacy ckpt + meta-op WAL -> one TinDB
+        segment + MANIFEST with covered_seq past every legacy record
+        (same WAL framing, so the old records are seq-skipped, never
+        body-parsed). Crash before the MANIFEST lands = legacy store
+        intact, migration re-runs; after = KV store live."""
+        colls, omaps, committed, last_seq = \
+            self._legacy_load(self.path, truncate_torn=True)
+        items: dict[bytes, bytes] = {
+            b"S\x00committed_txns": struct.pack("<Q", committed)}
+        for cid, coll in colls.items():
+            items[b"C\x00" + cid.encode()] = b""
+            for oid, o in coll.items():
+                items[b"O\x00" + _okey(cid, oid)] = _encode_obj(o)
+        for (cid, oid), om in omaps.items():
+            for k, v in om.items():
+                items[b"M\x00" + _mkey(cid, oid, k)] = v
+        seg_path = os.path.join(self.path, "seg-00000001.tdb")
+        write_segment(seg_path, ((k, items[k]) for k in sorted(items)))
+        db = TinDB(self.path, wal_name="wal.log", mount=False)
+        db._covered_seq = last_seq
+        db._next_seg = 2
+        db._levels = [[Segment(seg_path)]]
+        db._write_manifest()            # the commit point
+        db.crash()
         try:
-            with open(self._wal_path, "rb") as f:
+            os.unlink(self._ckpt_path)  # cosmetic; ignored once KV
+        except OSError:
+            pass
+
+    @staticmethod
+    def _legacy_load(path: str, truncate_torn: bool):
+        """Read a pre-KV store's state: (collections, omaps,
+        committed_txns, last_wal_seq). Raises TinStoreCorruption on
+        damage (same contract the legacy mount had)."""
+        colls: dict[str, dict[str, _TinObject]] = {}
+        omaps: dict[tuple[str, str], dict[bytes, bytes]] = {}
+        committed = 0
+        base_seq = 0
+        ckpt = os.path.join(path, "ckpt")
+        try:
+            with open(ckpt, "rb") as f:
                 raw = f.read()
         except FileNotFoundError:
-            return 0, False, None
-        off = 0
-        n = len(raw)
-        while off < n:
-            if off + _REC_HDR.size + 4 > n:
-                return off, True, None           # torn header
-            magic, seq, blen = _REC_HDR.unpack_from(raw, off)
-            if magic != _REC_MAGIC:
-                return off, False, f"bad magic at {off}"
-            end = off + _REC_HDR.size + blen + 4
-            if end > n:
-                return off, True, None           # torn body
-            (crc,) = struct.unpack_from("<I", raw, end - 4)
-            if _crc32c(raw[off:end - 4]) != crc:
-                # a bad crc at the very tail is a torn append; bad crc
-                # FOLLOWED by more bytes is real corruption
-                return off, end >= n, (None if end >= n
-                                       else f"crc mismatch at {off}")
-            yield seq, raw[off + _REC_HDR.size:end - 4]
-            off = end
-        return off, False, None
-
-    def _replay_wal(self, base_seq: int) -> None:
-        gen = self._scan_wal()
+            raw = None
+        if raw is not None:
+            if len(raw) < 4:
+                raise TinStoreCorruption(f"{ckpt}: truncated")
+            (crc,) = struct.unpack_from("<I", raw, len(raw) - 4)
+            if host_crc32c(raw[:-4]) != crc:
+                raise TinStoreCorruption(f"{ckpt}: file seal "
+                                         f"crc mismatch")
+            d = Decoder(raw[:-4])
+            try:
+                v = d.start(_CKPT_VERSION)
+                base_seq = d.u64()
+                committed = d.u64()
+                for _ in range(d.u32()):
+                    cid = d.string()
+                    coll = colls.setdefault(cid, {})
+                    for _ in range(d.u32()):
+                        oid = d.string()
+                        size, doff, dlen, ocrc = (d.u64(), d.u64(),
+                                                  d.u64(), d.u32())
+                        xattrs = d.mapping(Decoder.string, Decoder.blob)
+                        omap = d.mapping(Decoder.blob, Decoder.blob)
+                        if v >= 3:
+                            calg, clen, ccrc = (d.string(), d.u64(),
+                                                d.u32())
+                        else:
+                            calg, clen, ccrc = "", 0, 0
+                        coll[oid] = _TinObject(size, doff, dlen, ocrc,
+                                               xattrs, calg, clen, ccrc)
+                        if omap:
+                            omaps[(cid, oid)] = omap
+                d.finish()
+            except EncodingError as e:
+                raise TinStoreCorruption(f"{ckpt}: {e}") from None
+        wal_path = os.path.join(path, "wal.log")
+        seq = base_seq
+        gen = scan_wal(wal_path)
         while True:
             try:
-                seq, body = next(gen)
+                rseq, body = next(gen)
             except StopIteration as stop:
                 good_bytes, torn, err = stop.value
                 if err:
                     raise TinStoreCorruption(
-                        f"{self._wal_path}: {err} (mid-log corruption; "
+                        f"{wal_path}: {err} (mid-log corruption; "
                         f"run fsck)")
-                if torn:
-                    # crash mid-append: drop the partial record
-                    with open(self._wal_path, "ab") as f:
+                if torn and truncate_torn:
+                    with open(wal_path, "ab") as f:
                         f.truncate(good_bytes)
-                return
-            if seq <= base_seq:
-                continue                         # checkpoint covers it
-            if seq != self._seq + 1:
+                break
+            if rseq <= base_seq:
+                continue                     # checkpoint covers it
+            if rseq != seq + 1:
                 raise TinStoreCorruption(
-                    f"{self._wal_path}: seq jump {self._seq} -> {seq}")
-            for op in _decode_meta_txn(body):
-                self._apply_meta(op, live=False)
-            self.committed_txns += 1
-            self._seq = seq
+                    f"{wal_path}: seq jump {seq} -> {rseq}")
+            try:
+                ops = _decode_meta_txn(body)
+            except EncodingError as e:
+                raise TinStoreCorruption(
+                    f"{wal_path}: record {rseq}: {e}") from None
+            for op in ops:
+                TinStore._legacy_apply(colls, omaps, op)
+            committed += 1
+            seq = rseq
+        return colls, omaps, committed, seq
 
-    # -- checkpoint ----------------------------------------------------------
+    @staticmethod
+    def _legacy_apply(colls, omaps, op: tuple) -> None:
+        kind = op[0]
+        if kind == "mkcoll":
+            colls.setdefault(op[1], {})
+        elif kind == "rmcoll":
+            coll = colls.pop(op[1], {})
+            for oid in coll:
+                omaps.pop((op[1], oid), None)
+        elif kind == "touch":
+            colls[op[1]].setdefault(op[2], _TinObject())
+        elif kind in ("setext", "setextc"):
+            _, cid, oid, doff, dlen, size, crc = op[:7]
+            o = colls[cid].setdefault(oid, _TinObject())
+            o.doff, o.dlen, o.size, o.crc = doff, dlen, size, crc
+            if kind == "setextc":
+                o.calg, o.clen, o.ccrc = op[7], op[8], op[9]
+            else:
+                o.calg, o.clen, o.ccrc = "", 0, 0
+        elif kind == "remove":
+            colls[op[1]].pop(op[2], None)
+            omaps.pop((op[1], op[2]), None)
+        elif kind == "setattr":
+            colls[op[1]].setdefault(op[2], _TinObject()) \
+                .xattrs[op[3]] = op[4]
+        elif kind == "rmattr":
+            o = colls[op[1]].get(op[2])
+            if o is not None:
+                o.xattrs.pop(op[3], None)
+        elif kind == "omap_set":
+            colls[op[1]].setdefault(op[2], _TinObject())
+            omaps.setdefault((op[1], op[2]), {}).update(op[3])
+        elif kind == "omap_rmkeys":
+            om = omaps.get((op[1], op[2]))
+            if om is not None:
+                for k in op[3]:
+                    om.pop(k, None)
+        elif kind == "omap_clear":
+            omaps.pop((op[1], op[2]), None)
+        else:
+            raise TinStoreCorruption(f"unknown legacy meta op {kind!r}")
+
+    # -- flush (the checkpoint role) -----------------------------------------
 
     def checkpoint(self) -> None:
-        """Serialize METADATA atomically (extent refs, not data — cost
-        is independent of store size); then reset the WAL. Crash
-        windows: before rename -> old ckpt + full WAL; after rename,
-        before reset -> new ckpt + stale WAL records whose seqs are
-        skipped at replay. Either way state is exact."""
+        """Flush the KV memtable to a sorted segment and reset the
+        WAL (the metadata-checkpoint role; cost O(memtable))."""
         with self._lock:
-            meta = self._alive()
-            e = Encoder()
-            e.start(_CKPT_VERSION, _CKPT_VERSION)
-            e.u64(self._seq)
-            e.u64(self.committed_txns)
-            e.u32(len(meta))
-            for cid in sorted(meta):
-                e.string(cid)
-                coll = meta[cid]
-                e.u32(len(coll))
-                for oid in sorted(coll):
-                    o = coll[oid]
-                    e.string(oid)
-                    e.u64(o.size).u64(o.doff).u64(o.dlen).u32(o.crc)
-                    e.mapping(o.xattrs, Encoder.string, Encoder.blob)
-                    e.mapping(o.omap, Encoder.blob, Encoder.blob)
-                    # v3: compression triple
-                    e.string(o.calg).u64(o.clen).u32(o.ccrc)
-            e.finish()
-            body = e.bytes()
-            body += struct.pack("<I", _crc32c(body))
-            tmp = self._ckpt_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(body)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._ckpt_path)
-            if self._wal_f is not None:
-                self._wal_f.close()
-            self._wal_f = open(self._wal_path, "wb")  # reset the log
-
-    def _load_checkpoint(self) -> int:
-        try:
-            with open(self._ckpt_path, "rb") as f:
-                raw = f.read()
-        except FileNotFoundError:
-            return 0
-        if len(raw) < 4:
-            raise TinStoreCorruption(f"{self._ckpt_path}: truncated")
-        (crc,) = struct.unpack_from("<I", raw, len(raw) - 4)
-        if _crc32c(raw[:-4]) != crc:
-            raise TinStoreCorruption(f"{self._ckpt_path}: file seal "
-                                     f"crc mismatch")
-        d = Decoder(raw[:-4])
-        v = d.start(_CKPT_VERSION)
-        seq = d.u64()
-        self.committed_txns = d.u64()
-        for _ in range(d.u32()):
-            cid = d.string()
-            coll = self._meta.setdefault(cid, {})
-            for _ in range(d.u32()):
-                oid = d.string()
-                size, doff, dlen, ocrc = d.u64(), d.u64(), d.u64(), d.u32()
-                xattrs = d.mapping(Decoder.string, Decoder.blob)
-                omap = d.mapping(Decoder.blob, Decoder.blob)
-                if v >= 3:
-                    calg, clen, ccrc = d.string(), d.u64(), d.u32()
-                else:
-                    calg, clen, ccrc = "", 0, 0
-                coll[oid] = _TinObject(size, doff, dlen, ocrc,
-                                       xattrs, omap, calg, clen, ccrc)
-        d.finish()
-        return seq
+            self._alive()
+            self._db.flush()
 
     # -- transactional write path -------------------------------------------
 
@@ -810,16 +959,107 @@ class TinStore:
                 raise
             if self.o_dsync and new_extents:
                 os.fsync(self._dev_fd)     # data durable BEFORE the WAL
-            self._append_record(_encode_meta_txn(meta_ops))
+            self._db.submit_transaction(self._kv_txn_for(meta_ops))
             for op in meta_ops:
-                self._apply_meta(op, live=True)
+                self._apply_meta(op)
             for key, arr in staged.items():
                 cid, oid = key
                 if cid in self._meta and oid in self._meta[cid]:
                     self._cache.put(key, arr)
             self.committed_txns += 1
-            if self._wal_f.tell() >= self.wal_max_bytes:
-                self.checkpoint()
+            if self._db.wal_size() >= self.wal_max_bytes:
+                self._db.flush()
+
+    def _kv_txn_for(self, meta_ops: list[tuple]):
+        """Translate one metadata-op batch into ONE TinDB transaction
+        (the BlueStore txc->t WriteBatch build). Object records are
+        re-encoded whole per touch (they're small — extent refs +
+        xattrs); omap entries map 1:1 onto "M" keys; range deletes
+        cover collection/object teardown."""
+        kvt = self._db.transaction()
+        kvt.set("S", b"committed_txns",
+                struct.pack("<Q", self.committed_txns + 1))
+        work: dict[tuple[str, str], _TinObject | None] = {}
+
+        def getobj(cid, oid, create):
+            key = (cid, oid)
+            if key in work:
+                o = work[key]
+            else:
+                cur = self._meta.get(cid, {}).get(oid)
+                o = cur.copy() if cur is not None else None
+            if o is None and create:
+                o = _TinObject()
+            work[key] = o
+            return o
+
+        def put(cid, oid, o):
+            kvt.set("O", _okey(cid, oid), _encode_obj(o))
+
+        for op in meta_ops:
+            kind = op[0]
+            if kind == "mkcoll":
+                kvt.set("C", op[1].encode(), b"")
+            elif kind == "rmcoll":
+                cid = op[1]
+                kvt.rmkey("C", cid.encode())
+                kvt.rmkeys_by_prefix("O", cid.encode() + b"\x00")
+                kvt.rmkeys_by_prefix("M", cid.encode() + b"\x00")
+                for key in [k for k in work if k[0] == cid]:
+                    work[key] = None
+            elif kind == "touch":
+                _, cid, oid = op
+                put(cid, oid, getobj(cid, oid, create=True))
+            elif kind in ("setext", "setextc"):
+                _, cid, oid, doff, dlen, size, crc = op[:7]
+                o = getobj(cid, oid, create=True)
+                o.doff, o.dlen, o.size, o.crc = doff, dlen, size, crc
+                if kind == "setextc":
+                    o.calg, o.clen, o.ccrc = op[7], op[8], op[9]
+                else:
+                    o.calg, o.clen, o.ccrc = "", 0, 0
+                put(cid, oid, o)
+            elif kind == "remove":
+                _, cid, oid = op
+                prior = getobj(cid, oid, create=False)
+                work[(cid, oid)] = None
+                kvt.rmkey("O", _okey(cid, oid))
+                if prior is not None and prior.has_omap:
+                    kvt.rmkeys_by_prefix(
+                        "M", _okey(cid, oid) + b"\x00")
+            elif kind == "setattr":
+                _, cid, oid, k, v = op
+                o = getobj(cid, oid, create=True)
+                o.xattrs[k] = v
+                put(cid, oid, o)
+            elif kind == "rmattr":
+                _, cid, oid, k = op
+                o = getobj(cid, oid, create=False)
+                if o is not None:
+                    o.xattrs.pop(k, None)
+                    put(cid, oid, o)
+            elif kind == "omap_set":
+                _, cid, oid, kv = op
+                o = getobj(cid, oid, create=True)
+                if not o.has_omap:
+                    o.has_omap = True
+                put(cid, oid, o)
+                for k, v in kv.items():
+                    kvt.set("M", _mkey(cid, oid, k), v)
+            elif kind == "omap_rmkeys":
+                _, cid, oid, keys = op
+                if getobj(cid, oid, create=False) is not None:
+                    for k in keys:
+                        kvt.rmkey("M", _mkey(cid, oid, k))
+            elif kind == "omap_clear":
+                _, cid, oid = op
+                o = getobj(cid, oid, create=False)
+                if o is not None and o.has_omap:
+                    kvt.rmkeys_by_prefix(
+                        "M", _okey(cid, oid) + b"\x00")
+            else:
+                raise ValueError(f"unknown meta op {kind!r}")
+        return kvt
 
     def _staged_bytes(self, staged, gone, gone_colls,
                       cid, oid) -> np.ndarray:
@@ -857,8 +1097,8 @@ class TinStore:
     def _stage(self, staged, new_extents, cid, oid,
                arr: np.ndarray) -> tuple:
         """COW the object's new bytes into a fresh extent; return the
-        setext/setextc metadata op. Nothing commits until the WAL
-        record. Compression happens HERE (the _do_write decision):
+        setext/setextc metadata op. Nothing commits until the KV
+        batch. Compression happens HERE (the _do_write decision):
         the device and the crc-on-stored-bytes see compressed data,
         the cache and the logical crc see raw data."""
         stored = arr.tobytes()
@@ -902,27 +1142,26 @@ class TinStore:
                 if op[1] not in cols:
                     raise KeyError(f"{kind}: no collection {op[1]!r}")
 
-    def _apply_meta(self, op: tuple, live: bool) -> None:
-        """Apply one metadata op. `live` frees replaced extents back
-        to the allocator and maintains the cache; replay skips both
-        (the allocator is derived after replay, the cache is cold)."""
+    def _apply_meta(self, op: tuple) -> None:
+        """Apply one metadata op to the RAM mirror (the KV plane got
+        the same mutation in the committed batch); frees replaced
+        extents back to the allocator and maintains the cache."""
         meta = self._meta
         kind = op[0]
         if kind == "mkcoll":
             meta.setdefault(op[1], {})
         elif kind == "rmcoll":
             coll = meta.pop(op[1])
-            if live:
-                for o in coll.values():
-                    if o.dlen:
-                        self._alloc.free(o.doff, o.dlen)
-                self._cache.drop_coll(op[1])
+            for o in coll.values():
+                if o.dlen:
+                    self._alloc.free(o.doff, o.dlen)
+            self._cache.drop_coll(op[1])
         elif kind == "touch":
             meta[op[1]].setdefault(op[2], _TinObject())
         elif kind in ("setext", "setextc"):
             _, cid, oid, doff, dlen, size, crc = op[:7]
             o = meta[cid].setdefault(oid, _TinObject())
-            if live and o.dlen and (o.doff, o.dlen) != (doff, dlen):
+            if o.dlen and (o.doff, o.dlen) != (doff, dlen):
                 self._alloc.free(o.doff, o.dlen)
             o.doff, o.dlen, o.size, o.crc = doff, dlen, size, crc
             if kind == "setextc":
@@ -931,10 +1170,9 @@ class TinStore:
                 o.calg, o.clen, o.ccrc = "", 0, 0
         elif kind == "remove":
             o = meta[op[1]].pop(op[2], None)
-            if live:
-                if o is not None and o.dlen:
-                    self._alloc.free(o.doff, o.dlen)
-                self._cache.drop((op[1], op[2]))
+            if o is not None and o.dlen:
+                self._alloc.free(o.doff, o.dlen)
+            self._cache.drop((op[1], op[2]))
         elif kind == "setattr":
             meta[op[1]].setdefault(op[2], _TinObject()) \
                 .xattrs[op[3]] = op[4]
@@ -943,17 +1181,11 @@ class TinStore:
             if o is not None:
                 o.xattrs.pop(op[3], None)
         elif kind == "omap_set":
-            meta[op[1]].setdefault(op[2], _TinObject()) \
-                .omap.update(op[3])
-        elif kind == "omap_rmkeys":
-            o = meta[op[1]].get(op[2])
-            if o is not None:
-                for k in op[3]:
-                    o.omap.pop(k, None)
-        elif kind == "omap_clear":
-            o = meta[op[1]].get(op[2])
-            if o is not None:
-                o.omap.clear()
+            # keys live in the KV plane; mirror only existence + hint
+            o = meta[op[1]].setdefault(op[2], _TinObject())
+            o.has_omap = True
+        elif kind in ("omap_rmkeys", "omap_clear"):
+            pass                             # KV-plane-only mutation
         else:
             raise ValueError(f"unknown meta op {kind!r}")
 
@@ -1034,13 +1266,55 @@ class TinStore:
             meta = self._alive()
             return cid in meta and oid in meta[cid]
 
-    def list_objects(self, cid: str) -> list[str]:
+    # -- ordered listings (served from the KV plane) -------------------------
+
+    def list_objects(self, cid: str, start_after: str | None = None,
+                     limit: int | None = None) -> list[str]:
+        """Ordered object listing from the KV plane's prefix-bounded
+        iterator. With (start_after, limit) this is a PAGE: cost
+        O(page + log segments), independent of collection size — the
+        sublinear listing the flat-dict scan couldn't give (ref:
+        BlueStore::collection_list's rocksdb iterator walk)."""
         with self._lock:
-            return sorted(self._alive().get(cid, {}))
+            if cid not in self._alive():
+                return []
+            pre = cid.encode() + b"\x00"
+            start = pre if start_after is None \
+                else pre + start_after.encode() + b"\x00"
+            it = self._db.iterate("O", start=start,
+                                  end=pre[:-1] + b"\x01")
+        out: list[str] = []
+        for k, _v in it:
+            out.append(k[len(pre):].decode())
+            if limit is not None and len(out) >= limit:
+                break
+        return out
 
     def list_collections(self) -> list[str]:
         with self._lock:
-            return sorted(self._alive())
+            self._alive()
+            return [k.decode() for k, _v in self._db.iterate("C")]
+
+    def omap_iter(self, cid: str, oid: str,
+                  start_after: bytes | None = None,
+                  limit: int | None = None) -> list[tuple[bytes, bytes]]:
+        """Ordered omap page for one object (the DBObjectMap
+        get_iterator role): prefix-bounded, O(page)."""
+        with self._lock:
+            coll = self._alive().get(cid)
+            if coll is None or oid not in coll:
+                raise KeyError(f"no object {cid}/{oid}")
+            pre = _okey(cid, oid) + b"\x00"
+            start = pre if start_after is None \
+                else pre + bytes(start_after) + b"\x00"
+            it = self._db.iterate("M", start=start,
+                                  end=pre[:-1] + b"\x01")
+        out: list[tuple[bytes, bytes]] = []
+        for k, v in it:
+            out.append((k[len(pre):], v))
+            if limit is not None and len(out) >= limit:
+                break
+        return out
 
     @property
     def collections(self) -> _CollectionsView:
@@ -1055,58 +1329,87 @@ class TinStore:
         return {"budget": self._cache.budget, "bytes": self._cache.total,
                 "hits": self._cache.hits, "misses": self._cache.misses}
 
+    def kv_stats(self) -> dict:
+        """KV-plane introspection (segment/level/memtable shape)."""
+        with self._lock:
+            self._alive()
+            return {**self._db.segment_stats(), **self._db.stats}
+
+    def compact(self) -> None:
+        """Full KV compaction (the ceph-kvstore-tool compact role)."""
+        with self._lock:
+            self._alive()
+            self._db.compact()
+
     # -- fsck ----------------------------------------------------------------
 
     @staticmethod
     def fsck(path: str) -> dict:
-        """Offline integrity audit (ref: BlueStore::fsck): checkpoint
-        seal, WAL chain, extent-map audit (overlaps / device bounds),
-        and every object's data crc read straight from the device —
-        without mutating anything."""
+        """Offline integrity audit (ref: BlueStore::fsck): the KV
+        plane (manifest seal, segment seals + ordering, WAL chain via
+        TinDB.fsck), KV-vs-block cross-checks (omap rows need an
+        object record, object records a collection, extents in-bounds
+        and disjoint), and every object's data crc read straight from
+        the device — without mutating anything. Legacy (pre-KV)
+        stores get the equivalent legacy audit."""
         report = {"objects": 0, "bad_objects": [], "wal_records": 0,
                   "torn_tail": False, "errors": [], "extent_errors": [],
-                  "device_bytes": 0, "used_bytes": 0}
-        scratch = TinStore.__new__(TinStore)
-        scratch.path = path
-        scratch._lock = threading.RLock()
-        scratch._meta = {}
-        scratch._cache = _BufferCache(0)
-        scratch._alloc = ExtentAllocator()
-        scratch._seq = 0
-        scratch._wal_f = None
-        scratch._dev_fd = None
-        scratch.committed_txns = 0
+                  "device_bytes": 0, "used_bytes": 0,
+                  "format": "kv", "kv": {}, "omap_keys": 0}
+        if TinStore._is_legacy(path):
+            report["format"] = "legacy"
+            try:
+                colls, omaps, _committed, _seq = \
+                    TinStore._legacy_load(path, truncate_torn=False)
+            except TinStoreCorruption as e:
+                report["errors"].append(str(e))
+                return report
+            report["omap_keys"] = sum(len(m) for m in omaps.values())
+            TinStore._audit_block_plane(path, colls, report)
+            return report
+        kv = TinDB.fsck(path)
+        report["kv"] = kv
+        report["wal_records"] = kv["wal_records"]
+        report["torn_tail"] = kv["torn_tail"]
+        report["errors"].extend(kv["errors"])
+        if kv["errors"]:
+            return report
         try:
-            base = scratch._load_checkpoint()
-        except TinStoreCorruption as e:
+            snap = TinDB.open_readonly(path)
+        except TinDBCorruption as e:
             report["errors"].append(str(e))
             return report
-        gen = scratch._scan_wal()
-        seq = base
-        while True:
+        colls: dict[str, dict[str, _TinObject]] = {}
+        for k, _v in snap.iterate("C"):
+            colls.setdefault(k.decode(), {})
+        for k, v in snap.iterate("O"):
+            cid_b, oid_b = k.split(b"\x00", 1)
+            cid = cid_b.decode()
+            if cid not in colls:
+                report["errors"].append(
+                    f"object record {cid}/{oid_b.decode()} has no "
+                    f"collection record")
+                colls.setdefault(cid, {})
             try:
-                rseq, body = next(gen)
-            except StopIteration as stop:
-                _, torn, err = stop.value
-                report["torn_tail"] = torn
-                if err:
-                    report["errors"].append(err)
-                break
-            if rseq <= base:
-                continue
-            if rseq != seq + 1:
-                report["errors"].append(f"seq jump {seq} -> {rseq}")
-                break
-            try:
-                for op in _decode_meta_txn(body):
-                    scratch._apply_meta(op, live=False)
-            except (EncodingError, KeyError, ValueError) as e:
-                report["errors"].append(f"record {rseq}: {e}")
-                break
-            seq = rseq
-            report["wal_records"] += 1
-        # extent audit: every referenced extent must be in-bounds and
-        # disjoint (reserve() raises on violation)
+                colls[cid][oid_b.decode()] = _decode_obj(v)
+            except EncodingError as e:
+                report["errors"].append(f"bad object record {k!r}: {e}")
+        for k, _v in snap.iterate("M"):
+            cid_b, oid_b, _mk = k.split(b"\x00", 2)
+            report["omap_keys"] += 1
+            if oid_b.decode() not in colls.get(cid_b.decode(), {}):
+                report["errors"].append(
+                    f"omap key for missing object "
+                    f"{cid_b.decode()}/{oid_b.decode()}")
+        TinStore._audit_block_plane(path, colls, report)
+        return report
+
+    @staticmethod
+    def _audit_block_plane(path: str, colls, report: dict) -> None:
+        """Extent + data-crc audit shared by the kv and legacy fsck
+        paths: every referenced extent in-bounds and disjoint
+        (reserve() raises on violation), every object's stored bytes
+        re-checksummed straight from the device."""
         try:
             dev_size = os.path.getsize(os.path.join(path, "block.dev"))
         except OSError:
@@ -1119,7 +1422,7 @@ class TinStore:
         except OSError:
             dev_fd = None
         try:
-            for cid, coll in scratch._meta.items():
+            for cid, coll in colls.items():
                 for oid, o in coll.items():
                     report["objects"] += 1
                     if o.dlen:
@@ -1157,4 +1460,3 @@ class TinStore:
             if dev_fd is not None:
                 os.close(dev_fd)
         report["used_bytes"] = audit.used_bytes()
-        return report

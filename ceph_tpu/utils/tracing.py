@@ -60,19 +60,44 @@ def span(name: str, counters=None, key: str | None = None):
             counters.tinc(key, time.perf_counter() - t0)
 
 
+_session: list = [None, None]        # [ProfilerSession, log_dir]
+
+
 def start_trace(log_dir: str) -> bool:
     """Begin a jax.profiler capture (the 'enable tracing' admin-socket
-    toggle). Returns False when the profiler is unavailable."""
+    toggle). Returns False when the profiler is unavailable.
+
+    Drives an XLA ProfilerSession directly with the PYTHON TRACER OFF
+    when the binding allows: the per-python-call events of the default
+    tracer flood the profiler's ~1M-event buffer within the first
+    compile, silently dropping the very span/device events the trace
+    is for. Falls back to the plain jax.profiler API otherwise."""
     try:
         import jax
-        jax.profiler.start_trace(log_dir)
+        jax.devices()                # backend init before the session
+        from jax._src.lib import xla_client
+        opts = xla_client.profiler.ProfileOptions()
+        opts.python_tracer_level = 0
+        _session[0] = xla_client.profiler.ProfilerSession(opts)
+        _session[1] = log_dir
         return True
     except Exception:
-        return False
+        _session[0] = None
+        try:
+            import jax
+            jax.profiler.start_trace(log_dir)
+            return True
+        except Exception:
+            return False
 
 
 def stop_trace() -> bool:
     try:
+        if _session[0] is not None:
+            sess, log_dir = _session
+            _session[0] = None
+            sess.export(sess.stop(), str(log_dir))
+            return True
         import jax
         jax.profiler.stop_trace()
         return True

@@ -1,0 +1,366 @@
+"""TinDB suite — the ordered-KV metadata plane standing alone.
+
+What BlueStore's store_test assumes of RocksDB, proved against TinDB
+directly (ref: src/kv/KeyValueDB.h contract; src/test/objectstore/
+test_kv.cc): ordered prefix-bounded iteration, atomic transaction
+batches (wholly present or wholly absent across SIGKILL), WAL replay,
+flush/compaction equivalence (same logical state before and after any
+segment reshuffle), snapshots isolated from later writes, and fsck on
+both clean and damaged directories.
+"""
+
+import os
+import struct
+
+import pytest
+
+from ceph_tpu.kv import KVTransaction, TinDB, TinDBCorruption
+from ceph_tpu.kv.interface import combine_key, prefix_range, split_key
+
+
+def mk(tmp_path, **kw):
+    kw.setdefault("memtable_max_bytes", 1 << 20)
+    return TinDB(str(tmp_path / "db"), **kw)
+
+
+def put(db, prefix, *pairs):
+    t = db.transaction()
+    for k, v in pairs:
+        t.set(prefix, k, v)
+    db.submit_transaction(t)
+
+
+def dump(db, prefix):
+    return list(db.iterate(prefix))
+
+
+class TestKeySpace:
+    def test_combine_split_roundtrip(self):
+        full = combine_key("O", b"cid\x00oid")
+        assert full == b"O\x00cid\x00oid"
+        assert split_key(full) == ("O", b"cid\x00oid")
+
+    def test_nul_prefix_rejected(self):
+        with pytest.raises(ValueError):
+            combine_key("bad\x00prefix", b"k")
+
+    def test_prefix_range_covers_exactly_one_prefix(self):
+        lo, hi = prefix_range("M")
+        assert lo == b"M\x00"
+        # every "M" key is inside, every "N"/"MA" full key outside
+        assert lo <= b"M\x00anything" < hi
+        assert not (lo <= b"N\x00x" < hi)
+
+    def test_prefixes_do_not_interleave(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "A", (b"z", b"1"))
+        put(db, "B", (b"a", b"2"))
+        assert dump(db, "A") == [(b"z", b"1")]
+        assert dump(db, "B") == [(b"a", b"2")]
+
+
+class TestOrderedIteration:
+    def test_ascending_order_across_layers(self, tmp_path):
+        # keys land via different routes: memtable, flushed segment,
+        # compacted run — iteration must present ONE ascending view
+        db = mk(tmp_path)
+        put(db, "O", *((f"k{i:03d}".encode(), b"seg") for i in
+                       range(0, 90, 3)))
+        db.flush()
+        put(db, "O", *((f"k{i:03d}".encode(), b"seg2") for i in
+                       range(1, 90, 3)))
+        db.flush()
+        put(db, "O", *((f"k{i:03d}".encode(), b"mem") for i in
+                       range(2, 90, 3)))
+        keys = [k for k, _ in dump(db, "O")]
+        assert keys == sorted(keys)
+        assert len(keys) == 90
+
+    def test_start_end_bounds(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", *((f"{i:02d}".encode(), b"v") for i in range(50)))
+        got = list(db.iterate("O", start=b"10", end=b"20"))
+        assert [k for k, _ in got] == [f"{i}".encode()
+                                      for i in range(10, 20)]
+
+    def test_newest_layer_wins(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"old"))
+        db.flush()
+        put(db, "O", (b"k", b"mid"))
+        db.flush()
+        put(db, "O", (b"k", b"new"))
+        assert db.get("O", b"k") == b"new"
+        assert dump(db, "O") == [(b"k", b"new")]
+
+    def test_tombstone_masks_older_segments(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"a", b"1"), (b"b", b"2"))
+        db.flush()
+        t = db.transaction().rmkey("O", b"a")
+        db.submit_transaction(t)
+        assert db.get("O", b"a") is None
+        assert dump(db, "O") == [(b"b", b"2")]
+        db.flush()                      # tombstone now in its own seg
+        assert dump(db, "O") == [(b"b", b"2")]
+
+
+class TestTransactions:
+    def test_batch_applies_in_order(self, tmp_path):
+        db = mk(tmp_path)
+        t = (db.transaction()
+             .set("O", b"k", b"first")
+             .rmkey("O", b"k")
+             .set("O", b"k", b"last"))
+        db.submit_transaction(t)
+        assert db.get("O", b"k") == b"last"
+
+    def test_rm_range_covers_batch_position(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"a1", b"x"), (b"a2", b"x"), (b"b1", b"x"))
+        t = (db.transaction()
+             .set("O", b"a3", b"added-then-doomed")
+             .rm_range_keys("O", b"a1", b"a9")
+             .set("O", b"a2", b"resurrected"))
+        db.submit_transaction(t)
+        assert dump(db, "O") == [(b"a2", b"resurrected"), (b"b1", b"x")]
+
+    def test_rmkeys_by_prefix(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "M", (b"c\x00o1\x00k", b"1"), (b"c\x00o2\x00k", b"2"),
+            (b"d\x00o1\x00k", b"3"))
+        db.submit_transaction(
+            db.transaction().rmkeys_by_prefix("M", b"c\x00"))
+        assert dump(db, "M") == [(b"d\x00o1\x00k", b"3")]
+
+    def test_atomicity_across_sigkill(self, tmp_path):
+        # every committed batch is wholly present after crash+remount;
+        # replay is pure WAL (no flush ever ran)
+        db = mk(tmp_path)
+        for i in range(20):
+            t = db.transaction()
+            for j in range(5):
+                t.set("O", f"b{i:02d}k{j}".encode(), b"v" * 10)
+            db.submit_transaction(t)
+        db.crash()
+        db.mount()
+        assert db.stats["wal_replayed"] == 20
+        assert len(dump(db, "O")) == 100
+
+    def test_range_delete_replays_blind(self, tmp_path):
+        # rm_range is expanded at submit, so replay needs no live
+        # state to re-resolve it (the WAL body is point ops only)
+        db = mk(tmp_path)
+        put(db, "O", *((f"k{i}".encode(), b"v") for i in range(9)))
+        db.submit_transaction(
+            db.transaction().rmkeys_by_prefix("O", b"k"))
+        db.crash()
+        db.mount()
+        assert dump(db, "O") == []
+
+
+class TestDurability:
+    def test_torn_tail_truncated(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"good", b"bytes"))
+        db.crash()
+        with open(os.path.join(db.path, "wal.log"), "ab") as f:
+            f.write(struct.pack("<IQI", 0x544E4952, 99, 1 << 20))
+            f.write(b"\xde\xad")
+        db.mount()
+        assert db.get("O", b"good") == b"bytes"
+        put(db, "O", (b"post", b"crash"))     # log extends cleanly
+        db.crash()
+        db.mount()
+        assert db.get("O", b"post") == b"crash"
+
+    def test_mid_log_corruption_fatal(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"a", b"1"))
+        put(db, "O", (b"b", b"2"))
+        db.crash()
+        with open(os.path.join(db.path, "wal.log"), "r+b") as f:
+            f.seek(18)
+            f.write(b"\xff\xff")
+        with pytest.raises(TinDBCorruption):
+            db.mount()
+        rep = TinDB.fsck(db.path)
+        assert rep["errors"]
+
+    def test_flush_covers_wal_and_resets(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"v"))
+        db.flush()
+        assert os.path.getsize(os.path.join(db.path, "wal.log")) == 0
+        put(db, "O", (b"k2", b"v2"))
+        db.crash()
+        db.mount()
+        assert db.get("O", b"k") == b"v"       # from the segment
+        assert db.get("O", b"k2") == b"v2"     # from the WAL
+        assert db.stats["wal_replayed"] == 1   # k's record was covered
+
+    def test_orphan_segment_reclaimed(self, tmp_path):
+        # crash between segment write and MANIFEST swap leaves an
+        # orphan file; mount must delete it, fsck must name it
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"v"))
+
+        def boom(point):
+            if point == "flush.segment-written":
+                raise KeyboardInterrupt("sigkill window")
+        db._fault = boom
+        with pytest.raises(KeyboardInterrupt):
+            db.flush()
+        db._fault = None
+        db.crash()
+        orphans = TinDB.fsck(db.path)["orphans"]
+        assert len(orphans) == 1
+        db.mount()
+        assert db.get("O", b"k") == b"v"       # WAL still covers it
+        assert TinDB.fsck(db.path)["orphans"] == []
+
+    def test_memtable_budget_triggers_flush(self, tmp_path):
+        db = mk(tmp_path, memtable_max_bytes=2048)
+        for i in range(40):
+            put(db, "O", (f"k{i:03d}".encode(), b"x" * 100))
+        assert db.stats["flushes"] >= 1
+        assert db.segment_stats()["segments"] >= 1
+        db.crash()
+        db.mount()
+        assert len(dump(db, "O")) == 40
+
+
+class TestCompaction:
+    def fill(self, db, rounds, stride=7):
+        want = {}
+        for r in range(rounds):
+            pairs = [(f"k{(r * stride + i) % 97:03d}".encode(),
+                      f"r{r}i{i}".encode()) for i in range(20)]
+            put(db, "O", *pairs)
+            want.update(pairs)
+            db.flush()
+        return want
+
+    def test_compaction_preserves_logical_state(self, tmp_path):
+        db = mk(tmp_path, fanout=3)
+        want = self.fill(db, rounds=9)
+        assert db.stats["compactions"] >= 1
+        assert dump(db, "O") == sorted(want.items())
+        db.crash()
+        db.mount()
+        assert dump(db, "O") == sorted(want.items())
+
+    def test_full_compact_to_one_run(self, tmp_path):
+        db = mk(tmp_path, fanout=10)      # no auto-compaction
+        want = self.fill(db, rounds=5)
+        db.submit_transaction(db.transaction().rmkey("O", b"k000"))
+        want.pop(b"k000", None)
+        db.compact()
+        st = db.segment_stats()
+        assert st["segments"] == 1
+        assert dump(db, "O") == sorted(want.items())
+        # deepest-level output drops tombstones entirely
+        assert st["entries"] == len(want)
+
+    def test_tombstones_survive_shallow_merges(self, tmp_path):
+        # deletion of a key whose value lives DEEP must not resurrect
+        # when shallow levels merge (tombstone dropped too early)
+        db = mk(tmp_path, fanout=2)
+        put(db, "O", (b"victim", b"deep-value"))
+        db.flush()
+        db.compact()                       # victim now on the deepest run
+        db.submit_transaction(db.transaction().rmkey("O", b"victim"))
+        db.flush()                         # tombstone in L0
+        for i in range(6):                 # force shallow L0 merges
+            put(db, "O", (f"fill{i}".encode(), b"x"))
+            db.flush()
+        assert db.get("O", b"victim") is None
+        assert b"victim" not in dict(dump(db, "O"))
+        db.crash()
+        db.mount()
+        assert db.get("O", b"victim") is None
+
+    def test_readers_unblocked_by_compaction(self, tmp_path):
+        # an open iterator pins replaced segments through their fds:
+        # compaction mid-scan must not disturb the walk
+        db = mk(tmp_path, fanout=10)
+        self.fill(db, rounds=4)
+        it = db.iterate("O")
+        first = [next(it) for _ in range(5)]
+        db.compact()                       # unlinks the old segments
+        rest = list(it)
+        keys = [k for k, _ in first + rest]
+        assert keys == sorted(set(keys))
+
+
+class TestSnapshots:
+    def test_snapshot_isolated_from_writes(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"before"))
+        snap = db.snapshot()
+        put(db, "O", (b"k", b"after"), (b"new", b"x"))
+        assert snap.get("O", b"k") == b"before"
+        assert snap.get("O", b"new") is None
+        assert list(snap.iterate("O")) == [(b"k", b"before")]
+        assert db.get("O", b"k") == b"after"
+
+    def test_snapshot_survives_flush_and_compact(self, tmp_path):
+        db = mk(tmp_path, fanout=2)
+        put(db, "O", (b"k", b"pinned"))
+        db.flush()
+        snap = db.snapshot()
+        for i in range(6):
+            put(db, "O", (b"k", f"v{i}".encode()))
+            db.flush()                     # compactions unlink files
+        assert snap.get("O", b"k") == b"pinned"
+
+    def test_open_readonly_matches_live(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"seg", b"1"))
+        db.flush()
+        put(db, "O", (b"wal", b"2"))
+        db.crash()                         # WAL record not flushed
+        snap = TinDB.open_readonly(db.path)
+        assert snap.get("O", b"seg") == b"1"
+        assert snap.get("O", b"wal") == b"2"
+        assert [k for k, _ in snap.iterate("O")] == [b"seg", b"wal"]
+        # and it mutated nothing: a real mount replays the same WAL
+        db.mount()
+        assert db.get("O", b"wal") == b"2"
+
+
+class TestFsck:
+    def test_clean_report(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"a", b"1"), (b"b", b"2"))
+        db.flush()
+        put(db, "O", (b"c", b"3"))
+        db.crash()
+        rep = TinDB.fsck(db.path)
+        assert rep["errors"] == [] and rep["orphans"] == []
+        assert rep["segments"] == 1 and rep["entries"] == 2
+        assert rep["wal_records"] == 1 and not rep["torn_tail"]
+
+    def test_segment_seal_damage_reported(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"sealed"))
+        db.flush()
+        db.crash()
+        seg = [f for f in os.listdir(db.path) if f.endswith(".tdb")][0]
+        with open(os.path.join(db.path, seg), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xaa")
+        rep = TinDB.fsck(db.path)
+        assert any("crc mismatch" in e for e in rep["errors"])
+        with pytest.raises(TinDBCorruption):
+            db.mount()
+
+    def test_manifest_seal_damage_reported(self, tmp_path):
+        db = mk(tmp_path)
+        put(db, "O", (b"k", b"v"))
+        db.umount()
+        with open(os.path.join(db.path, "MANIFEST"), "r+b") as f:
+            f.seek(5)
+            f.write(b"\x99")
+        rep = TinDB.fsck(db.path)
+        assert any("MANIFEST" in e for e in rep["errors"])

@@ -221,6 +221,55 @@ class TestCephxWire:
         finally:
             c.shutdown()
 
+    def test_thrash_with_injection_knobs_cephx_secure(self, tmp_path):
+        """The full-composition chaos cell: ms_inject_socket_failures
+        + ms_inject_delay live on every OSD, cephx tickets AND secure
+        (encrypted) wire mode on, persistent TinStore under the
+        daemons — kill/revive thrash must keep every byte through
+        reconnect+replay, re-auth, and WAL remount all at once."""
+        import numpy as np
+        c = StandaloneCluster(n_osds=4, pg_num=2, op_timeout=6.0,
+                              cephx=True, secret=b"\x42" * 32,
+                              store="tin", store_dir=str(tmp_path))
+        try:
+            c.wait_for_clean(timeout=25)
+            # every Nth send tears the socket down; every Mth send
+            # sleeps — the r5 injection knobs, now composed with the
+            # auth + secure + persistence planes instead of isolated
+            c.inject_socket_failures(9)
+            c.inject_delays(6, 8.0)
+            cl = c.client()
+            rng = np.random.default_rng(11)
+            data: dict[str, bytes] = {}
+            for rnd in range(2):
+                objs = {f"inj-{rnd}-{i}":
+                        rng.integers(0, 256, 300, np.uint8).tobytes()
+                        for i in range(4)}
+                cl.write(objs)
+                data.update(objs)
+                victim = c.osd_ids()[rnd % 4]
+                c.kill_osd(victim)
+                c._wait(lambda: any(
+                    not m._stop.is_set() and m.osdmap is not None
+                    and not m.osdmap.osd_up[victim]
+                    for m in c.mons), 25, f"osd.{victim} marked down")
+                more = {f"inj-{rnd}-deg-{i}":
+                        rng.integers(0, 256, 300, np.uint8).tobytes()
+                        for i in range(2)}
+                cl.write(more)           # degraded, through injection
+                data.update(more)
+                c.revive_osd(victim)     # WAL remount + re-auth; the
+                c.inject_socket_failures(9, osds=[victim])  # revived
+                c.inject_delays(6, 8.0, osds=[victim])      # daemon
+                #                          rejoins the injection matrix
+                c.wait_for_clean(timeout=50)
+            for name, want in sorted(data.items()):
+                assert cl.read(name) == want
+        finally:
+            c.inject_socket_failures(0)
+            c.inject_delays(0, 0.0)
+            c.shutdown()
+
     def test_rotation_keep_window_then_refresh(self, cluster):
         cl = cluster.client()
         objs = corpus(7)

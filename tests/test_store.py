@@ -228,7 +228,11 @@ class TestTinStoreDurability:
             data = rng.integers(0, 256, 150, np.uint8)
             st.queue_transaction(Transaction().write("c", f"o{i}", 0, data))
             want[f"o{i}"] = data.tobytes()
-        assert os.path.exists(os.path.join(str(tmp_path / "s"), "ckpt"))
+        # crossing wal_max_bytes flushed the KV memtable to at least
+        # one sorted segment under the (crc-sealed) MANIFEST
+        assert os.path.exists(os.path.join(str(tmp_path / "s"), "MANIFEST"))
+        ks = st.kv_stats()
+        assert ks["flushes"] >= 1 and ks["segments"] >= 1
         reopen(st)
         for name, data in want.items():
             assert bytes(st.read("c", name)) == data
@@ -254,15 +258,35 @@ class TestTinStoreDurability:
         with pytest.raises(TinStoreCorruption):
             st.read("c", "o")
 
-    def test_checkpoint_corruption_detected_at_mount(self, tmp_path):
+    def test_segment_corruption_detected_at_mount(self, tmp_path):
+        # umount flushes the memtable into a sealed segment; flip a
+        # byte inside it — the seal must fail the next mount AND fsck
         st = TinStore(str(tmp_path / "s"))
         st.queue_transaction(
             Transaction().create_collection("c")
             .write("c", "o", 0, b"will be sealed"))
         st.umount()
-        ckpt = os.path.join(str(tmp_path / "s"), "ckpt")
-        with open(ckpt, "r+b") as f:
-            f.seek(30)
+        segs = [f for f in os.listdir(str(tmp_path / "s"))
+                if f.startswith("seg-") and f.endswith(".tdb")]
+        assert segs, "umount should have flushed a segment"
+        with open(os.path.join(str(tmp_path / "s"), segs[0]),
+                  "r+b") as f:
+            f.seek(12)
+            f.write(b"\xaa")
+        with pytest.raises(TinStoreCorruption):
+            st.remount()
+        rep = TinStore.fsck(str(tmp_path / "s"))
+        assert rep["errors"]
+
+    def test_manifest_corruption_detected_at_mount(self, tmp_path):
+        st = TinStore(str(tmp_path / "s"))
+        st.queue_transaction(
+            Transaction().create_collection("c")
+            .write("c", "o", 0, b"manifest guard"))
+        st.umount()
+        with open(os.path.join(str(tmp_path / "s"), "MANIFEST"),
+                  "r+b") as f:
+            f.seek(6)
             f.write(b"\xaa")
         with pytest.raises(TinStoreCorruption):
             st.remount()
@@ -316,16 +340,20 @@ class TestTinStoreBlockPlane:
             assert st.cache_stats()["bytes"] <= budget
 
     def test_checkpoint_is_metadata_only(self, tmp_path):
-        # 4 MiB of object data; the checkpoint must stay tiny (extent
-        # refs, not bytes) — the r3 O(store) serialize is gone
+        # 4 MiB of object data; the flushed KV plane must stay tiny
+        # (extent refs, not bytes) — the r3 O(store) serialize is gone
         st = TinStore(str(tmp_path / "s"))
         big = bytes(range(256)) * (4 << 12)
         st.queue_transaction(
             Transaction().create_collection("c")
             .write("c", "big", 0, big))
         st.checkpoint()
-        ckpt = os.path.getsize(os.path.join(str(tmp_path / "s"), "ckpt"))
-        assert ckpt < 16 << 10, f"checkpoint {ckpt}B should be metadata-only"
+        d = str(tmp_path / "s")
+        kv_bytes = sum(
+            os.path.getsize(os.path.join(d, f)) for f in os.listdir(d)
+            if f == "MANIFEST" or f.endswith(".tdb"))
+        assert kv_bytes < 16 << 10, \
+            f"KV plane {kv_bytes}B should be metadata-only"
         st.crash()
         st.remount()
         assert bytes(st.read("c", "big")) == big
@@ -591,6 +619,129 @@ class TestTinStoreCompression:
         for ps in range(c.pg_num):
             rep = c.pgs[ps].deep_scrub(dead_osds=c._dead_osds())
             assert rep["inconsistent"] == []
+
+
+class TestLegacyForwardReplay:
+    """Pre-KV stores (v3 `ckpt` checkpoint + metadata-op WAL) must
+    mount on the KV TinStore: migration forward-replays them into
+    TinDB's first segment and lands the MANIFEST atomically. Nothing
+    readable before may be unreadable after."""
+
+    def _make_legacy(self, path):
+        """Fabricate a pre-KV store directly in the legacy on-disk
+        format: sealed v3 checkpoint + two metadata-op WAL records."""
+        from ceph_tpu.kv.tindb import append_wal_record, host_crc32c
+        from ceph_tpu.osd.tinstore import (_encode_meta_txn,
+                                           ExtentAllocator)
+        from ceph_tpu.utils.encoding import Encoder
+        os.makedirs(path, exist_ok=True)
+        payloads = {"o1": b"legacy object one", "o2": b"second" * 40}
+        doffs = {}
+        with open(os.path.join(path, "block.dev"), "wb") as dev:
+            off = 0
+            for oid, data in payloads.items():
+                dev.write(data)
+                doffs[oid] = (off, ExtentAllocator.round_up(len(data)))
+                pad = doffs[oid][1] - len(data)
+                dev.write(b"\x00" * pad)
+                off += doffs[oid][1]
+        e = Encoder()
+        e.start(3, 3)
+        e.u64(0)                      # base_seq
+        e.u64(1)                      # committed_txns at checkpoint
+        e.u32(1)                      # one collection
+        e.string("c")
+        e.u32(len(payloads))
+        from ceph_tpu.osd.tinstore import _crc32c
+        for oid, data in payloads.items():
+            doff, dlen = doffs[oid]
+            e.string(oid)
+            e.u64(len(data)).u64(doff).u64(dlen).u32(_crc32c(data))
+            e.mapping({"who": b"ckpt"}, Encoder.string, Encoder.blob)
+            e.mapping({b"ck": b"from-ckpt"} if oid == "o1" else {},
+                      Encoder.blob, Encoder.blob)
+            e.string("").u64(0).u32(0)      # uncompressed
+        e.finish()
+        body = e.bytes()
+        body += struct.pack("<I", host_crc32c(body))
+        with open(os.path.join(path, "ckpt"), "wb") as f:
+            f.write(body)
+        with open(os.path.join(path, "wal.log"), "wb") as f:
+            append_wal_record(f, 1, _encode_meta_txn(
+                [("touch", "c", "o3"),
+                 ("omap_set", "c", "o1", {b"wk": b"from-wal"})]),
+                o_dsync=False)
+            append_wal_record(f, 2, _encode_meta_txn(
+                [("setattr", "c", "o3", "hinfo", b"\x07")]),
+                o_dsync=False)
+        return payloads
+
+    def test_legacy_store_migrates_and_serves(self, tmp_path):
+        path = str(tmp_path / "old")
+        payloads = self._make_legacy(path)
+        # pre-migration fsck sees the legacy format, clean
+        rep = TinStore.fsck(path)
+        assert rep["format"] == "legacy" and not rep["errors"]
+        assert not rep["bad_objects"]
+        st = TinStore(path)           # mount = forward migration
+        assert os.path.exists(os.path.join(path, "MANIFEST"))
+        assert not os.path.exists(os.path.join(path, "ckpt"))
+        for oid, data in payloads.items():
+            assert bytes(st.read("c", oid)) == data
+            assert st.getattr("c", oid, "who") == b"ckpt"
+        # checkpoint omap AND wal omap both present, ordered
+        assert dict(st.collections["c"]["o1"].omap) \
+            == {b"ck": b"from-ckpt", b"wk": b"from-wal"}
+        assert st.exists("c", "o3")
+        assert st.getattr("c", "o3", "hinfo") == b"\x07"
+        # ckpt committed 1 txn + 2 wal records
+        assert st.committed_txns == 3
+        st.umount()
+        rep = TinStore.fsck(path)
+        assert rep["format"] == "kv" and not rep["errors"]
+        assert not rep["bad_objects"] and not rep["extent_errors"]
+
+    def test_migrated_store_is_durable_and_writable(self, tmp_path):
+        path = str(tmp_path / "old")
+        payloads = self._make_legacy(path)
+        st = TinStore(path)
+        st.queue_transaction(
+            Transaction().write("c", "post", 0, b"post-migration")
+            .omap_set("c", "o3", {b"nk": b"nv"}))
+        st.crash()
+        st.remount()                  # plain KV remount, no re-migration
+        for oid, data in payloads.items():
+            assert bytes(st.read("c", oid)) == data
+        assert bytes(st.read("c", "post")) == b"post-migration"
+        assert dict(st.collections["c"]["o3"].omap) == {b"nk": b"nv"}
+
+    def test_crash_before_manifest_reruns_migration(self, tmp_path):
+        # the migration's commit point is the MANIFEST rename: fake
+        # the "crashed halfway" window (segment written, no MANIFEST)
+        # with a stray orphan segment — remount must re-migrate and
+        # reclaim the orphan
+        path = str(tmp_path / "old")
+        payloads = self._make_legacy(path)
+        from ceph_tpu.kv.tindb import write_segment
+        write_segment(os.path.join(path, "seg-00000001.tdb"),
+                      [(b"O\x00half", b"way")])
+        st = TinStore(path)           # _is_legacy: no MANIFEST -> migrate
+        for oid, data in payloads.items():
+            assert bytes(st.read("c", oid)) == data
+        assert st._db.get("O", b"half") is None
+        st.umount()
+        assert not TinStore.fsck(path)["errors"]
+
+    def test_legacy_mid_log_corruption_still_fatal(self, tmp_path):
+        path = str(tmp_path / "old")
+        self._make_legacy(path)
+        with open(os.path.join(path, "wal.log"), "r+b") as f:
+            f.seek(20)
+            f.write(b"\xff\xff\xff")
+        with pytest.raises(TinStoreCorruption):
+            TinStore(path)
+        rep = TinStore.fsck(path)
+        assert rep["format"] == "legacy" and rep["errors"]
 
 
 def test_store_bench_tool_smoke():

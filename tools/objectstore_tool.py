@@ -12,6 +12,19 @@ demonstrates the full round trip end to end:
 
   python tools/objectstore_tool.py inspect <export-file>
       prints an export file's header + object list.
+
+KV-plane surface (the ceph-kvstore-tool role over a TinStore/TinDB
+directory — offline, no daemon):
+
+  python tools/objectstore_tool.py kv-dump <store-dir>
+      MANIFEST levels, per-segment entry counts, WAL chain state.
+  python tools/objectstore_tool.py kv-list <store-dir> [--prefix O]
+      ordered key walk (key + value size) from a read-only snapshot.
+  python tools/objectstore_tool.py kv-compact <store-dir>
+      flush + full leveled compaction down to one run.
+  python tools/objectstore_tool.py fsck <store-dir>
+      full offline audit: KV seals/ordering/WAL chain + KV-vs-block
+      cross-checks + every object's data crc.
 """
 
 from __future__ import annotations
@@ -72,6 +85,83 @@ def cmd_inspect(args) -> None:
         print(f"  {n}  {len(d)} bytes")
 
 
+def cmd_kv_dump(args) -> None:
+    from ceph_tpu.kv import TinDB, TinDBCorruption
+    try:
+        man = TinDB._read_manifest(args.dir)
+    except TinDBCorruption as e:
+        raise SystemExit(f"objectstore_tool: {e}")
+    if man is None:
+        raise SystemExit(f"objectstore_tool: {args.dir}: no MANIFEST "
+                         f"(not a KV store, or pre-KV legacy layout)")
+    covered, next_seg, levels = man
+    print(f"{args.dir}: covered_seq={covered} next_seg={next_seg}")
+    from ceph_tpu.kv.tindb import Segment
+    for i, lvl in enumerate(levels):
+        print(f"  L{i}: {len(lvl)} segment(s)")
+        for name in lvl:
+            try:
+                seg = Segment(os.path.join(args.dir, name))
+                size = os.path.getsize(os.path.join(args.dir, name))
+                print(f"    {name}  {seg.n_entries} entries  "
+                      f"{size} bytes")
+                seg.close()
+            except (TinDBCorruption, OSError) as e:
+                print(f"    {name}  UNREADABLE: {e}")
+    rep = TinDB.fsck(args.dir)
+    print(f"  WAL: {rep['wal_records']} record(s) past covered_seq"
+          + (" (torn tail)" if rep["torn_tail"] else ""))
+    for o in rep["orphans"]:
+        print(f"  orphan segment: {o}")
+    for e in rep["errors"]:
+        print(f"  ERROR: {e}")
+    if rep["errors"]:
+        raise SystemExit(1)
+
+
+def cmd_kv_list(args) -> None:
+    from ceph_tpu.kv import TinDB, TinDBCorruption
+    try:
+        snap = TinDB.open_readonly(args.dir)
+    except TinDBCorruption as e:
+        raise SystemExit(f"objectstore_tool: {e}")
+    prefixes = [args.prefix] if args.prefix else ["C", "O", "M", "S"]
+    n = 0
+    for pre in prefixes:
+        for k, v in snap.iterate(pre):
+            print(f"  {pre} {k!r}  {len(v)} bytes")
+            n += 1
+            if args.limit and n >= args.limit:
+                print(f"  ... (stopped at --limit {args.limit})")
+                return
+    print(f"{n} key(s)")
+
+
+def cmd_kv_compact(args) -> None:
+    from ceph_tpu.kv import TinDB, TinDBCorruption
+    try:
+        db = TinDB(args.dir)
+    except TinDBCorruption as e:
+        raise SystemExit(f"objectstore_tool: {e}")
+    before = db.segment_stats()
+    db.compact()
+    after = db.segment_stats()
+    db.umount()
+    print(f"compacted {args.dir}: {before['segments']} -> "
+          f"{after['segments']} segment(s), "
+          f"{after['entries']} live entries")
+
+
+def cmd_fsck(args) -> None:
+    import json
+    from ceph_tpu.osd.tinstore import TinStore
+    rep = TinStore.fsck(args.dir)
+    print(json.dumps(rep, indent=1, default=str))
+    bad = rep["errors"] or rep["extent_errors"] or rep["bad_objects"]
+    if bad:
+        raise SystemExit(1)
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -82,11 +172,26 @@ def main(argv=None) -> None:
     demo.add_argument("--file", default=None)
     insp = sub.add_parser("inspect")
     insp.add_argument("file")
+    for name in ("kv-dump", "kv-list", "kv-compact", "fsck"):
+        p = sub.add_parser(name)
+        p.add_argument("dir")
+        if name == "kv-list":
+            p.add_argument("--prefix", default=None,
+                           choices=["C", "O", "M", "S"])
+            p.add_argument("--limit", type=int, default=None)
     args = ap.parse_args(argv)
     if args.cmd == "demo":
         cmd_demo(args)
-    else:
+    elif args.cmd == "inspect":
         cmd_inspect(args)
+    elif args.cmd == "kv-dump":
+        cmd_kv_dump(args)
+    elif args.cmd == "kv-list":
+        cmd_kv_list(args)
+    elif args.cmd == "kv-compact":
+        cmd_kv_compact(args)
+    else:
+        cmd_fsck(args)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,11 @@ def main(argv=None) -> None:
     ap.add_argument("--lost", type=int, default=1)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--no-verify-hinfo", action="store_true")
+    ap.add_argument("--trace", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the recovery "
+                         "phase into DIR (view with tensorboard/xprof; "
+                         "the ecbackend.recover.{stage,launch,fetch,"
+                         "writeback} spans mark the pipeline stages)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -64,10 +69,23 @@ def main(argv=None) -> None:
         cluster.stores.pop(be.acting[s], None)
     repl = {s: 1000 + s for s in lost}
 
+    from ceph_tpu.utils.tracing import trace
     t0 = time.perf_counter()
-    counters = be.recover_shards(lost, replacement_osds=repl,
-                                 batch=args.batch,
-                                 verify_hinfo=not args.no_verify_hinfo)
+    if args.trace:
+        # trace ONLY the recovery phase: the write-path compile noise
+        # is out of frame, so the 3-stage pipeline overlap (stage /
+        # launch / fetch+writeback spans) is what the timeline shows
+        with trace(args.trace) as traced:
+            counters = be.recover_shards(
+                lost, replacement_osds=repl, batch=args.batch,
+                verify_hinfo=not args.no_verify_hinfo)
+        if not traced:
+            print("warning: jax.profiler unavailable, no trace "
+                  "captured", file=sys.stderr)
+    else:
+        counters = be.recover_shards(
+            lost, replacement_osds=repl, batch=args.batch,
+            verify_hinfo=not args.no_verify_hinfo)
     t_rec = time.perf_counter() - t0
 
     import jax

@@ -13,6 +13,17 @@ parameterizes (tests/test_store.py, the store_test.cc role).
   python tools/store_bench.py --store mem write
   python tools/store_bench.py --store tin --o-dsync randwrite
   python tools/store_bench.py --store tin --compression zlib read
+
+Metadata-plane workloads (the paths TinDB exists for):
+
+  list — paginated object listing from random cursors. MemStore sorts
+  the whole collection per page (O(n log n) in collection size); tin
+  serves each page from TinDB's ordered prefix-bounded iterator
+  (O(page)). Run at several --objects sizes to see the scaling split.
+  omap — same shape over one object's omap keys (--objects = keys).
+
+  python tools/store_bench.py --store tin --objects 100000 list
+  python tools/store_bench.py --store mem --objects 100000 --page 64 omap
 """
 
 from __future__ import annotations
@@ -45,7 +56,8 @@ def main(argv=None) -> None:
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("workload",
-                    choices=["write", "randwrite", "read", "randread"])
+                    choices=["write", "randwrite", "read", "randread",
+                             "list", "omap"])
     ap.add_argument("--store", choices=["mem", "tin"], default="mem")
     ap.add_argument("--object-size", type=int, default=64 * 1024)
     ap.add_argument("--objects", type=int, default=256,
@@ -54,6 +66,8 @@ def main(argv=None) -> None:
     ap.add_argument("--txn-ops", type=int, default=8,
                     help="ops batched per transaction "
                          "(the queue_transaction unit)")
+    ap.add_argument("--page", type=int, default=64,
+                    help="list/omap: entries per page")
     ap.add_argument("--o-dsync", action="store_true",
                     help="tin: O_DSYNC on the data device")
     ap.add_argument("--compression", default=None,
@@ -61,7 +75,7 @@ def main(argv=None) -> None:
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.object_size <= 0 or args.objects <= 0 \
-            or args.txn_ops <= 0 or args.seconds <= 0:
+            or args.txn_ops <= 0 or args.seconds <= 0 or args.page <= 0:
         raise SystemExit("store_bench: sizes/counts/seconds must be "
                          "positive")
 
@@ -76,21 +90,64 @@ def main(argv=None) -> None:
     def name(i):
         return f"o{i % args.objects:06d}"
 
-    # stage the working set (read workloads need it; write workloads
-    # get steady-state overwrite behavior instead of cold creates)
-    for i in range(args.objects):
-        st.queue_transaction(Transaction().write(
-            cid, name(i), 0, payloads[i % len(payloads)]))
+    if args.workload in ("list", "omap"):
+        # metadata-only working set: the payload plane is irrelevant,
+        # what's measured is listing/omap-iteration cost vs set size
+        if args.workload == "list":
+            for base in range(0, args.objects, 1024):
+                t = Transaction()
+                for i in range(base, min(base + 1024, args.objects)):
+                    t.touch(cid, name(i))
+                st.queue_transaction(t)
+        else:
+            st.queue_transaction(Transaction().touch(cid, "omap_obj"))
+            for base in range(0, args.objects, 1024):
+                t = Transaction()
+                t.omap_set(cid, "omap_obj",
+                           {f"k{i:09d}".encode(): f"v{i}".encode()
+                            for i in range(base, min(base + 1024,
+                                                     args.objects))})
+                st.queue_transaction(t)
+        if hasattr(st, "checkpoint"):
+            st.checkpoint()       # steady state: memtable flushed, the
+            #                       pages walk sorted segments
+    else:
+        # stage the working set (read workloads need it; write
+        # workloads get steady-state overwrite instead of cold creates)
+        for i in range(args.objects):
+            st.queue_transaction(Transaction().write(
+                cid, name(i), 0, payloads[i % len(payloads)]))
 
     order = (rng.permutation(args.objects)
-             if args.workload.startswith("rand") else None)
+             if args.workload.startswith("rand")
+             or args.workload in ("list", "omap") else None)
     lat: list[float] = []
     n_ops = 0
+    n_entries = 0
     t_start = time.perf_counter()
     t_end = t_start + args.seconds
     i = 0
     while time.perf_counter() < t_end:
-        if args.workload.endswith("write"):
+        if args.workload == "list":
+            j = int(order[i % args.objects])
+            t0 = time.perf_counter()
+            page = st.list_objects(cid, start_after=name(j),
+                                   limit=args.page)
+            lat.append(time.perf_counter() - t0)
+            n_ops += 1
+            n_entries += len(page)
+            i += 1
+        elif args.workload == "omap":
+            j = int(order[i % args.objects])
+            t0 = time.perf_counter()
+            page = st.omap_iter(cid, "omap_obj",
+                                start_after=f"k{j:09d}".encode(),
+                                limit=args.page)
+            lat.append(time.perf_counter() - t0)
+            n_ops += 1
+            n_entries += len(page)
+            i += 1
+        elif args.workload.endswith("write"):
             t = Transaction()
             for _ in range(args.txn_ops):
                 j = order[i % args.objects] if order is not None else i
@@ -126,6 +183,15 @@ def main(argv=None) -> None:
         "note": "direct ObjectStore queue_transaction/read loop — "
                 "no OSD/PG layers (the fio_ceph_objectstore role)",
     }
+    if args.workload in ("list", "omap"):
+        # pages, not byte I/O: iops = pages/s, latency = per page
+        out.update(set_size=args.objects, page=args.page,
+                   pages_per_s=out.pop("iops"),
+                   entries_per_s=round(n_entries / dt, 1),
+                   note="paginated metadata scan from random cursors "
+                        "— per-page latency vs set size is the "
+                        "linear-vs-sublinear listing evidence")
+        del out["mb_per_s"], out["object_size"], out["txn_ops"]
     if tmp is not None:
         import shutil
         shutil.rmtree(tmp, ignore_errors=True)
