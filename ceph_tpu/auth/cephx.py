@@ -399,6 +399,17 @@ class ClientAuth:
             with self._lock:
                 self._svc.update(fresh)
 
+    def has_ticket(self, service: str) -> bool:
+        """Is a cached, unexpired `service` ticket present? Zero I/O:
+        lets dispatch-path callers FAIL FAST on a cold cache instead
+        of hunting monitors while holding their daemon lock — the
+        monitor's reply can be head-of-line-blocked behind undelivered
+        frames on the very connection whose reader waits for that
+        lock (the boot map-storm deadlock)."""
+        with self._lock:
+            ent = self._svc.get(service)
+            return ent is not None and self.now() <= ent["expires"] - 1.0
+
     def authorizer_for(self, service: str,
                        server_challenge: str | None = None) -> dict:
         return self.authorizer_with_key(service, server_challenge)[0]

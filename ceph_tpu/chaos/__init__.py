@@ -1,0 +1,5 @@
+"""Chaos engineering for the wire tier: the seeded Thrasher (the
+teuthology OSDThrasher role) and its invariant checkers."""
+
+from .thrasher import (KNOBS, InvariantViolation, Thrasher,  # noqa: F401
+                       repro_command)

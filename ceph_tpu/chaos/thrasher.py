@@ -1,0 +1,416 @@
+"""Thrasher — a deterministic, seed-driven fault scheduler for the
+wire tier (the teuthology OSDThrasher role, ref: qa/tasks/
+ceph_manager.py: random kill/revive/injection during live I/O, then
+assert the cluster converged and nothing was lost).
+
+Design goals, in order:
+
+1. REPRODUCIBLE. Every decision — which fault, which victim, what
+   data, which injection knob values — is drawn from ONE
+   `random.Random(seed)`. The messenger injection knobs are seeded
+   per daemon (`Messenger.seed_injection`), so a logged seed replays
+   the same fault schedule and the same delay draws. Thread
+   interleaving still varies run to run (real sockets, real
+   threads), which is the point: the schedule is the experiment, the
+   nondeterministic execution is the population it samples.
+2. COMPOSED. Faults run with cephx tickets AND secure (encrypted)
+   frames on, over either store backend ("mem"/"tin"), with
+   `ms_inject_socket_failures` + `ms_inject_delay` live on every
+   daemon and scheduled scrub enabled — the full production-shaped
+   stack, not an isolated knob (round 5's messenger identity bugs
+   only surfaced under exactly this composition).
+3. CHECKED. After every round's heal the invariants run:
+     * convergence   — every PG's primary hosts a caught-up backend
+                       (wait_for_clean);
+     * exactly-once  — every acked write reads back byte-exact, every
+       bytes           acked overwrite reads the LAST acked value;
+     * no            — an acked remove stays removed (a rejoined
+       resurrection    shard's stale copy must never come back);
+   and at teardown:
+     * fsck-clean    — every TinStore directory (the stores crashed
+       remount         mid-chaos and remounted, then died with the
+                       final shutdown) passes offline fsck with zero
+                       errors.
+   An invariant failure raises InvariantViolation carrying the seed
+   and the one-command reproducer (`tools/thrash.py --seed N ...`).
+
+Client ops that fail mid-chaos (PG below min_size, primary pre-
+active, quorum loss) are PARKED, not errors: the op's target object
+moves to the `unknown` set and is excluded from exactly-once /
+resurrection claims — an op whose ack never arrived proves nothing
+either way (the reference's thrasher tolerates EAGAIN the same way).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+#: the fault menu — name -> (weight, description). `--list-knobs`
+#: prints this; the weights are part of the schedule contract (a seed
+#: replays the same draws only against the same menu).
+KNOBS: dict[str, tuple[int, str]] = {
+    "write": (4, "write fresh objects through the client"),
+    "overwrite": (2, "rewrite a previously-named object (exactly-once "
+                     "check tracks the last acked value)"),
+    "remove": (2, "remove an object (no-resurrection check)"),
+    "kill_osd": (2, "SIGKILL an OSD (budget: <= m concurrently dead)"),
+    "revive_osd": (2, "revive a killed OSD (TinStore: WAL remount)"),
+    "remount": (1, "kill + immediately revive one OSD — a pure "
+                   "store-remount cycle"),
+    "socket_failures": (1, "re-seed ms_inject_socket_failures with a "
+                           "drawn period on every live daemon"),
+    "delays": (1, "re-seed ms_inject_delay with drawn period/max_ms"),
+    "mon_kill": (1, "SIGKILL a monitor (may take out the majority — "
+                    "map mutations and activation stall)"),
+    "mon_revive": (1, "revive a killed monitor (store sync + "
+                      "election)"),
+    "deep_scrub": (1, "client-driven deep scrub of a random PG "
+                      "(scheduled scrub also runs throughout via "
+                      "osd_scrub_interval)"),
+}
+
+
+def repro_command(seed: int, store: str, rounds: int, ops: int) -> str:
+    """The one-command local reproduction for a failing cell."""
+    return (f"python tools/thrash.py --seed {seed} --store {store} "
+            f"--rounds {rounds} --ops {ops}")
+
+
+class InvariantViolation(AssertionError):
+    """An invariant failed; the message carries seed + reproducer."""
+
+    def __init__(self, what: str, seed: int, repro: str):
+        super().__init__(
+            f"{what}\n  thrash seed: {seed}\n  reproduce: {repro}")
+        self.seed = seed
+        self.repro = repro
+
+
+class Thrasher:
+    """One seeded thrash run over a StandaloneCluster."""
+
+    def __init__(self, seed: int, store: str = "mem", rounds: int = 2,
+                 ops: int = 6, n_osds: int = 4, pg_num: int = 2,
+                 store_dir: str | None = None, verbose: bool = False):
+        self.seed = int(seed)
+        self.store = store
+        self.rounds = rounds
+        self.ops = ops
+        self.n_osds = n_osds
+        self.pg_num = pg_num
+        self.store_dir = store_dir
+        self.verbose = verbose
+        self.rng = random.Random(self.seed)
+        # shadow state (the invariant oracles)
+        self.shadow: dict[str, bytes] = {}   # name -> last ACKED bytes
+        self.removed: set[str] = set()       # ACKED removes
+        self.unknown: set[str] = set()       # un-acked fate: no claims
+        self.dead_osds: set[int] = set()
+        self.dead_mons: set[int] = set()
+        self.schedule: list[str] = []        # the replayable fault log
+        self._obj_i = 0
+        self.repro = repro_command(self.seed, store, rounds, ops)
+        self.c = None
+        self.cl = None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        self.schedule.append(msg)
+        if self.verbose:
+            print(f"thrash[{self.seed}]: {msg}", flush=True)
+
+    def _violate(self, what: str) -> None:
+        raise InvariantViolation(what, self.seed, self.repro)
+
+    def _fresh_names(self, n: int) -> list[str]:
+        names = [f"thrash-{self.seed}-{self._obj_i + j}"
+                 for j in range(n)]
+        self._obj_i += n
+        return names
+
+    def _parked(self, what: str, e: Exception) -> None:
+        self._log(f"parked {what}: {type(e).__name__}")
+
+    # -- setup / teardown ----------------------------------------------------
+
+    def setup(self):
+        from ..osd.standalone import StandaloneCluster
+        # cephx + secure ON: the secret is seed-derived so even the
+        # key schedule replays; tin gets a real on-disk directory
+        secret = bytes(self.rng.randrange(256) for _ in range(32))
+        self._log(f"setup n_osds={self.n_osds} pg_num={self.pg_num} "
+                  f"store={self.store} cephx+secure on")
+        self.c = StandaloneCluster(
+            n_osds=self.n_osds, pg_num=self.pg_num, store=self.store,
+            store_dir=self.store_dir, cephx=True, secret=secret,
+            op_timeout=6.0)
+        self.m = self.c.pool_size - self.c.pool_min_size
+        self.c.wait_for_clean(timeout=40)
+        self.cl = self.c.client()
+        # injection + scheduled scrub live from the start
+        self._set_injection()
+        try:
+            self.cl.config_set("osd_scrub_interval", 3.0, timeout=20)
+            self.cl.config_set("osd_scrub_auto_repair", "true",
+                               timeout=20)
+        except TimeoutError as e:
+            self._parked("config_set scrub", e)
+        return self
+
+    def teardown(self) -> None:
+        if self.c is None:
+            return
+        self.c.inject_socket_failures(0)
+        self.c.inject_delays(0, 0.0)
+        self.c.shutdown()
+
+    def _set_injection(self) -> None:
+        every_sock = self.rng.randrange(8, 14)
+        every_delay = self.rng.randrange(5, 10)
+        max_ms = self.rng.uniform(4.0, 12.0)
+        alive = sorted(set(self.c.osd_ids()) - self.dead_osds)
+        self.c.inject_socket_failures(every_sock, osds=alive,
+                                      seed=self.seed)
+        self.c.inject_delays(every_delay, max_ms, osds=alive,
+                             seed=self.seed)
+        self._log(f"inject socket_failures={every_sock} "
+                  f"delay=({every_delay}, {max_ms:.1f}ms)")
+
+    # -- fault + IO actions --------------------------------------------------
+
+    def act_write(self) -> None:
+        objs = {n: self.rng.randbytes(self.rng.randrange(50, 900))
+                for n in self._fresh_names(self.rng.randrange(2, 5))}
+        try:
+            self.cl.write(objs)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            self.unknown.update(objs)
+            self._parked("write", e)
+            return
+        self.shadow.update(objs)
+        self.removed -= set(objs)
+        self._log(f"write {len(objs)} objects")
+
+    def act_overwrite(self) -> None:
+        # target drawn from the DETERMINISTIC name counter, never from
+        # the ack-dependent shadow: which ops got parked varies run to
+        # run (thread timing), and a state-dependent candidate set
+        # would desync the RNG stream between a run and its replay
+        if not self._obj_i:
+            return
+        name = f"thrash-{self.seed}-{self.rng.randrange(self._obj_i)}"
+        data = self.rng.randbytes(self.rng.randrange(50, 900))
+        try:
+            self.cl.write({name: data})
+        except (ConnectionError, OSError, RuntimeError) as e:
+            self.unknown.add(name)
+            self._parked("overwrite", e)
+            return
+        self.shadow[name] = data
+        self.removed.discard(name)
+        self.unknown.discard(name)   # ack resolves an unknown fate
+        self._log(f"overwrite {name}")
+
+    def act_remove(self) -> None:
+        if self._obj_i < 3:
+            return
+        name = f"thrash-{self.seed}-{self.rng.randrange(self._obj_i)}"
+        try:
+            self.cl.remove(name)     # idempotent: absent names ack too
+        except (ConnectionError, OSError, RuntimeError, KeyError) as e:
+            self.unknown.add(name)
+            self._parked("remove", e)
+            return
+        self.shadow.pop(name, None)
+        self.removed.add(name)
+        self.unknown.discard(name)
+        self._log(f"remove {name}")
+
+    def act_kill_osd(self) -> None:
+        alive = sorted(set(self.c.osd_ids()) - self.dead_osds)
+        if len(self.dead_osds) >= self.m or not alive:
+            return
+        victim = alive[self.rng.randrange(len(alive))]
+        self.c.kill_osd(victim)
+        self.dead_osds.add(victim)
+        self._log(f"kill osd.{victim}")
+
+    def act_revive_osd(self) -> None:
+        if not self.dead_osds:
+            return
+        dead = sorted(self.dead_osds)
+        victim = dead[self.rng.randrange(len(dead))]
+        self.c.revive_osd(victim)
+        self.dead_osds.discard(victim)
+        # the revived daemon rejoins the injection matrix
+        self.c.inject_socket_failures(self.rng.randrange(8, 14),
+                                      osds=[victim], seed=self.seed)
+        self.c.inject_delays(self.rng.randrange(5, 10),
+                             self.rng.uniform(4.0, 12.0),
+                             osds=[victim], seed=self.seed)
+        self._log(f"revive osd.{victim}")
+
+    def act_remount(self) -> None:
+        """Kill + immediate revive: on TinStore this is a real WAL+
+        checkpoint remount under traffic; on MemStore a process
+        restart with state kept by fiat."""
+        alive = sorted(set(self.c.osd_ids()) - self.dead_osds)
+        if len(self.dead_osds) >= self.m or not alive:
+            return
+        victim = alive[self.rng.randrange(len(alive))]
+        self.c.kill_osd(victim)
+        self.c.revive_osd(victim)
+        self.c.inject_socket_failures(self.rng.randrange(8, 14),
+                                      osds=[victim], seed=self.seed)
+        self._log(f"remount osd.{victim}")
+
+    def act_socket_failures(self) -> None:
+        self._set_injection()
+
+    def act_delays(self) -> None:
+        self._set_injection()
+
+    def act_mon_kill(self) -> None:
+        # allowed to take out the MAJORITY: the quorum-loss map freeze
+        # (and up_thru activation stall) is part of what chaos must
+        # exercise; the round's heal revives them
+        alive = sorted(set(range(3)) - self.dead_mons)
+        if len(self.dead_mons) >= 2 or not alive:
+            return
+        victim = alive[self.rng.randrange(len(alive))]
+        self.c.kill_mon(victim)
+        self.dead_mons.add(victim)
+        self._log(f"kill mon.{victim}")
+
+    def act_mon_revive(self) -> None:
+        if not self.dead_mons:
+            return
+        dead = sorted(self.dead_mons)
+        victim = dead[self.rng.randrange(len(dead))]
+        self.c.revive_mon(victim)
+        self.dead_mons.discard(victim)
+        self._log(f"revive mon.{victim}")
+
+    def act_deep_scrub(self) -> None:
+        ps = self.rng.randrange(self.pg_num)
+        try:
+            self.cl.deep_scrub(ps)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            self._parked("deep_scrub", e)
+            return
+        # the report content is run-dependent (timing); the schedule
+        # line must stay replay-identical
+        self._log(f"deep_scrub pg 1.{ps}")
+
+    # -- the schedule --------------------------------------------------------
+
+    def _menu(self):
+        acts = []
+        for name, (weight, _desc) in KNOBS.items():
+            acts.extend([getattr(self, f"act_{name}")] * weight)
+        return acts
+
+    def run(self) -> dict:
+        """Execute rounds of (faults under I/O, heal, invariants).
+        Returns the report dict; raises InvariantViolation (with the
+        seed + reproducer in the message) on any violated invariant."""
+        t0 = time.monotonic()
+        if self.c is None:
+            self.setup()
+        try:
+            menu = self._menu()
+            for round_i in range(self.rounds):
+                self.act_write()     # every round has data on the line
+                for _ in range(self.ops):
+                    menu[self.rng.randrange(len(menu))]()
+                    time.sleep(0.15)
+                self._heal_and_check(round_i)
+            report = self._final_report(time.monotonic() - t0)
+        finally:
+            self.teardown()
+        if self.store == "tin":
+            self._check_fsck(report)
+        self._log(f"OK: {report['objects_verified']} objects verified "
+                  f"across {self.rounds} rounds")
+        return report
+
+    # -- heal + invariants ---------------------------------------------------
+
+    def _heal_and_check(self, round_i: int) -> None:
+        for r in sorted(self.dead_mons):
+            self.c.revive_mon(r)
+        self.dead_mons.clear()
+        for o in sorted(self.dead_osds):
+            self.c.revive_osd(o)
+        self.dead_osds.clear()
+        self._log(f"round {round_i}: healed; checking invariants")
+        # invariant: CONVERGENCE — recovery + activation (up_thru)
+        # must settle with injection still live
+        try:
+            self.c.wait_for_clean(timeout=90)
+        except TimeoutError as e:
+            self._violate(f"round {round_i}: cluster did not "
+                          f"converge after heal ({e})")
+        # invariant: EXACTLY-ONCE BYTES — every acked write reads back
+        # the last acked value, byte-exact, through live injection
+        for name in sorted(set(self.shadow) - self.unknown):
+            try:
+                got = self.cl.read(name)
+            except Exception as e:   # noqa: BLE001 — any read failure
+                self._violate(f"round {round_i}: acked object "
+                              f"{name!r} unreadable ({e})")
+            if got != self.shadow[name]:
+                self._violate(f"round {round_i}: {name!r} bytes "
+                              f"diverged from last acked write")
+        # invariant: NO RESURRECTION — an acked remove stays removed
+        # even after dead shards rejoined with stale copies
+        for name in sorted(self.removed - self.unknown):
+            try:
+                self.cl.read(name)
+            except KeyError:
+                continue             # correctly gone
+            except Exception as e:   # noqa: BLE001 — must be ENOENT,
+                self._violate(       # not a transport wedge
+                    f"round {round_i}: removed {name!r} read "
+                    f"errored oddly ({e})")
+            self._violate(f"round {round_i}: removed object "
+                          f"{name!r} resurrected")
+
+    def _final_report(self, elapsed: float) -> dict:
+        return {
+            "seed": self.seed,
+            "store": self.store,
+            "rounds": self.rounds,
+            "objects_verified": len(set(self.shadow) - self.unknown),
+            "removes_verified": len(self.removed - self.unknown),
+            "unknown_fate": len(self.unknown),
+            "schedule_len": len(self.schedule),
+            "elapsed_s": round(elapsed, 2),
+            "repro": self.repro,
+        }
+
+    def _check_fsck(self, report: dict) -> None:
+        """Invariant: FSCK-CLEAN REMOUNT — after the final shutdown
+        (a crash, not a clean umount) every TinStore directory must
+        audit clean offline. Orphan segments are crash artifacts the
+        next mount reclaims, not corruption."""
+        import os
+
+        from ..osd.tinstore import TinStore
+        checked = 0
+        for osd in range(self.n_osds):
+            path = os.path.join(self.c.store_dir, f"osd.{osd}")
+            if not os.path.isdir(path):
+                continue
+            rep = TinStore.fsck(path)
+            bad = (rep["errors"] or rep["extent_errors"]
+                   or rep["bad_objects"])
+            if bad:
+                self._violate(f"fsck of {path} not clean: {bad}")
+            checked += 1
+        if not checked:
+            self._violate("store=tin but no TinStore directories "
+                          "found to fsck")
+        report["fsck_clean_stores"] = checked

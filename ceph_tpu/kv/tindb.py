@@ -107,11 +107,35 @@ def append_wal_record(f, seq: int, body: bytes, o_dsync: bool) -> None:
         os.fsync(f.fileno())       # survives machine crash
 
 
+def _valid_record_after(raw: bytes, start: int) -> bool:
+    """Is there any crc-valid record at/after `start`? Resyncs on the
+    magic. This is what tells a corrupt TAIL (recoverable — the torn-
+    append class: truncate to the last sealed record) from MID-LOG
+    corruption (fatal — later sealed records would be silently
+    dropped by a truncation)."""
+    magic = struct.pack("<I", _REC_MAGIC)
+    n = len(raw)
+    pos = raw.find(magic, start)
+    while pos != -1:
+        if pos + _REC_HDR.size + 4 <= n:
+            _m, _seq, blen = _REC_HDR.unpack_from(raw, pos)
+            end = pos + _REC_HDR.size + blen + 4
+            if end <= n:
+                (crc,) = struct.unpack_from("<I", raw, end - 4)
+                if host_crc32c(raw[pos:end - 4]) == crc:
+                    return True
+        pos = raw.find(magic, pos + 1)
+    return False
+
+
 def scan_wal(path: str):
     """Yield (seq, body) for every valid record; StopIteration.value
-    is the (good_bytes, torn_tail, error) triple (same contract the
-    r5 TinStore scanner had — a bad crc at the very tail is a torn
-    append, a bad crc followed by more bytes is corruption)."""
+    is the (good_bytes, torn_tail, error) triple. A record that fails
+    its seal (bad magic, bad crc, short) is a TORN TAIL when no valid
+    record follows it — a torn or partially-persisted last append,
+    recovered by truncating to the last sealed record — and mid-log
+    CORRUPTION (error, nothing truncated) when sealed records follow:
+    truncating there would silently drop committed data."""
     try:
         with open(path, "rb") as f:
             raw = f.read()
@@ -124,14 +148,17 @@ def scan_wal(path: str):
             return off, True, None           # torn header
         magic, seq, blen = _REC_HDR.unpack_from(raw, off)
         if magic != _REC_MAGIC:
+            if not _valid_record_after(raw, off + 1):
+                return off, True, None       # corrupt last record
             return off, False, f"bad magic at {off}"
         end = off + _REC_HDR.size + blen + 4
         if end > n:
             return off, True, None           # torn body
         (crc,) = struct.unpack_from("<I", raw, end - 4)
         if host_crc32c(raw[off:end - 4]) != crc:
-            return off, end >= n, (None if end >= n
-                                   else f"crc mismatch at {off}")
+            if end >= n or not _valid_record_after(raw, off + 1):
+                return off, True, None       # corrupt last record
+            return off, False, f"crc mismatch at {off}"
         yield seq, raw[off + _REC_HDR.size:end - 4]
         off = end
     return off, False, None

@@ -144,6 +144,22 @@ class MonitorCluster:
             raise NoQuorum("no majority")
         return src.version
 
+    # -- osd monitor role ----------------------------------------------------
+
+    def record_up_thru(self, osd: int, epoch: int) -> int:
+        """Commit an OSD's up_thru claim (the MOSDAlive handling, ref:
+        OSDMonitor::prepare_alive -> osd_info_t::up_thru): the proof
+        that an interval's primary was up at its start epoch rides the
+        replicated store like any other map mutation — no quorum, no
+        recorded up_thru, no PG activation. Monotone: a stale claim
+        commits a no-op version bump but never regresses the value."""
+        cur = int(self.get(f"osd/{osd}/up_thru", 0) or 0)
+        return self.propose(f"osd/{osd}/up_thru", max(cur, int(epoch)))
+
+    def up_thru(self, osd: int) -> int:
+        """The committed up_thru for `osd` (0 = never recorded)."""
+        return int(self.get(f"osd/{osd}/up_thru", 0) or 0)
+
     # -- config monitor role -------------------------------------------------
 
     def config_set(self, name: str, value) -> int:

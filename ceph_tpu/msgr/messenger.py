@@ -323,6 +323,12 @@ class Messenger:
         self._delay_max_ms = 0.0
         self._delay_count = 0
         self._delay_fired = 0
+        # injection decisions come from a PER-MESSENGER RNG, never the
+        # global `random`: a thrash run that logs its seed must replay
+        # the same delay schedule, and the global stream is perturbed
+        # by every other random consumer in the process
+        import random as _random
+        self._inject_rng = _random.Random()
         self._stopping = False
         self._listener = socket.create_server((host, 0))
         self.addr = self._listener.getsockname()
@@ -659,8 +665,7 @@ class Messenger:
             if self._delay_every:
                 self._delay_count += 1
                 if self._delay_count % self._delay_every == 0:
-                    import random
-                    delay_s = random.uniform(
+                    delay_s = self._inject_rng.uniform(
                         0, self._delay_max_ms) / 1e3
         if delay_s:
             import time as _time
@@ -703,6 +708,17 @@ class Messenger:
         with self._lock:
             self._delay_every = int(every)
             self._delay_max_ms = float(max_ms)
+
+    def seed_injection(self, seed: int) -> None:
+        """Reset the injection RNG and counters to a deterministic
+        state: with the same seed and the same send sequence, the
+        exact same sends get torn down / delayed by the same amounts —
+        what makes a logged thrash seed a real reproducer."""
+        import random as _random
+        with self._lock:
+            self._inject_rng = _random.Random(seed)
+            self._inject_count = 0
+            self._delay_count = 0
 
     def set_inject_socket_failures(self, every: int) -> None:
         """Tear the live connection down on every Nth send (the

@@ -232,6 +232,14 @@ class SimCluster:
         # carrying an older epoch are rejected with the current map
         # (the reference OSD's require_same_or_newer_map behavior)
         self.pg_changed_epoch: dict[int, int] = {}
+        # interval-freshness bookkeeping (the up_thru machinery, ref:
+        # osd_info_t::up_thru + PeeringState WaitUpThru): ps -> epoch
+        # at which its acting primary last changed (the interval's
+        # start). A primary whose map-recorded up_thru lags its
+        # interval start holds the PG in "peering" until the monitors
+        # commit it (_record_up_thrus).
+        self.interval_start: dict[int, int] = {}
+        self._pg_primary: dict[int, int] = {}
         # per-op stage tracking on the client path (ref: OpTracker/
         # TrackedOp, dump_historic_ops on the admin socket)
         from ..utils.op_tracker import OpTracker
@@ -258,6 +266,10 @@ class SimCluster:
                 raise ValueError(f"pg {ps} has unfilled slots at creation; "
                                  f"use more osds/hosts")
             self.pgs[ps] = self._make_backend(f"1.{ps}", acting)
+        # the creation interval: every primary records its up_thru
+        # through the (fully alive) monitor quorum before I/O starts
+        self._refresh_intervals()
+        self._record_up_thrus()
 
     def _make_backend(self, pg: str, acting: list[int]) -> PGBackend:
         if self.is_erasure:
@@ -297,6 +309,56 @@ class SimCluster:
 
     def locate(self, name: str) -> int:
         return self.osdmap.object_to_pg(1, name)[1]
+
+    # -- interval freshness (up_thru) ----------------------------------------
+
+    def _refresh_intervals(self) -> None:
+        """Detect acting-primary changes — each one starts a NEW
+        INTERVAL for that PG — and stamp the start epoch (the
+        PastIntervals bookkeeping, collapsed to the piece up_thru
+        needs: who led, since when)."""
+        for ps in range(self.pg_num):
+            p = self.osdmap.pg_to_up_acting_osds(1, ps)[3]
+            if self._pg_primary.get(ps) != p:
+                self._pg_primary[ps] = p
+                self.interval_start[ps] = self.osdmap.epoch
+
+    def _record_up_thrus(self) -> None:
+        """Primaries of fresh intervals get their up_thru recorded
+        through the monitor quorum (the MOSDAlive flow, ref:
+        OSDMonitor::prepare_alive). No quorum -> nothing is recorded,
+        the PG stays in WaitUpThru (client ops park), and the request
+        retries on the next tick — monitor loss visibly gates
+        activation of new intervals, exactly the reference behavior."""
+        for ps in range(self.pg_num):
+            p = self._pg_primary.get(ps, -1)
+            start = self.interval_start.get(ps, 0)
+            if not (0 <= p < len(self.alive)) or not self.alive[p] \
+                    or not self.osdmap.osd_up[p] \
+                    or self.osdmap.osd_up_thru[p] >= start:
+                continue
+            try:
+                self.mons.record_up_thru(p, start)
+            except self._NoQuorum:
+                g_log.dout("mon", 0, f"no quorum; up_thru for osd.{p} "
+                                     f"(pg 1.{ps}) deferred")
+                continue
+            self.osdmap.record_up_thru(p, start)
+            g_log.dout("mon", 1, f"osd.{p} up_thru {start} recorded "
+                                 f"(epoch {self.osdmap.epoch})")
+
+    def _peer_classify(self, ps: int):
+        """One classify-only peering pass with the up_thru consult
+        (shared by the client-op gate and the health view)."""
+        from .peering import peer
+        p = self._pg_primary.get(ps, -1)
+        up_thru = int(self.osdmap.osd_up_thru[p]) \
+            if 0 <= p < len(self.alive) else None
+        return peer(self.pgs[ps], self.alive,
+                    backfilling=ps in self.backfills,
+                    compute_missing=False,
+                    interval_start=self.interval_start.get(ps, 0),
+                    up_thru=up_thru)
 
     # -- client I/O ---------------------------------------------------------
 
@@ -725,13 +787,13 @@ class SimCluster:
         if not self.alive[target_osd]:
             raise StaleMap(self.osdmap.epoch,
                            f"osd.{target_osd} is not answering")
-        # a PG that peered down/incomplete blocks I/O entirely (the
-        # reference parks ops on a waiting list; our client retries
-        # until a revive makes the PG serviceable again)
-        from .peering import peer
-        res = peer(self.pgs[ps], self.alive,
-                   backfilling=ps in self.backfills,
-                   compute_missing=False)
+        # a PG that peered down/incomplete blocks I/O entirely, and so
+        # does one still in WaitUpThru — serving a write before the
+        # monitors recorded this interval's up_thru would create a
+        # write nobody can later prove happened (the reference parks
+        # ops on a waiting list; our client retries until the PG is
+        # serviceable again)
+        res = self._peer_classify(ps)
         if not res.serviceable:
             raise StaleMap(self.osdmap.epoch,
                            f"pg 1.{ps} is {res.state}; op parked")
@@ -897,6 +959,11 @@ class SimCluster:
             self._progress_backfills()
             self._schedule_scrubs()
             self._pump()
+            # close any WaitUpThru window this step opened (mark_down
+            # primary changes, backfill cutovers) or a previous quorum
+            # loss left behind — the MOSDAlive retry
+            self._refresh_intervals()
+            self._record_up_thrus()
 
     # -- monitor plumbing ---------------------------------------------------
 
@@ -1028,6 +1095,12 @@ class SimCluster:
                 # completes (ref: pg_temp during backfill)
                 self._start_backfill(ps, moved)
         self._update_degraded()
+        # map change may have started new intervals: their primaries
+        # record up_thru NOW (quorum permitting) so a healthy cluster
+        # activates synchronously; under quorum loss the PGs stay in
+        # WaitUpThru and the tick loop retries
+        self._refresh_intervals()
+        self._record_up_thrus()
 
     # -- backfill (async, pg_temp-protected) --------------------------------
 
@@ -1231,11 +1304,8 @@ class SimCluster:
 
     def pg_state(self, ps: int) -> str:
         """Current pg_state string from a fresh peering pass (the
-        `ceph pg stat` view)."""
-        from .peering import peer
-        return peer(self.pgs[ps], self.alive,
-                    backfilling=ps in self.backfills,
-                    compute_missing=False).state
+        `ceph pg stat` view), up_thru consult included."""
+        return self._peer_classify(ps).state
 
     def health(self) -> dict:
         states = {ps: self.pg_state(ps) for ps in range(self.pg_num)}
@@ -1252,6 +1322,8 @@ class SimCluster:
             "pgs_undersized": sum(
                 1 for s in states.values() if "undersized" in s),
             "pgs_backfilling": len(self.backfills),
+            "pgs_peering": sum(
+                1 for s in states.values() if s.startswith("peering")),
             "pgs_down": sum(
                 1 for s in states.values()
                 if s in ("down", "incomplete")),

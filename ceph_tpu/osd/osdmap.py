@@ -121,6 +121,15 @@ class OSDMap:
         n = crush.n_devices
         self.osd_weight = np.full(n, 0x10000, dtype=np.int32)  # in/out 16.16
         self.osd_up = np.ones(n, dtype=bool)
+        # per-OSD up_thru (ref: osd_info_t::up_thru, recorded by
+        # OSDMonitor on MOSDAlive): the newest epoch through which the
+        # monitors have PROOF the OSD was up and serving. A primary
+        # must get its up_thru recorded at (or past) its interval's
+        # start epoch before the PG may go active — so peering can
+        # later decide whether a past interval could possibly have
+        # served writes (maybe_went_rw) without asking its dead
+        # members (ref: PastIntervals::check_new_interval).
+        self.osd_up_thru = np.zeros(n, dtype=np.int64)
         self.pg_temp: dict[tuple[int, int], list[int]] = {}
         self.primary_temp: dict[tuple[int, int], int] = {}
         # balancer overrides (ref: OSDMap pg_upmap_items + _apply_upmap)
@@ -156,9 +165,10 @@ class OSDMap:
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
         # v2 appends pg_upmap_items, v3 config_kv, v4 mon_members,
-        # v5 osd_admin_out; compat stays 1 (an old reader skips the
-        # tail via the section length — the ENCODE_START contract)
-        e = Encoder().start(5, 1)
+        # v5 osd_admin_out, v6 osd_up_thru; compat stays 1 (an old
+        # reader skips the tail via the section length — the
+        # ENCODE_START contract)
+        e = Encoder().start(6, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -192,13 +202,15 @@ class OSDMap:
                   lambda en, v: en.string(v))
         e.list(self.mon_members, lambda e2, r: e2.i32(r))
         e.list(sorted(self.osd_admin_out), lambda e2, o: e2.i32(o))
+        e.list([int(t) for t in self.osd_up_thru],
+               lambda e2, t: e2.u64(t))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        v = d.start(5)
+        v = d.start(6)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -235,6 +247,9 @@ class OSDMap:
             m.mon_members = d.list(lambda dd: dd.i32())
         if v >= 5:
             m.osd_admin_out = set(d.list(lambda dd: dd.i32()))
+        if v >= 6:
+            m.osd_up_thru = np.asarray(d.list(lambda dd: dd.u64()),
+                                       dtype=np.int64)
         d.finish()
         return m
 
@@ -256,6 +271,18 @@ class OSDMap:
 
     def mark_up(self, osd: int) -> None:
         self.osd_up[osd] = True
+        self._bump()
+
+    def record_up_thru(self, osd: int, epoch: int | None = None) -> None:
+        """Record that `osd` was up through `epoch` (default: the
+        current epoch) — the OSDMonitor's MOSDAlive handling (ref:
+        OSDMonitor::prepare_alive -> osd_info_t::up_thru). Monotone
+        and idempotent: a stale or duplicate request rebases to a
+        no-op on the proposal pipe."""
+        epoch = self.epoch if epoch is None else int(epoch)
+        if not self.osd_up[osd] or self.osd_up_thru[osd] >= epoch:
+            return
+        self.osd_up_thru[osd] = epoch
         self._bump()
 
     def mark_out(self, osd: int) -> None:

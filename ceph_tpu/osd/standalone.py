@@ -175,6 +175,29 @@ class MOSDBoot(MOSDFailure):
 
 
 @register_message
+class MOSDAlive(Message):
+    """up_thru request (ref: MOSDAlive -> OSDMonitor::prepare_alive):
+    `osd` asks the monitors to record that it is up through map epoch
+    `want` — the activation proof its fresh primary intervals need
+    before they may serve I/O (PeeringState WaitUpThru)."""
+
+    type_id = 0x48
+
+    def __init__(self, osd: int, want: int):
+        self.osd, self.want = osd, want
+
+    def encode_payload(self, e: Encoder) -> None:
+        e.start(1, 1).i32(self.osd).u64(self.want).finish()
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MOSDAlive":
+        d.start(1)
+        m = cls(d.i32(), d.u64())
+        d.finish()
+        return m
+
+
+@register_message
 class MMonPropose(Message):
     type_id = 0x38
 
@@ -675,6 +698,20 @@ class OSDDaemon:
         # later reconcile until clean
         self._rewind_pending: dict[int, set[str]] = {}
         self._restore_backoff: dict[int, float] = {}
+        # interval-freshness bookkeeping (the up_thru machinery, ref:
+        # PeeringState WaitUpThru): per primaried pg, the map acting
+        # we last processed and the epoch its interval began. While
+        # osd_up_thru[self] lags an interval's start, that PG is
+        # PRE-ACTIVE: no restore, no recovery, no client I/O — only a
+        # MOSDAlive request to the monitors. The activation persist
+        # (_persist_meta's epoch stamp) therefore happens strictly
+        # AFTER the up_thru commit, which grounds the (epoch, head)
+        # meta ranking in map-provable interval freshness: an interval
+        # whose primary died pre-activation left neither an up_thru
+        # claim nor an epoch-stamped blob, so later peering neither
+        # waits on it nor trusts it.
+        self._interval_start: dict[int, int] = {}
+        self._last_acting: dict[int, list[int]] = {}
         # scheduled scrub bookkeeping (per primaried pg; ref: the
         # scrubber's per-PG schedule, osd_scrub_min_interval /
         # osd_deep_scrub_interval)
@@ -735,6 +772,10 @@ class OSDDaemon:
             self._cauth = ClientAuth(
                 _WireAuth(self.c, self.auth_rpc), self.name,
                 self.c.osd_secrets[self.osd_id])
+            # single-flight background ticket refresher: dispatch-path
+            # authorize (the meta gather, the shard fan-out) must
+            # NEVER hunt monitors itself — see _authorize_peer
+            self._ticket_gate = threading.Lock()
             # pre-warm tickets OFF the dispatch path: peer store reads
             # happen inside map/op dispatch, and a monitor hunt there
             # (seconds, worse across a partition) stalls the dispatch
@@ -756,10 +797,43 @@ class OSDDaemon:
                                     daemon=True)
         self._hb.start()
 
+    def _spawn_ticket_refresh(self) -> None:
+        """Kick ONE background fetch_tickets (no-op when one is
+        already running). Dispatch threads call this instead of
+        fetching inline — the deferral costs one reconcile retry,
+        the inline hunt can cost the whole daemon (see
+        _authorize_peer)."""
+        if not self._ticket_gate.acquire(blocking=False):
+            return
+
+        def _go():
+            try:
+                self._cauth.fetch_tickets(["osd"])
+            except Exception:    # noqa: BLE001 — mons down/partition:
+                pass             # the next deferral re-kicks us
+            finally:
+                self._ticket_gate.release()
+        threading.Thread(target=_go, daemon=True).start()
+
     def _authorize_peer(self, peer: str) -> None:
         """osd->osd cephx (ref: OSD heartbeat/cluster messengers carry
-        cephx authorizers too): used by RemoteStore on first contact."""
-        _wire_authorize(self._cauth, self.auth_rpc, peer, "osd")
+        cephx authorizers too): used by RemoteStore on first contact.
+
+        Runs on DISPATCH threads (the meta gather inside _on_map, the
+        write fan-out inside _on_client_op) while self._lock is held —
+        so it must never hunt monitors: the monitor's auth reply can
+        be head-of-line-blocked behind an undelivered map frame on
+        the same connection, whose reader is waiting for self._lock.
+        Under a map-commit storm (boot, up_thru activation rounds)
+        that livelocks the whole daemon. Cold cache -> fail fast,
+        refresh in the background, let the reconcile retry."""
+        if not self._cauth.has_ticket("osd"):
+            self._spawn_ticket_refresh()
+            raise ConnectionError(
+                f"{self.name}: osd service ticket not warm; authorize "
+                f"to {peer} deferred (background refresh kicked)")
+        _wire_authorize(self._cauth, self.auth_rpc, peer, "osd",
+                        async_refresh=self._spawn_ticket_refresh)
 
     # -- store service (the SubOp executor) ---------------------------------
 
@@ -984,6 +1058,16 @@ class OSDDaemon:
                       and (o == self.osd_id or self.osdmap.osd_up[o])}
         need = len(up_members) // 2 + 1
         quorum_ok = len(heard & up_members) >= need
+        if not quorum_ok:
+            # the gather starved below quorum: clear the suspicion on
+            # map-up members so the backoff retry RE-PROBES them
+            # instead of skipping them forever. A suspicion set during
+            # the boot thundering-herd (every daemon gathering from
+            # every other at once, cold secure sessions) would
+            # otherwise wedge this restore permanently once map
+            # traffic goes quiet — we are not serving anyway, so
+            # re-paying the probe timeout is the right price.
+            self.suspect -= {o for o in up_members if o != self.osd_id}
         best_local = pick(local_blobs)
         # remotes first: on an (epoch, head) TIE the majority side
         # must win, never this daemon's own (possibly divergent) copy
@@ -1216,6 +1300,20 @@ class OSDDaemon:
                     self.scrub_reports.pop(ps, None)
                     self._last_scrub.pop(ps, None)
                     self._last_deep.pop(ps, None)
+                self._interval_start.pop(ps, None)
+                self._last_acting.pop(ps, None)
+                continue
+            # interval detection: any acting change starts a NEW
+            # INTERVAL whose primary must re-prove freshness — its
+            # up_thru must reach the interval's start epoch before the
+            # PG restores/recovers/serves (WaitUpThru; ref:
+            # PeeringState::adjust_need_up_thru)
+            if self._last_acting.get(ps) != acting:
+                self._last_acting[ps] = list(acting)
+                self._interval_start[ps] = self.osdmap.epoch
+            need_ut = self._interval_start.get(ps, 0)
+            if int(self.osdmap.osd_up_thru[self.osd_id]) < need_ut:
+                self._request_up_thru(need_ut)
                 continue
             be = self.backends.get(ps)
             if be is None:
@@ -1224,7 +1322,16 @@ class OSDDaemon:
                     continue        # recent below-quorum gather:
                 #                     don't re-pay its RPC timeouts
                 #                     on every map/heartbeat tick
-                be = self._restore_backend(ps, acting)
+                try:
+                    be = self._restore_backend(ps, acting)
+                except (ConnectionError, OSError, KeyError) as e:
+                    # transient transport/auth trouble mid-restore
+                    # (cold tickets fail fast, a helper died): defer
+                    # with the same backoff as a below-quorum gather
+                    self.c.log(f"{self.name}: pg 1.{ps} restore "
+                               f"deferred ({e})")
+                    self._restore_backoff[ps] = now_m + 2.0
+                    continue
                 if be is None:      # info gather below quorum:
                     self._restore_backoff[ps] = now_m + 2.0
                     continue        # retried by the heartbeat tick
@@ -1296,6 +1403,19 @@ class OSDDaemon:
                 except (ValueError, ConnectionError, KeyError) as e:
                     self.c.log(f"{self.name}: pg 1.{ps} recovery "
                                f"deferred: {e}")
+
+    def _request_up_thru(self, want: int) -> None:
+        """Ask every monitor to record our up_thru through `want` (the
+        MOSDAlive flow): broadcast so whoever leads proposes; the
+        committed map comes back via the normal subscription and the
+        next reconcile finds the interval activatable. Re-sent on
+        every reconcile while the window is open — a request consumed
+        by a monitor that lost leadership must not strand the PG."""
+        for mon_name in self.c.mon_names():
+            try:
+                self.msgr.send(mon_name, MOSDAlive(self.osd_id, want))
+            except (KeyError, OSError, ConnectionError):
+                pass
 
     def _move_shard(self, be, slot: int, old_osd: int,
                     new_osd: int) -> None:
@@ -1376,9 +1496,13 @@ class OSDDaemon:
                 else:
                     alive = [bool(u) and o not in self.suspect
                              for o, u in enumerate(self.osdmap.osd_up)]
+                    my_ut = int(self.osdmap.osd_up_thru[self.osd_id])
                     out = {"pgs": {
-                        f"1.{ps}": _peer(be, alive,
-                                         compute_missing=False).state
+                        f"1.{ps}": _peer(
+                            be, alive, compute_missing=False,
+                            interval_start=self._interval_start.get(
+                                ps, 0),
+                            up_thru=my_ut).state
                         for ps, be in sorted(self.backends.items())}}
         else:
             raise ValueError(f"unknown admin command {cmd!r}; "
@@ -1581,6 +1705,15 @@ class OSDDaemon:
         if be is None:
             raise RuntimeError(f"not primary for pg 1.{ps} "
                                f"(epoch {self.osdmap.epoch})")
+        need_ut = self._interval_start.get(ps, 0)
+        if int(self.osdmap.osd_up_thru[self.osd_id]) < need_ut:
+            # WaitUpThru: serving a write before the monitors recorded
+            # this interval's up_thru would create an interval nobody
+            # can later prove went rw — park the op (client retries
+            # until the committed map unblocks us)
+            raise RuntimeError(
+                f"pg 1.{ps} peering (wait_up_thru {need_ut}, "
+                f"epoch {self.osdmap.epoch})")
         if kind == "write":
             self._check_snapc(d.u64())
             objs = d.mapping(Decoder.string, Decoder.blob)
@@ -1841,6 +1974,8 @@ class OSDDaemon:
         fresh.snapsets = {}
         fresh.births = {}
         fresh.obj_kv = {}
+        fresh._interval_start = {}
+        fresh._last_acting = {}
         fresh.suspect = set()
         fresh._last_pong = {}
         fresh._reported = set()
@@ -1916,6 +2051,7 @@ class MonDaemon:
         m = self.msgr
         m.register_handler(MOSDFailure.type_id, self._on_failure)
         m.register_handler(MOSDBoot.type_id, self._on_boot)
+        m.register_handler(MOSDAlive.type_id, self._on_alive)
         m.register_handler(MMonCollect.type_id, self._on_collect)
         m.register_handler(MMonLast.type_id, self._on_last)
         m.register_handler(MMonBegin.type_id, self._on_begin)
@@ -2534,6 +2670,22 @@ class MonDaemon:
                 m.mark_in(osd)
         self._commit(mutate)
 
+    def _on_alive(self, peer: str, msg: MOSDAlive) -> None:
+        """up_thru request (ref: OSDMonitor::prepare_alive): record
+        the claimed epoch through the same Paxos pipe as every other
+        map mutation — the commit IS the activation permission the
+        requesting primary is waiting on. Monotone/idempotent, so a
+        duplicate or stale request rebases to a no-op."""
+        if self.osdmap is None:
+            return
+        osd, want = msg.osd, msg.want
+        if not _valid_osd(osd, len(self.osdmap.osd_up)):
+            return
+
+        def mutate(m: OSDMap) -> None:
+            m.record_up_thru(osd, want)
+        self._commit(mutate)
+
     def _mon_admin_denied(self, peer: str, what: str) -> bool:
         """Admin-plane gate (ref: MonCap check in
         Monitor::_allowed_command): with cephx on, pool/config
@@ -2663,12 +2815,16 @@ def _valid_osd(osd: int, n_osds: int) -> bool:
     return 0 <= osd < n_osds
 
 
-def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
+def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str,
+                    async_refresh=None) -> None:
     """Present a `service` ticket to `peer` over MAuthOp("authorize"),
     running the daemon's anti-replay challenge round, then verify its
     mutual-auth proof; refresh the ticket once if its sealing secret
     rotated out. Shared by clients (osd + mon sessions) and by OSDs
-    authorizing to peer OSDs."""
+    authorizing to peer OSDs. `async_refresh` marks a DISPATCH-PATH
+    caller: a needed ticket refresh is delegated to it (background)
+    and this attempt fails fast with ConnectionError instead of
+    hunting monitors inline (see OSDDaemon._authorize_peer)."""
     import json as _json
     from ..auth import AuthError
     server_challenge = None
@@ -2702,6 +2858,11 @@ def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str) -> None:
             server_challenge = rep.err.rsplit(":", 1)[1]
             continue
         if "rotated out" in rep.err and not refreshed:
+            if async_refresh is not None:
+                async_refresh()
+                raise ConnectionError(
+                    f"{service} ticket rotated out; refresh kicked, "
+                    f"authorize to {peer} deferred")
             cauth.fetch_tickets([service])
             refreshed, server_challenge = True, None
             continue
@@ -3179,27 +3340,35 @@ class StandaloneCluster:
         eps += [(c.msgr.name, c.msgr) for c in self.clients]
         return eps
 
-    def inject_socket_failures(self, every: int,
-                               osds=None) -> None:
+    def inject_socket_failures(self, every: int, osds=None,
+                               seed: int | None = None) -> None:
         """Enable ms_inject_socket_failures on the given OSD daemons
         (default: all alive): every Nth send tears the live socket
         down first, so the whole data+control plane runs through
-        reconnect+replay continuously. 0 disables."""
+        reconnect+replay continuously. 0 disables. `seed` resets each
+        daemon's injection RNG/counters deterministically (per-daemon
+        derived seeds) so a logged thrash seed replays the same
+        teardown schedule."""
         targets = osds if osds is not None else list(self.osds)
         for o in targets:
             d = self.osds[o]
             if not d._stop.is_set():
+                if seed is not None:
+                    d.msgr.seed_injection(seed * 131 + o)
                 d.msgr.set_inject_socket_failures(every)
 
-    def inject_delays(self, every: int, max_ms: float,
-                      osds=None) -> None:
+    def inject_delays(self, every: int, max_ms: float, osds=None,
+                      seed: int | None = None) -> None:
         """Enable ms_inject_delay on the given OSD daemons (default:
         all alive): uniform [0, max_ms] sleep before every Nth
-        transmit."""
+        transmit. `seed` makes the per-daemon delay draws
+        deterministic (see inject_socket_failures)."""
         targets = osds if osds is not None else list(self.osds)
         for o in targets:
             d = self.osds[o]
             if not d._stop.is_set():
+                if seed is not None:
+                    d.msgr.seed_injection(seed * 131 + o)
                 d.msgr.set_inject_delay(every, max_ms)
 
     def partition(self, *groups) -> None:

@@ -135,6 +135,28 @@ def test_pg_stats_balance():
     assert counts.max() <= 4 * counts.mean()  # no pathological skew
 
 
+def test_up_thru_records_and_roundtrips():
+    """up_thru (ref: osd_info_t::up_thru): monotone, idempotent,
+    refused for down OSDs, and carried through the v6 wire form."""
+    om = make_osdmap()
+    e0 = om.epoch
+    om.record_up_thru(3)                  # defaults to current epoch
+    assert int(om.osd_up_thru[3]) == e0
+    assert om.epoch == e0 + 1
+    om.record_up_thru(3, e0 - 1)          # stale claim: no-op
+    assert int(om.osd_up_thru[3]) == e0 and om.epoch == e0 + 1
+    om.record_up_thru(7, e0 + 1)
+    om.mark_down(5)
+    e1 = om.epoch
+    om.record_up_thru(5)                  # down OSD: refused
+    assert int(om.osd_up_thru[5]) == 0 and om.epoch == e1
+    # wire round-trip preserves the whole array
+    om2 = OSDMap.decode(om.encode())
+    assert om2.osd_up_thru.tolist() == om.osd_up_thru.tolist()
+    assert int(om2.osd_up_thru[3]) == e0
+    assert int(om2.osd_up_thru[7]) == e0 + 1
+
+
 def test_pool_validation():
     om = make_osdmap()
     with pytest.raises(ValueError):

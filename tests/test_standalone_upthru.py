@@ -1,0 +1,106 @@
+"""Wire-tier up_thru (interval-freshness) tests — MOSDAlive through
+real Paxos, activation gated on the committed up_thru, and the
+kill-primary-before-active case (ref: osd_info_t::up_thru,
+OSDMonitor::prepare_alive, PeeringState WaitUpThru /
+PastIntervals::check_new_interval maybe_went_rw)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.osd.peering import interval_maybe_went_rw
+from ceph_tpu.osd.standalone import StandaloneCluster
+
+
+@pytest.fixture
+def cluster():
+    c = StandaloneCluster(n_osds=4, pg_num=2, op_timeout=6.0)
+    try:
+        c.wait_for_clean(timeout=30)
+        yield c
+    finally:
+        c.shutdown()
+
+
+def _live_mon(c):
+    return next(m for m in c.mons
+                if not m._stop.is_set() and m.osdmap is not None)
+
+
+def test_boot_records_up_thru_before_serving(cluster):
+    """Every primary's up_thru reaches its creation interval before
+    wait_for_clean passes — activation rode a real MOSDAlive commit,
+    not a local assumption."""
+    mon = _live_mon(cluster)
+    for ps in range(cluster.pg_num):
+        acting = mon.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        prim = cluster.osds[acting[0]]
+        start = prim._interval_start[ps]
+        assert int(mon.osdmap.osd_up_thru[acting[0]]) >= start
+        # and the daemon's own activation gate agrees
+        assert ps in prim.backends
+
+
+def test_kill_primary_before_active_wire(cluster):
+    """VERDICT demand 4, on real sockets: a takeover primary that can
+    never record up_thru (partitioned from the monitors) dies before
+    anyone saw it active. The map must prove its interval never went
+    rw, and the cluster must converge WITHOUT waiting on it or
+    trusting it — every byte serves afterward."""
+    c = cluster
+    cl = c.client()
+    objs = {f"ut-{i}": bytes([i]) * 200 for i in range(8)}
+    cl.write(objs)
+    mon = _live_mon(c)
+    ps = 0
+    acting = mon.osdmap.pg_to_up_acting_osds(1, ps)[2]
+    prim = acting[0]
+    # predict the takeover primary: the failure path commits down+out,
+    # so CRUSH remaps — simulate the mutation on a map copy (placement
+    # is a pure function of the map)
+    from ceph_tpu.osd.osdmap import OSDMap
+    sim = OSDMap.decode(mon.osdmap.encode())
+    sim.mark_down(prim)
+    sim.mark_out(prim)
+    nxt = sim.pg_to_up_acting_osds(1, ps)[2][0]
+    assert nxt != prim
+    # cut the would-be takeover primary off from every monitor: its
+    # MOSDAlive (and any map subscription) can never commit
+    c.partition({f"osd.{nxt}"}, set(c.mon_names()))
+    c.kill_osd(prim)
+    # the surviving, un-partitioned daemons report the death; the
+    # monitors commit down+out and the takeover interval begins
+    c._wait(lambda: any(
+        not m._stop.is_set() and m.osdmap is not None
+        and not m.osdmap.osd_up[prim] for m in c.mons),
+        30, f"osd.{prim} marked down at the monitors")
+    c._wait(lambda: _live_mon(c).osdmap.pg_to_up_acting_osds(
+        1, ps)[2][0] == nxt, 30, f"osd.{nxt} is the new map primary")
+    mon = _live_mon(c)
+    interval_epoch = mon.osdmap.epoch
+    # the doomed primary cannot activate: its up_thru never reaches
+    # the takeover interval (the WaitUpThru wedge, held open by the
+    # partition), so the map can PROVE the interval never served I/O
+    time.sleep(2.0)
+    assert int(mon.osdmap.osd_up_thru[nxt]) < interval_epoch
+    assert not interval_maybe_went_rw(
+        interval_epoch, int(mon.osdmap.osd_up_thru[nxt]))
+    # ...and it dies before anyone saw it active
+    c.kill_osd(nxt)
+    c.heal_partition()
+    c.revive_osd(prim)       # disk intact; boot reverses auto-out
+    c._wait(lambda: any(
+        not m._stop.is_set() and m.osdmap is not None
+        and not m.osdmap.osd_up[nxt] for m in c.mons),
+        30, f"osd.{nxt} marked down at the monitors")
+    c.wait_for_clean(timeout=60)
+    # the dead pre-active interval still has no up_thru claim — later
+    # peering neither waited on it nor trusted it
+    mon = _live_mon(c)
+    assert not interval_maybe_went_rw(
+        interval_epoch, int(mon.osdmap.osd_up_thru[nxt]))
+    for name, want in sorted(objs.items()):
+        assert cl.read(name) == want, name
+    # and the healed PG is writable again end-to-end
+    cl.write({"post-heal": b"alive"})
+    assert cl.read("post-heal") == b"alive"

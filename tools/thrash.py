@@ -1,0 +1,92 @@
+"""thrash — run one seeded Thrasher cell from the command line.
+
+The failure reproducer for the chaos matrix (tests/test_thrash.py):
+a failing cell prints `python tools/thrash.py --seed N --store S ...`
+and THIS command replays the exact fault schedule (same RNG draws,
+same injection periods, same victims, same data) with the invariant
+checkers live — CI failure to local reproduction in one command (the
+teuthology `--seed` rerun role, ref: qa/tasks/ceph_manager.py).
+
+  python tools/thrash.py --seed 7 --store tin
+  python tools/thrash.py --seed 7 --store tin --repro   # verbose replay
+  python tools/thrash.py --list-knobs
+  python tools/thrash.py --matrix 10                    # seed sweep
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_cell(seed: int, store: str, rounds: int, ops: int,
+             verbose: bool) -> dict:
+    from ceph_tpu.chaos import InvariantViolation, Thrasher
+    tmp = tempfile.mkdtemp(prefix=f"thrash-{seed}-") \
+        if store == "tin" else None
+    th = Thrasher(seed, store=store, rounds=rounds, ops=ops,
+                  store_dir=tmp, verbose=verbose)
+    try:
+        report = th.run()
+        report["ok"] = True
+        return report
+    except InvariantViolation as e:
+        return {"ok": False, "seed": seed, "store": store,
+                "violation": str(e), "repro": th.repro,
+                "schedule": th.schedule}
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded wire-tier fault thrasher (teuthology "
+                    "Thrasher role); exit 0 iff every invariant held")
+    ap.add_argument("--seed", type=int, default=1,
+                    help="fault-schedule seed (logged by failing "
+                         "tests; same seed = same schedule)")
+    ap.add_argument("--store", choices=("mem", "tin"), default="mem")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--ops", type=int, default=6,
+                    help="fault/IO actions per round")
+    ap.add_argument("--matrix", type=int, metavar="N",
+                    help="run seeds 1..N instead of one --seed")
+    ap.add_argument("--repro", action="store_true",
+                    help="replay mode: verbose schedule log on (use "
+                         "with the --seed a failing test printed)")
+    ap.add_argument("--list-knobs", action="store_true",
+                    help="print the fault menu and exit")
+    args = ap.parse_args()
+
+    if args.list_knobs:
+        from ceph_tpu.chaos import KNOBS
+        print("fault menu (name  weight  description):")
+        for name, (weight, desc) in KNOBS.items():
+            print(f"  {name:<16} {weight:>2}  {desc}")
+        print("\ninvariants checked after every round's heal:\n"
+              "  convergence, exactly-once bytes, no resurrection;\n"
+              "  plus fsck-clean stores at teardown (--store tin)")
+        return 0
+
+    seeds = list(range(1, args.matrix + 1)) if args.matrix \
+        else [args.seed]
+    failed = 0
+    for seed in seeds:
+        rep = run_cell(seed, args.store, args.rounds, args.ops,
+                       verbose=args.repro)
+        print(json.dumps(rep, sort_keys=True))
+        if not rep["ok"]:
+            failed += 1
+            print(f"REPRODUCE: {rep['repro']}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
