@@ -234,49 +234,102 @@ print("PLATFORM=" + ds[0].platform, flush=True)
 """
 
 
-def _probe(timeout: float) -> str | None:
+def _probe(timeout: float) -> tuple[str | None, str | None]:
     """Probe backend setup AND a tiny jit compile in a subprocess (the
-    known failure mode is a hang no in-process guard survives). Returns
-    the platform string or None."""
+    known failure mode is a hang no in-process guard survives). The
+    child runs in its OWN PROCESS GROUP and a hang kills the whole
+    group — plain subprocess timeout kills only the direct child, and
+    a wedged tunnel grandchild kept the fd open so communicate() still
+    blocked (the r05 capture lost 600 s to four silent 150 s stalls).
+    Returns (platform, cause) — exactly one is None."""
+    import signal
     try:
-        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        fail("probe", f"hung > {timeout:.0f}s (killed)")
-        return None
+        p = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             start_new_session=True)
     except Exception as e:        # noqa: BLE001 — diagnostics, not control
         fail("probe", e)
-        return None
-    for line in r.stdout.splitlines():
+        return None, f"spawn failed: {e!r}"[:160]
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            p.kill()
+        try:
+            p.communicate(timeout=10)
+        except Exception:         # noqa: BLE001 — already killed
+            pass
+        cause = f"hung > {timeout:.0f}s (process group killed)"
+        fail("probe", cause)
+        return None, cause
+    for line in out.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
-    tail = (r.stderr or "").strip().splitlines()[-3:]
-    fail("probe", f"rc={r.returncode} stderr={' | '.join(tail)[:200]}")
-    return None
+            return line.split("=", 1)[1], None
+    tail = " | ".join((err or "").strip().splitlines()[-3:])[:200]
+    cause = f"rc={p.returncode} stderr={tail}"
+    fail("probe", cause)
+    return None, cause
 
 
 def acquire_backend() -> str:
     """Patiently wait for the TPU tunnel; fall back to CPU. Returns the
     platform this process should use ('axon'/'tpu'/'cpu'/...). No jax
-    import happens in this process until the decision is made."""
+    import happens in this process until the decision is made. Probe
+    outcomes land in extra.tpu_probe (attempts, per-attempt causes,
+    wall spent) so a dead tunnel reads as one JSON line instead of a
+    silent stall; after two consecutive HANGS the wait is cut short —
+    a wedged tunnel does not un-wedge within one bench window (r04/r05
+    evidence), and every further 150 s probe starves the real
+    sections."""
+    t_probe0 = time.monotonic()
+    diag = STATE["extra"].setdefault(
+        "tpu_probe", {"attempts": 0, "causes": []})
+
+    def _record(plat: str | None, cause: str | None) -> None:
+        diag["attempts"] += 1
+        if cause:
+            diag["causes"].append(cause[:160])
+        diag["outcome"] = plat or "cpu-fallback"
+        diag["wall_s"] = round(time.monotonic() - t_probe0, 1)
+
     want_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) and \
         os.environ.get("JAX_PLATFORMS", "") != "cpu"
     if not want_tpu:
-        plat = _probe(timeout=180) or "cpu"
+        plat, cause = _probe(timeout=180)
+        _record(plat, cause)
+        plat = plat or "cpu"
         log(f"no TPU tunnel configured; backend={plat}")
         return plat
     probe_deadline = time.monotonic() + min(TPU_WAIT, DEADLINE * 0.45)
-    delay, attempt = 5.0, 0
+    delay, attempt, hangs = 5.0, 0, 0
     while time.monotonic() < probe_deadline:
         attempt += 1
         left = probe_deadline - time.monotonic()
-        per_probe = max(60.0, min(150.0, left))
+        # hard per-probe deadline: full patience for the first try,
+        # but once a probe has HUNG (vs failed fast) shrink the
+        # follow-ups — they are confirming a wedge, not waiting out
+        # a boot
+        per_probe = max(60.0, min(150.0, left)) if hangs == 0 \
+            else max(45.0, min(60.0, left))
         log(f"TPU probe #{attempt} (timeout {per_probe:.0f}s, "
             f"{left:.0f}s of patience left)")
-        plat = _probe(timeout=per_probe)
+        plat, cause = _probe(timeout=per_probe)
+        _record(plat, cause)
         if plat:
             log(f"TPU probe #{attempt} OK: platform={plat}")
             return plat
+        if cause and cause.startswith("hung"):
+            hangs += 1
+            if hangs >= 2:
+                diag["outcome"] = "cpu-fallback (tunnel wedged)"
+                log("two consecutive probe hangs: tunnel presumed "
+                    "wedged; falling back to CPU early")
+                return "cpu"
+        else:
+            hangs = 0
         if time.monotonic() + delay >= probe_deadline:
             break
         time.sleep(delay)
@@ -848,7 +901,7 @@ def bench_wire(seconds=None):
             [sys.executable, tool, "--transport", "standalone",
              "--seconds", str(seconds), "--object-size", "65536",
              "--num-osds", "6", "--pg-num", "4", "--batch", "8",
-             "--json", workload],
+             "--window", "8", "--json", workload],
             capture_output=True, text=True, timeout=240, env=env)
         if r.returncode != 0:
             tail = " | ".join((r.stderr or "").strip()
@@ -868,6 +921,7 @@ def bench_wire(seconds=None):
             f"{d.get('objects_per_s')} obj/s p50={d.get('p50_ms')}ms")
     out["config"] = {"transport": "standalone", "cephx": True,
                      "secure": True, "object_size": 65536, "batch": 8,
+                     "window": 8, "pg_num": 4,
                      "n_osds": 6, "backend": "cpu (messenger bench)"}
     STATE["extra"]["wire_rados_bench"] = out
     return out

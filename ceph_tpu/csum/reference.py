@@ -98,6 +98,18 @@ def ceph_crc32c(seed: int, data: bytes | np.ndarray) -> int:
     return _crc32c_update(seed & _M32, data)
 
 
+def ceph_crc32c_iov(seed: int, parts, update=ceph_crc32c) -> int:
+    """Running ceph_crc32c over an iovec (list of buffers): the
+    seeded-continuation form the scatter-gather framing path uses —
+    bit-identical to ceph_crc32c(seed, join(parts)) without ever
+    joining. `update` may be any chainable ceph_crc32c implementation
+    (e.g. the native codec's)."""
+    reg = seed & _M32
+    for p in parts:
+        reg = update(reg, p)
+    return reg & _M32
+
+
 # ------------------------------------------------- GF(2) combine matrices
 
 def _zero_byte_matrix() -> np.ndarray:

@@ -59,11 +59,20 @@ import struct
 import threading
 from collections import deque
 
-from ..csum.reference import ceph_crc32c
+from ..csum.reference import ceph_crc32c, ceph_crc32c_iov
 from ..utils.encoding import Decoder, Encoder
 
 BANNER = b"ceph_tpu msgr v2\n"
 ACK_TYPE = 0
+#: cumulative-ACK coalescing: ack every Nth delivered frame inline,
+#: and let the ack flusher cover the tail within ~20 ms. ACK frames
+#: are bit-identical to the per-frame era (same [seq 0][type 0][u64]
+#: format — the u64 is cumulative, which the sender's `<=` retire loop
+#: always honored), so mixed old/new peers interoperate. Acks only
+#: retire the sender's replay queue — replies never wait on them — so
+#: the delay costs nothing while cutting the rpc pattern's frame count
+#: by a third.
+ACK_BATCH = 8
 MODE_CRC = 0
 MODE_SECURE = 1
 _GCM_TAG = 16
@@ -185,7 +194,7 @@ class Message:
 _crc32c_impl = None
 
 
-def _crc(data: bytes) -> int:
+def _crc_impl():
     # frame CRCs run per message on the hot wire path: use the native
     # C codec's crc32c (bit-identical to ceph_crc32c — pinned by
     # tests/test_native.py) instead of the per-byte python reference.
@@ -203,7 +212,53 @@ def _crc(data: bytes) -> int:
         except Exception:          # noqa: BLE001 — optional native lib
             pass
         _crc32c_impl = impl
-    return int(_crc32c_impl(0xFFFFFFFF, data)) & 0xFFFFFFFF
+    return _crc32c_impl
+
+
+def _crc(data: bytes) -> int:
+    return int(_crc_impl()(0xFFFFFFFF, data)) & 0xFFFFFFFF
+
+
+def _crc_iov(parts) -> int:
+    """Frame CRC as a seeded continuation over segments — identical to
+    _crc(join(parts)) with no join (the running-CRC form both the
+    python reference and the native codec are chainable in)."""
+    return ceph_crc32c_iov(0xFFFFFFFF, parts, update=_crc_impl())
+
+
+def _flatten(payload) -> bytes:
+    """Materialize a payload (bytes-like or segment list) into ONE
+    contiguous bytes. This is the single choke point where the framing
+    path may copy payload bytes — the zero-copy smoke test counts
+    calls to it (crc mode: zero; secure/compress: one staged buffer
+    per frame)."""
+    if isinstance(payload, (list, tuple)):
+        return b"".join(payload)
+    return bytes(payload)
+
+
+def _payload_len(payload) -> int:
+    if isinstance(payload, (list, tuple)):
+        return sum(len(p) for p in payload)
+    return len(payload)
+
+
+def _sendmsg_all(sock: socket.socket, parts: list) -> None:
+    """Gather-write the iovec fully (sendmsg may send partially under
+    pressure; resume from the exact byte like sendall would)."""
+    views = [memoryview(p) for p in parts if len(p)]
+    total = sum(len(v) for v in views)
+    sent = sock.sendmsg(views)
+    while sent < total:
+        total -= sent
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+        sent = sock.sendmsg(views)
 
 
 class _Conn:
@@ -217,6 +272,11 @@ class _Conn:
         self.wlock = threading.Lock()
         self.alive = True
         self.box = box
+        # receive-side cumulative-ack cursor: highest peer seq this
+        # side has ACKED on this conn (reader + ack flusher both
+        # advance it; acks are idempotent so the benign race costs at
+        # most one duplicate ack)
+        self.acked_out = 0
         self.comp = comp            # negotiated compression algo id
         self.stats = stats if stats is not None else {}
         self.stats_lock = stats_lock or threading.Lock()
@@ -225,30 +285,44 @@ class _Conn:
         # reach the session state (see _read_loop)
         self.peer_inst = peer_inst
 
-    def send_frame(self, seq: int, type_id: int, payload: bytes) -> None:
-        if self.comp == COMP_ZLIB and len(payload) >= _COMPRESS_MIN:
+    def send_frame(self, seq: int, type_id: int, payload) -> None:
+        """`payload` is bytes-like OR a segment list (Encoder.segments
+        output). Wire bytes are bit-identical either way; the list form
+        never copies the payload in crc mode (gather-write + running
+        CRC), and stages exactly one contiguous buffer in secure/
+        compressed mode (the seal/deflate input)."""
+        segs = list(payload) if isinstance(payload, (list, tuple)) \
+            else [payload]
+        plen = sum(len(s) for s in segs)
+        if self.comp == COMP_ZLIB and plen >= _COMPRESS_MIN:
             import zlib
-            packed = zlib.compress(payload, 1)
-            if len(packed) < len(payload):   # only when it helps
-                payload = packed
+            packed = zlib.compress(_flatten(segs), 1)
+            if len(packed) < plen:   # only when it helps
+                segs = [packed]
+                plen = len(packed)
                 type_id |= _COMP_FLAG
                 with self.stats_lock:
                     self.stats["tx_compressed"] = \
                         self.stats.get("tx_compressed", 0) + 1
-        plain = struct.pack("<QH", seq, type_id) + payload
         if self.box is None:
-            frame = struct.pack("<I", len(plain)) + plain
-            frame += struct.pack("<I", _crc(frame))
+            # [u32 len][u64 seq][u16 type] packs to the same 14 bytes
+            # the two-step concat produced; the crc is a seeded
+            # continuation over header + payload segments — no join
+            hdr = struct.pack("<IQH", 10 + plen, seq, type_id)
+            crc = struct.pack("<I", _crc_iov([hdr] + segs))
             with self.wlock:
-                self.sock.sendall(frame)
+                _sendmsg_all(self.sock, [hdr] + segs + [crc])
         else:
             with self.wlock:
                 # seal under the lock: the nonce counter must advance
-                # in transmit order or a reordered pair would reuse one
+                # in transmit order or a reordered pair would reuse
+                # one. AEAD needs contiguous input: stage ONE buffer.
                 hdr = struct.pack(
-                    "<I", _NONCE + len(plain) + _GCM_TAG)
-                frame = hdr + self.box.seal(plain, hdr)
-                self.sock.sendall(frame)
+                    "<I", _NONCE + 10 + plen + _GCM_TAG)
+                plain = _flatten(
+                    [struct.pack("<QH", seq, type_id)] + segs)
+                _sendmsg_all(self.sock,
+                             [hdr, self.box.seal(plain, hdr)])
 
     def close(self) -> None:
         self.alive = False
@@ -335,6 +409,13 @@ class Messenger:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+        # delayed-ack flusher: covers frames the inline every-Nth ack
+        # didn't reach (see ACK_BATCH); event-driven so an idle
+        # messenger sleeps
+        self._ack_event = threading.Event()
+        self._ack_thread = threading.Thread(target=self._ack_loop,
+                                            daemon=True)
+        self._ack_thread.start()
 
     # -- dispatch ------------------------------------------------------------
 
@@ -647,7 +728,11 @@ class Messenger:
             raise ConnectionError(f"{self.name}: messenger is shut down")
         e = Encoder()
         msg.encode_payload(e)
-        payload = e.bytes()
+        # segment list, not one joined buffer: data blobs the encoder
+        # appended by reference (blob_ref) travel pointer-style from
+        # here through sendmsg — the unacked queue keeps the same list
+        # for replay, so the aliasing contract extends until the ack
+        payload = e.segments()
         # ms_inject_socket_failures (ref: src/msg/Messenger.h debug
         # knob): every Nth send tears the live socket down FIRST, so
         # this message and any unacked predecessors must survive
@@ -751,19 +836,30 @@ class Messenger:
     # -- receive -------------------------------------------------------------
 
     def _read_loop(self, peer: str, conn: _Conn) -> None:
+        # buffered reader: one C-level buffer fill serves many small
+        # header/body reads (the raw 2-3 recv syscalls per frame cost
+        # real CPU at wire rates); read(n) blocks until n bytes like
+        # _recv_exact did, and a close/shutdown unblocks it the same
+        # way
+        rf = conn.sock.makefile("rb", buffering=1 << 18)
+
+        def read_exact(n: int) -> bytes:
+            b = rf.read(n)
+            if b is None or len(b) < n:
+                raise ConnectionError("peer closed")
+            return b
         try:
             while conn.alive:
-                raw_len = self._recv_exact(conn.sock, 4)
+                raw_len = read_exact(4)
                 (blen,) = struct.unpack("<I", raw_len)
                 floor = 10 if conn.box is None \
                     else 10 + _NONCE + _GCM_TAG
                 if blen < floor or blen > (1 << 26):
                     raise ConnectionError(f"bad frame length {blen}")
-                body = self._recv_exact(conn.sock, blen)
+                body = read_exact(blen)
                 if conn.box is None:
-                    (crc,) = struct.unpack(
-                        "<I", self._recv_exact(conn.sock, 4))
-                    if _crc(raw_len + body) != crc:
+                    (crc,) = struct.unpack("<I", read_exact(4))
+                    if _crc_iov([raw_len, body]) != crc:
                         # ProtocolV2 crc mode: corrupt frame kills the
                         # session; replay redelivers after reconnect
                         raise ConnectionError("frame crc mismatch")
@@ -771,8 +867,10 @@ class Messenger:
                     # secure mode: the GCM tag is the integrity check
                     # (and the length header is bound in as AAD)
                     body = conn.box.open(body, raw_len)
-                seq, tid = struct.unpack("<QH", body[:10])
-                payload = body[10:]
+                seq, tid = struct.unpack_from("<QH", body)
+                # zero-copy view over the payload (Decoder accepts a
+                # memoryview; blob fields copy out only what they keep)
+                payload = memoryview(body)[10:]
                 if tid & _COMP_FLAG:
                     import zlib
                     try:
@@ -822,11 +920,21 @@ class Messenger:
                     if seq > self._in_seq.get(peer, 0):
                         self._in_seq[peer] = seq
                         deliver = True  # else: replayed dup, drop
-                try:
-                    conn.send_frame(0, ACK_TYPE,
-                                    struct.pack("<Q", seq))
-                except (OSError, ConnectionError):
-                    pass
+                    ack_seq = self._in_seq.get(peer, 0)
+                # coalesced cumulative ack: every ACK_BATCH frames
+                # inline, the rest via the ~2ms flusher — replies
+                # never wait on acks (they only retire the sender's
+                # replay queue), so the delay costs nothing while
+                # cutting the rpc pattern's frame count by a third
+                if ack_seq - conn.acked_out >= ACK_BATCH:
+                    conn.acked_out = max(conn.acked_out, ack_seq)
+                    try:
+                        conn.send_frame(0, ACK_TYPE,
+                                        struct.pack("<Q", ack_seq))
+                    except (OSError, ConnectionError):
+                        pass
+                else:
+                    self._ack_event.set()
                 if deliver:
                     cls = _MSG_TYPES.get(tid)
                     handler = self._handlers.get(tid)
@@ -842,18 +950,46 @@ class Messenger:
                             g_log.dout("msgr", 0,
                                        f"dispatch error from {peer} "
                                        f"type={tid:#x} seq={seq}: {e!r}")
-        except (OSError, ConnectionError):
-            pass
+        except (OSError, ConnectionError, ValueError):
+            pass   # ValueError: read on a concurrently closed makefile
         finally:
+            try:
+                rf.close()
+            except OSError:
+                pass
             conn.close()
             with self._lock:
                 if self._conns.get(peer) is conn:
                     del self._conns[peer]
 
+    def _ack_loop(self) -> None:
+        """Flush owed cumulative acks ~2ms after a burst: the sender's
+        replay queue retires promptly even when the inline every-Nth
+        ack didn't fire (a lone frame, a stream that went quiet)."""
+        import time as _time
+        while not self._stopping:
+            if not self._ack_event.wait(timeout=0.5):
+                continue
+            self._ack_event.clear()
+            _time.sleep(0.02)           # let the burst coalesce
+            with self._lock:
+                conns = list(self._conns.items())
+                seqs = {p: self._in_seq.get(p, 0) for p, _ in conns}
+            for peer, conn in conns:
+                seq = seqs[peer]
+                if conn.alive and seq > conn.acked_out:
+                    conn.acked_out = max(conn.acked_out, seq)
+                    try:
+                        conn.send_frame(0, ACK_TYPE,
+                                        struct.pack("<Q", seq))
+                    except (OSError, ConnectionError):
+                        pass
+
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:
         self._stopping = True
+        self._ack_event.set()   # unblock the flusher so it can exit
         try:
             self._listener.close()
         except OSError:

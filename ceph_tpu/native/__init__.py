@@ -110,6 +110,13 @@ def lib() -> ctypes.CDLL:
     L.ec_registered_plugin.restype = ctypes.c_char_p
     L.ec_set_runtime_socket.argtypes = [ctypes.c_char_p]
     L.ec_runtime_ping.restype = ctypes.c_int
+    L.ec_aes256gcm_supported.restype = ctypes.c_int
+    for fn in (L.ec_aes256gcm_seal, L.ec_aes256gcm_open):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                       ctypes.c_char_p, ctypes.c_int64,
+                       ctypes.c_char_p, ctypes.c_int64,
+                       ctypes.c_char_p]
     return L
 
 
@@ -136,6 +143,44 @@ def native_crc32c(seed: int, data: bytes | np.ndarray) -> int:
     buf = bytes(data) if not isinstance(data, np.ndarray) else \
         np.ascontiguousarray(data, np.uint8).tobytes()
     return int(lib().ec_crc32c(seed & 0xFFFFFFFF, buf, len(buf)))
+
+
+def aes256gcm_supported() -> bool:
+    """True when the .so is built and the CPU has AES-NI + PCLMUL."""
+    try:
+        return ready() and bool(lib().ec_aes256gcm_supported())
+    except (NativeUnavailable, OSError, AttributeError):
+        return False
+
+
+def aes256gcm_seal(key: bytes, nonce: bytes, plain: bytes,
+                   aad: bytes) -> bytes:
+    """NIST AES-256-GCM (96-bit nonce): ciphertext || 16-byte tag —
+    bit-identical to cryptography's AESGCM.encrypt."""
+    out = ctypes.create_string_buffer(len(plain) + 16)
+    r = lib().ec_aes256gcm_seal(key, nonce, aad,
+                                ctypes.c_int64(len(aad)), plain,
+                                ctypes.c_int64(len(plain)), out)
+    if r != 0:
+        raise NativeUnavailable(f"ec_aes256gcm_seal rc={r}")
+    return out.raw
+
+
+def aes256gcm_open(key: bytes, nonce: bytes, blob: bytes,
+                   aad: bytes) -> bytes:
+    """Decrypt+verify; raises ValueError on tag mismatch (the caller
+    maps it to the AEAD InvalidTag)."""
+    if len(blob) < 16:
+        raise ValueError("aes256gcm blob too short")
+    out = ctypes.create_string_buffer(len(blob) - 16)
+    r = lib().ec_aes256gcm_open(key, nonce, aad,
+                                ctypes.c_int64(len(aad)), blob,
+                                ctypes.c_int64(len(blob)), out)
+    if r == -1:
+        raise ValueError("aes256gcm tag mismatch")
+    if r != 0:
+        raise NativeUnavailable(f"ec_aes256gcm_open rc={r}")
+    return out.raw
 
 
 from ..ec.interface import ErasureCode  # noqa: E402

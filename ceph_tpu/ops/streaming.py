@@ -85,6 +85,10 @@ class StreamingCodec:
         self.tile = int(tile)
         self.depth = depth  # in-flight tiles (double buffering = 2)
         self._fn = make_encoder(matrix, impl)
+        # reusable ragged-tail staging buffer: allocated once per
+        # (B, k, tile) shape instead of a fresh zeroed array per
+        # encode call's tail tile
+        self._pad: np.ndarray | None = None
 
     def encode(self, data: np.ndarray, out: np.ndarray | None = None
                ) -> np.ndarray:
@@ -106,21 +110,37 @@ class StreamingCodec:
         inflight: list[tuple[int, int, object]] = []  # (off, len, dev)
 
         def drain(entry):
+            # device_get writes STRAIGHT into the caller's out slice
+            # (no intermediate host array + second copy); the D2H for
+            # this tile was already started at launch, so by the time
+            # the pipeline is `depth` deep this is mostly a wait
             off, ln, dev = entry
-            host = np.asarray(jax.device_get(dev))
-            out[:, :, off:off + ln] = host[:, :, :ln]
+            out[:, :, off:off + ln] = jax.device_get(dev)[:, :, :ln]
 
         for ti in range(n_tiles):
             off = ti * tl
             ln = min(tl, L - off)
             src = data[:, :, off:off + tl]
-            if ln < tl:  # ragged tail: zero-pad to the fixed shape
-                pad = np.zeros((B, self.k, tl), dtype=np.uint8)
-                pad[:, :, :ln] = src
-                src = pad
+            if ln < tl:  # ragged tail: zero-pad to the fixed shape,
+                # reusing ONE preallocated staging buffer per shape
+                if self._pad is None or \
+                        self._pad.shape != (B, self.k, tl):
+                    self._pad = np.zeros((B, self.k, tl),
+                                         dtype=np.uint8)
+                else:
+                    self._pad[:, :, ln:] = 0
+                self._pad[:, :, :ln] = src
+                src = self._pad
             # enqueue: device_put + launch return immediately (async
-            # dispatch); compute of tile i overlaps staging of i+1
-            inflight.append((off, ln, self._fn(jax.device_put(src))))
+            # dispatch); compute of tile i overlaps staging of i+1,
+            # and the result's D2H copy starts NOW instead of when
+            # drain() blocks on it
+            dev = self._fn(jax.device_put(src))
+            try:
+                dev.copy_to_host_async()
+            except AttributeError:
+                pass   # non-jax array stub
+            inflight.append((off, ln, dev))
             if len(inflight) >= self.depth:
                 drain(inflight.pop(0))
         while inflight:

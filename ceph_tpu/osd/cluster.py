@@ -411,13 +411,17 @@ class SimCluster:
               snapc: int = 0) -> None:
         # dead processes get no sub-writes; their shards fall behind in
         # the PG log and catch up on revive (ref: a down OSD misses
-        # MOSDECSubOpWrite fan-out; PGLog records the gap)
+        # MOSDECSubOpWrite fan-out; PGLog records the gap). One dead-set
+        # snapshot serves every PG group of this dispatch (the groups
+        # all commit under the same failure view, matching the wire
+        # tier's one-op-one-suspect-set semantics), and each group runs
+        # the backend's fused encode+CRC launch.
         by_pg: dict[int, dict] = {}
         for name, data in objects.items():
             by_pg.setdefault(self.locate(name), {})[name] = data
+        dead = self._dead_osds()
         for ps, group in by_pg.items():
-            self._apply_write(ps, "write", group, self._dead_osds(),
-                              snapc=snapc)
+            self._apply_write(ps, "write", group, dead, snapc=snapc)
 
     def read(self, name: str) -> np.ndarray:
         ps = self.locate(name)

@@ -38,6 +38,7 @@ measures (objects/s).
 
 from __future__ import annotations
 
+import functools as _functools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -125,6 +126,76 @@ class ECBackend(PGBackend):
     # hinfo CRCs use the shared batched-launch helper
     _batched_hinfo_crcs = staticmethod(PGBackend._batched_crcs)
 
+    @staticmethod
+    @_functools.lru_cache(maxsize=256)
+    def _fused_write_fn(matrix_bytes: bytes, m: int, k: int, impl: str,
+                        sl: int, bucket: int):
+        """Process-wide cache (like rs_kernels._make_jitted): every
+        PG backend with the same coder geometry shares ONE compiled
+        program per (shard len, batch bucket) — a per-backend cache
+        would recompile the identical HLO once per PG per daemon."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..csum.kernels import crc32c_blocks
+        from ..ops.rs_kernels import make_encoder
+        matrix = np.frombuffer(matrix_bytes,
+                               dtype=np.uint8).reshape(m, k)
+        enc = make_encoder(matrix, impl, bucket_batch=False)
+        n = m + k
+
+        def fused(d):                # (bucket, k, sl) u8
+            parity = enc(d)          # (bucket, m, sl)
+            rows = jnp.concatenate([d, parity], axis=1)
+            crcs = crc32c_blocks(rows.reshape(bucket * n, sl),
+                                 init=0xFFFFFFFF,
+                                 xorout=0).reshape(bucket, n)
+            return parity, crcs
+        return jax.jit(fused)
+
+    def _encode_shards_with_crcs(self, data_shards: np.ndarray,
+                                 sl: int) -> tuple[np.ndarray,
+                                                   np.ndarray]:
+        """(B, k, sl) data rows -> (slot-ordered (B, n, sl) shards,
+        slot-ordered (B, n) hinfo CRCs). For static-matrix coders the
+        encode AND both CRC sets run as ONE fused, B-bucketed device
+        launch with a single host fetch — the write path's r01 shape
+        dispatched encode + CRC as separate launches with host
+        round-trips between (the wire tier pays that per client op).
+        Other coders take the generic two-launch path."""
+        from ..ec.rs import ReedSolomon
+        B = data_shards.shape[0]
+        if isinstance(self.coder, ReedSolomon):
+            import jax
+            from ..ops.rs_kernels import pow2_bucket
+            bucket = pow2_bucket(B)
+            mat = np.ascontiguousarray(self.coder.matrix,
+                                       dtype=np.uint8)
+            fn = self._fused_write_fn(mat.tobytes(), self.m, self.k,
+                                      self.coder.impl, sl, bucket)
+            padded = data_shards
+            if bucket != B:
+                padded = np.zeros((bucket,) + data_shards.shape[1:],
+                                  dtype=np.uint8)
+                padded[:B] = data_shards
+            parity_d, crcs_d = fn(padded)
+            parity, dense_crcs = jax.device_get((parity_d, crcs_d))
+            dense = np.concatenate(
+                [data_shards, np.asarray(parity)[:B]], axis=1)
+            dense_crcs = np.asarray(dense_crcs)[:B]
+            shards = self._slots_from_dense(dense)
+            if self._identity_mapping:
+                return shards, dense_crcs
+            crcs = np.empty_like(dense_crcs)
+            crcs[:, self._perm] = dense_crcs
+            return shards, crcs
+        parity = np.asarray(self.coder.encode_chunks(data_shards))
+        shards = self._slots_from_dense(
+            np.concatenate([data_shards, parity], axis=1))
+        crcs = self._batched_hinfo_crcs(
+            shards.reshape(-1, sl)).reshape(B, self.n)
+        return shards, crcs
+
     def _write_empty(self, name: str, live: list[int] | None = None) -> None:
         hinfo = HashInfo(1, 0, [0xFFFFFFFF])
         self.object_sizes[name] = 0
@@ -141,11 +212,24 @@ class ECBackend(PGBackend):
     # -- write path (submit_transaction, full-object) ------------------------
 
     def write_objects(self, objects: dict[str, bytes | np.ndarray],
-                      dead_osds: set[int] | None = None) -> None:
+                      dead_osds: set[int] | None = None,
+                      shard_txn_extra=None) -> None:
         """Full-object writes, batched: encode every equal-length group
         in one device launch, then scatter per-shard store transactions
         (the role of ECTransaction::generate_transactions). Shards on
-        dead OSDs are skipped and fall behind in the PG log."""
+        dead OSDs are skipped and fall behind in the PG log.
+
+        shard_txn_extra: optional factory, called once per fan-out
+        wave with the wave's object names, AFTER the PG log reflects
+        the wave's writes; returns fn(shard, txn) that appends extra
+        ops to each shard's transaction. The wire tier rides the PG metadata persist on it
+        (the pg-log-entries-inside-the-transaction discipline, ref:
+        ECTransaction carrying log entries to every shard) so a client
+        write costs ONE fan-out instead of two. With the hook in use
+        the log append happens before the fan-out; a failed wave then
+        leaves log entries no shard applied, which the caller's
+        degraded retry simply supersedes (cursors only advance on the
+        entries the retry wave ships)."""
         live = self._live_slots(dead_osds)
         self._check_min_size(live)
         by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
@@ -156,22 +240,37 @@ class ECBackend(PGBackend):
             if olen == 0:
                 for name, _ in group:
                     self._write_empty(name, live)
+                if shard_txn_extra is not None:
+                    add = shard_txn_extra([n for n, _ in group])
+                    txns = []
+                    for shard in live:
+                        t = Transaction()
+                        add(shard, t)
+                        txns.append((shard, t))
+                    self._fanout_txns(txns)
                 continue
             batch = np.stack([a for _, a in group])
             sl = self._shard_len(olen)
             data_shards = self.sinfo.object_to_shards(batch)  # (B, k, sl)
-            parity = np.asarray(self.coder.encode_chunks(data_shards))
-            shards = self._slots_from_dense(
-                np.concatenate([data_shards, parity], axis=1))
-            crcs = self._batched_hinfo_crcs(shards.reshape(-1, sl))
-            crcs = crcs.reshape(len(group), self.n)
+            shards, crcs = self._encode_shards_with_crcs(data_shards,
+                                                         sl)
             for name, _ in group:
                 self.object_sizes[name] = olen
+            add = None
+            if shard_txn_extra is not None:
+                # log FIRST so the extra ops (the metadata persist)
+                # see the post-write history; see the docstring for
+                # why a failed wave cannot wedge the cursors
+                for name, _ in group:
+                    self._log_write(name, live)
+                add = shard_txn_extra([n for n, _ in group])
             # ONE combined transaction per shard for the whole batch
             # (the sub-op fan-out unit; on the wire tier this is one
             # MStoreOp frame per shard instead of one per object —
             # the batched analog of MOSDECSubOpWrite carrying the
-            # whole RMW plan)
+            # whole RMW plan), fanned out pipelined: all shards'
+            # frames hit the wire before any ack is awaited
+            txns = []
             for shard in live:
                 cid = shard_cid(self.pg, shard)
                 t = Transaction()
@@ -182,12 +281,13 @@ class ECBackend(PGBackend):
                     t.write(cid, name, 0, shards[bi, shard, :]) \
                      .truncate(cid, name, sl) \
                      .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
-                # sequential fan-out is deliberate: measured A/B,
-                # python thread spawn + GIL beat the ~1ms localhost
-                # RTT overlap (43ms vs 51ms median batch write)
-                self._store(shard).queue_transaction(t)
-            for name, _ in group:
-                self._log_write(name, live)
+                if add is not None:
+                    add(shard, t)
+                txns.append((shard, t))
+            self._fanout_txns(txns)
+            if shard_txn_extra is None:
+                for name, _ in group:
+                    self._log_write(name, live)
 
     # -- write path (RMW partial-stripe) -------------------------------------
 
@@ -353,17 +453,20 @@ class ECBackend(PGBackend):
                 crcs = self._batched_hinfo_crcs(np.stack(fulls))
                 for (bi, s), c in zip(slots[nsl], crcs):
                     crc_of[(bi, s)] = int(c)
+            # one combined txn per live shard for the whole group,
+            # fanned out pipelined (matches the full-write path)
+            shard_txns = {s: Transaction() for s in live}
             for bi, (name, writes, _, new_size, s0, _) in enumerate(group):
                 nsl = self._shard_len(new_size)
                 c0 = s0 // k
                 for s in live:
                     hinfo = HashInfo(1, nsl, [crc_of[(bi, s)]])
-                    t = (Transaction()
-                         .write(shard_cid(self.pg, s), name, c0,
-                                shards[bi, s])
-                         .setattr(shard_cid(self.pg, s), name,
-                                  HINFO_KEY, hinfo.to_bytes()))
-                    self._store(s).queue_transaction(t)
+                    shard_txns[s].write(shard_cid(self.pg, s), name, c0,
+                                        shards[bi, s]) \
+                        .setattr(shard_cid(self.pg, s), name,
+                                 HINFO_KEY, hinfo.to_bytes())
+            self._fanout_txns(list(shard_txns.items()))
+            for bi, (name, writes, _, new_size, s0, _) in enumerate(group):
                 self.object_sizes[name] = new_size
                 self._log_write(name, live)
 
@@ -605,18 +708,23 @@ class ECBackend(PGBackend):
     def _writeback_rebuilt(self, lost: list[int], subgroup: list[str],
                            rebuilt_all: np.ndarray, crcs: np.ndarray,
                            sl: int, counters: dict) -> None:
+        # ONE combined txn per replacement shard for the whole batch
+        # (the write-path fan-out unit), pipelined across shards — at
+        # the wire tier this is len(lost) overlapped MStoreOp frames
+        # per batch instead of len(lost) * B sequential ones
+        txns = []
         for li, s in enumerate(lost):
             cid = shard_cid(self.pg, s)
-            store = self._store(s)
+            t = Transaction()
             for bi, name in enumerate(subgroup):
                 chunk = rebuilt_all[bi, li]
                 hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
-                t = (Transaction()
-                     .write(cid, name, 0, chunk)
-                     .truncate(cid, name, sl)
-                     .setattr(cid, name, HINFO_KEY, hinfo.to_bytes()))
-                store.queue_transaction(t)
+                t.write(cid, name, 0, chunk) \
+                 .truncate(cid, name, sl) \
+                 .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
                 counters["bytes"] += int(chunk.size)
+            txns.append((s, t))
+        self._fanout_txns(txns)
         counters["objects"] += len(subgroup)
 
     def recover_shards(self, lost_shards: list[int],
@@ -786,6 +894,17 @@ class ECBackend(PGBackend):
                     with span("ecbackend.recover.launch"):
                         handles = self._fused_recover_fn(
                             dec_fn, sl, verify_hinfo)(stack, exp)
+                        # start the D2H transfer NOW (async): by the
+                        # time complete() blocks in device_get, batch
+                        # i's results are already streaming to the
+                        # host underneath batch i+1's launch — the r06
+                        # trace showed the blocking fetch (~60 ms/
+                        # batch) as the warm path's critical section
+                        for h in handles:
+                            try:
+                                h.copy_to_host_async()
+                            except AttributeError:
+                                break   # non-jax handle (test stub)
                     pending.append((sl, subgroup, handles))
                     if len(pending) >= 2:
                         complete(pending.pop(0))

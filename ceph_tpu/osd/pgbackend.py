@@ -103,6 +103,36 @@ class PGBackend:
                    default=0)
         return [s for s in shards if self.shard_applied[s] >= need]
 
+    def _fanout_txns(self, items) -> None:
+        """Apply [(shard, Transaction)] across the acting set,
+        PIPELINED where the store supports it (RemoteStore at the wire
+        tier): every txn is transmitted before any ack is awaited, so
+        the fan-out costs one overlapped round trip instead of
+        len(items) sequential ones (the reference dispatches its
+        MOSDECSubOpWrite sub-ops in parallel too). Durability point
+        unchanged — this returns only after EVERY shard acked, and a
+        shard failure raises exactly like the sequential loop did.
+        In-process stores (MemStore/TinStore) take the sync path."""
+        waits: list = []
+        first_err: BaseException | None = None
+        for shard, t in items:
+            st = self._store(shard)
+            submit = getattr(st, "queue_transaction_async", None)
+            try:
+                if submit is not None:
+                    waits.append(submit(t))
+                else:
+                    st.queue_transaction(t)
+            except (ConnectionError, OSError) as e:
+                first_err = first_err or e
+        for h in waits:
+            try:
+                h.result()
+            except (ConnectionError, OSError) as e:
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err
+
     def _check_min_size(self, live: list[int]) -> None:
         """Writes need >= min_live receiving slots or the PG goes
         inactive and blocks I/O (the pool min_size gate). Counts
@@ -390,13 +420,13 @@ class ReplicatedBackend(PGBackend):
     def _put_full(self, name: str, arr: np.ndarray, crc: int,
                   live: list[int]) -> None:
         hinfo = HashInfo(1, len(arr), [crc])
-        for s in live:
-            t = (Transaction()
-                 .write(shard_cid(self.pg, s), name, 0, arr)
-                 .truncate(shard_cid(self.pg, s), name, len(arr))
-                 .setattr(shard_cid(self.pg, s), name,
-                          HINFO_KEY, hinfo.to_bytes()))
-            self._store(s).queue_transaction(t)
+        self._fanout_txns(
+            [(s, Transaction()
+              .write(shard_cid(self.pg, s), name, 0, arr)
+              .truncate(shard_cid(self.pg, s), name, len(arr))
+              .setattr(shard_cid(self.pg, s), name,
+                       HINFO_KEY, hinfo.to_bytes()))
+             for s in live])
         self.object_sizes[name] = len(arr)
         self._log_write(name, live)
 

@@ -74,6 +74,19 @@ class PGLog:
             self.tail = v
         return self.head
 
+    def append_entry(self, version: int, name: str) -> None:
+        """Replay a known (version, name) entry — the delta-meta
+        restore path reapplying entries persisted after the last full
+        snapshot. Versions must arrive strictly ascending past head."""
+        if version <= self.head:
+            raise ValueError(f"append_entry {version} <= head "
+                             f"{self.head}")
+        self.head = version
+        self._entries.append((version, name))
+        while len(self._entries) > self.max_entries:
+            v, _ = self._entries.popleft()
+            self.tail = v
+
     def missing_since(self, version: int) -> list[str] | None:
         """Objects mutated after `version` (dedup, oldest-first), or
         None when `version` predates the retained log — the caller must

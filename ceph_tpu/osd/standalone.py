@@ -74,24 +74,35 @@ from .memstore import MemStore, Transaction
 from .osdmap import OSDMap, PGPool
 from .pgbackend import ReplicatedBackend
 from .pglog import PGLog, divergent_names, share_history
-from .tinstore import _decode_txn, _encode_txn
+from .tinstore import _decode_txn, _encode_txn, _encode_txn_iov
 
 PG_META_KEY = b"pg_meta"
+#: delta-meta omap key (same omap object as PG_META_KEY): entries
+#: appended since the last full base blob — see OSDDaemon._meta_extra
+PG_META_DELTA_KEY = b"pg_meta_delta"
+#: full-base persist cadence: a delta may cover at most this many
+#: entries before the next write re-ships the full blob
+_META_DELTA_MAX = 32
 
 
 # -- typed frames (0x30 block) ----------------------------------------------
 
 class _Blob(Message):
-    """Shared shape: (req_id, ok, kind, payload-bytes)."""
+    """Shared shape: (req_id, ok, kind, payload-bytes). `blob` may be
+    one buffer or a segment list (Encoder.segments output): either way
+    it is appended BY REFERENCE, so an op body carrying object data
+    crosses the encode + framing path without a copy. Decoded messages
+    always carry contiguous bytes."""
 
     def __init__(self, req_id: int, ok: bool = True, kind: str = "",
-                 blob: bytes = b"", err: str = ""):
+                 blob=b"", err: str = ""):
         self.req_id, self.ok = req_id, ok
         self.kind, self.blob, self.err = kind, blob, err
 
     def encode_payload(self, e: Encoder) -> None:
         (e.start(1, 1).u64(self.req_id).boolean(self.ok)
-         .string(self.kind).blob(self.blob).string(self.err).finish())
+         .string(self.kind).blob_ref(self.blob).string(self.err)
+         .finish())
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "_Blob":
@@ -521,50 +532,160 @@ class MAuthReply(_Blob):
 
 # -- request/reply plumbing --------------------------------------------------
 
-class _Rpc:
-    """Blocking request/reply over the messenger: correlation ids +
-    per-request events. Reply handlers route by req_id."""
+class _PendingCall:
+    """One in-flight rpc: event + slot accounting. wait() returns the
+    reply or raises ConnectionError on timeout — exactly call()'s
+    contract, split so callers can have MANY of these on the wire."""
 
-    def __init__(self, msgr: Messenger, reply_type: int):
+    __slots__ = ("_rpc", "rid", "peer", "nbytes", "_ev", "_replies",
+                 "_released")
+
+    def __init__(self, rpc: "_Rpc", rid: int, peer: str, nbytes: int):
+        self._rpc = rpc
+        self.rid, self.peer, self.nbytes = rid, peer, nbytes
+        self._ev = threading.Event()
+        self._replies: list = []
+        self._released = False
+
+    def wait(self, timeout: float = 10.0):
+        try:
+            if not self._ev.wait(timeout):
+                raise ConnectionError(f"rpc to {self.peer} timed out")
+            rep = self._replies[0]
+            if isinstance(rep, BaseException):
+                raise rep
+            return rep
+        finally:
+            self._rpc._retire(self)
+
+    def fail(self, err: BaseException) -> None:
+        self._replies.append(err)
+        self._ev.set()
+
+
+class _Rpc:
+    """Request/reply over the messenger: correlation ids + per-request
+    events; reply handlers route by req_id, so completions match OUT
+    OF ORDER. submit() opens a windowed in-flight op (the Objecter's
+    seq-tagged pipeline role); call() is submit()+wait() — one op per
+    round trip, the pre-window behavior.
+
+    The window (ops cap + byte budget) bounds how much a caller may
+    pipeline: submit() BLOCKS while the window is full (backpressure,
+    the objecter_inflight_op_bytes role) and a completion — in any
+    order — frees its slot. window=0 disables the cap (daemon-internal
+    rpc must never backpressure dispatch threads against each other)."""
+
+    def __init__(self, msgr: Messenger, reply_type: int,
+                 window: int = 0, window_bytes: int = 0):
         self.msgr = msgr
         self._lock = threading.Lock()
         self._next = 1
-        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._pending: dict[int, _PendingCall] = {}
+        self.window = int(window)
+        self.window_bytes = int(window_bytes)
+        self._win = threading.Condition(self._lock)
+        self._inflight = 0
+        self._inflight_bytes = 0
         msgr.register_handler(reply_type, self._on_reply)
 
     def _on_reply(self, peer: str, msg) -> None:
         with self._lock:
-            ent = self._pending.get(msg.req_id)
+            # pop, not get: an abandoned handle (caller gave up before
+            # the late reply landed) must not leak its table entry
+            ent = self._pending.pop(msg.req_id, None)
+            if ent is not None:
+                # the slot frees the moment the ack arrives (not when
+                # the waiter gets scheduled): the window refills at
+                # wire speed even with a slow consumer
+                self._release_locked(ent)
         if ent is not None:
-            ent[1].append(msg)
-            ent[0].set()
+            ent._replies.append(msg)
+            ent._ev.set()
+
+    def _release_locked(self, ent: _PendingCall) -> None:
+        if ent._released:
+            return
+        ent._released = True
+        self._inflight -= 1
+        self._inflight_bytes -= ent.nbytes
+        self._win.notify_all()
+
+    def _retire(self, ent: _PendingCall) -> None:
+        with self._lock:
+            self._pending.pop(ent.rid, None)
+            self._release_locked(ent)
+
+    def submit(self, peer: str, make_msg,
+               nbytes: int = 0) -> _PendingCall:
+        """make_msg(req_id) -> Message. Transmits and returns the
+        pending handle immediately (blocking first while the window is
+        full). The reply — or a transport error — is delivered through
+        handle.wait()."""
+        with self._lock:
+            if self.window:
+                while (self._inflight >= self.window
+                       or (self.window_bytes and self._inflight
+                           and self._inflight_bytes + nbytes
+                           > self.window_bytes)):
+                    self._win.wait()
+            rid = self._next
+            self._next += 1
+            ent = _PendingCall(self, rid, peer, nbytes)
+            self._pending[rid] = ent
+            self._inflight += 1
+            self._inflight_bytes += nbytes
+        try:
+            self.msgr.send(peer, make_msg(rid))
+        except KeyError:
+            # unknown endpoint (peer not wired yet / torn down):
+            # a TRANSPORT failure, never to be confused with an
+            # application-level KeyError reply ("no such omap
+            # key") — peering quorum counts only peers that
+            # actually ANSWERED
+            self._retire(ent)
+            ent.fail(ConnectionError(
+                f"rpc to {peer}: endpoint unknown"))
+        except (OSError, ConnectionError) as e:
+            # the lossless messenger queues + replays on reconnect, so
+            # most transport errors never surface here; a hard refusal
+            # (partition injection) does — fail the handle, not the
+            # batch
+            self._retire(ent)
+            ent.fail(ConnectionError(f"rpc to {peer}: {e}"))
+        return ent
 
     def call(self, peer: str, make_msg, timeout: float = 10.0):
         """make_msg(req_id) -> Message. Returns the reply or raises
         ConnectionError on timeout (the caller treats the peer as
         suspect — the OSD op timeout role)."""
-        with self._lock:
-            rid = self._next
-            self._next += 1
-            ev: tuple[threading.Event, list] = (threading.Event(), [])
-            self._pending[rid] = ev
-        try:
-            try:
-                self.msgr.send(peer, make_msg(rid))
-            except KeyError:
-                # unknown endpoint (peer not wired yet / torn down):
-                # a TRANSPORT failure, never to be confused with an
-                # application-level KeyError reply ("no such omap
-                # key") — peering quorum counts only peers that
-                # actually ANSWERED
-                raise ConnectionError(
-                    f"rpc to {peer}: endpoint unknown") from None
-            if not ev[0].wait(timeout):
-                raise ConnectionError(f"rpc to {peer} timed out")
-            return ev[1][0]
-        finally:
-            with self._lock:
-                self._pending.pop(rid, None)
+        return self.submit(peer, make_msg).wait(timeout)
+
+
+class _AsyncStoreOp:
+    """In-flight MStoreOp with the same error surface as
+    RemoteStore._call: result() maps the reply like the sync path,
+    including the one cephx re-authorize retry on a cold session."""
+
+    def __init__(self, rs: "RemoteStore", kind: str, body: bytes):
+        self._rs, self._kind, self._body = rs, kind, body
+        self._pending = rs._submit(kind, body)
+
+    def result(self) -> bytes:
+        rs = self._rs
+        rep = self._pending.wait(rs._timeout)
+        if not rep.ok and rep.err == "EPERM:unauthenticated" \
+                and rs._authorize is not None:
+            # first store op to this peer since (re)boot: run the
+            # osd->osd cephx round, then retry once
+            rs._authorize(rs._peer)
+            rep = rs._submit(self._kind, self._body).wait(rs._timeout)
+        if rep.ok:
+            return rep.blob
+        if rep.err.startswith("KeyError"):
+            raise KeyError(rep.err[9:] or rep.err)
+        raise ConnectionError(f"store op {self._kind} on {rs._peer}: "
+                              f"{rep.err}")
 
 
 class RemoteStore:
@@ -580,12 +701,13 @@ class RemoteStore:
         self._timeout = timeout
         self._authorize = authorize   # cephx: establish session, retry
 
+    def _submit(self, kind: str, body):
+        return self._rpc.submit(
+            self._peer, lambda rid: MStoreOp(rid, True, kind, body))
+
     def _call(self, kind: str, body: bytes = b"") -> bytes:
         for attempt in range(2):
-            rep = self._rpc.call(
-                self._peer,
-                lambda rid: MStoreOp(rid, True, kind, body),
-                timeout=self._timeout)
+            rep = self._submit(kind, body).wait(self._timeout)
             if rep.ok:
                 return rep.blob
             if (rep.err == "EPERM:unauthenticated"
@@ -609,7 +731,17 @@ class RemoteStore:
         return e.bytes()
 
     def queue_transaction(self, txn: Transaction) -> None:
-        self._call("txn", _encode_txn(txn))
+        self._call("txn", _encode_txn_iov(txn))
+
+    def queue_transaction_async(self, txn: Transaction):
+        """Pipelined txn: transmit now, ack later. Returns a handle
+        whose .result() blocks until the peer committed (same
+        durability point as the sync path — callers wait ALL handles
+        before acking upward) and raises exactly what queue_transaction
+        would. The PG fan-out uses this so n shard sub-ops cost one
+        overlapped round trip instead of n sequential ones (the
+        reference's parallel MOSDECSubOpWrite dispatch)."""
+        return _AsyncStoreOp(self, "txn", _encode_txn_iov(txn))
 
     def read(self, cid: str, oid: str, offset: int = 0,
              length: int | None = None) -> np.ndarray:
@@ -698,6 +830,9 @@ class OSDDaemon:
         # later reconcile until clean
         self._rewind_pending: dict[int, set[str]] = {}
         self._restore_backoff: dict[int, float] = {}
+        # per-PG delta-meta window: (entries since last full base
+        # persist, base pg_log head) — see _meta_extra
+        self._meta_delta: dict[int, tuple[list, int]] = {}
         # interval-freshness bookkeeping (the up_thru machinery, ref:
         # PeeringState WaitUpThru): per primaried pg, the map acting
         # we last processed and the epoch its interval began. While
@@ -924,22 +1059,110 @@ class OSDDaemon:
                                  min_size=self.c.pool_min_size)
 
     def _persist_meta(self, ps: int) -> None:
-        """Ship the PG's metadata to every live shard as omap (the
-        pg_log-rides-with-the-transaction discipline, ref:
-        PGLog entries inside ObjectStore::Transaction)."""
+        """Ship the PG's FULL metadata to every live shard as omap
+        (the pg_log-rides-with-the-transaction discipline, ref: PGLog
+        entries inside ObjectStore::Transaction). Clears the delta key
+        in the same transaction — the base subsumes it (see
+        _meta_extra for the delta scheme)."""
         be = self.backends[ps]
         blob = self._encode_meta(ps)
+        self._meta_delta[ps] = ([], be.pg_log.head)
+        # fan the omap txns out PIPELINED: transmit to every live
+        # shard first, then wait each ack — one overlapped round trip
+        # instead of len(acting) sequential ones (failure handling
+        # unchanged: an unreachable shard is suspected, not fatal)
+        waits: list[tuple[int, object]] = []
         for s, osd in enumerate(be.acting):
             if osd in self.suspect:
                 continue
             t = Transaction().omap_set(shard_cid(be.pg, s), "__pg_meta__",
-                                       {PG_META_KEY: blob})
+                                       {PG_META_KEY: blob,
+                                        PG_META_DELTA_KEY: b""})
+            st = be.cluster.osd(osd)
+            submit = getattr(st, "queue_transaction_async", None)
             try:
-                be.cluster.osd(osd).queue_transaction(t)
+                if submit is not None:
+                    waits.append((osd, submit(t)))
+                else:
+                    st.queue_transaction(t)
+            except (ConnectionError, OSError):
+                self.suspect.add(osd)
+        for osd, h in waits:
+            try:
+                h.result()
             except (ConnectionError, OSError):
                 self.suspect.add(osd)
 
+    def _encode_meta_delta(self, ps: int) -> bytes:
+        """The bounded per-write metadata record: entries appended
+        since the last FULL base persist, plus the current applied
+        cursors. O(delta window) per write where the base blob is
+        O(objects in PG) — the difference between a flat and a
+        quadratically-degrading write path at scale. `base_head` pins
+        which base the delta extends; a reader ignores a delta whose
+        base doesn't match (defensive — the clearing txn makes the
+        pair atomic per shard)."""
+        be = self.backends[ps]
+        entries, base_head = self._meta_delta[ps]
+        e = Encoder()
+        e.start(1, 1)
+        e.u64(self.osdmap.epoch if self.osdmap is not None else 0)
+        e.u64(base_head)
+        e.list(be.shard_applied, lambda en, v: en.u64(v))
+        e.list(entries, lambda en, t: en.string(t[0]).u64(t[1])
+               .u64(t[2]))
+        e.finish()
+        return e.bytes()
+
+    @staticmethod
+    def _decode_meta_delta(blob: bytes):
+        """-> (epoch, base_head, shard_applied, [(name, ver, size)])
+        or None for an absent/corrupt delta."""
+        if not blob:
+            return None
+        try:
+            d = Decoder(blob)
+            d.start(1)
+            epoch = d.u64()
+            base_head = d.u64()
+            applied = d.list(Decoder.u64)
+            entries = d.list(lambda dd: (dd.string(), dd.u64(),
+                                         dd.u64()))
+            d.finish()
+        except Exception:        # noqa: BLE001 — corrupt delta: the
+            return None          # base alone is still a candidate
+        return (epoch, base_head, applied, entries)
+
     def _encode_meta(self, ps: int) -> bytes:
+        """v4 envelope: the v3 body, zlib-wrapped. The blob ships to
+        every live shard on EVERY write (it rides the write fan-out
+        txn) and grows with the PG's object count — deflating the
+        name/int-table body ~4-5x keeps the metadata bytes a small
+        fraction of the data bytes at bench scale. compat=4: the
+        body layout moved, so a pre-v4 reader must refuse (its
+        _meta_rank treats the refusal as no-candidate) rather than
+        misparse."""
+        import zlib
+        inner = self._encode_meta_v3(ps)
+        e = Encoder()
+        e.start(4, 4).blob(zlib.compress(inner, 1)).finish()
+        return e.bytes()
+
+    @staticmethod
+    def _meta_decoder(blob: bytes) -> tuple[Decoder, int]:
+        """Open a persisted meta blob, unwrapping the v4 zlib envelope
+        when present; returns (decoder positioned at the v3-era
+        fields, version<=3). Raises on corrupt/unknown blobs — every
+        caller already treats decode failure as 'no candidate'."""
+        d = Decoder(blob)
+        v = d.start(4)
+        if v >= 4:
+            import zlib
+            d = Decoder(zlib.decompress(d.blob()))
+            v = d.start(3)
+        return d, v
+
+    def _encode_meta_v3(self, ps: int) -> bytes:
         import json as _json
         be = self.backends[ps]
         e = Encoder()
@@ -971,20 +1194,28 @@ class OSDDaemon:
         return e.bytes()
 
     @staticmethod
-    def _meta_rank(blob: bytes) -> tuple[int, int] | None:
-        """(epoch, head) precedence key of a persisted meta blob, or
-        None for a corrupt candidate. Epoch FIRST: a newer interval's
-        state beats any head from an older one — the divergent-log
-        guard (ref: find_best_info)."""
+    def _meta_rank(pair) -> tuple[int, int] | None:
+        """(epoch, head) precedence key of a persisted (base, delta)
+        meta pair, or None for a corrupt candidate. Epoch FIRST: a
+        newer interval's state beats any head from an older one — the
+        divergent-log guard (ref: find_best_info). A delta extending
+        this base advances the effective head (and carries the newer
+        persist epoch); a delta pinned to a DIFFERENT base head is
+        stale pairing and is ignored."""
+        base, delta_blob = pair
         try:
-            d = Decoder(blob)
-            v = d.start(3)
+            d, v = OSDDaemon._meta_decoder(base)
             epoch = d.u64() if v >= 3 else 0
             d.mapping(Decoder.string, Decoder.u64)
             d.mapping(Decoder.string, Decoder.u64)
             head = PGLog.decode(d.blob()).head
         except Exception:        # noqa: BLE001 — a corrupt candidate
             return None          # must not block takeover
+        delta = OSDDaemon._decode_meta_delta(delta_blob) \
+            if delta_blob else None
+        if delta is not None and delta[1] == head and delta[3]:
+            epoch = max(epoch, delta[0])
+            head = max(head, delta[3][-1][1])
         return (epoch, head)
 
     def _load_meta(self, ps: int,
@@ -1005,14 +1236,16 @@ class OSDDaemon:
         as authoritative (ref: PeeringState GetInfo needs a quorum
         before the PG may go active)."""
         pgid = f"1.{ps}"
-        local_blobs: list[bytes] = []
-        remote_blobs: list[bytes] = []
+        local_blobs: list[tuple[bytes, bytes | None]] = []
+        remote_blobs: list[tuple[bytes, bytes | None]] = []
         heard = {self.osd_id}
         for s in range(len(acting)):
             obj = self.store.collections.get(
                 shard_cid(pgid, s), {}).get("__pg_meta__")
             if obj is not None and PG_META_KEY in obj.omap:
-                local_blobs.append(obj.omap[PG_META_KEY])
+                local_blobs.append(
+                    (obj.omap[PG_META_KEY],
+                     obj.omap.get(PG_META_DELTA_KEY)))
         n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
             else 0
         for osd in dict.fromkeys(acting):   # each peer once, in order
@@ -1029,10 +1262,16 @@ class OSDDaemon:
             # silently crowns a divergent local log)
             for s in range(len(acting)):
                 try:
-                    remote_blobs.append(rs.omap_get(
-                        shard_cid(pgid, s), "__pg_meta__",
-                        PG_META_KEY))
+                    base = rs.omap_get(shard_cid(pgid, s),
+                                       "__pg_meta__", PG_META_KEY)
                     heard.add(osd)
+                    try:
+                        delta = rs.omap_get(shard_cid(pgid, s),
+                                            "__pg_meta__",
+                                            PG_META_DELTA_KEY)
+                    except KeyError:
+                        delta = None   # base-only shard (pre-delta)
+                    remote_blobs.append((base, delta))
                 except KeyError:
                     heard.add(osd)   # answered: no blob at this slot
                 except (ConnectionError, OSError):
@@ -1045,12 +1284,12 @@ class OSDDaemon:
                     self.suspect.add(osd)
                     break
 
-        def pick(blobs: list[bytes]) -> bytes | None:
+        def pick(pairs):
             best, best_rank = None, (-1, -1)
-            for blob in blobs:
-                rank = self._meta_rank(blob)
+            for pair in pairs:
+                rank = self._meta_rank(pair)
                 if rank is not None and rank > best_rank:
-                    best, best_rank = blob, rank
+                    best, best_rank = pair, rank
             return best
 
         up_members = {o for o in acting
@@ -1074,6 +1313,31 @@ class OSDDaemon:
         best = pick(remote_blobs + local_blobs)
         return best, best_local, quorum_ok
 
+    @staticmethod
+    def _apply_meta_delta(delta_blob, sizes: dict, versions: dict,
+                          log: PGLog, applied: list) -> list:
+        """Replay a delta window over decoded base metadata: append
+        the (name, version, size) entries past the base head and adopt
+        the delta's applied cursors. Ignores an absent/corrupt delta
+        or one pinned to a different base (stale pairing). Returns the
+        effective shard_applied list."""
+        delta = OSDDaemon._decode_meta_delta(delta_blob) \
+            if delta_blob else None
+        if delta is None:
+            return applied
+        _, base_head, d_applied, entries = delta
+        if base_head != log.head:
+            return applied       # delta extends a different base
+        for name, ver, size in entries:
+            if ver <= log.head:
+                continue         # defensive: never rewind
+            log.append_entry(ver, name)
+            versions[name] = ver
+            sizes[name] = size
+        if len(d_applied) == len(applied):
+            applied = [max(a, b) for a, b in zip(applied, d_applied)]
+        return applied
+
     def _restore_backend(self, ps: int, acting: list[int]):
         """Primary takeover: rebuild the PG from persisted metadata.
         The backend is restored with the acting set the metadata was
@@ -1096,8 +1360,8 @@ class OSDDaemon:
         if blob is None:
             return be            # virgin PG: nothing written yet
         import json as _json
-        d = Decoder(blob)
-        v = d.start(3)
+        base, delta_blob = blob
+        d, v = self._meta_decoder(base)
         if v >= 3:
             d.u64()              # persist epoch (used by _meta_rank)
         be.object_sizes = d.mapping(Decoder.string, Decoder.u64)
@@ -1114,6 +1378,11 @@ class OSDDaemon:
                 k: _json.loads(b) for k, b in d.mapping(
                     Decoder.string, Decoder.blob).items()}
         d.finish()
+        # roll the delta window forward over the base (the entries
+        # persisted since the last full blob — see _meta_extra)
+        applied = self._apply_meta_delta(
+            delta_blob, be.object_sizes, be.object_versions,
+            be.pg_log, applied)
         # adopt the RECORDED acting so the reconcile pass recovers any
         # slot whose OSD has since changed (collections for the new
         # set already exist — _make_backend created them above)
@@ -1126,13 +1395,15 @@ class OSDDaemon:
         # never served from the tainted local copy.
         if local_blob is not None and local_blob != blob:
             try:
-                ld = Decoder(local_blob)
-                lv = ld.start(3)
+                lbase, ldelta = local_blob
+                ld, lv = self._meta_decoder(lbase)
                 if lv >= 3:
                     ld.u64()
-                ld.mapping(Decoder.string, Decoder.u64)   # sizes
-                ld.mapping(Decoder.string, Decoder.u64)   # versions
+                lsizes = ld.mapping(Decoder.string, Decoder.u64)
+                lvers = ld.mapping(Decoder.string, Decoder.u64)
                 local_log = PGLog.decode(ld.blob())
+                self._apply_meta_delta(ldelta, lsizes, lvers,
+                                       local_log, [])
             except Exception:    # noqa: BLE001 — corrupt local blob:
                 local_log = None  # nothing credible to rewind
             if local_log is not None:
@@ -1300,6 +1571,7 @@ class OSDDaemon:
                     self.scrub_reports.pop(ps, None)
                     self._last_scrub.pop(ps, None)
                     self._last_deep.pop(ps, None)
+                    self._meta_delta.pop(ps, None)
                 self._interval_start.pop(ps, None)
                 self._last_acting.pop(ps, None)
                 continue
@@ -1458,7 +1730,8 @@ class OSDDaemon:
             b.add_u64_counter(key)
         self.perf = b.create_perf_counters()
 
-    _READ_KINDS = frozenset({"read", "snap_read", "admin"})
+    _READ_KINDS = frozenset({"read", "readv", "snap_read",
+                             "admin"})
 
     _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
                    "dump_historic_ops_by_duration",
@@ -1541,11 +1814,36 @@ class OSDDaemon:
                     f"(entity {sess['entity']})")
         return None
 
+    @staticmethod
+    def _op_need(kind: str) -> str:
+        return "x" if kind == "cls" else \
+            ("r" if kind in OSDDaemon._READ_KINDS else "w")
+
     def _on_client_op(self, peer: str, msg: MOSDOp) -> None:
+        sub_ops: list[tuple[str, bytes]] | None = None
+        if msg.kind == "batch":
+            # coalesced dispatch (one frame, many PG ops — the client
+            # groups small ops to the same primary): decode sub-ops up
+            # front so caps are gated per sub-op need before anything
+            # executes
+            try:
+                d = Decoder(msg.blob)
+                sub_ops = d.list(
+                    lambda dd: (dd.string(), dd.blob()))
+            except Exception as e:   # noqa: BLE001 — reply, don't die
+                try:
+                    self.msgr.send(peer, MOSDOpReply(
+                        msg.req_id, False, msg.kind,
+                        err=f"{type(e).__name__}:{e}"))
+                except (KeyError, OSError, ConnectionError):
+                    pass
+                return
         if self.verifier is not None:
-            need = "x" if msg.kind == "cls" else \
-                ("r" if msg.kind in self._READ_KINDS else "w")
-            deny = self._auth_gate(peer, need)
+            needs = {self._op_need(k) for k, _ in sub_ops} \
+                if sub_ops is not None else {self._op_need(msg.kind)}
+            deny = next((d for d in (self._auth_gate(peer, n)
+                                     for n in sorted(needs))
+                         if d is not None), None)
             if deny is not None:
                 try:
                     self.msgr.send(peer, MOSDOpReply(
@@ -1557,18 +1855,22 @@ class OSDDaemon:
             if msg.kind == "admin":
                 d = Decoder(msg.blob)
                 blob = self._admin_cmd(d.string())
+            elif sub_ops is not None:
+                # per-sub-op fault isolation: one bad sub-op fails its
+                # slot, not the frame (the client maps each slot back
+                # to its op's retry state)
+                e = Encoder()
+                e.u32(len(sub_ops))
+                for kind, body in sub_ops:
+                    try:
+                        sub_blob = self._one_client_op(peer, kind, body)
+                        e.boolean(True).blob_ref(sub_blob).string("")
+                    except Exception as err:   # noqa: BLE001
+                        e.boolean(False).blob(b"").string(
+                            f"{type(err).__name__}:{err}")
+                blob = e.bytes()
             else:
-                with self.op_tracker.create_op(
-                        f"osd_op({msg.kind}) client={peer}") as op:
-                    with self._lock:
-                        op.mark_event("reached_pg")
-                        blob = self._client_op(msg.kind, msg.blob)
-                    op.mark_event("commit_sent")
-                self.perf.inc("op")
-                self.perf.inc("op_r" if msg.kind in self._READ_KINDS
-                              else "op_w")
-                self.perf.inc("op_in_bytes", len(msg.blob))
-                self.perf.inc("op_out_bytes", len(blob))
+                blob = self._one_client_op(peer, msg.kind, msg.blob)
             rep = MOSDOpReply(msg.req_id, True, msg.kind, blob)
         except Exception as e:   # noqa: BLE001 — reply, don't die
             rep = MOSDOpReply(msg.req_id, False, msg.kind,
@@ -1577,6 +1879,19 @@ class OSDDaemon:
             self.msgr.send(peer, rep)
         except (KeyError, OSError, ConnectionError):
             pass
+
+    def _one_client_op(self, peer: str, kind: str, body: bytes) -> bytes:
+        with self.op_tracker.create_op(
+                f"osd_op({kind}) client={peer}") as op:
+            with self._lock:
+                op.mark_event("reached_pg")
+                blob = self._client_op(kind, body)
+            op.mark_event("commit_sent")
+        self.perf.inc("op")
+        self.perf.inc("op_r" if kind in self._READ_KINDS else "op_w")
+        self.perf.inc("op_in_bytes", len(body))
+        self.perf.inc("op_out_bytes", len(blob))
+        return blob
 
     SNAP_SEP = "@@snap."
 
@@ -1718,14 +2033,57 @@ class OSDDaemon:
             self._check_snapc(d.u64())
             objs = d.mapping(Decoder.string, Decoder.blob)
             self._snap_guard(ps, be, objs)
+
+            def _meta_extra(wave_names):
+                # the PG metadata rides the write fan-out transaction
+                # itself (the pg_log-inside-the-transaction
+                # discipline): one wave persists bytes AND the
+                # metadata that proves them, halving the write path's
+                # frame count vs the old separate _persist_meta pass.
+                # Steady state ships a BOUNDED DELTA (entries since
+                # the last full blob + applied cursors, O(window));
+                # the full O(objects-in-PG) base goes out every
+                # _META_DELTA_MAX entries — without this, per-write
+                # metadata cost grows linearly with PG object count
+                # and the write path degrades quadratically over a
+                # sustained workload. Snap-era state (snapsets/births
+                # beyond era 0) isn't delta-encoded: any pool with
+                # snaps takes the full-persist path every time,
+                # keeping COW restore semantics byte-identical.
+                ent, base_head = self._meta_delta.get(ps, ([], -1))
+                ent = ent + [(n, be.object_versions[n],
+                              be.object_sizes[n]) for n in wave_names]
+                full = (base_head < 0
+                        or len(ent) >= _META_DELTA_MAX
+                        or self.osdmap.pools[1].snap_seq > 0
+                        or self.snapsets.get(ps)
+                        or self.obj_kv.get(ps))
+                if full:
+                    blob = self._encode_meta(ps)
+                    self._meta_delta[ps] = ([], be.pg_log.head)
+                    kv = {PG_META_KEY: blob, PG_META_DELTA_KEY: b""}
+                else:
+                    self._meta_delta[ps] = (ent, base_head)
+                    kv = {PG_META_DELTA_KEY:
+                          self._encode_meta_delta(ps)}
+
+                def add(shard, t):
+                    t.omap_set(shard_cid(be.pg, shard),
+                               "__pg_meta__", kv)
+                return add
+            fused = isinstance(be, ECBackend)
+            kw = {"shard_txn_extra": _meta_extra} if fused else {}
             try:
-                be.write_objects(objs, dead_osds=set(self.suspect))
+                be.write_objects(objs, dead_osds=set(self.suspect),
+                                 **kw)
             except (ConnectionError, OSError):
                 # a shard holder died mid-fan-out: mark it suspect and
                 # retry once degraded; the client write must not bounce
                 self._mark_suspects(be)
-                be.write_objects(objs, dead_osds=set(self.suspect))
-            self._persist_meta(ps)
+                be.write_objects(objs, dead_osds=set(self.suspect),
+                                 **kw)
+            if not fused:
+                self._persist_meta(ps)
             return b""
         if kind == "remove":
             self._check_snapc(d.u64())
@@ -1744,6 +2102,19 @@ class OSDDaemon:
             name = d.string()
             data = be.read_object(name, dead_osds=set(self.suspect))
             return np.asarray(data, np.uint8).tobytes()
+        if kind == "readv":
+            # batched read: ONE decode launch serves the whole name
+            # group (read_objects stacks equal-length groups), where
+            # per-name ops would launch one decode each
+            names = d.list(Decoder.string)
+            for n in names:
+                if n not in be.object_sizes:
+                    raise KeyError(n)
+            got = be.read_objects(names, dead_osds=set(self.suspect))
+            e = Encoder()
+            e.list([np.asarray(got[n], np.uint8).tobytes()
+                    for n in names], Encoder.blob_ref)
+            return e.bytes()
         if kind == "snap_read":
             name, sid = d.string(), d.u64()
             data = self._snap_resolve(ps, be, name, sid)
@@ -2870,17 +3241,39 @@ def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str,
     raise AuthError(f"authorize to {peer} did not converge")
 
 
+class _WireOp:
+    """One client op's retry state inside _run_ops."""
+
+    __slots__ = ("kind", "ps", "body_fn", "blob", "last", "done",
+                 "fatal")
+
+    def __init__(self, kind: str, ps: int, body_fn):
+        self.kind, self.ps, self.body_fn = kind, ps, body_fn
+        self.blob: bytes = b""
+        self.last = None
+        self.done = False
+        self.fatal: BaseException | None = None
+
+
 class Client:
     """librados over the wire: locate the PG from the cached map, talk
-    to its primary, retry on map change / primary death."""
+    to its primary, retry on map change / primary death. Ops dispatch
+    through a windowed in-flight pipeline (`window` ops / `window_bytes`
+    payload budget) with per-primary frame coalescing — see
+    _run_ops."""
 
     def __init__(self, cluster: "StandaloneCluster", name: str = "client",
                  entity: str = "client.admin",
-                 secret: bytes | None = None):
+                 secret: bytes | None = None,
+                 window: int | None = None,
+                 window_bytes: int = 64 << 20):
         self.c = cluster
         self.msgr = Messenger(name, secret=cluster.secret,
                               compress=cluster.compress)
-        self.rpc = _Rpc(self.msgr, MOSDOpReply.type_id)
+        self.rpc = _Rpc(self.msgr, MOSDOpReply.type_id,
+                        window=cluster.op_window if window is None
+                        else window,
+                        window_bytes=window_bytes)
         self.osdmap: OSDMap | None = None
         self._lock = threading.Lock()
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
@@ -2947,52 +3340,141 @@ class Client:
 
     def _op(self, kind: str, ps: int, body_fn, timeout=None,
             retries=30, retry_sleep=0.3) -> bytes:
+        op = _WireOp(kind, ps, body_fn)
+        self._run_ops([op], timeout=timeout, retries=retries,
+                      retry_sleep=retry_sleep)
+        return op.blob
+
+    def _encode_op_body(self, op: "_WireOp") -> list:
+        e = Encoder()
+        e.u32(op.ps)
+        op.body_fn(e)
+        return e.segments()
+
+    def _settle(self, op: "_WireOp", ok: bool, blob: bytes, err: str,
+                tgt: str, need_auth: set) -> None:
+        """Fold one reply (or batch sub-reply) into the op's retry
+        state — the same decision table the sequential _op loop ran."""
+        if ok:
+            op.blob, op.done = blob, True
+            return
+        op.last = err
+        if err == "EPERM:unauthenticated":
+            # first contact with this daemon (or it restarted):
+            # establish the cephx session and retry the op
+            need_auth.add(tgt)
+            return
+        if err.startswith("EPERM:denied"):
+            # caps refusal is deterministic; retrying is useless
+            op.fatal = PermissionError(err)
+            return
+        if err.startswith("ClsError:"):
+            # a class method REFUSED the op (EBUSY-style):
+            # deterministic, retrying can't change the answer
+            from .objclass import ClsError
+            op.fatal = ClsError(err[9:])
+            return
+        if err.startswith("KeyError"):
+            # no-such-object is deterministic at the primary that
+            # answered: retry sleeps cannot make a deleted object
+            # reappear
+            op.fatal = KeyError(err[9:] or err)
+            return
+        # anything else is transport-shaped: retarget and retry
+
+    def _run_ops(self, ops: list["_WireOp"], timeout=None,
+                 retries=30, retry_sleep=0.3) -> None:
+        """Pipelined dispatch of many ops: every round, outstanding
+        ops are grouped by their CURRENT primary, ops sharing a
+        primary coalesce into one `batch` frame, and all frames go
+        out through the windowed rpc before any reply is awaited — so
+        a client batch really has window-many ops on the wire (the
+        Objecter's in-flight pipeline, ref: src/osdc/Objecter.cc
+        op_submit + the objecter_inflight_ops window). Retry/error
+        semantics per op are identical to the old one-op loop."""
         if timeout is None:
             timeout = self.c.op_timeout + 8.0   # server-side retry room
-        last = None
         for _ in range(retries):
-            e = Encoder()
-            e.u32(ps)
-            body_fn(e)
-            try:
-                rep = self.rpc.call(
-                    self._primary(ps),
-                    lambda rid: MOSDOp(rid, True, kind, e.bytes()),
-                    timeout=timeout)
-                if rep.ok:
-                    return rep.blob
-                last = rep.err
-                if rep.err == "EPERM:unauthenticated":
-                    # first contact with this daemon (or it restarted):
-                    # establish the cephx session and retry the op
-                    self._authorize(self._primary(ps))
+            outstanding = [op for op in ops
+                           if not op.done and op.fatal is None]
+            if not outstanding:
+                break
+            by_tgt: dict[str, list[_WireOp]] = {}
+            for op in outstanding:
+                try:
+                    by_tgt.setdefault(self._primary(op.ps),
+                                      []).append(op)
+                except ConnectionError as e:
+                    op.last = str(e)   # no primary yet: wait for map
+            handles = []
+            for tgt, group in by_tgt.items():
+                if len(group) == 1:
+                    op = group[0]
+                    body = self._encode_op_body(op)
+                    nbytes = sum(len(s) for s in body)
+                    pend = self.rpc.submit(
+                        tgt, lambda rid, k=op.kind, b=body:
+                        MOSDOp(rid, True, k, b), nbytes=nbytes)
+                else:
+                    # coalesce: one frame carries every outstanding op
+                    # for this primary (small-op dispatch stops paying
+                    # a round trip per PG)
+                    e = Encoder()
+                    e.u32(len(group))
+                    for op in group:
+                        e.string(op.kind)
+                        e.blob_ref(self._encode_op_body(op))
+                    body = e.segments()
+                    nbytes = sum(len(s) for s in body)
+                    pend = self.rpc.submit(
+                        tgt, lambda rid, b=body:
+                        MOSDOp(rid, True, "batch", b), nbytes=nbytes)
+                handles.append((tgt, group, pend))
+            need_auth: set[str] = set()
+            for tgt, group, pend in handles:
+                try:
+                    rep = pend.wait(timeout)
+                except (ConnectionError, KeyError, OSError) as err:
+                    for op in group:
+                        op.last = str(err)
                     continue
-                if rep.err.startswith("EPERM:denied"):
-                    # caps refusal is deterministic; retrying is
-                    # useless. NB: raised outside the except clause
-                    # below — PermissionError IS an OSError and must
-                    # not be swallowed as a transport hiccup.
-                    raise PermissionError(rep.err)
-                if rep.err.startswith("ClsError:"):
-                    # a class method REFUSED the op (EBUSY-style):
-                    # deterministic, retrying can't change the answer
-                    from .objclass import ClsError
-                    raise ClsError(rep.err[9:])
-                if rep.err.startswith("KeyError"):
-                    # no-such-object is deterministic at the primary
-                    # that answered: 30 retry sleeps cannot make a
-                    # deleted object reappear — break to the final
-                    # KeyError raise (an inline raise would be eaten
-                    # by the transport-retry except below)
-                    break
-            except PermissionError:
-                raise
-            except (ConnectionError, KeyError, OSError) as err:
-                last = str(err)
-            time.sleep(retry_sleep)   # map may be in flight; retarget
-        if str(last).startswith("KeyError:"):
-            raise KeyError(str(last)[9:])
-        raise ConnectionError(f"op {kind} pg 1.{ps} failed: {last}")
+                if rep.ok and len(group) > 1:
+                    d = Decoder(rep.blob)
+                    subs = d.list(lambda dd: (dd.boolean(), dd.blob(),
+                                              dd.string()))
+                    for op, (ok, blob, err) in zip(group, subs):
+                        self._settle(op, ok, blob, err, tgt, need_auth)
+                elif rep.ok:
+                    self._settle(group[0], True, rep.blob, "", tgt,
+                                 need_auth)
+                else:
+                    for op in group:
+                        self._settle(op, False, b"", rep.err, tgt,
+                                     need_auth)
+            for tgt in need_auth:
+                try:
+                    self._authorize(tgt)
+                except PermissionError:
+                    raise          # caps refusal is deterministic
+                except (ConnectionError, KeyError, OSError):
+                    pass   # daemon unreachable: the round retries
+            remaining = [op for op in ops
+                         if not op.done and op.fatal is None]
+            if not remaining:
+                break
+            if not need_auth:
+                time.sleep(retry_sleep)   # map may be in flight
+        for op in ops:
+            if op.fatal is not None and not isinstance(op.fatal,
+                                                       KeyError):
+                raise op.fatal
+        for op in ops:
+            if op.fatal is not None:
+                raise op.fatal
+        for op in ops:
+            if not op.done:
+                raise ConnectionError(
+                    f"op {op.kind} pg 1.{op.ps} failed: {op.last}")
 
     def _snapc(self) -> int:
         """The client's snap context (ref: MOSDOp SnapContext): every
@@ -3005,15 +3487,39 @@ class Client:
         for name, data in objects.items():
             ps = self.osdmap.object_to_pg(1, name)[1]
             by_pg.setdefault(ps, {})[name] = bytes(data)
-        for ps, group in by_pg.items():
-            self._op("write", ps,
-                     lambda e, g=group: e.u64(self._snapc()).mapping(
-                         g, Encoder.string, Encoder.blob))
+        # one op per PG, ALL pipelined through the window (and ops
+        # landing on the same primary coalesce into one frame); the
+        # data blobs ride by reference from here to sendmsg
+        self._run_ops([
+            _WireOp("write", ps,
+                    lambda e, g=group: e.u64(self._snapc()).mapping(
+                        g, Encoder.string, Encoder.blob_ref))
+            for ps, group in by_pg.items()])
 
     def read(self, name: str) -> bytes:
         ps = self.osdmap.object_to_pg(1, name)[1]
         return self._op("read", ps,
                         lambda e: e.string(name))
+
+    def read_many(self, names) -> dict[str, bytes]:
+        """Batched reads: ONE multi-name op per PG (the daemon decodes
+        the whole group in one batched launch), all PG ops pipelined
+        through the window with per-primary coalescing (the librados
+        aio_read batch role). Raises KeyError if any name is absent."""
+        names = list(names)
+        by_pg: dict[int, list[str]] = {}
+        for name in names:
+            ps = self.osdmap.object_to_pg(1, name)[1]
+            by_pg.setdefault(ps, []).append(name)
+        ops = {ps: _WireOp("readv", ps,
+                           lambda e, g=group: e.list(g, Encoder.string))
+               for ps, group in by_pg.items()}
+        self._run_ops(list(ops.values()))
+        out: dict[str, bytes] = {}
+        for ps, group in by_pg.items():
+            blobs = Decoder(ops[ps].blob).list(Decoder.blob)
+            out.update(zip(group, blobs))
+        return {n: out[n] for n in names}
 
     def remove(self, names) -> None:
         """Delete objects (a LOGGED mutation: a shard down across
@@ -3025,10 +3531,11 @@ class Client:
         for name in names:
             ps = self.osdmap.object_to_pg(1, name)[1]
             by_pg.setdefault(ps, []).append(name)
-        for ps, group in by_pg.items():
-            self._op("remove", ps,
-                     lambda e, g=group: e.u64(self._snapc()).list(
-                         g, Encoder.string))
+        self._run_ops([
+            _WireOp("remove", ps,
+                    lambda e, g=group: e.u64(self._snapc()).list(
+                        g, Encoder.string))
+            for ps, group in by_pg.items()])
 
     # -- pool snapshots over the wire ----------------------------------------
 
@@ -3165,7 +3672,8 @@ class StandaloneCluster:
                  compress: str | None = None, cephx: bool = False,
                  hb_interval: float = 0.25, hb_grace: float = 1.2,
                  min_reporters: int = 2, op_timeout: float = 8.0,
-                 chunk_size: int = 256, verbose: bool | None = None):
+                 chunk_size: int = 256, verbose: bool | None = None,
+                 op_window: int = 8):
         import os as _os
         if verbose is None:
             verbose = bool(_os.environ.get("STANDALONE_VERBOSE"))
@@ -3201,6 +3709,9 @@ class StandaloneCluster:
         self.hb_interval, self.hb_grace = hb_interval, hb_grace
         self.min_reporters = min_reporters
         self.op_timeout = op_timeout
+        # client-side in-flight op window (ops; see Client/_Rpc —
+        # 0 disables pipelining, restoring one-op-per-round-trip)
+        self.op_window = op_window
         self.chunk_size = chunk_size
         self.verbose = verbose
         self.profile = profile

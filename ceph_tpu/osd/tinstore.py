@@ -142,7 +142,14 @@ def _encode_op(e: Encoder, op: tuple) -> None:
     elif kind in ("touch", "remove", "omap_clear"):
         e.string(op[1]).string(op[2])
     elif kind == "write":
-        e.string(op[1]).string(op[2]).u64(op[3]).blob(op[4].tobytes())
+        # data by REFERENCE (no tobytes copy): the buffer rides the
+        # encoder's segment list; wire callers keep it alive/unmodified
+        # until the frame is acked (the bufferlist aliasing contract),
+        # WAL callers join immediately via bytes()
+        import numpy as _np
+        data = _np.ascontiguousarray(op[4], _np.uint8)
+        e.string(op[1]).string(op[2]).u64(op[3]) \
+            .blob_ref(memoryview(data).cast("B"))
     elif kind == "truncate":
         e.string(op[1]).string(op[2]).u64(op[3])
     elif kind == "setattr":
@@ -189,6 +196,17 @@ def _encode_txn(txn: Transaction) -> bytes:
     e.list(txn.ops, _encode_op)
     e.finish()
     return e.bytes()
+
+
+def _encode_txn_iov(txn: Transaction) -> list:
+    """Segment-list form for the wire path: shard data buffers
+    travel by reference from the transaction straight through
+    MStoreOp framing to sendmsg — zero payload copies."""
+    e = Encoder()
+    e.start(1, 1)
+    e.list(txn.ops, _encode_op)
+    e.finish()
+    return e.segments()
 
 
 def _decode_txn(body: bytes) -> Transaction:

@@ -26,11 +26,25 @@ class EncodingError(ValueError):
 
 
 class Encoder:
-    """Append-only byte builder (the `bufferlist& bl` role)."""
+    """Append-only byte builder (the `bufferlist& bl` role).
+
+    Scatter-gather aware: `blob_ref` appends a caller buffer BY
+    REFERENCE (the bufferlist::append(bufferptr) role — no copy), so a
+    message carrying a large data payload encodes as a list of
+    segments: small bytearray chunks of framing fields interleaved
+    with zero-copy views of the payload. `bytes()` still joins to one
+    contiguous buffer for callers that need it; `segments()` hands the
+    raw part list to the messenger's sendmsg path. Buffers appended by
+    reference must stay unmodified until the encoded message is fully
+    sent (and, on the lossless messenger, acked) — the same aliasing
+    contract a bufferlist imposes."""
 
     def __init__(self):
         self._buf = bytearray()
-        self._sections: list[int] = []  # offsets of open length slots
+        self._parts: list = []          # finalized parts (bytearray/mv)
+        self._starts: list[int] = []    # absolute offset of each part
+        self._base = 0                  # total bytes in finalized parts
+        self._sections: list[int] = []  # ABS offsets of open length slots
 
     # -- primitives ---------------------------------------------------------
 
@@ -70,6 +84,29 @@ class Encoder:
         self._buf += b
         return self
 
+    def blob_ref(self, b) -> "Encoder":
+        """Length-prefixed blob appended BY REFERENCE: `b` is one
+        buffer (bytes/bytearray/memoryview) or a list of them. Wire
+        bytes are identical to `blob(joined)`; no payload copy is
+        made. The caller must keep the buffers unmodified until the
+        encoded message has been transmitted (and acked on lossless
+        transports)."""
+        parts = b if isinstance(b, (list, tuple)) else (b,)
+        self.u32(sum(len(p) for p in parts))
+        for p in parts:
+            if len(p) == 0:
+                continue
+            if self._buf:
+                self._parts.append(self._buf)
+                self._starts.append(self._base)
+                self._base += len(self._buf)
+                self._buf = bytearray()
+            mv = p if isinstance(p, memoryview) else memoryview(p)
+            self._parts.append(mv)
+            self._starts.append(self._base)
+            self._base += len(mv)
+        return self
+
     def string(self, s: str) -> "Encoder":
         return self.blob(s.encode("utf-8"))
 
@@ -93,7 +130,7 @@ class Encoder:
         if compat > version:
             raise EncodingError(f"compat {compat} > version {version}")
         self.u8(version).u8(compat)
-        self._sections.append(len(self._buf))
+        self._sections.append(self._base + len(self._buf))
         self.u32(0)  # length slot, patched by finish()
         return self
 
@@ -101,22 +138,62 @@ class Encoder:
         if not self._sections:
             raise EncodingError("finish() without start()")
         at = self._sections.pop()
-        body_len = len(self._buf) - at - 4
-        self._buf[at:at + 4] = struct.pack("<I", body_len)
+        body_len = self._base + len(self._buf) - at - 4
+        self._patch_u32(at, body_len)
         return self
+
+    def _patch_u32(self, at: int, value: int) -> None:
+        """Patch 4 bytes at absolute offset `at`. The slot is always
+        inside a bytearray part: blob_ref only flushes the current
+        chunk AFTER writing the length prefix, and the 4-byte slot is
+        written contiguously into one chunk."""
+        packed = struct.pack("<I", value)
+        if at >= self._base:
+            self._buf[at - self._base:at - self._base + 4] = packed
+            return
+        import bisect
+        i = bisect.bisect_right(self._starts, at) - 1
+        part = self._parts[i]
+        off = at - self._starts[i]
+        part[off:off + 4] = packed
+
+    def __len__(self) -> int:
+        return self._base + len(self._buf)
 
     def bytes(self) -> bytes:
         if self._sections:
             raise EncodingError(f"{len(self._sections)} unfinished "
                                 f"section(s)")
-        return bytes(self._buf)
+        if not self._parts:
+            return bytes(self._buf)
+        return b"".join(self._parts) + bytes(self._buf)
+
+    def segments(self) -> list:
+        """The encoded message as its raw part list (zero-copy where
+        blob_ref was used). Joining the parts equals bytes() exactly.
+        The encoder must not be appended to afterwards."""
+        if self._sections:
+            raise EncodingError(f"{len(self._sections)} unfinished "
+                                f"section(s)")
+        if self._buf:
+            self._parts.append(self._buf)
+            self._starts.append(self._base)
+            self._base += len(self._buf)
+            self._buf = bytearray()
+        return list(self._parts)
 
 
 class Decoder:
     """Cursor over bytes (the `bufferlist::const_iterator` role)."""
 
-    def __init__(self, data: bytes):
-        self._buf = memoryview(bytes(data))
+    def __init__(self, data):
+        # bytes/bytearray/memoryview wrap zero-copy (the receive path
+        # hands in a view over the frame body); anything else (numpy,
+        # etc.) materializes once
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            self._buf = memoryview(data)
+        else:
+            self._buf = memoryview(bytes(data))
         self._off = 0
         self._ends: list[int] = []  # section end offsets
 

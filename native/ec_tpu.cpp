@@ -470,6 +470,361 @@ uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, int64_t len) {
   return reg;
 }
 
+// ---------------------------------------------------------------------
+// AES-256-GCM via AES-NI + PCLMUL — bit-identical to the `cryptography`
+// wheel's AESGCM (it's the same NIST algorithm), so an environment
+// with the wheel and one using this path interoperate on the wire.
+// Compiled with per-function target attributes so the .so still builds
+// on machines without the ISA; callers must gate on
+// ec_aes256gcm_supported() (returns 0 there, and seal/open return -2).
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define EC_HAVE_AESNI_BUILD 1
+
+__attribute__((target("aes,ssse3")))
+static void aes256_expand(const uint8_t key[32], __m128i rk[15]) {
+  rk[0] = _mm_loadu_si128((const __m128i*)key);
+  rk[1] = _mm_loadu_si128((const __m128i*)(key + 16));
+#define EC_A1(prev2, ka)                                               \
+  ({                                                                   \
+    __m128i a = prev2;                                                 \
+    __m128i t = _mm_shuffle_epi32(ka, 0xff);                           \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    _mm_xor_si128(a, t);                                               \
+  })
+#define EC_A2(prev2, prev1)                                            \
+  ({                                                                   \
+    __m128i a = prev2;                                                 \
+    __m128i t = _mm_shuffle_epi32(                                     \
+        _mm_aeskeygenassist_si128(prev1, 0), 0xaa);                    \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    a = _mm_xor_si128(a, _mm_slli_si128(a, 4));                        \
+    _mm_xor_si128(a, t);                                               \
+  })
+  rk[2] = EC_A1(rk[0], _mm_aeskeygenassist_si128(rk[1], 0x01));
+  rk[3] = EC_A2(rk[1], rk[2]);
+  rk[4] = EC_A1(rk[2], _mm_aeskeygenassist_si128(rk[3], 0x02));
+  rk[5] = EC_A2(rk[3], rk[4]);
+  rk[6] = EC_A1(rk[4], _mm_aeskeygenassist_si128(rk[5], 0x04));
+  rk[7] = EC_A2(rk[5], rk[6]);
+  rk[8] = EC_A1(rk[6], _mm_aeskeygenassist_si128(rk[7], 0x08));
+  rk[9] = EC_A2(rk[7], rk[8]);
+  rk[10] = EC_A1(rk[8], _mm_aeskeygenassist_si128(rk[9], 0x10));
+  rk[11] = EC_A2(rk[9], rk[10]);
+  rk[12] = EC_A1(rk[10], _mm_aeskeygenassist_si128(rk[11], 0x20));
+  rk[13] = EC_A2(rk[11], rk[12]);
+  rk[14] = EC_A1(rk[12], _mm_aeskeygenassist_si128(rk[13], 0x40));
+#undef EC_A1
+#undef EC_A2
+}
+
+__attribute__((target("aes,ssse3")))
+static inline __m128i aes256_enc_block(const __m128i rk[15], __m128i b) {
+  b = _mm_xor_si128(b, rk[0]);
+  for (int i = 1; i < 14; ++i) b = _mm_aesenc_si128(b, rk[i]);
+  return _mm_aesenclast_si128(b, rk[14]);
+}
+
+// GF(2^128) carry-less multiply + reduction on byte-reflected blocks
+// (the Intel GCM white-paper "gfmul" sequence).
+__attribute__((target("pclmul,ssse3")))
+static inline __m128i ec_gfmul(__m128i a, __m128i b) {
+  __m128i tmp3 = _mm_clmulepi64_si128(a, b, 0x00);
+  __m128i tmp4 = _mm_clmulepi64_si128(a, b, 0x10);
+  __m128i tmp5 = _mm_clmulepi64_si128(a, b, 0x01);
+  __m128i tmp6 = _mm_clmulepi64_si128(a, b, 0x11);
+  tmp4 = _mm_xor_si128(tmp4, tmp5);
+  tmp5 = _mm_slli_si128(tmp4, 8);
+  tmp4 = _mm_srli_si128(tmp4, 8);
+  tmp3 = _mm_xor_si128(tmp3, tmp5);
+  tmp6 = _mm_xor_si128(tmp6, tmp4);
+  __m128i tmp7 = _mm_srli_epi32(tmp3, 31);
+  __m128i tmp8 = _mm_srli_epi32(tmp6, 31);
+  tmp3 = _mm_slli_epi32(tmp3, 1);
+  tmp6 = _mm_slli_epi32(tmp6, 1);
+  __m128i tmp9 = _mm_srli_si128(tmp7, 12);
+  tmp8 = _mm_slli_si128(tmp8, 4);
+  tmp7 = _mm_slli_si128(tmp7, 4);
+  tmp3 = _mm_or_si128(tmp3, tmp7);
+  tmp6 = _mm_or_si128(tmp6, tmp8);
+  tmp6 = _mm_or_si128(tmp6, tmp9);
+  tmp7 = _mm_slli_epi32(tmp3, 31);
+  tmp8 = _mm_slli_epi32(tmp3, 30);
+  tmp9 = _mm_slli_epi32(tmp3, 25);
+  tmp7 = _mm_xor_si128(tmp7, tmp8);
+  tmp7 = _mm_xor_si128(tmp7, tmp9);
+  tmp8 = _mm_srli_si128(tmp7, 4);
+  tmp7 = _mm_slli_si128(tmp7, 12);
+  tmp3 = _mm_xor_si128(tmp3, tmp7);
+  __m128i tmp2 = _mm_srli_epi32(tmp3, 1);
+  tmp4 = _mm_srli_epi32(tmp3, 2);
+  tmp5 = _mm_srli_epi32(tmp3, 7);
+  tmp2 = _mm_xor_si128(tmp2, tmp4);
+  tmp2 = _mm_xor_si128(tmp2, tmp5);
+  tmp2 = _mm_xor_si128(tmp2, tmp8);
+  tmp3 = _mm_xor_si128(tmp3, tmp2);
+  return _mm_xor_si128(tmp6, tmp3);
+}
+
+// 256-bit carry-less product without reduction (for the aggregated
+// 4-block GHASH), plus the reduction step shared with ec_gfmul.
+__attribute__((target("pclmul,ssse3")))
+static inline void ec_clmul256(__m128i a, __m128i b, __m128i* hi,
+                               __m128i* lo) {
+  __m128i t0 = _mm_clmulepi64_si128(a, b, 0x00);
+  __m128i t1 = _mm_clmulepi64_si128(a, b, 0x10);
+  __m128i t2 = _mm_clmulepi64_si128(a, b, 0x01);
+  __m128i t3 = _mm_clmulepi64_si128(a, b, 0x11);
+  t1 = _mm_xor_si128(t1, t2);
+  *lo = _mm_xor_si128(t0, _mm_slli_si128(t1, 8));
+  *hi = _mm_xor_si128(t3, _mm_srli_si128(t1, 8));
+}
+
+// Reduce a 256-bit (hi:lo) carry-less product modulo the GHASH
+// polynomial — the tail of the Intel white-paper gfmul sequence.
+__attribute__((target("pclmul,ssse3")))
+static inline __m128i ec_gfred(__m128i tmp6, __m128i tmp3) {
+  __m128i tmp7 = _mm_srli_epi32(tmp3, 31);
+  __m128i tmp8 = _mm_srli_epi32(tmp6, 31);
+  tmp3 = _mm_slli_epi32(tmp3, 1);
+  tmp6 = _mm_slli_epi32(tmp6, 1);
+  __m128i tmp9 = _mm_srli_si128(tmp7, 12);
+  tmp8 = _mm_slli_si128(tmp8, 4);
+  tmp7 = _mm_slli_si128(tmp7, 4);
+  tmp3 = _mm_or_si128(tmp3, tmp7);
+  tmp6 = _mm_or_si128(tmp6, tmp8);
+  tmp6 = _mm_or_si128(tmp6, tmp9);
+  tmp7 = _mm_slli_epi32(tmp3, 31);
+  tmp8 = _mm_slli_epi32(tmp3, 30);
+  tmp9 = _mm_slli_epi32(tmp3, 25);
+  tmp7 = _mm_xor_si128(tmp7, tmp8);
+  tmp7 = _mm_xor_si128(tmp7, tmp9);
+  tmp8 = _mm_srli_si128(tmp7, 4);
+  tmp7 = _mm_slli_si128(tmp7, 12);
+  tmp3 = _mm_xor_si128(tmp3, tmp7);
+  __m128i tmp2 = _mm_srli_epi32(tmp3, 1);
+  __m128i tmp4 = _mm_srli_epi32(tmp3, 2);
+  __m128i tmp5 = _mm_srli_epi32(tmp3, 7);
+  tmp2 = _mm_xor_si128(tmp2, tmp4);
+  tmp2 = _mm_xor_si128(tmp2, tmp5);
+  tmp2 = _mm_xor_si128(tmp2, tmp8);
+  tmp3 = _mm_xor_si128(tmp3, tmp2);
+  return _mm_xor_si128(tmp6, tmp3);
+}
+
+struct EcGcmCtx {
+  __m128i rk[15];
+  __m128i h;        // byte-reflected hash subkey
+  __m128i h2, h3, h4;  // H^2..H^4 for the aggregated 4-block GHASH
+  __m128i y;        // running GHASH state (byte-reflected)
+  __m128i bswap;
+};
+
+__attribute__((target("aes,pclmul,ssse3")))
+static void ec_gcm_init(EcGcmCtx* c, const uint8_t key[32]) {
+  aes256_expand(key, c->rk);
+  c->bswap = _mm_set_epi8(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
+                          14, 15);
+  __m128i h = aes256_enc_block(c->rk, _mm_setzero_si128());
+  c->h = _mm_shuffle_epi8(h, c->bswap);
+  c->h2 = ec_gfmul(c->h, c->h);
+  c->h3 = ec_gfmul(c->h2, c->h);
+  c->h4 = ec_gfmul(c->h3, c->h);
+  c->y = _mm_setzero_si128();
+}
+
+__attribute__((target("aes,pclmul,ssse3")))
+static void ec_ghash_update(EcGcmCtx* c, const uint8_t* data, int64_t len) {
+  __m128i y = c->y;
+  // aggregated 4-block form: ((Y^X1)·H^4) ^ (X2·H^3) ^ (X3·H^2) ^
+  // (X4·H) with the four products accumulated carry-lessly and ONE
+  // reduction — same value as four chained gfmuls, ~2x fewer shifts
+  while (len >= 64) {
+    __m128i x1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)data),
+                                  c->bswap);
+    __m128i x2 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 16)), c->bswap);
+    __m128i x3 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 32)), c->bswap);
+    __m128i x4 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(data + 48)), c->bswap);
+    __m128i hi, lo, hi2, lo2;
+    ec_clmul256(_mm_xor_si128(y, x1), c->h4, &hi, &lo);
+    ec_clmul256(x2, c->h3, &hi2, &lo2);
+    hi = _mm_xor_si128(hi, hi2);
+    lo = _mm_xor_si128(lo, lo2);
+    ec_clmul256(x3, c->h2, &hi2, &lo2);
+    hi = _mm_xor_si128(hi, hi2);
+    lo = _mm_xor_si128(lo, lo2);
+    ec_clmul256(x4, c->h, &hi2, &lo2);
+    hi = _mm_xor_si128(hi, hi2);
+    lo = _mm_xor_si128(lo, lo2);
+    y = ec_gfred(hi, lo);
+    data += 64;
+    len -= 64;
+  }
+  while (len >= 16) {
+    __m128i x = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)data),
+                                 c->bswap);
+    y = ec_gfmul(_mm_xor_si128(y, x), c->h);
+    data += 16;
+    len -= 16;
+  }
+  if (len > 0) {
+    uint8_t block[16] = {0};
+    for (int64_t i = 0; i < len; ++i) block[i] = data[i];
+    __m128i x = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)block),
+                                 c->bswap);
+    y = ec_gfmul(_mm_xor_si128(y, x), c->h);
+  }
+  c->y = y;
+}
+
+// CTR keystream XOR with the GCM 32-bit big-endian counter increment,
+// 4 blocks in flight to fill the AES-NI pipeline.
+__attribute__((target("aes,pclmul,ssse3")))
+static void ec_gcm_ctr_xor(EcGcmCtx* c, const uint8_t nonce[12],
+                           uint32_t ctr_start, const uint8_t* in,
+                           uint8_t* out, int64_t len) {
+  uint8_t ctrblk[16];
+  for (int i = 0; i < 12; ++i) ctrblk[i] = nonce[i];
+  uint32_t ctr = ctr_start;
+  while (len > 0) {
+    __m128i ks[4];
+    int nblk = (int)((len + 15) / 16);
+    if (nblk > 4) nblk = 4;
+    for (int b = 0; b < nblk; ++b) {
+      ctrblk[12] = (uint8_t)(ctr >> 24);
+      ctrblk[13] = (uint8_t)(ctr >> 16);
+      ctrblk[14] = (uint8_t)(ctr >> 8);
+      ctrblk[15] = (uint8_t)ctr;
+      ++ctr;
+      ks[b] = _mm_xor_si128(_mm_loadu_si128((const __m128i*)ctrblk),
+                            c->rk[0]);
+    }
+    for (int i = 1; i < 14; ++i)
+      for (int b = 0; b < nblk; ++b) ks[b] = _mm_aesenc_si128(ks[b], c->rk[i]);
+    for (int b = 0; b < nblk; ++b) ks[b] = _mm_aesenclast_si128(ks[b], c->rk[14]);
+    for (int b = 0; b < nblk && len > 0; ++b) {
+      if (len >= 16) {
+        _mm_storeu_si128(
+            (__m128i*)out,
+            _mm_xor_si128(_mm_loadu_si128((const __m128i*)in), ks[b]));
+        in += 16;
+        out += 16;
+        len -= 16;
+      } else {
+        uint8_t kb[16];
+        _mm_storeu_si128((__m128i*)kb, ks[b]);
+        for (int64_t i = 0; i < len; ++i) out[i] = in[i] ^ kb[i];
+        len = 0;
+      }
+    }
+  }
+}
+
+__attribute__((target("aes,pclmul,ssse3")))
+static void ec_gcm_tag(EcGcmCtx* c, const uint8_t nonce[12],
+                       int64_t aad_len, int64_t ct_len, uint8_t tag[16]) {
+  uint8_t lens[16];
+  uint64_t ab = (uint64_t)aad_len * 8, cb = (uint64_t)ct_len * 8;
+  for (int i = 0; i < 8; ++i) {
+    lens[i] = (uint8_t)(ab >> (56 - 8 * i));
+    lens[8 + i] = (uint8_t)(cb >> (56 - 8 * i));
+  }
+  ec_ghash_update(c, lens, 16);
+  uint8_t j0[16];
+  for (int i = 0; i < 12; ++i) j0[i] = nonce[i];
+  j0[12] = j0[13] = j0[14] = 0;
+  j0[15] = 1;
+  __m128i ek = aes256_enc_block(c->rk, _mm_loadu_si128((const __m128i*)j0));
+  __m128i t = _mm_xor_si128(_mm_shuffle_epi8(c->y, c->bswap), ek);
+  _mm_storeu_si128((__m128i*)tag, t);
+}
+
+__attribute__((target("aes,pclmul,ssse3")))
+static int ec_aes256gcm_seal_impl(const uint8_t* key, const uint8_t* nonce,
+                                  const uint8_t* aad, int64_t aad_len,
+                                  const uint8_t* in, int64_t len,
+                                  uint8_t* out) {
+  EcGcmCtx c;
+  ec_gcm_init(&c, key);
+  ec_ghash_update(&c, aad, aad_len);
+  ec_gcm_ctr_xor(&c, nonce, 2, in, out, len);
+  ec_ghash_update(&c, out, len);
+  ec_gcm_tag(&c, nonce, aad_len, len, out + len);
+  return 0;
+}
+
+__attribute__((target("aes,pclmul,ssse3")))
+static int ec_aes256gcm_open_impl(const uint8_t* key, const uint8_t* nonce,
+                                  const uint8_t* aad, int64_t aad_len,
+                                  const uint8_t* in, int64_t len,
+                                  uint8_t* out) {
+  if (len < 16) return -1;
+  int64_t ct_len = len - 16;
+  EcGcmCtx c;
+  ec_gcm_init(&c, key);
+  ec_ghash_update(&c, aad, aad_len);
+  ec_ghash_update(&c, in, ct_len);
+  uint8_t tag[16];
+  ec_gcm_tag(&c, nonce, aad_len, ct_len, tag);
+  uint8_t diff = 0;
+  for (int i = 0; i < 16; ++i) diff |= (uint8_t)(tag[i] ^ in[ct_len + i]);
+  if (diff != 0) return -1;
+  ec_gcm_ctr_xor(&c, nonce, 2, in, out, ct_len);
+  return 0;
+}
+#endif  // x86
+
+extern "C" {
+
+int ec_aes256gcm_supported() {
+#ifdef EC_HAVE_AESNI_BUILD
+  return __builtin_cpu_supports("aes") && __builtin_cpu_supports("pclmul") &&
+         __builtin_cpu_supports("ssse3");
+#else
+  return 0;
+#endif
+}
+
+// NIST AES-256-GCM (96-bit nonce): out = ciphertext(len) || tag(16).
+// Returns 0, or -2 when the CPU lacks AES-NI/PCLMUL (gate on
+// ec_aes256gcm_supported()).
+int ec_aes256gcm_seal(const uint8_t* key, const uint8_t* nonce,
+                      const uint8_t* aad, int64_t aad_len, const uint8_t* in,
+                      int64_t len, uint8_t* out) {
+#ifdef EC_HAVE_AESNI_BUILD
+  if (!ec_aes256gcm_supported()) return -2;
+  return ec_aes256gcm_seal_impl(key, nonce, aad, aad_len, in, len, out);
+#else
+  (void)key; (void)nonce; (void)aad; (void)aad_len; (void)in; (void)len;
+  (void)out;
+  return -2;
+#endif
+}
+
+// Returns 0 and fills out (len-16 bytes), -1 on tag mismatch, -2 when
+// unsupported.
+int ec_aes256gcm_open(const uint8_t* key, const uint8_t* nonce,
+                      const uint8_t* aad, int64_t aad_len, const uint8_t* in,
+                      int64_t len, uint8_t* out) {
+#ifdef EC_HAVE_AESNI_BUILD
+  if (!ec_aes256gcm_supported()) return -2;
+  return ec_aes256gcm_open_impl(key, nonce, aad, aad_len, in, len, out);
+#else
+  (void)key; (void)nonce; (void)aad; (void)aad_len; (void)in; (void)len;
+  (void)out;
+  return -2;
+#endif
+}
+
+}  // extern "C"
+
 // ABI-shape parity with the reference's plugin entry point. The real
 // registry lives in the host process (Python side); this records the
 // name so probes see a live symbol with the expected signature.

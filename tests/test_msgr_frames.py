@@ -1,0 +1,218 @@
+"""Frame equivalence: the scatter-gather framing path must emit wire
+bytes BIT-IDENTICAL to the pre-PR single-buffer framing in every mode
+(crc / secure / compressed) — no protocol break, so mixed old/new
+peers interoperate and the lossless replay/dedup machinery is
+untouched. The legacy reference implementation lives HERE, frozen, as
+the oracle."""
+
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from ceph_tpu.msgr.messenger import (_COMP_FLAG, _COMPRESS_MIN, _GCM_TAG,
+                                     _NONCE, COMP_NONE, COMP_ZLIB,
+                                     _Conn, _crc, _SecureBox)
+from tests.test_msgr import Ping, pair, wait_for
+
+SECRET = b"0123456789abcdef0123456789abcdef"
+KEY = b"K" * 32
+
+
+def legacy_frame(seq: int, type_id: int, payload: bytes,
+                 comp: int = COMP_NONE,
+                 box: "_SecureBox | None" = None) -> bytes:
+    """The pre-scatter-gather framing algorithm, verbatim: build each
+    frame by concatenating bytes (struct.pack + payload, then += crc),
+    compressing/sealing the joined buffer."""
+    if comp == COMP_ZLIB and len(payload) >= _COMPRESS_MIN:
+        packed = zlib.compress(payload, 1)
+        if len(packed) < len(payload):
+            payload = packed
+            type_id |= _COMP_FLAG
+    plain = struct.pack("<QH", seq, type_id) + payload
+    if box is None:
+        frame = struct.pack("<I", len(plain)) + plain
+        frame += struct.pack("<I", _crc(frame))
+        return frame
+    hdr = struct.pack("<I", _NONCE + len(plain) + _GCM_TAG)
+    return hdr + box.seal(plain, hdr)
+
+
+def capture_frame(seq: int, type_id: int, payload,
+                  comp: int = COMP_NONE, box=None) -> bytes:
+    """Run the REAL _Conn.send_frame into a socketpair and return the
+    exact bytes that hit the wire."""
+    a, b = socket.socketpair()
+    try:
+        conn = _Conn(a, box=box, comp=comp)
+        got = bytearray()
+        done = threading.Event()
+
+        def drain():
+            b.settimeout(5)
+            try:
+                while True:
+                    chunk = b.recv(1 << 16)
+                    if not chunk:
+                        break
+                    got.extend(chunk)
+            except (socket.timeout, OSError):
+                pass
+            done.set()
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        conn.send_frame(seq, type_id, payload)
+        a.shutdown(socket.SHUT_WR)
+        done.wait(10)
+        return bytes(got)
+    finally:
+        a.close()
+        b.close()
+
+
+PAYLOADS = [
+    b"",
+    b"x",
+    b"hello world" * 3,
+    bytes(range(256)) * 64,          # 16 KiB, incompressible-ish
+    b"A" * 4096,                     # compressible, over _COMPRESS_MIN
+    bytes(200),                      # zeros over the min size
+]
+
+
+def segmentations(payload: bytes):
+    """Several ways to slice the same payload into segments."""
+    yield payload                                 # single buffer
+    yield [payload]                               # one-element list
+    if len(payload) > 2:
+        cut = len(payload) // 3
+        yield [payload[:cut], payload[cut:]]
+        yield [payload[:1], payload[1:cut], payload[cut:]]
+        yield [memoryview(payload)[:cut], memoryview(payload)[cut:]]
+    yield [b"", payload, b""]                     # empty segments
+
+
+class TestFrameEquivalence:
+    @pytest.mark.parametrize("comp", [COMP_NONE, COMP_ZLIB],
+                             ids=["plain", "zlib"])
+    def test_crc_mode_bit_identical(self, comp):
+        for pi, payload in enumerate(PAYLOADS):
+            want = legacy_frame(3 + pi, 0x70, payload, comp=comp)
+            for si, segs in enumerate(segmentations(payload)):
+                got = capture_frame(3 + pi, 0x70, segs, comp=comp)
+                assert got == want, (pi, si)
+
+    @pytest.mark.parametrize("comp", [COMP_NONE, COMP_ZLIB],
+                             ids=["plain", "zlib"])
+    def test_secure_mode_bit_identical(self, comp):
+        for pi, payload in enumerate(PAYLOADS):
+            # two boxes with the same key/prefix/counter produce the
+            # same nonce + ciphertext — deterministic oracle
+            box_old = _SecureBox(KEY, b"cli\x00", b"srv\x00")
+            want = legacy_frame(9 + pi, 0x70, payload, comp=comp,
+                                box=box_old)
+            for si, segs in enumerate(segmentations(payload)):
+                box_new = _SecureBox(KEY, b"cli\x00", b"srv\x00")
+                got = capture_frame(9 + pi, 0x70, segs, comp=comp,
+                                    box=box_new)
+                assert got == want, (pi, si)
+
+    def test_legacy_sender_interops_with_new_receiver(self):
+        """An old-framing peer's bytes must decode on today's read
+        loop: write a legacy-built frame straight onto a live
+        connection and see it dispatched."""
+        a, b = pair()
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(1))
+            assert wait_for(lambda: got == [1])
+            conn = next(iter(a._conns.values()))
+            from ceph_tpu.utils.encoding import Encoder
+            e = Encoder()
+            Ping(2, "legacy").encode_payload(e)
+            with conn.wlock:
+                conn.sock.sendall(legacy_frame(2, Ping.type_id,
+                                               e.bytes()))
+            assert wait_for(lambda: got == [1, 2]), got
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_mid_frame_kill_replays_exactly_once(self):
+        """A connection dying mid-frame (partial header+body on the
+        wire) must kill the session, and the lossless replay must
+        redeliver the victim message exactly once."""
+        a, b = pair()
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.stamp))
+            a.send("osd.1", Ping(1))
+            assert wait_for(lambda: got == [1])
+            conn = next(iter(a._conns.values()))
+            # half a legit frame, then kill the socket under it
+            frame = legacy_frame(99, Ping.type_id, b"payload-bytes")
+            with conn.wlock:
+                conn.sock.sendall(frame[:len(frame) // 2])
+            conn.close()
+            time.sleep(0.05)
+            for i in (2, 3):
+                a.send("osd.1", Ping(i))
+            assert a.flush("osd.1", timeout=15)
+            assert wait_for(lambda: got == [1, 2, 3]), got
+            time.sleep(0.3)
+            assert got == [1, 2, 3]   # replay stayed exactly-once
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_injection_composes_with_segment_payloads(self):
+        """ms_inject_socket_failures + segment-list payloads: teardown
+        every 3rd send; every message still arrives exactly once."""
+        a, b = pair()
+        try:
+            got = []
+            lock = threading.Lock()
+
+            def h(p, m):
+                with lock:
+                    got.append(m.stamp)
+            b.register_handler(Ping.type_id, h)
+            a.seed_injection(7)
+            a.set_inject_socket_failures(3)
+            for i in range(30):
+                a.send("osd.1", Ping(i, note="Z" * 2048))
+            assert a.flush("osd.1", timeout=30)
+            assert wait_for(lambda: len(got) == 30), len(got)
+            assert sorted(got) == list(range(30))
+            assert len(set(got)) == 30
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+class TestSecureEquivalenceLive:
+    """End-to-end: a secure pair exchanging segment-encoded messages
+    still authenticates/decrypts — the staged-seal path is live, not
+    just the capture harness."""
+
+    def test_roundtrip(self):
+        a, b = pair(secret_a=SECRET, secret_b=SECRET)
+        try:
+            got = []
+            b.register_handler(Ping.type_id,
+                               lambda p, m: got.append(m.note))
+            big = "S" * 30000
+            for i in range(4):
+                a.send("osd.1", Ping(i, note=big))
+            assert wait_for(lambda: len(got) == 4)
+            assert all(n == big for n in got)
+        finally:
+            a.shutdown()
+            b.shutdown()

@@ -57,6 +57,14 @@ def main(argv=None) -> None:
     ap.add_argument("--batch", type=int, default=8,
                     help="objects per client op (batched writes are "
                          "the TPU-native unit of work)")
+    ap.add_argument("--window", type=int, default=8,
+                    help="standalone transport: client in-flight op "
+                         "window cap (0 = uncapped; 1 restores "
+                         "one-op-per-round-trip)")
+    ap.add_argument("--insecure", action="store_true",
+                    help="standalone transport: crc frames, no cephx "
+                         "(measures the secure-mode delta; the "
+                         "committed config keeps security ON)")
     ap.add_argument("--transport", choices=["sim", "standalone"],
                     default="sim",
                     help="sim: hermetic in-process SimCluster; "
@@ -82,7 +90,9 @@ def main(argv=None) -> None:
             c = StandaloneCluster(
                 n_osds=args.num_osds, pg_num=args.pg_num,
                 profile=profile, chunk_size=4096,
-                secret=_os.urandom(32), cephx=True, op_timeout=15.0)
+                secret=None if args.insecure else _os.urandom(32),
+                cephx=not args.insecure, op_timeout=15.0,
+                op_window=args.window)
         except ValueError as e:
             raise SystemExit(f"rados_bench: {e}")
         c.wait_for_clean(timeout=30)
@@ -97,7 +107,7 @@ def main(argv=None) -> None:
 
             @staticmethod
             def read(names):
-                return {n: wire_client.read(n) for n in names}
+                return wire_client.read_many(names)
         ob = _WireOb()
     else:
         from ceph_tpu.client.rados import Rados
@@ -116,14 +126,38 @@ def main(argv=None) -> None:
             0, 256, args.object_size, np.uint8)
             for j in range(args.batch)}
 
+    def warm_buckets(write_fn, read_fn=None):
+        """Compile every bucketed launch shape INSIDE warmup: random
+        scatter alone can leave a power-of-two bucket cold, and one
+        XLA compile mid-window (~1.5 s on a 1-core CPU host) wrecks
+        the percentiles. Uses names that all hash to one PG so group
+        sizes 1/2/4/batch are hit deterministically."""
+        if args.transport != "standalone":
+            return
+        same_pg, i = [], 0
+        while len(same_pg) < args.batch and i < 10000:
+            nm = f"warmpg-{i}"
+            i += 1
+            if wire_client.osdmap.object_to_pg(1, nm)[1] == 0:
+                same_pg.append(nm)
+        sizes = sorted({1, 2, 4, max(1, args.batch)})
+        for s in sizes:
+            write_fn({nm: rng.integers(0, 256, args.object_size,
+                                       np.uint8)
+                      for nm in same_pg[:s]})
+        if read_fn is not None:
+            for s in sizes:
+                read_fn(same_pg[:s])
+
     lat: list[float] = []
     nobj = 0
     if args.workload == "write":
         # jit compile outside the window: objects scatter over PGs in
-        # per-PG sub-batches whose sizes bucket to powers of two, so a
-        # few warmup rounds cover the compile cache
+        # per-PG sub-batches whose sizes bucket to powers of two —
+        # warm every bucket deterministically, then a few full rounds
         for wi in range(3):
             ob.write(batch(f"warmup{wi}"))
+        warm_buckets(ob.write)
         t_start = time.perf_counter()
         t_end = t_start + args.seconds
         i = 0
@@ -145,6 +179,7 @@ def main(argv=None) -> None:
             objs = batch(i)
             ob.write(objs)
             staged.update(objs)
+        warm_buckets(ob.write, ob.read)
         names = sorted(staged)
         t0_all = time.perf_counter()
         t_end = t0_all + args.seconds
@@ -169,6 +204,20 @@ def main(argv=None) -> None:
         "ops_per_s": round(len(lat) / dt, 1),
         "objects_per_s": round(nobj / dt, 1),
         **percentiles(lat),
+        # machine-readable run config, same shape bench.py commits in
+        # wire_rados_bench["config"] — CI diffs the whole dict
+        "config": {
+            "transport": args.transport,
+            "cephx": args.transport == "standalone"
+            and not args.insecure,
+            "secure": args.transport == "standalone"
+            and not args.insecure,
+            "object_size": args.object_size, "batch": args.batch,
+            "window": args.window
+            if args.transport == "standalone" else None,
+            "n_osds": args.num_osds, "pg_num": args.pg_num,
+            "pool": args.pool, "profile": profile,
+        },
         "note": ("standalone wire cluster: real sockets, cephx auth, "
                  "AES-GCM secure frames — measures the messenger+EC "
                  "stack on localhost"

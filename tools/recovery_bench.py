@@ -33,6 +33,11 @@ def main(argv=None) -> None:
     ap.add_argument("--lost", type=int, default=1)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--no-verify-hinfo", action="store_true")
+    ap.add_argument("--warm", action="store_true",
+                    help="run one recovery before the timed/traced one "
+                         "so jit compiles are out of frame — the "
+                         "steady-state pipeline (stage/launch/fetch "
+                         "overlap) is what the trace then shows")
     ap.add_argument("--trace", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the recovery "
                          "phase into DIR (view with tensorboard/xprof; "
@@ -68,6 +73,16 @@ def main(argv=None) -> None:
     for s in lost:
         cluster.stores.pop(be.acting[s], None)
     repl = {s: 1000 + s for s in lost}
+
+    if args.warm:
+        # compile + rebuild once, then re-lose the shards so the
+        # measured/traced recovery hits every jit cache
+        be.recover_shards(lost, replacement_osds=repl,
+                          batch=args.batch,
+                          verify_hinfo=not args.no_verify_hinfo)
+        for s in lost:
+            cluster.stores.pop(be.acting[s], None)
+        repl = {s: 2000 + s for s in lost}
 
     from ceph_tpu.utils.tracing import trace
     t0 = time.perf_counter()
