@@ -336,6 +336,23 @@ class ClientAuth:
         # never the reverse.
         self._lock = threading.RLock()
         self._io_lock = threading.Lock()
+        # declared ticket-lifecycle counters ("cephx" logger): a daemon
+        # nests them in its perf dump; single-flight-wait accounting
+        # (refreshes deferred because one was already running) lives
+        # at the daemon, which owns that gate
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("cephx")
+                     .add_u64_counter("logins",
+                                      "hello/authenticate rounds run")
+                     .add_u64_counter("ticket_fetches",
+                                      "service-ticket fetch rounds")
+                     .add_u64_counter("ticket_relogins",
+                                      "fetch rounds that re-logged in "
+                                      "(auth ticket aged/rotated out)")
+                     .add_time_avg("fetch_time",
+                                   "fetch_tickets wall time incl. "
+                                   "monitor hunt")
+                     .create_perf_counters())
 
     def login(self) -> None:
         with self._io_lock:
@@ -360,12 +377,15 @@ class ClientAuth:
                 raise
             break
         sk = _unseal(self.secret, _ub(got["enc_session_key"]))
+        self.perf.inc("logins")
         with self._lock:
             self.session_key = _ub(sk["session_key"])
             self._auth_ticket = got["ticket"]
 
     def fetch_tickets(self, services: list[str]) -> None:
+        t0 = _time.perf_counter()
         with self._io_lock:
+            self.perf.inc("ticket_fetches")
             with self._lock:
                 need_login = self.session_key is None
             if need_login:
@@ -386,6 +406,7 @@ class ClientAuth:
                     # path; a genuine refusal stays terminal
                     if attempt == 0 and ("expired" in str(e)
                                          or "rotated out" in str(e)):
+                        self.perf.inc("ticket_relogins")
                         self._login_io()
                         continue
                     raise
@@ -398,6 +419,7 @@ class ClientAuth:
                               "ticket": entry["ticket"]}
             with self._lock:
                 self._svc.update(fresh)
+        self.perf.tinc("fetch_time", _time.perf_counter() - t0)
 
     def has_ticket(self, service: str) -> bool:
         """Is a cached, unexpired `service` ticket present? Zero I/O:

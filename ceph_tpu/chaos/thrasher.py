@@ -118,6 +118,12 @@ class Thrasher:
 
     def _log(self, msg: str) -> None:
         self.schedule.append(msg)
+        # every fault event ALSO rides the gathered log ring with the
+        # seed stamped in, so `ceph daemon <name> log dump` over the
+        # admin socket reconstructs the fault timeline mid-chaos —
+        # interleaved with the daemons' own events in one clock
+        from ..utils.log import dout
+        dout("chaos", 1, f"thrash seed={self.seed} {msg}")
         if self.verbose:
             print(f"thrash[{self.seed}]: {msg}", flush=True)
 

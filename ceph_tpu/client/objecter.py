@@ -55,6 +55,9 @@ class Objecter:
                      .add_u64_counter("op_resend")
                      .add_u64_counter("map_refresh")
                      .add_u64_counter("throttle_blocked_bytes")
+                     .add_time_avg("op_latency",
+                                   "submit-to-reply wall time incl. "
+                                   "resends")
                      .create_perf_counters())
         self._epoch = -1
         self._primaries: dict[int, int] = {}
@@ -103,30 +106,36 @@ class Objecter:
         (the while loop is _op_submit's resend-on-new-map path).
         `snapc` is the newest snap id the caller's SnapContext names
         (selfmanaged-snap pools; 0 = no snaps follow this writer)."""
-        from ..osd.cluster import StaleMap
+        from ..utils.tracing import span
         cost = self._payload_bytes(kind, payload)
         if cost and not self.op_throttle.get_or_fail(cost):
             self.perf.inc("throttle_blocked_bytes", cost)
             self.op_throttle.get(cost)  # block until in-flight drains
         try:
-            for attempt in range(self.MAX_ATTEMPTS):
-                primary = self._primaries.get(ps, -1)
-                self.perf.inc("op_send")
-                if attempt:
-                    self.perf.inc("op_resend")
-                try:
-                    with self._dispatch_lock:
-                        return self.cluster.client_rpc(
-                            primary, self._epoch, kind, ps, payload,
-                            snapc=snapc)
-                except StaleMap:
-                    self._refresh()
-            raise ObjecterError(
-                f"op on pg {ps} still untargetable after "
-                f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
+            with span(f"objecter.{kind}", counters=self.perf,
+                      key="op_latency"):
+                return self._submit_inner(kind, ps, payload, snapc)
         finally:
             if cost:
                 self.op_throttle.put(cost)
+
+    def _submit_inner(self, kind: str, ps: int, payload, snapc: int):
+        from ..osd.cluster import StaleMap
+        for attempt in range(self.MAX_ATTEMPTS):
+            primary = self._primaries.get(ps, -1)
+            self.perf.inc("op_send")
+            if attempt:
+                self.perf.inc("op_resend")
+            try:
+                with self._dispatch_lock:
+                    return self.cluster.client_rpc(
+                        primary, self._epoch, kind, ps, payload,
+                        snapc=snapc)
+            except StaleMap:
+                self._refresh()
+        raise ObjecterError(
+            f"op on pg {ps} still untargetable after "
+            f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
 
     def write(self, objects: dict[str, bytes | np.ndarray],
               snapc: int = 0) -> None:

@@ -476,6 +476,27 @@ class TinDB(KeyValueDB):
         self.stats = {"gets": 0, "iterators": 0, "flushes": 0,
                       "compactions": 0, "submitted": 0,
                       "wal_replayed": 0}
+        # declared counter mirror of `stats` plus byte/time detail —
+        # what a daemon nests under "tindb" in its perf dump and what
+        # MgrReports aggregate (the RocksDB statistics -> perf
+        # counters bridge the reference's BlueStore maintains)
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("tindb")
+                     .add_u64_counter("wal_records",
+                                      "transaction batches appended")
+                     .add_u64_counter("wal_bytes",
+                                      "bytes appended to the WAL")
+                     .add_u64_counter("wal_replayed",
+                                      "records replayed at mount")
+                     .add_u64_counter("flushes", "memtable flushes")
+                     .add_u64_counter("compactions", "level merges")
+                     .add_u64_counter("gets", "point lookups")
+                     .add_u64_counter("iterators", "range scans opened")
+                     .add_time_avg("submit_time",
+                                   "submit_transaction wall time")
+                     .add_time_avg("compact_time",
+                                   "per-merge compaction wall time")
+                     .create_perf_counters())
         os.makedirs(path, exist_ok=True)
         if mount:
             self.mount()
@@ -597,6 +618,7 @@ class TinDB(KeyValueDB):
             for op in _decode_batch(body):
                 self._mem_apply(op)
             self.stats["wal_replayed"] += 1
+            self.perf.inc("wal_replayed")
             self._seq = seq
 
     def crash(self) -> None:
@@ -686,17 +708,24 @@ class TinDB(KeyValueDB):
             yield k
 
     def submit_transaction(self, txn: KVTransaction) -> None:
+        import time as _time
+        t0 = _time.perf_counter()
         with self._lock:
             self._alive()
             ops = self._expand(txn)
             self._seq += 1
-            append_wal_record(self._wal_f, self._seq,
-                              _encode_batch(ops), self.o_dsync)
+            body = _encode_batch(ops)
+            append_wal_record(self._wal_f, self._seq, body,
+                              self.o_dsync)
             for op in ops:
                 self._mem_apply(op)
             self.stats["submitted"] += 1
+            self.perf.inc_many(
+                (("wal_records", 1),
+                 ("wal_bytes", _REC_HDR.size + len(body) + 4)))
             if self._mem_bytes >= self.memtable_max_bytes:
                 self.flush()
+        self.perf.tinc("submit_time", _time.perf_counter() - t0)
 
     # -- flush + compaction --------------------------------------------------
 
@@ -728,6 +757,7 @@ class TinDB(KeyValueDB):
                     self._levels.append([])
                 self._levels[0].append(Segment(path))
                 self.stats["flushes"] += 1
+                self.perf.inc("flushes")
             # covered_seq must equal the last written seq whenever the
             # WAL is truncated — even for an empty memtable (a no-op
             # batch still consumed a seq; replay after the reset must
@@ -756,6 +786,8 @@ class TinDB(KeyValueDB):
         (newer wins per key; tombstones dropped iff the output is the
         deepest level). Readers are never blocked: old segments stay
         readable through open fds until their objects die."""
+        import time as _time
+        t0 = _time.perf_counter()
         with self._lock:
             self._alive()
             if i >= len(self._levels) or not self._levels[i]:
@@ -785,6 +817,8 @@ class TinDB(KeyValueDB):
                 except OSError:
                     pass
             self.stats["compactions"] += 1
+            self.perf.inc("compactions")
+        self.perf.tinc("compact_time", _time.perf_counter() - t0)
 
     def compact(self) -> None:
         """Full compaction (the `ceph-kvstore-tool compact` role):
@@ -803,6 +837,7 @@ class TinDB(KeyValueDB):
         with self._lock:
             self._alive()
             self.stats["gets"] += 1
+            self.perf.inc("gets")
             full = combine_key(prefix, key)
             if full in self._mem:
                 return self._mem[full]
@@ -820,6 +855,7 @@ class TinDB(KeyValueDB):
         with self._lock:
             self._alive()
             self.stats["iterators"] += 1
+            self.perf.inc("iterators")
             snap = self.snapshot()
         return snap.iterate(prefix, start, end)
 

@@ -57,10 +57,40 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time as _time_mod
 from collections import deque
 
 from ..csum.reference import ceph_crc32c, ceph_crc32c_iov
 from ..utils.encoding import Decoder, Encoder
+from ..utils.perf_counters import PerfCountersBuilder
+
+
+def msgr_perf_counters():
+    """The messenger's counter schema (ref: AsyncMessenger's
+    msgr_send/recv counters in src/msg/async/Stack.h, dumped as the
+    `AsyncMessenger::Worker-*` loggers). One instance per Messenger;
+    a daemon nests it under "msgr" in its perf dump."""
+    return (PerfCountersBuilder("msgr")
+            .add_u64_counter("msg_tx", "logical messages sent")
+            .add_u64_counter("msg_rx", "messages delivered upward")
+            .add_u64_counter("frames_tx", "wire frames written")
+            .add_u64_counter("frames_rx", "wire frames read")
+            .add_u64_counter("bytes_tx", "wire bytes written")
+            .add_u64_counter("bytes_rx", "wire bytes read")
+            .add_u64_counter("segments_tx",
+                             "gather segments written (zero-copy iov)")
+            .add_u64_counter("acks_tx", "cumulative ACK frames sent")
+            .add_u64_counter("acks_rx", "cumulative ACK frames received")
+            .add_u64_counter("dup_rx", "replayed duplicates dropped")
+            .add_u64_counter("reconnects", "outbound dials completed")
+            .add_u64_counter("replayed", "unacked frames replayed")
+            .add_u64_counter("tx_compressed", "frames compressed on tx")
+            .add_u64_counter("rx_compressed", "frames inflated on rx")
+            .add_time_avg("crc_time", "frame crc32c compute (crc mode)")
+            .add_time_avg("seal_time",
+                          "AEAD seal incl. staging (secure mode)")
+            .add_time_avg("open_time", "AEAD open (secure mode)")
+            .create_perf_counters())
 
 BANNER = b"ceph_tpu msgr v2\n"
 ACK_TYPE = 0
@@ -267,11 +297,13 @@ class _Conn:
     def __init__(self, sock: socket.socket, box: _SecureBox | None = None,
                  peer_inst: bytes = b"", comp: int = COMP_NONE,
                  stats: dict | None = None,
-                 stats_lock: threading.Lock | None = None):
+                 stats_lock: threading.Lock | None = None,
+                 perf=None):
         self.sock = sock
         self.wlock = threading.Lock()
         self.alive = True
         self.box = box
+        self.perf = perf
         # receive-side cumulative-ack cursor: highest peer seq this
         # side has ACKED on this conn (reader + ack flusher both
         # advance it; acks are idempotent so the benign race costs at
@@ -294,6 +326,7 @@ class _Conn:
         segs = list(payload) if isinstance(payload, (list, tuple)) \
             else [payload]
         plen = sum(len(s) for s in segs)
+        is_ack = type_id == ACK_TYPE
         if self.comp == COMP_ZLIB and plen >= _COMPRESS_MIN:
             import zlib
             packed = zlib.compress(_flatten(segs), 1)
@@ -304,14 +337,22 @@ class _Conn:
                 with self.stats_lock:
                     self.stats["tx_compressed"] = \
                         self.stats.get("tx_compressed", 0) + 1
+                if self.perf is not None:
+                    self.perf.inc("tx_compressed")
         if self.box is None:
             # [u32 len][u64 seq][u16 type] packs to the same 14 bytes
             # the two-step concat produced; the crc is a seeded
             # continuation over header + payload segments — no join
             hdr = struct.pack("<IQH", 10 + plen, seq, type_id)
+            t0 = _time_mod.perf_counter() if self.perf is not None else 0.0
             crc = struct.pack("<I", _crc_iov([hdr] + segs))
+            if self.perf is not None:
+                self.perf.tinc("crc_time",
+                               _time_mod.perf_counter() - t0)
             with self.wlock:
                 _sendmsg_all(self.sock, [hdr] + segs + [crc])
+            wire = 14 + plen + 4
+            nseg = len(segs)
         else:
             with self.wlock:
                 # seal under the lock: the nonce counter must advance
@@ -319,10 +360,21 @@ class _Conn:
                 # one. AEAD needs contiguous input: stage ONE buffer.
                 hdr = struct.pack(
                     "<I", _NONCE + 10 + plen + _GCM_TAG)
+                t0 = _time_mod.perf_counter() \
+                    if self.perf is not None else 0.0
                 plain = _flatten(
                     [struct.pack("<QH", seq, type_id)] + segs)
-                _sendmsg_all(self.sock,
-                             [hdr, self.box.seal(plain, hdr)])
+                sealed = self.box.seal(plain, hdr)
+                if self.perf is not None:
+                    self.perf.tinc("seal_time",
+                                   _time_mod.perf_counter() - t0)
+                _sendmsg_all(self.sock, [hdr, sealed])
+            wire = 4 + _NONCE + 10 + plen + _GCM_TAG
+            nseg = 1
+        if self.perf is not None:
+            self.perf.inc_many((("frames_tx", 1), ("bytes_tx", wire),
+                                ("segments_tx", nseg))
+                               + ((("acks_tx", 1),) if is_ack else ()))
 
     def close(self) -> None:
         self.alive = False
@@ -359,6 +411,9 @@ class Messenger:
         self._comp_id = _COMP_IDS[compress]
         self.stats: dict[str, int] = {}
         self._stats_lock = threading.Lock()
+        # per-messenger counters (a daemon nests this under "msgr" in
+        # its perf dump; ref: the AsyncMessenger worker loggers)
+        self.perf = msgr_perf_counters()
         self.mode = MODE_SECURE if secret is not None else MODE_CRC
         # instance cookie (ref: ProtocolV2 client/server cookies +
         # RESET_SESSION): a rebooted process reuses its NAME but not
@@ -528,7 +583,8 @@ class Messenger:
             return
         self._check_incarnation(peer, peer_inst)   # post-validation
         conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
-                     stats=self.stats, stats_lock=self._stats_lock)
+                     stats=self.stats, stats_lock=self._stats_lock,
+                     perf=self.perf)
         # adopt+replay must be one atomic step under the peer lock:
         # published-but-not-yet-replayed is a window where a concurrent
         # send() (which holds only the peer lock) could emit a NEW
@@ -553,6 +609,7 @@ class Messenger:
             try:
                 for seq, tid, payload in pending:
                     conn.send_frame(seq, tid, payload)
+                    self.perf.inc("replayed")
             except (OSError, ConnectionError):
                 pass  # conn died again; next reconnect replays
 
@@ -631,8 +688,10 @@ class Messenger:
                     _derive_key(self.secret, nonce_c, nonce_s),
                     tx_prefix=_PREFIX_CLI, rx_prefix=_PREFIX_SRV)
             self._check_incarnation(peer, peer_inst)  # post-validation
+            self.perf.inc("reconnects")
             conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
-                         stats=self.stats, stats_lock=self._stats_lock)
+                         stats=self.stats, stats_lock=self._stats_lock,
+                         perf=self.perf)
             if not self._adopt(peer, conn, inbound=False):
                 # a crossing dial won (we're the non-designated side):
                 # the WINNING connection carries the session now — put
@@ -733,6 +792,7 @@ class Messenger:
         # here through sendmsg — the unacked queue keeps the same list
         # for replay, so the aliasing contract extends until the ack
         payload = e.segments()
+        self.perf.inc("msg_tx")
         # ms_inject_socket_failures (ref: src/msg/Messenger.h debug
         # knob): every Nth send tears the live socket down FIRST, so
         # this message and any unacked predecessors must survive
@@ -859,14 +919,24 @@ class Messenger:
                 body = read_exact(blen)
                 if conn.box is None:
                     (crc,) = struct.unpack("<I", read_exact(4))
+                    t0 = _time_mod.perf_counter()
                     if _crc_iov([raw_len, body]) != crc:
                         # ProtocolV2 crc mode: corrupt frame kills the
                         # session; replay redelivers after reconnect
                         raise ConnectionError("frame crc mismatch")
+                    self.perf.tinc("crc_time",
+                                   _time_mod.perf_counter() - t0)
+                    self.perf.inc_many((("frames_rx", 1),
+                                        ("bytes_rx", 8 + blen)))
                 else:
                     # secure mode: the GCM tag is the integrity check
                     # (and the length header is bound in as AAD)
+                    t0 = _time_mod.perf_counter()
                     body = conn.box.open(body, raw_len)
+                    self.perf.tinc("open_time",
+                                   _time_mod.perf_counter() - t0)
+                    self.perf.inc_many((("frames_rx", 1),
+                                        ("bytes_rx", 4 + blen)))
                 seq, tid = struct.unpack_from("<QH", body)
                 # zero-copy view over the payload (Decoder accepts a
                 # memoryview; blob fields copy out only what they keep)
@@ -894,6 +964,7 @@ class Messenger:
                     with self._stats_lock:
                         self.stats["rx_compressed"] = \
                             self.stats.get("rx_compressed", 0) + 1
+                    self.perf.inc("rx_compressed")
                 # incarnation fencing: a conn authenticated against a
                 # peer incarnation that is no longer current must not
                 # touch session state — a dying incarnation's buffered
@@ -910,6 +981,7 @@ class Messenger:
                     if len(payload) != 8:
                         raise ConnectionError("malformed ACK frame")
                     (acked,) = struct.unpack("<Q", payload)
+                    self.perf.inc("acks_rx")
                     with self._lock:
                         q = self._unacked.get(peer)
                         while q and q[0][0] <= acked:
@@ -921,6 +993,8 @@ class Messenger:
                         self._in_seq[peer] = seq
                         deliver = True  # else: replayed dup, drop
                     ack_seq = self._in_seq.get(peer, 0)
+                if not deliver:
+                    self.perf.inc("dup_rx")
                 # coalesced cumulative ack: every ACK_BATCH frames
                 # inline, the rest via the ~2ms flusher — replies
                 # never wait on acks (they only retire the sender's
@@ -936,6 +1010,7 @@ class Messenger:
                 else:
                     self._ack_event.set()
                 if deliver:
+                    self.perf.inc("msg_rx")
                     cls = _MSG_TYPES.get(tid)
                     handler = self._handlers.get(tid)
                     if cls is not None and handler is not None:

@@ -77,7 +77,7 @@ class StreamingCodec:
     """
 
     def __init__(self, matrix: np.ndarray, impl: str = DEFAULT_IMPL,
-                 tile: int = 1 << 20, depth: int = 2):
+                 tile: int = 1 << 20, depth: int = 2, perf=None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
@@ -85,6 +85,10 @@ class StreamingCodec:
         self.tile = int(tile)
         self.depth = depth  # in-flight tiles (double buffering = 2)
         self._fn = make_encoder(matrix, impl)
+        # optional instrumentation: a PerfCounters with
+        # stream_launches / stream_bytes / stream_drain_time declared
+        # (the daemon's "ec" logger fits; None = uncounted)
+        self.perf = perf
         # reusable ragged-tail staging buffer: allocated once per
         # (B, k, tile) shape instead of a fresh zeroed array per
         # encode call's tail tile
@@ -115,7 +119,12 @@ class StreamingCodec:
             # this tile was already started at launch, so by the time
             # the pipeline is `depth` deep this is mostly a wait
             off, ln, dev = entry
-            out[:, :, off:off + ln] = jax.device_get(dev)[:, :, :ln]
+            if self.perf is not None:
+                with self.perf.time("stream_drain_time"):
+                    out[:, :, off:off + ln] = \
+                        jax.device_get(dev)[:, :, :ln]
+            else:
+                out[:, :, off:off + ln] = jax.device_get(dev)[:, :, :ln]
 
         for ti in range(n_tiles):
             off = ti * tl
@@ -136,6 +145,9 @@ class StreamingCodec:
             # and the result's D2H copy starts NOW instead of when
             # drain() blocks on it
             dev = self._fn(jax.device_put(src))
+            if self.perf is not None:
+                self.perf.inc_many((("stream_launches", 1),
+                                    ("stream_bytes", int(src.size))))
             try:
                 dev.copy_to_host_async()
             except AttributeError:

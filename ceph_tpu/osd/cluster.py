@@ -242,8 +242,12 @@ class SimCluster:
         self._pg_primary: dict[int, int] = {}
         # per-op stage tracking on the client path (ref: OpTracker/
         # TrackedOp, dump_historic_ops on the admin socket)
+        from ..utils.config import g_conf
         from ..utils.op_tracker import OpTracker
-        self.op_tracker = OpTracker()
+        # thresholds resolve through the process config, so
+        # osd_op_complaint_time / osd_op_history_* apply to the sim
+        # tier's tracker the same way they do per wire daemon
+        self.op_tracker = OpTracker(config=g_conf)
         self.perf = (PerfCountersBuilder("cluster")
                      .add_u64_counter("recovered_objects")
                      .add_u64_counter("log_replayed_objects")

@@ -45,10 +45,59 @@ import numpy as np
 
 from ..ec.interface import ErasureCode
 from ..ec.registry import factory
+from ..utils.perf_counters import PerfCountersBuilder
 from ..utils.tracing import span
 from .memstore import MemStore, Transaction
 from .pgbackend import HINFO_KEY, PGBackend, shard_cid  # noqa: F401
 from .stripe import HashInfo, StripeInfo, as_flat_u8
+
+
+def ec_perf_counters():
+    """The EC data-path counter schema (logger "ec"). A daemon builds
+    ONE instance and shares it across every PG backend it primaries
+    (per-PG loggers would explode the metric space); standalone
+    harnesses (recovery_bench) read the backend's own default."""
+    return (PerfCountersBuilder("ec")
+            .add_u64_counter("encode_launches",
+                             "generic encode device launches")
+            .add_u64_counter("fused_write_launches",
+                             "fused encode+crc single launches")
+            .add_u64_counter("decode_launches",
+                             "read-path decode launches")
+            .add_u64_counter("recover_launches",
+                             "fused recovery launches")
+            .add_u64_counter("program_cache_hits",
+                             "compiled-program cache hits")
+            .add_u64_counter("program_cache_misses",
+                             "compiled-program cache compiles")
+            .add_u64_counter("encode_bytes", "logical bytes encoded")
+            .add_u64_counter("decode_bytes", "logical bytes decoded")
+            .add_u64_counter("recovered_objects",
+                             "objects rebuilt by recovery")
+            .add_u64_counter("recovered_bytes",
+                             "shard bytes rebuilt by recovery")
+            .add_u64_counter("hinfo_failures",
+                             "helper chunks failing hinfo verify")
+            .add_u64_counter("read_eio",
+                             "read-path chunk crc mismatches")
+            .add_time_avg("encode_time", "write-path encode wall time")
+            .add_time_avg("decode_time", "read-path decode wall time")
+            .add_time_avg("recover_stage_time",
+                          "recovery host staging (producer thread)")
+            .add_time_avg("recover_launch_time",
+                          "recovery launch enqueue + async D2H start")
+            .add_time_avg("recover_fetch_time",
+                          "blocking remainder of the D2H fetch "
+                          "(overlap eats the rest)")
+            .add_time_avg("recover_writeback_time",
+                          "rebuilt-shard writeback fan-out")
+            .add_u64_counter("stream_launches",
+                             "StreamingCodec tile launches")
+            .add_u64_counter("stream_bytes",
+                             "bytes streamed through tiled encode")
+            .add_time_avg("stream_drain_time",
+                          "StreamingCodec blocking drain remainder")
+            .create_perf_counters())
 
 
 @dataclass
@@ -72,7 +121,11 @@ class ECBackend(PGBackend):
 
     def __init__(self, profile: dict | str, pg: str, acting: list[int],
                  cluster: ShardSet | None = None,
-                 chunk_size: int | None = None):
+                 chunk_size: int | None = None,
+                 perf=None):
+        # data-path counters: the owning daemon passes its shared "ec"
+        # logger; a bare backend (benches, unit tests) gets its own
+        self.perf = perf if perf is not None else ec_perf_counters()
         self.coder: ErasureCode = factory(profile)
         self.k = self.coder.get_data_chunk_count()
         self.m = self.coder.get_coding_chunk_count()
@@ -171,15 +224,24 @@ class ECBackend(PGBackend):
             bucket = pow2_bucket(B)
             mat = np.ascontiguousarray(self.coder.matrix,
                                        dtype=np.uint8)
+            ci0 = self._fused_write_fn.cache_info()
             fn = self._fused_write_fn(mat.tobytes(), self.m, self.k,
                                       self.coder.impl, sl, bucket)
+            ci1 = self._fused_write_fn.cache_info()
+            self.perf.inc_many(
+                (("fused_write_launches", 1),
+                 ("encode_bytes", int(data_shards.size)),
+                 ("program_cache_hits", ci1.hits - ci0.hits),
+                 ("program_cache_misses", ci1.misses - ci0.misses)))
             padded = data_shards
             if bucket != B:
                 padded = np.zeros((bucket,) + data_shards.shape[1:],
                                   dtype=np.uint8)
                 padded[:B] = data_shards
-            parity_d, crcs_d = fn(padded)
-            parity, dense_crcs = jax.device_get((parity_d, crcs_d))
+            with span("ecbackend.write.encode", counters=self.perf,
+                      key="encode_time"):
+                parity_d, crcs_d = fn(padded)
+                parity, dense_crcs = jax.device_get((parity_d, crcs_d))
             dense = np.concatenate(
                 [data_shards, np.asarray(parity)[:B]], axis=1)
             dense_crcs = np.asarray(dense_crcs)[:B]
@@ -189,7 +251,11 @@ class ECBackend(PGBackend):
             crcs = np.empty_like(dense_crcs)
             crcs[:, self._perm] = dense_crcs
             return shards, crcs
-        parity = np.asarray(self.coder.encode_chunks(data_shards))
+        self.perf.inc_many((("encode_launches", 1),
+                            ("encode_bytes", int(data_shards.size))))
+        with span("ecbackend.write.encode", counters=self.perf,
+                  key="encode_time"):
+            parity = np.asarray(self.coder.encode_chunks(data_shards))
         shards = self._slots_from_dense(
             np.concatenate([data_shards, parity], axis=1))
         crcs = self._batched_hinfo_crcs(
@@ -524,7 +590,13 @@ class ECBackend(PGBackend):
             if clean_group:
                 idx = [group.index(n) for n in clean_group]
                 sub = {s: stacks[s][idx] for s in need}
-                rec = self.coder.decode(want, sub)
+                self.perf.inc_many(
+                    (("decode_launches", 1),
+                     ("decode_bytes",
+                      len(clean_group) * len(need) * sl)))
+                with span("ecbackend.read.decode", counters=self.perf,
+                          key="decode_time"):
+                    rec = self.coder.decode(want, sub)
                 shards = np.stack([rec[s] for s in self.data_slots],
                                   axis=1)
                 objs = self.sinfo.shards_to_object(shards)
@@ -532,6 +604,7 @@ class ECBackend(PGBackend):
                     out[name] = objs[oi, :self.object_sizes[name]]
             for name, bad_set in bad.items():
                 self.eio_stats["read_eio"] += len(bad_set)
+                self.perf.inc("read_eio", len(bad_set))
                 out[name] = self._read_eio(name, sl, avail, bad_set)
         return out
 
@@ -637,6 +710,8 @@ class ECBackend(PGBackend):
 
         key = (id(dec_fn), sl, verify)
         fn = self._fused_cache.get(key)
+        self.perf.inc("program_cache_hits" if fn is not None
+                      else "program_cache_misses")
         if fn is None:
             from ..csum.kernels import crc32c_blocks
 
@@ -817,7 +892,8 @@ class ECBackend(PGBackend):
         def complete(entry) -> None:
             sl, subgroup, handles = entry
             rebuilt_d, rcrc_d, ok_d = handles
-            with span("ecbackend.recover.fetch"):
+            with span("ecbackend.recover.fetch", counters=self.perf,
+                      key="recover_fetch_time"):
                 rebuilt_all, crcs, ok = jax.device_get(
                     (rebuilt_d, rcrc_d, ok_d))
             bad_pairs: dict[str, set[int]] = {}
@@ -839,7 +915,8 @@ class ECBackend(PGBackend):
                         len(idxs), len(lost))
                 crcs = np.array(crcs)
                 crcs[idxs] = fix
-            with span("ecbackend.recover.writeback"):
+            with span("ecbackend.recover.writeback", counters=self.perf,
+                      key="recover_writeback_time"):
                 self._writeback_rebuilt(lost, subgroup, rebuilt_all,
                                         crcs, sl, counters)
 
@@ -871,7 +948,9 @@ class ECBackend(PGBackend):
                     for sl_, subgroup_ in jobs:
                         if stop.is_set():
                             return
-                        with span("ecbackend.recover.stage"):
+                        with span("ecbackend.recover.stage",
+                                  counters=self.perf,
+                                  key="recover_stage_time"):
                             stack_, exp_ = self._gather_helper_stack(
                                 helper, subgroup_, sl_, verify_hinfo)
                         _put((sl_, subgroup_, stack_, exp_))
@@ -891,7 +970,10 @@ class ECBackend(PGBackend):
                     if item is None:
                         break
                     sl, subgroup, stack, exp = item
-                    with span("ecbackend.recover.launch"):
+                    self.perf.inc("recover_launches")
+                    with span("ecbackend.recover.launch",
+                              counters=self.perf,
+                              key="recover_launch_time"):
                         handles = self._fused_recover_fn(
                             dec_fn, sl, verify_hinfo)(stack, exp)
                         # start the D2H transfer NOW (async): by the
@@ -921,11 +1003,13 @@ class ECBackend(PGBackend):
             while pending:
                 complete(pending.pop(0))
             self._mark_caught_up(lost, full_plan, provided)
+            self._count_recovery(counters)
             return counters
 
         # generic path (codecs without a static plan): batched per
         # launch but not fused
         for sl, subgroup in jobs:
+            self.perf.inc("recover_launches")
             stacks = {s: np.stack([self._store(s).read(
                 shard_cid(self.pg, s), n) for n in subgroup])
                 for s in helper}
@@ -952,7 +1036,14 @@ class ECBackend(PGBackend):
             self._writeback_rebuilt(lost, subgroup, rebuilt_all,
                                     crcs, sl, counters)
         self._mark_caught_up(lost, full_plan, provided)
+        self._count_recovery(counters)
         return counters
+
+    def _count_recovery(self, counters: dict) -> None:
+        self.perf.inc_many(
+            (("recovered_objects", counters["objects"]),
+             ("recovered_bytes", counters["bytes"]),
+             ("hinfo_failures", counters["hinfo_failures"])))
 
     # -- deep scrub ----------------------------------------------------------
 

@@ -530,6 +530,31 @@ class MAuthReply(_Blob):
     type_id = 0x45
 
 
+@register_message
+class MMgrReport(_Blob):
+    """Daemon -> monitor stats report (ref: MMgrReport.h): kind is
+    "full" or "delta", blob is the JSON report the MgrReportAggregator
+    ingests (perf dump/delta + op stats + primary-claimed PG states).
+    Broadcast to every monitor fire-and-forget; each folds its own
+    aggregate, so any monitor can answer `ceph status`."""
+
+    type_id = 0x49
+
+
+@register_message
+class MMonCmd(_Blob):
+    """Read-only monitor command (the MMonCommand slice observability
+    needs): kind names the command (status / health / health detail /
+    prometheus / perf dump / report dump); the reply blob is JSON."""
+
+    type_id = 0x4A
+
+
+@register_message
+class MMonCmdReply(_Blob):
+    type_id = 0x4B
+
+
 # -- request/reply plumbing --------------------------------------------------
 
 class _PendingCall:
@@ -550,6 +575,7 @@ class _PendingCall:
     def wait(self, timeout: float = 10.0):
         try:
             if not self._ev.wait(timeout):
+                self._rpc.perf.inc("op_timeout")
                 raise ConnectionError(f"rpc to {self.peer} timed out")
             rep = self._replies[0]
             if isinstance(rep, BaseException):
@@ -578,6 +604,7 @@ class _Rpc:
 
     def __init__(self, msgr: Messenger, reply_type: int,
                  window: int = 0, window_bytes: int = 0):
+        from ..utils.perf_counters import PerfCountersBuilder
         self.msgr = msgr
         self._lock = threading.Lock()
         self._next = 1
@@ -587,6 +614,26 @@ class _Rpc:
         self._win = threading.Condition(self._lock)
         self._inflight = 0
         self._inflight_bytes = 0
+        # op-window observability (the objecter_ops / objecter_bytes
+        # counters the reference's Objecter logger carries): occupancy
+        # gauges, submit/reply counters, and the backpressure stall
+        # time a full window cost submitters
+        self.perf = (PerfCountersBuilder("rpc")
+                     .add_u64_counter("op_send", "ops submitted")
+                     .add_u64_counter("op_reply", "replies matched")
+                     .add_u64_counter("op_timeout", "waits timed out")
+                     .add_u64_counter("op_send_bytes",
+                                      "payload bytes submitted")
+                     .add_u64_counter("window_stalls",
+                                      "submits that blocked on a "
+                                      "full window")
+                     .add_u64("inflight_ops", "ops on the wire now")
+                     .add_u64("inflight_bytes",
+                              "payload bytes on the wire now")
+                     .add_time_avg("window_stall_time",
+                                   "backpressure wait per stalled "
+                                   "submit")
+                     .create_perf_counters())
         msgr.register_handler(reply_type, self._on_reply)
 
     def _on_reply(self, peer: str, msg) -> None:
@@ -600,6 +647,7 @@ class _Rpc:
                 # wire speed even with a slow consumer
                 self._release_locked(ent)
         if ent is not None:
+            self.perf.inc("op_reply")
             ent._replies.append(msg)
             ent._ev.set()
 
@@ -609,6 +657,8 @@ class _Rpc:
         ent._released = True
         self._inflight -= 1
         self._inflight_bytes -= ent.nbytes
+        self.perf.set("inflight_ops", self._inflight)
+        self.perf.set("inflight_bytes", self._inflight_bytes)
         self._win.notify_all()
 
     def _retire(self, ent: _PendingCall) -> None:
@@ -624,17 +674,31 @@ class _Rpc:
         handle.wait()."""
         with self._lock:
             if self.window:
+                t0 = None
                 while (self._inflight >= self.window
                        or (self.window_bytes and self._inflight
                            and self._inflight_bytes + nbytes
                            > self.window_bytes)):
+                    if t0 is None:
+                        t0 = time.perf_counter()
                     self._win.wait()
+                if t0 is not None:
+                    # backpressure accounting: how long a full window
+                    # held this submitter (the stall the r8 bench
+                    # could only guess at)
+                    self.perf.inc("window_stalls")
+                    self.perf.tinc("window_stall_time",
+                                   time.perf_counter() - t0)
             rid = self._next
             self._next += 1
             ent = _PendingCall(self, rid, peer, nbytes)
             self._pending[rid] = ent
             self._inflight += 1
             self._inflight_bytes += nbytes
+            self.perf.inc_many((("op_send", 1),
+                                ("op_send_bytes", nbytes)))
+            self.perf.set("inflight_ops", self._inflight)
+            self.perf.set("inflight_bytes", self._inflight_bytes)
         try:
             self.msgr.send(peer, make_msg(rid))
         except KeyError:
@@ -853,6 +917,21 @@ class OSDDaemon:
         self._last_scrub: dict[int, float] = {}
         self._last_deep: dict[int, float] = {}
         self.scrub_reports: dict[int, dict] = {}
+        # per-daemon layered config (ref: md_config_t per daemon). The
+        # cluster's tuned knobs act as the conf-file layer; the
+        # centralized KV riding the committed OSDMap lands at the
+        # "mon" layer on every map fold (_apply_central_config), so
+        # the full precedence chain default < file < mon < override
+        # is live on a running daemon and observers fire on commit.
+        # Built BEFORE observability: the OpTracker resolves its
+        # complaint/history thresholds through this config live.
+        from ..utils.config import Config
+        self.config = Config()
+        self.config.load_file({
+            "osd_heartbeat_interval": cluster.hb_interval,
+            "osd_heartbeat_grace": cluster.hb_grace,
+        })
+        self._cfg_applied: dict[str, str] = {}
         # admin-socket observability (ref: OpTracker/TrackedOp +
         # PerfCounters served by `ceph daemon osd.N <cmd>`)
         self._init_observability()
@@ -862,19 +941,6 @@ class OSDDaemon:
         self._last_pong: dict[int, float] = {}
         self._reported: set[int] = set()
         self._stop = threading.Event()
-        # per-daemon layered config (ref: md_config_t per daemon). The
-        # cluster's tuned knobs act as the conf-file layer; the
-        # centralized KV riding the committed OSDMap lands at the
-        # "mon" layer on every map fold (_apply_central_config), so
-        # the full precedence chain default < file < mon < override
-        # is live on a running daemon and observers fire on commit.
-        from ..utils.config import Config
-        self.config = Config()
-        self.config.load_file({
-            "osd_heartbeat_interval": cluster.hb_interval,
-            "osd_heartbeat_grace": cluster.hb_grace,
-        })
-        self._cfg_applied: dict[str, str] = {}
         # cephx (ref: OSD::ms_verify_authorizer): rotating secrets are
         # fetched at boot (stand-in: exported straight from the
         # cluster's KeyServer); per-peer sessions are established by
@@ -891,6 +957,17 @@ class OSDDaemon:
     def _start(self) -> None:
         """Register handlers + start the heartbeat thread (shared by
         __init__ and revive so the two can't silently diverge)."""
+        # the daemon's live admin socket (ref: admin_socket.cc asok
+        # per daemon): same dispatcher as the wire `admin` op, but
+        # reachable without a client, a map, or cephx — the operator's
+        # side door into a wedged daemon
+        from ..utils.admin_socket import AdminSocket
+        self.asok = AdminSocket(self.c.asok_path(self.name))
+        for _cmd in self._ADMIN_CMDS:
+            self.asok.register(_cmd,
+                               lambda args, c=_cmd:
+                               self._admin_obj((c + " " + args).strip()))
+        self.asok.start()
         m = self.msgr
         m.register_handler(MStoreOp.type_id, self._on_store_op)
         m.register_handler(MOSDOp.type_id, self._on_client_op)
@@ -939,7 +1016,11 @@ class OSDDaemon:
         the inline hunt can cost the whole daemon (see
         _authorize_peer)."""
         if not self._ticket_gate.acquire(blocking=False):
+            # single-flight: someone is already fetching — this wait
+            # is the cheap outcome the counter exists to prove
+            self.perf.inc("cephx_refresh_coalesced")
             return
+        self.perf.inc("cephx_refresh_kicked")
 
         def _go():
             try:
@@ -963,6 +1044,7 @@ class OSDDaemon:
         that livelocks the whole daemon. Cold cache -> fail fast,
         refresh in the background, let the reconcile retry."""
         if not self._cauth.has_ticket("osd"):
+            self.perf.inc("authorize_deferred")
             self._spawn_ticket_refresh()
             raise ConnectionError(
                 f"{self.name}: osd service ticket not warm; authorize "
@@ -991,8 +1073,12 @@ class OSDDaemon:
                     pass
                 return
         try:
-            with self._store_lock:
-                blob = self._store_op(msg.kind, msg.blob)
+            with self.perf.time("subop_latency"):
+                with self._store_lock:
+                    blob = self._store_op(msg.kind, msg.blob)
+            self.perf.inc_many((("subop", 1),
+                                ("subop_in_bytes", len(msg.blob)),
+                                ("subop_out_bytes", len(blob))))
             rep = MStoreReply(msg.req_id, True, msg.kind, blob)
         except KeyError as e:
             rep = MStoreReply(msg.req_id, False, msg.kind,
@@ -1053,7 +1139,8 @@ class OSDDaemon:
         if self.c.is_erasure:
             return ECBackend(self.c.profile, f"1.{ps}", acting,
                              self._shard_set(),
-                             chunk_size=self.c.chunk_size)
+                             chunk_size=self.c.chunk_size,
+                             perf=self.ec_perf)
         return ReplicatedBackend(self.c.pool_size, f"1.{ps}", acting,
                                  self._shard_set(),
                                  min_size=self.c.pool_min_size)
@@ -1530,6 +1617,8 @@ class OSDDaemon:
                     self.suspect.discard(osd)
             self._apply_central_config()
             self._reconcile()
+            self.perf.set("osdmap_epoch", self.osdmap.epoch)
+            self.perf.set("numpg", len(self.backends))
 
     def _apply_central_config(self) -> None:
         """Land the committed map's config KV at this daemon's "mon"
@@ -1671,6 +1760,7 @@ class OSDDaemon:
                         be.recover_shards(lost, replacement_osds=repl,
                                           helper_exclude=exclude)
                         self.suspect -= dead
+                        self.perf.inc("recovery_rounds")
                     self._persist_meta(ps)
                 except (ValueError, ConnectionError, KeyError) as e:
                     self.c.log(f"{self.name}: pg 1.{ps} recovery "
@@ -1720,67 +1810,184 @@ class OSDDaemon:
         """Fresh OpTracker + PerfCounters — called at boot AND on
         revive (in-RAM observability dies with the process, like a
         real restart); ONE list of counter keys so the two paths
-        cannot drift."""
+        cannot drift. The OpTracker resolves its thresholds through
+        this daemon's layered config (osd_op_complaint_time /
+        osd_op_history_*), so a committed `config set` retunes it
+        live."""
         from ..utils.op_tracker import OpTracker
         from ..utils.perf_counters import PerfCountersBuilder
-        self.op_tracker = OpTracker()
+        from .ecbackend import ec_perf_counters
+        self.op_tracker = OpTracker(config=self.config)
         b = PerfCountersBuilder(f"osd.{self.osd_id}")
         for key in ("op", "op_r", "op_w", "op_in_bytes",
                     "op_out_bytes"):
             b.add_u64_counter(key)
+        (b.add_u64_counter("subop", "store sub-ops served")
+         .add_u64_counter("subop_in_bytes", "store sub-op bytes in")
+         .add_u64_counter("subop_out_bytes", "store sub-op bytes out")
+         .add_u64_counter("recovery_rounds",
+                          "reconcile-driven recovery passes")
+         .add_u64_counter("cephx_refresh_kicked",
+                          "background ticket refreshes started")
+         .add_u64_counter("cephx_refresh_coalesced",
+                          "refresh requests folded into an already "
+                          "running single-flight fetch")
+         .add_u64_counter("authorize_deferred",
+                          "dispatch-path authorizes failed fast on a "
+                          "cold ticket cache")
+         .add_u64_counter("mgr_reports_tx", "MgrReports shipped")
+         .add_u64("numpg", "PGs this daemon primaries")
+         .add_u64("osdmap_epoch", "newest folded map epoch")
+         .add_time_avg("op_latency",
+                       "client op wall time (tracker enter to reply "
+                       "built)")
+         .add_time_avg("subop_latency", "store sub-op service time"))
         self.perf = b.create_perf_counters()
+        # ONE "ec" logger shared by every PG backend this daemon
+        # hosts (per-PG loggers would explode the metric space)
+        self.ec_perf = ec_perf_counters()
+        # MgrReport delta stream state (see mgr/reports.py)
+        self._mgr_seq = 0
+        self._mgr_last_perf: dict | None = None
+        self._mgr_last_sent = 0.0
+
+    # -- perf dump assembly (admin socket + wire admin op + MgrReport) -------
+
+    def perf_dump_all(self) -> dict:
+        """Every logger this daemon owns, keyed the way `ceph daemon
+        osd.N perf dump` shows them. Assembled ONLY from declared
+        PerfCounters dumps — the counter-name smoke test depends on
+        that."""
+        out = {self.perf.name: self.perf.dump(),
+               "msgr": self.msgr.perf.dump(),
+               "rpc": self.rpc.perf.dump(),
+               "ec": self.ec_perf.dump()}
+        if self._cauth is not None:
+            out["cephx"] = self._cauth.perf.dump()
+        kvp = getattr(self.store, "kv_perf", None)
+        if kvp is not None:
+            out["tindb"] = kvp.dump()
+        return out
+
+    def perf_schema_all(self) -> dict:
+        out = {self.perf.name: self.perf.schema(),
+               "msgr": self.msgr.perf.schema(),
+               "rpc": self.rpc.perf.schema(),
+               "ec": self.ec_perf.schema()}
+        if self._cauth is not None:
+            out["cephx"] = self._cauth.perf.schema()
+        kvp = getattr(self.store, "kv_perf", None)
+        if kvp is not None:
+            out["tindb"] = kvp.schema()
+        return out
+
+    def perf_reset_all(self) -> None:
+        self.perf.reset()
+        self.msgr.perf.reset()
+        self.rpc.perf.reset()
+        self.ec_perf.reset()
+        if self._cauth is not None:
+            self._cauth.perf.reset()
+        kvp = getattr(self.store, "kv_perf", None)
+        if kvp is not None:
+            kvp.reset()
+        # the delta stream re-bases: a reset between two deltas would
+        # otherwise ship huge negative deltas the aggregator folds
+        # into nonsense
+        self._mgr_last_perf = None
 
     _READ_KINDS = frozenset({"read", "readv", "snap_read",
                              "admin"})
 
-    _ADMIN_CMDS = ("perf dump", "dump_historic_ops",
+    _ADMIN_CMDS = ("perf dump", "perf reset", "perf schema",
+                   "dump_historic_ops",
                    "dump_historic_ops_by_duration",
                    "dump_ops_in_flight", "slow_ops", "pg stat",
-                   "dump_scrubs")
+                   "dump_scrubs", "log dump", "config show",
+                   "config diff", "trace start", "trace stop",
+                   "status")
+
+    def _pg_states(self) -> dict:
+        """pg_state strings for the PGs this daemon primaries, through
+        the GetInfo/GetLog/GetMissing classifier (the `ceph pg stat`
+        slice a primary can answer; ref: PeeringState pg_state_t
+        names). Caller holds self._lock."""
+        from .peering import peer as _peer
+        if self.osdmap is None:
+            return {}
+        alive = [bool(u) and o not in self.suspect
+                 for o, u in enumerate(self.osdmap.osd_up)]
+        my_ut = int(self.osdmap.osd_up_thru[self.osd_id])
+        return {f"1.{ps}": _peer(
+                    be, alive, compute_missing=False,
+                    interval_start=self._interval_start.get(ps, 0),
+                    up_thru=my_ut).state
+                for ps, be in sorted(self.backends.items())}
+
+    def _admin_obj(self, cmd: str):
+        """ONE dispatcher for both admin surfaces — the wire `admin`
+        MOSDOp and the Unix admin socket (ref: src/common/
+        admin_socket.cc registering OpTracker/PerfCounters/log
+        commands) — so the two can't drift."""
+        from ..utils.log import g_log
+        if cmd == "perf dump":
+            return self.perf_dump_all()
+        if cmd == "perf schema":
+            return self.perf_schema_all()
+        if cmd == "perf reset":
+            self.perf_reset_all()
+            return {"success": True}
+        if cmd == "dump_historic_ops":
+            return self.op_tracker.dump_historic_ops()
+        if cmd == "dump_historic_ops_by_duration":
+            return self.op_tracker.dump_historic_ops(by_duration=True)
+        if cmd == "dump_ops_in_flight":
+            return self.op_tracker.dump_ops_in_flight()
+        if cmd == "slow_ops":
+            return {"slow_ops": self.op_tracker.slow_ops(),
+                    "complaint_time": self.op_tracker.complaint_time}
+        if cmd == "log dump":
+            # the gathered ring (more detail than was ever printed) —
+            # during chaos runs the Thrasher's seed-stamped events are
+            # in here, so this reconstructs the fault timeline
+            return {"lines": g_log.dump_recent()}
+        if cmd == "config show":
+            return self.config.dump()
+        if cmd == "config diff":
+            return self.config.diff()
+        if cmd.startswith("trace start"):
+            from ..utils.tracing import start_trace
+            log_dir = cmd[len("trace start"):].strip() \
+                or f"/tmp/{self.name}-trace"
+            return {"started": start_trace(log_dir), "dir": log_dir}
+        if cmd == "trace stop":
+            from ..utils.tracing import stop_trace
+            return {"stopped": stop_trace()}
+        if cmd == "dump_scrubs":
+            with self._lock:   # heartbeat inserts concurrently
+                return {"scrubs": {f"1.{ps}": r for ps, r in
+                                   sorted(self.scrub_reports.items())}}
+        if cmd == "status":
+            with self._lock:
+                return {
+                    "name": self.name,
+                    "osdmap_epoch": self.osdmap.epoch
+                    if self.osdmap is not None else 0,
+                    "num_pgs": len(self.backends),
+                    "suspect": sorted(self.suspect),
+                    "store": type(self.store).__name__,
+                }
+        if cmd == "pg stat":
+            with self._lock:
+                return {"pgs": self._pg_states()}
+        raise ValueError(f"unknown admin command {cmd!r}; "
+                         f"known: {list(self._ADMIN_CMDS)}")
 
     def _admin_cmd(self, cmd: str) -> bytes:
-        """`ceph daemon osd.N <cmd>` over the wire (ref: the admin
-        socket commands src/common/admin_socket.cc registers from
-        OpTracker + PerfCounters)."""
+        """`ceph daemon osd.N <cmd>` over the wire."""
         import json as _json
-        if cmd == "perf dump":
-            out = {self.perf.name: self.perf.dump()}
-        elif cmd == "dump_historic_ops":
-            out = self.op_tracker.dump_historic_ops()
-        elif cmd == "dump_historic_ops_by_duration":
-            out = self.op_tracker.dump_historic_ops(by_duration=True)
-        elif cmd == "dump_ops_in_flight":
-            out = self.op_tracker.dump_ops_in_flight()
-        elif cmd == "slow_ops":
-            out = {"slow_ops": self.op_tracker.slow_ops()}
-        elif cmd == "dump_scrubs":
-            with self._lock:   # heartbeat inserts concurrently
-                out = {"scrubs": {f"1.{ps}": r for ps, r in
-                                  sorted(self.scrub_reports.items())}}
-        elif cmd == "pg stat":
-            # pg_state strings for the PGs this daemon primaries,
-            # through the GetInfo/GetLog/GetMissing classifier (the
-            # `ceph pg stat` slice a primary can answer; ref:
-            # PeeringState pg_state_t names)
-            from .peering import peer as _peer
-            with self._lock:
-                if self.osdmap is None:
-                    out = {"pgs": {}}
-                else:
-                    alive = [bool(u) and o not in self.suspect
-                             for o, u in enumerate(self.osdmap.osd_up)]
-                    my_ut = int(self.osdmap.osd_up_thru[self.osd_id])
-                    out = {"pgs": {
-                        f"1.{ps}": _peer(
-                            be, alive, compute_missing=False,
-                            interval_start=self._interval_start.get(
-                                ps, 0),
-                            up_thru=my_ut).state
-                        for ps, be in sorted(self.backends.items())}}
-        else:
-            raise ValueError(f"unknown admin command {cmd!r}; "
-                             f"known: {list(self._ADMIN_CMDS)}")
-        return _json.dumps(out, sort_keys=True).encode()
+        return _json.dumps(self._admin_obj(cmd), sort_keys=True,
+                           default=str).encode()
 
     def _on_auth(self, peer: str, msg: MAuthOp) -> None:
         """Session establishment (ref: CephxAuthorizeHandler via
@@ -1881,16 +2088,19 @@ class OSDDaemon:
             pass
 
     def _one_client_op(self, peer: str, kind: str, body: bytes) -> bytes:
-        with self.op_tracker.create_op(
-                f"osd_op({kind}) client={peer}") as op:
-            with self._lock:
-                op.mark_event("reached_pg")
-                blob = self._client_op(kind, body)
-            op.mark_event("commit_sent")
-        self.perf.inc("op")
-        self.perf.inc("op_r" if kind in self._READ_KINDS else "op_w")
-        self.perf.inc("op_in_bytes", len(body))
-        self.perf.inc("op_out_bytes", len(blob))
+        from ..utils.tracing import span
+        with span("osd.op", counters=self.perf, key="op_latency"):
+            with self.op_tracker.create_op(
+                    f"osd_op({kind}) client={peer}") as op:
+                with self._lock:
+                    op.mark_event("reached_pg")
+                    blob = self._client_op(kind, body)
+                op.mark_event("commit_sent")
+        self.perf.inc_many(
+            (("op", 1),
+             ("op_r" if kind in self._READ_KINDS else "op_w", 1),
+             ("op_in_bytes", len(body)),
+             ("op_out_bytes", len(blob))))
         return blob
 
     SNAP_SEP = "@@snap."
@@ -2326,10 +2536,67 @@ class OSDDaemon:
             # scrub LAST: this beat's pings are already out, so a long
             # deep scrub cannot push our liveness past peers' grace
             self._maybe_scheduled_scrub()
+            try:
+                self._maybe_mgr_report()
+            except Exception as e:  # noqa: BLE001 — stats shipping
+                # must never kill the heartbeat thread
+                self.c.log(f"{self.name}: mgr report failed: {e!r}")
+
+    def _maybe_mgr_report(self) -> None:
+        """Periodically ship this daemon's counters + op stats + the
+        PG states it primaries to every monitor (the MMgrReport flow,
+        ref: DaemonServer::handle_report): FULL dump every Nth report,
+        bounded DELTA in between — the aggregator re-bases on fulls,
+        so lost reports and monitor restarts self-heal without acks."""
+        import json as _json
+
+        from ..mgr.reports import FULL_EVERY
+        from ..utils.perf_counters import dump_delta
+        now = time.monotonic()
+        if now - self._mgr_last_sent \
+                < float(self.config["mgr_report_interval"]):
+            return
+        self._mgr_last_sent = now
+        perf = self.perf_dump_all()
+        self._mgr_seq += 1
+        full = (self._mgr_last_perf is None
+                or self._mgr_seq % FULL_EVERY == 0)
+        report = {
+            "name": self.name,
+            "seq": self._mgr_seq,
+            "kind": "full" if full else "delta",
+            "perf": perf if full
+            else dump_delta(self._mgr_last_perf, perf),
+            "ops_in_flight": len(self.op_tracker._in_flight),
+            "slow_ops": len(self.op_tracker.slow_ops()),
+            "epoch": self.osdmap.epoch
+            if self.osdmap is not None else 0,
+        }
+        if full:
+            report["schema"] = self.perf_schema_all()
+        self._mgr_last_perf = perf
+        # PG states want the daemon lock; never stall the heartbeat
+        # for them — a busy beat ships without, and the aggregator
+        # keeps the previous claim
+        if self._lock.acquire(blocking=False):
+            try:
+                report["pgs"] = self._pg_states()
+            finally:
+                self._lock.release()
+        blob = _json.dumps(report, separators=(",", ":")).encode()
+        self.perf.inc("mgr_reports_tx")
+        for mon_name in self.c.mon_names():
+            try:
+                self.msgr.send(mon_name,
+                               MMgrReport(0, True, report["kind"],
+                                          blob))
+            except (KeyError, OSError, ConnectionError):
+                pass
 
     def kill(self) -> None:
         """SIGKILL: stop answering everything, drop RAM state."""
         self._stop.set()
+        self.asok.stop()
         self.msgr.shutdown()
         self.store.crash()
 
@@ -2419,7 +2686,45 @@ class MonDaemon:
         # window). Death is proven by grace expiry, not assumed.
         self._boot = time.monotonic()
         self._stop = threading.Event()
+        # observability: paxos/mon counters + the per-monitor
+        # MgrReport aggregate every daemon broadcasts into (the mgr
+        # DaemonStateIndex role — this tier has no separate mgr
+        # daemon, disclosed in ARCHITECTURE.md)
+        from ..mgr.reports import MgrReportAggregator
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder(f"mon.{rank}")
+                     .add_u64_counter("paxos_collects",
+                                      "collect rounds started")
+                     .add_u64_counter("paxos_begins",
+                                      "begin batches proposed")
+                     .add_u64_counter("paxos_commits",
+                                      "commits this monitor drove")
+                     .add_u64_counter("paxos_commits_folded",
+                                      "commits learned from peers")
+                     .add_u64_counter("paxos_nacks_rx",
+                                      "rounds lost to a nack")
+                     .add_u64_counter("map_broadcasts",
+                                      "map fan-outs to subscribers")
+                     .add_u64_counter("mgr_reports_rx",
+                                      "MgrReports ingested")
+                     .add_u64_counter("mon_cmds",
+                                      "read-only commands answered")
+                     .add_u64("osdmap_epoch", "committed map epoch")
+                     .create_perf_counters())
+        self.mgr = MgrReportAggregator()
+        self._mgr_seq = 0
+        self._mgr_last_sent = 0.0
+        from ..utils.admin_socket import AdminSocket
+        self.asok = AdminSocket(cluster.asok_path(self.name))
+        for _cmd in ("status", "health", "health detail", "prometheus",
+                     "perf dump", "perf schema", "report dump",
+                     "mon_status", "log dump"):
+            self.asok.register(_cmd,
+                               lambda args, c=_cmd: self._mon_cmd_obj(c))
+        self.asok.start()
         m = self.msgr
+        m.register_handler(MMgrReport.type_id, self._on_mgr_report)
+        m.register_handler(MMonCmd.type_id, self._on_mon_cmd)
         m.register_handler(MOSDFailure.type_id, self._on_failure)
         m.register_handler(MOSDBoot.type_id, self._on_boot)
         m.register_handler(MOSDAlive.type_id, self._on_alive)
@@ -2556,6 +2861,10 @@ class MonDaemon:
                             if cand.epoch != base.epoch:
                                 keep.append(mutate)
                         self._mutations = keep
+            try:
+                self._self_report(broadcast=True)
+            except Exception:    # noqa: BLE001 — observability must
+                pass             # never kill the mon heartbeat
             if self._stop.wait(self.c.hb_interval):
                 return
 
@@ -2672,6 +2981,8 @@ class MonDaemon:
                 or msg.epoch > self.osdmap.epoch
             self._fold_committed_locked(msg.epoch, msg.map_bytes)
         if fresh:
+            self.perf.inc("paxos_commits_folded")
+            self.perf.set("osdmap_epoch", msg.epoch)
             # peons broadcast too: if the committing leader dies
             # between its commit fan-out and its subscriber fan-out,
             # subscribers would otherwise strand on the old epoch
@@ -2807,6 +3118,163 @@ class MonDaemon:
             except (KeyError, OSError, ConnectionError):
                 pass
 
+    # -- observability (MgrReport aggregation + read-only commands) ----------
+
+    def _on_mgr_report(self, peer: str, msg: MMgrReport) -> None:
+        import json as _json
+        try:
+            self.mgr.ingest(_json.loads(msg.blob.decode()))
+            self.perf.inc("mgr_reports_rx")
+        except (ValueError, UnicodeDecodeError):
+            pass                 # malformed report: drop, don't die
+
+    def _self_report(self, broadcast: bool = False) -> None:
+        """The monitor is a daemon too: fold its own counters into its
+        aggregator (no wire hop — local ingest) and, on the
+        mgr_report_interval cadence, ship them to peer monitors as a
+        normal MMgrReport — so ANY monitor's `ceph status`/prometheus
+        covers the whole control plane, not just itself. Broadcasts
+        are throttled like OSD reports: a 12-daemon bench showed
+        unthrottled per-beat self-reports (dump + schema + sealed
+        frames ×peers ×4 Hz) costing real percent of the one core the
+        data plane shares."""
+        from ..utils.config import g_conf
+        now = time.monotonic()
+        if broadcast and now - self._mgr_last_sent \
+                < float(g_conf["mgr_report_interval"]):
+            return
+        self._mgr_last_sent = now
+        self._mgr_seq += 1
+        report = {
+            "name": self.name, "seq": self._mgr_seq, "kind": "full",
+            "perf": {self.perf.name: self.perf.dump(),
+                     "msgr": self.msgr.perf.dump()},
+            "schema": {self.perf.name: self.perf.schema(),
+                       "msgr": self.msgr.perf.schema()},
+        }
+        self.mgr.ingest(report)
+        if broadcast:
+            import json as _json
+            self._send_peers(MMgrReport(
+                0, True, "full",
+                _json.dumps(report,
+                            separators=(",", ":")).encode()))
+
+    def _mon_read_denied(self, peer: str) -> bool:
+        """Read-only command gate: any mon session with r (the MonCap
+        `allow r` the reference requires for status). The asok path
+        never comes through here — local filesystem access IS the
+        operator credential there, like the reference's asok."""
+        if self.verifier is None:
+            return False
+        sess = self._authed.get(peer)
+        caps = sess["caps"].get("mon") if sess else None
+        return caps is None or not caps.allows("r")
+
+    def _health_obj(self, detail: bool = True) -> dict:
+        from ..mgr.health import health_checks
+        from ..utils.config import g_conf
+        res = health_checks(
+            osdmap=self.osdmap,
+            quorum=sorted(self._alive_ranks()),
+            mon_members=self._members(),
+            reports=self.mgr,
+            stale_grace=float(g_conf["mgr_stale_report_grace"]),
+            pg_num=self.c.pg_num)
+        if not detail:
+            for c in res["checks"]:
+                c.pop("detail", None)
+        return res
+
+    def _status_obj(self) -> dict:
+        alive = sorted(self._alive_ranks())
+        with self._lock:
+            epoch = self.osdmap.epoch if self.osdmap is not None else 0
+            osds_up = int(sum(self.osdmap.osd_up)) \
+                if self.osdmap is not None else 0
+            osds_in = int(sum(1 for w in self.osdmap.osd_weight
+                              if w > 0)) \
+                if self.osdmap is not None else 0
+            n_osds = len(self.osdmap.osd_up) \
+                if self.osdmap is not None else 0
+        counts: dict[str, int] = {}
+        for st in self.mgr.pg_states().values():
+            counts[st] = counts.get(st, 0) + 1
+        health = self._health_obj(detail=False)
+        return {
+            "health": health["status"],
+            "checks": [c["code"] for c in health["checks"]],
+            "epoch": epoch,
+            "num_osds": n_osds, "osds_up": osds_up,
+            "osds_in": osds_in,
+            "mon_members": self._members(),
+            "mon_quorum": alive,
+            "mon_leader": min(alive) if alive else None,
+            "pg_states": counts,
+            "pgs_total": self.c.pg_num,
+            **self.mgr.totals(),
+        }
+
+    def _mon_cmd_obj(self, kind: str):
+        """ONE dispatcher for the wire MMonCmd and the monitor's admin
+        socket — the `ceph status / health / prometheus` surface,
+        rendered from the committed map + this monitor's own liveness
+        view + MgrReport-aggregated REAL daemon counters."""
+        from ..mgr import reports as _reports
+        from ..utils.log import g_log
+        self.perf.inc("mon_cmds")
+        self.perf.set("osdmap_epoch",
+                      self.osdmap.epoch if self.osdmap is not None
+                      else 0)
+        self._self_report()      # answer with our own counters fresh
+        if kind == "status":
+            return self._status_obj()
+        if kind == "health":
+            return self._health_obj(detail=False)
+        if kind == "health detail":
+            return self._health_obj(detail=True)
+        if kind == "prometheus":
+            return {"text": _reports.prometheus_text(self.mgr)}
+        if kind == "perf dump":
+            return {"cluster": self.mgr.cluster_perf(),
+                    self.name: {self.perf.name: self.perf.dump(),
+                                "msgr": self.msgr.perf.dump()}}
+        if kind == "perf schema":
+            return {self.perf.name: self.perf.schema(),
+                    "msgr": self.msgr.perf.schema()}
+        if kind == "report dump":
+            return self.mgr.daemons()
+        if kind == "mon_status":
+            alive = sorted(self._alive_ranks())
+            return {"rank": self.rank, "members": self._members(),
+                    "quorum": alive,
+                    "leader": min(alive) if alive else None,
+                    "is_leader": self.is_leader(),
+                    "epoch": self.osdmap.epoch
+                    if self.osdmap is not None else 0}
+        if kind == "log dump":
+            return {"lines": g_log.dump_recent()}
+        raise ValueError(f"unknown mon command {kind!r}")
+
+    def _on_mon_cmd(self, peer: str, msg: MMonCmd) -> None:
+        import json as _json
+        if self._mon_read_denied(peer):
+            rep = MMonCmdReply(msg.req_id, False, msg.kind,
+                               err="EPERM:need mon r")
+        else:
+            try:
+                rep = MMonCmdReply(
+                    msg.req_id, True, msg.kind,
+                    _json.dumps(self._mon_cmd_obj(msg.kind),
+                                sort_keys=True, default=str).encode())
+            except Exception as e:   # noqa: BLE001 — reply, don't die
+                rep = MMonCmdReply(msg.req_id, False, msg.kind,
+                                   err=f"{type(e).__name__}:{e}")
+        try:
+            self.msgr.send(peer, rep)
+        except (KeyError, OSError, ConnectionError):
+            pass
+
     # -- proposer (leader) side ----------------------------------------------
 
     def _next_pn_locked(self) -> int:
@@ -2825,6 +3293,7 @@ class MonDaemon:
             # from splitting us off its quorum
             self._promised = max(self._promised, pn)
             self._collecting = [pn, set(), None]
+        self.perf.inc("paxos_collects")
         self._send_peers(MMonCollect(pn))
 
     def _on_last(self, peer: str, msg: MMonLast) -> None:
@@ -2905,6 +3374,8 @@ class MonDaemon:
                     self._accepted = None
                 committed = (epoch, blob)
         if committed is not None:
+            self.perf.inc("paxos_commits")
+            self.perf.set("osdmap_epoch", committed[0])
             self._send_peers(MMonCommit(*committed))
             self._broadcast(committed[0])
             self._try_propose()
@@ -2934,6 +3405,8 @@ class MonDaemon:
                 or self._pn == msg.nacked)
             if current:
                 self._abandon_locked()
+        if current:
+            self.perf.inc("paxos_nacks_rx")
 
     def _commit(self, mutate) -> None:
         """Queue `mutate` on the serialized proposal pipe; the map
@@ -2981,6 +3454,7 @@ class MonDaemon:
             self._accepts = set()
             self._accepted = (self._pn, epoch, blob)  # self-accept
             begin = MMonBegin(self._pn, epoch, blob)
+        self.perf.inc("paxos_begins")
         self._send_peers(begin)
 
     def _broadcast(self, epoch: int) -> None:
@@ -2988,6 +3462,7 @@ class MonDaemon:
             if self.osdmap is None or self.osdmap.epoch != epoch:
                 return
             blob = self.osdmap.encode()
+        self.perf.inc("map_broadcasts")
         for peer in self.c.map_subscribers():
             try:
                 self.msgr.send(peer, MOSDMapMsg(epoch, blob))
@@ -3116,6 +3591,7 @@ class MonDaemon:
 
     def kill(self) -> None:
         self._stop.set()
+        self.asok.stop()
         self.msgr.shutdown()
 
 
@@ -3277,6 +3753,9 @@ class Client:
         self.osdmap: OSDMap | None = None
         self._lock = threading.Lock()
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
+        # read-only monitor commands (status/health/prometheus) ride
+        # their own correlation space
+        self.mon_rpc = _Rpc(self.msgr, MMonCmdReply.type_id)
         self._cauth = None
         if cluster.key_server is not None:
             from ..auth import ClientAuth
@@ -3337,6 +3816,40 @@ class Client:
             raise RuntimeError(f"admin {cmd!r} on osd.{osd}: "
                                f"{rep.err}")
         return _json.loads(rep.blob)
+
+    def mon_command(self, kind: str, timeout: float = 10.0):
+        """Read-only monitor command (`ceph status` / `health` /
+        `health detail` / `prometheus` / `perf dump` / `report dump`):
+        hunts the monitors in order, answers from the first one's
+        MgrReport aggregate. With cephx on, establishes mon sessions
+        first (the commands need mon r)."""
+        import json as _json
+        self._ensure_mon_sessions()
+        last = None
+        for mon in self.c.mon_names():
+            try:
+                rep = self.mon_rpc.call(
+                    mon, lambda rid: MMonCmd(rid, True, kind),
+                    timeout=timeout)
+            except (ConnectionError, KeyError, OSError) as e:
+                last = str(e)
+                continue             # hunt the next monitor
+            if rep.ok:
+                return _json.loads(rep.blob)
+            if rep.err.startswith("EPERM"):
+                raise PermissionError(rep.err)
+            raise RuntimeError(f"mon command {kind!r}: {rep.err}")
+        raise ConnectionError(f"no monitor answered {kind!r}: {last}")
+
+    def status(self) -> dict:
+        return self.mon_command("status")
+
+    def health(self, detail: bool = False) -> dict:
+        return self.mon_command("health detail" if detail
+                                else "health")
+
+    def prometheus_text(self) -> str:
+        return self.mon_command("prometheus")["text"]
 
     def _op(self, kind: str, ps: int, body_fn, timeout=None,
             retries=30, retry_sleep=0.3) -> bytes:
@@ -3673,7 +4186,7 @@ class StandaloneCluster:
                  hb_interval: float = 0.25, hb_grace: float = 1.2,
                  min_reporters: int = 2, op_timeout: float = 8.0,
                  chunk_size: int = 256, verbose: bool | None = None,
-                 op_window: int = 8):
+                 op_window: int = 8, admin_dir: str | None = None):
         import os as _os
         if verbose is None:
             verbose = bool(_os.environ.get("STANDALONE_VERBOSE"))
@@ -3743,6 +4256,13 @@ class StandaloneCluster:
         if store == "tin" and store_dir is None:
             import tempfile
             self.store_dir = tempfile.mkdtemp(prefix="standalone-tin-")
+        # the run dir for daemon admin sockets (the /var/run/ceph
+        # role): every daemon binds <dir>/<name>.asok. Kept short —
+        # AF_UNIX paths cap at ~107 bytes.
+        if admin_dir is None:
+            import tempfile
+            admin_dir = tempfile.mkdtemp(prefix="ceph-asok-")
+        self.admin_dir = admin_dir
         self.mons = [MonDaemon(r, self) for r in range(3)]
         self.mons[0].osdmap = osdmap
         for m in self.mons[1:]:
@@ -3759,8 +4279,16 @@ class StandaloneCluster:
     # -- topology ------------------------------------------------------------
 
     def log(self, msg: str) -> None:
+        # every cluster event also lands in the gathered log ring, so
+        # `ceph daemon <name> log dump` reconstructs the timeline
+        from ..utils.log import dout
+        dout("osd", 4, f"standalone: {msg}")
         if self.verbose:
             print(f"standalone: {msg}", flush=True)
+
+    def asok_path(self, name: str) -> str:
+        import os as _os
+        return _os.path.join(self.admin_dir, f"{name}.asok")
 
     def osd_ids(self) -> list[int]:
         return list(self.osds)
@@ -4066,3 +4594,5 @@ class StandaloneCluster:
                 d.kill()
         for m in self.mons:
             m.kill()
+        import shutil
+        shutil.rmtree(self.admin_dir, ignore_errors=True)

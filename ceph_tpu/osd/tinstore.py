@@ -1353,6 +1353,14 @@ class TinStore:
             self._alive()
             return {**self._db.segment_stats(), **self._db.stats}
 
+    @property
+    def kv_perf(self):
+        """The mounted TinDB's declared PerfCounters (None when the
+        store is down) — a daemon nests this under "tindb" in its
+        perf dump."""
+        db = self._db
+        return db.perf if db is not None else None
+
     def compact(self) -> None:
         """Full KV compaction (the ceph-kvstore-tool compact role)."""
         with self._lock:

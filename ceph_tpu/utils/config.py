@@ -80,6 +80,20 @@ OPTIONS: list[Option] = [
     Option("log_max_recent", int, 1000,
            "in-memory ring of recent log entries", min=10),
     Option("debug_level", int, 1, "global log gate", min=-1, max=30),
+    Option("osd_op_complaint_time", float, 30.0,
+           "seconds in flight before an op counts as a slow request "
+           "(the SLOW_OPS health source)", min=0.0),
+    Option("osd_op_history_size", int, 20,
+           "completed ops kept for dump_historic_ops", min=0),
+    Option("osd_op_history_duration", float, 600.0,
+           "seconds a completed op stays in the historic dump", min=0.0),
+    Option("mgr_report_interval", float, 2.0,
+           "seconds between a daemon's MgrReports to the monitors "
+           "(the reference defaults to 5; lower = fresher `ceph "
+           "status` at more control-plane CPU)", min=0.05),
+    Option("mgr_stale_report_grace", float, 15.0,
+           "report age past which a daemon's PGs count as stale "
+           "(the PG_STALE health source)", min=0.1),
 ]
 
 

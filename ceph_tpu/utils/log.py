@@ -29,6 +29,12 @@ SUBSYS: dict[str, tuple[int, int]] = {
     "csum": (1, 5),
     "mon": (1, 5),
     "bench": (1, 5),
+    "msgr": (0, 5),
+    "mgr": (1, 5),
+    # chaos events gather into the ring (reconstructable over `log
+    # dump` on the admin socket) without printing: the Thrasher keeps
+    # its own verbose switch for stdout
+    "chaos": (0, 5),
 }
 
 

@@ -4,6 +4,14 @@ Rebuild of the reference's op tracking (ref: src/common/TrackedOp.{h,cc}
 — TrackedOp::mark_event stage marks, OpTracker in-flight registry,
 `dump_historic_ops` / `dump_ops_in_flight` admin-socket commands, slow
 op warnings past osd_op_complaint_time).
+
+Thresholds come from the config system when a Config is provided
+(osd_op_complaint_time / osd_op_history_size /
+osd_op_history_duration): a committed `ceph config set
+osd_op_complaint_time 5` retunes a RUNNING daemon's slow-op detector
+on the next call, no restart — the md_config_obs_t behavior the
+reference gets from its config observers. Constructor keywords remain
+the fallback for config-less users (tests, the sim tier default).
 """
 
 from __future__ import annotations
@@ -61,15 +69,43 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 20, history_duration: float = 600.0,
-                 complaint_time: float = 30.0):
+                 complaint_time: float = 30.0, config=None):
         self._ids = itertools.count(1)
         self._in_flight: dict[int, TrackedOp] = {}
-        self._history: collections.deque[TrackedOp] = collections.deque(
-            maxlen=history_size)
+        # unbounded deque, trimmed against the LIVE history_size: a
+        # maxlen frozen at construction could not follow a runtime
+        # `config set osd_op_history_size`
+        self._history: collections.deque[TrackedOp] = collections.deque()
         self._slowest: list[TrackedOp] = []
-        self.history_duration = history_duration
-        self.complaint_time = complaint_time
+        self._config = config
+        self._history_size = history_size
+        self._history_duration = history_duration
+        self._complaint_time = complaint_time
         self._lock = threading.Lock()
+
+    # -- config-resolved thresholds (live values, not boot snapshots) --------
+
+    def _opt(self, name: str, fallback):
+        if self._config is not None:
+            try:
+                return self._config.get(name)
+            except KeyError:
+                pass
+        return fallback
+
+    @property
+    def history_size(self) -> int:
+        return int(self._opt("osd_op_history_size", self._history_size))
+
+    @property
+    def history_duration(self) -> float:
+        return float(self._opt("osd_op_history_duration",
+                               self._history_duration))
+
+    @property
+    def complaint_time(self) -> float:
+        return float(self._opt("osd_op_complaint_time",
+                               self._complaint_time))
 
     def create_op(self, desc: str) -> TrackedOp:
         op = TrackedOp(self, next(self._ids), desc)
@@ -78,21 +114,25 @@ class OpTracker:
         return op
 
     def _retire(self, op: TrackedOp) -> None:
+        size = self.history_size
         with self._lock:
             self._in_flight.pop(op.id, None)
             self._history.append(op)
+            while len(self._history) > size:
+                self._history.popleft()
             self._slowest.append(op)
             self._slowest.sort(key=lambda o: -o.duration)
-            del self._slowest[self._history.maxlen:]
+            del self._slowest[size:]
 
     def _prune_expired(self) -> None:
         """Drop completed ops older than history_duration (the
         reference's osd_op_history_duration expiry). Call with lock."""
         cutoff = time.time() - self.history_duration
+        size = self.history_size
         while self._history and self._history[0].t_end_wall < cutoff:
             self._history.popleft()
         self._slowest = [o for o in self._slowest
-                         if o.t_end_wall >= cutoff]
+                         if o.t_end_wall >= cutoff][:size]
 
     def dump_ops_in_flight(self) -> dict:
         with self._lock:
@@ -100,9 +140,11 @@ class OpTracker:
         return {"num_ops": len(ops), "ops": ops}
 
     def dump_historic_ops(self, by_duration: bool = False) -> dict:
+        size = self.history_size
         with self._lock:
             self._prune_expired()
-            src = self._slowest if by_duration else list(self._history)
+            src = self._slowest[:size] if by_duration \
+                else list(self._history)[-size:]
             ops = [op.dump() for op in src]
         return {"num_ops": len(ops), "ops": ops}
 
@@ -110,6 +152,7 @@ class OpTracker:
         """In-flight ops past the complaint threshold (the
         'slow request' warning source)."""
         now = time.perf_counter()
+        threshold = self.complaint_time
         with self._lock:
             return [op.dump() for op in self._in_flight.values()
-                    if now - op.t_start > self.complaint_time]
+                    if now - op.t_start > threshold]

@@ -32,6 +32,20 @@ class _Counter:
     buckets: list[int] = field(default_factory=list)
 
 
+#: every (logger name, key) ever declared through PerfCountersBuilder —
+#: the reference's "counters exist only if declared in a schema"
+#: property, checkable from the outside: a dump/exposition emitting a
+#: name absent here was assembled by hand (dynamic/typo'd counter
+#: names, the failure mode the smoke test hunts).
+declared_counters: dict[str, set] = {}
+_declared_lock = threading.Lock()
+
+
+def is_declared(logger: str, key: str) -> bool:
+    with _declared_lock:
+        return key in declared_counters.get(logger, ())
+
+
 class PerfCountersBuilder:
     """Declare-then-freeze, like the reference's builder."""
 
@@ -39,23 +53,25 @@ class PerfCountersBuilder:
         self.name = name
         self._counters: dict[str, _Counter] = {}
 
-    def add_u64_counter(self, key: str, description: str = ""):
-        self._counters[key] = _Counter("counter", description)
+    def _declare(self, key: str, counter: _Counter):
+        self._counters[key] = counter
+        with _declared_lock:
+            declared_counters.setdefault(self.name, set()).add(key)
         return self
+
+    def add_u64_counter(self, key: str, description: str = ""):
+        return self._declare(key, _Counter("counter", description))
 
     def add_u64(self, key: str, description: str = ""):
-        self._counters[key] = _Counter("gauge", description)
-        return self
+        return self._declare(key, _Counter("gauge", description))
 
     def add_time_avg(self, key: str, description: str = ""):
-        self._counters[key] = _Counter("time_avg", description)
-        return self
+        return self._declare(key, _Counter("time_avg", description))
 
     def add_histogram(self, key: str, description: str = "",
                       n_buckets: int = 32):
-        self._counters[key] = _Counter("histogram", description,
-                                       buckets=[0] * n_buckets)
-        return self
+        return self._declare(key, _Counter("histogram", description,
+                                           buckets=[0] * n_buckets))
 
     def create_perf_counters(self) -> "PerfCounters":
         return PerfCounters(self.name, self._counters)
@@ -76,6 +92,13 @@ class PerfCounters:
     def inc(self, key: str, by: float = 1) -> None:
         with self._lock:
             self._get(key, ("counter", "gauge")).value += by
+
+    def inc_many(self, pairs) -> None:
+        """Batch inc: one lock acquisition for a hot path that bumps
+        several counters per event (the msgr frame path)."""
+        with self._lock:
+            for key, by in pairs:
+                self._get(key, ("counter", "gauge")).value += by
 
     def dec(self, key: str, by: float = 1) -> None:
         with self._lock:
@@ -137,6 +160,24 @@ class PerfCounters:
                     out[key] = c.value
         return out
 
+    def schema(self) -> dict:
+        """{key: {"kind", "description"}} — `perf schema` (ref: the
+        admin socket's perf schema command); ships on full MgrReports
+        so the aggregator can type metrics it never declared."""
+        with self._lock:
+            return {key: {"kind": c.kind, "description": c.description}
+                    for key, c in self._c.items()}
+
+    def reset(self) -> None:
+        """`perf reset` (ref: admin_socket perf reset all): zero every
+        counter, keeping the declarations."""
+        with self._lock:
+            for c in self._c.values():
+                c.value = 0
+                c.sum_s = 0.0
+                c.count = 0
+                c.buckets = [0] * len(c.buckets)
+
 
 class PerfCountersCollection:
     """Process-wide registry; `perf dump` equivalent."""
@@ -157,6 +198,12 @@ class PerfCountersCollection:
     def dump(self) -> dict:
         with self._lock:
             return {name: c.dump() for name, c in self._loggers.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            loggers = list(self._loggers.values())
+        for c in loggers:
+            c.reset()
 
     def dump_json(self) -> str:
         return json.dumps(self.dump(), sort_keys=True)
@@ -218,6 +265,48 @@ class PerfCountersCollection:
                     lines.append(f"{metric}_sum {sum_s!r}")
                     lines.append(f"{metric}_count {total}")
         return "\n".join(lines) + "\n"
+
+
+def dump_delta(before: dict, after: dict) -> dict:
+    """Counter-delta attribution: `after - before` over two perf-dump
+    shaped dicts (numbers subtract, time_avg dicts subtract
+    field-wise, histogram lists subtract element-wise, nested logger
+    dicts recurse). Keys new in `after` pass through whole. This is
+    what rados_bench/recovery_bench emit so every BENCH_* number
+    carries its own per-stage breakdown, and what a daemon ships in a
+    delta MgrReport."""
+    out: dict = {}
+    for key, a in after.items():
+        b = before.get(key)
+        if b is None:
+            out[key] = a
+        elif isinstance(a, dict):
+            out[key] = dump_delta(b, a)
+        elif isinstance(a, list):
+            out[key] = [x - y for x, y in zip(a, b)] \
+                if len(a) == len(b) else a
+        else:
+            out[key] = a - b
+    return out
+
+
+def fold_delta(base: dict, delta: dict) -> dict:
+    """The aggregation-side inverse of dump_delta: fold a delta dump
+    onto an accumulated base (numbers add, dicts recurse, histogram
+    lists add element-wise). Returns a NEW dict; inputs unchanged."""
+    out = dict(base)
+    for key, d in delta.items():
+        b = out.get(key)
+        if b is None:
+            out[key] = d
+        elif isinstance(d, dict):
+            out[key] = fold_delta(b, d)
+        elif isinstance(d, list):
+            out[key] = [x + y for x, y in zip(b, d)] \
+                if len(b) == len(d) else d
+        else:
+            out[key] = b + d
+    return out
 
 
 # the default process-wide collection (role of CephContext's collection)

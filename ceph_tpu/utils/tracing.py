@@ -31,14 +31,27 @@ import contextlib
 import time
 
 
+#: memoized jax.profiler.TraceAnnotation class (False = unresolved):
+#: the old per-span() try/import ran the import machinery on EVERY
+#: entry — sys.modules lookup + exception plumbing on the msgr hot
+#: path. Resolved once, lazily, so pure-host users still never pay
+#: for the jax import and disabled spans are near-zero-cost.
+_TRACE_ANNOTATION = False
+
+
 def _annotation(name: str):
-    """jax.profiler.TraceAnnotation when jax is importable, else None.
-    Imported lazily so pure-host users never pay for jax import."""
-    try:
-        from jax.profiler import TraceAnnotation
-    except Exception:  # pragma: no cover - jax is baked into this image
+    """jax.profiler.TraceAnnotation(name) when jax is importable,
+    else None. The import result is memoized at module level."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is False:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except Exception:  # pragma: no cover - jax is baked in here
+            _TRACE_ANNOTATION = None
+    if _TRACE_ANNOTATION is None:
         return None
-    return TraceAnnotation(name)
+    return _TRACE_ANNOTATION(name)
 
 
 @contextlib.contextmanager

@@ -50,6 +50,101 @@ class TestPerfCounters:
         assert sum(c.get("op_size_hist")) == 4
         assert c.get("op_size_hist")[7] == 1  # 130 -> bucket 7
 
+    def test_histogram_bucket_boundaries_in_dump(self):
+        """Slot i holds samples in [2^i, 2^(i+1)): exact powers of two
+        land in their OWN slot, the last slot is the overflow clamp,
+        and the dump carries the raw (non-cumulative) buckets."""
+        c = self.build()
+        for v in (1, 2, 4, 8, 127, 128, 1 << 30):  # 1<<30 >> 8 buckets
+            c.hinc("op_size_hist", v)
+        dumped = c.dump()["op_size_hist"]
+        assert dumped[0] == 1          # 1
+        assert dumped[1] == 1          # 2..3
+        assert dumped[2] == 1          # 4..7
+        assert dumped[3] == 1          # 8..15
+        assert dumped[6] == 1          # 64..127
+        assert dumped[7] == 2          # 128 + the overflow clamp
+        assert sum(dumped) == 7
+
+    def test_time_avg_math(self):
+        """time_avg dump is (avgcount, sum); avg = sum/count exactly,
+        0 when empty (no div-by-zero)."""
+        c = self.build()
+        assert c.get("op_latency") == {"sum": 0.0, "count": 0,
+                                       "avg": 0.0}
+        for s in (0.25, 0.25, 1.0):
+            c.tinc("op_latency", s)
+        got = c.get("op_latency")
+        assert got == {"sum": 1.5, "count": 3, "avg": 0.5}
+        d = c.dump()["op_latency"]
+        assert d == {"avgcount": 3, "sum": 1.5}
+
+    def test_concurrent_inc_from_threads(self):
+        """inc/inc_many are atomic under the counter lock: N threads
+        hammering one counter lose nothing."""
+        import threading
+        c = self.build()
+
+        def worker():
+            for _ in range(500):
+                c.inc("op_w")
+                c.inc_many((("op_w", 2),))
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.get("op_w") == 8 * 500 * 3
+
+    def test_dump_reset_roundtrip(self):
+        """perf reset zeroes every kind; declarations (schema) and
+        dump SHAPE survive — a post-reset dump has the same keys with
+        zero values."""
+        c = self.build()
+        c.inc("op_w", 7)
+        c.set("numpg", 4)
+        c.tinc("op_latency", 1.0)
+        c.hinc("op_size_hist", 9)
+        before = c.dump()
+        schema_before = c.schema()
+        c.reset()
+        after = c.dump()
+        assert set(after) == set(before)
+        assert after["op_w"] == 0 and after["numpg"] == 0
+        assert after["op_latency"] == {"avgcount": 0, "sum": 0.0}
+        assert sum(after["op_size_hist"]) == 0
+        assert len(after["op_size_hist"]) == len(before["op_size_hist"])
+        assert c.schema() == schema_before
+        c.inc("op_w")               # still usable after reset
+        assert c.get("op_w") == 1
+
+    def test_declared_registry(self):
+        from ceph_tpu.utils.perf_counters import is_declared
+        self.build()
+        assert is_declared("osd", "op_w")
+        assert is_declared("osd", "op_size_hist")
+        assert not is_declared("osd", "totally_made_up")
+
+    def test_dump_delta_and_fold(self):
+        from ceph_tpu.utils.perf_counters import dump_delta, fold_delta
+        c = self.build()
+        c.inc("op_w", 3)
+        c.tinc("op_latency", 1.0)
+        c.hinc("op_size_hist", 2)
+        before = c.dump()
+        c.inc("op_w", 4)
+        c.tinc("op_latency", 0.5)
+        c.hinc("op_size_hist", 2)
+        delta = dump_delta({"osd": before}, {"osd": c.dump()})["osd"]
+        assert delta["op_w"] == 4
+        assert delta["op_latency"] == {"avgcount": 1, "sum": 0.5}
+        assert sum(delta["op_size_hist"]) == 1
+        # fold_delta(before, delta) == after
+        refold = fold_delta({"osd": before},
+                            {"osd": delta})["osd"]
+        assert refold["op_w"] == c.dump()["op_w"]
+        assert refold["op_size_hist"] == c.dump()["op_size_hist"]
+
     def test_collection_dump(self):
         coll = PerfCountersCollection()
         c = coll.add(self.build())
@@ -174,6 +269,41 @@ class TestOpTracker:
         assert any("failed: RuntimeError" in e for e in events)
 
 
+class TestOpTrackerConfig:
+    def test_thresholds_resolve_through_config(self):
+        """osd_op_complaint_time / osd_op_history_* come from the
+        config system LIVE — a runtime `config set` retunes a running
+        tracker, no restart (the md_config_obs_t behavior)."""
+        cfg = Config()
+        tr = OpTracker(config=cfg)
+        assert tr.complaint_time == 30.0          # schema default
+        assert tr.history_size == 20
+        cfg.set("osd_op_complaint_time", 0.01)
+        op = tr.create_op("will be slow")
+        time.sleep(0.02)
+        assert len(tr.slow_ops()) == 1            # new threshold live
+        cfg.set("osd_op_complaint_time", 60.0)
+        assert tr.slow_ops() == []                # retuned again
+        op.finish()
+
+    def test_history_size_shrinks_live(self):
+        cfg = Config()
+        cfg.set("osd_op_history_size", 5)
+        tr = OpTracker(config=cfg)
+        for i in range(10):
+            tr.create_op(f"op{i}").finish()
+        assert tr.dump_historic_ops()["num_ops"] == 5
+        cfg.set("osd_op_history_size", 2)
+        assert tr.dump_historic_ops()["num_ops"] == 2
+        assert tr.dump_historic_ops(
+            by_duration=True)["num_ops"] == 2
+
+    def test_ctor_fallbacks_without_config(self):
+        tr = OpTracker(history_size=3, complaint_time=1.5)
+        assert tr.history_size == 3
+        assert tr.complaint_time == 1.5
+
+
 def test_historic_ops_expire_by_age():
     tr = OpTracker(history_size=10, history_duration=0.05)
     tr.create_op("old").finish()
@@ -231,6 +361,16 @@ class TestPrometheusExport:
 
 
 class TestTracing:
+    def test_annotation_import_memoized(self):
+        """The jax.profiler import resolves ONCE at module level (the
+        per-span try/import was measurable on the msgr hot path)."""
+        from ceph_tpu.utils import tracing
+        tracing._annotation("warm")           # resolve
+        assert tracing._TRACE_ANNOTATION is not False
+        resolved = tracing._TRACE_ANNOTATION
+        tracing._annotation("again")
+        assert tracing._TRACE_ANNOTATION is resolved
+
     def test_span_noop_and_counter(self):
         from ceph_tpu.utils.perf_counters import PerfCountersBuilder
         from ceph_tpu.utils.tracing import span

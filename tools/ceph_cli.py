@@ -7,15 +7,25 @@ src/common/admin_socket.cc; `ceph config set/get` via
 src/mon/ConfigMonitor.cc; the prometheus scrape via
 src/pybind/mgr/prometheus/module.py).
 
-The cluster is hermetic (SimCluster), so the CLI builds one from a
-scenario first, then answers against it:
+TWO modes:
+
+* LIVE (`--asok-dir DIR`): answer against a RUNNING standalone
+  cluster through its daemons' Unix admin sockets — status / health /
+  prometheus render from the monitors' MgrReport-aggregated REAL
+  counters, and `daemon <name> <cmd>` talks straight to one daemon's
+  asok (perf dump, dump_historic_ops, log dump, trace start/stop...).
+  The cluster passes its `admin_dir` here (StandaloneCluster prints
+  nothing; tests and benches own the handle).
+* HERMETIC (default): build a SimCluster from a scenario first, then
+  answer against it — the deterministic demo path.
 
   python tools/ceph_cli.py status
-  python tools/ceph_cli.py --scenario osd-failure status
   python tools/ceph_cli.py --scenario osd-failure pg stat
-  python tools/ceph_cli.py --scenario mon-loss health
-  python tools/ceph_cli.py perf dump
-  python tools/ceph_cli.py prometheus
+  python tools/ceph_cli.py --asok-dir /tmp/ceph-asok-X status
+  python tools/ceph_cli.py --asok-dir /tmp/ceph-asok-X health detail
+  python tools/ceph_cli.py --asok-dir /tmp/ceph-asok-X prometheus
+  python tools/ceph_cli.py --asok-dir /tmp/ceph-asok-X \\
+      daemon osd.0 perf dump
   python tools/ceph_cli.py config set osd_max_backfills 4
 """
 
@@ -31,6 +41,71 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("healthy", "osd-failure", "mon-loss", "backfill")
+
+
+# -- live mode: a running standalone cluster over its admin sockets ----------
+
+def live_mon_command(asok_dir: str, kind: str):
+    """Hunt the monitors' admin sockets; first answer wins (any
+    monitor folds every daemon's MgrReports independently)."""
+    import glob
+    from ceph_tpu.utils.admin_socket import (AdminSocketError,
+                                             admin_command)
+    socks = sorted(glob.glob(os.path.join(asok_dir, "mon.*.asok")))
+    if not socks:
+        raise SystemExit(f"no mon.*.asok under {asok_dir} "
+                         f"(is the cluster running?)")
+    last = None
+    for p in socks:
+        try:
+            return admin_command(p, kind)
+        except (OSError, AdminSocketError) as e:
+            last = e
+    raise SystemExit(f"no monitor answered {kind!r}: {last}")
+
+
+def live_daemon_command(asok_dir: str, name: str, cmd: str):
+    from ceph_tpu.utils.admin_socket import admin_command
+    path = os.path.join(asok_dir, f"{name}.asok")
+    if not os.path.exists(path):
+        raise SystemExit(f"no admin socket {path}")
+    return admin_command(path, cmd)
+
+
+def cmd_live_status(asok_dir: str, args) -> None:
+    st = live_mon_command(asok_dir, "status")
+    if args.json:
+        print(json.dumps(st, sort_keys=True))
+        return
+    quorum = st.get("mon_quorum") or []
+    print("  cluster:")
+    print(f"    health: {st['health']}"
+          + (f" ({', '.join(st['checks'])})" if st["checks"] else ""))
+    print("  services:")
+    print(f"    mon: {len(st['mon_members'])} monitors, quorum "
+          f"{quorum}, leader mon.{st['mon_leader']}")
+    print(f"    osd: {st['num_osds']} osds: {st['osds_up']} up, "
+          f"{st['osds_in']} in (epoch {st['epoch']})")
+    print("  data:")
+    states = ", ".join(f"{n} {s}" for s, n in
+                       sorted(st["pg_states"].items())) or "none "
+    print(f"    pgs: {states} ({st['pgs_total']} total)")
+    print(f"    io: {st['ops_in_flight']} ops in flight, "
+          f"{st['slow_ops']} slow "
+          f"({st['daemons_reporting']} daemons reporting)")
+
+
+def cmd_live_health(asok_dir: str, args, detail: bool) -> None:
+    h = live_mon_command(asok_dir,
+                         "health detail" if detail else "health")
+    if args.json:
+        print(json.dumps(h, sort_keys=True))
+        return
+    print(h["status"])
+    for c in h["checks"]:
+        print(f"  {c['code']}: {c['summary']}")
+        for line in c.get("detail") or []:
+            print(f"      {line}")
 
 
 def build_cluster(name: str, n_osds: int, pg_num: int):
@@ -243,9 +318,22 @@ def main(argv=None) -> None:
     ap.add_argument("--num-osds", type=int, default=12)
     ap.add_argument("--pg-num", type=int, default=8)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--asok-dir", default=None,
+                    help="LIVE mode: a running standalone cluster's "
+                         "admin-socket dir (its .admin_dir); status/"
+                         "health/prometheus/perf/pg answer from the "
+                         "monitors' MgrReport aggregate instead of a "
+                         "hermetic scenario")
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("status")
-    sub.add_parser("health")
+    hp = sub.add_parser("health")
+    hp.add_argument("detail", nargs="?", choices=["detail"])
+    dm = sub.add_parser(
+        "daemon", help="LIVE mode: `ceph daemon <name> <cmd>` against "
+                       "one daemon's admin socket")
+    dm.add_argument("name", help="daemon name, e.g. osd.0 / mon.1")
+    dm.add_argument("daemon_cmd", nargs=argparse.REMAINDER,
+                    help="command words, e.g. perf dump")
     sub.add_parser("df")
     sub.add_parser("osd-df")
     pg = sub.add_parser("pg")
@@ -265,6 +353,44 @@ def main(argv=None) -> None:
     cfg.add_argument("name", nargs="?")
     cfg.add_argument("value", nargs="?")
     args = ap.parse_args(argv)
+
+    if args.cmd == "daemon" and not args.asok_dir:
+        raise SystemExit("`daemon` needs --asok-dir (live mode only)")
+    if args.asok_dir:
+        # LIVE mode: no hermetic cluster — answer over admin sockets
+        if args.cmd == "status":
+            cmd_live_status(args.asok_dir, args)
+        elif args.cmd == "health":
+            cmd_live_health(args.asok_dir, args,
+                            detail=args.detail == "detail")
+        elif args.cmd == "prometheus":
+            sys.stdout.write(
+                live_mon_command(args.asok_dir, "prometheus")["text"])
+        elif args.cmd == "perf":
+            print(json.dumps(live_mon_command(args.asok_dir,
+                                              "perf dump"),
+                             indent=None if args.json else 2,
+                             sort_keys=True))
+        elif args.cmd == "pg":
+            daemons = live_mon_command(args.asok_dir, "report dump")
+            pgs: dict = {}
+            for ent in sorted(daemons.values(),
+                              key=lambda e: e.get("epoch", 0)):
+                pgs.update(ent.get("pgs") or {})
+            if args.json:
+                print(json.dumps(pgs, sort_keys=True))
+            else:
+                for pgid, state in sorted(pgs.items()):
+                    print(f"  {pgid}  {state}")
+        elif args.cmd == "daemon":
+            out = live_daemon_command(args.asok_dir, args.name,
+                                      " ".join(args.daemon_cmd))
+            print(json.dumps(out, indent=None if args.json else 2,
+                             sort_keys=True))
+        else:
+            raise SystemExit(f"{args.cmd!r} has no live-mode "
+                             f"implementation; drop --asok-dir")
+        return
 
     c = build_cluster(args.scenario, args.num_osds, args.pg_num)
     if args.cmd == "status":

@@ -109,6 +109,18 @@ def main(argv=None) -> None:
             def read(names):
                 return wire_client.read_many(names)
         ob = _WireOb()
+
+        def perf_snapshot():
+            """Perf dumps of every live daemon + the bench client —
+            before/after deltas ship in the JSON so the bench carries
+            its own per-stage attribution (msgr frames, op-window
+            stalls, encode launches, cephx rounds)."""
+            snap = {d.name: d.perf_dump_all()
+                    for d in c.osds.values() if not d._stop.is_set()}
+            snap["client"] = {
+                "rpc": wire_client.rpc.perf.dump(),
+                "msgr": wire_client.msgr.perf.dump()}
+            return snap
     else:
         from ceph_tpu.client.rados import Rados
         from ceph_tpu.osd.cluster import SimCluster
@@ -119,6 +131,10 @@ def main(argv=None) -> None:
             raise SystemExit(f"rados_bench: {e}")
         io = Rados(c).open_ioctx()
         ob = io._ob
+
+        def perf_snapshot():
+            return {"cluster": c.perf.dump(),
+                    "objecter": io._ob.perf.dump()}
     rng = np.random.default_rng(0)
 
     def batch(i):
@@ -158,6 +174,7 @@ def main(argv=None) -> None:
         for wi in range(3):
             ob.write(batch(f"warmup{wi}"))
         warm_buckets(ob.write)
+        perf_before = perf_snapshot()
         t_start = time.perf_counter()
         t_end = t_start + args.seconds
         i = 0
@@ -181,6 +198,7 @@ def main(argv=None) -> None:
             staged.update(objs)
         warm_buckets(ob.write, ob.read)
         names = sorted(staged)
+        perf_before = perf_snapshot()
         t0_all = time.perf_counter()
         t_end = t0_all + args.seconds
         k = 0
@@ -194,6 +212,19 @@ def main(argv=None) -> None:
             k += 1
         dt = time.perf_counter() - t0_all
 
+    from ceph_tpu.utils.perf_counters import dump_delta
+    perf_delta = dump_delta(perf_before, perf_snapshot())
+    if args.transport == "standalone":
+        # sum the per-OSD deltas per logger/key so the attribution is
+        # one readable table (per-daemon detail is in the raw dumps)
+        from ceph_tpu.mgr.reports import _normalized
+        from ceph_tpu.utils.perf_counters import fold_delta
+        osd_total: dict = {}
+        for name, dump in perf_delta.items():
+            if name.startswith("osd."):
+                osd_total = fold_delta(osd_total, _normalized(dump))
+        perf_delta = {"osd_total": osd_total,
+                      "client": perf_delta.get("client", {})}
     total_bytes = nobj * args.object_size
     out = {
         "workload": args.workload, "pool": args.pool,
@@ -204,6 +235,10 @@ def main(argv=None) -> None:
         "ops_per_s": round(len(lat) / dt, 1),
         "objects_per_s": round(nobj / dt, 1),
         **percentiles(lat),
+        # counter-delta attribution over the timed window (declared
+        # PerfCounters only): every BENCH_* number carries its own
+        # per-stage breakdown
+        "perf_delta": perf_delta,
         # machine-readable run config, same shape bench.py commits in
         # wire_rados_bench["config"] — CI diffs the whole dict
         "config": {

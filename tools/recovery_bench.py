@@ -84,7 +84,9 @@ def main(argv=None) -> None:
             cluster.stores.pop(be.acting[s], None)
         repl = {s: 2000 + s for s in lost}
 
+    from ceph_tpu.utils.perf_counters import dump_delta
     from ceph_tpu.utils.tracing import trace
+    perf_before = be.perf.dump()
     t0 = time.perf_counter()
     if args.trace:
         # trace ONLY the recovery phase: the write-path compile noise
@@ -114,6 +116,11 @@ def main(argv=None) -> None:
         "recovered_MBps": round(counters["bytes"] / t_rec / 1e6, 1),
         "hinfo_failures": counters["hinfo_failures"],
         "backend": jax.default_backend(),
+        # per-stage attribution over the timed recovery (the "ec"
+        # logger's declared counters): launches, program-cache
+        # hits, stage/launch/fetch/writeback time split
+        "perf_delta": {"ec": dump_delta(perf_before,
+                                        be.perf.dump())},
     }
     if args.json:
         print(json.dumps(stats))
