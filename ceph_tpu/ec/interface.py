@@ -116,7 +116,26 @@ class ErasureCode(abc.ABC):
                     continue
             fn = make_encoder(R, impl) if R is not None else False
             cache[(erasures, survivors)] = fn
+            if R is not None:
+                self.__dict__.setdefault("_bd_keys", {})[
+                    (erasures, survivors)] = (
+                        "lin", R.tobytes(), R.shape, impl)
         return fn or None
+
+    def decode_program_key(self, erasures: Sequence[int],
+                           survivors: Sequence[int]):
+        """Hashable identity of batch_decoder's compiled program, EQUAL
+        across coder instances with the same geometry — the process-wide
+        recovery program cache key (a per-backend cache recompiles the
+        identical HLO once per PG per daemon; the write path learned
+        this in round 8). None when there is no static form (callers
+        fall back to caching per coder instance)."""
+        erasures = tuple(int(e) for e in erasures)
+        survivors = tuple(int(s) for s in survivors)
+        if self.batch_decoder(erasures, survivors) is None:
+            return None
+        return self.__dict__.get("_bd_keys", {}).get(
+            (erasures, survivors))
 
     # -- availability ------------------------------------------------------
 

@@ -105,6 +105,10 @@ def lib() -> ctypes.CDLL:
     L.ec_crc32c.restype = ctypes.c_uint32
     L.ec_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
                             ctypes.c_int64]
+    L.ec_crc32c_hw.restype = ctypes.c_int
+    L.ec_crc32c_rows.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
+                                 ctypes.c_int64, ctypes.c_int64,
+                                 ctypes.POINTER(ctypes.c_uint32)]
     L.__erasure_code_init.restype = ctypes.c_int
     L.__erasure_code_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     L.ec_registered_plugin.restype = ctypes.c_char_p
@@ -143,6 +147,30 @@ def native_crc32c(seed: int, data: bytes | np.ndarray) -> int:
     buf = bytes(data) if not isinstance(data, np.ndarray) else \
         np.ascontiguousarray(data, np.uint8).tobytes()
     return int(lib().ec_crc32c(seed & 0xFFFFFFFF, buf, len(buf)))
+
+
+def crc32c_hw() -> bool:
+    """True when the .so is built and ec_crc32c runs on the SSE4.2
+    CRC32 instruction (the rate the recovery host-integrity path
+    assumes; the table fallback is ~20x slower)."""
+    try:
+        return ready() and bool(lib().ec_crc32c_hw())
+    except (NativeUnavailable, OSError, AttributeError):
+        return False
+
+
+def native_crc32c_rows(seed: int, rows: np.ndarray) -> np.ndarray:
+    """Raw-register crc32c of each row of a (B, L) uint8 stack in ONE
+    ctypes crossing — the recovery pipeline's host checksum path."""
+    rows = np.ascontiguousarray(rows, np.uint8)
+    if rows.ndim != 2:
+        raise ValueError(f"want (B, L), got {rows.shape}")
+    out = np.empty(rows.shape[0], np.uint32)
+    lib().ec_crc32c_rows(
+        seed & 0xFFFFFFFF, rows.ctypes.data_as(ctypes.c_void_p),
+        rows.shape[0], rows.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
 
 
 def aes256gcm_supported() -> bool:

@@ -35,16 +35,24 @@ import numpy as np
 from .rs_kernels import DEFAULT_IMPL, apply_matrix, make_encoder
 
 
-def make_tiled_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
-                       tile: int = 1 << 20):
-    """Jitted (B, k, L) -> (B, m, L) that internally lax.maps over
-    L/tile chunk tiles. L must be a multiple of `tile` (the stripe
-    layer already pads chunks to alignment)."""
+@functools.lru_cache(maxsize=256)
+def _shared_encoder(matrix_bytes: bytes, m: int, k: int, impl: str):
+    """Process-wide program cache for streaming/tiled codecs: every
+    instance with the same (matrix, impl) shares ONE jitted kernel —
+    per-instance make_encoder recompiled the identical HLO once per
+    PG backend (the same lesson the write path and the r10 recovery
+    program cache already encode)."""
+    matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k)
+    return make_encoder(matrix, impl)
+
+
+@functools.lru_cache(maxsize=64)
+def _tiled_encoder_cached(matrix_bytes: bytes, m: int, k: int,
+                          impl: str, tile: int):
     import jax
     import jax.numpy as jnp
 
-    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
-    m, k = matrix.shape
+    matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k)
 
     @jax.jit
     def enc(data):
@@ -63,6 +71,18 @@ def make_tiled_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
         return jnp.moveaxis(out, 0, 2).reshape(B, m, L)
 
     return enc
+
+
+def make_tiled_encoder(matrix: np.ndarray, impl: str = DEFAULT_IMPL,
+                       tile: int = 1 << 20):
+    """Jitted (B, k, L) -> (B, m, L) that internally lax.maps over
+    L/tile chunk tiles. L must be a multiple of `tile` (the stripe
+    layer already pads chunks to alignment). Process-wide cached per
+    (matrix, impl, tile)."""
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    m, k = matrix.shape
+    return _tiled_encoder_cached(matrix.tobytes(), m, k, impl,
+                                 int(tile))
 
 
 class StreamingCodec:
@@ -84,7 +104,8 @@ class StreamingCodec:
         self.m, self.k = matrix.shape
         self.tile = int(tile)
         self.depth = depth  # in-flight tiles (double buffering = 2)
-        self._fn = make_encoder(matrix, impl)
+        self._fn = _shared_encoder(matrix.tobytes(), self.m, self.k,
+                                   impl)
         # optional instrumentation: a PerfCounters with
         # stream_launches / stream_bytes / stream_drain_time declared
         # (the daemon's "ec" logger fits; None = uncounted)

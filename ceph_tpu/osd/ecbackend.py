@@ -39,6 +39,7 @@ measures (objects/s).
 from __future__ import annotations
 
 import functools as _functools
+import threading as _threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -699,139 +700,21 @@ class ECBackend(PGBackend):
 
     # -- recovery (the objects/s metric) -------------------------------------
 
-    def _fused_recover_fn(self, dec_fn, sl: int, verify: bool):
-        """ONE device launch per recovery batch: helper-CRC verify +
-        decode + rebuilt-CRC, all device-resident between stages (the
-        r01 path dispatched ~k+2 launches with host round-trips between
-        them — SURVEY §2.7 P5). Cached per (decoder, shard length,
-        verify); with verify off the helper CRCs are never computed."""
-        import jax
-        import jax.numpy as jnp
-
-        key = (id(dec_fn), sl, verify)
-        fn = self._fused_cache.get(key)
-        self.perf.inc("program_cache_hits" if fn is not None
-                      else "program_cache_misses")
-        if fn is None:
-            from ..csum.kernels import crc32c_blocks
-
-            def fused(stack, exp):        # (B, H, sl) u8, (B, H) u32
-                B, H, _ = stack.shape
-                rebuilt = dec_fn(stack)   # (B, E, sl)
-                E = rebuilt.shape[1]
-                rcrc = crc32c_blocks(rebuilt.reshape(B * E, sl),
-                                     init=0xFFFFFFFF,
-                                     xorout=0).reshape(B, E)
-                if verify:
-                    hcrc = crc32c_blocks(stack.reshape(B * H, sl),
-                                         init=0xFFFFFFFF,
-                                         xorout=0).reshape(B, H)
-                    ok = hcrc == exp
-                else:
-                    ok = jnp.ones((B, H), dtype=bool)
-                return rebuilt, rcrc, ok
-            fn = jax.jit(fused)
-            self._fused_cache[key] = fn
-        return fn
-
-    def _gather_helper_stack(self, helper: list[int], subgroup: list[str],
-                             sl: int,
-                             want_hinfo: bool) -> tuple[np.ndarray, np.ndarray]:
-        """Host-side staging: helper chunks (B, H, sl) + their expected
-        hinfo CRCs (B, H) (zeros when hinfo isn't wanted — the xattr
-        may legitimately be absent then)."""
-        B, H = len(subgroup), len(helper)
-        stack = np.empty((B, H, sl), dtype=np.uint8)
-        exp = np.zeros((B, H), dtype=np.uint32)
-        for hi, s in enumerate(helper):
-            st = self._store(s)
-            cid = shard_cid(self.pg, s)
-            batch_read = getattr(st, "read_batch", None)
-            if batch_read is not None:
-                batch_read(cid, subgroup, sl, out=stack[:, hi, :])
-            else:
-                for bi, name in enumerate(subgroup):
-                    stack[bi, hi] = st.read(cid, name)
-            if want_hinfo:
-                for bi, name in enumerate(subgroup):
-                    hb = st.getattr(cid, name, HINFO_KEY)
-                    exp[bi, hi] = HashInfo.from_bytes(hb).get_chunk_hash(0)
-        return stack, exp
-
-    def _recover_fallback(self, lost: list[int], survivors: list[int],
-                          bad_pairs: dict[str, set[int]],
-                          subgroup: list[str], rebuilt_all: np.ndarray,
-                          counters: dict) -> None:
-        """Re-decode objects whose helper reads failed hinfo, batched by
-        identical bad-shard set (one decode launch per distinct set
-        instead of the r01 per-object loop)."""
-        by_bad: dict[tuple[int, ...], list[str]] = {}
-        for name, bad in bad_pairs.items():
-            by_bad.setdefault(tuple(sorted(bad)), []).append(name)
-        for bad, names_ in by_bad.items():
-            alt = [s for s in survivors if s not in bad]
-            alt_need = sorted(self.coder.minimum_to_decode(lost, alt))
-            stacks = {s: np.stack([self._store(s).read(
-                shard_cid(self.pg, s), n) for n in names_])
-                for s in alt_need}
-            alt_rec = self.coder.decode_chunks(lost, stacks)
-            for li, s in enumerate(lost):
-                rec_s = np.asarray(alt_rec[s])
-                for ni, name in enumerate(names_):
-                    rebuilt_all[subgroup.index(name), li] = rec_s[ni]
-
-    def _writeback_rebuilt(self, lost: list[int], subgroup: list[str],
-                           rebuilt_all: np.ndarray, crcs: np.ndarray,
-                           sl: int, counters: dict) -> None:
-        # ONE combined txn per replacement shard for the whole batch
-        # (the write-path fan-out unit), pipelined across shards — at
-        # the wire tier this is len(lost) overlapped MStoreOp frames
-        # per batch instead of len(lost) * B sequential ones
-        txns = []
-        for li, s in enumerate(lost):
-            cid = shard_cid(self.pg, s)
-            t = Transaction()
-            for bi, name in enumerate(subgroup):
-                chunk = rebuilt_all[bi, li]
-                hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
-                t.write(cid, name, 0, chunk) \
-                 .truncate(cid, name, sl) \
-                 .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
-                counters["bytes"] += int(chunk.size)
-            txns.append((s, t))
-        self._fanout_txns(txns)
-        counters["objects"] += len(subgroup)
-
-    def recover_shards(self, lost_shards: list[int],
-                       replacement_osds: dict[int, int] | None = None,
-                       batch: int = 128,
-                       verify_hinfo: bool = True,
-                       names: list[str] | None = None,
-                       helper_exclude: set[int] | None = None) -> dict:
-        """Rebuild every object's lost shard(s): the RecoveryOp loop,
-        batched AND pipelined. Returns counters {objects, bytes,
-        hinfo_failures}.
-
-        Dataflow (ref: ECBackend::continue_recovery_op streaming, P5):
-        for codecs with a static decode matrix (batch_decoder), each
-        sub-batch is ONE fused device launch (helper-CRC + decode +
-        rebuilt-CRC); launches are enqueued asynchronously and results
-        fetched one batch behind, so host staging of batch i+1 overlaps
-        device compute of batch i (double buffering). Codecs without a
-        static matrix (clay/lrc local plans) take the generic
-        decode_chunks path, still batched per launch.
-
-        lost_shards: shard slots whose OSD died.
-        replacement_osds: slot -> new OSD id (defaults to reusing the
-        slot's OSD id, i.e. re-created store after replacement).
-        names: restrict recovery to these objects — the PG-log
-        delta-replay path (a revived shard rebuilds only what it
-        missed; ref: PGLog-driven recovery vs backfill).
-        helper_exclude: shard slots that must not serve helper reads
-        (other still-down OSDs during a partial rejoin).
-        """
-        import jax
-
+    def plan_recovery(self, lost_shards: list[int],
+                      replacement_osds: dict[int, int] | None = None,
+                      verify_hinfo: bool = True,
+                      names: list[str] | None = None,
+                      helper_exclude: set[int] | None = None
+                      ) -> "_RecoveryPlan":
+        """Open one PG's recovery intent: validate the plan, point the
+        lost slots at their replacement OSDs, replay deletes and empty
+        objects immediately, and return the rebuild work (names grouped
+        by shard length) for a RecoveryRunner to execute — possibly
+        FUSED with other PGs' plans into shared decode launches (the
+        cross-PG batch formation the per-PG reconcile round lacked).
+        Raises ValueError before any mutation when the plan is
+        impossible (insufficient live helpers), exactly like the old
+        monolithic recover_shards."""
         lost = sorted(set(lost_shards))
         if len(lost) > self.m:
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
@@ -859,12 +742,11 @@ class ECBackend(PGBackend):
             self.acting[s] = new_osd
             t = Transaction().create_collection(shard_cid(self.pg, s))
             self.cluster.osd(new_osd).queue_transaction(t)
-        counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+        plan = _RecoveryPlan(self, lost, helper, survivors,
+                             verify_hinfo, full_plan, provided)
         # names whose last log entry was a DELETE replay as removals
         names = self._replay_deletes(lost, names)
 
-        # split into (shard_len, subgroup) jobs of <= batch objects
-        by_len: dict[int, list[str]] = {}
         for name in names:
             if self.object_sizes[name] == 0:
                 hinfo = HashInfo(1, 0, [0xFFFFFFFF])
@@ -878,166 +760,108 @@ class ECBackend(PGBackend):
                          .setattr(shard_cid(self.pg, s), name,
                                   HINFO_KEY, hinfo.to_bytes()))
                     self._store(s).queue_transaction(t)
-                counters["objects"] += 1
+                plan.counters["objects"] += 1
                 continue
-            by_len.setdefault(self._shard_len(self.object_sizes[name]),
-                              []).append(name)
-        jobs = [(sl, group[i:i + batch])
-                for sl, group in by_len.items()
-                for i in range(0, len(group), batch)]
+            plan.names_by_len.setdefault(
+                self._shard_len(self.object_sizes[name]),
+                []).append(name)
+        plan.remaining = {n for g in plan.names_by_len.values()
+                          for n in g}
+        if plan.names_by_len:
+            plan.dec_fn = self.coder.batch_decoder(lost, helper)
+            if plan.dec_fn is not None:
+                key = self.coder.decode_program_key(lost, helper)
+                # id()-keyed fallbacks stay in the BACKEND's cache (a
+                # process-wide id key could alias a dead object)
+                plan.group_key = key if key is not None else None
+        return plan
 
-        dec_fn = self.coder.batch_decoder(lost, helper) if jobs else None
-        pending: list[tuple] = []  # (sl, subgroup, device handles)
+    def recover_shards(self, lost_shards: list[int],
+                       replacement_osds: dict[int, int] | None = None,
+                       batch: int = 128,
+                       verify_hinfo: bool = True,
+                       names: list[str] | None = None,
+                       helper_exclude: set[int] | None = None) -> dict:
+        """Rebuild every object's lost shard(s): the RecoveryOp loop,
+        batched AND pipelined. Returns counters {objects, bytes,
+        hinfo_failures}. One-plan convenience over plan_recovery +
+        RecoveryRunner — the cross-PG reconcile pass feeds MANY plans
+        to one runner instead.
 
-        def complete(entry) -> None:
-            sl, subgroup, handles = entry
-            rebuilt_d, rcrc_d, ok_d = handles
-            with span("ecbackend.recover.fetch", counters=self.perf,
-                      key="recover_fetch_time"):
-                rebuilt_all, crcs, ok = jax.device_get(
-                    (rebuilt_d, rcrc_d, ok_d))
-            bad_pairs: dict[str, set[int]] = {}
-            if verify_hinfo and not ok.all():
-                for bi, hi in zip(*np.nonzero(~ok)):
-                    counters["hinfo_failures"] += 1
-                    bad_pairs.setdefault(subgroup[bi], set()).add(
-                        helper[hi])
-            if bad_pairs:
-                # device_get hands back read-only buffers; the fallback
-                # patches rebuilt rows in place
-                rebuilt_all = np.array(rebuilt_all)
-                self._recover_fallback(lost, survivors, bad_pairs,
-                                       subgroup, rebuilt_all, counters)
-                # CRCs of re-decoded chunks changed; recompute for those
-                idxs = sorted(subgroup.index(n) for n in bad_pairs)
-                fix = self._batched_hinfo_crcs(
-                    rebuilt_all[idxs].reshape(-1, sl)).reshape(
-                        len(idxs), len(lost))
-                crcs = np.array(crcs)
-                crcs[idxs] = fix
-            with span("ecbackend.recover.writeback", counters=self.perf,
-                      key="recover_writeback_time"):
-                self._writeback_rebuilt(lost, subgroup, rebuilt_all,
-                                        crcs, sl, counters)
+        Dataflow (ref: ECBackend::continue_recovery_op streaming, P5):
+        for codecs with a static decode matrix (batch_decoder), each
+        sub-batch is ONE fused device launch (decode + helper XOR-fold;
+        integrity rides the fold — see RecoveryRunner); launches are
+        enqueued asynchronously with copy_to_host_async, so results
+        stream back one batch behind (double buffering). Codecs
+        without a static matrix take the generic decode_chunks path,
+        still batched per launch.
 
-        if dec_fn is not None and jobs:
-            # fused path, three-stage pipeline: a producer thread
-            # stages batch i+1 (store reads + hinfo parses, pure host
-            # work) WHILE batch i's launch computes on device and
-            # batch i-1's results write back — staging, compute and
-            # writeback all overlap (SURVEY §2.7 P5 both directions)
-            import queue as _queue
-            import threading as _threading
-            stageq: "_queue.Queue" = _queue.Queue(maxsize=2)
-            stage_err: list[BaseException] = []
-            stop = _threading.Event()
+        lost_shards: shard slots whose OSD died.
+        replacement_osds: slot -> new OSD id (defaults to reusing the
+        slot's OSD id, i.e. re-created store after replacement).
+        names: restrict recovery to these objects — the PG-log
+        delta-replay path (a revived shard rebuilds only what it
+        missed; ref: PGLog-driven recovery vs backfill).
+        helper_exclude: shard slots that must not serve helper reads
+        (other still-down OSDs during a partial rejoin).
+        """
+        plan = self.plan_recovery(lost_shards, replacement_osds,
+                                  verify_hinfo, names, helper_exclude)
+        RecoveryRunner([plan], batch=batch, perf=self.perf).run()
+        return plan.counters
 
-            def _put(item) -> None:
-                # bounded put that aborts if the consumer died (a
-                # blocked put would pin staged batches and leak this
-                # thread for the process lifetime)
-                while not stop.is_set():
-                    try:
-                        stageq.put(item, timeout=0.5)
-                        return
-                    except _queue.Full:
-                        continue
-
-            def _producer() -> None:
-                try:
-                    for sl_, subgroup_ in jobs:
-                        if stop.is_set():
-                            return
-                        with span("ecbackend.recover.stage",
-                                  counters=self.perf,
-                                  key="recover_stage_time"):
-                            stack_, exp_ = self._gather_helper_stack(
-                                helper, subgroup_, sl_, verify_hinfo)
-                        _put((sl_, subgroup_, stack_, exp_))
-                except BaseException as e:   # noqa: BLE001 — re-raised
-                    stage_err.append(e)      # in the consumer
-                finally:
-                    # the sentinel MUST go through the same bounded
-                    # put: dropping it on a full queue would leave the
-                    # consumer blocked on get() forever
-                    _put(None)
-
-            t = _threading.Thread(target=_producer, daemon=True)
-            t.start()
-            try:
-                while True:
-                    item = stageq.get()
-                    if item is None:
-                        break
-                    sl, subgroup, stack, exp = item
-                    self.perf.inc("recover_launches")
-                    with span("ecbackend.recover.launch",
-                              counters=self.perf,
-                              key="recover_launch_time"):
-                        handles = self._fused_recover_fn(
-                            dec_fn, sl, verify_hinfo)(stack, exp)
-                        # start the D2H transfer NOW (async): by the
-                        # time complete() blocks in device_get, batch
-                        # i's results are already streaming to the
-                        # host underneath batch i+1's launch — the r06
-                        # trace showed the blocking fetch (~60 ms/
-                        # batch) as the warm path's critical section
-                        for h in handles:
-                            try:
-                                h.copy_to_host_async()
-                            except AttributeError:
-                                break   # non-jax handle (test stub)
-                    pending.append((sl, subgroup, handles))
-                    if len(pending) >= 2:
-                        complete(pending.pop(0))
-            finally:
-                stop.set()
-                while True:        # unblock a producer mid-put
-                    try:
-                        stageq.get_nowait()
-                    except _queue.Empty:
-                        break
-                t.join()
-            if stage_err:
-                raise stage_err[0]
-            while pending:
-                complete(pending.pop(0))
-            self._mark_caught_up(lost, full_plan, provided)
-            self._count_recovery(counters)
-            return counters
-
-        # generic path (codecs without a static plan): batched per
-        # launch but not fused
-        for sl, subgroup in jobs:
-            self.perf.inc("recover_launches")
+    def _recover_fallback(self, lost: list[int], survivors: list[int],
+                          bad_pairs: dict[str, set[int]],
+                          subgroup: list[str], rebuilt_all: np.ndarray,
+                          counters: dict) -> None:
+        """Re-decode objects whose helper reads failed hinfo, batched by
+        identical bad-shard set (one decode launch per distinct set
+        instead of the r01 per-object loop)."""
+        by_bad: dict[tuple[int, ...], list[str]] = {}
+        for name, bad in bad_pairs.items():
+            by_bad.setdefault(tuple(sorted(bad)), []).append(name)
+        for bad, names_ in by_bad.items():
+            alt = [s for s in survivors if s not in bad]
+            alt_need = sorted(self.coder.minimum_to_decode(lost, alt))
             stacks = {s: np.stack([self._store(s).read(
-                shard_cid(self.pg, s), n) for n in subgroup])
-                for s in helper}
-            bad_pairs: dict[str, set[int]] = {}
-            if verify_hinfo:
-                for s in helper:
-                    crcs_s = self._batched_hinfo_crcs(stacks[s])
-                    for bi, name in enumerate(subgroup):
-                        hb = self._store(s).getattr(
-                            shard_cid(self.pg, s), name, HINFO_KEY)
-                        if HashInfo.from_bytes(hb).get_chunk_hash(0) \
-                                != int(crcs_s[bi]):
-                            counters["hinfo_failures"] += 1
-                            bad_pairs.setdefault(name, set()).add(s)
-            rec = self.coder.decode_chunks(lost, stacks)
-            rebuilt_all = np.stack(
-                [np.asarray(rec[s]) for s in lost], axis=1)
-            if bad_pairs:
-                self._recover_fallback(lost, survivors, bad_pairs,
-                                       subgroup, rebuilt_all, counters)
-            crcs = self._batched_hinfo_crcs(
-                rebuilt_all.reshape(-1, sl)).reshape(len(subgroup),
-                                                     len(lost))
-            self._writeback_rebuilt(lost, subgroup, rebuilt_all,
-                                    crcs, sl, counters)
-        self._mark_caught_up(lost, full_plan, provided)
-        self._count_recovery(counters)
-        return counters
+                shard_cid(self.pg, s), n) for n in names_])
+                for s in alt_need}
+            alt_rec = self.coder.decode_chunks(lost, stacks)
+            for li, s in enumerate(lost):
+                rec_s = np.asarray(alt_rec[s])
+                for ni, name in enumerate(names_):
+                    rebuilt_all[subgroup.index(name), li] = rec_s[ni]
+
+    def _writeback_rebuilt(self, lost: list[int], subgroup: list[str],
+                           rebuilt_all: np.ndarray, crcs: np.ndarray,
+                           sl: int, counters: dict,
+                           window: "RecoveryRunner | None" = None) -> None:
+        # ONE combined txn per replacement shard for the whole batch
+        # (the write-path fan-out unit), pipelined across shards — at
+        # the wire tier this is len(lost) overlapped MStoreOp frames
+        # per batch instead of len(lost) * B sequential ones. With a
+        # `window`, the push rides the runner's byte-budgeted in-flight
+        # window instead: frames of LATER batches go out before these
+        # acks return (acks are collected as the budget fills and at
+        # finish()), the recovery analog of the client op window.
+        txns = []
+        for li, s in enumerate(lost):
+            cid = shard_cid(self.pg, s)
+            t = Transaction()
+            for bi, name in enumerate(subgroup):
+                chunk = rebuilt_all[bi, li]
+                hinfo = HashInfo(1, sl, [int(crcs[bi, li])])
+                t.write(cid, name, 0, chunk) \
+                 .truncate(cid, name, sl) \
+                 .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
+                counters["bytes"] += int(chunk.size)
+            txns.append((s, t))
+        if window is None:
+            self._fanout_txns(txns)
+        else:
+            window.push_txns(self, txns, len(subgroup) * sl)
+        counters["objects"] += len(subgroup)
 
     def _count_recovery(self, counters: dict) -> None:
         self.perf.inc_many(
@@ -1087,3 +911,591 @@ class ECBackend(PGBackend):
                     if hinfo.get_chunk_hash(0) != int(crcs[bi]):
                         bad.append((n, s))
         return {"checked": checked, "inconsistent": bad}
+
+
+# -- cross-PG recovery engine -------------------------------------------------
+
+_RECOVER_PROGRAMS: dict = {}
+_RECOVER_PROGRAMS_LOCK = _threading.Lock()
+
+#: one shard-fetch frame's byte budget (readv chunks larger batches so
+#: a single source OSD never serializes a multi-MiB frame per pull)
+RECOVERY_FETCH_BYTES = 8 << 20
+
+
+@_functools.lru_cache(maxsize=1)
+def _host_crc_available() -> bool:
+    """Host-integrity mode: on the CPU backend with the native SSE4.2
+    crc32c built, checksums run ~20x faster as host instructions than
+    as gather-bound XLA programs — the device then runs DECODE ONLY
+    (plus the helper XOR-fold) and integrity moves off the launch.
+    On a real accelerator the device checksum is nearly free and the
+    host would serialize, so this stays device-side there."""
+    import jax
+    if jax.default_backend() != "cpu":
+        return False
+    try:
+        from .. import native
+        return native.ready() and native.crc32c_hw()
+    except Exception:   # noqa: BLE001 — any native trouble = no mode
+        return False
+
+
+@_functools.lru_cache(maxsize=256)
+def _fold_seed_const(sl: int) -> int:
+    """shift^{sl}(0xFFFFFFFF): the seed contribution inside a raw
+    hinfo CRC of an sl-byte row (crc_{-1}(m) = crc_0(m) ^ K)."""
+    from ..csum.reference import apply_shift
+    return int(apply_shift(0xFFFFFFFF, sl))
+
+
+def _expected_fold_crcs(exp: np.ndarray, sl: int) -> np.ndarray:
+    """Expected raw CRC of the XOR-fold of H helper rows, from their
+    expected per-row hinfo CRCs. CRC32C is GF(2)-linear in the
+    message: crc_0(r0 ^ .. ^ rH) = XOR_i crc_0(r_i), and the -1 seed
+    adds the constant K = shift^{sl}(-1) per row — so H rows verify
+    with ONE data-pass checksum instead of H (arxiv 2108.02692's
+    aggregation idea applied to the verify pass; a corruption pair
+    that XOR-cancels would need a 2^-32 collision AND two rotten
+    helpers in one object)."""
+    K = np.uint32(_fold_seed_const(sl))
+    folded = np.bitwise_xor.reduce(exp.astype(np.uint32) ^ K, axis=1)
+    return folded ^ K
+
+
+def _build_recover_program(dec_fn, verify: bool, host_crc: bool):
+    """ONE jitted device program per (decode program, verify, mode) —
+    process-wide when the coder exposes a decode_program_key, so every
+    PG backend with the same geometry shares ONE compiled program (the
+    r09 tree compiled it once per PG per daemon).
+
+    host_crc mode: fn(stack) -> (rebuilt[, helper-fold]); checksums run
+    on the host (native SSE4.2). Device mode: fn(stack, expfold) ->
+    (rebuilt, rebuilt-CRCs, fold-ok) all device-resident."""
+    import jax
+    import jax.numpy as jnp
+
+    if host_crc:
+        def fused(stack):              # (B, H, sl) u8
+            rebuilt = dec_fn(stack)    # (B, E, sl)
+            if verify:
+                fold = jnp.bitwise_xor.reduce(stack, axis=1)
+                return rebuilt, fold
+            return (rebuilt,)
+        return jax.jit(fused)
+
+    from ..csum.kernels import crc32c_blocks
+
+    def fused(stack, expfold):         # (B, H, sl) u8, (B,) u32
+        B, H, L = stack.shape
+        rebuilt = dec_fn(stack)        # (B, E, L)
+        E = rebuilt.shape[1]
+        rcrc = crc32c_blocks(rebuilt.reshape(B * E, L),
+                             init=0xFFFFFFFF,
+                             xorout=0).reshape(B, E)
+        if verify:
+            fold = jnp.bitwise_xor.reduce(stack, axis=1)
+            fcrc = crc32c_blocks(fold, init=0xFFFFFFFF, xorout=0)
+            ok = fcrc == expfold
+        else:
+            ok = jnp.ones((B,), dtype=bool)
+        return rebuilt, rcrc, ok
+    return jax.jit(fused)
+
+
+class _RecoveryPlan:
+    """One PG's recovery intent (opened by ECBackend.plan_recovery):
+    the rebuild name groups plus everything a RecoveryRunner needs to
+    stage, verify, write back, and finally mark the slots caught up.
+    `remaining` shrinks as batches land — a wire-tier round that dies
+    mid-way re-plans exactly the leftover names."""
+
+    __slots__ = ("be", "lost", "helper", "survivors", "verify",
+                 "full_plan", "provided", "counters", "names_by_len",
+                 "dec_fn", "group_key", "remaining", "done")
+
+    def __init__(self, be, lost, helper, survivors, verify, full_plan,
+                 provided):
+        self.be = be
+        self.lost = list(lost)
+        self.helper = list(helper)
+        self.survivors = list(survivors)
+        self.verify = verify
+        self.full_plan = full_plan
+        self.provided = provided
+        self.counters = {"objects": 0, "bytes": 0, "hinfo_failures": 0}
+        self.names_by_len: dict[int, list[str]] = {}
+        self.dec_fn = None
+        self.group_key = None
+        self.remaining: set[str] = set()
+        self.done = False
+
+    def finish(self) -> None:
+        """Count the work done; advance applied cursors only when every
+        planned name landed (a partial round must not defeat the
+        staleness gate — the retry covers the rest)."""
+        if self.done:
+            return
+        self.done = True
+        if not self.remaining:
+            self.be._mark_caught_up(self.lost, self.full_plan,
+                                    self.provided)
+        self.be._count_recovery(self.counters)
+
+
+class RecoveryRunner:
+    """Cross-PG fused recovery: executes MANY plans as one pipeline of
+    fused decode batches (ref: ECBackend::continue_recovery_op, but the
+    unit of admission is a BATCH drawn from every primaried PG, not one
+    RecoveryOp of one PG).
+
+    Batch formation: fused plans group by (decode-program key, shard
+    length) — PGs sharing a geometry and loss pattern FILL shared
+    batches, so the round costs one launch per batch instead of one
+    per PG; mixed-geometry plans (different k/m, different loss slots)
+    ride the same pipeline side by side with their own programs. The
+    batch dim is pow2-bucketed like the write path (ragged tails would
+    compile one program per size).
+
+    Pipelining: launches dispatch async with copy_to_host_async, one
+    batch ahead (results stream back under the next batch's staging);
+    shard fetches submit per (PG, helper shard) and overlap across
+    source OSDs (windowed PULL); writeback acks collect behind a byte
+    budget (windowed PUSH). step() advances one batch at a time so the
+    wire tier's mClock worker can interleave client ops between grants.
+
+    Consistency under interleaved client ops (wire tier): the lost
+    slots were repointed at plan time, so every client mutation after
+    that reaches the recovering store directly; staging skips names
+    whose size-class changed, and writeback skips names whose version
+    moved since their stage — a skipped name needs nothing from us and
+    a write of the OLD decode would resurrect overwritten (or deleted)
+    bytes under a fresh CRC."""
+
+    def __init__(self, plans, batch: int = 128, perf=None,
+                 push_window_ops: int = 0, push_window_bytes: int = 0,
+                 host_crc: bool | None = None):
+        self.plans = [p for p in plans if p is not None]
+        self.perf = perf if perf is not None else (
+            self.plans[0].be.perf if self.plans else ec_perf_counters())
+        self.batch = max(1, int(batch))
+        self._host_crc = (_host_crc_available() if host_crc is None
+                          else bool(host_crc))
+        self._push_ops_cap = int(push_window_ops)
+        self._push_bytes_cap = int(push_window_bytes)
+        self._push: list = []        # (handle, nbytes) in-flight acks
+        self._push_bytes = 0
+        self.stats = {"batches": 0, "fused_batches": 0,
+                      "generic_batches": 0, "cross_pg_batches": 0,
+                      "push_stalls": 0, "push_max_inflight_bytes": 0,
+                      "skipped_stale": 0,
+                      "host_crc": self._host_crc}
+        self._batches: list = []
+        groups: dict = {}
+        order: list = []
+        for plan in self.plans:
+            for sl, names in sorted(plan.names_by_len.items()):
+                if plan.dec_fn is None:
+                    for i in range(0, len(names), self.batch):
+                        self._batches.append(
+                            ("generic", plan, sl,
+                             names[i:i + self.batch]))
+                    continue
+                key = (plan.group_key
+                       if plan.group_key is not None
+                       else ("inst", id(plan.be), tuple(plan.lost),
+                             tuple(plan.helper)),
+                       sl, plan.verify)
+                if key not in groups:
+                    groups[key] = []
+                    order.append(key)
+                groups[key].extend((plan, n) for n in names)
+        for key in order:
+            pairs = groups[key]
+            for i in range(0, len(pairs), self.batch):
+                sub = pairs[i:i + self.batch]
+                self._batches.append(("fused", sub[0][0], key[1], sub))
+        self._bi = 0
+        self._pending: list = []
+        self._stage_bufs: dict = {}
+
+    # -- pacing hooks (the mClock worker's inputs) -------------------------
+
+    def pending(self) -> int:
+        return (len(self._batches) - self._bi) + len(self._pending)
+
+    def next_cost(self) -> int:
+        """Bytes the next step will move — the mClock cost input."""
+        if self._bi < len(self._batches):
+            kind, plan, sl, payload = self._batches[self._bi]
+            return max(1, len(plan.helper)) * sl * len(payload)
+        if self._pending:
+            sl, pairs = self._pending[0][0], self._pending[0][1]
+            return sl * len(pairs)
+        return 1
+
+    # -- pipeline ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One pipeline advance: launch the next batch (completing the
+        oldest first when the pipeline is full) or drain one pending
+        completion. Returns True while work remains."""
+        if self._bi < len(self._batches):
+            kind, plan, sl, payload = self._batches[self._bi]
+            self._bi += 1
+            if kind == "generic":
+                self._run_generic(plan, sl, payload)
+            else:
+                self._launch(sl, payload)
+                if len(self._pending) >= 2:
+                    self._complete(self._pending.pop(0))
+        elif self._pending:
+            self._complete(self._pending.pop(0))
+        else:
+            return False
+        return self._bi < len(self._batches) or bool(self._pending)
+
+    def run(self) -> None:
+        while self.step():
+            pass
+        self.finish()
+
+    def finish(self) -> None:
+        """Drain the pipeline and the push window, then settle every
+        plan (cursor advance + counter fold)."""
+        while self._pending:
+            self._complete(self._pending.pop(0))
+        self._drain_push(0, 0)
+        for plan in self.plans:
+            plan.finish()
+
+    # -- windowed push ------------------------------------------------------
+
+    def push_txns(self, be, txns, nbytes: int) -> None:
+        """Submit writeback transactions into the in-flight window:
+        transmit now, collect acks only when the byte/op budget fills
+        (and at finish) — later batches' frames overlap these acks."""
+        for shard, t in txns:
+            st = be._store(shard)
+            submit = getattr(st, "queue_transaction_async", None)
+            if submit is None:
+                st.queue_transaction(t)
+                continue
+            if self._push_ops_cap or self._push_bytes_cap:
+                stalled = self._drain_push(
+                    (self._push_ops_cap - 1) if self._push_ops_cap
+                    else None,
+                    (self._push_bytes_cap - nbytes)
+                    if self._push_bytes_cap else None)
+                if stalled:
+                    self.stats["push_stalls"] += stalled
+            self._push.append((submit(t), nbytes))
+            self._push_bytes += nbytes
+            self.stats["push_max_inflight_bytes"] = max(
+                self.stats["push_max_inflight_bytes"], self._push_bytes)
+        if not (self._push_ops_cap or self._push_bytes_cap):
+            # no window configured: keep the synchronous durability
+            # point (every shard acked before the next batch) — the
+            # frames still all hit the wire before any ack is awaited
+            self._drain_push(0, 0)
+
+    def _drain_push(self, max_ops: int | None,
+                    max_bytes: int | None) -> int:
+        drained = 0
+        while self._push and (
+                (max_ops is not None and len(self._push) > max_ops)
+                or (max_bytes is not None
+                    and self._push_bytes > max(0, max_bytes))):
+            h, nb = self._push.pop(0)
+            self._push_bytes -= nb
+            drained += 1
+            h.result()
+        return drained
+
+    # -- fused path ---------------------------------------------------------
+
+    def _program(self, plan):
+        key = plan.group_key
+        if key is None:
+            # no shareable identity: cache on the owning backend (the
+            # pre-r10 behavior, minus the per-(sl) duplication)
+            ckey = ("r10", id(plan.dec_fn), plan.verify, self._host_crc)
+            fn = plan.be._fused_cache.get(ckey)
+            if fn is None:
+                self.perf.inc("program_cache_misses")
+                fn = _build_recover_program(plan.dec_fn, plan.verify,
+                                            self._host_crc)
+                plan.be._fused_cache[ckey] = fn
+            else:
+                self.perf.inc("program_cache_hits")
+            return fn
+        ckey = (key, plan.verify, self._host_crc)
+        with _RECOVER_PROGRAMS_LOCK:
+            fn = _RECOVER_PROGRAMS.get(ckey)
+            if fn is None:
+                self.perf.inc("program_cache_misses")
+                fn = _build_recover_program(plan.dec_fn, plan.verify,
+                                            self._host_crc)
+                _RECOVER_PROGRAMS[ckey] = fn
+            else:
+                self.perf.inc("program_cache_hits")
+        return fn
+
+    def _stage_buffer(self, bucket: int, H: int, sl: int) -> np.ndarray:
+        # ring of 2 reusable buffers per shape: with a depth-2 pipeline
+        # the transfer of batch i completed at dispatch, so buffer
+        # i % 2 is free by the time batch i+2 stages (a fresh 100+ MiB
+        # np.empty per batch pays page-fault cost every launch)
+        key = (bucket, H, sl, self.stats["batches"] % 2)
+        buf = self._stage_bufs.get(key)
+        if buf is None:
+            buf = np.zeros((bucket, H, sl), dtype=np.uint8)
+            self._stage_bufs[key] = buf
+        return buf
+
+    def _launch(self, sl: int, pairs) -> None:
+        import jax
+
+        from ..ops.rs_kernels import pow2_bucket
+        proto = pairs[0][0]
+        helper = proto.helper
+        H = len(helper)
+        # stage-time revalidation (see class docstring)
+        live: list[tuple] = []   # (plan, name, version-at-stage)
+        for plan, name in pairs:
+            size = plan.be.object_sizes.get(name)
+            if size is None or plan.be._shard_len(size) != sl:
+                plan.remaining.discard(name)
+                self.stats["skipped_stale"] += 1
+                continue
+            live.append((plan, name,
+                         plan.be.object_versions.get(name, 0)))
+        if not live:
+            return
+        B = len(live)
+        bucket = pow2_bucket(B)
+        stack = self._stage_buffer(bucket, H, sl)
+        exp = np.zeros((B, H), dtype=np.uint32)
+        with span("ecbackend.recover.stage", counters=self.perf,
+                  key="recover_stage_time"):
+            self._stage(live, sl, stack, exp, proto.verify)
+        if bucket != B:
+            stack[B:] = 0
+        program = self._program(proto)
+        self.perf.inc("recover_launches")
+        with span("ecbackend.recover.launch", counters=self.perf,
+                  key="recover_launch_time"):
+            if self._host_crc:
+                handles = program(stack)
+            else:
+                expfold = np.zeros(bucket, dtype=np.uint32)
+                if proto.verify:
+                    expfold[:B] = _expected_fold_crcs(exp, sl)
+                    # a padded all-zero row folds to zero bytes, whose
+                    # raw CRC is just the seed shifted through sl zero
+                    # bytes — match it so padding never "fails"
+                    expfold[B:] = _fold_seed_const(sl)
+                handles = program(stack, expfold)
+            for h in handles:
+                try:
+                    h.copy_to_host_async()
+                except AttributeError:
+                    break   # non-jax handle (test stub)
+        self._pending.append((sl, live, handles, exp))
+        self.stats["batches"] += 1
+        self.stats["fused_batches"] += 1
+        if len({id(p) for p, _, _ in live}) > 1:
+            self.stats["cross_pg_batches"] += 1
+
+    @staticmethod
+    def _segments(live) -> list[tuple]:
+        """Contiguous per-plan runs of a batch: (plan, row0, names)."""
+        segs: list[tuple] = []
+        for ri, (plan, name, _v) in enumerate(live):
+            if not segs or segs[-1][0] is not plan:
+                segs.append((plan, ri, []))
+            segs[-1][2].append(name)
+        return segs
+
+    def _stage(self, live, sl: int, stack: np.ndarray, exp: np.ndarray,
+               verify: bool) -> None:
+        """Fill (B, H, sl) helper rows + expected hinfo CRCs. Remote
+        stores submit ONE readv frame per (PG, helper shard) — data
+        AND hinfo in the frame — all frames on the wire before any
+        reply is collected (the windowed PULL: fetches from different
+        source OSDs overlap instead of serializing per object)."""
+        waits: list[tuple] = []
+        for plan, r0, names in self._segments(live):
+            nb = len(names)
+            for hi, s in enumerate(plan.helper):
+                st = plan.be._store(s)
+                cid = shard_cid(plan.be.pg, s)
+                subv = getattr(st, "readv_submit", None)
+                if subv is not None:
+                    # chunk by the fetch byte budget so one source OSD
+                    # never serializes a giant frame
+                    per = max(1, RECOVERY_FETCH_BYTES // max(1, sl))
+                    for c0 in range(0, nb, per):
+                        cnames = names[c0:c0 + per]
+                        waits.append(
+                            (subv(cid, cnames, sl,
+                                  HINFO_KEY if verify else None),
+                             r0 + c0, hi, len(cnames)))
+                    continue
+                out = stack[r0:r0 + nb, hi, :]
+                rb = getattr(st, "read_batch", None)
+                if rb is not None:
+                    rb(cid, names, sl, out=out)
+                else:
+                    for bi, name in enumerate(names):
+                        out[bi] = st.read(cid, name)
+                if verify:
+                    for bi, name in enumerate(names):
+                        hb = st.getattr(cid, name, HINFO_KEY)
+                        exp[r0 + bi, hi] = HashInfo.from_bytes(
+                            hb).get_chunk_hash(0)
+        for handle, r0, hi, nb in waits:
+            data, attrs = handle.result()
+            rows = np.frombuffer(data, np.uint8)
+            if rows.size != nb * sl:
+                raise ValueError(
+                    f"readv: got {rows.size} bytes, expected {nb * sl}")
+            stack[r0:r0 + nb, hi, :] = rows.reshape(nb, sl)
+            if attrs is not None:
+                for bi, hb in enumerate(attrs):
+                    exp[r0 + bi, hi] = HashInfo.from_bytes(
+                        hb).get_chunk_hash(0)
+
+    def _locate_bad_helpers(self, plan, name: str, bi: int,
+                            exp: np.ndarray) -> set[int]:
+        """Fold CRC mismatched for one object: re-read its helper rows
+        and checksum each to find the rotten shard(s) — the rare path
+        pays the per-row pass the common path no longer does."""
+        bad: set[int] = set()
+        for hi, s in enumerate(plan.helper):
+            chunk = plan.be._store(s).read(
+                shard_cid(plan.be.pg, s), name)
+            if self._host_crc:
+                from .. import native
+                crc = int(native.native_crc32c(0xFFFFFFFF, chunk))
+            else:
+                crc = int(PGBackend._batched_crcs(chunk[None, :])[0])
+            if crc != int(exp[bi, hi]):
+                bad.add(s)
+        return bad
+
+    def _complete(self, entry) -> None:
+        import jax
+        sl, live, handles, exp = entry
+        B = len(live)
+        proto = live[0][0]
+        with span("ecbackend.recover.fetch", counters=self.perf,
+                  key="recover_fetch_time"):
+            got = jax.device_get(handles)
+        if self._host_crc:
+            rebuilt = np.asarray(got[0])[:B]
+            E = rebuilt.shape[1]
+            from .. import native
+            rcrc = native.native_crc32c_rows(
+                0xFFFFFFFF, rebuilt.reshape(B * E, sl)).reshape(B, E)
+            if proto.verify:
+                fold = np.asarray(got[1])[:B]
+                ok = (native.native_crc32c_rows(0xFFFFFFFF, fold)
+                      == _expected_fold_crcs(exp, sl))
+            else:
+                ok = np.ones(B, dtype=bool)
+        else:
+            rebuilt = np.asarray(got[0])[:B]
+            rcrc = np.asarray(got[1])[:B]
+            ok = np.asarray(got[2])[:B]
+        # rebuilt may be a read-only device_get view; the fallback and
+        # the bucket slice both want a private copy
+        rebuilt = np.array(rebuilt)
+        rcrc = np.array(rcrc)
+        bad_by_plan: dict[int, dict[str, set[int]]] = {}
+        if proto.verify and not ok.all():
+            for bi in np.nonzero(~ok)[0]:
+                plan, name, _v = live[bi]
+                bad = self._locate_bad_helpers(plan, name, int(bi), exp)
+                if bad:
+                    plan.counters["hinfo_failures"] += len(bad)
+                    bad_by_plan.setdefault(id(plan), {})[name] = bad
+        with span("ecbackend.recover.writeback", counters=self.perf,
+                  key="recover_writeback_time"):
+            for plan, r0, names in self._segments(live):
+                nb = len(names)
+                seg_rebuilt = rebuilt[r0:r0 + nb]
+                seg_crcs = rcrc[r0:r0 + nb]
+                bad_pairs = bad_by_plan.get(id(plan), {})
+                if bad_pairs:
+                    plan.be._recover_fallback(
+                        plan.lost, plan.survivors, bad_pairs, names,
+                        seg_rebuilt, plan.counters)
+                    idxs = sorted(names.index(n) for n in bad_pairs)
+                    fix = plan.be._batched_hinfo_crcs(
+                        seg_rebuilt[idxs].reshape(-1, sl)).reshape(
+                            len(idxs), len(plan.lost))
+                    seg_crcs[idxs] = fix
+                # writeback-time revalidation: a name whose version
+                # moved since its stage already holds fresher bytes on
+                # the recovering slot — writing the stale decode would
+                # resurrect them under a matching CRC
+                keep = [i for i in range(nb)
+                        if plan.be.object_versions.get(names[i], 0)
+                        == live[r0 + i][2]
+                        and names[i] in plan.be.object_sizes]
+                if len(keep) != nb:
+                    self.stats["skipped_stale"] += nb - len(keep)
+                if keep:
+                    plan.be._writeback_rebuilt(
+                        plan.lost, [names[i] for i in keep],
+                        seg_rebuilt[keep], seg_crcs[keep], sl,
+                        plan.counters, window=self)
+                plan.remaining.difference_update(names)
+
+    # -- generic path (codecs without a static decode plan) ----------------
+
+    def _run_generic(self, plan, sl: int, names: list[str]) -> None:
+        be = plan.be
+        live = [n for n in names
+                if be.object_sizes.get(n) is not None
+                and be._shard_len(be.object_sizes[n]) == sl]
+        if len(live) != len(names):
+            # stale-skipped names need nothing from us (their mutation
+            # already reached the repointed slot) but must still leave
+            # the remaining set or the plan never settles
+            self.stats["skipped_stale"] += len(names) - len(live)
+            plan.remaining.difference_update(
+                set(names) - set(live))
+        names = live
+        if not names:
+            return
+        self.perf.inc("recover_launches")
+        self.stats["batches"] += 1
+        self.stats["generic_batches"] += 1
+        stacks = {s: np.stack([be._store(s).read(
+            shard_cid(be.pg, s), n) for n in names])
+            for s in plan.helper}
+        bad_pairs: dict[str, set[int]] = {}
+        if plan.verify:
+            for s in plan.helper:
+                crcs_s = be._batched_hinfo_crcs(stacks[s])
+                for bi, name in enumerate(names):
+                    hb = be._store(s).getattr(
+                        shard_cid(be.pg, s), name, HINFO_KEY)
+                    if HashInfo.from_bytes(hb).get_chunk_hash(0) \
+                            != int(crcs_s[bi]):
+                        plan.counters["hinfo_failures"] += 1
+                        bad_pairs.setdefault(name, set()).add(s)
+        rec = be.coder.decode_chunks(plan.lost, stacks)
+        rebuilt_all = np.stack(
+            [np.asarray(rec[s]) for s in plan.lost], axis=1)
+        if bad_pairs:
+            be._recover_fallback(plan.lost, plan.survivors, bad_pairs,
+                                 names, rebuilt_all, plan.counters)
+        crcs = be._batched_hinfo_crcs(
+            rebuilt_all.reshape(-1, sl)).reshape(len(names),
+                                                 len(plan.lost))
+        be._writeback_rebuilt(plan.lost, names, rebuilt_all, crcs, sl,
+                              plan.counters, window=self)
+        plan.remaining.difference_update(names)

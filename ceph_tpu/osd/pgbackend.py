@@ -291,17 +291,24 @@ class PGBackend:
     def _replay_deletes(self, lost: list[int], names) -> list[str]:
         """Split a recovery name list: apply deletes for names the PG
         no longer knows (their last log entry was a remove) to the
-        recovering slots, and return the names still to rebuild."""
-        keep = []
-        for name in names:
-            if name in self.object_sizes:
-                keep.append(name)
-                continue
+        recovering slots, and return the names still to rebuild.
+
+        Batched per slot: ONE listing + ONE combined remove txn
+        instead of a per-name exists+remove pair — at the wire tier
+        the per-name form cost 2B round trips per recovering slot."""
+        keep = [n for n in names if n in self.object_sizes]
+        dels = [n for n in names if n not in self.object_sizes]
+        if dels:
             for s in lost:
                 cid = shard_cid(self.pg, s)
-                if self._store(s).exists(cid, name):
-                    self._store(s).queue_transaction(
-                        Transaction().remove(cid, name))
+                present = set(self._store(s).list_objects(cid))
+                doomed = [n for n in dels if n in present]
+                if not doomed:
+                    continue
+                t = Transaction()
+                for name in doomed:
+                    t.remove(cid, name)
+                self._store(s).queue_transaction(t)
         return keep
 
     def recover_shards(self, lost_shards, replacement_osds=None,

@@ -70,7 +70,7 @@ DEFAULT_PROFILES = {
 
 class _ClassQueue:
     __slots__ = ("profile", "items", "r_prev", "l_prev", "p_prev",
-                 "busy")
+                 "busy", "served", "served_cost")
 
     def __init__(self, profile: ClientProfile):
         self.profile = profile
@@ -79,6 +79,8 @@ class _ClassQueue:
         self.l_prev = 0.0
         self.p_prev = 0.0
         self.busy = False
+        self.served = 0             # ops granted (occupancy dumps)
+        self.served_cost = 0.0      # cost units granted
 
 
 class MClockScheduler:
@@ -176,8 +178,37 @@ class MClockScheduler:
         _, item, cost = heapq.heappop(q.items)
         q.r_prev, q.l_prev, q.p_prev = r_tag, l_tag, p_tag
         q.busy = True
+        q.served += 1
+        q.served_cost += cost
         self._len -= 1
         return name, item
+
+    def next_eligible(self, now: float) -> float | None:
+        """Earliest future time a queued head becomes servable, or None
+        when the queue is empty (lets a wall-clock pump sleep precisely
+        instead of polling while every class is limit-bound)."""
+        best = None
+        for q in self._classes.values():
+            if not q.items:
+                continue
+            r_tag, l_tag, _ = self._head_tags(q, now)
+            t = min(r_tag, l_tag)
+            if t <= now:
+                return now
+            if best is None or t < best:
+                best = t
+        return best
+
+    def dump(self) -> dict:
+        """Per-class occupancy + grant counters (the `dump_mclock`
+        admin view; recovery_bench emits this next to perf deltas)."""
+        return {name: {"queued": len(q.items),
+                       "served": q.served,
+                       "served_cost": round(q.served_cost, 3),
+                       "profile": {"reservation": q.profile.reservation,
+                                   "weight": q.profile.weight,
+                                   "limit": q.profile.limit}}
+                for name, q in self._classes.items()}
 
     def drain(self, now: float, budget: int | None = None) -> list:
         """Dequeue until idle/limit-bound (or budget ops); the per-tick

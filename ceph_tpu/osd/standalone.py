@@ -752,6 +752,22 @@ class _AsyncStoreOp:
                               f"{rep.err}")
 
 
+class _ReadvOp:
+    """In-flight readv: result() -> (data bytes, attrs list | None),
+    with _AsyncStoreOp's error surface (incl. the one cephx
+    re-authorize retry)."""
+
+    def __init__(self, rs: "RemoteStore", body: bytes, want_attrs: bool):
+        self._op = _AsyncStoreOp(rs, "readv", body)
+        self._want_attrs = want_attrs
+
+    def result(self) -> tuple[bytes, list[bytes] | None]:
+        d = Decoder(self._op.result())
+        data = d.blob()
+        attrs = d.list(Decoder.blob)
+        return data, (attrs if self._want_attrs else None)
+
+
 class RemoteStore:
     """ObjectStore proxy: the MOSDECSubOpWrite/Read role. Every method
     is one MStoreOp frame to the OSD owning the physical store."""
@@ -813,6 +829,17 @@ class RemoteStore:
                         .i64(-1 if length is None else length))
         return np.frombuffer(self._call("read", body), np.uint8).copy()
 
+    def readv_submit(self, cid: str, oids: list[str], length: int,
+                     attr_key: str | None = None) -> "_ReadvOp":
+        """Pipelined multi-object fetch: ONE readv frame carries every
+        row (+ optional per-row attr) for `oids`; transmit now, collect
+        later. The recovery runner submits one of these per (PG,
+        helper shard) before awaiting any — pulls from different
+        source OSDs overlap (the windowed PULL)."""
+        body = self._co(cid, "", lambda e: e.string(attr_key or "")
+                        .i64(length).list(list(oids), Encoder.string))
+        return _ReadvOp(self, body, attr_key is not None)
+
     def stat(self, cid: str, oid: str) -> int:
         return Decoder(self._call("stat", self._co(cid, oid))).i64()
 
@@ -869,6 +896,88 @@ class _PgClsView:
     @property
     def obj_kv(self) -> dict:
         return self._d.obj_kv.setdefault(self._ps, {})
+
+
+class _RecoveryRound:
+    """One mClock-governed pass of the cross-PG recovery runner: every
+    grant executes ONE fused batch under the daemon lock then yields
+    (re-enqueues itself, after osd_recovery_sleep), so client ops
+    interleave between batches instead of waiting out the whole
+    rebuild. The runner's push window is sized by the recovery
+    reservation knobs: osd_recovery_max_active in-flight pushes,
+    osd_recovery_max_active * osd_recovery_max_chunk bytes."""
+
+    def __init__(self, daemon: "OSDDaemon", entries):
+        from .ecbackend import RecoveryRunner
+        self.d = daemon
+        self.entries = entries            # [(ps, plan, dead osd ids)]
+        self.plans = {ps: plan for ps, plan, _ in entries}
+        self.dead: set[int] = set()
+        for _ps, _plan, dead in entries:
+            self.dead |= dead
+        cfg = daemon.config
+        max_active = int(cfg["osd_recovery_max_active"])
+        self.runner = RecoveryRunner(
+            [plan for _ps, plan, _dead in entries],
+            batch=int(cfg["osd_recovery_batch"]),
+            perf=daemon.ec_perf,
+            push_window_ops=max_active,
+            push_window_bytes=max_active
+            * int(cfg["osd_recovery_max_chunk"]))
+        self.failed = False
+
+    def lost_of(self, ps: int) -> list[int]:
+        return self.plans[ps].lost
+
+    def next_cost(self) -> float:
+        """One grant's work in client-op cost units (bytes-scaled, the
+        osd_mclock_cost_per_byte role)."""
+        return max(1.0, self.runner.next_cost()
+                   / float(self.d.config["osd_recovery_max_chunk"]))
+
+    def __call__(self) -> None:
+        d = self.d
+        try:
+            with d._lock:
+                if self.runner.step():
+                    pass                    # yield below
+                else:
+                    self.runner.finish()
+                    self._settle_locked()
+                    return
+        except (ValueError, ConnectionError, OSError, KeyError) as e:
+            # helper died / push refused mid-round: park it — the next
+            # reconcile re-plans the leftover names against the fresh
+            # map (plan.remaining tracks exactly what didn't land)
+            self.failed = True
+            d.c.log(f"{d.name}: recovery round deferred: {e}")
+            return
+        sleep = float(d.config["osd_recovery_sleep"])
+        if sleep > 0 and not d._stop.is_set():
+            t = threading.Timer(sleep, self._requeue)
+            t.daemon = True
+            t.start()
+        else:
+            self._requeue()
+
+    def _requeue(self) -> None:
+        if self.d._stop.is_set():
+            return
+        self.d._sched_enqueue("background_recovery", self,
+                              self.next_cost())
+
+    def _settle_locked(self) -> None:
+        d = self.d
+        d.suspect -= self.dead
+        for ps, _plan, _dead in self.entries:
+            if d._recovering.get(ps) is self:
+                d._recovering.pop(ps, None)
+            try:
+                d._persist_meta(ps)
+            except (ConnectionError, OSError, KeyError) as e:
+                d.c.log(f"{d.name}: pg 1.{ps} post-recovery persist "
+                        f"deferred: {e}")
+        d.perf.inc("recovery_rounds")
 
 
 class OSDDaemon:
@@ -961,6 +1070,21 @@ class OSDDaemon:
         # per daemon): same dispatcher as the wire `admin` op, but
         # reachable without a client, a map, or cephx — the operator's
         # side door into a wedged daemon
+        # mClock-governed op admission (ref: src/osd/scheduler/
+        # mClockScheduler.cc wired into OSD::op_shardedwq): client ops
+        # and recovery batch grants flow through ONE scheduler; a
+        # single worker drains it in tag order, so background_recovery
+        # competes with (instead of head-of-line-blocking) client ops.
+        # Built fresh here (empty queue per boot), and BEFORE any
+        # handler registers — a map or op frame may land the moment
+        # the messenger knows the type.
+        from .scheduler import MClockScheduler
+        self.op_sched = MClockScheduler(self._mclock_profiles())
+        self._sched_cv = threading.Condition()
+        self._recovering: dict[int, "_RecoveryRound"] = {}
+        self._opw = threading.Thread(target=self._op_worker_loop,
+                                     daemon=True)
+        self._opw.start()
         from ..utils.admin_socket import AdminSocket
         self.asok = AdminSocket(self.c.asok_path(self.name))
         for _cmd in self._ADMIN_CMDS:
@@ -1052,10 +1176,103 @@ class OSDDaemon:
         _wire_authorize(self._cauth, self.auth_rpc, peer, "osd",
                         async_refresh=self._spawn_ticket_refresh)
 
+    # -- mClock op admission -------------------------------------------------
+
+    # the reference's built-in profile split (osd_mclock_profile):
+    # (reservation, weight, limit) per class, ops/s-space with cost
+    # scaled so one recovery batch counts its bytes, not "one op"
+    _MCLOCK_BUILTIN = {
+        "high_client_ops": {
+            "client": (50.0, 10.0, 0.0),
+            "background_recovery": (25.0, 5.0, 100.0),
+            "background_best_effort": (0.0, 2.0, 0.0),
+            "scrub": (0.0, 1.0, 50.0)},
+        "balanced": {
+            "client": (50.0, 5.0, 0.0),
+            "background_recovery": (50.0, 5.0, 150.0),
+            "background_best_effort": (0.0, 2.0, 0.0),
+            "scrub": (0.0, 1.0, 50.0)},
+        "high_recovery_ops": {
+            "client": (30.0, 2.0, 0.0),
+            "background_recovery": (60.0, 10.0, 0.0),
+            "background_best_effort": (0.0, 2.0, 0.0),
+            "scrub": (0.0, 1.0, 50.0)},
+    }
+
+    def _mclock_profiles(self) -> dict:
+        """(ρ, w, λ) per op class, resolved LIVE through this daemon's
+        layered config: osd_mclock_profile picks a built-in split;
+        `custom` reads the osd_mclock_scheduler_* knobs (the reference's
+        config-change path, no restart)."""
+        from .scheduler import ClientProfile
+        name = str(self.config["osd_mclock_profile"])
+        if name == "custom":
+            cfg = self.config
+            table = {
+                "client": (cfg["osd_mclock_scheduler_client_res"],
+                           cfg["osd_mclock_scheduler_client_wgt"],
+                           cfg["osd_mclock_scheduler_client_lim"]),
+                "background_recovery": (
+                    cfg["osd_mclock_scheduler_background_recovery_res"],
+                    cfg["osd_mclock_scheduler_background_recovery_wgt"],
+                    cfg["osd_mclock_scheduler_background_recovery_lim"]),
+                "background_best_effort": (0.0, 2.0, 0.0),
+                "scrub": (0.0, 1.0, 50.0)}
+        else:
+            table = self._MCLOCK_BUILTIN.get(
+                name, self._MCLOCK_BUILTIN["high_client_ops"])
+        return {cls: ClientProfile(reservation=r, weight=w, limit=lim)
+                for cls, (r, w, lim) in table.items()}
+
+    def _refresh_mclock_profiles(self) -> None:
+        """Re-resolve the (ρ, w, λ) table after a config change (called
+        from the central-config fold — cheaper and lifetime-safer than
+        per-key observers across revives)."""
+        try:
+            profiles = self._mclock_profiles()
+        except (KeyError, ValueError) as e:
+            self.c.log(f"{self.name}: bad mclock config ignored: {e}")
+            return
+        with self._sched_cv:
+            for cls, prof in profiles.items():
+                q = self.op_sched._classes.get(cls)
+                if q is not None and q.profile != prof:
+                    self.op_sched.set_profile(cls, prof)
+
+    def _sched_enqueue(self, cls: str, item, cost: float = 1.0) -> None:
+        with self._sched_cv:
+            self.op_sched.enqueue(cls, item, cost)
+            self._sched_cv.notify()
+
+    def _op_worker_loop(self) -> None:
+        """Drain the mClock queue in tag order. Every item is a
+        callable; recovery rounds re-enqueue themselves after each
+        batch grant, so the daemon lock is free between grants and a
+        queued client op never waits behind more than ONE recovery
+        batch (the p95-bounding property the scheduler exists for)."""
+        while not self._stop.is_set():
+            with self._sched_cv:
+                now = time.monotonic()
+                got = self.op_sched.dequeue(now)
+                if got is None:
+                    nxt = self.op_sched.next_eligible(now)
+                    self._sched_cv.wait(
+                        0.5 if nxt is None
+                        else min(0.5, max(0.001, nxt - now)))
+                    continue
+            _cls, item = got
+            try:
+                item()
+            except Exception as e:   # noqa: BLE001 — the worker must
+                # survive any op; the item owns its own error reply
+                self.c.log(f"{self.name}: op worker item failed: "
+                           f"{e!r}")
+
     # -- store service (the SubOp executor) ---------------------------------
 
     _STORE_READ_KINDS = frozenset(
-        {"read", "stat", "getattr", "exists", "ls", "omap_get"})
+        {"read", "readv", "stat", "getattr", "exists", "ls",
+         "omap_get"})
 
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
         # the store plane is ticket-gated exactly like the client op
@@ -1103,6 +1320,31 @@ class OSDDaemon:
             off, ln = d.i64(), d.i64()
             arr = st.read(cid, oid, off, None if ln < 0 else ln)
             return arr.tobytes()
+        if kind == "readv":
+            # multi-object shard fetch: ONE frame returns many equal-
+            # length rows (+ their hinfo attrs) — the recovery pull
+            # unit (ref: MOSDPGPull carrying a PullOp vector; the
+            # per-object read() path costs B round trips per helper
+            # shard per batch)
+            attr_key = d.string()
+            length = d.i64()
+            names = d.list(Decoder.string)
+            rows = []
+            for name in names:
+                arr = st.read(cid, name)
+                if len(arr) != length:
+                    # a stale/partial shard must fail LOUDLY — zero-
+                    # filling would hand the decoder garbage that
+                    # writeback then stamps with matching CRCs
+                    raise ValueError(
+                        f"readv: {name!r} is {len(arr)} bytes, "
+                        f"expected {length}")
+                rows.append(np.asarray(arr, np.uint8))
+            e = Encoder()
+            e.blob(b"".join(r.tobytes() for r in rows))
+            e.list([st.getattr(cid, n, attr_key) for n in names]
+                   if attr_key else [], Encoder.blob)
+            return e.bytes()
         if kind == "stat":
             return Encoder().i64(st.stat(cid, oid)).bytes()
         if kind == "getattr":
@@ -1643,11 +1885,20 @@ class OSDDaemon:
             except KeyError:
                 pass
             del self._cfg_applied[key]
+        # QoS knobs may have moved: re-resolve the mClock profile table
+        # (live, no restart — the osd_mclock config-change path)
+        self._refresh_mclock_profiles()
 
     def _reconcile(self) -> None:
         """Map changed: adopt/recover the PGs this daemon primaries
         (the PeeringState Get* exchange outcome, driven from the
-        authoritative persisted metadata)."""
+        authoritative persisted metadata). Recovery is PLANNED here but
+        EXECUTED by the mClock worker: every primaried PG's plan joins
+        ONE cross-PG round whose fused batches interleave with client
+        ops (the pre-r10 tree ran one blocking recover_shards per PG
+        inside this loop, holding the daemon lock for the whole
+        rebuild)."""
+        new_plans: list[tuple[int, object, set[int]]] = []
         for ps in range(self.c.pg_num):
             acting = self._acting(ps)
             if not acting or acting[0] != self.osd_id:
@@ -1719,6 +1970,27 @@ class OSDDaemon:
                     ps, be, sorted(self._rewind_pending[ps]))
             if be.acting == acting:
                 self._snap_trim(ps, be)   # snaps may have left the map
+                rnd = self._recovering.get(ps)
+                if rnd is not None and getattr(rnd, "failed", False):
+                    # a round died mid-way (helper lost, push refused):
+                    # re-plan THIS pg in full — helpers re-validate
+                    # against the current map, already-landed objects
+                    # re-verify cheaply through the fused pipeline
+                    n_osds = len(self.osdmap.osd_up)
+                    exclude = {
+                        s for s, o in enumerate(be.acting)
+                        if s not in rnd.lost_of(ps)
+                        and (not _valid_osd(o, n_osds)
+                             or o in self.suspect
+                             or not self.osdmap.osd_up[o])}
+                    try:
+                        plan = be.plan_recovery(
+                            rnd.lost_of(ps), helper_exclude=exclude)
+                        self._recovering[ps] = None   # round pending
+                        new_plans.append((ps, plan, set()))
+                    except (ValueError, ConnectionError, KeyError) as e:
+                        self.c.log(f"{self.name}: pg 1.{ps} recovery "
+                                   f"retry deferred: {e}")
             if be.acting != acting:
                 # a changed slot whose old OSD is still up is a MOVE
                 # (CRUSH re-slotted a live member: copy the shard
@@ -1757,14 +2029,39 @@ class OSDDaemon:
                             and (not _valid_osd(o, n_osds)
                                  or o in self.suspect
                                  or not self.osdmap.osd_up[o])}
-                        be.recover_shards(lost, replacement_osds=repl,
-                                          helper_exclude=exclude)
-                        self.suspect -= dead
-                        self.perf.inc("recovery_rounds")
+                        # plan now (validates helpers, repoints the
+                        # lost slots so new client writes reach the
+                        # rebuilding store directly); the mClock
+                        # worker executes the batches. The recovering
+                        # marker goes up IN THE SAME locked breath as
+                        # the acting mutation — wait_for_clean polls
+                        # unlocked and must never see a repointed
+                        # acting without the in-flight marker.
+                        # Replicated pools have no fused decode plan:
+                        # their push-based recover_shards runs inline
+                        # (the pre-r10 path; copies, not decodes).
+                        if hasattr(be, "plan_recovery"):
+                            plan = be.plan_recovery(
+                                lost, replacement_osds=repl,
+                                helper_exclude=exclude)
+                            self._recovering[ps] = None  # round pending
+                            new_plans.append((ps, plan, dead))
+                        else:
+                            be.recover_shards(lost,
+                                              replacement_osds=repl,
+                                              helper_exclude=exclude)
+                            self.suspect -= dead
+                            self.perf.inc("recovery_rounds")
                     self._persist_meta(ps)
                 except (ValueError, ConnectionError, KeyError) as e:
                     self.c.log(f"{self.name}: pg 1.{ps} recovery "
                                f"deferred: {e}")
+        if new_plans:
+            rnd = _RecoveryRound(self, new_plans)
+            for ps, _plan, _dead in new_plans:
+                self._recovering[ps] = rnd
+            self._sched_enqueue("background_recovery", rnd,
+                                rnd.next_cost())
 
     def _request_up_thru(self, want: int) -> None:
         """Ask every monitor to record our up_thru through `want` (the
@@ -2058,11 +2355,35 @@ class OSDDaemon:
                 except (KeyError, OSError, ConnectionError):
                     pass
                 return
-        try:
-            if msg.kind == "admin":
+        if msg.kind == "admin":
+            # the operator side door bypasses the op queue (like the
+            # asok): it must answer even when the queue is wedged
+            try:
                 d = Decoder(msg.blob)
-                blob = self._admin_cmd(d.string())
-            elif sub_ops is not None:
+                rep = MOSDOpReply(msg.req_id, True, msg.kind,
+                                  self._admin_cmd(d.string()))
+            except Exception as e:   # noqa: BLE001 — reply, don't die
+                rep = MOSDOpReply(msg.req_id, False, msg.kind,
+                                  err=f"{type(e).__name__}:{e}")
+            try:
+                self.msgr.send(peer, rep)
+            except (KeyError, OSError, ConnectionError):
+                pass
+            return
+        # mClock admission: PG ops queue under their QoS class and a
+        # single worker drains in tag order — during recovery a client
+        # op waits behind at most one recovery batch grant, not the
+        # whole rebuild (the pre-r10 inline path held the daemon lock
+        # for the full multi-second round)
+        cls = "scrub" if msg.kind in ("deep_scrub", "repair") \
+            else "client"
+        self._sched_enqueue(
+            cls, lambda: self._serve_client_op(peer, msg, sub_ops))
+
+    def _serve_client_op(self, peer: str, msg: MOSDOp,
+                         sub_ops) -> None:
+        try:
+            if sub_ops is not None:
                 # per-sub-op fault isolation: one bad sub-op fails its
                 # slot, not the frame (the client maps each slot back
                 # to its op's retry state)
@@ -4583,6 +4904,8 @@ class StandaloneCluster:
                 be = owner.backends.get(ps)
                 if be is None or be.acting != acting:
                     return False
+                if ps in owner._recovering:
+                    return False   # async rebuild still in flight
             return True
         self._wait(clean, timeout, "all PGs clean")
 

@@ -54,9 +54,38 @@ OPTIONS: list[Option] = [
     Option("osd_pool_default_size", int, 3, "replicas for new pools", min=1),
     Option("osd_pool_default_pg_num", int, 32, "PGs for new pools", min=1),
     Option("osd_recovery_max_active", int, 3,
-           "concurrent recovery batches", min=1),
+           "concurrent recovery pulls/pushes in flight per OSD (the "
+           "local+remote reservation: bounds outstanding fetch frames "
+           "and sizes the push window)", min=1),
     Option("osd_recovery_batch", int, 128,
            "objects per batched recovery launch", min=1),
+    Option("osd_recovery_sleep", float, 0.0,
+           "seconds a recovering OSD waits between recovery batch "
+           "grants (throttles background_recovery under client load; "
+           "0 = no injected sleep)", min=0.0),
+    Option("osd_recovery_max_chunk", int, 8 << 20,
+           "byte budget of one recovery push op (with "
+           "osd_recovery_max_active it bounds the windowed-push "
+           "in-flight bytes: active * chunk)", min=4096),
+    Option("osd_mclock_profile", str, "high_client_ops",
+           "mClock built-in profile for the wire-tier op scheduler "
+           "(high_client_ops | balanced | high_recovery_ops | "
+           "custom; custom reads the osd_mclock_scheduler_* knobs)"),
+    Option("osd_mclock_scheduler_client_res", float, 50.0,
+           "custom profile: client reservation (ops/s)", min=0.0),
+    Option("osd_mclock_scheduler_client_wgt", float, 10.0,
+           "custom profile: client weight", min=0.001),
+    Option("osd_mclock_scheduler_client_lim", float, 0.0,
+           "custom profile: client limit (ops/s; 0 = unlimited)",
+           min=0.0),
+    Option("osd_mclock_scheduler_background_recovery_res", float, 25.0,
+           "custom profile: background_recovery reservation (ops/s)",
+           min=0.0),
+    Option("osd_mclock_scheduler_background_recovery_wgt", float, 5.0,
+           "custom profile: background_recovery weight", min=0.001),
+    Option("osd_mclock_scheduler_background_recovery_lim", float, 100.0,
+           "custom profile: background_recovery limit (ops/s; 0 = "
+           "unlimited)", min=0.0),
     Option("osd_heartbeat_interval", float, 6.0,
            "seconds between peer pings", min=0.1),
     Option("osd_heartbeat_grace", float, 20.0,

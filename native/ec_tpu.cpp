@@ -452,7 +452,13 @@ int ec_decode(void* h, const int* erasures, int n_erasures,
 
 // crc32c (Castagnoli), raw-register convention like ceph_crc32c:
 // chainable, seed in, no final inversion (ref: src/common/crc32c.h).
-uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, int64_t len) {
+// Two lowerings behind one symbol: the SSE4.2 CRC32 instruction IS
+// this polynomial (reflected register update, no inversion — the
+// exact raw convention), so on x86 with the ISA the hot path runs
+// ~8 bytes/3 cycles (ref: src/common/crc32c_intel_fast.c); the
+// table loop stays as the portable fallback, bit-identical.
+static uint32_t crc32c_table_impl(uint32_t seed, const uint8_t* data,
+                                  int64_t len) {
   // magic static: C++11 guarantees thread-safe one-time init
   static const std::array<uint32_t, 256> table = [] {
     std::array<uint32_t, 256> t{};
@@ -468,6 +474,64 @@ uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, int64_t len) {
   for (int64_t i = 0; i < len; ++i)
     reg = (reg >> 8) ^ table[(reg ^ data[i]) & 0xFF];
   return reg;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw_impl(uint32_t seed, const uint8_t* data,
+                               int64_t len) {
+  uint64_t reg = seed;
+  // bytewise to 8-byte alignment, then quadwords, then the tail
+  while (len > 0 && (reinterpret_cast<uintptr_t>(data) & 7) != 0) {
+    reg = __builtin_ia32_crc32qi(reg, *data++);
+    --len;
+  }
+  while (len >= 8) {
+    uint64_t w;
+    std::memcpy(&w, data, 8);
+    reg = __builtin_ia32_crc32di(reg, w);
+    data += 8;
+    len -= 8;
+  }
+  while (len > 0) {
+    reg = __builtin_ia32_crc32qi(reg, *data++);
+    --len;
+  }
+  return static_cast<uint32_t>(reg);
+}
+
+static bool crc32c_hw_ok() {
+  static const bool ok = __builtin_cpu_supports("sse4.2");
+  return ok;
+}
+#endif
+
+uint32_t ec_crc32c(uint32_t seed, const uint8_t* data, int64_t len) {
+#if defined(__x86_64__)
+  if (crc32c_hw_ok()) return crc32c_hw_impl(seed, data, len);
+#endif
+  return crc32c_table_impl(seed, data, len);
+}
+
+// 1 when ec_crc32c dispatches to the hardware instruction (callers
+// deciding host-vs-device checksum placement want the real rate, not
+// the table fallback's)
+int ec_crc32c_hw() {
+#if defined(__x86_64__)
+  return crc32c_hw_ok() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// batched rows: crc of each `row_len`-byte row of a (n_rows, row_len)
+// C-contiguous block, one ctypes crossing for the whole stack (the
+// recovery host-integrity path checksums hundreds of shard rows per
+// fused batch)
+void ec_crc32c_rows(uint32_t seed, const uint8_t* data, int64_t n_rows,
+                    int64_t row_len, uint32_t* out) {
+  for (int64_t r = 0; r < n_rows; ++r)
+    out[r] = ec_crc32c(seed, data + r * row_len, row_len);
 }
 
 // ---------------------------------------------------------------------

@@ -147,6 +147,7 @@ class TestAutoscaler:
         assert r["pg_num_recommended"] == 256  # 8*100/3 ~ 267 -> 256
 
 
+@pytest.mark.slow   # ~12 s live-backfill cell; nightly (r10)
 def test_cluster_balancer_triggers_pg_temp_backfills():
     # upmap moves on a LIVE cluster repeer into pg_temp backfills and
     # data stays byte-exact through the migration

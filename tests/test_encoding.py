@@ -93,6 +93,7 @@ class TestVersionedSections:
 
 
 class TestWireForms:
+    @pytest.mark.slow   # ~24 s placement sweep; nightly (r10)
     def test_crushmap_roundtrip_same_placements(self):
         m = build_hierarchy(64, osds_per_host=4, hosts_per_rack=4)
         ec_rule(m, 1, choose_type=1)

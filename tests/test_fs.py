@@ -118,6 +118,7 @@ class TestData:
         assert fs.read("/f") == b"AAB"
         assert fs.stat("/f")["size"] == 3
 
+    @pytest.mark.slow   # ~15 s big-stripe sweep; nightly (r10)
     def test_large_file_stripes(self):
         c, fs = mk()
         rng = np.random.default_rng(5)

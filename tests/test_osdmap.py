@@ -66,6 +66,7 @@ def test_object_to_pg_and_up():
     assert all(0 <= o < 32 for o in up)
 
 
+@pytest.mark.slow   # ~17 s full-map parity sweep; nightly (r10)
 def test_batched_matches_scalar():
     om = make_osdmap()
     for pool_id in (1, 2):

@@ -94,6 +94,7 @@ def test_shrink_then_regrow_reads_zeros():
     assert img.read(0, 5_000) == b"A" * 5_000
 
 
+@pytest.mark.slow   # ~15 s CLI bench smoke; nightly (r10 cap fix)
 def test_rbd_bench_cli_smoke(tmp_path):
     """`rbd bench` (ref: src/tools/rbd/action/Bench.cc) emits sane
     JSON for both io types through the saved-state CLI."""

@@ -238,3 +238,35 @@ class TestReviewRegressions:
         got = [s.dequeue(100.0 + i) for i in range(3)]
         assert [g[1][0] for g in got] == ["pg2"] * 3
         assert s.dequeue(200.0) is None
+
+
+class TestRound10Additions:
+    def test_next_eligible_limit_bound(self):
+        s = MClockScheduler({
+            "capped": ClientProfile(weight=1.0, limit=10.0)})
+        for _ in range(3):
+            s.enqueue("capped", object())
+        assert s.next_eligible(0.0) == 0.0      # head servable now
+        assert s.dequeue(0.0) is not None
+        # head now spaced by 1/limit: eligible ~0.1s out, not "poll me
+        # every tick"
+        t = s.next_eligible(0.0)
+        assert t is not None and 0.05 < t <= 0.11
+        assert s.next_eligible(1.0) == 1.0      # past the spacing
+        s.dequeue(1.0)
+        s.dequeue(2.0)
+        assert s.next_eligible(3.0) is None     # empty queue
+
+    def test_dump_counts_grants(self):
+        s = MClockScheduler()
+        for i in range(4):
+            s.enqueue("client", i, cost=2.0)
+        s.enqueue("background_recovery", "r", cost=5.0)
+        for t in range(3):
+            s.dequeue(float(t))
+        d = s.dump()
+        assert sum(c["served"] for c in d.values()) == 3
+        assert sum(c["queued"] for c in d.values()) == 2
+        assert d["client"]["profile"]["weight"] == 10.0
+        served_cost = sum(c["served_cost"] for c in d.values())
+        assert served_cost > 0

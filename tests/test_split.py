@@ -152,6 +152,7 @@ class TestSplit:
         for name, want in objs.items():
             assert ob.read(name).tobytes() == want
 
+    @pytest.mark.slow   # ~27 s; EC-pool split stays tier-1 (r10)
     def test_replicated_pool_splits_too(self):
         c, ob = make(pg_num=4, profile="replicated size=3", n_osds=9)
         objs = write_corpus(ob, n=40, seed=11)

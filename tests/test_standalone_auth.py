@@ -137,6 +137,7 @@ class TestCephxWire:
                            match=re.escape("EPERM:unauthenticated")):
             rs.list_objects("meta")
 
+    @pytest.mark.slow   # ~22 s thrash cell; nightly (r10 cap fix)
     def test_auth_survives_thrash_rotation_and_partition(self):
         """cephx under chaos: OSD kill/revive, repeated secret
         rotation, and a monitor partition — client I/O keeps flowing

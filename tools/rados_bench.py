@@ -73,11 +73,33 @@ def main(argv=None) -> None:
                          "qa/standalone analog — measures the wire "
                          "stack, ref: rados bench against a vstart "
                          "cluster)")
+    ap.add_argument("--recovery-kill", action="store_true",
+                    help="standalone write workload: kill one OSD a "
+                         "third into the window so recovery runs "
+                         "CONCURRENTLY with client ops — reports "
+                         "pre/post-kill latency splits and the mClock "
+                         "class occupancy (the QoS-bounded-p95 "
+                         "scenario)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.seconds <= 0 or args.object_size <= 0 or args.batch <= 0:
         raise SystemExit("rados_bench: --seconds/--object-size/--batch "
                          "must be positive")
+    if args.recovery_kill and (args.transport != "standalone"
+                               or args.workload != "write"):
+        raise SystemExit("rados_bench: --recovery-kill needs "
+                         "--transport standalone and the write "
+                         "workload")
+
+    # persistent jit cache: a cold bench process stops re-paying every
+    # XLA compile (the r09 cold-recovery tax); native codecs build once
+    from ceph_tpu.utils.jax_cache import enable_persistent_compile_cache
+    jax_cache_dir = enable_persistent_compile_cache()
+    try:
+        from ceph_tpu import native as _native
+        _native.build()
+    except Exception:   # noqa: BLE001 — no compiler: jax paths serve
+        pass
 
     profile = (args.profile or "plugin=tpu_rs k=4 m=2 impl=bitlinear") \
         if args.pool == "ec" else "replicated size=3"
@@ -91,7 +113,12 @@ def main(argv=None) -> None:
                 n_osds=args.num_osds, pg_num=args.pg_num,
                 profile=profile, chunk_size=4096,
                 secret=None if args.insecure else _os.urandom(32),
-                cephx=not args.insecure, op_timeout=15.0,
+                cephx=not args.insecure,
+                # 3s (the test tier's value), not 15: a dead shard
+                # holder stalls the unlucky in-flight fan-out for ONE
+                # rpc timeout before the suspect-marked degraded retry
+                # — at 15s that single stall eats a whole bench window
+                op_timeout=3.0,
                 op_window=args.window)
         except ValueError as e:
             raise SystemExit(f"rados_bench: {e}")
@@ -166,6 +193,7 @@ def main(argv=None) -> None:
                 read_fn(same_pg[:s])
 
     lat: list[float] = []
+    lat_stamp: list[float] = []   # completion time of each write op
     nobj = 0
     if args.workload == "write":
         # jit compile outside the window: objects scatter over PGs in
@@ -177,13 +205,40 @@ def main(argv=None) -> None:
         perf_before = perf_snapshot()
         t_start = time.perf_counter()
         t_end = t_start + args.seconds
+        t_kill = t_start + args.seconds / 3.0
+        killed_at = None
+        op_errors = 0
         i = 0
         while time.perf_counter() < t_end:
+            if args.recovery_kill and killed_at is None \
+                    and time.perf_counter() >= t_kill:
+                # kill a NON-PRIMARY (pure shard holder): every PG it
+                # held a shard for starts an mClock-governed recovery
+                # round that now COMPETES with this loop's ops. A
+                # primary victim would measure the client's dead-peer
+                # retry timeout (a different, detection-window story),
+                # not the QoS of recovery-vs-client admission.
+                primaries = {
+                    wire_client.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                    for ps in range(args.pg_num)}
+                victim = max(o for o in c.osd_ids()
+                             if o not in primaries
+                             and not c.osds[o]._stop.is_set())
+                c.kill_osd(victim)
+                killed_at = time.perf_counter()
             objs = batch(i)
             t0 = time.perf_counter()
-            ob.write(objs)
-            lat.append(time.perf_counter() - t0)
-            nobj += len(objs)
+            try:
+                ob.write(objs)
+                lat.append(time.perf_counter() - t0)
+                lat_stamp.append(time.perf_counter())
+                nobj += len(objs)
+            except (ConnectionError, OSError, RuntimeError, KeyError):
+                if killed_at is None:
+                    raise
+                # op raced the failure window (old primary dead, map
+                # not committed yet): real clusters retry; count it
+                op_errors += 1
             i += 1
         # measured elapsed, not the nominal window: an op crossing the
         # deadline still counts its real time (keeps write comparable
@@ -260,6 +315,24 @@ def main(argv=None) -> None:
                  "hermetic SimCluster: measures the framework "
                  "pipeline, not network storage"),
     }
+    if jax_cache_dir is not None:
+        out["config"]["jax_compile_cache"] = jax_cache_dir
+    if args.recovery_kill:
+        # latency split around the kill + the schedulers' class grants:
+        # the QoS claim ("client p95 bounded during recovery") is
+        # checkable from this one JSON line
+        k = killed_at if killed_at is not None else t_end
+        pre = [v for t, v in zip(lat_stamp, lat) if t < k]
+        post = [v for t, v in zip(lat_stamp, lat) if t >= k]
+        out["recovery_kill"] = {
+            "victim_killed_at_s": round((killed_at or 0) - t_start, 3),
+            "op_errors": op_errors,
+            "pre_kill": percentiles(pre),
+            "post_kill": percentiles(post),
+            "mclock": {d.name: d.op_sched.dump()
+                       for d in c.osds.values()
+                       if not d._stop.is_set()},
+        }
     if shutdown is not None:
         shutdown()
     if args.json:
