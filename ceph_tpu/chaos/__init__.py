@@ -2,4 +2,4 @@
 teuthology OSDThrasher role) and its invariant checkers."""
 
 from .thrasher import (KNOBS, InvariantViolation, Thrasher,  # noqa: F401
-                       repro_command)
+                       load_factor, repro_command)

@@ -54,6 +54,10 @@ class Objecter:
                      .add_u64_counter("op_send")
                      .add_u64_counter("op_resend")
                      .add_u64_counter("map_refresh")
+                     .add_u64_counter("op_degraded",
+                                      "reads served through the "
+                                      "degraded fast path (primary "
+                                      "dead/parked; any-k decode)")
                      .add_u64_counter("throttle_blocked_bytes")
                      .add_time_avg("op_latency",
                                    "submit-to-reply wall time incl. "
@@ -133,9 +137,39 @@ class Objecter:
                         snapc=snapc)
             except StaleMap:
                 self._refresh()
+                if kind == "read":
+                    got = self._maybe_degraded_read(ps, payload)
+                    if got is not None:
+                        return got
         raise ObjecterError(
             f"op on pg {ps} still untargetable after "
             f"{self.MAX_ATTEMPTS} attempts (epoch {self._epoch})")
+
+    def _maybe_degraded_read(self, ps: int, names):
+        """Degraded-read fast path (ROADMAP item 3): when the FRESH
+        map still offers no serviceable primary — the primary process
+        is dead but not yet detected, or the PG is parked in
+        peering/WaitUpThru — a read is served immediately from any k
+        surviving shards instead of burning the resend budget waiting
+        for detection + activation (mutations still wait: they need
+        the durable primary path). Returns None when the normal
+        retarget should proceed, and falls back to the retry loop if
+        the degraded decode itself cannot complete (below min_size)."""
+        with self._dispatch_lock:
+            primary = self._primaries.get(ps, -1)
+            healthy = (0 <= primary < len(self.cluster.alive)
+                       and self.cluster.alive[primary]
+                       and self.cluster._peer_classify(ps).serviceable)
+            if healthy:
+                return None            # a plain retarget will do
+            try:
+                out = self.cluster.degraded_read(ps, names)
+            except (ValueError, KeyError) as e:
+                if isinstance(e, KeyError):
+                    raise              # no such object is definitive
+                return None            # not decodable: keep retrying
+        self.perf.inc("op_degraded")
+        return out
 
     def write(self, objects: dict[str, bytes | np.ndarray],
               snapc: int = 0) -> None:

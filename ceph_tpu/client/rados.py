@@ -145,6 +145,15 @@ class IoCtx:
             return arr[offset:].tobytes()
         return arr[offset:offset + length].tobytes()
 
+    def read_many(self, names) -> dict[str, bytes]:
+        """Batched reads: one submission per PG, each decoded in one
+        batched launch (the aio_read-batch role; wire-tier Client
+        .read_many parity). Rides the Objecter, so the degraded-read
+        fast path covers these too — a dead primary costs a decode
+        from surviving shards, not a detection wait."""
+        got = self._ob.read(list(names))
+        return {n: arr.tobytes() for n, arr in got.items()}
+
     def remove(self, name: str, snapc: int = 0) -> None:
         self._ob.remove(name, snapc=snapc)
 

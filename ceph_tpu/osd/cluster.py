@@ -817,6 +817,23 @@ class SimCluster:
             return out
         raise ValueError(f"unknown client op kind {kind!r}")
 
+    def degraded_read(self, ps: int, names):
+        """Degraded-read fast path (the wire tier's `read_degraded`
+        analog, ROADMAP item 3): serve a read from any k surviving
+        shards RIGHT NOW, bypassing the primary-session and peering
+        gates client_rpc enforces — a dead or still-peering primary
+        must cost a decode, not a detection + activation wait (the
+        degraded-read tail of the online-EC study, arxiv 1709.05365).
+        Reads mutate nothing, so no EIO repair writeback either
+        (repair=False keeps the re-decode)."""
+        with self.op_tracker.create_op(
+                f"degraded_read pg 1.{ps}") as op:
+            dead = self._dead_osds()
+            out = self.pgs[ps].read_objects(names, dead_osds=dead,
+                                            repair=False)
+            op.mark_event("reply_sent")
+            return out
+
     # -- failure model ------------------------------------------------------
 
     def kill_osd(self, osd: int) -> None:

@@ -123,7 +123,7 @@ class ECBackend(PGBackend):
     def __init__(self, profile: dict | str, pg: str, acting: list[int],
                  cluster: ShardSet | None = None,
                  chunk_size: int | None = None,
-                 perf=None):
+                 perf=None, ensure_collections: bool = True):
         # data-path counters: the owning daemon passes its shared "ec"
         # logger; a bare backend (benches, unit tests) gets its own
         self.perf = perf if perf is not None else ec_perf_counters()
@@ -155,7 +155,8 @@ class ECBackend(PGBackend):
         requested = chunk_size or self.coder.get_chunk_size(0) or 4096
         cs = self.coder.get_chunk_size(requested * self.k)
         self.sinfo = StripeInfo(self.k, cs)
-        self._init_common(pg, acting, cluster or ShardSet())
+        self._init_common(pg, acting, cluster or ShardSet(),
+                          ensure_collections=ensure_collections)
         self._fused_cache: dict = {}
         # read-path EIO accounting (verify-on-read mismatches + the
         # in-place rewrites they triggered)
@@ -544,14 +545,17 @@ class ECBackend(PGBackend):
 
     def read_objects(self, names: list[str],
                      dead_osds: set[int] | None = None,
-                     verify: bool = True) -> dict[str, np.ndarray]:
+                     verify: bool = True,
+                     repair: bool = True) -> dict[str, np.ndarray]:
         """Batched reads with BlueStore-style verify-on-read: every
         chunk consumed is CRC-checked against its stored hinfo in one
         batched launch (ref: BlueStore::_verify_csum on every read);
         a mismatch is the EIO path — the read transparently re-decodes
         from other shards AND repairs the rotten chunk in place (ref:
         the read-error recovery qa/standalone/erasure-code/
-        test-erasure-eio.sh exercises)."""
+        test-erasure-eio.sh exercises). repair=False keeps the
+        re-decode but skips the writeback — the read-only contract of
+        a degraded-read view served by a non-primary."""
         dead = dead_osds or set()
         alive = [s for s in range(self.n)
                  if self.acting[s] not in dead]
@@ -570,10 +574,26 @@ class ECBackend(PGBackend):
             # a shard that missed any of this group's writes is stale
             # for it and must not serve (it replays on rejoin)
             avail = self._fresh_for(group, alive)
-            need = sorted(self.coder.minimum_to_decode(want, avail))
-            stacks = {s: np.stack([self._store(s).read(shard_cid(self.pg, s),
-                                                       n) for n in group])
-                      for s in need}
+            while True:
+                # minimum_to_decode raises when the survivors can't
+                # cover `want` — the caller's retry boundary
+                need = sorted(self.coder.minimum_to_decode(want, avail))
+                stacks, missing = {}, None
+                for s in need:
+                    try:
+                        stacks[s] = np.stack(
+                            [self._store(s).read(shard_cid(self.pg, s),
+                                                 n) for n in group])
+                    except KeyError:
+                        # cursor says fresh but the store lacks the
+                        # object: a repointed slot whose rebuild has
+                        # not landed this object yet (recovery in
+                        # flight) — plan around it like a stale shard
+                        missing = s
+                        break
+                if missing is None:
+                    break
+                avail.remove(missing)
             bad: dict[str, set[int]] = {}
             if verify:
                 rows = np.concatenate([stacks[s] for s in need])
@@ -606,11 +626,12 @@ class ECBackend(PGBackend):
             for name, bad_set in bad.items():
                 self.eio_stats["read_eio"] += len(bad_set)
                 self.perf.inc("read_eio", len(bad_set))
-                out[name] = self._read_eio(name, sl, avail, bad_set)
+                out[name] = self._read_eio(name, sl, avail, bad_set,
+                                           repair=repair)
         return out
 
     def _read_eio(self, name: str, sl: int, avail: list[int],
-                  bad: set[int]) -> np.ndarray:
+                  bad: set[int], repair: bool = True) -> np.ndarray:
         """One object's EIO path: decode around the rotten shards,
         return the bytes, and repair the rot in place.
 
@@ -629,10 +650,17 @@ class ECBackend(PGBackend):
             for s in need:
                 st = self._store(s)
                 cid = shard_cid(self.pg, s)
-                chunk = st.read(cid, name)
+                try:
+                    chunk = st.read(cid, name)
+                    hinfo = HashInfo.from_bytes(st.getattr(cid, name,
+                                                           HINFO_KEY))
+                except KeyError:
+                    # repointed slot mid-rebuild (no bytes/hinfo yet):
+                    # plan around it, exactly like rot
+                    bad.add(s)
+                    newly_bad = True
+                    break
                 crc = int(self._batched_crcs(chunk[None, :])[0])
-                hinfo = HashInfo.from_bytes(st.getattr(cid, name,
-                                                       HINFO_KEY))
                 if crc != hinfo.get_chunk_hash(0):
                     self.eio_stats["read_eio"] += 1
                     bad.add(s)
@@ -644,7 +672,8 @@ class ECBackend(PGBackend):
             rec = self.coder.decode(want, stacks)
             shards = np.stack([rec[s] for s in self.data_slots], axis=1)
             obj = self.sinfo.shards_to_object(shards)[0]
-            self._repair_shards(name, obj, sorted(bad), sl)
+            if repair:
+                self._repair_shards(name, obj, sorted(bad), sl)
             return obj[:self.object_sizes[name]]
 
     def _repair_shards(self, name: str, logical: np.ndarray,

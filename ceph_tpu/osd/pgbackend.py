@@ -52,14 +52,20 @@ class PGBackend:
     #: min_size gate); subclasses set it in __init__
     min_live: int = 1
 
-    def _init_common(self, pg: str, acting: list[int], cluster) -> None:
+    def _init_common(self, pg: str, acting: list[int], cluster,
+                     ensure_collections: bool = True) -> None:
         self.pg = pg
         self.acting = list(acting)
         self.n = len(acting)
         self.cluster = cluster
-        for shard, osd in enumerate(self.acting):
-            t = Transaction().create_collection(shard_cid(pg, shard))
-            self.cluster.osd(osd).queue_transaction(t)
+        if ensure_collections:
+            # ensure_collections=False builds a READ-ONLY view (the
+            # degraded-read fast path): no store mutation, and no txn
+            # to an acting member that may be dead-but-not-yet-marked
+            # (the collections already exist on every real member)
+            for shard, osd in enumerate(self.acting):
+                t = Transaction().create_collection(shard_cid(pg, shard))
+                self.cluster.osd(osd).queue_transaction(t)
         self.object_sizes: dict[str, int] = {}  # authoritative size info
         # mutation log + per-shard applied cursor (ref: PGLog /
         # peering's last_update per shard): a shard that missed writes
@@ -405,7 +411,8 @@ class ReplicatedBackend(PGBackend):
     """
 
     def __init__(self, size: int, pg: str, acting: list[int],
-                 cluster=None, min_size: int | None = None):
+                 cluster=None, min_size: int | None = None,
+                 ensure_collections: bool = True):
         if len(acting) != size:
             raise ValueError(f"acting set size {len(acting)} != size={size}")
         from .ecbackend import ShardSet
@@ -416,7 +423,8 @@ class ReplicatedBackend(PGBackend):
             else size - size // 2
         if not (1 <= self.min_live <= size):
             raise ValueError(f"min_size {self.min_live} not in [1, {size}]")
-        self._init_common(pg, acting, cluster or ShardSet())
+        self._init_common(pg, acting, cluster or ShardSet(),
+                          ensure_collections=ensure_collections)
         self.eio_stats = {"read_eio": 0, "repaired": 0}
 
     def _expected_shard_len(self, object_size: int) -> int:
@@ -505,12 +513,15 @@ class ReplicatedBackend(PGBackend):
     # -- read path -----------------------------------------------------------
 
     def read_objects(self, names, dead_osds=None,
-                     verify: bool = True) -> dict[str, np.ndarray]:
+                     verify: bool = True,
+                     repair: bool = True) -> dict[str, np.ndarray]:
         """Serve each object from the first caught-up live replica
         (primary-first, the reference's default read path), with
         verify-on-read: a digest mismatch fails over to the next good
         replica and repairs the rotten copy in place (the read-error
-        EIO path)."""
+        EIO path). repair=False fails over without the writeback — the
+        read-only contract of a degraded-read view served by a
+        non-primary (only an activated primary may mutate shards)."""
         alive = self._live_slots(dead_osds)
         out: dict[str, np.ndarray] = {}
         srcs_of: dict[str, list[int]] = {}
@@ -556,13 +567,16 @@ class ReplicatedBackend(PGBackend):
                     suspects.append(n)
         for name in suspects:  # EIO path: failover + repair
             out[name] = self._read_failover(name, srcs_of[name],
-                                            {srcs_of[name][0]})
+                                            {srcs_of[name][0]},
+                                            repair=repair)
         return out
 
     def _read_failover(self, name: str, srcs: list[int],
-                       bad: set[int]) -> np.ndarray:
+                       bad: set[int],
+                       repair: bool = True) -> np.ndarray:
         """Try the remaining fresh replicas in order; the first
-        digest-valid copy wins and repairs every rotten one met."""
+        digest-valid copy wins and repairs every rotten one met
+        (unless repair=False — the read-only degraded view)."""
         good = None
         for s in srcs:
             if s in bad:
@@ -583,8 +597,9 @@ class ReplicatedBackend(PGBackend):
         if good is None:
             raise ValueError(
                 f"every replica of {name!r} fails its digest")
-        for s in bad:
-            self._rewrite_replica(name, s, good)
+        if repair:
+            for s in bad:
+                self._rewrite_replica(name, s, good)
         return good
 
     def _rewrite_replica(self, name: str, s: int,

@@ -26,6 +26,17 @@ drive it with wall time. Weight tags use a per-class "virtual start"
 bumped to now on idle->busy transitions so an idle class doesn't bank
 credit forever (dmclock's idle-adjustment).
 
+Classes are DYNAMIC: beyond the fixed op-class split (client /
+background_recovery / scrub ...), the wire OSD registers one class per
+client entity ("tenant:<entity>", see OSDDaemon._client_class) via
+ensure_class(), each with its own (ρ, w, λ) resolved from the
+osd_mclock_scheduler_tenant_* config — the per-client dmclock deployment
+shape from the mClock paper, so one heavy tenant (or its hedged
+duplicates) competes under its own tags instead of riding the shared
+client class. Idle tenant classes cost one tag comparison per dequeue
+and are not garbage-collected (tenant counts here are tens, not
+millions).
+
 TPU relevance: the scheduler is the admission layer that decides WHICH
 batch the device runs next (client encode vs recovery decode vs scrub
 CRC); keeping it cost-aware keeps recovery from starving client
@@ -68,6 +79,35 @@ DEFAULT_PROFILES = {
 }
 
 
+def parse_profile(spec: str) -> ClientProfile:
+    """'res,wgt,lim' -> ClientProfile (ops/s-space; lim 0 = unlimited).
+    The value grammar of the osd_mclock_scheduler_tenant_default
+    option."""
+    parts = [p.strip() for p in str(spec).split(",")]
+    if len(parts) != 3:
+        raise ValueError(f"bad profile spec {spec!r} "
+                         f"(want 'res,wgt,lim')")
+    res, wgt, lim = (float(p) for p in parts)
+    return ClientProfile(reservation=res, weight=wgt, limit=lim)
+
+
+def parse_profile_table(spec: str) -> dict[str, ClientProfile]:
+    """'entityA=r,w,l;entityB=r,w,l' -> per-tenant profile table (the
+    osd_mclock_scheduler_tenant_profiles grammar). Empty items are
+    skipped so trailing ';' is legal."""
+    out: dict[str, ClientProfile] = {}
+    for item in str(spec).split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        ent, eq, prof = item.partition("=")
+        if not eq or not ent.strip():
+            raise ValueError(f"bad tenant profile item {item!r} "
+                             f"(want 'entity=res,wgt,lim')")
+        out[ent.strip()] = parse_profile(prof)
+    return out
+
+
 class _ClassQueue:
     __slots__ = ("profile", "items", "r_prev", "l_prev", "p_prev",
                  "busy", "served", "served_cost")
@@ -95,6 +135,19 @@ class MClockScheduler:
         if name in self._classes:
             raise ValueError(f"class {name!r} exists")
         self._classes[name] = _ClassQueue(profile)
+
+    def ensure_class(self, name: str, profile: ClientProfile) -> None:
+        """Create-or-retune: the dynamic per-tenant registration path
+        (first op from a new client entity creates its class; a config
+        change retunes it in place, queued ops keep their order)."""
+        q = self._classes.get(name)
+        if q is None:
+            self._classes[name] = _ClassQueue(profile)
+        elif q.profile != profile:
+            self.set_profile(name, profile)
+
+    def class_names(self) -> list[str]:
+        return list(self._classes)
 
     def remove_if(self, cls: str, pred) -> int:
         """Drop queued ops of `cls` matching pred(item) — cancelled
@@ -202,13 +255,15 @@ class MClockScheduler:
     def dump(self) -> dict:
         """Per-class occupancy + grant counters (the `dump_mclock`
         admin view; recovery_bench emits this next to perf deltas)."""
+        # snapshot the table: tenant classes appear dynamically from
+        # dispatch threads while admin/bench threads dump
         return {name: {"queued": len(q.items),
                        "served": q.served,
                        "served_cost": round(q.served_cost, 3),
                        "profile": {"reservation": q.profile.reservation,
                                    "weight": q.profile.weight,
                                    "limit": q.profile.limit}}
-                for name, q in self._classes.items()}
+                for name, q in list(self._classes.items())}
 
     def drain(self, now: float, budget: int | None = None) -> list:
         """Dequeue until idle/limit-bound (or budget ops); the per-tick

@@ -563,7 +563,7 @@ class _PendingCall:
     contract, split so callers can have MANY of these on the wire."""
 
     __slots__ = ("_rpc", "rid", "peer", "nbytes", "_ev", "_replies",
-                 "_released")
+                 "_released", "_waiters")
 
     def __init__(self, rpc: "_Rpc", rid: int, peer: str, nbytes: int):
         self._rpc = rpc
@@ -571,6 +571,7 @@ class _PendingCall:
         self._ev = threading.Event()
         self._replies: list = []
         self._released = False
+        self._waiters: list[threading.Event] = []
 
     def wait(self, timeout: float = 10.0):
         try:
@@ -584,9 +585,48 @@ class _PendingCall:
         finally:
             self._rpc._retire(self)
 
+    # -- hedged-read surface: wait-any without retiring -----------------------
+
+    def ready(self, timeout: float | None = 0.0) -> bool:
+        """Reply (or transport error) arrived? Unlike wait(), does NOT
+        retire the handle — the hedging client polls many handles and
+        claims only the winner."""
+        return self._ev.wait(timeout)
+
+    def take(self):
+        """Claim a ready() handle: the reply, or raises its transport
+        error. Retires exactly like wait() — call once."""
+        try:
+            rep = self._replies[0]
+            if isinstance(rep, BaseException):
+                raise rep
+            return rep
+        finally:
+            self._rpc._retire(self)
+
+    def cancel(self) -> None:
+        """Abandon the op: frees the window slot NOW and drops any
+        late reply on the floor (_on_reply pops the table entry, so a
+        straggler reply no longer matches). The hedging client's
+        loser-cancellation path; retiring twice is a no-op, so a
+        cancel racing the reply is safe either way."""
+        self._rpc._retire(self)
+
+    def add_waiter(self, ev: threading.Event) -> None:
+        """Signal `ev` (too) on completion — the wait-any primitive the
+        hedge loop blocks on instead of polling."""
+        self._waiters.append(ev)
+        if self._ev.is_set():   # completion raced the registration
+            ev.set()
+
+    def _notify(self) -> None:
+        self._ev.set()
+        for ev in self._waiters:
+            ev.set()
+
     def fail(self, err: BaseException) -> None:
         self._replies.append(err)
-        self._ev.set()
+        self._notify()
 
 
 class _Rpc:
@@ -649,7 +689,7 @@ class _Rpc:
         if ent is not None:
             self.perf.inc("op_reply")
             ent._replies.append(msg)
-            ent._ev.set()
+            ent._notify()
 
     def _release_locked(self, ent: _PendingCall) -> None:
         if ent._released:
@@ -1199,6 +1239,11 @@ class OSDDaemon:
             "scrub": (0.0, 1.0, 50.0)},
     }
 
+    #: per-tenant class namespace inside the scheduler — one class per
+    #: client entity, so heavy tenants (and their hedged duplicates)
+    #: compete under their own (ρ, w, λ) tags
+    _TENANT_CLS = "tenant:"
+
     def _mclock_profiles(self) -> dict:
         """(ρ, w, λ) per op class, resolved LIVE through this daemon's
         layered config: osd_mclock_profile picks a built-in split;
@@ -1224,10 +1269,47 @@ class OSDDaemon:
         return {cls: ClientProfile(reservation=r, weight=w, limit=lim)
                 for cls, (r, w, lim) in table.items()}
 
+    def _tenant_profile(self, entity: str):
+        """Resolve one client entity's (ρ, w, λ): the per-entity table
+        first, then the tenant default, then the aggregate client
+        class split (equal-share per entity). All three resolve LIVE
+        through config, so `ceph config set
+        osd_mclock_scheduler_tenant_profiles ...` retunes a running
+        daemon's tenants on the next fold."""
+        from .scheduler import parse_profile, parse_profile_table
+        try:
+            table = parse_profile_table(
+                self.config["osd_mclock_scheduler_tenant_profiles"])
+            if entity in table:
+                return table[entity]
+            dflt = str(
+                self.config["osd_mclock_scheduler_tenant_default"]
+            ).strip()
+            if dflt:
+                return parse_profile(dflt)
+        except (KeyError, ValueError) as e:
+            self.c.log(f"{self.name}: bad tenant QoS config ignored: "
+                       f"{e}")
+        return self._mclock_profiles()["client"]
+
+    def _client_class(self, peer: str) -> str:
+        """mClock class of one client op: per-tenant, keyed by the
+        cephx entity bound to the peer's session (the authenticated
+        identity; caps already gated it) — the transport peer name
+        without cephx. Registers the class on first contact."""
+        sess = self._authed.get(peer)
+        entity = sess["entity"] if sess is not None else peer
+        cls = self._TENANT_CLS + entity
+        with self._sched_cv:
+            self.op_sched.ensure_class(cls,
+                                       self._tenant_profile(entity))
+        return cls
+
     def _refresh_mclock_profiles(self) -> None:
         """Re-resolve the (ρ, w, λ) table after a config change (called
         from the central-config fold — cheaper and lifetime-safer than
-        per-key observers across revives)."""
+        per-key observers across revives). Live per-tenant classes are
+        re-resolved too."""
         try:
             profiles = self._mclock_profiles()
         except (KeyError, ValueError) as e:
@@ -1238,6 +1320,11 @@ class OSDDaemon:
                 q = self.op_sched._classes.get(cls)
                 if q is not None and q.profile != prof:
                     self.op_sched.set_profile(cls, prof)
+            for cls in self.op_sched.class_names():
+                if cls.startswith(self._TENANT_CLS):
+                    entity = cls[len(self._TENANT_CLS):]
+                    self.op_sched.ensure_class(
+                        cls, self._tenant_profile(entity))
 
     def _sched_enqueue(self, cls: str, item, cost: float = 1.0) -> None:
         with self._sched_cv:
@@ -1377,15 +1464,18 @@ class OSDDaemon:
     def _acting(self, ps: int) -> list[int]:
         return self.osdmap.pg_to_up_acting_osds(1, ps)[2]
 
-    def _make_backend(self, ps: int, acting: list[int]):
+    def _make_backend(self, ps: int, acting: list[int],
+                      ensure_collections: bool = True):
         if self.c.is_erasure:
             return ECBackend(self.c.profile, f"1.{ps}", acting,
                              self._shard_set(),
                              chunk_size=self.c.chunk_size,
-                             perf=self.ec_perf)
+                             perf=self.ec_perf,
+                             ensure_collections=ensure_collections)
         return ReplicatedBackend(self.c.pool_size, f"1.{ps}", acting,
                                  self._shard_set(),
-                                 min_size=self.c.pool_min_size)
+                                 min_size=self.c.pool_min_size,
+                                 ensure_collections=ensure_collections)
 
     def _persist_meta(self, ps: int) -> None:
         """Ship the PG's FULL metadata to every live shard as omap
@@ -1547,9 +1637,9 @@ class OSDDaemon:
             head = max(head, delta[3][-1][1])
         return (epoch, head)
 
-    def _load_meta(self, ps: int,
-                   acting: list[int]) -> tuple[bytes | None,
-                                               bytes | None, bool]:
+    def _load_meta(self, ps: int, acting: list[int],
+                   suspect_extra: set[int] | None = None
+                   ) -> tuple[bytes | None, bytes | None, bool]:
         """Find the FRESHEST persisted PG metadata: gather the blob
         from the local shard AND every reachable acting member, decode
         each, and keep the one with the highest (epoch, head) — a
@@ -1565,6 +1655,10 @@ class OSDDaemon:
         as authoritative (ref: PeeringState GetInfo needs a quorum
         before the PG may go active)."""
         pgid = f"1.{ps}"
+        # suspect_extra: callers' dead-peer hints (a degraded read's
+        # routed-around primary) — skipped like suspects, but NEVER
+        # recorded into self.suspect (the hint is per-op and untrusted)
+        skip = set(self.suspect) | (suspect_extra or set())
         local_blobs: list[tuple[bytes, bytes | None]] = []
         remote_blobs: list[tuple[bytes, bytes | None]] = []
         heard = {self.osd_id}
@@ -1578,7 +1672,7 @@ class OSDDaemon:
         n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
             else 0
         for osd in dict.fromkeys(acting):   # each peer once, in order
-            if osd == self.osd_id or osd in self.suspect \
+            if osd == self.osd_id or osd in skip \
                     or not _valid_osd(osd, n_osds):
                 continue
             rs = RemoteStore(
@@ -2133,6 +2227,15 @@ class OSDDaemon:
                           "dispatch-path authorizes failed fast on a "
                           "cold ticket cache")
          .add_u64_counter("mgr_reports_tx", "MgrReports shipped")
+         .add_u64_counter("op_degraded_read",
+                          "objects served through the degraded-read "
+                          "fast path (any-k decode, peering bypassed)")
+         .add_u64_counter("degraded_view_builds",
+                          "read-only degraded views built (meta "
+                          "gather + decode, non-primary serves)")
+         .add_time_avg("degraded_read_time",
+                       "degraded-read service time (gather + any-k "
+                       "decode)")
          .add_u64("numpg", "PGs this daemon primaries")
          .add_u64("osdmap_epoch", "newest folded map epoch")
          .add_time_avg("op_latency",
@@ -2193,14 +2296,15 @@ class OSDDaemon:
         # into nonsense
         self._mgr_last_perf = None
 
-    _READ_KINDS = frozenset({"read", "readv", "snap_read",
-                             "admin"})
+    _READ_KINDS = frozenset({"read", "readv", "read_degraded",
+                             "snap_read", "admin"})
 
     _ADMIN_CMDS = ("perf dump", "perf reset", "perf schema",
                    "dump_historic_ops",
                    "dump_historic_ops_by_duration",
                    "dump_ops_in_flight", "slow_ops", "pg stat",
-                   "dump_scrubs", "log dump", "config show",
+                   "dump_mclock", "dump_scrubs", "log dump",
+                   "config show",
                    "config diff", "trace start", "trace stop",
                    "status")
 
@@ -2260,6 +2364,11 @@ class OSDDaemon:
         if cmd == "trace stop":
             from ..utils.tracing import stop_trace
             return {"stopped": stop_trace()}
+        if cmd == "dump_mclock":
+            # per-class occupancy + grants, tenant classes included
+            # (the scheduler's own dump snapshots the dynamic table)
+            with self._sched_cv:
+                return self.op_sched.dump()
         if cmd == "dump_scrubs":
             with self._lock:   # heartbeat inserts concurrently
                 return {"scrubs": {f"1.{ps}": r for ps, r in
@@ -2374,9 +2483,12 @@ class OSDDaemon:
         # single worker drains in tag order — during recovery a client
         # op waits behind at most one recovery batch grant, not the
         # whole rebuild (the pre-r10 inline path held the daemon lock
-        # for the full multi-second round)
+        # for the full multi-second round). Client ops land in their
+        # PER-TENANT class (one per client entity), so a heavy tenant
+        # — hedged duplicates and degraded decodes included — competes
+        # under its own (ρ, w, λ) tags instead of starving the rest.
         cls = "scrub" if msg.kind in ("deep_scrub", "repair") \
-            else "client"
+            else self._client_class(peer)
         self._sched_enqueue(
             cls, lambda: self._serve_client_op(peer, msg, sub_ops))
 
@@ -2547,6 +2659,12 @@ class OSDDaemon:
         import json as _json
         d = Decoder(body)
         ps = d.u32()
+        if kind == "read_degraded":
+            # degraded-read fast path: served by ANY reachable acting
+            # member — the not-primary and WaitUpThru gates below
+            # deliberately do not apply (a read mutates nothing and
+            # the serving view is read-only; see _degraded_read_op)
+            return self._degraded_read_op(ps, d)
         be = self.backends.get(ps)
         if be is None:
             raise RuntimeError(f"not primary for pg 1.{ps} "
@@ -2679,6 +2797,105 @@ class OSDDaemon:
             self._persist_meta(ps)   # kv mutations ride the metadata
             return out
         raise ValueError(f"unknown client op {kind!r}")
+
+    # -- degraded-read fast path (server side) -------------------------------
+
+    def _degraded_view(self, ps: int, hints: set[int]):
+        """READ-ONLY backend over the freshest quorum-visible PG
+        metadata — what lets a surviving acting shard serve reads
+        while the primary is down, unreachable, or still peering
+        (WaitUpThru), instead of parking them behind activation and
+        recovery (ROADMAP item 3; the online-EC characterization's
+        degraded-read tail, arxiv 1709.05365).
+
+        Correctness leans on the meta-rides-the-write discipline: an
+        ACKED write persisted its (base, delta) metadata on every live
+        shard in the same transaction wave as the bytes, so the
+        freshest pair a MAJORITY gather can see always covers it —
+        serving from that pair is read-your-acked-writes consistent.
+        The view is rebuilt per op (never cached): a primary may have
+        activated elsewhere and served writes since any cached gather.
+        No collections are created, nothing is persisted, EIO repairs
+        are disabled — only an activated primary mutates shards.
+        Raises RuntimeError (retryable at the client) when the gather
+        cannot reach quorum."""
+        acting = self._acting(ps)
+        blob, _local, quorum_ok = self._load_meta(
+            ps, acting, suspect_extra=hints)
+        if not quorum_ok:
+            raise RuntimeError(f"pg 1.{ps} degraded read deferred "
+                               f"(meta gather below quorum)")
+        be = self._make_backend(ps, acting, ensure_collections=False)
+        if blob is None:
+            return be            # virgin PG: the name check KeyErrors
+        base, delta_blob = blob
+        d, v = self._meta_decoder(base)
+        if v >= 3:
+            d.u64()              # persist epoch (ranking already used it)
+        be.object_sizes = d.mapping(Decoder.string, Decoder.u64)
+        be.object_versions = d.mapping(Decoder.string, Decoder.u64)
+        be.pg_log = PGLog.decode(d.blob())
+        applied = d.list(Decoder.u64)
+        meta_acting = d.list(Decoder.i32)
+        # the v2 tail (snapsets/births/cls-kv) is deliberately not
+        # decoded: plain reads need sizes/versions/cursors only;
+        # snap_read stays on the activated-primary path
+        applied = self._apply_meta_delta(
+            delta_blob, be.object_sizes, be.object_versions,
+            be.pg_log, applied)
+        # adopt the RECORDED acting: that is the set the cursors (and
+        # the shard bytes) were written against
+        be.acting = list(meta_acting)
+        be.shard_applied = list(applied)
+        return be
+
+    def _degraded_read_op(self, ps: int, d: Decoder) -> bytes:
+        """Serve a `read_degraded` op: fetch any k fresh surviving
+        shards and decode on device through the process-wide fused
+        programs (r10), skipping every down/suspected/hinted member.
+        The hint list carries the OSDs the client is routing around
+        (its timed-out primary) — honored for this op only, never
+        recorded into self.suspect. Reply encoding matches `readv`
+        (list of blobs, in name order)."""
+        names = d.list(Decoder.string)
+        hints = {int(h) for h in d.list(Decoder.i32)}
+        n_osds = len(self.osdmap.osd_up)
+        dead = ({o for o in range(n_osds)
+                 if not self.osdmap.osd_up[o]}
+                | set(self.suspect) | hints)
+        dead.discard(self.osd_id)   # our own store always answers us
+        be = self.backends.get(ps)
+        need_ut = self._interval_start.get(ps, 0)
+        if be is not None \
+                and int(self.osdmap.osd_up_thru[self.osd_id]) >= need_ut:
+            # we ARE the activated primary: the normal engine serves
+            # (a hedged duplicate landing here costs one decode, and
+            # EIO repair stays on — we own the shards)
+            src, repair = be, True
+        else:
+            self.perf.inc("degraded_view_builds")
+            src, repair = self._degraded_view(ps, hints), False
+        for n in names:
+            if n not in src.object_sizes:
+                raise KeyError(n)
+        with self.perf.time("degraded_read_time"):
+            try:
+                got = src.read_objects(names, dead_osds=dead,
+                                       repair=repair)
+            except KeyError as e:
+                # names were just checked, so this KeyError is a
+                # SHARD-level store miss: the meta already names a
+                # repointed, still-rebuilding slot (recovery in
+                # flight) whose store lacks this object. Transient —
+                # surface as retryable, never as no-such-object.
+                raise RuntimeError(
+                    f"pg 1.{ps} degraded read raced recovery ({e}); "
+                    f"retry") from None
+        self.perf.inc("op_degraded_read", len(names))
+        e = Encoder()
+        e.list([np.asarray(got[n], np.uint8).tobytes()
+                for n in names], Encoder.blob_ref)
+        return e.bytes()
 
     def _mark_suspects(self, be) -> None:
         n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
@@ -4039,17 +4256,28 @@ def _wire_authorize(cauth, rpc: _Rpc, peer: str, service: str,
 
 
 class _WireOp:
-    """One client op's retry state inside _run_ops."""
+    """One client op's retry state inside _run_ops.
+
+    `names` (read kinds only) lets the op be re-issued as a
+    `read_degraded` frame to a non-primary acting shard — the hedged /
+    degraded dispatch paths; mutating ops never carry names and are
+    never duplicated. `avoid` collects targets that transport-failed
+    for THIS op; `try_degraded` marks that the primary path is parked
+    (peering / not-primary / timed out) and the next round should go
+    straight to a surviving shard."""
 
     __slots__ = ("kind", "ps", "body_fn", "blob", "last", "done",
-                 "fatal")
+                 "fatal", "names", "avoid", "try_degraded")
 
-    def __init__(self, kind: str, ps: int, body_fn):
+    def __init__(self, kind: str, ps: int, body_fn, names=None):
         self.kind, self.ps, self.body_fn = kind, ps, body_fn
         self.blob: bytes = b""
         self.last = None
         self.done = False
         self.fatal: BaseException | None = None
+        self.names: list[str] | None = names
+        self.avoid: set[str] = set()
+        self.try_degraded = False
 
 
 class Client:
@@ -4059,11 +4287,20 @@ class Client:
     payload budget) with per-primary frame coalescing — see
     _run_ops."""
 
+    #: read kinds a client may duplicate (hedge) or re-route to a
+    #: surviving shard as `read_degraded` — NEVER mutations (exactly-
+    #: once would break) and not snap_read (snap state lives only at
+    #: the activated primary)
+    _HEDGE_KINDS = frozenset({"read", "readv"})
+
     def __init__(self, cluster: "StandaloneCluster", name: str = "client",
                  entity: str = "client.admin",
                  secret: bytes | None = None,
                  window: int | None = None,
-                 window_bytes: int = 64 << 20):
+                 window_bytes: int = 64 << 20,
+                 hedge_delay_ms: float | None = None):
+        from ..utils.op_tracker import OpTracker
+        from ..utils.perf_counters import PerfCountersBuilder
         self.c = cluster
         self.msgr = Messenger(name, secret=cluster.secret,
                               compress=cluster.compress)
@@ -4073,6 +4310,44 @@ class Client:
                         window_bytes=window_bytes)
         self.osdmap: OSDMap | None = None
         self._lock = threading.Lock()
+        # hedged-read knob: None resolves through the committed
+        # central config (client_hedge_delay_ms) with the schema
+        # default (0 = auto from latency history, < 0 = off)
+        self.hedge_delay_ms = hedge_delay_ms
+        # read-frame latency history: the OpTracker the auto hedge
+        # delay derives from (submit->reply wall time per read frame)
+        self.op_tracker = OpTracker(history_size=64,
+                                    complaint_time=5.0)
+        self.perf = (PerfCountersBuilder("client")
+                     .add_u64_counter("hedge_issued",
+                                      "duplicate shard reads sent "
+                                      "after the hedge delay")
+                     .add_u64_counter("hedge_wins",
+                                      "ops settled by the hedged "
+                                      "duplicate first")
+                     .add_u64_counter("hedge_losses",
+                                      "hedges beaten by the primary "
+                                      "reply (loser cancelled)")
+                     .add_u64_counter("hedge_cancelled",
+                                      "in-flight frames abandoned "
+                                      "after the other side won or "
+                                      "the round timed out")
+                     .add_u64_counter("degraded_dispatch",
+                                      "reads sent straight to a "
+                                      "surviving shard (primary "
+                                      "down/parked)")
+                     .add_u64_counter("degraded_served",
+                                      "ops settled by a degraded "
+                                      "shard reply")
+                     .create_perf_counters())
+        # per-target read-latency EWMA: orders the fallback/hedge
+        # candidates ("next-best shard")
+        self._lat_ewma: dict[str, float] = {}
+        # complaint memory: targets that transport-failed or lost to a
+        # hedge outright, pinned to the map epoch that named them —
+        # later reads skip the hedge delay and go straight degraded
+        # until a newer map (or a successful reply) clears the entry
+        self._tgt_suspect: dict[str, int] = {}
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
         # read-only monitor commands (status/health/prometheus) ride
         # their own correlation space
@@ -4173,8 +4448,8 @@ class Client:
         return self.mon_command("prometheus")["text"]
 
     def _op(self, kind: str, ps: int, body_fn, timeout=None,
-            retries=30, retry_sleep=0.3) -> bytes:
-        op = _WireOp(kind, ps, body_fn)
+            retries=30, retry_sleep=0.3, names=None) -> bytes:
+        op = _WireOp(kind, ps, body_fn, names=names)
         self._run_ops([op], timeout=timeout, retries=retries,
                       retry_sleep=retry_sleep)
         return op.blob
@@ -4215,6 +4490,241 @@ class Client:
             op.fatal = KeyError(err[9:] or err)
             return
         # anything else is transport-shaped: retarget and retry
+        if op.kind in self._HEDGE_KINDS \
+                and ("peering" in err or "not primary" in err):
+            # the mapped primary exists but cannot serve yet
+            # (WaitUpThru / restore pending): route the next round
+            # straight to a surviving shard as a degraded read
+            # instead of sleeping out the peering window
+            op.try_degraded = True
+
+    # -- degraded / hedged read dispatch --------------------------------------
+
+    def _hedge_delay_s(self) -> float | None:
+        """Resolve the live hedge delay: constructor override, else
+        the committed central config, else the schema default. > 0 =
+        fixed seconds; None = hedging off; 0/auto derives from this
+        client's OpTracker read-latency history (a generous multiple
+        of recent p95, floored so healthy clusters almost never hedge
+        and capped below the op timeout so a hedge still has time to
+        win)."""
+        raw = self.hedge_delay_ms
+        if raw is None and self.osdmap is not None:
+            raw = self.osdmap.config_kv.get("client_hedge_delay_ms")
+        if raw is None:
+            raw = 0.0
+        try:
+            raw = float(raw)
+        except ValueError:
+            return None
+        if raw < 0:
+            return None
+        if raw > 0:
+            return raw / 1e3
+        hist = sorted(self.op_tracker.recent_durations(32))
+        lo, hi = 0.15, max(0.15, self.c.op_timeout / 2.0)
+        if not hist:
+            return hi
+        p95 = hist[min(len(hist) - 1, int(0.95 * len(hist)))]
+        return min(max(4.0 * p95, lo), hi)
+
+    def _read_fallback(self, ps: int, avoid: set[str]) -> str | None:
+        """Next-best acting shard for a degraded/hedged read: an
+        acting member that is up in OUR map and not in `avoid`,
+        preferring the one with the best recent latency (EWMA per
+        target), then acting order."""
+        acting = self.osdmap.pg_to_up_acting_osds(1, ps)[2]
+        n = len(self.osdmap.osd_up)
+        cands = []
+        for rank, o in enumerate(dict.fromkeys(acting)):
+            if not _valid_osd(o, n) or not self.osdmap.osd_up[o]:
+                continue
+            name = f"osd.{o}"
+            if name in avoid or self._target_suspected(name):
+                continue
+            # unmeasured targets rank after measured ones, in acting
+            # order — "next-best" prefers a shard we know answers fast
+            cands.append((self._lat_ewma.get(name, float("inf")),
+                          rank, name))
+        if not cands:
+            return None
+        return min(cands)[2]
+
+    def _note_latency(self, tgt: str, dt: float) -> None:
+        prev = self._lat_ewma.get(tgt)
+        self._lat_ewma[tgt] = dt if prev is None \
+            else 0.75 * prev + 0.25 * dt
+        self._tgt_suspect.pop(tgt, None)   # it answered: complaint over
+
+    def _suspect_target(self, tgt: str) -> None:
+        if self.osdmap is not None:
+            self._tgt_suspect[tgt] = self.osdmap.epoch
+
+    def _target_suspected(self, tgt: str) -> bool:
+        epoch = self._tgt_suspect.get(tgt)
+        if epoch is None:
+            return False
+        if self.osdmap is None or self.osdmap.epoch != epoch:
+            # a newer map re-earns trust (the primary may have moved
+            # or revived); one slow round trip re-proves it either way
+            self._tgt_suspect.pop(tgt, None)
+            return False
+        return True
+
+    def _submit_degraded(self, op: "_WireOp",
+                         tgt: str, hints: set[str]) -> _PendingCall:
+        """One read re-issued as a `read_degraded` frame: names plus
+        the osd ids being routed around (the server skips them in its
+        meta gather and decode instead of re-paying their timeouts)."""
+        e = Encoder()
+        e.u32(op.ps)
+        e.list(op.names, Encoder.string)
+        e.list(sorted(int(t[4:]) for t in hints
+                      if t.startswith("osd.")),
+               lambda en, v: en.i32(v))
+        body = e.bytes()
+        return self.rpc.submit(
+            tgt, lambda rid: MOSDOp(rid, True, "read_degraded", body),
+            nbytes=len(body))
+
+    def _settle_degraded(self, op: "_WireOp", ok: bool, blob: bytes,
+                         err: str, tgt: str, need_auth: set) -> None:
+        """Fold a read_degraded reply: the server answers in readv
+        encoding (list of blobs), so a single-name `read` op unwraps
+        its one blob; `readv` ops pass through unchanged."""
+        self._settle(op, ok, blob, err, tgt, need_auth)
+        if op.done:
+            if op.kind == "read":
+                op.blob = Decoder(op.blob).list(Decoder.blob)[0]
+            self.perf.inc("degraded_served")
+
+    def _fold_frame_reply(self, tgt: str, group: list["_WireOp"], rep,
+                          need_auth: set, skip=()) -> None:
+        """Fold one primary-frame reply into its ops' retry state
+        (the decision table of the sequential loop), skipping ops a
+        hedge already settled."""
+        if rep.ok and len(group) > 1:
+            d = Decoder(rep.blob)
+            subs = d.list(lambda dd: (dd.boolean(), dd.blob(),
+                                      dd.string()))
+            for op, (ok, blob, err) in zip(group, subs):
+                if op not in skip:
+                    self._settle(op, ok, blob, err, tgt, need_auth)
+        elif rep.ok:
+            if group[0] not in skip:
+                self._settle(group[0], True, rep.blob, "", tgt,
+                             need_auth)
+        else:
+            for op in group:
+                if op not in skip:
+                    self._settle(op, False, b"", rep.err, tgt,
+                                 need_auth)
+
+    def _await_hedged(self, tgt: str, group: list["_WireOp"],
+                      pend: _PendingCall, t0: float, deadline: float,
+                      hedge_at: float, need_auth: set) -> None:
+        """First-complete-wins wait for one read frame: if the primary
+        reply is not in by `hedge_at`, duplicate every still-open op
+        to its next-best shard as a degraded read; whichever answer
+        lands first settles each op, losers are cancelled (window slot
+        freed, late reply dropped), and every handle retires exactly
+        once."""
+        hedges: list[tuple["_WireOp", str, _PendingCall]] = []
+        if not pend.ready(max(0.0, hedge_at - time.monotonic())):
+            for op in group:
+                alt = self._read_fallback(op.ps, op.avoid | {tgt})
+                if alt is None:
+                    continue
+                self.perf.inc("hedge_issued")
+                hedges.append((op, alt, self._submit_degraded(
+                    op, alt, op.avoid | {tgt})))
+        ev = threading.Event()
+        pend.add_waiter(ev)
+        for _op, _alt, hp in hedges:
+            hp.add_waiter(ev)
+        primary_open = True
+        won_by_hedge: set[int] = set()   # id(op) settled by a hedge
+        while True:
+            progressed = False
+            if primary_open and pend.ready(0.0):
+                primary_open = False
+                progressed = True
+                try:
+                    rep = pend.take()
+                except (ConnectionError, KeyError, OSError) as err:
+                    self._suspect_target(tgt)
+                    for op in group:
+                        if id(op) in won_by_hedge or op.done:
+                            continue
+                        op.last = str(err)
+                        op.avoid.add(tgt)
+                        op.try_degraded = True
+                else:
+                    self._note_latency(tgt, time.monotonic() - t0)
+                    self._fold_frame_reply(
+                        tgt, group, rep, need_auth,
+                        skip={op for op in group
+                              if id(op) in won_by_hedge})
+            still = []
+            for op, alt, hp in hedges:
+                if op.done or op.fatal is not None:
+                    # the primary settled it first: cancel the loser
+                    hp.cancel()
+                    self.perf.inc("hedge_losses")
+                    progressed = True
+                    continue
+                if hp.ready(0.0):
+                    progressed = True
+                    try:
+                        hrep = hp.take()
+                    except (ConnectionError, KeyError, OSError) as err:
+                        op.last = str(err)
+                        op.avoid.add(alt)
+                    else:
+                        self._settle_degraded(op, hrep.ok, hrep.blob,
+                                              hrep.err, alt, need_auth)
+                        if op.done:
+                            won_by_hedge.add(id(op))
+                            self.perf.inc("hedge_wins")
+                    continue
+                still.append((op, alt, hp))
+            hedges = still
+            open_ops = any(not op.done and op.fatal is None
+                           for op in group)
+            if primary_open and not open_ops:
+                # every op settled by hedges before the primary said a
+                # word: cancel it AND remember the complaint — later
+                # reads go straight degraded instead of re-paying the
+                # hedge delay every op while this map epoch lasts
+                pend.cancel()
+                self.perf.inc("hedge_cancelled")
+                self._suspect_target(tgt)
+                primary_open = False
+                progressed = True
+            if not primary_open and not hedges:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if primary_open:
+                    pend.cancel()
+                    self.perf.inc("hedge_cancelled")
+                    self._suspect_target(tgt)
+                    for op in group:
+                        if op.done or op.fatal is not None \
+                                or id(op) in won_by_hedge:
+                            continue
+                        op.last = f"rpc to {tgt} timed out"
+                        op.avoid.add(tgt)
+                        op.try_degraded = True
+                for op, alt, hp in hedges:
+                    hp.cancel()
+                    self.perf.inc("hedge_cancelled")
+                    if not op.done and op.fatal is None:
+                        op.last = f"hedge to {alt} timed out"
+                return
+            if not progressed:
+                ev.wait(min(remaining, 0.05))
+                ev.clear()
 
     def _run_ops(self, ops: list["_WireOp"], timeout=None,
                  retries=30, retry_sleep=0.3) -> None:
@@ -4225,7 +4735,21 @@ class Client:
         a client batch really has window-many ops on the wire (the
         Objecter's in-flight pipeline, ref: src/osdc/Objecter.cc
         op_submit + the objecter_inflight_ops window). Retry/error
-        semantics per op are identical to the old one-op loop."""
+        semantics per op are identical to the old one-op loop.
+
+        Reads additionally get graceful degradation (the degraded-read
+        fast path, ROADMAP item 3):
+        * DEGRADED DISPATCH — a read whose primary is down in the map,
+          parked in peering, or has already transport-failed goes
+          straight to the next-best acting shard as a `read_degraded`
+          frame instead of sleeping out detection + peering;
+        * HEDGING — a read frame with no reply after the hedge delay
+          (live via client_hedge_delay_ms; auto mode derives it from
+          this client's OpTracker history) is duplicated to the
+          next-best shard; the first complete answer wins and the
+          loser is cancelled (slot freed, late reply dropped).
+        Both paths ride the same windowed rpc, so in-flight accounting
+        stays exactly-once per handle; mutations never hedge."""
         if timeout is None:
             timeout = self.c.op_timeout + 8.0   # server-side retry room
         for _ in range(retries):
@@ -4233,13 +4757,26 @@ class Client:
                            if not op.done and op.fatal is None]
             if not outstanding:
                 break
+            hedge_s = self._hedge_delay_s()
             by_tgt: dict[str, list[_WireOp]] = {}
+            deg_ops: list[tuple[_WireOp, str]] = []
             for op in outstanding:
+                tgt = None
                 try:
-                    by_tgt.setdefault(self._primary(op.ps),
-                                      []).append(op)
+                    tgt = self._primary(op.ps)
                 except ConnectionError as e:
-                    op.last = str(e)   # no primary yet: wait for map
+                    op.last = str(e)   # no primary yet
+                if op.kind in self._HEDGE_KINDS and op.names is not None \
+                        and (tgt is None or op.try_degraded
+                             or tgt in op.avoid
+                             or self._target_suspected(tgt)):
+                    alt = self._read_fallback(op.ps, op.avoid)
+                    if alt is not None:
+                        deg_ops.append((op, alt))
+                        continue
+                if tgt is None:
+                    continue           # wait for a serviceable map
+                by_tgt.setdefault(tgt, []).append(op)
             handles = []
             for tgt, group in by_tgt.items():
                 if len(group) == 1:
@@ -4263,28 +4800,57 @@ class Client:
                     pend = self.rpc.submit(
                         tgt, lambda rid, b=body:
                         MOSDOp(rid, True, "batch", b), nbytes=nbytes)
-                handles.append((tgt, group, pend))
+                handles.append((tgt, group, pend, time.monotonic()))
+            deg_handles = []
+            for op, alt in deg_ops:
+                self.perf.inc("degraded_dispatch")
+                # the hint set carries every complained-about target
+                # too, so the serving shard's meta gather skips the
+                # dead primary instead of re-paying its timeout
+                deg_handles.append((alt, op, self._submit_degraded(
+                    op, alt, op.avoid | set(self._tgt_suspect))))
             need_auth: set[str] = set()
-            for tgt, group, pend in handles:
+            for tgt, group, pend, t0 in handles:
+                hedgeable = (
+                    hedge_s is not None
+                    and all(o.kind in self._HEDGE_KINDS
+                            and o.names is not None for o in group))
+                track = all(o.kind in self._HEDGE_KINDS
+                            for o in group)
+                frame_op = self.op_tracker.create_op(
+                    f"client_read -> {tgt} x{len(group)}") \
+                    if track else None
+                if hedgeable:
+                    self._await_hedged(tgt, group, pend, t0,
+                                       t0 + timeout, t0 + hedge_s,
+                                       need_auth)
+                    if frame_op is not None:
+                        frame_op.finish()
+                    continue
                 try:
                     rep = pend.wait(timeout)
                 except (ConnectionError, KeyError, OSError) as err:
+                    self._suspect_target(tgt)
                     for op in group:
                         op.last = str(err)
+                        op.avoid.add(tgt)
+                        if op.kind in self._HEDGE_KINDS:
+                            op.try_degraded = True
                     continue
-                if rep.ok and len(group) > 1:
-                    d = Decoder(rep.blob)
-                    subs = d.list(lambda dd: (dd.boolean(), dd.blob(),
-                                              dd.string()))
-                    for op, (ok, blob, err) in zip(group, subs):
-                        self._settle(op, ok, blob, err, tgt, need_auth)
-                elif rep.ok:
-                    self._settle(group[0], True, rep.blob, "", tgt,
-                                 need_auth)
-                else:
-                    for op in group:
-                        self._settle(op, False, b"", rep.err, tgt,
-                                     need_auth)
+                finally:
+                    if frame_op is not None:
+                        frame_op.finish()
+                self._note_latency(tgt, time.monotonic() - t0)
+                self._fold_frame_reply(tgt, group, rep, need_auth)
+            for alt, op, pend in deg_handles:
+                try:
+                    rep = pend.wait(timeout)
+                except (ConnectionError, KeyError, OSError) as err:
+                    op.last = str(err)
+                    op.avoid.add(alt)
+                    continue
+                self._settle_degraded(op, rep.ok, rep.blob, rep.err,
+                                      alt, need_auth)
             for tgt in need_auth:
                 try:
                     self._authorize(tgt)
@@ -4333,7 +4899,7 @@ class Client:
     def read(self, name: str) -> bytes:
         ps = self.osdmap.object_to_pg(1, name)[1]
         return self._op("read", ps,
-                        lambda e: e.string(name))
+                        lambda e: e.string(name), names=[name])
 
     def read_many(self, names) -> dict[str, bytes]:
         """Batched reads: ONE multi-name op per PG (the daemon decodes
@@ -4346,7 +4912,8 @@ class Client:
             ps = self.osdmap.object_to_pg(1, name)[1]
             by_pg.setdefault(ps, []).append(name)
         ops = {ps: _WireOp("readv", ps,
-                           lambda e, g=group: e.list(g, Encoder.string))
+                           lambda e, g=group: e.list(g, Encoder.string),
+                           names=group)
                for ps, group in by_pg.items()}
         self._run_ops(list(ops.values()))
         out: dict[str, bytes] = {}
@@ -4645,9 +5212,11 @@ class StandaloneCluster:
                     msgr_a.add_peer(name_b, msgr_b.addr)
 
     def client(self, entity: str = "client.admin",
-               secret: bytes | None = None) -> Client:
+               secret: bytes | None = None,
+               hedge_delay_ms: float | None = None) -> Client:
         cl = Client(self, f"client.{len(self.clients)}",
-                    entity=entity, secret=secret)
+                    entity=entity, secret=secret,
+                    hedge_delay_ms=hedge_delay_ms)
         self.clients.append(cl)
         self._wire_peers()
         # subscribe: any mon will answer with the current map

@@ -86,6 +86,22 @@ OPTIONS: list[Option] = [
     Option("osd_mclock_scheduler_background_recovery_lim", float, 100.0,
            "custom profile: background_recovery limit (ops/s; 0 = "
            "unlimited)", min=0.0),
+    Option("osd_mclock_scheduler_tenant_default", str, "",
+           "per-tenant QoS: default (res,wgt,lim) profile every client "
+           "entity's tenant class gets, as 'res,wgt,lim' in ops/s "
+           "(empty = each tenant inherits the aggregate client-class "
+           "profile — equal-share QoS per entity)"),
+    Option("osd_mclock_scheduler_tenant_profiles", str, "",
+           "per-tenant QoS overrides, "
+           "'entityA=res,wgt,lim;entityB=res,wgt,lim' keyed by cephx "
+           "entity (messenger peer name without cephx); entities not "
+           "listed fall back to osd_mclock_scheduler_tenant_default"),
+    Option("client_hedge_delay_ms", float, 0.0,
+           "hedged read delay: after this many ms without a reply the "
+           "client duplicates a read to the next-best acting shard as "
+           "a degraded read and takes the first complete answer "
+           "(0 = auto from the client's OpTracker latency history, "
+           "< 0 = hedging off)"),
     Option("osd_heartbeat_interval", float, 6.0,
            "seconds between peer pings", min=0.1),
     Option("osd_heartbeat_grace", float, 20.0,

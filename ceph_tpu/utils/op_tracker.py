@@ -148,6 +148,17 @@ class OpTracker:
             ops = [op.dump() for op in src]
         return {"num_ops": len(ops), "ops": ops}
 
+    def recent_durations(self, limit: int | None = None) -> list[float]:
+        """Completion times of the most recent retired ops (newest
+        last). The cheap slice hedged-read delay tuning reads: the
+        client derives its auto hedge delay from a percentile of this
+        history instead of a fixed guess (see Client._hedge_delay_s)."""
+        with self._lock:
+            src = list(self._history)
+        if limit is not None:
+            src = src[-limit:]
+        return [op.duration for op in src]
+
     def slow_ops(self) -> list[dict]:
         """In-flight ops past the complaint threshold (the
         'slow request' warning source)."""

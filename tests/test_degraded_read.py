@@ -1,0 +1,179 @@
+"""Degraded-read fast path + hedged shard requests + per-tenant QoS
+(wire tier). A read against a down, slow, or still-peering primary must
+be served from any k surviving shards NOW — bit-exact vs healthy reads
+— instead of waiting out detection + peering + recovery (ROADMAP item
+3; the degraded-read tail of the online-EC characterization study,
+arxiv 1709.05365). Hedged duplicates must be exactly-once through the
+op window: losers cancelled, slots freed, no duplicate side effects.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.standalone import StandaloneCluster
+
+
+def corpus(seed, n=8, size=500):
+    rng = np.random.default_rng(seed)
+    return {f"dgr-{seed}-{i}":
+            rng.integers(0, 256, size, np.uint8).tobytes()
+            for i in range(n)}
+
+
+def _window_clean(cl):
+    """Exactly-once accounting: nothing left in flight, no leaked
+    correlation-table entries (a cancelled loser must free its slot)."""
+    assert cl.rpc.perf.get("inflight_ops") == 0
+    assert not cl.rpc._pending
+
+
+class TestDegradedReads:
+    def test_served_bit_exact_with_primary_down_and_no_quorum(self):
+        """The strongest form of 'no waiting on peering': with the mon
+        quorum dead there will NEVER be a down-mark, a new map, or a
+        recovered primary — so these reads can only succeed through
+        the degraded fast path."""
+        c = StandaloneCluster(n_osds=5, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client(hedge_delay_ms=40)
+            objs = corpus(1)
+            cl.write(objs)
+            healthy = {n: cl.read(n) for n in objs}
+            assert healthy == objs
+
+            # ---- per-tenant mClock while quorum still exists ----
+            # without cephx the tenant identity is the messenger peer
+            # name; this first client is "client.0"
+            cl.config_set(
+                "osd_mclock_scheduler_tenant_profiles",
+                "client.0=5,9,0;client.1=1,1,50")
+            cl2 = c.client()          # second entity = "client.1"
+            for n in list(objs)[:3]:
+                assert cl2.read(n) == objs[n]
+            dumps = [cl.daemon(o, "dump_mclock")
+                     for o in c.osd_ids()]
+            tenants = {k: v for mc in dumps for k, v in mc.items()
+                       if k.startswith("tenant:")}
+            assert "tenant:client.0" in tenants
+            assert "tenant:client.1" in tenants
+            profiled = [mc["tenant:client.0"]["profile"]
+                        for mc in dumps
+                        if "tenant:client.0" in mc]
+            assert {"reservation": 5.0, "weight": 9.0,
+                    "limit": 0.0} in profiled
+            served = sum(v["served"] for v in tenants.values())
+            assert served > 0
+
+            # ---- kill quorum, then the primary ----
+            c.kill_mon(1)
+            c.kill_mon(2)
+            ps0 = cl.osdmap.object_to_pg(1, next(iter(objs)))[1]
+            victim = cl.osdmap.pg_to_up_acting_osds(1, ps0)[2][0]
+            c.kill_osd(victim)
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+            pd = cl.perf.dump()
+            assert pd["hedge_wins"] + pd["degraded_served"] > 0
+            # map can never move: every later read of the dead
+            # primary's PGs keeps riding the fast path
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+            _window_clean(cl)
+
+            # an object that never existed stays a KeyError, even
+            # degraded (absence per the freshest quorum meta is real)
+            with pytest.raises(KeyError):
+                cl.read(f"dgr-never-{victim}")
+
+            # ---- heal: quorum back -> detection -> clean -> normal
+            c.revive_mon(1)
+            c.wait_for_down(victim, timeout=30)
+            c.wait_for_clean(timeout=60)
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+        finally:
+            c.shutdown()
+
+    def test_hedge_beats_slow_primary_and_cancels_loser(self):
+        """A primary that is merely SLOW (not dead): the hedge fires
+        after the configured delay, the shard's degraded answer wins,
+        the late primary reply is dropped on a cancelled handle, and
+        accounting stays exactly-once."""
+        c = StandaloneCluster(n_osds=4, pg_num=2, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client(hedge_delay_ms=30)
+            objs = corpus(2, n=6)
+            cl.write(objs)
+            name = next(iter(objs))
+            ps = cl.osdmap.object_to_pg(1, name)[1]
+            slow = cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+            in_pg = [n for n in objs
+                     if cl.osdmap.object_to_pg(1, n)[1] == ps]
+            # delay EVERY transmit of the slow primary by ~10x the
+            # hedge delay; everyone else stays fast
+            c.inject_delays(1, 300.0, osds=[slow], seed=7)
+            try:
+                for n in in_pg * 2:
+                    assert cl.read(n) == objs[n], n
+            finally:
+                c.inject_delays(0, 0.0)
+            pd = cl.perf.dump()
+            assert pd["hedge_issued"] > 0
+            # every issued hedge resolved: won, lost, or cancelled
+            assert pd["hedge_wins"] + pd["hedge_losses"] \
+                <= pd["hedge_issued"]
+            assert pd["hedge_wins"] + pd["degraded_served"] > 0
+            _window_clean(cl)
+            # writes never hedge (exactly-once side effects): rewrite
+            # through the slow window, then verify
+            c.inject_delays(1, 120.0, osds=[slow], seed=8)
+            try:
+                repl = {n: bytes(reversed(v)) for n, v in objs.items()}
+                cl.write(repl)
+            finally:
+                c.inject_delays(0, 0.0)
+            before = cl.perf.dump()
+            for n in repl:
+                assert cl.read(n) == repl[n], n
+            _window_clean(cl)
+        finally:
+            c.shutdown()
+
+    def test_degraded_reads_do_not_wait_for_recovery(self):
+        """Kill a primary with recovery throttled hard: reads complete
+        while the cluster is provably NOT clean (wait_for_clean still
+        times out), i.e. the fast path never queued behind the
+        rebuild."""
+        c = StandaloneCluster(n_osds=5, pg_num=4, op_timeout=3.0)
+        try:
+            c.wait_for_clean(timeout=20)
+            cl = c.client(hedge_delay_ms=40)
+            objs = corpus(3, n=10, size=900)
+            cl.write(objs)
+            # throttle recovery to a crawl so the rebuild window stays
+            # open long after detection
+            cl.config_set("osd_recovery_sleep", "15")
+            cl.config_set("osd_recovery_batch", "1")
+            ps0 = cl.osdmap.object_to_pg(1, next(iter(objs)))[1]
+            victim = cl.osdmap.pg_to_up_acting_osds(1, ps0)[2][0]
+            c.kill_osd(victim)
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+            c.wait_for_down(victim, timeout=30)
+            # recovery is in flight and throttled; reads still served
+            with pytest.raises(TimeoutError):
+                c.wait_for_clean(timeout=1.0)
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+            _window_clean(cl)
+            cl.config_set("osd_recovery_sleep", "0")
+            cl.config_set("osd_recovery_batch", "128")
+            c.wait_for_clean(timeout=90)
+            for n, want in objs.items():
+                assert cl.read(n) == want, n
+        finally:
+            c.shutdown()

@@ -16,7 +16,10 @@ import pytest
 from ceph_tpu.msgr.messenger import (_COMP_FLAG, _COMPRESS_MIN, _GCM_TAG,
                                      _NONCE, COMP_NONE, COMP_ZLIB,
                                      _Conn, _crc, _SecureBox)
-from tests.test_msgr import Ping, pair, wait_for
+# bare import, matching how pytest imports test_msgr.py itself (no tests/
+# __init__.py): a "tests.test_msgr" spelling would materialize a SECOND
+# module object, re-run @register_message, and die on frame type 0x70
+from test_msgr import Ping, pair, wait_for
 
 SECRET = b"0123456789abcdef0123456789abcdef"
 KEY = b"K" * 32

@@ -11,7 +11,10 @@ import pytest
 
 from ceph_tpu.msgr import messenger as M
 from ceph_tpu.utils.encoding import Decoder, Encoder
-from tests.test_msgr import Ping, pair, wait_for
+# bare import, matching how pytest imports test_msgr.py itself (no tests/
+# __init__.py): a "tests.test_msgr" spelling would materialize a SECOND
+# module object, re-run @register_message, and die on frame type 0x70
+from test_msgr import Ping, pair, wait_for
 
 
 class _CountingFlatten:

@@ -63,23 +63,35 @@ def test_stale_client_retargets_after_remap():
     assert c.verify_all(objs) == len(objs)
 
 
-def test_reads_resend_when_primary_dies_unnoticed():
+def test_degraded_read_fast_path_when_primary_dies_unnoticed():
+    """A primary death the map hasn't noticed used to cost the whole
+    detection window (the read failed until a new map promoted a
+    primary). The degraded fast path now serves it immediately from
+    the surviving shards — bit-exact — and reverts to the normal
+    primary path once detection does its thing (ROADMAP item 3)."""
     c = make_cluster()
     cl = Objecter(c)
     objs = corpus(n=10)
     cl.write(objs)
-    # kill a primary; within grace the map epoch hasn't moved, so the
-    # client refreshes, gets the same primary, retries, and only
-    # succeeds once failure detection promotes a new map
     name = next(iter(objs))
     ps = c.locate(name)
     primary = c.osdmap.pg_to_up_acting_osds(1, ps)[3]
     c.kill_osd(primary)
+    got = cl.read(name)                 # map unchanged: fast path
+    assert np.array_equal(got, objs[name])
+    assert cl.perf.get("op_degraded") > 0
+    # mutations do NOT take the fast path: they need the primary
     with pytest.raises(ObjecterError):
-        cl.read(name)                   # nobody answers yet
+        cl.write({name: objs[name]})
     c.tick(30.0)                        # marked down -> new primary
+    before = cl.perf.get("op_degraded")
     got = cl.read(name)
     assert np.array_equal(got, objs[name])
+    assert cl.perf.get("op_degraded") == before  # normal path again
+    # a never-written name stays KeyError even through the fast path
+    c.kill_osd(c.osdmap.pg_to_up_acting_osds(1, ps)[3])
+    with pytest.raises(KeyError):
+        cl.read("no-such-object-xyz")
 
 
 def test_wrong_target_rejected_at_transport():

@@ -8,7 +8,10 @@ import time
 
 from ceph_tpu.msgr.messenger import Messenger
 from ceph_tpu.osd.standalone import MOSDOp, MOSDOpReply, _Rpc
-from tests.test_msgr import wait_for
+# bare import, matching how pytest imports test_msgr.py itself (no tests/
+# __init__.py): a "tests.test_msgr" spelling would materialize a SECOND
+# module object, re-run @register_message, and die on frame type 0x70
+from test_msgr import wait_for
 
 
 class FakeOsd:

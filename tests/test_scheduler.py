@@ -270,3 +270,64 @@ class TestRound10Additions:
         assert d["client"]["profile"]["weight"] == 10.0
         served_cost = sum(c["served_cost"] for c in d.values())
         assert served_cost > 0
+
+
+class TestPerTenantClasses:
+    """Round-11: dynamic per-tenant (ρ, w, λ) classes keyed by client
+    entity (ensure_class + the osd_mclock_scheduler_tenant_* config
+    grammar) — one heavy tenant must not starve the rest."""
+
+    def test_parse_profile_and_table(self):
+        from ceph_tpu.osd.scheduler import (parse_profile,
+                                            parse_profile_table)
+        p = parse_profile(" 50, 10 , 0 ")
+        assert (p.reservation, p.weight, p.limit) == (50.0, 10.0, 0.0)
+        table = parse_profile_table(
+            "client.a=1,2,3;client.b=0,5,0;")
+        assert set(table) == {"client.a", "client.b"}
+        assert table["client.a"].limit == 3.0
+        with pytest.raises(ValueError):
+            parse_profile("1,2")          # not three fields
+        with pytest.raises(ValueError):
+            parse_profile_table("justanentity")   # no '='
+        with pytest.raises(ValueError):
+            parse_profile("5,1,3")        # reservation > limit
+
+    def test_ensure_class_creates_then_retunes(self):
+        s = MClockScheduler()
+        s.ensure_class("tenant:a", ClientProfile(weight=2.0))
+        s.enqueue("tenant:a", "op")
+        assert s.dequeue(0.0) == ("tenant:a", "op")
+        # retune in place: profile changes, queue/order survive
+        s.enqueue("tenant:a", "op2")
+        s.ensure_class("tenant:a", ClientProfile(weight=9.0))
+        assert s.dump()["tenant:a"]["profile"]["weight"] == 9.0
+        assert s.dequeue(1.0) == ("tenant:a", "op2")
+        # idempotent for an unchanged profile
+        s.ensure_class("tenant:a", ClientProfile(weight=9.0))
+        assert "tenant:a" in s.class_names()
+
+    def test_tenant_weight_split_under_saturation(self):
+        # two tenants sharing spare capacity 4:1 by weight — the
+        # "heavy tenant cannot starve the rest" property in its
+        # simplest measurable form
+        s = MClockScheduler({
+            "tenant:heavy": ClientProfile(weight=4.0),
+            "tenant:light": ClientProfile(weight=1.0),
+        })
+        served = run_sim(s, {"tenant:heavy": 10, "tenant:light": 10},
+                         seconds=1.0, capacity_per_s=500.0)
+        ratio = served["tenant:heavy"] / max(1, served["tenant:light"])
+        assert 3.2 < ratio < 4.8, served
+
+    def test_tenant_limit_caps_hedge_storms(self):
+        # a tenant flooding duplicates under a λ cap cannot exceed its
+        # ceiling; an unlimited tenant soaks the rest
+        s = MClockScheduler({
+            "tenant:storm": ClientProfile(weight=10.0, limit=50.0),
+            "tenant:calm": ClientProfile(weight=1.0),
+        })
+        served = run_sim(s, {"tenant:storm": 50, "tenant:calm": 50},
+                         seconds=1.0, capacity_per_s=1000.0)
+        assert served["tenant:storm"] <= 60, served
+        assert served["tenant:calm"] >= 900, served

@@ -9,7 +9,13 @@ passing read IS proof the data plane crossed sockets."""
 import numpy as np
 import pytest
 
+from ceph_tpu.chaos import load_factor
 from ceph_tpu.osd.standalone import StandaloneCluster
+
+# leadership/convergence deadlines tuned on an idle box flake when the
+# full suite oversubscribes the host (CHANGES r10: the leader-failover
+# cases pass alone, fail only under load) — scale them by observed load
+_LF = load_factor()
 
 
 def corpus(seed, n=24, lo=100, hi=800):
@@ -405,7 +411,7 @@ class TestCentralConfig:
             cl = c.client()
             cl.config_set("debug_level", "9")
             c.kill_mon(0)
-            c._wait(lambda: c.mons[1].is_leader(), 10,
+            c._wait(lambda: c.mons[1].is_leader(), 10 * _LF,
                     "mon.1 leadership")
             # committed value survives the leader's death...
             assert cl.config_get("debug_level") == "9"
@@ -415,7 +421,7 @@ class TestCentralConfig:
                 lambda: all(d.config["debug_level"] == 11
                             for d in c.osds.values()
                             if not d._stop.is_set()),
-                15, "post-failover config resolved on daemons")
+                15 * _LF, "post-failover config resolved on daemons")
         finally:
             c.shutdown()
 
@@ -436,7 +442,7 @@ class TestMonitorFailover:
             assert c.mons[0].is_leader()
             c.kill_mon(0)
             # mon.1 must take over within the grace window
-            c._wait(lambda: c.mons[1].is_leader(), 10,
+            c._wait(lambda: c.mons[1].is_leader(), 10 * _LF,
                     "mon.1 leadership")
             # an OSD death is still detected and committed (mon.1
             # proposes, mon.2 accepts: 2-of-3 quorum)

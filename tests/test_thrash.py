@@ -54,6 +54,24 @@ def test_thrash_matrix(seed, store, tmp_path):
     run_cell(seed, store, tmp_path)
 
 
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store", [(19, "mem"), (31, "tin")])
+def test_thrash_degraded_reads_never_block(seed, store, tmp_path):
+    """Round-11 invariant cell: with each round's faults still LIVE
+    (dead primaries un-revived, mon churn un-healed, injection on),
+    every acked object must read back bit-exact through the
+    degraded-read fast path — no read ever blocks on
+    wait_for_clean."""
+    th = Thrasher(seed, store=store, rounds=2, ops=6,
+                  read_during_faults=True,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["degraded_read_checks"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
 def test_same_seed_same_schedule(tmp_path):
     """Reproducibility contract: two Thrashers with one seed draw the
     IDENTICAL fault schedule (victims, knob values, data sizes) —

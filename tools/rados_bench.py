@@ -37,7 +37,16 @@ def percentiles(lat: list[float]) -> dict:
     return {"p50_ms": round(pick(0.50) * 1e3, 3),
             "p95_ms": round(pick(0.95) * 1e3, 3),
             "p99_ms": round(pick(0.99) * 1e3, 3),
+            # tail-latency acceptance metric of the degraded-read work
+            # (Round-11): meaningless below ~1000 samples, where it
+            # degenerates to max — reported anyway, judged with count
+            "p999_ms": round(pick(0.999) * 1e3, 3),
             "max_ms": round(float(a[-1]) * 1e3, 3)}
+
+
+def hedge_counters(cl) -> dict:
+    """One client's hedge/degraded accounting (the 'client' logger)."""
+    return cl.perf.dump()
 
 
 def main(argv=None) -> None:
@@ -74,22 +83,39 @@ def main(argv=None) -> None:
                          "stack, ref: rados bench against a vstart "
                          "cluster)")
     ap.add_argument("--recovery-kill", action="store_true",
-                    help="standalone write workload: kill one OSD a "
-                         "third into the window so recovery runs "
-                         "CONCURRENTLY with client ops — reports "
-                         "pre/post-kill latency splits and the mClock "
-                         "class occupancy (the QoS-bounded-p95 "
-                         "scenario)")
+                    help="standalone: kill one OSD a third into the "
+                         "window so recovery runs CONCURRENTLY with "
+                         "client ops — reports pre/post-kill latency "
+                         "splits and the mClock class occupancy. "
+                         "write kills a pure shard holder (QoS-"
+                         "bounded-p95 scenario); seq kills a PRIMARY "
+                         "(the degraded-read fast-path scenario: "
+                         "reads must keep flowing through hedged "
+                         "shard requests, not wait for recovery)")
+    ap.add_argument("--hedge-delay-ms", type=float, default=None,
+                    help="standalone: client hedged-read delay in ms, "
+                         "committed live via client_hedge_delay_ms "
+                         "(0 = auto from latency history, < 0 = off; "
+                         "default: leave the cluster default)")
+    ap.add_argument("--tenants", type=int, default=1,
+                    help="standalone: run ops round-robin across N "
+                         "client entities (per-tenant mClock classes "
+                         "on every OSD); the JSON gains per-tenant "
+                         "latency percentiles + hedge win/loss counts")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.seconds <= 0 or args.object_size <= 0 or args.batch <= 0:
         raise SystemExit("rados_bench: --seconds/--object-size/--batch "
                          "must be positive")
-    if args.recovery_kill and (args.transport != "standalone"
-                               or args.workload != "write"):
+    if args.tenants < 1:
+        raise SystemExit("rados_bench: --tenants must be >= 1")
+    if args.recovery_kill and args.transport != "standalone":
         raise SystemExit("rados_bench: --recovery-kill needs "
-                         "--transport standalone and the write "
-                         "workload")
+                         "--transport standalone")
+    if (args.tenants > 1 or args.hedge_delay_ms is not None) \
+            and args.transport != "standalone":
+        raise SystemExit("rados_bench: --tenants/--hedge-delay-ms "
+                         "need --transport standalone")
 
     # persistent jit cache: a cold bench process stops re-paying every
     # XLA compile (the r09 cold-recovery tax); native codecs build once
@@ -125,28 +151,53 @@ def main(argv=None) -> None:
         c.wait_for_clean(timeout=30)
         shutdown = c.shutdown
         wire_client = c.client()
+        if args.hedge_delay_ms is not None:
+            # committed centrally: every current AND future client of
+            # this cluster resolves it live (the config-observer path)
+            wire_client.config_set("client_hedge_delay_ms",
+                                   args.hedge_delay_ms)
+        # per-tenant clients: each is its own cephx entity (its own
+        # messenger peer without cephx), so every OSD's mClock gives
+        # it its own tenant class — the per-tenant QoS under test
+        tenant_clients = [wire_client]
+        tenant_entities = ["client.admin" if not args.insecure
+                           else wire_client.msgr.name]
+        for i in range(args.tenants - 1):
+            if c.key_server is not None:
+                ent = f"client.tenant{i}"
+                sec = c.create_entity(ent, caps={"mon": "allow r",
+                                                 "osd": "allow rwx"})
+                tenant_clients.append(c.client(entity=ent, secret=sec))
+                tenant_entities.append(ent)
+            else:
+                tcl = c.client()
+                tenant_clients.append(tcl)
+                tenant_entities.append(tcl.msgr.name)
 
         class _WireOb:   # the Objecter-shaped slice the loops use
             @staticmethod
-            def write(objs):
-                wire_client.write({k: np.asarray(v, np.uint8).tobytes()
-                                   for k, v in objs.items()})
+            def write(objs, tenant=0):
+                tenant_clients[tenant % len(tenant_clients)].write(
+                    {k: np.asarray(v, np.uint8).tobytes()
+                     for k, v in objs.items()})
 
             @staticmethod
-            def read(names):
-                return wire_client.read_many(names)
+            def read(names, tenant=0):
+                return tenant_clients[
+                    tenant % len(tenant_clients)].read_many(names)
         ob = _WireOb()
 
         def perf_snapshot():
             """Perf dumps of every live daemon + the bench client —
             before/after deltas ship in the JSON so the bench carries
             its own per-stage attribution (msgr frames, op-window
-            stalls, encode launches, cephx rounds)."""
+            stalls, encode launches, cephx rounds, hedge wins)."""
             snap = {d.name: d.perf_dump_all()
                     for d in c.osds.values() if not d._stop.is_set()}
             snap["client"] = {
                 "rpc": wire_client.rpc.perf.dump(),
-                "msgr": wire_client.msgr.perf.dump()}
+                "msgr": wire_client.msgr.perf.dump(),
+                "hedge": wire_client.perf.dump()}
             return snap
     else:
         from ceph_tpu.client.rados import Rados
@@ -157,7 +208,16 @@ def main(argv=None) -> None:
         except ValueError as e:
             raise SystemExit(f"rados_bench: {e}")
         io = Rados(c).open_ioctx()
-        ob = io._ob
+
+        class _SimOb:    # tenant-arg parity with the wire adapter
+            @staticmethod
+            def write(objs, tenant=0):
+                io._ob.write(objs)
+
+            @staticmethod
+            def read(names, tenant=0):
+                return io._ob.read(names)
+        ob = _SimOb()
 
         def perf_snapshot():
             return {"cluster": c.perf.dump(),
@@ -193,8 +253,32 @@ def main(argv=None) -> None:
                 read_fn(same_pg[:s])
 
     lat: list[float] = []
-    lat_stamp: list[float] = []   # completion time of each write op
+    lat_stamp: list[float] = []   # completion time of each timed op
+    lat_tenant: list[list[float]] = [[] for _ in range(args.tenants)]
     nobj = 0
+    killed_at = None
+    op_errors = 0
+
+    def maybe_kill(t_kill, want_primary: bool):
+        """--recovery-kill victim selection: a pure shard holder for
+        the write workload (QoS-vs-recovery), a PRIMARY for seq (the
+        degraded-read scenario — reads must ride the fast path)."""
+        nonlocal killed_at
+        if not args.recovery_kill or killed_at is not None \
+                or time.perf_counter() < t_kill:
+            return
+        wire_client = tenant_clients[0]
+        primaries = {
+            wire_client.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+            for ps in range(args.pg_num)}
+        live = [o for o in c.osd_ids()
+                if not c.osds[o]._stop.is_set()]
+        pool = [o for o in live
+                if (o in primaries) == want_primary] or live
+        victim = max(pool)
+        c.kill_osd(victim)
+        killed_at = time.perf_counter()
+
     if args.workload == "write":
         # jit compile outside the window: objects scatter over PGs in
         # per-PG sub-batches whose sizes bucket to powers of two —
@@ -206,31 +290,23 @@ def main(argv=None) -> None:
         t_start = time.perf_counter()
         t_end = t_start + args.seconds
         t_kill = t_start + args.seconds / 3.0
-        killed_at = None
-        op_errors = 0
         i = 0
         while time.perf_counter() < t_end:
-            if args.recovery_kill and killed_at is None \
-                    and time.perf_counter() >= t_kill:
-                # kill a NON-PRIMARY (pure shard holder): every PG it
-                # held a shard for starts an mClock-governed recovery
-                # round that now COMPETES with this loop's ops. A
-                # primary victim would measure the client's dead-peer
-                # retry timeout (a different, detection-window story),
-                # not the QoS of recovery-vs-client admission.
-                primaries = {
-                    wire_client.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
-                    for ps in range(args.pg_num)}
-                victim = max(o for o in c.osd_ids()
-                             if o not in primaries
-                             and not c.osds[o]._stop.is_set())
-                c.kill_osd(victim)
-                killed_at = time.perf_counter()
+            # kill a NON-PRIMARY (pure shard holder): every PG it
+            # held a shard for starts an mClock-governed recovery
+            # round that now COMPETES with this loop's ops. A
+            # primary victim would measure the client's dead-peer
+            # retry timeout (a different, detection-window story),
+            # not the QoS of recovery-vs-client admission.
+            maybe_kill(t_kill, want_primary=False)
+            ti = i % args.tenants
             objs = batch(i)
             t0 = time.perf_counter()
             try:
-                ob.write(objs)
-                lat.append(time.perf_counter() - t0)
+                ob.write(objs, tenant=ti)
+                dt = time.perf_counter() - t0
+                lat.append(dt)
+                lat_tenant[ti].append(dt)
                 lat_stamp.append(time.perf_counter())
                 nobj += len(objs)
             except (ConnectionError, OSError, RuntimeError, KeyError):
@@ -239,6 +315,9 @@ def main(argv=None) -> None:
                 # op raced the failure window (old primary dead, map
                 # not committed yet): real clusters retry; count it
                 op_errors += 1
+                if os.environ.get("RADOS_BENCH_DEBUG"):
+                    import traceback
+                    traceback.print_exc()
             i += 1
         # measured elapsed, not the nominal window: an op crossing the
         # deadline still counts its real time (keeps write comparable
@@ -254,16 +333,35 @@ def main(argv=None) -> None:
         warm_buckets(ob.write, ob.read)
         names = sorted(staged)
         perf_before = perf_snapshot()
-        t0_all = time.perf_counter()
+        t_start = t0_all = time.perf_counter()
         t_end = t0_all + args.seconds
+        t_kill = t0_all + args.seconds / 3.0
         k = 0
         while time.perf_counter() < t_end:
+            # seq + --recovery-kill: kill a PRIMARY — the degraded-
+            # read scenario. Reads must keep completing through
+            # hedged shard requests + any-k decode, not wait out
+            # detection/peering/recovery (acceptance: p99 within 2x
+            # of pre-kill, from this JSON's pre/post split).
+            maybe_kill(t_kill, want_primary=True)
+            ti = k % args.tenants
             group = names[(k * args.batch) % len(names):]
             group = group[:args.batch] or names[:args.batch]
             t0 = time.perf_counter()
-            got = ob.read(group)
-            lat.append(time.perf_counter() - t0)
-            nobj += len(got)
+            try:
+                got = ob.read(group, tenant=ti)
+                dt = time.perf_counter() - t0
+                lat.append(dt)
+                lat_tenant[ti].append(dt)
+                lat_stamp.append(time.perf_counter())
+                nobj += len(got)
+            except (ConnectionError, OSError, RuntimeError, KeyError):
+                if killed_at is None:
+                    raise
+                op_errors += 1
+                if os.environ.get("RADOS_BENCH_DEBUG"):
+                    import traceback
+                    traceback.print_exc()
             k += 1
         dt = time.perf_counter() - t0_all
 
@@ -317,10 +415,33 @@ def main(argv=None) -> None:
     }
     if jax_cache_dir is not None:
         out["config"]["jax_compile_cache"] = jax_cache_dir
+    if args.transport == "standalone":
+        # hedge/degraded accounting + per-tenant percentiles: the
+        # degraded-read and per-tenant-QoS acceptance numbers, keyed
+        # so CI can parse them (tier-1 smoke asserts this schema)
+        out["config"]["tenants"] = args.tenants
+        out["config"]["hedge_delay_ms"] = args.hedge_delay_ms
+        agg = {k: 0 for k in ("hedge_issued", "hedge_wins",
+                              "hedge_losses", "hedge_cancelled",
+                              "degraded_dispatch", "degraded_served")}
+        tenants = {}
+        for i, (tcl, ent) in enumerate(zip(tenant_clients,
+                                           tenant_entities)):
+            hc = hedge_counters(tcl)
+            for key in agg:
+                agg[key] += int(hc.get(key, 0))
+            tenants[f"tenant{i}"] = {
+                "entity": ent,
+                "ops": len(lat_tenant[i]),
+                **percentiles(lat_tenant[i]),
+                "hedge": hc}
+        out["hedge"] = agg
+        out["tenants"] = tenants
     if args.recovery_kill:
         # latency split around the kill + the schedulers' class grants:
-        # the QoS claim ("client p95 bounded during recovery") is
-        # checkable from this one JSON line
+        # the QoS claim ("client p95 bounded during recovery", seq:
+        # "degraded p99 within 2x of pre-kill") is checkable from this
+        # one JSON line; tenant mClock classes ride the dumps
         k = killed_at if killed_at is not None else t_end
         pre = [v for t, v in zip(lat_stamp, lat) if t < k]
         post = [v for t, v in zip(lat_stamp, lat) if t >= k]
