@@ -12,6 +12,12 @@ per round (the vectorized CRUSH mapper) instead of the reference's
 per-PG loop; the load histogram and the greedy move-selection derive
 from that single array host-side.
 
+Since r12 this scalar module is the PARITY ORACLE: the production
+path is `mgr/placement.py` (device-batched candidate scoring, one
+raw launch per optimize run, data-movement budgets), which pins its
+legality rules and objective against this implementation in
+tests/test_placement.py.
+
 Failure-domain safety: a move is only legal if the target device does
 not put two shards of the PG into one failure domain, at the SAME
 bucket level the pool's CRUSH rule separates on (chooseleaf type) —

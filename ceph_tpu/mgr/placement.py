@@ -1,0 +1,363 @@
+"""Placement plane — the device-batched balancer/upmap loop (r12).
+
+The scalar balancer (`balancer.calc_pg_upmaps`, kept as the parity
+oracle) walks PGs one at a time in Python: per move it re-derives one
+PG's raw mapping, rebuilds failure-domain sets, and scans targets —
+fine at 128 PGs, hopeless at 1M. This module runs the same greedy
+max-deviation optimization as array programs:
+
+* ONE batched `pgs_to_raw` launch per optimize() call maps every PG
+  of the pool through the vectorized CRUSH mapper (chunked so one
+  compiled program shape serves arbitrarily large pools). The raw
+  mapping is invariant under upmap edits, so rounds after the first
+  re-score against a host-side effective view instead of relaunching.
+* Candidate generation is vectorized: every (pg, src_osd) shard held
+  by an overfull device crossed with the most-underfull target set.
+* Scoring runs ON DEVICE (`_score_kernel`, jitted): legality (target
+  not already a member, failure-domain separation at the pool rule's
+  chooseleaf type) and gain (deviation transfer) for the whole
+  (N candidates x U targets) block in one launch — millions of
+  candidates per step.
+* Selection is a cheap host greedy over the device-ranked survivors,
+  bounded by a DATA-MOVEMENT BUDGET (each accepted move migrates one
+  PG shard; rebalancing at scale is a wire-cost problem first —
+  PAPERS.md, arxiv 1309.0186).
+
+Objective and legality match the scalar oracle: weight-proportional
+expected load over up+in devices, moves only from overfull to
+strictly-better targets (gain = dev[src] - dev[dst] - 1 > 0), domain
+membership derived from the RAW set plus redirect targets (a
+down-but-in member still owns its slot). The bit-exactness guard in
+tests/test_placement.py pins batched results against scalar
+`pg_to_up_acting_osds` after application.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crush.map import CRUSH_ITEM_NONE
+from .balancer import _domain_of, _rule_domain_type
+
+_NONE = np.int32(CRUSH_ITEM_NONE)
+
+
+def osd_domains(crush, type_id: int, n_osds: int) -> np.ndarray:
+    """Per-device failure-domain id at bucket level `type_id` — the
+    dense form of balancer._domain_of for every OSD at once. Devices
+    with no ancestor at that level get a unique negative id (they can
+    never clash with anything)."""
+    dom = np.empty(n_osds, dtype=np.int32)
+    cache: dict = {}
+    for o in range(n_osds):
+        d = _domain_of(crush, o, type_id, cache)
+        # no-ancestor devices get unique ids far below any bucket id
+        # (bucket ids are small negatives) but above the kernel's
+        # masked-slot sentinel
+        dom[o] = d if d is not None else -(10 ** 7) - o
+    return dom
+
+
+def chunked_pgs_to_raw(osdmap, pool_id: int,
+                       chunk: int = 1 << 16) -> np.ndarray:
+    """Full-pool raw mapping through fixed-size device launches: one
+    compiled program shape (`chunk` lanes) serves any pg_num — at 1M
+    PGs a monolithic batch would compile its own program and hold
+    every intermediate live."""
+    pool = osdmap.pools[pool_id]
+    B = pool.pg_num
+    if B <= chunk:
+        return osdmap.pgs_to_raw(pool_id)
+    out = np.empty((B, pool.size), np.int32)
+    for s in range(0, B, chunk):
+        n = min(chunk, B - s)
+        ps = np.arange(s, s + chunk, dtype=np.uint32)  # pad past pg_num
+        ps[n:] = s  # padded lanes recompute a real pg; result sliced off
+        out[s:s + n] = osdmap.pgs_to_raw(pool_id, ps)[:n]
+    return out
+
+
+def apply_upmaps_to_raw(raw: np.ndarray, pool_id: int,
+                        pg_upmap_items: dict) -> np.ndarray:
+    """Effective placement: raw with every pg_upmap_items redirect
+    applied (same semantics as OSDMap._apply_upmap, vectorized over
+    the dense raw array with a sparse host overlay — upmaps are rare
+    relative to pg_num)."""
+    eff = raw.copy()
+    B = raw.shape[0]
+    for (pid, ps), items in pg_upmap_items.items():
+        if pid != pool_id or ps >= B:
+            continue
+        row = eff[ps]
+        for frm, to in items:
+            if (row == to).any():
+                continue  # a duplicate target would break slot sets
+            hits = np.nonzero(row == frm)[0]
+            if hits.size:
+                row[hits[0]] = to
+    return eff
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _score_kernel(members, src, dsts, dev, dom, topk):
+    """Device scoring of the (N, U) candidate block.
+
+    members: (N, 2S) raw-set + effective-set of each candidate's PG
+             (CRUSH_ITEM_NONE padding); src: (N,) the overfull device
+             each candidate would move a shard off; dsts: (U,) target
+             devices; dev: (n_osds,) load deviation; dom: (n_osds,)
+             failure-domain ids at the rule's separation level.
+
+    Returns (best (N, topk), score (N, topk)): per candidate the
+    indices into dsts of the topk highest-gain LEGAL targets (score
+    -inf past the legal count). Several ranked targets per candidate
+    keep the host greedy moving when the globally-best targets
+    saturate mid-round (at 10k OSDs a best-only kernel stalled every
+    round at ~100 accepts). Legality mirrors the scalar oracle:
+    target not already a member of the PG, and its failure domain
+    serves no OTHER shard (the source device's own occurrences are
+    masked out).
+    """
+    none = jnp.int32(CRUSH_ITEM_NONE)
+    valid = (members != none) & (members != src[:, None])      # (N, 2S)
+    midx = jnp.clip(members, 0, dom.shape[0] - 1)
+    # masked-out slots get a sentinel no real domain id can hold
+    # (bucket ids are small negatives; -1 is a REAL bucket, and
+    # osd_domains' no-ancestor ids stay above -(10^7 + n_osds))
+    mdom = jnp.where(valid, dom[midx],
+                     jnp.int32(-(2 ** 31) + 1))                # (N, 2S)
+    ddom = dom[dsts]                                           # (U,)
+    # (N, U): domain clash / already-member / gain
+    clash = (mdom[:, :, None] == ddom[None, None, :]).any(axis=1)
+    member = (members[:, :, None] == dsts[None, None, :]).any(axis=1)
+    gain = dev[src][:, None] - dev[dsts][None, :] - 1.0
+    score = jnp.where(clash | member | (gain <= 0.0),
+                      -jnp.inf, gain)
+    vals, best = jax.lax.top_k(score, topk)
+    return best, vals
+
+
+def _pow2_pad(n: int) -> int:
+    return 1 << max(6, (n - 1).bit_length())
+
+
+@dataclass
+class BalanceResult:
+    """What one batched optimize() run did — the numbers scale_sim
+    commits and the bench schema pins."""
+    moves: list = field(default_factory=list)
+    proposed: dict = field(default_factory=dict)
+    rounds: int = 0
+    candidates_scored: int = 0
+    score_elapsed_s: float = 0.0
+    elapsed_s: float = 0.0
+    max_dev_before: float = 0.0
+    max_dev_after: float = 0.0
+    spread_before: int = 0
+    spread_after: int = 0
+    budget: int | None = None
+    budget_used: int = 0
+    converged: bool = False
+
+    @property
+    def candidates_per_s(self) -> float:
+        if self.score_elapsed_s <= 0:
+            return 0.0
+        return self.candidates_scored / self.score_elapsed_s
+
+    def to_dict(self) -> dict:
+        return {
+            "moves": len(self.moves), "rounds": self.rounds,
+            "candidates_scored": self.candidates_scored,
+            "candidates_per_s": round(self.candidates_per_s, 1),
+            "score_elapsed_s": round(self.score_elapsed_s, 4),
+            "elapsed_s": round(self.elapsed_s, 4),
+            "max_dev_before": round(self.max_dev_before, 3),
+            "max_dev_after": round(self.max_dev_after, 3),
+            "spread_before": self.spread_before,
+            "spread_after": self.spread_after,
+            "budget": self.budget, "budget_used": self.budget_used,
+            "converged": self.converged,
+        }
+
+
+def batch_calc_pg_upmaps(osdmap, pool_id: int, max_deviation: int = 1,
+                         max_movement: int | None = None,
+                         max_src: int = 64, max_dst: int = 64,
+                         max_rounds: int = 256, chunk: int = 1 << 16,
+                         apply: bool = True,
+                         raw: np.ndarray | None = None) -> BalanceResult:
+    """One device-batched optimization run over a whole pool.
+
+    max_movement is the data-movement budget in PG shards (each move
+    migrates one shard's worth of data); None = unbounded. Pass a
+    precomputed `raw` (chunked_pgs_to_raw) to skip the mapping launch
+    — the scale sim reuses one launch across balancer calls on an
+    unchanged topology.
+
+    Returns a BalanceResult; with apply=True the winning upmap set is
+    landed on the map as ONE epoch (set_pg_upmap_bulk).
+    """
+    t_all = time.monotonic()
+    crush = osdmap.crush
+    pool = osdmap.pools[pool_id]
+    n_osds = len(osdmap.osd_weight)
+    dom = osd_domains(crush, _rule_domain_type(crush, pool.crush_rule),
+                      n_osds)
+    if raw is None:
+        raw = chunked_pgs_to_raw(osdmap, pool_id, chunk)
+    items_now = {pg: list(v) for pg, v in osdmap.pg_upmap_items.items()
+                 if pg[0] == pool_id}
+    eff = apply_upmaps_to_raw(raw, pool_id, items_now)
+
+    res = BalanceResult(budget=max_movement)
+    up_mask = np.asarray(osdmap.osd_up)
+    usable = up_mask & (np.asarray(osdmap.osd_weight) > 0)
+    if usable.sum() < 2:
+        res.elapsed_s = time.monotonic() - t_all
+        return res
+    w = np.asarray(osdmap.osd_weight, dtype=np.float64) / 0x10000
+    wsum = w[usable].sum()
+
+    def histo():
+        flat = eff[(eff != _NONE) & up_mask[np.clip(eff, 0, n_osds - 1)]
+                   & (eff >= 0)]
+        return np.bincount(flat, minlength=n_osds).astype(np.float64)
+
+    load = histo()
+    expected = np.zeros(n_osds)
+    expected[usable] = load[usable].sum() * w[usable] / wsum
+    dev = np.where(usable, load - expected, 0.0)
+
+    def spread():
+        d = dev[usable]
+        return float(d.max() - d.min()), float(np.abs(d).max())
+
+    res.spread_before = int(round(spread()[0]))
+    res.max_dev_before = spread()[1]
+    touched: dict = {}
+    dom_host = dom  # int64 domain ids
+
+    for _round in range(max_rounds):
+        sp, _ = spread()
+        if sp <= max_deviation:
+            res.converged = True
+            break
+        if max_movement is not None and res.budget_used >= max_movement:
+            break
+        order = np.argsort(-dev)
+        srcs = [int(o) for o in order[:max_src]
+                if usable[o] and dev[o] > 0][:max_src]
+        under = np.argsort(dev)
+        dsts = np.asarray([int(o) for o in under[:max_dst]
+                           if usable[o]], dtype=np.int32)
+        if not srcs or dsts.size == 0:
+            break
+        t0 = time.monotonic()
+        # every (pg, slot) shard currently on an overfull device
+        src_of = np.full(n_osds, -1, dtype=np.int32)
+        src_of[srcs] = np.arange(len(srcs))
+        eff_c = np.clip(eff, 0, n_osds - 1)
+        # NONE is a large POSITIVE sentinel: clip would alias it onto
+        # the last device, minting phantom candidates
+        hit = (eff != _NONE) & (eff >= 0) & (src_of[eff_c] >= 0)
+        pg_idx, slot_idx = np.nonzero(hit)
+        if pg_idx.size == 0:
+            break
+        src_arr = eff[pg_idx, slot_idx].astype(np.int32)
+        members = np.concatenate([raw[pg_idx], eff[pg_idx]], axis=1)
+        # pad N to a pow2 bucket so the device program recompiles
+        # O(log N) times, not once per round
+        N = pg_idx.size
+        Np = _pow2_pad(N)
+        if Np != N:
+            members = np.concatenate(
+                [members, np.full((Np - N, members.shape[1]), _NONE,
+                                  np.int32)])
+            src_arr = np.concatenate(
+                [src_arr, np.zeros(Np - N, np.int32)])
+        topk = int(min(8, dsts.size))
+        best, score = _score_kernel(
+            jnp.asarray(members), jnp.asarray(src_arr),
+            jnp.asarray(dsts), jnp.asarray(dev, jnp.float32),
+            jnp.asarray(dom_host), topk)
+        best = np.asarray(best)[:N]                 # (N, topk)
+        score = np.asarray(score)[:N]
+        res.candidates_scored += N * int(dsts.size)
+        res.score_elapsed_s += time.monotonic() - t0
+
+        moved_pgs: set[int] = set()
+        accepted = 0
+        for ci in np.argsort(-score[:, 0]):
+            if not np.isfinite(score[ci, 0]):
+                break
+            if max_movement is not None \
+                    and res.budget_used >= max_movement:
+                break
+            ps = int(pg_idx[ci])
+            if ps in moved_pgs:
+                continue
+            src = int(src_arr[ci])
+            # devs moved under us this round: walk this candidate's
+            # ranked legal targets for the first whose gain survives.
+            # Sign guards keep the movement budget honest: a shard
+            # must leave a device still ABOVE target for one still
+            # BELOW it, so every accepted move shrinks sum|dev| —
+            # without them, late-round moves onto targets that had
+            # already crossed zero burned ~2x the budget for zero
+            # convergence (observed at the 512-OSD 2x cell)
+            if dev[src] <= 0:
+                continue
+            dst = -1
+            for k in range(topk):
+                if not np.isfinite(score[ci, k]):
+                    break
+                cand = int(dsts[best[ci, k]])
+                if dev[cand] < 0 and dev[src] - dev[cand] > 1.0:
+                    dst = cand
+                    break
+            if dst < 0:
+                continue
+            pg = (pool_id, ps)
+            items = touched.get(pg, items_now.get(pg, []))
+            raw_row = raw[ps]
+            if (raw_row == src).any():
+                new_items = list(items) + [(src, dst)]
+            else:
+                act = [f for f, t in items
+                       if t == src and (raw_row == f).any()]
+                if not act:
+                    continue  # inactive redirect: wrong shard
+                new_items = [(f, t) for f, t in items
+                             if (f, t) != (act[0], src)]
+                new_items.append((act[0], dst))
+            slot = int(np.nonzero(eff[ps] == src)[0][0])
+            eff[ps, slot] = dst
+            touched[pg] = new_items
+            res.moves.append((pg, (src, dst)))
+            moved_pgs.add(ps)
+            res.budget_used += 1
+            accepted += 1
+            load[src] -= 1
+            load[dst] += 1
+            dev[src] = load[src] - expected[src]
+            dev[dst] = load[dst] - expected[dst]
+        res.rounds += 1
+        if accepted == 0:
+            break
+
+    sp, mx = spread()
+    res.spread_after = int(round(sp))
+    res.max_dev_after = mx
+    res.converged = res.converged or sp <= max_deviation
+    res.proposed = touched
+    if apply and touched:
+        osdmap.set_pg_upmap_bulk(touched)
+    res.elapsed_s = time.monotonic() - t_all
+    return res

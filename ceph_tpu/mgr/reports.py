@@ -55,7 +55,8 @@ class MgrReportAggregator:
             ent = self._daemons.setdefault(
                 name, {"perf": {}, "schema": {}, "seq": -1,
                        "synced": False, "pgs": {}, "epoch": 0,
-                       "ops_in_flight": 0, "slow_ops": 0, "stamp": now})
+                       "pool_bytes": {}, "ops_in_flight": 0,
+                       "slow_ops": 0, "stamp": now})
             seq = int(report.get("seq", 0))
             if report.get("kind") == "full":
                 ent["perf"] = report.get("perf", {})
@@ -72,7 +73,8 @@ class MgrReportAggregator:
                 ent["synced"] = False
             ent["seq"] = seq
             ent["stamp"] = now
-            for key in ("ops_in_flight", "slow_ops", "pgs", "epoch"):
+            for key in ("ops_in_flight", "slow_ops", "pgs", "epoch",
+                        "pool_bytes"):
                 if key in report:
                     ent[key] = report[key]
 
@@ -97,6 +99,21 @@ class MgrReportAggregator:
         out: dict[str, str] = {}
         for ent in ents:
             out.update(ent.get("pgs") or {})
+        return out
+
+    def pool_bytes(self) -> dict[int, int]:
+        """Logical bytes per pool summed over every reporting
+        primary's claim — the pool-utilization input the
+        pg_autoscaler's capacity shares derive from (role of
+        pg_stat_t num_bytes aggregation in the mgr)."""
+        out: dict[int, int] = {}
+        with self._lock:
+            claims = [e.get("pool_bytes") or {}
+                      for e in self._daemons.values()]
+        for claim in claims:
+            for pid, b in claim.items():
+                pid = int(pid)
+                out[pid] = out.get(pid, 0) + int(b)
         return out
 
     def totals(self) -> dict:

@@ -111,6 +111,34 @@ class PGPool:
         return int(hash32_2(np.uint32(m), np.uint32(self.pool_id)))
 
 
+def _encode_pool(en, p: "PGPool") -> None:
+    # v2 appends snap_seq + snaps; compat 1 (old readers skip the
+    # tail via the section length)
+    en.start(2, 1)
+    en.i32(p.pool_id).u32(p.pg_num).u32(p.size).u32(p.min_size)
+    en.i32(p.crush_rule).boolean(p.is_erasure).u32(p.pgp_num)
+    en.mapping(p.ec_profile, lambda e2, k: e2.string(k),
+               lambda e2, v: e2.string(str(v)))
+    en.u64(p.snap_seq)
+    en.mapping(p.snaps, lambda e2, k: e2.u64(k),
+               lambda e2, v: e2.string(v))
+    en.finish()
+
+
+def _decode_pool(dd) -> "PGPool":
+    pv = dd.start(2)
+    p = PGPool(dd.i32(), dd.u32(), dd.u32(), dd.u32(), dd.i32(),
+               dd.boolean(), dd.u32(),
+               dd.mapping(lambda e2: e2.string(),
+                          lambda e2: e2.string()))
+    if pv >= 2:
+        p.snap_seq = dd.u64()
+        p.snaps = dd.mapping(lambda e2: e2.u64(),
+                             lambda e2: e2.string())
+    dd.finish()
+    return p
+
+
 class OSDMap:
     """Cluster map: CRUSH topology + pools + per-OSD runtime state."""
 
@@ -175,19 +203,7 @@ class OSDMap:
                lambda en, w: en.i32(w))
         e.list([bool(u) for u in self.osd_up],
                lambda en, u: en.boolean(u))
-        def enc_pool(en, p: PGPool):
-            # v2 appends snap_seq + snaps; compat 1 (old readers skip
-            # the tail via the section length)
-            en.start(2, 1)
-            en.i32(p.pool_id).u32(p.pg_num).u32(p.size).u32(p.min_size)
-            en.i32(p.crush_rule).boolean(p.is_erasure).u32(p.pgp_num)
-            en.mapping(p.ec_profile, lambda e2, k: e2.string(k),
-                       lambda e2, v: e2.string(str(v)))
-            en.u64(p.snap_seq)
-            en.mapping(p.snaps, lambda e2, k: e2.u64(k),
-                       lambda e2, v: e2.string(v))
-            en.finish()
-        e.list([self.pools[k] for k in sorted(self.pools)], enc_pool)
+        e.list([self.pools[k] for k in sorted(self.pools)], _encode_pool)
         e.mapping(self.pg_temp,
                   lambda en, k: en.i32(k[0]).u32(k[1]),
                   lambda en, v: en.list(v, lambda e2, o: e2.i32(o)))
@@ -218,19 +234,7 @@ class OSDMap:
         ups = d.list(lambda dd: dd.boolean())
         m.osd_weight = np.asarray(weights, dtype=np.int32)
         m.osd_up = np.asarray(ups, dtype=bool)
-        def dec_pool(dd) -> PGPool:
-            pv = dd.start(2)
-            p = PGPool(dd.i32(), dd.u32(), dd.u32(), dd.u32(), dd.i32(),
-                       dd.boolean(), dd.u32(),
-                       dd.mapping(lambda e2: e2.string(),
-                                  lambda e2: e2.string()))
-            if pv >= 2:
-                p.snap_seq = dd.u64()
-                p.snaps = dd.mapping(lambda e2: e2.u64(),
-                                     lambda e2: e2.string())
-            dd.finish()
-            return p
-        for p in d.list(dec_pool):
+        for p in d.list(_decode_pool):
             m.pools[p.pool_id] = p
         m.pg_temp = d.mapping(lambda dd: (dd.i32(), dd.u32()),
                               lambda dd: dd.list(lambda e2: e2.i32()))
@@ -267,6 +271,7 @@ class OSDMap:
 
     def mark_down(self, osd: int) -> None:
         self.osd_up[osd] = False
+        self.clean_pg_upmaps()
         self._bump()
 
     def mark_up(self, osd: int) -> None:
@@ -334,18 +339,54 @@ class OSDMap:
             self.pg_upmap_items.pop(pg, None)
         self._bump()
 
+    def set_pg_upmap_bulk(self, updates: dict) -> None:
+        """Apply MANY per-PG upmap overrides as ONE map epoch — the
+        shape a balancer round lands in the real cluster (one monitor
+        commit carries the whole batch, not one epoch per PG). Empty
+        item lists clear their entries."""
+        if not updates:
+            return
+        for pg, items in updates.items():
+            if items:
+                self.pg_upmap_items[pg] = [(int(f), int(t))
+                                           for f, t in items]
+            else:
+                self.pg_upmap_items.pop(pg, None)
+        self._bump()
+
     def clean_pg_upmaps(self) -> None:
-        """Drop upmap entries that point at out OSDs (ref:
-        OSDMap::clean_pg_upmaps, run on map changes so stale balancer
-        decisions never pin data to dead devices)."""
+        """Drop upmap entries that can no longer be honored (ref:
+        OSDMap::clean_pg_upmaps + OSDMonitor maybe_remove_pg_upmaps,
+        run on map changes so stale balancer decisions never pin data
+        to dead devices): a redirect dies when its target OSD is out
+        OR down (a down target cannot serve the shard it pins), and a
+        whole entry dies when its pool is gone or its ps outgrew the
+        pool's pg space."""
         for pg, items in list(self.pg_upmap_items.items()):
+            pool = self.pools.get(pg[0])
+            if pool is None or pg[1] >= pool.pg_num:
+                del self.pg_upmap_items[pg]
+                continue
             kept = [(f, t) for f, t in items
-                    if t < len(self.osd_weight) and self.osd_weight[t] > 0]
+                    if t < len(self.osd_weight)
+                    and self.osd_weight[t] > 0 and self.osd_up[t]]
             if len(kept) != len(items):
                 if kept:
                     self.pg_upmap_items[pg] = kept
                 else:
                     del self.pg_upmap_items[pg]
+
+    def remove_pool(self, pool_id: int) -> None:
+        """Delete a pool and every per-PG override keyed to it (ref:
+        OSDMonitor pool deletion -> OSDMap::Incremental old_pools).
+        Idempotent: removing an absent pool is a no-op."""
+        if pool_id not in self.pools:
+            return
+        del self.pools[pool_id]
+        for d in (self.pg_temp, self.primary_temp, self.pg_upmap_items):
+            for pg in [k for k in d if k[0] == pool_id]:
+                del d[pg]
+        self._bump()
 
     def mark_in(self, osd: int, weight: float = 1.0) -> None:
         self.osd_weight[osd] = int(weight * 0x10000)
@@ -477,6 +518,20 @@ class OSDMap:
 
     # -- batched PG -> OSDs (the TPU path) ----------------------------------
 
+    def pgs_to_raw(self, pool_id: int, ps: np.ndarray | None = None):
+        """Raw CRUSH output for ALL (or the given) PGs of a pool in one
+        vectorized launch: NO upmap overlay, NO down-filtering — the
+        balancer's ground truth (a down-but-in member still owns its
+        slot, and failure-domain math must derive from it)."""
+        pool = self.pools[pool_id]
+        if ps is None:
+            ps = np.arange(pool.pg_num, dtype=np.uint32)
+        ps = np.asarray(ps, np.uint32)
+        pps = pool.raw_pg_to_pps(ps)
+        raw = np.asarray(self._vm.do_rule(pool.crush_rule, pps,
+                                          self.osd_weight, pool.size))
+        return raw[:, :pool.size].copy()
+
     def pgs_to_up(self, pool_id: int, ps: np.ndarray | None = None):
         """Map ALL (or the given) PGs of a pool in one vectorized launch.
 
@@ -488,10 +543,7 @@ class OSDMap:
         if ps is None:
             ps = np.arange(pool.pg_num, dtype=np.uint32)
         ps = np.asarray(ps, np.uint32)
-        pps = pool.raw_pg_to_pps(ps)
-        raw = np.asarray(self._vm.do_rule(pool.crush_rule, pps,
-                                          self.osd_weight, pool.size))
-        raw = raw[:, :pool.size].copy()
+        raw = self.pgs_to_raw(pool_id, ps)
         if self.pg_upmap_items:
             # sparse host-side overlay (like pg_temp in pgs_to_acting):
             # upmaps are rare relative to pg_num
@@ -531,3 +583,263 @@ class OSDMap:
         counts = np.bincount(real, minlength=len(self.osd_up))
         degraded = int((up == CRUSH_ITEM_NONE).any(axis=1).sum())
         return {"pg_per_osd": counts, "degraded_pgs": degraded}
+
+    # -- cloning / comparison ------------------------------------------------
+
+    def shallow_clone(self) -> "OSDMap":
+        """Structural copy sharing the (immutable-in-practice) CRUSH
+        map and its compiled mappers: O(n_osds) array copies + dict
+        copies, no re-decode. This is what an incremental apply
+        mutates so readers holding the old map object never see a
+        half-applied epoch."""
+        c = object.__new__(OSDMap)
+        c.crush = self.crush
+        c.epoch = self.epoch
+        c.pools = {
+            pid: PGPool(p.pool_id, p.pg_num, p.size, p.min_size,
+                        p.crush_rule, p.is_erasure, p.pgp_num,
+                        dict(p.ec_profile), p.snap_seq, dict(p.snaps))
+            for pid, p in self.pools.items()}
+        c.osd_weight = self.osd_weight.copy()
+        c.osd_up = self.osd_up.copy()
+        c.osd_up_thru = self.osd_up_thru.copy()
+        c.pg_temp = {k: list(v) for k, v in self.pg_temp.items()}
+        c.primary_temp = dict(self.primary_temp)
+        c.pg_upmap_items = {k: list(v)
+                            for k, v in self.pg_upmap_items.items()}
+        c.config_kv = dict(self.config_kv)
+        c.mon_members = list(self.mon_members)
+        c.osd_admin_out = set(self.osd_admin_out)
+        c._vm = self._vm
+        c._om = self._om
+        return c
+
+
+def same_state(a: "OSDMap", b: "OSDMap") -> bool:
+    """Canonical (order-insensitive) equality of two maps — what the
+    incremental-map property tests pin: a follower that applied the
+    delta chain must be indistinguishable from the leader. Byte
+    equality of encode() is NOT required (mapping sections ride dict
+    insertion order, which legitimately differs across histories)."""
+    if a.epoch != b.epoch or a.pools != b.pools:
+        return False
+    if a.osd_weight.tolist() != b.osd_weight.tolist() \
+            or a.osd_up.tolist() != b.osd_up.tolist() \
+            or a.osd_up_thru.tolist() != b.osd_up_thru.tolist():
+        return False
+    if a.pg_temp != b.pg_temp or a.primary_temp != b.primary_temp \
+            or a.pg_upmap_items != b.pg_upmap_items:
+        return False
+    if a.config_kv != b.config_kv or a.mon_members != b.mon_members \
+            or a.osd_admin_out != b.osd_admin_out:
+        return False
+    return (a.crush is b.crush) or a.crush.encode() == b.crush.encode()
+
+
+class Incremental:
+    """OSDMap delta — the epoch-to-epoch wire unit (ref: src/osd/
+    OSDMap.h OSDMap::Incremental — new_up_client/new_weight/new_state,
+    new_pg_temp, new_pg_upmap_items, new_pools/old_pools, fullmap
+    fallback; distributed by the monitors so map churn at 10k OSDs
+    ships deltas instead of full maps).
+
+    Construction is diff-based (`Incremental.diff(old, new)`): the
+    monitors' mutate closures already produce the post-change map, so
+    the delta is derived rather than accumulated — one code path no
+    matter which mutator ran. A CRUSH topology change (rare: device
+    add at the crush level) falls back to carrying the full map blob,
+    exactly the reference's `fullmap` member.
+
+    Erase sentinels: pg_temp/pg_upmap_items erase as empty lists,
+    primary_temp as -1 — the same convention the mutators use.
+    """
+
+    def __init__(self, epoch: int, base_epoch: int):
+        self.epoch = epoch
+        self.base_epoch = base_epoch
+        self.full_blob: bytes | None = None
+        self.new_up: list[int] = []
+        self.new_down: list[int] = []
+        self.new_weights: dict[int, int] = {}
+        self.new_up_thru: dict[int, int] = {}
+        self.new_pools: list[PGPool] = []
+        self.removed_pools: list[int] = []
+        self.new_pg_temp: dict[tuple[int, int], list[int]] = {}
+        self.new_primary_temp: dict[tuple[int, int], int] = {}
+        self.new_pg_upmap_items: dict[tuple[int, int],
+                                      list[tuple[int, int]]] = {}
+        self.new_config: dict[str, str] = {}
+        self.removed_config: list[str] = []
+        self.new_mon_members: list[int] | None = None
+        self.new_admin_out: list[int] | None = None
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def diff(cls, old: "OSDMap", new: "OSDMap") -> "Incremental":
+        inc = cls(new.epoch, old.epoch)
+        crush_same = (old.crush is new.crush) \
+            or old.crush.encode() == new.crush.encode()
+        if not crush_same or len(old.osd_up) != len(new.osd_up):
+            # topology changed: ship the full map (the reference's
+            # Incremental::fullmap escape hatch)
+            inc.full_blob = new.encode()
+            return inc
+        for o in np.nonzero(old.osd_up != new.osd_up)[0]:
+            (inc.new_up if new.osd_up[o] else inc.new_down).append(int(o))
+        for o in np.nonzero(old.osd_weight != new.osd_weight)[0]:
+            inc.new_weights[int(o)] = int(new.osd_weight[o])
+        for o in np.nonzero(old.osd_up_thru != new.osd_up_thru)[0]:
+            inc.new_up_thru[int(o)] = int(new.osd_up_thru[o])
+        for pid, p in new.pools.items():
+            if old.pools.get(pid) != p:
+                inc.new_pools.append(p)
+        inc.removed_pools = sorted(pid for pid in old.pools
+                                   if pid not in new.pools)
+        for attr, out, erase in (
+                ("pg_temp", inc.new_pg_temp, []),
+                ("primary_temp", inc.new_primary_temp, -1),
+                ("pg_upmap_items", inc.new_pg_upmap_items, [])):
+            od, nd = getattr(old, attr), getattr(new, attr)
+            for k, v in nd.items():
+                if od.get(k) != v:
+                    out[k] = v
+            for k in od:
+                if k not in nd:
+                    out[k] = erase
+        for k, v in new.config_kv.items():
+            if old.config_kv.get(k) != v:
+                inc.new_config[k] = v
+        inc.removed_config = sorted(k for k in old.config_kv
+                                    if k not in new.config_kv)
+        if old.mon_members != new.mon_members:
+            inc.new_mon_members = list(new.mon_members)
+        if old.osd_admin_out != new.osd_admin_out:
+            inc.new_admin_out = sorted(new.osd_admin_out)
+        return inc
+
+    # -- application ---------------------------------------------------------
+
+    def apply(self, m: "OSDMap") -> "OSDMap":
+        """Apply onto `m` (must sit at base_epoch) and return the
+        post-change map. The delta path mutates `m` IN PLACE —
+        callers wanting atomicity clone first (shallow_clone); the
+        full-map fallback returns a fresh decode."""
+        if m.epoch != self.base_epoch:
+            raise ValueError(f"incremental base {self.base_epoch} "
+                             f"!= map epoch {m.epoch}")
+        if self.full_blob is not None:
+            return OSDMap.decode(self.full_blob)
+        for o in self.new_up:
+            m.osd_up[o] = True
+        for o in self.new_down:
+            m.osd_up[o] = False
+        for o, w in self.new_weights.items():
+            m.osd_weight[o] = w
+        for o, t in self.new_up_thru.items():
+            m.osd_up_thru[o] = t
+        for p in self.new_pools:
+            m.pools[p.pool_id] = p
+        for pid in self.removed_pools:
+            m.pools.pop(pid, None)
+        for pg, v in self.new_pg_temp.items():
+            if v:
+                m.pg_temp[pg] = list(v)
+            else:
+                m.pg_temp.pop(pg, None)
+        for pg, o in self.new_primary_temp.items():
+            if o >= 0:
+                m.primary_temp[pg] = o
+            else:
+                m.primary_temp.pop(pg, None)
+        for pg, items in self.new_pg_upmap_items.items():
+            if items:
+                m.pg_upmap_items[pg] = [(int(f), int(t))
+                                        for f, t in items]
+            else:
+                m.pg_upmap_items.pop(pg, None)
+        for k, v in self.new_config.items():
+            m.config_kv[k] = v
+        for k in self.removed_config:
+            m.config_kv.pop(k, None)
+        if self.new_mon_members is not None:
+            m.mon_members = list(self.new_mon_members)
+        if self.new_admin_out is not None:
+            m.osd_admin_out = set(self.new_admin_out)
+        m.epoch = self.epoch
+        m.__dict__.pop("_placement_cache", None)
+        return m
+
+    # -- wire form -----------------------------------------------------------
+
+    def encode(self) -> bytes:
+        from ..utils.encoding import Encoder
+        e = Encoder().start(1, 1)
+        e.u32(self.epoch).u32(self.base_epoch)
+        e.boolean(self.full_blob is not None)
+        if self.full_blob is not None:
+            e.blob(self.full_blob)
+            return e.finish().bytes()
+        def enc_pg(en, k):
+            en.i32(k[0]).u32(k[1])
+        e.list(self.new_up, lambda en, o: en.i32(o))
+        e.list(self.new_down, lambda en, o: en.i32(o))
+        e.mapping(self.new_weights, lambda en, k: en.i32(k),
+                  lambda en, v: en.i32(v))
+        e.mapping(self.new_up_thru, lambda en, k: en.i32(k),
+                  lambda en, v: en.u64(v))
+        e.list(self.new_pools, _encode_pool)
+        e.list(self.removed_pools, lambda en, p: en.i32(p))
+        e.mapping(self.new_pg_temp, enc_pg,
+                  lambda en, v: en.list(v, lambda e2, o: e2.i32(o)))
+        e.mapping(self.new_primary_temp, enc_pg,
+                  lambda en, v: en.i32(v))
+        e.mapping(self.new_pg_upmap_items, enc_pg,
+                  lambda en, v: en.list(
+                      v, lambda e2, ft: e2.i32(ft[0]).i32(ft[1])))
+        e.mapping(self.new_config, lambda en, k: en.string(k),
+                  lambda en, v: en.string(v))
+        e.list(self.removed_config, lambda en, k: en.string(k))
+        e.boolean(self.new_mon_members is not None)
+        if self.new_mon_members is not None:
+            e.list(self.new_mon_members, lambda en, r: en.i32(r))
+        e.boolean(self.new_admin_out is not None)
+        if self.new_admin_out is not None:
+            e.list(self.new_admin_out, lambda en, o: en.i32(o))
+        return e.finish().bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Incremental":
+        from ..utils.encoding import Decoder
+        d = Decoder(data)
+        d.start(1)
+        inc = cls(d.u32(), d.u32())
+        if d.boolean():
+            inc.full_blob = d.blob()
+            d.finish()
+            return inc
+        def dec_pg(dd):
+            return (dd.i32(), dd.u32())
+        inc.new_up = d.list(lambda dd: dd.i32())
+        inc.new_down = d.list(lambda dd: dd.i32())
+        inc.new_weights = d.mapping(lambda dd: dd.i32(),
+                                    lambda dd: dd.i32())
+        inc.new_up_thru = d.mapping(lambda dd: dd.i32(),
+                                    lambda dd: dd.u64())
+        inc.new_pools = d.list(_decode_pool)
+        inc.removed_pools = d.list(lambda dd: dd.i32())
+        inc.new_pg_temp = d.mapping(
+            dec_pg, lambda dd: dd.list(lambda e2: e2.i32()))
+        inc.new_primary_temp = d.mapping(dec_pg, lambda dd: dd.i32())
+        inc.new_pg_upmap_items = d.mapping(
+            dec_pg,
+            lambda dd: dd.list(lambda e2: (e2.i32(), e2.i32())))
+        inc.new_config = d.mapping(lambda dd: dd.string(),
+                                   lambda dd: dd.string())
+        inc.removed_config = d.list(lambda dd: dd.string())
+        if d.boolean():
+            inc.new_mon_members = d.list(lambda dd: dd.i32())
+        if d.boolean():
+            inc.new_admin_out = d.list(lambda dd: dd.i32())
+        d.finish()
+        return inc

@@ -71,7 +71,7 @@ from ..msgr.messenger import Message, Messenger, register_message
 from ..utils.encoding import Decoder, Encoder
 from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
-from .osdmap import OSDMap, PGPool
+from .osdmap import Incremental, OSDMap, PGPool
 from .pgbackend import ReplicatedBackend
 from .pglog import PGLog, divergent_names, share_history
 from .tinstore import _decode_txn, _encode_txn, _encode_txn_iov
@@ -247,6 +247,23 @@ class MMonAccept(Message):
 @register_message
 class MOSDMapMsg(MMonPropose):
     type_id = 0x3A          # same shape: epoch + encoded map
+
+
+@register_message
+class MOSDIncMapMsg(MMonPropose):
+    """Incremental map fan-out (ref: MOSDMap carrying incremental_maps
+    instead of maps): epoch + encoded OSDMap.Incremental whose
+    base_epoch rides inside. Subscribers that can't chain it (gap,
+    fresh boot) ask for a full map with MOSDMapRequest."""
+    type_id = 0x4C
+
+
+@register_message
+class MOSDMapRequest(MMonAccept):
+    """Subscriber -> monitor full-map request (the on-request half of
+    the full-map-every-Nth-epoch cadence): payload is the requester's
+    current epoch; any monitor answers with its committed full map."""
+    type_id = 0x4D
 
 
 @register_message
@@ -1138,6 +1155,7 @@ class OSDDaemon:
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
         m.register_handler(MOSDMapMsg.type_id, self._on_map)
+        m.register_handler(MOSDIncMapMsg.type_id, self._on_inc_map)
         if self.verifier is not None:
             from ..auth import ClientAuth
             m.register_handler(MAuthOp.type_id, self._on_auth)
@@ -1940,21 +1958,51 @@ class OSDDaemon:
             if self.osdmap is not None \
                     and msg.epoch <= self.osdmap.epoch:
                 return
-            self.osdmap = OSDMap.decode(msg.map_bytes)
-            # an OSD the map marks UP again is no longer suspect and
-            # may be REPORTED again on its next real failure (else a
-            # revived OSD's second death would never reach the mon)
-            now = time.monotonic()
-            for osd in self.c.osd_ids():
-                if osd != self.osd_id and self.osdmap.osd_up[osd]:
-                    if osd in self._reported or osd in self.suspect:
-                        self._last_pong[osd] = now
-                    self._reported.discard(osd)
-                    self.suspect.discard(osd)
-            self._apply_central_config()
-            self._reconcile()
-            self.perf.set("osdmap_epoch", self.osdmap.epoch)
-            self.perf.set("numpg", len(self.backends))
+            self._adopt_map_locked(OSDMap.decode(msg.map_bytes))
+
+    def _on_inc_map(self, peer: str, msg: MOSDIncMapMsg) -> None:
+        """Delta fan-out arm of the map subscription: chain the
+        incremental when it extends our epoch exactly; on any gap
+        (fresh boot, missed broadcast, partition heal) ask the sender
+        for a full map instead of guessing. The apply mutates a
+        shallow CLONE and swaps — readers holding self.osdmap never
+        see a half-applied epoch."""
+        with self._lock:
+            cur = self.osdmap
+            if cur is not None and msg.epoch <= cur.epoch:
+                return
+            if cur is not None and msg.epoch == cur.epoch + 1:
+                inc = Incremental.decode(msg.map_bytes)
+                if inc.base_epoch == cur.epoch:
+                    self.perf.inc("map_incs_applied")
+                    self._adopt_map_locked(
+                        inc.apply(cur.shallow_clone()))
+                    return
+            self.perf.inc("map_full_requests")
+        try:
+            self.msgr.send(peer, MOSDMapRequest(
+                self.osdmap.epoch if self.osdmap is not None else 0))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+    def _adopt_map_locked(self, newmap: OSDMap) -> None:
+        """Land a newer map (full decode or chained incremental) —
+        caller holds self._lock and has checked epoch monotonicity."""
+        self.osdmap = newmap
+        # an OSD the map marks UP again is no longer suspect and
+        # may be REPORTED again on its next real failure (else a
+        # revived OSD's second death would never reach the mon)
+        now = time.monotonic()
+        for osd in self.c.osd_ids():
+            if osd != self.osd_id and self.osdmap.osd_up[osd]:
+                if osd in self._reported or osd in self.suspect:
+                    self._last_pong[osd] = now
+                self._reported.discard(osd)
+                self.suspect.discard(osd)
+        self._apply_central_config()
+        self._reconcile()
+        self.perf.set("osdmap_epoch", self.osdmap.epoch)
+        self.perf.set("numpg", len(self.backends))
 
     def _apply_central_config(self) -> None:
         """Land the committed map's config KV at this daemon's "mon"
@@ -2238,6 +2286,12 @@ class OSDDaemon:
                        "decode)")
          .add_u64("numpg", "PGs this daemon primaries")
          .add_u64("osdmap_epoch", "newest folded map epoch")
+         .add_u64_counter("map_incs_applied",
+                          "incremental OSDMaps chained onto the "
+                          "current epoch (delta fan-out path)")
+         .add_u64_counter("map_full_requests",
+                          "full-map requests sent after an "
+                          "unchainable incremental (gap/fresh boot)")
          .add_time_avg("op_latency",
                        "client op wall time (tracker enter to reply "
                        "built)")
@@ -2324,6 +2378,16 @@ class OSDDaemon:
                     interval_start=self._interval_start.get(ps, 0),
                     up_thru=my_ut).state
                 for ps, be in sorted(self.backends.items())}
+
+    def _pool_bytes(self) -> dict:
+        """Logical bytes per pool across the PGs this daemon primaries
+        (the pg_stat_t num_bytes slice the autoscaler's capacity
+        shares derive from; primaries-only so the cluster aggregate
+        counts each object once, not size times). Caller holds
+        self._lock. JSON-string pool keys — the report rides JSON."""
+        total = sum(sum(be.object_sizes.values())
+                    for be in self.backends.values())
+        return {"1": int(total)} if self.backends else {}
 
     def _admin_obj(self, cmd: str):
         """ONE dispatcher for both admin surfaces — the wire `admin`
@@ -3119,6 +3183,7 @@ class OSDDaemon:
         if self._lock.acquire(blocking=False):
             try:
                 report["pgs"] = self._pg_states()
+                report["pool_bytes"] = self._pool_bytes()
             finally:
                 self._lock.release()
         blob = _json.dumps(report, separators=(",", ":")).encode()
@@ -3216,6 +3281,9 @@ class MonDaemon:
         # on an epoch key or silently drop each other's mutations.
         self._mutations: list = []
         self._reporters: dict[int, set[str]] = {}
+        # epoch -> encoded Incremental for recent consecutive commits
+        # (the delta fan-out source; bounded, full maps cover evictions)
+        self._inc_cache: dict[int, bytes] = {}
         self._lock = threading.RLock()
         self._peer_pong: dict[int, float] = {}
         # peers start PRESUMED ALIVE for one grace window: a freshly
@@ -3243,6 +3311,12 @@ class MonDaemon:
                                       "rounds lost to a nack")
                      .add_u64_counter("map_broadcasts",
                                       "map fan-outs to subscribers")
+                     .add_u64_counter("map_inc_broadcasts",
+                                      "incremental (delta) map "
+                                      "fan-outs to subscribers")
+                     .add_u64_counter("map_full_serves",
+                                      "full maps served on request "
+                                      "(inc chain gap at a subscriber)")
                      .add_u64_counter("mgr_reports_rx",
                                       "MgrReports ingested")
                      .add_u64_counter("mon_cmds",
@@ -3256,7 +3330,7 @@ class MonDaemon:
         self.asok = AdminSocket(cluster.asok_path(self.name))
         for _cmd in ("status", "health", "health detail", "prometheus",
                      "perf dump", "perf schema", "report dump",
-                     "mon_status", "log dump"):
+                     "mon_status", "log dump", "autoscale status"):
             self.asok.register(_cmd,
                                lambda args, c=_cmd: self._mon_cmd_obj(c))
         self.asok.start()
@@ -3273,6 +3347,7 @@ class MonDaemon:
         m.register_handler(MMonCommit.type_id, self._on_commit)
         m.register_handler(MMonNack.type_id, self._on_nack)
         m.register_handler(MMonSyncReq.type_id, self._on_sync_req)
+        m.register_handler(MOSDMapRequest.type_id, self._on_map_request)
         m.register_handler(MMonJoin.type_id, self._on_mon_join)
         m.register_handler(MOsdAdmin.type_id, self._on_osd_admin)
         # cephx service (ref: AuthMonitor + CephxServiceHandler).
@@ -3430,7 +3505,9 @@ class MonDaemon:
         Commit frames carry one). Commit adoption is always safe —
         a majority durably accepted it — and monotonic by epoch."""
         if epoch and (self.osdmap is None or epoch > self.osdmap.epoch):
+            old = self.osdmap
             self.osdmap = OSDMap.decode(blob)
+            self._note_inc_locked(old, self.osdmap)
         if self._accepted is not None and self.osdmap is not None \
                 and self._accepted[1] <= self.osdmap.epoch:
             self._accepted = None    # superseded by a commit
@@ -3792,6 +3869,11 @@ class MonDaemon:
                     if self.osdmap is not None else 0}
         if kind == "log dump":
             return {"lines": g_log.dump_recent()}
+        if kind == "autoscale status":
+            from ..mgr.pg_autoscaler import autoscale_from_reports
+            if self.osdmap is None:
+                return []
+            return autoscale_from_reports(self.mgr, self.osdmap)
         raise ValueError(f"unknown mon command {kind!r}")
 
     def _on_mon_cmd(self, peer: str, msg: MMonCmd) -> None:
@@ -3906,7 +3988,9 @@ class MonDaemon:
                 # regress the committed map — requeue for rebase
                 self._mutations = muts + self._mutations
             else:
+                old = self.osdmap
                 self.osdmap = OSDMap.decode(blob)
+                self._note_inc_locked(old, self.osdmap)
                 if self._accepted is not None \
                         and self._accepted[1] <= epoch:
                     self._accepted = None
@@ -3995,17 +4079,55 @@ class MonDaemon:
         self.perf.inc("paxos_begins")
         self._send_peers(begin)
 
+    def _note_inc_locked(self, old: OSDMap | None,
+                         new: OSDMap) -> None:
+        """Derive + cache the delta for a freshly adopted consecutive
+        epoch (caller holds the lock). Non-consecutive adoption (store
+        sync across a gap) just doesn't cache — subscribers on the
+        old epoch will request a full map."""
+        if old is None or new.epoch != old.epoch + 1:
+            return
+        self._inc_cache[new.epoch] = Incremental.diff(old, new).encode()
+        while len(self._inc_cache) > 32:
+            del self._inc_cache[min(self._inc_cache)]
+
     def _broadcast(self, epoch: int) -> None:
+        """Fan the committed epoch to every subscriber: a DELTA when
+        this monitor holds the consecutive incremental and the epoch
+        is off the full-map cadence, the full map otherwise (ref:
+        OSDMonitor send_incremental — full every Nth epoch or on
+        request, deltas in between)."""
+        from ..utils.config import g_conf
         with self._lock:
             if self.osdmap is None or self.osdmap.epoch != epoch:
                 return
-            blob = self.osdmap.encode()
-        self.perf.inc("map_broadcasts")
+            inc = self._inc_cache.get(epoch)
+            full_every = max(1, int(g_conf["mon_osdmap_full_every"]))
+            if inc is not None and epoch % full_every:
+                cls_, blob, ctr = MOSDIncMapMsg, inc, "map_inc_broadcasts"
+            else:
+                cls_, blob, ctr = (MOSDMapMsg, self.osdmap.encode(),
+                                   "map_broadcasts")
+        self.perf.inc(ctr)
         for peer in self.c.map_subscribers():
             try:
-                self.msgr.send(peer, MOSDMapMsg(epoch, blob))
+                self.msgr.send(peer, cls_(epoch, blob))
             except (KeyError, OSError, ConnectionError):
                 pass
+
+    def _on_map_request(self, peer: str, msg: MOSDMapRequest) -> None:
+        """Serve the full committed map to a subscriber that could not
+        chain an incremental (gap, fresh boot) — the on-request half
+        of the full-map cadence."""
+        with self._lock:
+            if self.osdmap is None or self.osdmap.epoch <= msg.epoch:
+                return
+            epoch, blob = self.osdmap.epoch, self.osdmap.encode()
+        self.perf.inc("map_full_serves")
+        try:
+            self.msgr.send(peer, MOSDMapMsg(epoch, blob))
+        except (KeyError, OSError, ConnectionError):
+            pass
 
     def _on_failure(self, peer: str, msg: MOSDFailure) -> None:
         # EVERY mon queues the mutation (reports are broadcast to all):
@@ -4349,6 +4471,8 @@ class Client:
         # until a newer map (or a successful reply) clears the entry
         self._tgt_suspect: dict[str, int] = {}
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
+        self.msgr.register_handler(MOSDIncMapMsg.type_id,
+                                   self._on_inc_map)
         # read-only monitor commands (status/health/prometheus) ride
         # their own correlation space
         self.mon_rpc = _Rpc(self.msgr, MMonCmdReply.type_id)
@@ -4378,6 +4502,25 @@ class Client:
         with self._lock:
             if self.osdmap is None or msg.epoch > self.osdmap.epoch:
                 self.osdmap = OSDMap.decode(msg.map_bytes)
+
+    def _on_inc_map(self, peer: str, msg: MOSDIncMapMsg) -> None:
+        """Clients ride the same delta subscription as OSDs: chain a
+        consecutive incremental onto a clone, otherwise request the
+        full map from the sending monitor."""
+        with self._lock:
+            cur = self.osdmap
+            if cur is not None and msg.epoch <= cur.epoch:
+                return
+            if cur is not None and msg.epoch == cur.epoch + 1:
+                inc = Incremental.decode(msg.map_bytes)
+                if inc.base_epoch == cur.epoch:
+                    self.osdmap = inc.apply(cur.shallow_clone())
+                    return
+            req_epoch = cur.epoch if cur is not None else 0
+        try:
+            self.msgr.send(peer, MOSDMapRequest(req_epoch))
+        except (KeyError, OSError, ConnectionError):
+            pass
 
     def _primary(self, ps: int) -> str:
         acting = self.osdmap.pg_to_up_acting_osds(1, ps)[2]

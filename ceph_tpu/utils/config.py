@@ -132,6 +132,13 @@ OPTIONS: list[Option] = [
            "completed ops kept for dump_historic_ops", min=0),
     Option("osd_op_history_duration", float, 600.0,
            "seconds a completed op stays in the historic dump", min=0.0),
+    Option("mon_osdmap_full_every", int, 8,
+           "monitors fan out a FULL encoded OSDMap every Nth epoch "
+           "(and on request after a subscriber's delta-chain gap); "
+           "epochs in between ship OSDMap::Incremental deltas — at "
+           "10k OSDs per-epoch churn is a few redirects, not a "
+           "re-encode of the whole topology (1 = always full)",
+           min=1),
     Option("mgr_report_interval", float, 2.0,
            "seconds between a daemon's MgrReports to the monitors "
            "(the reference defaults to 5; lower = fresher `ceph "

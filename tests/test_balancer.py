@@ -147,6 +147,79 @@ class TestAutoscaler:
         assert r["pg_num_recommended"] == 256  # 8*100/3 ~ 267 -> 256
 
 
+class TestAutoscalerUtilization:
+    """r12: capacity shares from MgrReport-aggregated pool bytes
+    instead of synthetic even splits."""
+
+    def _two_pool_map(self):
+        om = make_map(n_osds=16, pg_num=64, size=3)
+        from ceph_tpu.osd.osdmap import PGPool
+        om.add_pool(PGPool(2, pg_num=64, size=3, min_size=2,
+                           crush_rule=1))
+        return om
+
+    def test_share_follows_pool_bytes(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = self._two_pool_map()
+        pb = {1: 900 << 20, 2: 100 << 20}
+        r1 = recommend_pg_num(om, 1, pool_bytes=pb)
+        r2 = recommend_pg_num(om, 2, pool_bytes=pb)
+        # 16 osds * 100 / 3 * 0.9 ~ 480 -> 512; * 0.1 ~ 53 -> 64
+        assert r1["pg_num_recommended"] == 512
+        assert r2["pg_num_recommended"] == 64
+        assert r1["would_adjust"]          # 64 -> 512 is 8x: scale UP
+
+    def test_scale_down_decision(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = self._two_pool_map()
+        om.set_pg_num(2, 512)
+        pb = {1: 990 << 20, 2: 10 << 20}   # pool 2 nearly empty
+        r2 = recommend_pg_num(om, 2, pool_bytes=pb)
+        assert r2["pg_num_recommended"] < 512
+        assert r2["would_adjust"]          # 512 vs ~8: scale DOWN
+
+    def test_empty_utilization_falls_back_to_even_split(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = self._two_pool_map()
+        base = recommend_pg_num(om, 1)
+        assert recommend_pg_num(om, 1, pool_bytes={}) == base
+        assert recommend_pg_num(om, 1, pool_bytes={1: 0, 2: 0}) == base
+
+    def test_zero_byte_pool_keeps_floor(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = self._two_pool_map()
+        r = recommend_pg_num(om, 2, pool_bytes={1: 1 << 30, 2: 0})
+        assert r["pg_num_recommended"] >= 1
+        assert r["pg_num_ideal"] >= 1.0
+
+    def test_from_reports_wiring(self):
+        """autoscale_from_reports consumes the SAME aggregate the
+        monitors build from primaries' MgrReports."""
+        from ceph_tpu.mgr.pg_autoscaler import (autoscale_from_reports,
+                                                autoscale_status)
+        from ceph_tpu.mgr.reports import MgrReportAggregator
+        om = self._two_pool_map()
+        agg = MgrReportAggregator()
+        # two primaries claim bytes; string pool keys (JSON wire form)
+        agg.ingest({"name": "osd.0", "seq": 1, "kind": "full",
+                    "perf": {}, "pool_bytes": {"1": 600 << 20}})
+        agg.ingest({"name": "osd.1", "seq": 1, "kind": "full",
+                    "perf": {}, "pool_bytes": {"1": 300 << 20,
+                                               "2": 100 << 20}})
+        assert agg.pool_bytes() == {1: 900 << 20, 2: 100 << 20}
+        rows = autoscale_from_reports(agg, om)
+        want = autoscale_status(om, pool_bytes={1: 900 << 20,
+                                                2: 100 << 20})
+        assert rows == want
+
+    def test_threshold_validation(self):
+        from ceph_tpu.mgr.pg_autoscaler import recommend_pg_num
+        om = self._two_pool_map()
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            recommend_pg_num(om, 1, threshold=0.5)
+
+
 @pytest.mark.slow   # ~12 s live-backfill cell; nightly (r10)
 def test_cluster_balancer_triggers_pg_temp_backfills():
     # upmap moves on a LIVE cluster repeer into pg_temp backfills and

@@ -1,9 +1,11 @@
-"""rados_bench JSON schema smoke (Round-11 CI satellite): the bench's
-machine-readable output carries the hedge/degraded counters and
-per-tenant percentiles the acceptance numbers are parsed from — this
-pins that schema so a refactor can't silently drop a key CI reads."""
+"""Bench JSON schema smoke (Round-11/12 CI satellite): the benches'
+machine-readable outputs carry the counters the acceptance numbers
+are parsed from — this pins those schemas (and the committed
+SCALE_r12.json artifact) so a refactor can't silently drop a key CI
+reads."""
 
 import json
+import os
 
 from tools import rados_bench
 
@@ -38,3 +40,41 @@ def test_rados_bench_json_schema(capsys):
     # attribution rides along (the r9 discipline): perf deltas exist
     assert "osd_total" in out["perf_delta"]
     assert "client" in out["perf_delta"]
+
+
+REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",
+                  "candidates_per_s", "score_elapsed_s", "elapsed_s",
+                  "max_dev_before", "max_dev_after", "spread_before",
+                  "spread_after", "budget", "budget_used", "converged"}
+
+
+def test_scale_sim_schema_and_acceptance_pinned():
+    """The committed 10k-OSD / 1M-PG scale-sim artifact (r12): schema
+    keys the docs/CI parse, plus the acceptance floors — balancer
+    candidate throughput, 2x-imbalance convergence under budget, and
+    the delta-vs-full wire-cost bound for single-OSD churn."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "SCALE_r12.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "scale_sim_r12/1"
+    main = data["cells"]["scale_main"]
+    for k in ("osds", "pg_num", "initial_map_launch_s",
+              "placements_per_s", "churn_single_osd", "expansion",
+              "failure", "rebalance", "follower_epoch", "inc_steps"):
+        assert k in main, k
+    assert main["osds"] == 10000 and main["pg_num"] == 1 << 20
+    assert REBALANCE_KEYS <= set(main["rebalance"])
+    for k in ("convergence_s", "upmap_pgs", "fraction_moved"):
+        assert k in main["rebalance"], k
+    bal2x = data["cells"]["balancer_2x"]
+    assert REBALANCE_KEYS <= set(bal2x)
+    for k in ("load_before_min", "load_before_max",
+              "budget_respected", "convergence_s"):
+        assert k in bal2x, k
+    acc = data["acceptance"]
+    assert acc["candidates_per_s"] >= 100_000
+    assert acc["balancer_2x_max_dev_after"] <= 1.0
+    assert acc["balancer_2x_converged"]
+    assert acc["balancer_2x_budget_respected"]
+    assert acc["single_osd_inc_to_full_ratio"] <= 0.05

@@ -1,0 +1,192 @@
+"""Placement-plane tests (r12): the device-batched balancer against
+the scalar oracle, movement budgets, failure-domain safety, and the
+scale-sim pipeline (tier-1 representative at small scale; the
+10k-OSD / 1M-PG cells are `slow` — their committed numbers live in
+SCALE_r12.json)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.map import (CRUSH_ITEM_NONE, build_hierarchy,
+                                replicated_rule)
+from ceph_tpu.mgr.balancer import calc_pg_upmaps, device_load
+from ceph_tpu.mgr.placement import (apply_upmaps_to_raw,
+                                    batch_calc_pg_upmaps,
+                                    chunked_pgs_to_raw, osd_domains)
+from ceph_tpu.osd.osdmap import OSDMap, PGPool
+
+
+# one CrushMap + compiled VectorMapper per topology, shared across
+# tests: each OSDMap otherwise compiles its own XLA program for the
+# identical rule (the per-instance _jitted cache), and this file
+# would spend minutes re-tracing the same map
+_TOPO_CACHE: dict = {}
+
+
+def make_map(n_osds=16, pg_num=128, size=3, osds_per_host=2):
+    key = (n_osds, osds_per_host)
+    if key not in _TOPO_CACHE:
+        m = build_hierarchy(n_osds, osds_per_host=osds_per_host,
+                            hosts_per_rack=4)
+        replicated_rule(m, 1, choose_type=1, firstn=True)
+        _TOPO_CACHE[key] = (m, None)
+    m, vm = _TOPO_CACHE[key]
+    om = OSDMap(m)
+    if vm is None:
+        _TOPO_CACHE[key] = (m, om._vm)
+    else:
+        om._vm = vm
+    om.add_pool(PGPool(1, pg_num=pg_num, size=size, min_size=2,
+                       crush_rule=1))
+    return om
+
+
+class TestBatchBalancer:
+    def test_converges_and_counts(self):
+        om = make_map()
+        before = device_load(om, 1)
+        res = batch_calc_pg_upmaps(om, 1, max_deviation=1)
+        after = device_load(om, 1)
+        assert after.sum() == before.sum()      # no shard lost
+        in_mask = np.asarray(om.osd_weight) > 0
+        assert int(after[in_mask].max() - after[in_mask].min()) <= 1
+        assert res.converged
+        assert res.candidates_scored > 0
+        assert len(res.moves) == res.budget_used == len(
+            [m for m in res.moves])
+        # the proposed dict landed on the map as ONE epoch
+        assert res.proposed.keys() <= set(om.pg_upmap_items)
+
+    def test_movement_budget_respected(self):
+        om = make_map()
+        res = batch_calc_pg_upmaps(om, 1, max_deviation=0,
+                                   max_movement=3)
+        assert res.budget_used <= 3
+        assert len(res.moves) <= 3
+        assert len(om.pg_upmap_items) <= 3
+
+    def test_domain_separation_survives(self):
+        om = make_map()
+        batch_calc_pg_upmaps(om, 1, max_deviation=1)
+        up = np.asarray(om.pgs_to_up(1))
+        hosts = np.where(up == CRUSH_ITEM_NONE, -1, up // 2)
+        for row in hosts:
+            real = row[row >= 0]
+            assert len(set(real.tolist())) == len(real)
+
+    def test_down_but_in_osd_never_a_target(self):
+        om = make_map()
+        om.mark_down(3)
+        batch_calc_pg_upmaps(om, 1, max_deviation=1)
+        for items in om.pg_upmap_items.values():
+            assert all(t != 3 for _, t in items)
+        assert not (np.asarray(om.pgs_to_up(1)) == 3).any()
+
+    def test_weight_proportional_targets(self):
+        om = make_map()
+        om.mark_in(0, weight=0.25)
+        batch_calc_pg_upmaps(om, 1, max_deviation=1)
+        load = device_load(om, 1)
+        assert load[0] < 0.6 * load[1:].mean(), load[:4]
+
+    def test_matches_scalar_oracle_quality(self):
+        """Batched and scalar runs of the same imbalanced map both
+        converge; the batched result is at least as balanced."""
+        om_b, om_s = make_map(), make_map()
+        res = batch_calc_pg_upmaps(om_b, 1, max_deviation=1)
+        calc_pg_upmaps(om_s, 1, max_deviation=1,
+                       max_optimizations=256)
+        lb, ls = device_load(om_b, 1), device_load(om_s, 1)
+        assert (lb.max() - lb.min()) <= max(ls.max() - ls.min(), 1)
+        assert res.spread_after <= res.spread_before
+
+
+class TestBitExactness:
+    def test_batched_pipeline_pins_scalar_with_all_overrides(self):
+        """The r12 guard: batched balancer placements and upmap
+        application pinned against scalar pg_to_up_acting_osds on a
+        pool carrying upmaps, pg_temp AND primary_temp."""
+        om = make_map()
+        # pre-existing operator state: pg_temp + primary_temp + a
+        # manual upmap, all live through the balancer run
+        om.set_pg_temp((1, 2), [5, 8, 11])
+        om.set_primary_temp((1, 2), 8)
+        up0 = om.pg_to_up_acting_osds(1, 0)[0]
+        to = next(o for o in range(16) if o not in up0
+                  and o // 2 not in {x // 2 for x in up0})
+        om.set_pg_upmap_items((1, 0), [(up0[1], to)])
+        res = batch_calc_pg_upmaps(om, 1, max_deviation=1)
+        # the balancer's effective view == a fresh batched launch
+        raw = chunked_pgs_to_raw(om, 1)
+        eff = apply_upmaps_to_raw(raw, 1, om.pg_upmap_items)
+        assert (np.asarray(om.pgs_to_up(1)) == eff).all()
+        # batched == scalar for every PG, up AND acting
+        up_b = np.asarray(om.pgs_to_up(1))
+        act_b = np.asarray(om.pgs_to_acting(1))
+        for ps in range(128):
+            up, upp, acting, actp = om.pg_to_up_acting_osds(1, ps)
+            assert up_b[ps].tolist() == up, ps
+            assert act_b[ps].tolist() == acting, ps
+        # overrides survived (balancer must not clobber pg_temp)
+        assert om.pg_temp[(1, 2)] == [5, 8, 11]
+        assert om.primary_temp[(1, 2)] == 8
+        assert res.rounds >= 0
+
+    def test_chunked_raw_matches_monolithic(self):
+        om = make_map(pg_num=128)
+        mono = om.pgs_to_raw(1)
+        chunked = chunked_pgs_to_raw(om, 1, chunk=32)
+        assert (mono == chunked).all()
+
+    def test_osd_domains_matches_scalar_walk(self):
+        from ceph_tpu.mgr.balancer import _domain_of
+        om = make_map()
+        dom = osd_domains(om.crush, 1, 16)
+        cache = {}
+        for o in range(16):
+            assert dom[o] == _domain_of(om.crush, o, 1, cache)
+
+
+class TestScaleSimRepresentative:
+    def test_quick_pipeline_and_schema(self):
+        """Tier-1 representative (<=1k OSDs) of the 1M-PG scale-sim:
+        the REAL expansion + failure + rebalance pipeline over the
+        real balancer and incremental maps, plus the JSON schema the
+        committed SCALE_r12.json is parsed by."""
+        import sys
+        sys.path.insert(0, ".")
+        from tools import scale_sim
+        out = scale_sim.run_scenario(n_osds=64, pg_num=256, spare=8,
+                                     fail=2, chunk=256, budget=64,
+                                     log=lambda *a: None)
+        assert out["rebalance"]["budget_used"] <= 64
+        assert out["rebalance"]["candidates_scored"] > 0
+        # delta pipeline held state equality the whole way
+        assert out["inc_steps"] >= 2 * 2 + 3
+        assert out["churn_single_osd"]["inc_to_full_ratio"] < 0.05
+        assert 0 <= out["expansion"]["fraction_moved"] <= 1
+        assert 0 <= out["failure"]["fraction_moved"] <= 1
+        # cell schema (what test_bench_schema pins on the artifact)
+        for k in ("initial_map_launch_s", "placements_per_s",
+                  "churn_single_osd", "expansion", "failure",
+                  "rebalance", "follower_epoch", "inc_steps"):
+            assert k in out, k
+        bal = scale_sim.run_balancer_2x(n_osds=32, pg_num=256,
+                                        budget=512, chunk=256,
+                                        log=lambda *a: None)
+        assert bal["budget_respected"]
+        assert bal["load_before_max"] > bal["load_before_min"]
+
+
+@pytest.mark.slow   # ~8 min 10k-OSD / 1M-PG cell; nightly — the
+#                     committed numbers live in SCALE_r12.json (r12)
+def test_scale_sim_full_cell():
+    import sys
+    sys.path.insert(0, ".")
+    from tools import scale_sim
+    out = scale_sim.run_scenario(n_osds=10000, pg_num=1 << 20,
+                                 spare=512, fail=8, chunk=1 << 16,
+                                 budget=65536, log=lambda *a: None)
+    assert out["churn_single_osd"]["inc_to_full_ratio"] <= 0.05
+    assert out["rebalance"]["budget_used"] <= 65536
+    assert out["rebalance"]["candidates_per_s"] >= 100_000

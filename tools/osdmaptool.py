@@ -98,9 +98,23 @@ def cmd_upmap(om, args) -> None:
     if pool_id not in om.pools:
         raise SystemExit(f"osdmaptool: no pool {pool_id}")
     before = device_load(om, pool_id)
-    moves = calc_pg_upmaps(om, pool_id,
-                           max_deviation=args.upmap_deviation,
-                           max_optimizations=args.upmap_max)
+    if args.upmap_mode == "batch":
+        from ceph_tpu.mgr.placement import batch_calc_pg_upmaps
+        res = batch_calc_pg_upmaps(
+            om, pool_id, max_deviation=args.upmap_deviation,
+            max_movement=(args.upmap_budget
+                          if args.upmap_budget is not None
+                          else args.upmap_max))
+        moves = res.moves
+        print(f"osdmaptool: batch balancer: {res.rounds} round(s), "
+              f"{res.candidates_scored} candidates scored "
+              f"({res.candidates_per_s:,.0f}/s), max deviation "
+              f"{res.max_dev_before:.1f} -> {res.max_dev_after:.1f}, "
+              f"converged={res.converged}")
+    else:
+        moves = calc_pg_upmaps(om, pool_id,
+                               max_deviation=args.upmap_deviation,
+                               max_optimizations=args.upmap_max)
     after = device_load(om, pool_id)
     # one command per PG from the map's FINAL upmap state: the real
     # `ceph osd pg-upmap-items` REPLACES a PG's whole item list, so
@@ -139,7 +153,16 @@ def main(argv=None) -> None:
     ap.add_argument("--upmap", metavar="OUT",
                     help="compute balancer upmaps; write commands here")
     ap.add_argument("--upmap-deviation", type=int, default=1)
-    ap.add_argument("--upmap-max", type=int, default=100)
+    ap.add_argument("--upmap-max", type=int, default=100,
+                    help="cap on upmap moves (both modes)")
+    ap.add_argument("--upmap-mode", choices=("batch", "scalar"),
+                    default="batch",
+                    help="batch = device-batched balancer (one "
+                    "vectorized CRUSH launch, r12); scalar = the "
+                    "per-PG oracle")
+    ap.add_argument("--upmap-budget", type=int, default=None,
+                    help="batch mode data-movement budget in PG "
+                    "shards (default: --upmap-max)")
     ap.add_argument("--save", action="store_true",
                     help="write the modified map back to mapfile")
     args = ap.parse_args(argv)

@@ -1,0 +1,333 @@
+"""scale_sim — cluster-scale placement scenario, no I/O (r12).
+
+Builds a 10k-OSD / 1M-PG cluster map, then runs expansion, OSD
+failure, and rebalance rounds through the REAL placement plane: the
+vectorized CRUSH mapper (chunked device launches), the device-batched
+balancer (mgr/placement.py), and the incremental-OSDMap pipeline
+(every epoch is diffed, encoded, decoded, and applied onto a follower
+map that must stay state-identical to the leader). Emits convergence
+time, upmap count, fraction-of-data-moved, and delta-vs-full map
+byte metrics:
+
+  JAX_PLATFORMS=cpu python tools/scale_sim.py --out SCALE_r12.json
+  JAX_PLATFORMS=cpu python tools/scale_sim.py --quick      # <=1k OSDs
+
+The scenario family this opens is "heavy traffic at scale" WITHOUT
+real I/O at that scale: rebalancing is a data-movement-budget problem
+(the repair-traffic pressure of PAPERS.md arxiv 1309.0186), so the
+metrics that matter are shards moved, bytes shipped per epoch, and
+time to converge — all measurable from maps alone.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "scale_sim_r12/1"
+
+
+def _imports():
+    from ceph_tpu.crush.map import CRUSH_ITEM_NONE, build_hierarchy, \
+        replicated_rule
+    from ceph_tpu.mgr.placement import (apply_upmaps_to_raw,
+                                        batch_calc_pg_upmaps,
+                                        chunked_pgs_to_raw)
+    from ceph_tpu.osd.osdmap import Incremental, OSDMap, PGPool, \
+        same_state
+    return locals()
+
+
+def build_cluster(n_osds: int, pg_num: int, size: int = 3,
+                  osds_per_host: int = 8, hosts_per_rack: int = 16,
+                  spare: int = 0, heavy_half: bool = False):
+    """Cluster map with `spare` extra devices present in CRUSH but
+    weighted out (expansion = weighting them in — the reweight-driven
+    expansion an operator runs). heavy_half doubles the crush weight
+    of the first half of the devices: the contrived 2x-imbalanced
+    pool when paired with uniform reweight targets."""
+    I = _imports()
+    m = I["build_hierarchy"](n_osds + spare, osds_per_host,
+                             hosts_per_rack)
+    if heavy_half:
+        half = n_osds // 2
+        for b in m.buckets.values():
+            if b.type_id == 1:
+                for i, it in enumerate(b.items):
+                    if it < half:
+                        b.weights[i] = 2 * 0x10000
+        for lvl in (2, 3):
+            for b in m.buckets.values():
+                if b.type_id == lvl:
+                    b.weights = [m.buckets[c].weight for c in b.items]
+        m._packed = None
+    I["replicated_rule"](m, 0, choose_type=1, firstn=True)
+    om = I["OSDMap"](m)
+    om.add_pool(I["PGPool"](1, pg_num=pg_num, size=size,
+                            min_size=max(1, size - 1), crush_rule=0))
+    if spare:
+        om.osd_weight[n_osds:] = 0
+        om._bump()
+    return om
+
+
+class IncPipe:
+    """The incremental-map wire pipeline: every leader epoch is
+    diffed against the previous one, encoded, decoded, and applied
+    onto a follower map; the follower must stay state-identical —
+    the property the wire tier's delta fan-out rests on."""
+
+    def __init__(self, leader):
+        self.I = _imports()
+        self._prev = leader.shallow_clone()
+        self.follower = leader.shallow_clone()
+        self.steps: list[dict] = []
+
+    def step(self, leader, label: str, measure_full: bool = True) -> dict:
+        inc = self.I["Incremental"].diff(self._prev, leader)
+        blob = inc.encode()
+        self.follower = self.I["Incremental"].decode(blob).apply(
+            self.follower)
+        if not self.I["same_state"](self.follower, leader):
+            raise AssertionError(f"follower diverged at {label} "
+                                 f"epoch {leader.epoch}")
+        rec = {"label": label, "epoch": leader.epoch,
+               "inc_bytes": len(blob)}
+        if measure_full:
+            rec["full_map_bytes"] = len(leader.encode())
+            rec["inc_to_full_ratio"] = round(
+                rec["inc_bytes"] / rec["full_map_bytes"], 5)
+        self.steps.append(rec)
+        self._prev = leader.shallow_clone()
+        return rec
+
+
+def _eff_up(I, om, raw):
+    """pgs_to_up equivalent from a precomputed raw array: upmap
+    overlay + down-holes (NONE), without a fresh CRUSH launch."""
+    eff = I["apply_upmaps_to_raw"](raw, 1, om.pg_upmap_items)
+    none = np.int32(I["CRUSH_ITEM_NONE"])
+    n = len(om.osd_up)
+    down = ~np.asarray(om.osd_up)
+    idx = np.clip(eff, 0, n - 1)
+    return np.where((eff != none) & down[idx], none, eff)
+
+
+def _fraction_moved(before_up, after_up) -> float:
+    return float((before_up != after_up).mean())
+
+
+def run_scenario(n_osds: int, pg_num: int, spare: int, fail: int,
+                 chunk: int, budget: int | None,
+                 log=print) -> dict:
+    """Expansion + failure + rebalance through the real pipeline."""
+    I = _imports()
+    out: dict = {"osds": n_osds, "pg_num": pg_num, "spare": spare,
+                 "failed": fail}
+    om = build_cluster(n_osds, pg_num, spare=spare)
+    pipe = IncPipe(om)
+
+    t0 = time.monotonic()
+    raw0 = I["chunked_pgs_to_raw"](om, 1, chunk)
+    t_map = time.monotonic() - t0
+    up0 = _eff_up(I, om, raw0)
+    out["initial_map_launch_s"] = round(t_map, 2)
+    out["placements_per_s"] = round(pg_num / t_map, 1)
+    log(f"mapped {pg_num} PGs x size {om.pools[1].size} in "
+        f"{t_map:.1f}s ({pg_num / t_map:,.0f} pg/s)")
+
+    # -- single-OSD churn: the per-epoch wire-cost acceptance cell --
+    om.mark_down(n_osds - 1)
+    churn = pipe.step(om, "single_osd_down")
+    om.mark_up(n_osds - 1)
+    pipe.step(om, "single_osd_up", measure_full=False)
+    out["churn_single_osd"] = churn
+    log(f"single-OSD churn: {churn['inc_bytes']} inc bytes vs "
+        f"{churn['full_map_bytes']} full "
+        f"({100 * churn['inc_to_full_ratio']:.3f}%)")
+
+    # -- expansion: weight the spare devices in (one admin epoch) --
+    om.osd_weight[n_osds:n_osds + spare] = 0x10000
+    om._bump()
+    exp_rec = pipe.step(om, "expansion")
+    t0 = time.monotonic()
+    raw1 = I["chunked_pgs_to_raw"](om, 1, chunk)
+    exp_launch = time.monotonic() - t0
+    up1 = _eff_up(I, om, raw1)
+    out["expansion"] = {
+        "added_osds": spare, "inc_bytes": exp_rec["inc_bytes"],
+        "full_map_bytes": exp_rec.get("full_map_bytes"),
+        "fraction_moved": round(_fraction_moved(up0, up1), 5),
+        "map_launch_s": round(exp_launch, 2),
+    }
+    log(f"expansion +{spare}: moved "
+        f"{out['expansion']['fraction_moved']:.2%} of shards, "
+        f"inc {exp_rec['inc_bytes']}B")
+
+    # -- failure: mark a host's worth of OSDs down, then out --
+    victims = list(range(0, fail))
+    for o in victims:
+        om.mark_down(o)
+        pipe.step(om, f"osd.{o} down", measure_full=False)
+    for o in victims:
+        om.mark_out(o)
+        pipe.step(om, f"osd.{o} out", measure_full=False)
+    t0 = time.monotonic()
+    raw2 = I["chunked_pgs_to_raw"](om, 1, chunk)
+    fail_launch = time.monotonic() - t0
+    up2 = _eff_up(I, om, raw2)
+    fail_inc_bytes = sum(s["inc_bytes"] for s in pipe.steps
+                         if "down" in s["label"] or "out" in s["label"])
+    out["failure"] = {
+        "failed_osds": fail,
+        "inc_epochs": 2 * fail,
+        "inc_bytes_total": fail_inc_bytes,
+        "fraction_moved": round(_fraction_moved(up1, up2), 5),
+        "map_launch_s": round(fail_launch, 2),
+    }
+    log(f"failure x{fail}: moved "
+        f"{out['failure']['fraction_moved']:.2%}, "
+        f"{2 * fail} inc epochs / {fail_inc_bytes}B total")
+
+    # -- rebalance: the device-batched balancer closes the loop --
+    t0 = time.monotonic()
+    # per-round candidate caps scale with the device population: at
+    # 10k OSDs a 64-source round would crawl (5k devices overfull
+    # after a churn), while the (N x U) scoring block stays one launch
+    cap = int(min(512, max(64, n_osds // 20)))
+    res = I["batch_calc_pg_upmaps"](om, 1, max_deviation=1,
+                                    max_movement=budget, chunk=chunk,
+                                    max_src=cap, max_dst=cap,
+                                    raw=raw2)
+    conv_s = time.monotonic() - t0
+    reb_rec = pipe.step(om, "rebalance") if res.moves else None
+    up3 = _eff_up(I, om, raw2)
+    out["rebalance"] = dict(res.to_dict(), convergence_s=round(conv_s, 2),
+                            upmap_pgs=len(res.proposed),
+                            fraction_moved=round(
+                                _fraction_moved(up2, up3), 5),
+                            inc_bytes=(reb_rec or {}).get("inc_bytes"))
+    log(f"rebalance: {len(res.moves)} moves over {res.rounds} rounds "
+        f"in {conv_s:.1f}s, max dev {res.max_dev_before:.1f} -> "
+        f"{res.max_dev_after:.1f}, "
+        f"{res.candidates_per_s:,.0f} candidates/s")
+    out["follower_epoch"] = pipe.follower.epoch
+    out["inc_steps"] = len(pipe.steps)
+    return out
+
+
+def run_balancer_2x(n_osds: int, pg_num: int, budget: int,
+                    chunk: int, log=print) -> dict:
+    """The contrived 2x-imbalanced pool: half the devices carry double
+    CRUSH weight while reweight targets stay uniform — the balancer
+    must converge it to max deviation <= 1 inside the movement
+    budget."""
+    I = _imports()
+    om = build_cluster(n_osds, pg_num, heavy_half=True)
+    raw = I["chunked_pgs_to_raw"](om, 1, chunk)
+    up = _eff_up(I, om, raw)
+    flat = up[up != np.int32(I["CRUSH_ITEM_NONE"])]
+    load0 = np.bincount(flat, minlength=n_osds)
+    cap = int(min(512, max(64, n_osds // 2)))
+    t0 = time.monotonic()
+    res = I["batch_calc_pg_upmaps"](om, 1, max_deviation=1,
+                                    max_movement=budget, raw=raw,
+                                    chunk=chunk, max_src=cap,
+                                    max_dst=cap)
+    conv_s = time.monotonic() - t0
+    out = dict(res.to_dict(), convergence_s=round(conv_s, 2),
+               load_before_min=int(load0.min()),
+               load_before_max=int(load0.max()),
+               budget_respected=bool(
+                   budget is None or res.budget_used <= budget))
+    log(f"2x cell: load {load0.min()}..{load0.max()} -> max dev "
+        f"{res.max_dev_after:.1f} in {len(res.moves)} moves "
+        f"({res.candidates_per_s:,.0f} candidates/s), "
+        f"converged={res.converged}")
+    return out
+
+
+def run(args) -> dict:
+    import jax
+    t_all = time.monotonic()
+    log = (lambda *a: None) if args.json_only else print
+    result = {
+        "schema": SCHEMA,
+        "backend": jax.default_backend(),
+        "config": {"osds": args.osds, "pg_num": args.pg_num,
+                   "spare": args.spare, "fail": args.fail,
+                   "chunk": args.chunk, "budget": args.budget,
+                   "quick": bool(args.quick)},
+        "cells": {
+            "scale_main": run_scenario(args.osds, args.pg_num,
+                                       args.spare, args.fail,
+                                       args.chunk, args.budget, log),
+            "balancer_2x": run_balancer_2x(args.osds_2x, args.pg_num_2x,
+                                           args.budget_2x, args.chunk,
+                                           log),
+        },
+    }
+    main, bal2x = result["cells"]["scale_main"], \
+        result["cells"]["balancer_2x"]
+    result["acceptance"] = {
+        "candidates_per_s": max(
+            main["rebalance"]["candidates_per_s"],
+            bal2x["candidates_per_s"]),
+        "balancer_2x_max_dev_after": bal2x["max_dev_after"],
+        "balancer_2x_converged": bal2x["converged"],
+        "balancer_2x_budget_respected": bal2x["budget_respected"],
+        "single_osd_inc_to_full_ratio":
+            main["churn_single_osd"]["inc_to_full_ratio"],
+    }
+    result["elapsed_s"] = round(time.monotonic() - t_all, 1)
+    return result
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--osds", type=int, default=10000)
+    ap.add_argument("--pg-num", type=int, default=1 << 20)
+    ap.add_argument("--spare", type=int, default=512,
+                    help="devices weighted in by the expansion round")
+    ap.add_argument("--fail", type=int, default=8,
+                    help="devices the failure round kills")
+    ap.add_argument("--chunk", type=int, default=1 << 16,
+                    help="PG lanes per device launch (one compiled "
+                    "program shape serves the whole pool)")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="rebalance data-movement budget in shards")
+    ap.add_argument("--osds-2x", type=int, default=512)
+    ap.add_argument("--pg-num-2x", type=int, default=1 << 15)
+    ap.add_argument("--budget-2x", type=int, default=1 << 15)
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1 representative scale (<=1k OSDs)")
+    ap.add_argument("--out", default=None, metavar="JSON")
+    ap.add_argument("--json-only", action="store_true")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.osds, args.pg_num = 256, 1 << 11
+        args.spare, args.fail, args.chunk = 16, 2, 1 << 11
+        args.osds_2x, args.pg_num_2x = 64, 1 << 11
+        args.budget_2x = 1 << 11
+    result = run(args)
+    text = json.dumps(result, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        if not args.json_only:
+            print(f"scale_sim: wrote {args.out}")
+    if args.json_only or not args.out:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()
