@@ -88,10 +88,14 @@ KNOBS: dict[str, tuple[int, str]] = {
 }
 
 
-def repro_command(seed: int, store: str, rounds: int, ops: int) -> str:
+def repro_command(seed: int, store: str, rounds: int, ops: int,
+                  op_shards: int = 1) -> str:
     """The one-command local reproduction for a failing cell."""
-    return (f"python tools/thrash.py --seed {seed} --store {store} "
-            f"--rounds {rounds} --ops {ops}")
+    cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
+           f"--rounds {rounds} --ops {ops}")
+    if op_shards != 1:
+        cmd += f" --op-shards {op_shards}"
+    return cmd
 
 
 class InvariantViolation(AssertionError):
@@ -110,7 +114,8 @@ class Thrasher:
     def __init__(self, seed: int, store: str = "mem", rounds: int = 2,
                  ops: int = 6, n_osds: int = 4, pg_num: int = 2,
                  store_dir: str | None = None, verbose: bool = False,
-                 read_during_faults: bool = False):
+                 read_during_faults: bool = False,
+                 op_shards: int = 1):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -125,6 +130,10 @@ class Thrasher:
         # so the seed-pinned matrix cells keep their timing profile.
         self.read_during_faults = read_during_faults
         self.degraded_read_checks = 0
+        # r13: osd_op_num_shards under chaos — ops hash by PG to
+        # per-shard mClock queues; the exactly-once/no-resurrection
+        # invariants must hold under sharded dispatch too
+        self.op_shards = int(op_shards)
         # deadline scaling, NOT schedule input: the RNG stream never
         # sees it, so a seed replays identically on an idle box
         self.load = load_factor()
@@ -137,7 +146,8 @@ class Thrasher:
         self.dead_mons: set[int] = set()
         self.schedule: list[str] = []        # the replayable fault log
         self._obj_i = 0
-        self.repro = repro_command(self.seed, store, rounds, ops)
+        self.repro = repro_command(self.seed, store, rounds, ops,
+                                    op_shards=self.op_shards)
         self.c = None
         self.cl = None
 
@@ -178,7 +188,7 @@ class Thrasher:
         self.c = StandaloneCluster(
             n_osds=self.n_osds, pg_num=self.pg_num, store=self.store,
             store_dir=self.store_dir, cephx=True, secret=secret,
-            op_timeout=6.0,
+            op_timeout=6.0, op_shards=self.op_shards,
             # a loaded host stretches every ping round trip: scale the
             # grace with the observed load so CPU starvation doesn't
             # read as daemon death (the [41-tin] full-suite flake)

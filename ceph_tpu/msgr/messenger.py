@@ -47,13 +47,41 @@ and correctness-first. Implemented faithfully:
   and in secure mode the negotiated byte is bound into the auth
   proof so an active tamperer cannot strip it.
 
-Threading model: one reader thread per connection + locked writers
-(the reference runs epoll worker threads; blocking threads keep this
-deterministic and dependency-free).
+Threading model (ref: src/msg/async/Stack.h Worker/NetworkStack —
+the AsyncMessenger epoll worker pool): N REACTOR worker threads per
+messenger, each running a `selectors` (epoll on Linux) event loop.
+Connections are bound to a reactor ROUND-ROBIN at handshake
+completion (accept and dial alike) and stay there for life — all of a
+connection's socket I/O happens on its one reactor, so per-connection
+frame order needs no cross-thread coordination. The contract:
+
+* READS are nonblocking and batched: one wakeup drains the socket
+  into a per-connection buffer and parses every complete frame in it
+  (wire format identical to the blocking era — the frame bytes are
+  pinned bit-for-bit by tests/test_msgr_frames.py).
+* WRITES go through a per-connection WRITE QUEUE: send_frame seals/
+  CRCs the frame (in queue order, under the connection write lock —
+  nonce counters never reorder) and appends the iovec; whoever holds
+  the lock gather-flushes the whole queue in ONE sendmsg (many frames
+  per syscall). A socket that won't drain arms EVENT_WRITE and the
+  reactor resumes from the exact byte. Senders block on a byte-budget
+  backpressure cap (never reactor threads — they may hold frames
+  other connections are waiting on).
+* DISPATCH is fast by default (the ms_fast_dispatch role): handlers
+  run inline on the reactor, so they must never wait for another
+  frame of the SAME messenger to make progress. Handlers that block
+  on remote replies (the OSD's map fold runs a whole reconcile)
+  register with fast=False and run on the messenger's dispatch
+  thread instead — a reactor never blocks, so rpc replies always
+  drain even while a slow handler is mid-flight.
+* A standalone _Conn with no reactor (the frame-capture tests, the
+  handshake window before binding) falls back to blocking writes —
+  same bytes, same order.
 """
 
 from __future__ import annotations
 
+import selectors
 import socket
 import struct
 import threading
@@ -90,6 +118,26 @@ def msgr_perf_counters():
             .add_time_avg("seal_time",
                           "AEAD seal incl. staging (secure mode)")
             .add_time_avg("open_time", "AEAD open (secure mode)")
+            # reactor event-loop occupancy (the AsyncMessenger worker
+            # counters: msgr_active_connections / worker event time)
+            .add_u64_counter("reactor_loops",
+                             "reactor loop iterations (select returns)")
+            .add_u64_counter("reactor_wakeups",
+                             "loop wakeups forced by the wake pipe "
+                             "(cross-thread register/arm-write)")
+            .add_time_avg("reactor_stall_time",
+                          "time per loop iteration spent OUT of "
+                          "select (dispatch + flush = loop lag for "
+                          "concurrent events)")
+            .add_u64("writeq_depth",
+                     "bytes queued across connection write queues")
+            .add_u64_counter("writeq_flushes",
+                             "gather-flush sendmsg calls")
+            .add_u64_counter("writeq_stalls",
+                             "sends that blocked on the write-queue "
+                             "byte budget")
+            .add_time_avg("writeq_stall_time",
+                          "backpressure wait per stalled send")
             .create_perf_counters())
 
 BANNER = b"ceph_tpu msgr v2\n"
@@ -273,6 +321,19 @@ def _payload_len(payload) -> int:
     return len(payload)
 
 
+def _set_nodelay(sock: socket.socket) -> None:
+    if sock.family == socket.AF_INET:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # generous kernel buffers: a 512 KiB batched write frame should
+    # leave in ONE sendmsg, not ping-pong through EAGAIN/arm-write
+    # reactor cycles against the ~208 KiB default
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, 1 << 20)
+        except OSError:
+            pass
+
+
 def _sendmsg_all(sock: socket.socket, parts: list) -> None:
     """Gather-write the iovec fully (sendmsg may send partially under
     pressure; resume from the exact byte like sendall would)."""
@@ -289,6 +350,139 @@ def _sendmsg_all(sock: socket.socket, parts: list) -> None:
                 views[0] = views[0][sent:]
                 sent = 0
         sent = sock.sendmsg(views)
+
+
+#: reactor threads must never block on another connection's write
+#: budget (they may hold frames that connection is waiting on): the
+#: loop marks itself and _enqueue skips the backpressure wait
+_TLS = threading.local()
+
+#: per-connection write-queue byte budget: senders beyond it block
+#: until the reactor drains below half (the ms write-queue throttle
+#: role). Generous — the op window bounds steady state well below it.
+_WQ_HIGH = 16 << 20
+#: max iovec parts per gather-flush sendmsg (IOV_MAX headroom)
+_WQ_IOV = 512
+
+
+class _Reactor(threading.Thread):
+    """One epoll worker (ref: src/msg/async/EventCenter): owns a
+    selector; every registered socket's events are handled on this
+    thread. Cross-thread mutations (register, arm-write, close) are
+    marshalled through call() + a wake pipe — the selector itself is
+    touched only from the loop."""
+
+    def __init__(self, name: str, perf=None):
+        super().__init__(daemon=True, name=name)
+        self.sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self.sel.register(self._wake_r, selectors.EVENT_READ, None)
+        self._calls: deque = deque()
+        self._clock = threading.Lock()
+        self._stopping = False
+        self.perf = perf
+        self._owned: set = set()     # sockets to close at stop
+        self.start()
+
+    # -- cross-thread surface ------------------------------------------------
+
+    def call(self, fn) -> None:
+        """Run fn() on the reactor thread (next loop iteration)."""
+        with self._clock:
+            self._calls.append(fn)
+        self.wakeup()
+
+    def wakeup(self) -> None:
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass                      # pipe full = already waking
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.wakeup()
+
+    # -- loop-thread surface -------------------------------------------------
+
+    def register(self, sock: socket.socket, events: int, cb) -> None:
+        """cb(mask) is invoked on this thread for every event."""
+        self._owned.add(sock)
+        try:
+            self.sel.register(sock, events, cb)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def unregister(self, sock: socket.socket) -> None:
+        self._owned.discard(sock)
+        try:
+            self.sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def set_events(self, sock: socket.socket, events: int) -> None:
+        try:
+            key = self.sel.get_key(sock)
+            if key.events != events:
+                self.sel.modify(sock, events, key.data)
+        except (KeyError, ValueError, OSError):
+            pass                      # unregistered/closed meanwhile
+
+    def run(self) -> None:
+        _TLS.in_reactor = True
+        perf = self.perf
+        while not self._stopping:
+            try:
+                events = self.sel.select(timeout=0.5)
+            except OSError:
+                if self._stopping:
+                    break
+                continue
+            t0 = _time_mod.perf_counter()
+            woke = 0
+            for key, mask in events:
+                if key.data is None:          # the wake pipe
+                    woke = 1
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                try:
+                    key.data(mask)
+                except Exception:   # noqa: BLE001 — one connection's
+                    pass            # failure must not kill the loop
+            while True:
+                with self._clock:
+                    if not self._calls:
+                        break
+                    fn = self._calls.popleft()
+                try:
+                    fn()
+                except Exception:   # noqa: BLE001
+                    pass
+            if perf is not None:
+                perf.inc_many((("reactor_loops", 1),
+                               ("reactor_wakeups", woke)))
+                perf.tinc("reactor_stall_time",
+                          _time_mod.perf_counter() - t0)
+        for sock in list(self._owned):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            self.sel.unregister(self._wake_r)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            self.sel.close()
+            self._wake_r.close()
+            self._wake_w.close()
+        except OSError:
+            pass
 
 
 class _Conn:
@@ -314,15 +508,26 @@ class _Conn:
         self.stats_lock = stats_lock or threading.Lock()
         # which peer INCARNATION this conn authenticated: frames from
         # a conn whose incarnation is no longer current must never
-        # reach the session state (see _read_loop)
+        # reach the session state (see _on_frame)
         self.peer_inst = peer_inst
+        # reactor binding (None = standalone blocking writes — the
+        # pre-handshake window and the frame-capture test harness)
+        self.reactor: _Reactor | None = None
+        self._rx = bytearray()      # unparsed inbound bytes
+        self._wq: deque = deque()   # outbound iovec parts, wire-ready
+        self._wq_bytes = 0
+        self._wcond = threading.Condition(self.wlock)
+        self._write_armed = False
+        self._closed = False
 
     def send_frame(self, seq: int, type_id: int, payload) -> None:
         """`payload` is bytes-like OR a segment list (Encoder.segments
         output). Wire bytes are bit-identical either way; the list form
         never copies the payload in crc mode (gather-write + running
         CRC), and stages exactly one contiguous buffer in secure/
-        compressed mode (the seal/deflate input)."""
+        compressed mode (the seal/deflate input). With a reactor bound
+        the frame is QUEUED (sealed/CRCed in queue order) and flushed
+        opportunistically — many frames coalesce into one sendmsg."""
         segs = list(payload) if isinstance(payload, (list, tuple)) \
             else [payload]
         plen = sum(len(s) for s in segs)
@@ -350,7 +555,10 @@ class _Conn:
                 self.perf.tinc("crc_time",
                                _time_mod.perf_counter() - t0)
             with self.wlock:
-                _sendmsg_all(self.sock, [hdr] + segs + [crc])
+                if self.reactor is None:
+                    _sendmsg_all(self.sock, [hdr] + segs + [crc])
+                else:
+                    self._enqueue_locked([hdr] + segs + [crc])
             wire = 14 + plen + 4
             nseg = len(segs)
         else:
@@ -368,7 +576,10 @@ class _Conn:
                 if self.perf is not None:
                     self.perf.tinc("seal_time",
                                    _time_mod.perf_counter() - t0)
-                _sendmsg_all(self.sock, [hdr, sealed])
+                if self.reactor is None:
+                    _sendmsg_all(self.sock, [hdr, sealed])
+                else:
+                    self._enqueue_locked([hdr, sealed])
             wire = 4 + _NONCE + 10 + plen + _GCM_TAG
             nseg = 1
         if self.perf is not None:
@@ -376,13 +587,121 @@ class _Conn:
                                 ("segments_tx", nseg))
                                + ((("acks_tx", 1),) if is_ack else ()))
 
+    # -- write queue (reactor-bound conns) ------------------------------------
+
+    def _enqueue_locked(self, parts: list) -> None:
+        """Append wire-ready parts and flush opportunistically. Caller
+        holds wlock. Blocks on the byte budget — except on reactor
+        threads, which must never wait on another conn's drain."""
+        if not self.alive:
+            raise ConnectionError("connection closed")
+        if (self._wq_bytes > _WQ_HIGH
+                and not getattr(_TLS, "in_reactor", False)):
+            t0 = _time_mod.perf_counter()
+            while self.alive and self._wq_bytes > _WQ_HIGH // 2:
+                self._wcond.wait(0.2)
+            if self.perf is not None:
+                self.perf.inc("writeq_stalls")
+                self.perf.tinc("writeq_stall_time",
+                               _time_mod.perf_counter() - t0)
+            if not self.alive:
+                raise ConnectionError("connection closed")
+        for p in parts:
+            if len(p):
+                self._wq.append(memoryview(p))
+                self._wq_bytes += len(p)
+        self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        """Gather-write as much of the queue as the socket takes (many
+        frames per sendmsg). Caller holds wlock. A full socket arms
+        EVENT_WRITE; the reactor resumes from the exact byte."""
+        while self._wq:
+            iov = []
+            n = 0
+            for v in self._wq:
+                iov.append(v)
+                n += 1
+                if n >= _WQ_IOV:
+                    break
+            try:
+                sent = self.sock.sendmsg(iov)
+            except (BlockingIOError, InterruptedError):
+                self._arm_write_locked()
+                return
+            except OSError:
+                # socket died with frames queued: they are all still
+                # in the sender's unacked queue — replay redelivers
+                # after the reconnect. The reactor reaps the conn.
+                self.alive = False
+                self._wcond.notify_all()
+                if self.reactor is not None:
+                    self.reactor.wakeup()
+                return
+            if self.perf is not None:
+                self.perf.inc("writeq_flushes")
+            self._wq_bytes -= sent
+            while sent:
+                head = self._wq[0]
+                if sent >= len(head):
+                    sent -= len(head)
+                    self._wq.popleft()
+                else:
+                    self._wq[0] = head[sent:]
+                    sent = 0
+        if self._wq_bytes <= _WQ_HIGH // 2:
+            self._wcond.notify_all()
+        if self.perf is not None:
+            self.perf.set("writeq_depth", self._wq_bytes)
+
+    def _arm_write_locked(self) -> None:
+        if self._write_armed or self.reactor is None:
+            return
+        self._write_armed = True
+        r, sock = self.reactor, self.sock
+        r.call(lambda: r.set_events(
+            sock, selectors.EVENT_READ | selectors.EVENT_WRITE))
+
+    def _on_writable(self) -> None:
+        """Reactor: socket drained — flush more, disarm when empty."""
+        with self.wlock:
+            self._flush_locked()
+            if not self._wq and self._write_armed:
+                self._write_armed = False
+                self.reactor.set_events(self.sock,
+                                        selectors.EVENT_READ)
+
     def close(self) -> None:
         self.alive = False
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        self.sock.close()
+        with self.wlock:
+            self._wcond.notify_all()   # unblock backpressured senders
+        r = self.reactor
+        if r is None:
+            self._close_fd()
+        else:
+            # the fd itself closes ON the reactor: closing here would
+            # let the OS reuse the number while the selector still
+            # maps it — events would route to the wrong connection
+            r.call(self._reactor_close)
+
+    def _reactor_close(self) -> None:
+        if self.reactor is not None:
+            self.reactor.unregister(self.sock)
+        self._close_fd()
+
+    def _close_fd(self) -> None:
+        with self.wlock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 class Messenger:
@@ -394,7 +713,9 @@ class Messenger:
 
     def __init__(self, name: str, host: str = "127.0.0.1",
                  secret: bytes | None = None,
-                 compress: str | None = None):
+                 compress: str | None = None,
+                 workers: int | None = None,
+                 uds: bool = False):
         """`secret` switches the endpoint to SECURE mode: every
         connection mutually authenticates against the shared secret
         and encrypts frames with a per-connection AES-GCM key. A
@@ -404,7 +725,14 @@ class Messenger:
         offer the same algorithm (an optimization, so a mismatch
         downgrades to plain rather than refusing); in secure mode the
         negotiated byte is bound into the auth proof so it cannot be
-        tampered down."""
+        tampered down. `workers` sets the reactor thread count (the
+        ms_async_op_threads role; default 1, or
+        $CEPH_TPU_MSGR_WORKERS) — connections bind round-robin.
+        `uds` listens on a Unix-domain socket instead of loopback TCP
+        (same frames, same handshake — only the byte carrier changes;
+        ~2.5x the bulk throughput of the loopback TCP stack on this
+        kernel). The address book carries ("unix", path) tuples, so
+        mixed TCP/UDS endpoints interoperate peer by peer."""
         self.name = name
         self.secret = secret
         self.compress = compress
@@ -459,11 +787,43 @@ class Messenger:
         import random as _random
         self._inject_rng = _random.Random()
         self._stopping = False
-        self._listener = socket.create_server((host, 0))
-        self.addr = self._listener.getsockname()
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
-        self._accept_thread.start()
+        # the reactor pool (ref: AsyncMessenger's Worker threads):
+        # every connection's socket I/O runs on exactly one of these
+        if workers is None:
+            import os as _os
+            workers = int(_os.environ.get("CEPH_TPU_MSGR_WORKERS",
+                                          "1") or 1)
+        self._reactors = [_Reactor(f"msgr-{name}-r{i}", perf=self.perf)
+                          for i in range(max(1, int(workers)))]
+        self._rr = 0                 # round-robin binding cursor
+        self._uds_path = None
+        if uds:
+            import os as _os
+            import tempfile as _tempfile
+            # short path (AF_UNIX caps at ~107 bytes), unique per
+            # incarnation — a revived daemon must not collide with
+            # its corpse's socket file
+            self._uds_path = _os.path.join(
+                _tempfile.gettempdir(),
+                f"cmsgr-{_os.getpid():x}-"
+                f"{self.instance_nonce[:4].hex()}.sock")
+            self._listener = socket.socket(socket.AF_UNIX,
+                                           socket.SOCK_STREAM)
+            self._listener.bind(self._uds_path)
+            self._listener.listen(128)
+            self.addr = ("unix", self._uds_path)
+        else:
+            self._listener = socket.create_server((host, 0))
+            self.addr = self._listener.getsockname()
+        self._listener.setblocking(False)
+        r0 = self._reactors[0]
+        r0.call(lambda: r0.register(self._listener,
+                                    selectors.EVENT_READ,
+                                    self._accept_ready))
+        # slow-dispatch queue (the DispatchQueue role): handlers
+        # registered fast=False run here so a blocking fold can never
+        # stall a reactor. Started lazily with the first slow handler.
+        self._dispatch_q = None
         # delayed-ack flusher: covers frames the inline every-Nth ack
         # didn't reach (see ACK_BATCH); event-driven so an idle
         # messenger sleeps
@@ -474,25 +834,58 @@ class Messenger:
 
     # -- dispatch ------------------------------------------------------------
 
-    def register_handler(self, type_id: int, fn) -> None:
-        """fn(peer_name: str, msg: Message) — ms_fast_dispatch."""
-        self._handlers[type_id] = fn
+    def register_handler(self, type_id: int, fn,
+                         fast: bool = True) -> None:
+        """fn(peer_name: str, msg: Message). `fast` handlers run
+        INLINE on the connection's reactor (ms_fast_dispatch): they
+        must never wait for another frame of this messenger to make
+        progress. Handlers that can block on remote replies (a map
+        fold that runs a reconcile) pass fast=False and run on the
+        messenger's dispatch thread — per-peer order among slow
+        frames is preserved (one FIFO), order RELATIVE to fast frames
+        of the same connection is not (exactly the reference's
+        fast-vs-queued dispatch contract)."""
+        self._handlers[type_id] = (fn, fast)
+        if not fast and self._dispatch_q is None:
+            import queue
+            self._dispatch_q = queue.SimpleQueue()
+            threading.Thread(target=self._dispatch_loop,
+                             daemon=True).start()
+
+    def _dispatch_loop(self) -> None:
+        import queue
+        while not self._stopping:
+            try:
+                fn, peer, cls, payload = self._dispatch_q.get(
+                    timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                fn(peer, cls.decode_payload(Decoder(payload)))
+            except Exception as e:  # noqa: BLE001 — poison message:
+                # already acked; contain the blast radius (same rule
+                # as fast dispatch)
+                from ..utils.log import g_log
+                g_log.dout("msgr", 0,
+                           f"dispatch error from {peer} "
+                           f"type={cls.type_id:#x}: {e!r}")
 
     # -- connection management ----------------------------------------------
 
-    def _accept_loop(self) -> None:
-        import time
+    def _accept_ready(self, mask: int) -> None:
+        """Reactor 0: the listener is readable — accept everything
+        pending; each new socket handshakes on its own (short-lived)
+        thread, then binds to a reactor round-robin."""
         while not self._stopping:
             try:
                 sock, _ = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
             except OSError:
-                if self._stopping:
-                    return
-                # transient failure (e.g. EMFILE): a dead listener
-                # would look exactly like a partition to peers — keep
-                # accepting rather than silently going deaf
-                time.sleep(0.05)
-                continue
+                # transient failure (e.g. EMFILE): the listener stays
+                # registered — the next readable event retries rather
+                # than silently going deaf
+                return
             threading.Thread(target=self._handshake_in, args=(sock,),
                              daemon=True).start()
 
@@ -514,8 +907,9 @@ class Messenger:
             # (header, then payload); coalescing them behind delayed
             # ACKs costs tens of ms PER FRAME on the rpc path (the
             # reference sets TCP_NODELAY on every messenger socket;
-            # ref: AsyncConnection socket options ms_tcp_nodelay)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # ref: AsyncConnection socket options ms_tcp_nodelay).
+            # Unix-domain sockets have no Nagle to disable.
+            _set_nodelay(sock)
             if self._recv_exact(sock, len(BANNER)) != BANNER:
                 sock.close()
                 return
@@ -623,8 +1017,15 @@ class Messenger:
             if conn is not None and conn.alive:
                 return conn  # someone beat us to it
             addr = self._addr_of[peer]
-            sock = socket.create_connection(tuple(addr), timeout=10)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if addr and addr[0] == "unix":
+                sock = socket.socket(socket.AF_UNIX,
+                                     socket.SOCK_STREAM)
+                sock.settimeout(10)
+                sock.connect(addr[1])
+            else:
+                sock = socket.create_connection(tuple(addr),
+                                                timeout=10)
+            _set_nodelay(sock)
             sock.sendall(BANNER)
             name_b = self.name.encode()
             sock.sendall(struct.pack("<H", len(name_b)) + name_b
@@ -729,9 +1130,24 @@ class Messenger:
             return False
         if old is not None and old is not conn:
             old.close()
-        threading.Thread(target=self._read_loop, args=(peer, conn),
-                         daemon=True).start()
+        self._bind_reactor(peer, conn)
         return True
+
+    def _bind_reactor(self, peer: str, conn: _Conn) -> None:
+        """Bind the handshaken connection to a reactor (round-robin —
+        the AsyncMessenger accept-time worker assignment) and start
+        event-driven reads. The socket goes nonblocking here; the
+        blocking handshake is over."""
+        with self._lock:
+            r = self._reactors[self._rr % len(self._reactors)]
+            self._rr += 1
+        conn.reactor = r
+        conn.sock.setblocking(False)
+
+        def _cb(mask: int, peer=peer, conn=conn) -> None:
+            self._conn_event(peer, conn, mask)
+        r.call(lambda: r.register(conn.sock, selectors.EVENT_READ,
+                                  _cb))
 
     @staticmethod
     def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -895,147 +1311,192 @@ class Messenger:
 
     # -- receive -------------------------------------------------------------
 
-    def _read_loop(self, peer: str, conn: _Conn) -> None:
-        # buffered reader: one C-level buffer fill serves many small
-        # header/body reads (the raw 2-3 recv syscalls per frame cost
-        # real CPU at wire rates); read(n) blocks until n bytes like
-        # _recv_exact did, and a close/shutdown unblocks it the same
-        # way
-        rf = conn.sock.makefile("rb", buffering=1 << 18)
-
-        def read_exact(n: int) -> bytes:
-            b = rf.read(n)
-            if b is None or len(b) < n:
-                raise ConnectionError("peer closed")
-            return b
+    def _conn_event(self, peer: str, conn: _Conn, mask: int) -> None:
+        """Reactor event entry for one connection. Read side drains
+        the socket and parses every complete frame (the _read_loop
+        body, event-driven); write side resumes the queued flush."""
         try:
-            while conn.alive:
-                raw_len = read_exact(4)
-                (blen,) = struct.unpack("<I", raw_len)
+            if mask & selectors.EVENT_READ:
+                self._conn_read(peer, conn)
+            if mask & selectors.EVENT_WRITE and conn.alive:
+                conn._on_writable()
+            if not conn.alive:
+                raise ConnectionError("connection closed")
+        except (OSError, ConnectionError, ValueError):
+            self._reactor_reap(peer, conn)
+
+    def _conn_read(self, peer: str, conn: _Conn) -> None:
+        # drain with a per-event byte budget: one hot connection must
+        # not starve the rest of this reactor (epoll is level-
+        # triggered, the remainder fires on the next loop)
+        budget = 1 << 20
+        while budget > 0 and conn.alive:
+            try:
+                chunk = conn.sock.recv(1 << 18)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                raise ConnectionError("recv failed")
+            if not chunk:
+                raise ConnectionError("peer closed")
+            budget -= len(chunk)
+            rx = conn._rx
+            rx += chunk
+            pos = 0
+            n = len(rx)
+            tail = 4 if conn.box is None else 0
+            while n - pos >= 4:
+                (blen,) = struct.unpack_from("<I", rx, pos)
                 floor = 10 if conn.box is None \
                     else 10 + _NONCE + _GCM_TAG
                 if blen < floor or blen > (1 << 26):
                     raise ConnectionError(f"bad frame length {blen}")
-                body = read_exact(blen)
-                if conn.box is None:
-                    (crc,) = struct.unpack("<I", read_exact(4))
-                    t0 = _time_mod.perf_counter()
-                    if _crc_iov([raw_len, body]) != crc:
-                        # ProtocolV2 crc mode: corrupt frame kills the
-                        # session; replay redelivers after reconnect
-                        raise ConnectionError("frame crc mismatch")
-                    self.perf.tinc("crc_time",
-                                   _time_mod.perf_counter() - t0)
-                    self.perf.inc_many((("frames_rx", 1),
-                                        ("bytes_rx", 8 + blen)))
-                else:
-                    # secure mode: the GCM tag is the integrity check
-                    # (and the length header is bound in as AAD)
-                    t0 = _time_mod.perf_counter()
-                    body = conn.box.open(body, raw_len)
-                    self.perf.tinc("open_time",
-                                   _time_mod.perf_counter() - t0)
-                    self.perf.inc_many((("frames_rx", 1),
-                                        ("bytes_rx", 4 + blen)))
-                seq, tid = struct.unpack_from("<QH", body)
-                # zero-copy view over the payload (Decoder accepts a
-                # memoryview; blob fields copy out only what they keep)
-                payload = memoryview(body)[10:]
-                if tid & _COMP_FLAG:
-                    import zlib
-                    try:
-                        o = zlib.decompressobj()
-                        payload = o.decompress(payload, _DECOMP_MAX)
-                        if o.unconsumed_tail:
-                            raise ConnectionError(
-                                "decompressed frame exceeds cap")
-                        if not o.eof or o.unused_data:
-                            # a TRUNCATED stream decompresses without
-                            # error — delivering the partial payload
-                            # would ack-and-lose the message
-                            raise ConnectionError(
-                                "compressed frame truncated")
-                    except zlib.error:
-                        # garbled compressed body: kill the session
-                        # exactly like a crc mismatch; replay heals
-                        raise ConnectionError(
-                            "compressed frame corrupt")
-                    tid &= _COMP_FLAG - 1
-                    with self._stats_lock:
-                        self.stats["rx_compressed"] = \
-                            self.stats.get("rx_compressed", 0) + 1
-                    self.perf.inc("rx_compressed")
-                # incarnation fencing: a conn authenticated against a
-                # peer incarnation that is no longer current must not
-                # touch session state — a dying incarnation's buffered
-                # frames arriving AFTER the new one's handshake reset
-                # would re-poison in_seq with stale high seqs (black-
-                # holing the new peer) or retire fresh unacked via old
-                # ACKs. Kill the stale conn instead.
-                with self._lock:
-                    cur = self._peer_nonce.get(peer)
-                if cur is not None and conn.peer_inst != cur:
-                    raise ConnectionError(
-                        "frame from a stale peer incarnation")
-                if tid == ACK_TYPE:
-                    if len(payload) != 8:
-                        raise ConnectionError("malformed ACK frame")
-                    (acked,) = struct.unpack("<Q", payload)
-                    self.perf.inc("acks_rx")
-                    with self._lock:
-                        q = self._unacked.get(peer)
-                        while q and q[0][0] <= acked:
-                            q.popleft()
-                    continue
-                deliver = False
-                with self._lock:
-                    if seq > self._in_seq.get(peer, 0):
-                        self._in_seq[peer] = seq
-                        deliver = True  # else: replayed dup, drop
-                    ack_seq = self._in_seq.get(peer, 0)
-                if not deliver:
-                    self.perf.inc("dup_rx")
-                # coalesced cumulative ack: every ACK_BATCH frames
-                # inline, the rest via the ~2ms flusher — replies
-                # never wait on acks (they only retire the sender's
-                # replay queue), so the delay costs nothing while
-                # cutting the rpc pattern's frame count by a third
-                if ack_seq - conn.acked_out >= ACK_BATCH:
-                    conn.acked_out = max(conn.acked_out, ack_seq)
-                    try:
-                        conn.send_frame(0, ACK_TYPE,
-                                        struct.pack("<Q", ack_seq))
-                    except (OSError, ConnectionError):
-                        pass
-                else:
-                    self._ack_event.set()
-                if deliver:
-                    self.perf.inc("msg_rx")
-                    cls = _MSG_TYPES.get(tid)
-                    handler = self._handlers.get(tid)
-                    if cls is not None and handler is not None:
-                        try:
-                            handler(peer,
-                                    cls.decode_payload(Decoder(payload)))
-                        except Exception as e:  # poison message: the
-                            # frame was crc-valid and is already acked;
-                            # contain the blast radius to this message
-                            # (fast dispatch must not kill the session)
-                            from ..utils.log import g_log
-                            g_log.dout("msgr", 0,
-                                       f"dispatch error from {peer} "
-                                       f"type={tid:#x} seq={seq}: {e!r}")
-        except (OSError, ConnectionError, ValueError):
-            pass   # ValueError: read on a concurrently closed makefile
-        finally:
+                if n - pos < 4 + blen + tail:
+                    break
+                raw_len = bytes(rx[pos:pos + 4])
+                body = bytes(rx[pos + 4:pos + 4 + blen])
+                crc = None
+                if tail:
+                    (crc,) = struct.unpack_from("<I", rx,
+                                                pos + 4 + blen)
+                pos += 4 + blen + tail
+                self._on_frame(peer, conn, raw_len, body, crc)
+            if pos:
+                del rx[:pos]
+
+    def _on_frame(self, peer: str, conn: _Conn, raw_len: bytes,
+                  body: bytes, crc: int | None) -> None:
+        """One complete wire frame: verify, dedup, ack, dispatch —
+        bit-for-bit the blocking read loop's semantics. Raises
+        ConnectionError to kill the session (corruption, stale
+        incarnation), exactly as before."""
+        blen = len(body)
+        if conn.box is None:
+            t0 = _time_mod.perf_counter()
+            if _crc_iov([raw_len, body]) != crc:
+                # ProtocolV2 crc mode: corrupt frame kills the
+                # session; replay redelivers after reconnect
+                raise ConnectionError("frame crc mismatch")
+            self.perf.tinc("crc_time",
+                           _time_mod.perf_counter() - t0)
+            self.perf.inc_many((("frames_rx", 1),
+                                ("bytes_rx", 8 + blen)))
+        else:
+            # secure mode: the GCM tag is the integrity check
+            # (and the length header is bound in as AAD)
+            t0 = _time_mod.perf_counter()
+            body = conn.box.open(body, raw_len)
+            self.perf.tinc("open_time",
+                           _time_mod.perf_counter() - t0)
+            self.perf.inc_many((("frames_rx", 1),
+                                ("bytes_rx", 4 + blen)))
+        seq, tid = struct.unpack_from("<QH", body)
+        # zero-copy view over the payload (Decoder accepts a
+        # memoryview; blob fields copy out only what they keep)
+        payload = memoryview(body)[10:]
+        if tid & _COMP_FLAG:
+            import zlib
             try:
-                rf.close()
-            except OSError:
-                pass
-            conn.close()
+                o = zlib.decompressobj()
+                payload = o.decompress(payload, _DECOMP_MAX)
+                if o.unconsumed_tail:
+                    raise ConnectionError(
+                        "decompressed frame exceeds cap")
+                if not o.eof or o.unused_data:
+                    # a TRUNCATED stream decompresses without
+                    # error — delivering the partial payload
+                    # would ack-and-lose the message
+                    raise ConnectionError(
+                        "compressed frame truncated")
+            except zlib.error:
+                # garbled compressed body: kill the session
+                # exactly like a crc mismatch; replay heals
+                raise ConnectionError(
+                    "compressed frame corrupt")
+            tid &= _COMP_FLAG - 1
+            with self._stats_lock:
+                self.stats["rx_compressed"] = \
+                    self.stats.get("rx_compressed", 0) + 1
+            self.perf.inc("rx_compressed")
+        # incarnation fencing: a conn authenticated against a
+        # peer incarnation that is no longer current must not
+        # touch session state — a dying incarnation's buffered
+        # frames arriving AFTER the new one's handshake reset
+        # would re-poison in_seq with stale high seqs (black-
+        # holing the new peer) or retire fresh unacked via old
+        # ACKs. Kill the stale conn instead.
+        with self._lock:
+            cur = self._peer_nonce.get(peer)
+        if cur is not None and conn.peer_inst != cur:
+            raise ConnectionError(
+                "frame from a stale peer incarnation")
+        if tid == ACK_TYPE:
+            if len(payload) != 8:
+                raise ConnectionError("malformed ACK frame")
+            (acked,) = struct.unpack("<Q", payload)
+            self.perf.inc("acks_rx")
             with self._lock:
-                if self._conns.get(peer) is conn:
-                    del self._conns[peer]
+                q = self._unacked.get(peer)
+                while q and q[0][0] <= acked:
+                    q.popleft()
+            return
+        deliver = False
+        with self._lock:
+            if seq > self._in_seq.get(peer, 0):
+                self._in_seq[peer] = seq
+                deliver = True  # else: replayed dup, drop
+            ack_seq = self._in_seq.get(peer, 0)
+        if not deliver:
+            self.perf.inc("dup_rx")
+        # coalesced cumulative ack: every ACK_BATCH frames
+        # inline, the rest via the ~2ms flusher — replies
+        # never wait on acks (they only retire the sender's
+        # replay queue), so the delay costs nothing while
+        # cutting the rpc pattern's frame count by a third
+        if ack_seq - conn.acked_out >= ACK_BATCH:
+            conn.acked_out = max(conn.acked_out, ack_seq)
+            try:
+                conn.send_frame(0, ACK_TYPE,
+                                struct.pack("<Q", ack_seq))
+            except (OSError, ConnectionError):
+                pass
+        else:
+            self._ack_event.set()
+        if deliver:
+            self.perf.inc("msg_rx")
+            cls = _MSG_TYPES.get(tid)
+            ent = self._handlers.get(tid)
+            if cls is not None and ent is not None:
+                fn, fast = ent
+                if not fast:
+                    # queued dispatch: decode + run on the dispatch
+                    # thread so a blocking fold never stalls this
+                    # reactor (replies keep draining meanwhile)
+                    self._dispatch_q.put((fn, peer, cls, payload))
+                    return
+                try:
+                    fn(peer, cls.decode_payload(Decoder(payload)))
+                except Exception as e:  # poison message: the
+                    # frame was crc-valid and is already acked;
+                    # contain the blast radius to this message
+                    # (fast dispatch must not kill the session)
+                    from ..utils.log import g_log
+                    g_log.dout("msgr", 0,
+                               f"dispatch error from {peer} "
+                               f"type={tid:#x} seq={seq}: {e!r}")
+
+    def _reactor_reap(self, peer: str, conn: _Conn) -> None:
+        """Reactor-side teardown: unregister + close the fd HERE (the
+        only thread that may — a foreign close would race the fd
+        number back into the selector) and drop the session's claim
+        on this conn."""
+        conn.alive = False
+        with conn.wlock:
+            conn._wcond.notify_all()
+        conn._reactor_close()
+        with self._lock:
+            if self._conns.get(peer) is conn:
+                del self._conns[peer]
 
     def _ack_loop(self) -> None:
         """Flush owed cumulative acks ~2ms after a burst: the sender's
@@ -1065,12 +1526,33 @@ class Messenger:
     def shutdown(self) -> None:
         self._stopping = True
         self._ack_event.set()   # unblock the flusher so it can exit
-        try:
-            self._listener.close()
-        except OSError:
-            pass
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
         for c in conns:
-            c.close()
+            # wake peers + blocked senders now; the fd itself closes
+            # with the reactor (it owns every registered socket)
+            c.alive = False
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            with c.wlock:
+                c._wcond.notify_all()
+        for r in self._reactors:
+            r.stop()
+        for r in self._reactors:
+            r.join(timeout=2.0)
+        for c in conns:
+            if c.reactor is None:
+                c._close_fd()
+        try:
+            self._listener.close()   # reactors are gone: direct close
+        except OSError:              # is race-free now (usually a
+            pass                     # no-op — reactor 0 owned it)
+        if self._uds_path is not None:
+            import os as _os
+            try:
+                _os.unlink(self._uds_path)
+            except OSError:
+                pass

@@ -51,7 +51,11 @@ class Transaction:
     def write(self, cid: str, oid: str, offset: int, data):
         if int(offset) < 0:
             raise ValueError(f"write offset {offset} < 0")
-        arr = (np.frombuffer(bytes(data), dtype=np.uint8).copy()
+        # frombuffer reads memoryviews/bytes directly — bytes(data)
+        # here would add a SECOND full copy per shard write on the
+        # subop hot path (the .copy() below is the one that must
+        # stay: a transaction owns its bytes, the aliasing contract)
+        arr = (np.frombuffer(data, dtype=np.uint8).copy()
                if isinstance(data, (bytes, bytearray, memoryview))
                else np.asarray(data, np.uint8).copy())
         if arr.ndim != 1:
@@ -172,7 +176,11 @@ class MemStore:
         elif kind == "truncate":
             _, cid, oid, size = op
             o = self._obj(cid, oid, create=True)
-            if size <= len(o.data):
+            if size == len(o.data):
+                pass    # the write-then-truncate-to-length pattern on
+                #         every shard subop: already exact, and the
+                #         .copy() below would re-copy the whole object
+            elif size <= len(o.data):
                 o.data = o.data[:size].copy()
             else:
                 grown = np.zeros(size, dtype=np.uint8)

@@ -62,6 +62,7 @@ run the whole cluster over AES-GCM sessions.
 
 from __future__ import annotations
 
+import struct
 import threading
 import time
 
@@ -986,6 +987,12 @@ class _RecoveryRound:
     def lost_of(self, ps: int) -> list[int]:
         return self.plans[ps].lost
 
+    def shard(self):
+        """All of a round's grants ride ONE op shard (the lowest
+        member PG's) so a client op waits behind at most one batch of
+        its own shard; other shards never see the round."""
+        return self.d._shard_of(min(self.plans))
+
     def next_cost(self) -> float:
         """One grant's work in client-op cost units (bytes-scaled, the
         osd_mclock_cost_per_byte role)."""
@@ -994,14 +1001,24 @@ class _RecoveryRound:
 
     def __call__(self) -> None:
         d = self.d
+        # the daemon lock plus EVERY member PG's lock (ascending —
+        # the one global order): a fused batch may touch any plan's
+        # PG, and client ops on other shards hold only pg locks now
+        locks = [d._pg_lock(ps) for ps in sorted(self.plans)]
         try:
             with d._lock:
-                if self.runner.step():
-                    pass                    # yield below
-                else:
-                    self.runner.finish()
-                    self._settle_locked()
-                    return
+                for lk in locks:
+                    lk.acquire()
+                try:
+                    if self.runner.step():
+                        pass                # yield below
+                    else:
+                        self.runner.finish()
+                        self._settle_locked()
+                        return
+                finally:
+                    for lk in reversed(locks):
+                        lk.release()
         except (ValueError, ConnectionError, OSError, KeyError) as e:
             # helper died / push refused mid-round: park it — the next
             # reconcile re-plans the leftover names against the fresh
@@ -1021,7 +1038,7 @@ class _RecoveryRound:
         if self.d._stop.is_set():
             return
         self.d._sched_enqueue("background_recovery", self,
-                              self.next_cost())
+                              self.next_cost(), shard=self.shard())
 
     def _settle_locked(self) -> None:
         d = self.d
@@ -1037,6 +1054,99 @@ class _RecoveryRound:
         d.perf.inc("recovery_rounds")
 
 
+class _OpShard:
+    """One op-queue shard (ref: OSD::ShardedOpWQ shard): its own
+    mClock scheduler + condition + worker thread. Ops hash to a shard
+    by PG id (OSDDaemon._shard_of), so one PG's ops drain FIFO on one
+    worker — per-PG ordering needs no cross-shard coordination."""
+
+    def __init__(self, daemon: "OSDDaemon", idx: int):
+        from .scheduler import MClockScheduler
+        self.d = daemon
+        self.idx = idx
+        self.sched = MClockScheduler(daemon._mclock_profiles())
+        self.cv = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._worker_loop, daemon=True,
+            name=f"{daemon.name}-shard{idx}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def enqueue(self, cls: str, item, cost: float = 1.0) -> None:
+        with self.cv:
+            self.sched.enqueue(cls, item, cost)
+            self.cv.notify()
+
+    def _worker_loop(self) -> None:
+        """Drain this shard's mClock queue in tag order. Every item is
+        a callable; recovery rounds re-enqueue themselves after each
+        batch grant, so a queued client op never waits behind more
+        than ONE recovery batch (the p95-bounding property the
+        scheduler exists for), and only within its own shard."""
+        d = self.d
+        while not d._stop.is_set():
+            with self.cv:
+                now = time.monotonic()
+                got = self.sched.dequeue(now)
+                if got is None:
+                    nxt = self.sched.next_eligible(now)
+                    self.cv.wait(
+                        0.5 if nxt is None
+                        else min(0.5, max(0.001, nxt - now)))
+                    continue
+            _cls, item = got
+            d.perf.inc("op_shard_grants")
+            try:
+                item()
+            except Exception as e:   # noqa: BLE001 — the worker must
+                # survive any op; the item owns its own error reply
+                d.c.log(f"{d.name}: op shard {self.idx} item "
+                        f"failed: {e!r}")
+            d._note_shard_gauges()
+
+
+class _BatchJoin:
+    """Reply assembly for a `batch` frame whose sub-ops span shards:
+    each shard executes its slots FIFO (per-PG order holds), the LAST
+    shard to finish encodes the reply in original slot order and
+    sends it — one frame in, one frame out, exactly like the
+    single-shard path."""
+
+    def __init__(self, daemon: "OSDDaemon", peer: str, msg,
+                 n_slots: int, n_groups: int):
+        self.d, self.peer, self.msg = daemon, peer, msg
+        self.slots: list = [None] * n_slots
+        self._left = n_groups
+        self._lock = threading.Lock()
+
+    def run(self, items: list) -> None:
+        """items: [(slot, kind, body)] — one shard's share."""
+        for slot, kind, body in items:
+            try:
+                blob = self.d._one_client_op(self.peer, kind, body)
+                self.slots[slot] = (True, blob, "")
+            except Exception as err:   # noqa: BLE001 — per-sub-op
+                # fault isolation (the client maps each slot back to
+                # its op's retry state)
+                self.slots[slot] = (False, b"",
+                                    f"{type(err).__name__}:{err}")
+        with self._lock:
+            self._left -= 1
+            done = self._left == 0
+        if not done:
+            return
+        e = Encoder()
+        e.u32(len(self.slots))
+        for ok, blob, err in self.slots:
+            e.boolean(ok).blob_ref(blob).string(err)
+        try:
+            self.d.msgr.send(self.peer, MOSDOpReply(
+                self.msg.req_id, True, self.msg.kind, e.bytes()))
+        except (KeyError, OSError, ConnectionError):
+            pass
+
+
 class OSDDaemon:
     """One OSD endpoint: local store + the PGs it primaries."""
 
@@ -1046,7 +1156,9 @@ class OSDDaemon:
         self.name = f"osd.{osd_id}"
         self.store = cluster.make_store(osd_id)
         self.msgr = Messenger(self.name, secret=cluster.secret,
-                              compress=cluster.compress)
+                              compress=cluster.compress,
+                              workers=cluster.msgr_workers,
+                              uds=cluster.msgr_uds)
         self.rpc = _Rpc(self.msgr, MStoreReply.type_id)
         self.osdmap: OSDMap | None = None
         self.backends: dict[int, object] = {}     # ps -> PGBackend
@@ -1096,6 +1208,8 @@ class OSDDaemon:
         self.config.load_file({
             "osd_heartbeat_interval": cluster.hb_interval,
             "osd_heartbeat_grace": cluster.hb_grace,
+            "osd_op_num_shards": cluster.op_shards,
+            "msgr_reactor_workers": cluster.msgr_workers,
         })
         self._cfg_applied: dict[str, str] = {}
         # admin-socket observability (ref: OpTracker/TrackedOp +
@@ -1127,21 +1241,35 @@ class OSDDaemon:
         # per daemon): same dispatcher as the wire `admin` op, but
         # reachable without a client, a map, or cephx — the operator's
         # side door into a wedged daemon
-        # mClock-governed op admission (ref: src/osd/scheduler/
-        # mClockScheduler.cc wired into OSD::op_shardedwq): client ops
-        # and recovery batch grants flow through ONE scheduler; a
-        # single worker drains it in tag order, so background_recovery
-        # competes with (instead of head-of-line-blocking) client ops.
-        # Built fresh here (empty queue per boot), and BEFORE any
-        # handler registers — a map or op frame may land the moment
-        # the messenger knows the type.
-        from .scheduler import MClockScheduler
-        self.op_sched = MClockScheduler(self._mclock_profiles())
-        self._sched_cv = threading.Condition()
+        # mClock-governed SHARDED op admission (ref: src/osd/
+        # scheduler/mClockScheduler.cc wired into OSD::op_shardedwq
+        # with osd_op_num_shards shards): client ops and recovery
+        # batch grants hash by PG id to a shard; each shard drains its
+        # own scheduler in tag order on its own worker — per-PG
+        # ordering is a queue invariant (one PG, one shard, one FIFO)
+        # while independent PGs dispatch concurrently, and
+        # background_recovery competes with (instead of head-of-line-
+        # blocking) the client ops of its shard. Built fresh here
+        # (empty queues per boot), and BEFORE any handler registers —
+        # a map or op frame may land the moment the messenger knows
+        # the type. mClock reservations are PER SHARD (the
+        # reference's documented osd_op_num_shards caveat).
+        self.num_op_shards = max(1, int(
+            self.config["osd_op_num_shards"]))
+        self.op_shards = [_OpShard(self, i)
+                          for i in range(self.num_op_shards)]
+        # compat alias: shard 0's scheduler (single-shard daemons
+        # behave exactly like the pre-shard tree)
+        self.op_sched = self.op_shards[0].sched
+        self._sched_cv = self.op_shards[0].cv
+        # per-PG execution locks: client ops serialize within their
+        # PG only; reconcile/recovery take the PG locks of the PGs
+        # they mutate (always AFTER self._lock — one global order)
+        self._pg_locks: dict[int, threading.RLock] = {}
+        self._pg_locks_guard = threading.Lock()
         self._recovering: dict[int, "_RecoveryRound"] = {}
-        self._opw = threading.Thread(target=self._op_worker_loop,
-                                     daemon=True)
-        self._opw.start()
+        for sh in self.op_shards:
+            sh.start()
         from ..utils.admin_socket import AdminSocket
         self.asok = AdminSocket(self.c.asok_path(self.name))
         for _cmd in self._ADMIN_CMDS:
@@ -1154,8 +1282,13 @@ class OSDDaemon:
         m.register_handler(MOSDOp.type_id, self._on_client_op)
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
-        m.register_handler(MOSDMapMsg.type_id, self._on_map)
-        m.register_handler(MOSDIncMapMsg.type_id, self._on_inc_map)
+        # map folds run a full reconcile (meta gathers, shard moves —
+        # BLOCKING remote rpc): queued dispatch, never on a reactor,
+        # or the fold would deadlock against its own replies
+        m.register_handler(MOSDMapMsg.type_id, self._on_map,
+                           fast=False)
+        m.register_handler(MOSDIncMapMsg.type_id, self._on_inc_map,
+                           fast=False)
         if self.verifier is not None:
             from ..auth import ClientAuth
             m.register_handler(MAuthOp.type_id, self._on_auth)
@@ -1310,68 +1443,103 @@ class OSDDaemon:
                        f"{e}")
         return self._mclock_profiles()["client"]
 
-    def _client_class(self, peer: str) -> str:
+    def _client_class(self, peer: str, shard: "_OpShard") -> str:
         """mClock class of one client op: per-tenant, keyed by the
         cephx entity bound to the peer's session (the authenticated
         identity; caps already gated it) — the transport peer name
-        without cephx. Registers the class on first contact."""
+        without cephx. Registers the class on first contact with the
+        op's shard (each shard tags its own tenants)."""
         sess = self._authed.get(peer)
         entity = sess["entity"] if sess is not None else peer
         cls = self._TENANT_CLS + entity
-        with self._sched_cv:
-            self.op_sched.ensure_class(cls,
-                                       self._tenant_profile(entity))
+        with shard.cv:
+            shard.sched.ensure_class(cls, self._tenant_profile(entity))
         return cls
 
     def _refresh_mclock_profiles(self) -> None:
         """Re-resolve the (ρ, w, λ) table after a config change (called
         from the central-config fold — cheaper and lifetime-safer than
         per-key observers across revives). Live per-tenant classes are
-        re-resolved too."""
+        re-resolved too, on every shard."""
         try:
             profiles = self._mclock_profiles()
         except (KeyError, ValueError) as e:
             self.c.log(f"{self.name}: bad mclock config ignored: {e}")
             return
-        with self._sched_cv:
-            for cls, prof in profiles.items():
-                q = self.op_sched._classes.get(cls)
-                if q is not None and q.profile != prof:
-                    self.op_sched.set_profile(cls, prof)
-            for cls in self.op_sched.class_names():
-                if cls.startswith(self._TENANT_CLS):
-                    entity = cls[len(self._TENANT_CLS):]
-                    self.op_sched.ensure_class(
-                        cls, self._tenant_profile(entity))
+        for sh in self.op_shards:
+            with sh.cv:
+                for cls, prof in profiles.items():
+                    q = sh.sched._classes.get(cls)
+                    if q is not None and q.profile != prof:
+                        sh.sched.set_profile(cls, prof)
+                for cls in sh.sched.class_names():
+                    if cls.startswith(self._TENANT_CLS):
+                        entity = cls[len(self._TENANT_CLS):]
+                        sh.sched.ensure_class(
+                            cls, self._tenant_profile(entity))
 
-    def _sched_enqueue(self, cls: str, item, cost: float = 1.0) -> None:
-        with self._sched_cv:
-            self.op_sched.enqueue(cls, item, cost)
-            self._sched_cv.notify()
+    # -- shard routing --------------------------------------------------------
 
-    def _op_worker_loop(self) -> None:
-        """Drain the mClock queue in tag order. Every item is a
-        callable; recovery rounds re-enqueue themselves after each
-        batch grant, so the daemon lock is free between grants and a
-        queued client op never waits behind more than ONE recovery
-        batch (the p95-bounding property the scheduler exists for)."""
-        while not self._stop.is_set():
-            with self._sched_cv:
-                now = time.monotonic()
-                got = self.op_sched.dequeue(now)
-                if got is None:
-                    nxt = self.op_sched.next_eligible(now)
-                    self._sched_cv.wait(
-                        0.5 if nxt is None
-                        else min(0.5, max(0.001, nxt - now)))
-                    continue
-            _cls, item = got
-            try:
-                item()
-            except Exception as e:   # noqa: BLE001 — the worker must
-                # survive any op; the item owns its own error reply
-                self.c.log(f"{self.name}: op worker item failed: "
-                           f"{e!r}")
+    def _shard_of(self, ps: int) -> "_OpShard":
+        """PG -> shard (the OSD::ShardedOpWQ hash): stable for the
+        daemon's lifetime, so one PG's ops always drain FIFO on one
+        worker."""
+        return self.op_shards[ps % self.num_op_shards]
+
+    @staticmethod
+    def _op_ps(body) -> int:
+        """Peek the PG id every client-op body leads with (the
+        Encoder's raw little-endian u32) without a full decode."""
+        try:
+            return struct.unpack_from("<I", body, 0)[0]
+        except struct.error:
+            return 0
+
+    def _pg_lock(self, ps: int) -> threading.RLock:
+        with self._pg_locks_guard:
+            lk = self._pg_locks.get(ps)
+            if lk is None:
+                lk = self._pg_locks[ps] = threading.RLock()
+            return lk
+
+    def _sched_enqueue(self, cls: str, item, cost: float = 1.0,
+                       shard: "_OpShard | None" = None) -> None:
+        (shard or self.op_shards[0]).enqueue(cls, item, cost)
+        self._note_shard_gauges()
+
+    def _note_shard_gauges(self) -> None:
+        """Declared occupancy gauges over the shard set: total queued
+        depth + grant imbalance (max-min served across shards — the
+        hash-skew signal the bench JSON carries)."""
+        depths = [len(sh.sched) for sh in self.op_shards]
+        served = [sum(q.served for q in sh.sched._classes.values())
+                  for sh in self.op_shards]
+        self.perf.set("op_shard_depth", sum(depths))
+        self.perf.set("op_shard_imbalance",
+                      max(served) - min(served) if served else 0)
+
+    def shard_dump(self) -> dict:
+        """Per-shard scheduler occupancy (the `dump_op_shards` admin
+        view; rados_bench ships it as per-shard attribution)."""
+        return {f"shard_{sh.idx}": sh.sched.dump()
+                for sh in self.op_shards}
+
+    def sched_dump(self) -> dict:
+        """Class -> occupancy MERGED across shards (the pre-shard
+        `dump_mclock` shape: tools and tests iterate class names at
+        the top level)."""
+        out: dict = {}
+        for sh in self.op_shards:
+            for cls, row in sh.sched.dump().items():
+                cur = out.get(cls)
+                if cur is None:
+                    out[cls] = dict(row)
+                else:
+                    cur["queued"] += row["queued"]
+                    cur["served"] += row["served"]
+                    cur["served_cost"] = round(
+                        cur["served_cost"] + row["served_cost"], 3)
+        return out
 
     # -- store service (the SubOp executor) ---------------------------------
 
@@ -2042,168 +2210,177 @@ class OSDDaemon:
         rebuild)."""
         new_plans: list[tuple[int, object, set[int]]] = []
         for ps in range(self.c.pg_num):
-            acting = self._acting(ps)
-            if not acting or acting[0] != self.osd_id:
-                if self.backends.pop(ps, None) is not None:
-                    # not ours (anymore): the new primary restores
-                    # snap/cls state from the PG metadata
-                    self.snapsets.pop(ps, None)
-                    self.births.pop(ps, None)
-                    self.obj_kv.pop(ps, None)
-                    self.scrub_reports.pop(ps, None)
-                    self._last_scrub.pop(ps, None)
-                    self._last_deep.pop(ps, None)
-                    self._meta_delta.pop(ps, None)
-                self._interval_start.pop(ps, None)
-                self._last_acting.pop(ps, None)
-                continue
-            # interval detection: any acting change starts a NEW
-            # INTERVAL whose primary must re-prove freshness — its
-            # up_thru must reach the interval's start epoch before the
-            # PG restores/recovers/serves (WaitUpThru; ref:
-            # PeeringState::adjust_need_up_thru)
-            if self._last_acting.get(ps) != acting:
-                self._last_acting[ps] = list(acting)
-                self._interval_start[ps] = self.osdmap.epoch
-            need_ut = self._interval_start.get(ps, 0)
-            if int(self.osdmap.osd_up_thru[self.osd_id]) < need_ut:
-                self._request_up_thru(need_ut)
-                continue
-            be = self.backends.get(ps)
-            if be is None:
-                now_m = time.monotonic()
-                if now_m < self._restore_backoff.get(ps, 0.0):
-                    continue        # recent below-quorum gather:
-                #                     don't re-pay its RPC timeouts
-                #                     on every map/heartbeat tick
-                try:
-                    be = self._restore_backend(ps, acting)
-                except (ConnectionError, OSError, KeyError) as e:
-                    # transient transport/auth trouble mid-restore
-                    # (cold tickets fail fast, a helper died): defer
-                    # with the same backoff as a below-quorum gather
-                    self.c.log(f"{self.name}: pg 1.{ps} restore "
-                               f"deferred ({e})")
-                    self._restore_backoff[ps] = now_m + 2.0
-                    continue
-                if be is None:      # info gather below quorum:
-                    self._restore_backoff[ps] = now_m + 2.0
-                    continue        # retried by the heartbeat tick
-                self._restore_backoff.pop(ps, None)
-                self.backends[ps] = be
-                if getattr(be, "restored_from_blob", False):
-                    # ACTIVATION (the last_epoch_started role): stamp
-                    # this interval's epoch onto the acting members
-                    # BEFORE recovery starts or I/O is served — a
-                    # member of the old interval rejoining mid-
-                    # takeover must find the new interval's claim on
-                    # the quorum, or its longer dead-interval log
-                    # would win the info gather and resurrect
-                    # uncommitted writes (ref: PeeringState::activate)
-                    try:
-                        self._persist_meta(ps)
-                    except Exception as e:  # noqa: BLE001
-                        self.c.log(f"{self.name}: pg 1.{ps} "
-                                   f"activation persist failed: {e}")
-            elif self._rewind_pending.get(ps):
-                # a deferred divergent rewind retries on every map
-                # change until its helpers are reachable
-                self._rewind_divergent(
-                    ps, be, sorted(self._rewind_pending[ps]))
-            if be.acting == acting:
-                self._snap_trim(ps, be)   # snaps may have left the map
-                rnd = self._recovering.get(ps)
-                if rnd is not None and getattr(rnd, "failed", False):
-                    # a round died mid-way (helper lost, push refused):
-                    # re-plan THIS pg in full — helpers re-validate
-                    # against the current map, already-landed objects
-                    # re-verify cheaply through the fused pipeline
-                    n_osds = len(self.osdmap.osd_up)
-                    exclude = {
-                        s for s, o in enumerate(be.acting)
-                        if s not in rnd.lost_of(ps)
-                        and (not _valid_osd(o, n_osds)
-                             or o in self.suspect
-                             or not self.osdmap.osd_up[o])}
-                    try:
-                        plan = be.plan_recovery(
-                            rnd.lost_of(ps), helper_exclude=exclude)
-                        self._recovering[ps] = None   # round pending
-                        new_plans.append((ps, plan, set()))
-                    except (ValueError, ConnectionError, KeyError) as e:
-                        self.c.log(f"{self.name}: pg 1.{ps} recovery "
-                                   f"retry deferred: {e}")
-            if be.acting != acting:
-                # a changed slot whose old OSD is still up is a MOVE
-                # (CRUSH re-slotted a live member: copy the shard
-                # bytes); only a dead old OSD is a LOSS (decode-rebuild
-                # from helpers). Conflating them would overrun m.
-                lost, moves = [], []
-                n_osds = len(self.osdmap.osd_up)
-                for s, (o, n) in enumerate(zip(be.acting, acting)):
-                    if o == n:
-                        continue
-                    if not _valid_osd(n, n_osds):
-                        # CRUSH couldn't fill this slot in the current
-                        # (degraded) epoch — acting carries the
-                        # ITEM_NONE sentinel. Addressing "osd.<2^31>"
-                        # would KeyError mid-dispatch; leave the slot
-                        # where it is and retry on a better map.
-                        continue
-                    if _valid_osd(o, n_osds) \
-                            and self.osdmap.osd_up[o] \
-                            and o not in self.suspect:
-                        moves.append((s, o, n))
-                    else:
-                        # dead old holder — or a hole: a slot born
-                        # unfillable has no old bytes anywhere and
-                        # must decode-rebuild, not copy
-                        lost.append(s)
-                try:
-                    for s, o, n in moves:
-                        self._move_shard(be, s, o, n)
-                    if lost:
-                        repl = {s: acting[s] for s in lost}
-                        dead = {be.acting[s] for s in lost}
-                        exclude = {
-                            s for s, o in enumerate(be.acting)
-                            if s not in lost
-                            and (not _valid_osd(o, n_osds)
-                                 or o in self.suspect
-                                 or not self.osdmap.osd_up[o])}
-                        # plan now (validates helpers, repoints the
-                        # lost slots so new client writes reach the
-                        # rebuilding store directly); the mClock
-                        # worker executes the batches. The recovering
-                        # marker goes up IN THE SAME locked breath as
-                        # the acting mutation — wait_for_clean polls
-                        # unlocked and must never see a repointed
-                        # acting without the in-flight marker.
-                        # Replicated pools have no fused decode plan:
-                        # their push-based recover_shards runs inline
-                        # (the pre-r10 path; copies, not decodes).
-                        if hasattr(be, "plan_recovery"):
-                            plan = be.plan_recovery(
-                                lost, replacement_osds=repl,
-                                helper_exclude=exclude)
-                            self._recovering[ps] = None  # round pending
-                            new_plans.append((ps, plan, dead))
-                        else:
-                            be.recover_shards(lost,
-                                              replacement_osds=repl,
-                                              helper_exclude=exclude)
-                            self.suspect -= dead
-                            self.perf.inc("recovery_rounds")
-                    self._persist_meta(ps)
-                except (ValueError, ConnectionError, KeyError) as e:
-                    self.c.log(f"{self.name}: pg 1.{ps} recovery "
-                               f"deferred: {e}")
+            # per-PG lock INSIDE the daemon lock (one global order):
+            # client ops of this PG are excluded while its backend/
+            # meta move; other PGs' ops keep flowing
+            with self._pg_lock(ps):
+                self._reconcile_pg(ps, new_plans)
         if new_plans:
             rnd = _RecoveryRound(self, new_plans)
             for ps, _plan, _dead in new_plans:
                 self._recovering[ps] = rnd
             self._sched_enqueue("background_recovery", rnd,
-                                rnd.next_cost())
+                                rnd.next_cost(), shard=rnd.shard())
+
+    def _reconcile_pg(self, ps: int, new_plans: list) -> None:
+        """One PG's slice of _reconcile. Caller holds self._lock and
+        the PG lock."""
+        acting = self._acting(ps)
+        if not acting or acting[0] != self.osd_id:
+            if self.backends.pop(ps, None) is not None:
+                # not ours (anymore): the new primary restores
+                # snap/cls state from the PG metadata
+                self.snapsets.pop(ps, None)
+                self.births.pop(ps, None)
+                self.obj_kv.pop(ps, None)
+                self.scrub_reports.pop(ps, None)
+                self._last_scrub.pop(ps, None)
+                self._last_deep.pop(ps, None)
+                self._meta_delta.pop(ps, None)
+            self._interval_start.pop(ps, None)
+            self._last_acting.pop(ps, None)
+            return
+        # interval detection: any acting change starts a NEW
+        # INTERVAL whose primary must re-prove freshness — its
+        # up_thru must reach the interval's start epoch before the
+        # PG restores/recovers/serves (WaitUpThru; ref:
+        # PeeringState::adjust_need_up_thru)
+        if self._last_acting.get(ps) != acting:
+            self._last_acting[ps] = list(acting)
+            self._interval_start[ps] = self.osdmap.epoch
+        need_ut = self._interval_start.get(ps, 0)
+        if int(self.osdmap.osd_up_thru[self.osd_id]) < need_ut:
+            self._request_up_thru(need_ut)
+            return
+        be = self.backends.get(ps)
+        if be is None:
+            now_m = time.monotonic()
+            if now_m < self._restore_backoff.get(ps, 0.0):
+                return          # recent below-quorum gather:
+            #                     don't re-pay its RPC timeouts
+            #                     on every map/heartbeat tick
+            try:
+                be = self._restore_backend(ps, acting)
+            except (ConnectionError, OSError, KeyError) as e:
+                # transient transport/auth trouble mid-restore
+                # (cold tickets fail fast, a helper died): defer
+                # with the same backoff as a below-quorum gather
+                self.c.log(f"{self.name}: pg 1.{ps} restore "
+                           f"deferred ({e})")
+                self._restore_backoff[ps] = now_m + 2.0
+                return
+            if be is None:      # info gather below quorum:
+                self._restore_backoff[ps] = now_m + 2.0
+                return          # retried by the heartbeat tick
+            self._restore_backoff.pop(ps, None)
+            self.backends[ps] = be
+            if getattr(be, "restored_from_blob", False):
+                # ACTIVATION (the last_epoch_started role): stamp
+                # this interval's epoch onto the acting members
+                # BEFORE recovery starts or I/O is served — a
+                # member of the old interval rejoining mid-
+                # takeover must find the new interval's claim on
+                # the quorum, or its longer dead-interval log
+                # would win the info gather and resurrect
+                # uncommitted writes (ref: PeeringState::activate)
+                try:
+                    self._persist_meta(ps)
+                except Exception as e:  # noqa: BLE001
+                    self.c.log(f"{self.name}: pg 1.{ps} "
+                               f"activation persist failed: {e}")
+        elif self._rewind_pending.get(ps):
+            # a deferred divergent rewind retries on every map
+            # change until its helpers are reachable
+            self._rewind_divergent(
+                ps, be, sorted(self._rewind_pending[ps]))
+        if be.acting == acting:
+            self._snap_trim(ps, be)   # snaps may have left the map
+            rnd = self._recovering.get(ps)
+            if rnd is not None and getattr(rnd, "failed", False):
+                # a round died mid-way (helper lost, push refused):
+                # re-plan THIS pg in full — helpers re-validate
+                # against the current map, already-landed objects
+                # re-verify cheaply through the fused pipeline
+                n_osds = len(self.osdmap.osd_up)
+                exclude = {
+                    s for s, o in enumerate(be.acting)
+                    if s not in rnd.lost_of(ps)
+                    and (not _valid_osd(o, n_osds)
+                         or o in self.suspect
+                         or not self.osdmap.osd_up[o])}
+                try:
+                    plan = be.plan_recovery(
+                        rnd.lost_of(ps), helper_exclude=exclude)
+                    self._recovering[ps] = None   # round pending
+                    new_plans.append((ps, plan, set()))
+                except (ValueError, ConnectionError, KeyError) as e:
+                    self.c.log(f"{self.name}: pg 1.{ps} recovery "
+                               f"retry deferred: {e}")
+        if be.acting != acting:
+            # a changed slot whose old OSD is still up is a MOVE
+            # (CRUSH re-slotted a live member: copy the shard
+            # bytes); only a dead old OSD is a LOSS (decode-rebuild
+            # from helpers). Conflating them would overrun m.
+            lost, moves = [], []
+            n_osds = len(self.osdmap.osd_up)
+            for s, (o, n) in enumerate(zip(be.acting, acting)):
+                if o == n:
+                    continue
+                if not _valid_osd(n, n_osds):
+                    # CRUSH couldn't fill this slot in the current
+                    # (degraded) epoch — acting carries the
+                    # ITEM_NONE sentinel. Addressing "osd.<2^31>"
+                    # would KeyError mid-dispatch; leave the slot
+                    # where it is and retry on a better map.
+                    continue
+                if _valid_osd(o, n_osds) \
+                        and self.osdmap.osd_up[o] \
+                        and o not in self.suspect:
+                    moves.append((s, o, n))
+                else:
+                    # dead old holder — or a hole: a slot born
+                    # unfillable has no old bytes anywhere and
+                    # must decode-rebuild, not copy
+                    lost.append(s)
+            try:
+                for s, o, n in moves:
+                    self._move_shard(be, s, o, n)
+                if lost:
+                    repl = {s: acting[s] for s in lost}
+                    dead = {be.acting[s] for s in lost}
+                    exclude = {
+                        s for s, o in enumerate(be.acting)
+                        if s not in lost
+                        and (not _valid_osd(o, n_osds)
+                             or o in self.suspect
+                             or not self.osdmap.osd_up[o])}
+                    # plan now (validates helpers, repoints the
+                    # lost slots so new client writes reach the
+                    # rebuilding store directly); the mClock
+                    # worker executes the batches. The recovering
+                    # marker goes up IN THE SAME locked breath as
+                    # the acting mutation — wait_for_clean polls
+                    # unlocked and must never see a repointed
+                    # acting without the in-flight marker.
+                    # Replicated pools have no fused decode plan:
+                    # their push-based recover_shards runs inline
+                    # (the pre-r10 path; copies, not decodes).
+                    if hasattr(be, "plan_recovery"):
+                        plan = be.plan_recovery(
+                            lost, replacement_osds=repl,
+                            helper_exclude=exclude)
+                        self._recovering[ps] = None  # round pending
+                        new_plans.append((ps, plan, dead))
+                    else:
+                        be.recover_shards(lost,
+                                          replacement_osds=repl,
+                                          helper_exclude=exclude)
+                        self.suspect -= dead
+                        self.perf.inc("recovery_rounds")
+                self._persist_meta(ps)
+            except (ValueError, ConnectionError, KeyError) as e:
+                self.c.log(f"{self.name}: pg 1.{ps} recovery "
+                           f"deferred: {e}")
 
     def _request_up_thru(self, want: int) -> None:
         """Ask every monitor to record our up_thru through `want` (the
@@ -2284,6 +2461,14 @@ class OSDDaemon:
          .add_time_avg("degraded_read_time",
                        "degraded-read service time (gather + any-k "
                        "decode)")
+         .add_u64_counter("op_shard_grants",
+                          "ops granted by shard workers (all shards; "
+                          "per-shard split in dump_op_shards)")
+         .add_u64("op_shard_depth",
+                  "ops queued across all op shards right now")
+         .add_u64("op_shard_imbalance",
+                  "grant spread across shards (max-min served — the "
+                  "PG-hash skew signal)")
          .add_u64("numpg", "PGs this daemon primaries")
          .add_u64("osdmap_epoch", "newest folded map epoch")
          .add_u64_counter("map_incs_applied",
@@ -2357,7 +2542,9 @@ class OSDDaemon:
                    "dump_historic_ops",
                    "dump_historic_ops_by_duration",
                    "dump_ops_in_flight", "slow_ops", "pg stat",
-                   "dump_mclock", "dump_scrubs", "log dump",
+                   "pg clean",
+                   "dump_mclock", "dump_op_shards", "dump_scrubs",
+                   "log dump",
                    "config show",
                    "config diff", "trace start", "trace stop",
                    "status")
@@ -2429,10 +2616,14 @@ class OSDDaemon:
             from ..utils.tracing import stop_trace
             return {"stopped": stop_trace()}
         if cmd == "dump_mclock":
-            # per-class occupancy + grants, tenant classes included
-            # (the scheduler's own dump snapshots the dynamic table)
-            with self._sched_cv:
-                return self.op_sched.dump()
+            # per-class occupancy + grants, tenant classes included,
+            # MERGED across op shards (the pre-shard shape — tools
+            # iterate class names at the top level)
+            return self.sched_dump()
+        if cmd == "dump_op_shards":
+            # per-shard detail: the hash-spread view the merged
+            # dump_mclock deliberately hides
+            return self.shard_dump()
         if cmd == "dump_scrubs":
             with self._lock:   # heartbeat inserts concurrently
                 return {"scrubs": {f"1.{ps}": r for ps, r in
@@ -2450,6 +2641,21 @@ class OSDDaemon:
         if cmd == "pg stat":
             with self._lock:
                 return {"pgs": self._pg_states()}
+        if cmd == "pg clean":
+            # per-primaried-PG cleanliness, the wait_for_clean slice
+            # one daemon can answer — the multi-process harness polls
+            # this over the asok (it cannot reach into a child's RAM)
+            with self._lock:
+                if self.osdmap is None:
+                    return {}
+                out = {}
+                for ps, be in self.backends.items():
+                    acting = self._acting(ps)
+                    out[f"1.{ps}"] = (bool(acting)
+                                      and acting[0] == self.osd_id
+                                      and be.acting == acting
+                                      and ps not in self._recovering)
+                return out
         raise ValueError(f"unknown admin command {cmd!r}; "
                          f"known: {list(self._ADMIN_CMDS)}")
 
@@ -2530,31 +2736,62 @@ class OSDDaemon:
                 return
         if msg.kind == "admin":
             # the operator side door bypasses the op queue (like the
-            # asok): it must answer even when the queue is wedged
-            try:
-                d = Decoder(msg.blob)
-                rep = MOSDOpReply(msg.req_id, True, msg.kind,
-                                  self._admin_cmd(d.string()))
-            except Exception as e:   # noqa: BLE001 — reply, don't die
-                rep = MOSDOpReply(msg.req_id, False, msg.kind,
-                                  err=f"{type(e).__name__}:{e}")
-            try:
-                self.msgr.send(peer, rep)
-            except (KeyError, OSError, ConnectionError):
-                pass
+            # asok): it must answer even when the queue is wedged.
+            # Own thread — some admin views take the daemon lock,
+            # which a mid-reconcile fold can hold for remote-rpc
+            # timescales, and a reactor must never wait that out
+            def _serve_admin():
+                try:
+                    d = Decoder(msg.blob)
+                    rep = MOSDOpReply(msg.req_id, True, msg.kind,
+                                      self._admin_cmd(d.string()))
+                except Exception as e:  # noqa: BLE001 — reply, don't
+                    rep = MOSDOpReply(msg.req_id, False, msg.kind,
+                                      err=f"{type(e).__name__}:{e}")
+                try:
+                    self.msgr.send(peer, rep)
+                except (KeyError, OSError, ConnectionError):
+                    pass
+            threading.Thread(target=_serve_admin, daemon=True).start()
             return
-        # mClock admission: PG ops queue under their QoS class and a
-        # single worker drains in tag order — during recovery a client
-        # op waits behind at most one recovery batch grant, not the
-        # whole rebuild (the pre-r10 inline path held the daemon lock
-        # for the full multi-second round). Client ops land in their
-        # PER-TENANT class (one per client entity), so a heavy tenant
-        # — hedged duplicates and degraded decodes included — competes
-        # under its own (ρ, w, λ) tags instead of starving the rest.
-        cls = "scrub" if msg.kind in ("deep_scrub", "repair") \
-            else self._client_class(peer)
-        self._sched_enqueue(
-            cls, lambda: self._serve_client_op(peer, msg, sub_ops))
+        # mClock SHARDED admission: PG ops hash by their leading PG id
+        # to an op shard and queue under their QoS class; each shard
+        # worker drains in tag order — during recovery a client op
+        # waits behind at most one recovery batch grant OF ITS SHARD,
+        # not the whole rebuild, and ops to independent PGs dispatch
+        # concurrently. Client ops land in their PER-TENANT class (one
+        # per client entity per shard), so a heavy tenant — hedged
+        # duplicates and degraded decodes included — competes under
+        # its own (ρ, w, λ) tags instead of starving the rest.
+        if sub_ops is None:
+            shard = self._shard_of(self._op_ps(msg.blob))
+            cls = "scrub" if msg.kind in ("deep_scrub", "repair") \
+                else self._client_class(peer, shard)
+            self._sched_enqueue(
+                cls, lambda: self._serve_client_op(peer, msg, None),
+                shard=shard)
+            return
+        # batch frame: split the sub-ops by shard (a batch groups by
+        # PRIMARY, so one frame may span PGs in different shards);
+        # every shard executes its slots FIFO — per-PG order holds —
+        # and the last shard to finish assembles + sends the reply
+        groups: dict[int, list] = {}
+        for slot, (kind, body) in enumerate(sub_ops):
+            sh = self._shard_of(self._op_ps(body))
+            groups.setdefault(sh.idx, []).append((slot, kind, body))
+        if len(groups) == 1:
+            shard = self.op_shards[next(iter(groups))]
+            cls = self._client_class(peer, shard)
+            self._sched_enqueue(
+                cls, lambda: self._serve_client_op(peer, msg, sub_ops),
+                shard=shard)
+            return
+        join = _BatchJoin(self, peer, msg, len(sub_ops), len(groups))
+        for idx, items in groups.items():
+            shard = self.op_shards[idx]
+            cls = self._client_class(peer, shard)
+            self._sched_enqueue(
+                cls, lambda items=items: join.run(items), shard=shard)
 
     def _serve_client_op(self, peer: str, msg: MOSDOp,
                          sub_ops) -> None:
@@ -2589,7 +2826,11 @@ class OSDDaemon:
         with span("osd.op", counters=self.perf, key="op_latency"):
             with self.op_tracker.create_op(
                     f"osd_op({kind}) client={peer}") as op:
-                with self._lock:
+                # per-PG execution lock, not the daemon lock: ops to
+                # independent PGs really do run concurrently across
+                # shards; reconcile/recovery exclude themselves per PG
+                # (they take self._lock THEN the PG locks they touch)
+                with self._pg_lock(self._op_ps(body)):
                     op.mark_event("reached_pg")
                     blob = self._client_op(kind, body)
                 op.mark_event("commit_sent")
@@ -3026,36 +3267,45 @@ class OSDDaemon:
                 self._last_scrub[ps] = now
                 if deep_due:
                     self._last_deep[ps] = now
-                try:
-                    if deep_due:
-                        rep = be.deep_scrub(
-                            dead_osds=set(self.suspect))
-                        rep["kind"] = "deep"
-                        found = (rep["inconsistent"]
-                                 or rep.get("digest_mismatch"))
-                        if found and bool(
-                                self.config["osd_scrub_auto_repair"]):
-                            be.repair_pg(dead_osds=set(self.suspect))
-                            rep["auto_repaired"] = True
-                    else:
-                        rep = be.shallow_scrub(
-                            skip_slots={s for s, o in
-                                        enumerate(be.acting)
-                                        if o in self.suspect})
-                        rep["kind"] = "shallow"
-                    rep["at"] = now
-                    self.scrub_reports[ps] = rep
-                    bad = (rep.get("inconsistent") or rep.get("errors")
-                           or rep.get("digest_mismatch"))
-                    if bad:
-                        self.c.log(f"{self.name}: scheduled "
-                                   f"{rep['kind']} scrub pg 1.{ps}: "
-                                   f"{len(bad)} inconsistenc(ies)")
-                except Exception as e:   # noqa: BLE001 — scrub must
-                    self.c.log(f"{self.name}: scheduled scrub pg "
-                               f"1.{ps} failed: {e}")  # not kill hb
+                # PG lock: client ops no longer ride the daemon lock,
+                # so the scrub read sweep must exclude them itself
+                with self._pg_lock(ps):
+                    self._run_scheduled_scrub(ps, be, deep_due, now)
         finally:
             self._lock.release()
+
+    def _run_scheduled_scrub(self, ps: int, be, deep_due: bool,
+                             now: float) -> None:
+        """Execute one due scrub. Caller holds self._lock + the PG
+        lock (see _maybe_scheduled_scrub)."""
+        try:
+            if deep_due:
+                rep = be.deep_scrub(
+                    dead_osds=set(self.suspect))
+                rep["kind"] = "deep"
+                found = (rep["inconsistent"]
+                         or rep.get("digest_mismatch"))
+                if found and bool(
+                        self.config["osd_scrub_auto_repair"]):
+                    be.repair_pg(dead_osds=set(self.suspect))
+                    rep["auto_repaired"] = True
+            else:
+                rep = be.shallow_scrub(
+                    skip_slots={s for s, o in
+                                enumerate(be.acting)
+                                if o in self.suspect})
+                rep["kind"] = "shallow"
+            rep["at"] = now
+            self.scrub_reports[ps] = rep
+            bad = (rep.get("inconsistent") or rep.get("errors")
+                   or rep.get("digest_mismatch"))
+            if bad:
+                self.c.log(f"{self.name}: scheduled "
+                           f"{rep['kind']} scrub pg 1.{ps}: "
+                           f"{len(bad)} inconsistenc(ies)")
+        except Exception as e:   # noqa: BLE001 — scrub must
+            self.c.log(f"{self.name}: scheduled scrub pg "
+                       f"1.{ps} failed: {e}")  # not kill hb
 
     def _heartbeat_loop(self) -> None:
         beat = 0
@@ -3209,7 +3459,9 @@ class OSDDaemon:
         fresh = OSDDaemon.__new__(OSDDaemon)
         fresh.__dict__.update(self.__dict__)
         fresh.msgr = Messenger(self.name, secret=self.c.secret,
-                               compress=self.c.compress)
+                               compress=self.c.compress,
+                               workers=self.c.msgr_workers,
+                               uds=self.c.msgr_uds)
         fresh.rpc = _Rpc(fresh.msgr, MStoreReply.type_id)
         fresh.backends = {}
         fresh.snapsets = {}
@@ -3262,7 +3514,9 @@ class MonDaemon:
         self.c = cluster
         self.name = f"mon.{rank}"
         self.msgr = Messenger(self.name, secret=cluster.secret,
-                              compress=cluster.compress)
+                              compress=cluster.compress,
+                              workers=cluster.msgr_workers,
+                              uds=cluster.msgr_uds)
         self.osdmap = osdmap            # the COMMITTED map, only
         # -- acceptor state (the peon role) --
         self._promised = 0              # highest pn promised
@@ -4425,7 +4679,9 @@ class Client:
         from ..utils.perf_counters import PerfCountersBuilder
         self.c = cluster
         self.msgr = Messenger(name, secret=cluster.secret,
-                              compress=cluster.compress)
+                              compress=cluster.compress,
+                              workers=cluster.msgr_workers,
+                              uds=cluster.msgr_uds)
         self.rpc = _Rpc(self.msgr, MOSDOpReply.type_id,
                         window=cluster.op_window if window is None
                         else window,
@@ -5217,7 +5473,9 @@ class StandaloneCluster:
                  hb_interval: float = 0.25, hb_grace: float = 1.2,
                  min_reporters: int = 2, op_timeout: float = 8.0,
                  chunk_size: int = 256, verbose: bool | None = None,
-                 op_window: int = 8, admin_dir: str | None = None):
+                 op_window: int = 8, admin_dir: str | None = None,
+                 op_shards: int = 1, msgr_workers: int = 1,
+                 osd_procs: bool = False, msgr_uds: bool = True):
         import os as _os
         if verbose is None:
             verbose = bool(_os.environ.get("STANDALONE_VERBOSE"))
@@ -5253,6 +5511,14 @@ class StandaloneCluster:
         self.hb_interval, self.hb_grace = hb_interval, hb_grace
         self.min_reporters = min_reporters
         self.op_timeout = op_timeout
+        # concurrency shape (r13): op-queue shards per OSD daemon
+        # (osd_op_num_shards) + epoll reactor threads per messenger
+        self.op_shards = max(1, int(op_shards))
+        self.msgr_workers = max(1, int(msgr_workers))
+        # Unix-domain messenger sockets by default: same frames and
+        # handshake, ~2.5x the loopback-TCP bulk throughput on this
+        # kernel (the whole harness is single-host by construction)
+        self.msgr_uds = bool(msgr_uds)
         # client-side in-flight op window (ops; see Client/_Rpc —
         # 0 disables pipelining, restoring one-op-per-round-trip)
         self.op_window = op_window
@@ -5298,14 +5564,41 @@ class StandaloneCluster:
         self.mons[0].osdmap = osdmap
         for m in self.mons[1:]:
             m.osdmap = OSDMap.decode(osdmap.encode())
-        self.osds = {o: OSDDaemon(o, self) for o in range(n_osds)}
+        # multi-process OSDs (r13): each daemon in its own OS process
+        # — the only way N daemons use N cores under the GIL. Spawn
+        # all children first (imports overlap), then collect ready.
+        self.osd_procs = bool(osd_procs)
+        if self.osd_procs:
+            from .multiproc import OSDProcHandle
+            self.osds = {o: OSDProcHandle(self, o)
+                         for o in range(n_osds)}
+            for h in self.osds.values():
+                h.wait_ready()
+        else:
+            self.osds = {o: OSDDaemon(o, self) for o in range(n_osds)}
         self.clients: list[Client] = []
         self._wire_peers()
         # initial map fan-out (the boot subscription)
         self.mons[0]._broadcast(osdmap.epoch)
-        self._wait(lambda: all(d.osdmap is not None
-                               for d in self.osds.values()), 10,
-                   "initial map fan-out")
+        if self.osd_procs:
+            # children's RAM is unreachable: poll their admin sockets
+            # for a folded epoch instead of reading d.osdmap
+            from ..utils.admin_socket import AdminSocketError
+
+            def _fanned_out() -> bool:
+                for h in self.osds.values():
+                    try:
+                        if h.asok("status",
+                                  timeout=5.0)["osdmap_epoch"] < 1:
+                            return False
+                    except (OSError, AdminSocketError, ValueError):
+                        return False
+                return True
+            self._wait(_fanned_out, 60, "initial map fan-out")
+        else:
+            self._wait(lambda: all(d.osdmap is not None
+                                   for d in self.osds.values()), 10,
+                       "initial map fan-out")
 
     # -- topology ------------------------------------------------------------
 
@@ -5384,7 +5677,10 @@ class StandaloneCluster:
         daemons = list(self.osds.values()) if service == "osd" \
             else self.mons if service == "mon" else []
         for d in daemons:
-            if d.verifier is not None and not d._stop.is_set():
+            # proc-mode OSD handles have no in-RAM verifier to push
+            # to (rotation is in-process-only; see multiproc.py)
+            if getattr(d, "verifier", None) is not None \
+                    and not d._stop.is_set():
                 d.verifier.refresh(rot)
 
     # -- fault injection ------------------------------------------------------
@@ -5398,6 +5694,9 @@ class StandaloneCluster:
         fresh = self.osds[osd].revive()
         self.osds[osd] = fresh
         self._wire_peers()   # registers fresh's new address everywhere
+        if self.osd_procs:
+            fresh.boot()     # the child announces itself to the mons
+            return
         for mon_name in self.mon_names():
             try:
                 fresh.msgr.send(mon_name, MOSDBoot(osd))
@@ -5591,6 +5890,32 @@ class StandaloneCluster:
         budget allows for a loaded host (thread starvation stretches
         every stage; the suite flaked at 15s under full-suite load
         while passing x3 idle)."""
+        if self.osd_procs:
+            from ..utils.admin_socket import AdminSocketError
+
+            def _down_everywhere() -> bool:
+                # the committed map must mark it down AND every live
+                # child must have folded an epoch at least that new
+                epochs = [m.osdmap.epoch for m in self.mons
+                          if not m._stop.is_set()
+                          and m.osdmap is not None
+                          and not m.osdmap.osd_up[osd]]
+                if not epochs:
+                    return False
+                want = min(epochs)
+                for h in self.osds.values():
+                    if h._stop.is_set():
+                        continue
+                    try:
+                        if h.asok("status",
+                                  timeout=5.0)["osdmap_epoch"] < want:
+                            return False
+                    except (OSError, AdminSocketError, ValueError):
+                        return False
+                return True
+            self._wait(_down_everywhere, timeout,
+                       f"osd.{osd} marked down everywhere")
+            return
         self._wait(
             lambda: all(d.osdmap is not None
                         and not d.osdmap.osd_up[osd]
@@ -5600,7 +5925,14 @@ class StandaloneCluster:
 
     def wait_for_clean(self, timeout: float = 30.0) -> None:
         """Every PG's primary hosts a backend whose acting set matches
-        the map and whose shards are all caught up."""
+        the map and whose shards are all caught up. In multi-process
+        mode the parent cannot reach into a child's RAM: it reads the
+        committed map from its in-process monitors and polls each
+        primary child's `pg clean` over the admin socket."""
+        if self.osd_procs:
+            self._wait(self._proc_clean, timeout, "all PGs clean")
+            return
+
         def clean() -> bool:
             for ps in range(self.pg_num):
                 owner = None
@@ -5620,6 +5952,30 @@ class StandaloneCluster:
                     return False   # async rebuild still in flight
             return True
         self._wait(clean, timeout, "all PGs clean")
+
+    def _proc_clean(self) -> bool:
+        from ..utils.admin_socket import AdminSocketError
+        osdmap = next((m.osdmap for m in self.mons
+                       if not m._stop.is_set()
+                       and m.osdmap is not None), None)
+        if osdmap is None:
+            return False
+        claims: dict[str, bool] = {}
+        for h in self.osds.values():
+            if h._stop.is_set():
+                continue
+            try:
+                claims.update(h.asok("pg clean", timeout=5.0))
+            except (OSError, AdminSocketError, ValueError):
+                return False
+        for ps in range(self.pg_num):
+            acting = osdmap.pg_to_up_acting_osds(1, ps)[2]
+            if not acting or not _valid_osd(acting[0],
+                                            len(osdmap.osd_up)):
+                return False
+            if not claims.get(f"1.{ps}", False):
+                return False
+        return True
 
     def shutdown(self) -> None:
         for cl in self.clients:

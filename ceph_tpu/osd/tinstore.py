@@ -174,7 +174,10 @@ def _decode_op(d: Decoder) -> tuple:
         return (kind, d.string(), d.string())
     if kind == "write":
         cid, oid, off = d.string(), d.string(), d.u64()
-        data = np.frombuffer(d.blob(), dtype=np.uint8).copy()
+        # d.blob() already copied the bytes out of the frame; the op
+        # tuple owns them exclusively, so wrapping without a second
+        # .copy() is safe (read-only array — stores only read op data)
+        data = np.frombuffer(d.blob(), dtype=np.uint8)
         return (kind, cid, oid, off, data)
     if kind == "truncate":
         return (kind, d.string(), d.string(), d.u64())

@@ -67,6 +67,17 @@ OPTIONS: list[Option] = [
            "byte budget of one recovery push op (with "
            "osd_recovery_max_active it bounds the windowed-push "
            "in-flight bytes: active * chunk)", min=4096),
+    Option("osd_op_num_shards", int, 1,
+           "op-queue shards per OSD daemon (the reference's sharded "
+           "op work queue): ops hash by PG id to a shard, each shard "
+           "drains its own mClock scheduler on its own worker thread "
+           "— per-PG ordering preserved, independent PGs dispatch "
+           "concurrently. Restart-scoped (like the reference); mClock "
+           "reservations are per shard", min=1, max=64),
+    Option("msgr_reactor_workers", int, 1,
+           "epoll reactor threads per messenger (the "
+           "ms_async_op_threads role): connections bind round-robin "
+           "at handshake. Restart-scoped", min=1, max=16),
     Option("osd_mclock_profile", str, "high_client_ops",
            "mClock built-in profile for the wire-tier op scheduler "
            "(high_client_ops | balanced | high_recovery_ops | "

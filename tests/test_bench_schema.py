@@ -15,11 +15,15 @@ HEDGE_KEYS = {"hedge_issued", "hedge_wins", "hedge_losses",
               "degraded_served"}
 
 
+REACTOR_KEYS = {"loops", "wakeups", "loop_lag_ms_avg",
+                "writeq_flushes", "writeq_stalls"}
+
+
 def test_rados_bench_json_schema(capsys):
     rados_bench.main([
         "seq", "--transport", "standalone", "--insecure",
         "--seconds", "0.4", "--object-size", "2048", "--batch", "2",
-        "--num-osds", "4", "--pg-num", "2",
+        "--num-osds", "4", "--pg-num", "2", "--op-shards", "2",
         "--profile", "plugin=tpu_rs k=2 m=1 impl=bitlinear",
         "--tenants", "2", "--hedge-delay-ms", "30", "--json"])
     out = json.loads(capsys.readouterr().out)
@@ -40,6 +44,55 @@ def test_rados_bench_json_schema(capsys):
     # attribution rides along (the r9 discipline): perf deltas exist
     assert "osd_total" in out["perf_delta"]
     assert "client" in out["perf_delta"]
+    # r13: sharded-OSD + reactor attribution — per-shard occupancy
+    # per daemon (every shard key present, counts are ints) and the
+    # reactor loop-lag block the acceptance numbers are read from
+    assert out["config"]["op_shards"] == 2
+    assert out["config"]["msgr_workers"] == 1
+    assert out["config"]["osd_procs"] is False
+    assert out["shards"], "per-shard occupancy missing"
+    served_total = 0
+    for osd_name, shards in out["shards"].items():
+        assert set(shards) == {"shard_0", "shard_1"}, osd_name
+        for row in shards.values():
+            assert isinstance(row["served"], int)
+            assert isinstance(row["queued"], int)
+            served_total += row["served"]
+    assert served_total > 0
+    assert REACTOR_KEYS <= set(out["reactor"])
+    assert out["reactor"]["loops"] > 0
+
+
+def test_bench_r13_artifact_pinned():
+    """The committed r13 wire-bench artifact: schema keys CI parses,
+    interleaved-median protocol evidence, and the floors the numbers
+    must not silently regress below when re-committed."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r13.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "wire_r13/1"
+    base = data["baselines"]["r12_head_measured"]
+    r13 = data["r13"]
+    for series in (base["write"], base["seq"], r13["write_default"],
+                   r13["write_op_shards2"], r13["seq_default"]):
+        assert len(series["mb_per_s_runs"]) >= 2
+        assert series["mb_per_s_median"] > 0
+    # the committed claim: r13 write beats the measured interleaved
+    # r12 baseline; seq stays within noise of it
+    assert (r13["write_op_shards2"]["mb_per_s_median"]
+            > base["write"]["mb_per_s_median"])
+    assert (r13["seq_default"]["mb_per_s_median"]
+            > 0.9 * base["seq"]["mb_per_s_median"])
+    acc = data["acceptance"]
+    assert acc["write_vs_measured_baseline"] >= 1.1
+    # per-shard + reactor attribution rides the committed cells
+    cell = data["cells"]["write_op_shards2"]
+    assert cell["config"]["op_shards"] == 2
+    assert cell["shards"] and cell["reactor"]["loops"] > 0
+    # the multi-process cell is present and annotated for 1-core
+    assert "write_osd_procs_1core" in r13
+    assert data["cells"]["write_osd_procs"]["config"]["osd_procs"]
 
 
 REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",

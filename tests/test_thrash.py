@@ -55,6 +55,33 @@ def test_thrash_matrix(seed, store, tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.parametrize("seed,store", [(43, "mem")])
+def test_thrash_sharded_smoke(seed, store, tmp_path):
+    """r13 tier-1 cell: `osd_op_num_shards = 2` + the reactor
+    messenger under the full fault schedule (kills land mid-window
+    via socket injection) — exactly-once and no-resurrection must
+    hold when ops hash across per-shard mClock queues."""
+    th = Thrasher(seed, store=store, rounds=2, ops=6, op_shards=2,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store", [(53, "tin"), (61, "mem")])
+def test_thrash_sharded_matrix(seed, store, tmp_path):
+    """Deeper sharded-dispatch cells (`-m chaos`): 4 shards, both
+    stores — beyond the tier-1 2-shard representative."""
+    th = Thrasher(seed, store=store, rounds=2, ops=6, op_shards=4,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
 @pytest.mark.slow
 @pytest.mark.parametrize("seed,store", [(19, "mem"), (31, "tin")])
 def test_thrash_degraded_reads_never_block(seed, store, tmp_path):

@@ -97,6 +97,22 @@ def main(argv=None) -> None:
                          "committed live via client_hedge_delay_ms "
                          "(0 = auto from latency history, < 0 = off; "
                          "default: leave the cluster default)")
+    ap.add_argument("--op-shards", type=int, default=1,
+                    help="standalone: osd_op_num_shards — op-queue "
+                         "shards per OSD daemon (ops hash by PG id; "
+                         "per-PG ordering preserved, independent PGs "
+                         "dispatch concurrently); the JSON gains "
+                         "per-shard occupancy")
+    ap.add_argument("--msgr-workers", type=int, default=1,
+                    help="standalone: epoll reactor threads per "
+                         "messenger (connections bind round-robin)")
+    ap.add_argument("--osd-procs", action="store_true",
+                    help="standalone: run every OSD daemon as its OWN "
+                         "OS process (multi-core scale-out — the GIL "
+                         "stops mattering; on a 1-core host expect "
+                         "parity, not speedup). Implies --store tin "
+                         "semantics for revive; shares the persistent "
+                         "jit cache across children")
     ap.add_argument("--tenants", type=int, default=1,
                     help="standalone: run ops round-robin across N "
                          "client entities (per-tenant mClock classes "
@@ -116,6 +132,14 @@ def main(argv=None) -> None:
             and args.transport != "standalone":
         raise SystemExit("rados_bench: --tenants/--hedge-delay-ms "
                          "need --transport standalone")
+    if (args.op_shards != 1 or args.msgr_workers != 1
+            or args.osd_procs) and args.transport != "standalone":
+        raise SystemExit("rados_bench: --op-shards/--msgr-workers/"
+                         "--osd-procs need --transport standalone")
+    if args.osd_procs and (args.tenants > 1 or args.recovery_kill):
+        raise SystemExit("rados_bench: --osd-procs composes with the "
+                         "plain write/seq workloads (tenant/recovery-"
+                         "kill attribution reads daemon RAM)")
 
     # persistent jit cache: a cold bench process stops re-paying every
     # XLA compile (the r09 cold-recovery tax); native codecs build once
@@ -145,7 +169,11 @@ def main(argv=None) -> None:
                 # rpc timeout before the suspect-marked degraded retry
                 # — at 15s that single stall eats a whole bench window
                 op_timeout=3.0,
-                op_window=args.window)
+                op_window=args.window,
+                op_shards=args.op_shards,
+                msgr_workers=args.msgr_workers,
+                osd_procs=args.osd_procs,
+                store="tin" if args.osd_procs else "mem")
         except ValueError as e:
             raise SystemExit(f"rados_bench: {e}")
         c.wait_for_clean(timeout=30)
@@ -187,18 +215,45 @@ def main(argv=None) -> None:
                     tenant % len(tenant_clients)].read_many(names)
         ob = _WireOb()
 
+        def _osd_perf(d):
+            # in-process daemons dump directly; multi-process handles
+            # answer over their admin socket (same declared counters)
+            if hasattr(d, "perf_dump_all"):
+                return d.perf_dump_all()
+            return d.asok("perf dump")
+
         def perf_snapshot():
             """Perf dumps of every live daemon + the bench client —
             before/after deltas ship in the JSON so the bench carries
             its own per-stage attribution (msgr frames, op-window
             stalls, encode launches, cephx rounds, hedge wins)."""
-            snap = {d.name: d.perf_dump_all()
+            snap = {d.name: _osd_perf(d)
                     for d in c.osds.values() if not d._stop.is_set()}
             snap["client"] = {
                 "rpc": wire_client.rpc.perf.dump(),
                 "msgr": wire_client.msgr.perf.dump(),
                 "hedge": wire_client.perf.dump()}
             return snap
+
+        def shard_occupancy():
+            """Per-OSD, per-shard grant counts (the hash-spread view):
+            the acceptance artifact's per-shard occupancy."""
+            out = {}
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                try:
+                    dump = d.shard_dump() if hasattr(d, "shard_dump") \
+                        else d.asok("dump_op_shards")
+                except Exception:   # noqa: BLE001 — a dying daemon
+                    continue        # drops out of the attribution
+                out[d.name] = {
+                    sh: {"served": sum(r["served"]
+                                       for r in classes.values()),
+                         "queued": sum(r["queued"]
+                                       for r in classes.values())}
+                    for sh, classes in dump.items()}
+            return out
     else:
         from ceph_tpu.client.rados import Rados
         from ceph_tpu.osd.cluster import SimCluster
@@ -421,6 +476,27 @@ def main(argv=None) -> None:
         # so CI can parse them (tier-1 smoke asserts this schema)
         out["config"]["tenants"] = args.tenants
         out["config"]["hedge_delay_ms"] = args.hedge_delay_ms
+        # r13 concurrency shape + its attribution: per-shard op-queue
+        # occupancy and the reactors' loop-lag (time a loop spent out
+        # of select — what concurrent connections wait on)
+        out["config"]["op_shards"] = args.op_shards
+        out["config"]["msgr_workers"] = args.msgr_workers
+        out["config"]["osd_procs"] = args.osd_procs
+        out["shards"] = shard_occupancy()
+        msgr_d = perf_delta.get("osd_total", {}).get("msgr", {})
+
+        def _avg_ms(key):
+            row = msgr_d.get(key) or {}
+            cnt = row.get("avgcount") or 0
+            return round(1e3 * row.get("sum", 0.0) / cnt, 6) \
+                if cnt else 0.0
+        out["reactor"] = {
+            "loops": msgr_d.get("reactor_loops", 0),
+            "wakeups": msgr_d.get("reactor_wakeups", 0),
+            "loop_lag_ms_avg": _avg_ms("reactor_stall_time"),
+            "writeq_flushes": msgr_d.get("writeq_flushes", 0),
+            "writeq_stalls": msgr_d.get("writeq_stalls", 0),
+        }
         agg = {k: 0 for k in ("hedge_issued", "hedge_wins",
                               "hedge_losses", "hedge_cancelled",
                               "degraded_dispatch", "degraded_served")}
@@ -450,7 +526,7 @@ def main(argv=None) -> None:
             "op_errors": op_errors,
             "pre_kill": percentiles(pre),
             "post_kill": percentiles(post),
-            "mclock": {d.name: d.op_sched.dump()
+            "mclock": {d.name: d.sched_dump()
                        for d in c.osds.values()
                        if not d._stop.is_set()},
         }

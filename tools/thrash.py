@@ -26,12 +26,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_cell(seed: int, store: str, rounds: int, ops: int,
-             verbose: bool) -> dict:
+             verbose: bool, op_shards: int = 1) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     tmp = tempfile.mkdtemp(prefix=f"thrash-{seed}-") \
         if store == "tin" else None
     th = Thrasher(seed, store=store, rounds=rounds, ops=ops,
-                  store_dir=tmp, verbose=verbose)
+                  store_dir=tmp, verbose=verbose, op_shards=op_shards)
     try:
         report = th.run()
         report["ok"] = True
@@ -56,6 +56,9 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=2)
     ap.add_argument("--ops", type=int, default=6,
                     help="fault/IO actions per round")
+    ap.add_argument("--op-shards", type=int, default=1,
+                    help="osd_op_num_shards on every OSD (r13 "
+                         "sharded dispatch under chaos)")
     ap.add_argument("--matrix", type=int, metavar="N",
                     help="run seeds 1..N instead of one --seed")
     ap.add_argument("--repro", action="store_true",
@@ -80,7 +83,7 @@ def main() -> int:
     failed = 0
     for seed in seeds:
         rep = run_cell(seed, args.store, args.rounds, args.ops,
-                       verbose=args.repro)
+                       verbose=args.repro, op_shards=args.op_shards)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
