@@ -433,6 +433,54 @@ class Clay(ErasureCode):
             self._affine_cache[key] = fn
         return fn
 
+    def range_batch_decoder(self, erasures: Sequence[int],
+                            survivors: Sequence[int]):
+        """Sub-chunk-granular MSR repair for the range-read wire path:
+        one jittable fn mapping the helpers' SHIPPED repair planes
+        (B, d, rl) — rl = beta * sub_size, each row the concatenation
+        of that helper's repair planes in ascending plane order — to
+        the rebuilt chunk (B, 1, q^t * sub_size). Unlike batch_decoder
+        the plane selection already happened at the SOURCE (the readv
+        range list), so the wire moved only beta/q^t of each helper
+        row; the device just applies the cached repair matrix."""
+        erasures = tuple(int(e) for e in erasures)
+        survivors = tuple(int(s) for s in survivors)
+        if len(erasures) != 1 or len(survivors) != self.d \
+                or self.impl == "ref":
+            return None
+        key = ("bdr", erasures, survivors)
+        fn = self._affine_cache.get(key)
+        if fn is None:
+            from ..ops.rs_kernels import make_encoder
+            D, planes = self.repair_plan_matrix(erasures[0], survivors)
+            mfn = make_encoder(D, self.impl)
+            beta = len(planes)
+            P = self.sub_chunk_count
+
+            def fn(stack):                  # (B, H, rl) u8
+                B, H_, rl = stack.shape
+                if rl % beta:
+                    raise ValueError(
+                        f"range row length {rl} not divisible into "
+                        f"{beta} repair planes")
+                s = rl // beta
+                # helper-major, plane-minor — the repair matrix's
+                # input order (const_idx in _affine_repair)
+                out = mfn(stack.reshape(B, H_ * beta, s))  # (B, P, s)
+                return out.reshape(B, 1, P * s)
+            self._affine_cache[key] = fn
+        return fn
+
+    def range_decode_program_key(self, erasures: Sequence[int],
+                                 survivors: Sequence[int]):
+        erasures = tuple(int(e) for e in erasures)
+        survivors = tuple(int(s) for s in survivors)
+        if self.range_batch_decoder(erasures, survivors) is None:
+            return None
+        D, planes = self.repair_plan_matrix(erasures[0], survivors)
+        return ("clayrng", D.tobytes(), D.shape, tuple(planes),
+                self.impl)
+
     # -- data paths ---------------------------------------------------------
 
     def _apply(self, D: np.ndarray, stacked: np.ndarray) -> np.ndarray:
@@ -533,24 +581,49 @@ class Clay(ErasureCode):
         return set(avail)
 
     def _pick_helpers(self, failed_chunk: int,
-                      candidates: Sequence[int]) -> list[int]:
+                      candidates: Sequence[int],
+                      costs: Mapping[int, int] | None = None) -> list[int]:
         """Choose d helpers for a single-chunk repair.
 
         The failed node's non-repair-plane sub-chunks are coupled only
         with its grid-COLUMN mates, so every surviving same-column chunk
         must be a helper or the repair system is underdetermined; the
-        remaining slots are filled with the lowest surviving ids.
+        remaining slots are filled with the cheapest surviving ids
+        (lowest id when no costs are given).
         """
         _, y0 = self._xy(self._node_of_chunk(failed_chunk))
         cand = sorted(set(candidates) - {failed_chunk})
         mates = [c for c in cand
                  if self._xy(self._node_of_chunk(c))[1] == y0]
         rest = [c for c in cand if c not in set(mates)]
+        if costs:
+            rest.sort(key=lambda c: (int(costs.get(c, 0)), c))
         # at most q-1 = d-k column mates survive, so mates never fill d
         helpers = sorted(mates + rest[:self.d - len(mates)])
         if len(helpers) < self.d:
             raise ValueError(f"need {self.d} helpers, have {len(helpers)}")
         return helpers
+
+    def minimum_to_decode_with_cost(self, want_to_read: Sequence[int],
+                                    available: Mapping[int, int]) -> set[int]:
+        """Cost-aware override: the MDS default's 'k cheapest' is wrong
+        for a coupled code (single-loss repair needs d helpers
+        INCLUDING every surviving grid-column mate; multi-loss consumes
+        every survivor), so pick structurally and spend the costs only
+        on the free helper slots."""
+        want = set(want_to_read)
+        avail = set(available)
+        missing = want - avail
+        if not missing:
+            return want
+        if len(missing) == 1:
+            helpers = sorted(avail - want)
+            if len(helpers) >= self.d:
+                failed = next(iter(missing))
+                return set(self._pick_helpers(failed, helpers,
+                                              costs=available)) \
+                    | (want & avail)
+        return self.minimum_to_decode(sorted(want), sorted(avail))
 
     def minimum_to_decode_subchunks(
             self, failed_chunk: int,

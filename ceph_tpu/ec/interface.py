@@ -122,6 +122,23 @@ class ErasureCode(abc.ABC):
                         "lin", R.tobytes(), R.shape, impl)
         return fn or None
 
+    def range_batch_decoder(self, erasures: Sequence[int],
+                            survivors: Sequence[int]):
+        """Optional sub-chunk fast path: a jitted fn mapping the
+        helpers' PLANNED BYTE RANGES — stacked (B, H, rl) uint8 where
+        rl = row_bytes(shard_len) of the repair plan — to the rebuilt
+        full chunks (B, len(erasures), shard_len). Only codecs whose
+        repair touches a strict sub-range of each helper (Clay/MSR)
+        provide one; None means the planner ships full rows and
+        batch_decoder applies."""
+        return None
+
+    def range_decode_program_key(self, erasures: Sequence[int],
+                                 survivors: Sequence[int]):
+        """Process-wide program identity for range_batch_decoder
+        (same sharing contract as decode_program_key)."""
+        return None
+
     def decode_program_key(self, erasures: Sequence[int],
                            survivors: Sequence[int]):
         """Hashable identity of batch_decoder's compiled program, EQUAL

@@ -165,6 +165,11 @@ class Lrc(ErasureCode):
             lprof = profile_from_string(lprof_s) if isinstance(
                 lprof_s, str) and lprof_s else dict(lprof_s or {})
             lprof.setdefault("plugin", "tpu_rs")
+            if "impl" in profile:
+                # the top-level impl choice reaches the layer coders
+                # (k/m/l expansions carry empty layer profiles, which
+                # otherwise pinned every layer to the plugin default)
+                lprof.setdefault("impl", profile["impl"])
             lprof["k"] = str(len(d_pos))
             lprof["m"] = str(len(c_pos))
             self.layers.append(_Layer(d_pos, c_pos, factory(lprof)))

@@ -146,18 +146,23 @@ class Shec(ErasureCode):
     # -- recovery planning --------------------------------------------------
 
     def _plan(self, unknown_data: frozenset[int], want: frozenset[int],
-              avail: frozenset[int]) -> tuple[set[int], tuple[int, ...]]:
+              avail: frozenset[int],
+              costs: Mapping[int, int] | None = None
+              ) -> tuple[set[int], tuple[int, ...]]:
         """Choose the cheapest survivor set able to produce `want`.
 
         Search: parity subsets of the available parities in increasing
         total-read order; a subset works if every wanted chunk's G row
         lies in the rowspace of [available window data rows + parity
         rows]. Returns (chunks to read, survivor order for decode).
+        With `costs`, fewest reads still wins first (the shingle
+        locality is the point of SHEC) and per-chunk costs break ties
+        among equal-sized candidate sets.
         """
         avail_par = sorted(p for p in avail if p >= self.k)
         avail_data = frozenset(j for j in avail if j < self.k)
         want_rows = self.G[sorted(want)]
-        best: tuple[int, set[int], tuple[int, ...]] | None = None
+        best: tuple[tuple, set[int], tuple[int, ...]] | None = None
         # re-encoding a wanted (lost) parity consumes its own window data
         want_par_data: set[int] = set()
         for w in want:
@@ -179,7 +184,9 @@ class Shec(ErasureCode):
                     continue
                 if gf_express(self.G[list(surv_all)], want_rows) is None:
                     continue
-                cost = len(surv_all)
+                cost = (len(surv_all),
+                        sum(int(costs.get(c, 0)) for c in surv_all)
+                        if costs else 0)
                 if best is None or cost < best[0]:
                     best = (cost, set(surv_all), surv_all)
             if best is not None:
@@ -206,6 +213,24 @@ class Shec(ErasureCode):
             hit = self._plan(unknown, want, avail)[0]
             self._mtd_cache[key] = hit
         return set(hit)
+
+    def minimum_to_decode_with_cost(self, want_to_read: Sequence[int],
+                                    available: Mapping[int, int]) -> set[int]:
+        """Structural like minimum_to_decode — the MDS default's 'k
+        cheapest' can be an undecodable set for a shingled matrix —
+        with per-chunk costs breaking ties among the smallest
+        workable survivor sets."""
+        want = frozenset(want_to_read)
+        avail = frozenset(available)
+        n = self.get_chunk_count()
+        bad = [i for i in want | avail if not 0 <= i < n]
+        if bad:
+            raise ValueError(f"chunk ids must be in [0, {n}), got {sorted(bad)}")
+        if want <= avail:
+            return set(want)
+        unknown = frozenset(j for j in range(self.k) if j not in avail)
+        return set(self._plan(unknown, want, avail,
+                              costs=available)[0])
 
     # -- codec --------------------------------------------------------------
 

@@ -50,6 +50,7 @@ from ..utils.perf_counters import PerfCountersBuilder
 from ..utils.tracing import span
 from .memstore import MemStore, Transaction
 from .pgbackend import HINFO_KEY, PGBackend, shard_cid  # noqa: F401
+from .repairplan import plan_read, plan_repair
 from .stripe import HashInfo, StripeInfo, as_flat_u8
 
 
@@ -86,6 +87,21 @@ def ec_perf_counters():
                              "helper chunks failing hinfo verify")
             .add_u64_counter("read_eio",
                              "read-path chunk crc mismatches")
+            .add_u64_counter("planner_local_plans",
+                             "repairs planned inside one LRC local "
+                             "group (repair-locality planner)")
+            .add_u64_counter("planner_subchunk_plans",
+                             "repairs planned as Clay/MSR sub-chunk "
+                             "range reads")
+            .add_u64_counter("planner_cost_plans",
+                             "cost-ranked helper selections (SHEC "
+                             "windows / MDS cheapest-k)")
+            .add_u64_counter("planner_full_plans",
+                             "plans laddered to a full/multi-loss "
+                             "decode (locality broken or multi-loss)")
+            .add_u64_counter("recover_wire_bytes",
+                             "helper bytes pulled for recovery (the "
+                             "repair-bytes-on-wire numerator)")
             .add_time_avg("encode_time", "write-path encode wall time")
             .add_time_avg("decode_time", "read-path decode wall time")
             .add_time_avg("recover_stage_time",
@@ -593,7 +609,9 @@ class ECBackend(PGBackend):
     def read_objects(self, names: list[str],
                      dead_osds: set[int] | None = None,
                      verify: bool = True,
-                     repair: bool = True) -> dict[str, np.ndarray]:
+                     repair: bool = True,
+                     helper_costs: dict[int, int] | None = None
+                     ) -> dict[str, np.ndarray]:
         """Batched reads with BlueStore-style verify-on-read: every
         chunk consumed is CRC-checked against its stored hinfo in one
         batched launch (ref: BlueStore::_verify_csum on every read);
@@ -602,7 +620,13 @@ class ECBackend(PGBackend):
         the read-error recovery qa/standalone/erasure-code/
         test-erasure-eio.sh exercises). repair=False keeps the
         re-decode but skips the writeback — the read-only contract of
-        a degraded-read view served by a non-primary."""
+        a degraded-read view served by a non-primary.
+
+        Degraded reads gather through the repair-locality planner
+        (plan_read): an LRC single-shard loss pulls its local group
+        instead of any-k, and `helper_costs` (slot -> cost) biases
+        which survivors serve (the daemon's complaint/latency
+        memory)."""
         dead = dead_osds or set()
         alive = [s for s in range(self.n)
                  if self.acting[s] not in dead]
@@ -622,9 +646,13 @@ class ECBackend(PGBackend):
             # for it and must not serve (it replays on rejoin)
             avail = self._fresh_for(group, alive)
             while True:
-                # minimum_to_decode raises when the survivors can't
-                # cover `want` — the caller's retry boundary
-                need = sorted(self.coder.minimum_to_decode(want, avail))
+                # the planner raises when the survivors can't cover
+                # `want` — the caller's retry boundary
+                need_set, family = plan_read(self.coder, want, avail,
+                                             costs=helper_costs)
+                if family != "direct":
+                    self._count_plan(family)
+                need = sorted(need_set)
                 stacks, missing = {}, None
                 for s in need:
                     try:
@@ -691,7 +719,7 @@ class ECBackend(PGBackend):
         bad = set(bad)
         while True:
             ok_shards = [s for s in avail if s not in bad]
-            need = sorted(self.coder.minimum_to_decode(want, ok_shards))
+            need = sorted(plan_read(self.coder, want, ok_shards)[0])
             stacks = {}
             newly_bad = False
             for s in need:
@@ -776,11 +804,21 @@ class ECBackend(PGBackend):
 
     # -- recovery (the objects/s metric) -------------------------------------
 
+    def _count_plan(self, family: str) -> None:
+        """Fold a planner decision into the declared counters."""
+        key = {"lrc_local": "planner_local_plans",
+               "clay_planes": "planner_subchunk_plans",
+               "shec_cost": "planner_cost_plans",
+               "mds": "planner_cost_plans"}.get(family,
+                                                "planner_full_plans")
+        self.perf.inc(key)
+
     def plan_recovery(self, lost_shards: list[int],
                       replacement_osds: dict[int, int] | None = None,
                       verify_hinfo: bool = True,
                       names: list[str] | None = None,
-                      helper_exclude: set[int] | None = None
+                      helper_exclude: set[int] | None = None,
+                      helper_costs: dict[int, int] | None = None
                       ) -> "_RecoveryPlan":
         """Open one PG's recovery intent: validate the plan, point the
         lost slots at their replacement OSDs, replay deletes and empty
@@ -790,7 +828,14 @@ class ECBackend(PGBackend):
         cross-PG batch formation the per-PG reconcile round lacked).
         Raises ValueError before any mutation when the plan is
         impossible (insufficient live helpers), exactly like the old
-        monolithic recover_shards."""
+        monolithic recover_shards.
+
+        Helper selection goes through the repair-locality planner
+        (repairplan.plan_repair): LRC single-loss reads one local
+        group, Clay single-loss reads only the repair planes (the
+        runner ships sub-chunk ranges), SHEC/RS rank by the optional
+        per-helper `helper_costs` (slot -> cost; the daemon feeds its
+        complaint memory + peer-latency EWMAs)."""
         lost = sorted(set(lost_shards))
         if len(lost) > self.m:
             raise ValueError(f"{len(lost)} lost shards exceeds m={self.m}")
@@ -807,11 +852,15 @@ class ECBackend(PGBackend):
         rebuild = [n for n in names if n in self.object_sizes]
         survivors: list[int] = []
         helper: list[int] = []
+        repair = None
         if rebuild:
             survivors = self._fresh_for(
                 rebuild, [s for s in range(self.n)
                           if s not in lost and s not in excluded])
-            helper = sorted(self.coder.minimum_to_decode(lost, survivors))
+            repair = plan_repair(self.coder, lost, survivors,
+                                 costs=helper_costs)
+            helper = sorted(repair.helpers)
+            self._count_plan(repair.family)
         repl = replacement_osds or {}
         for s in lost:
             new_osd = repl.get(s, self.acting[s])
@@ -820,6 +869,7 @@ class ECBackend(PGBackend):
             self.cluster.osd(new_osd).queue_transaction(t)
         plan = _RecoveryPlan(self, lost, helper, survivors,
                              verify_hinfo, full_plan, provided)
+        plan.repair = repair
         # names whose last log entry was a DELETE replay as removals
         names = self._replay_deletes(lost, names)
 
@@ -844,12 +894,25 @@ class ECBackend(PGBackend):
         plan.remaining = {n for g in plan.names_by_len.values()
                           for n in g}
         if plan.names_by_len:
-            plan.dec_fn = self.coder.batch_decoder(lost, helper)
-            if plan.dec_fn is not None:
-                key = self.coder.decode_program_key(lost, helper)
-                # id()-keyed fallbacks stay in the BACKEND's cache (a
-                # process-wide id key could alias a dead object)
-                plan.group_key = key if key is not None else None
+            if repair is not None and repair.planes is not None:
+                # sub-chunk wire reads: stage only the repair planes
+                # and decode through the range program — the helper
+                # bytes on the wire drop to wire_fraction of a full
+                # pull (beta/q^t for Clay)
+                fn = self.coder.range_batch_decoder(lost, helper)
+                if fn is not None:
+                    plan.dec_fn = fn
+                    plan.group_key = self.coder. \
+                        range_decode_program_key(lost, helper)
+                    plan.range_planes = repair.planes
+                    plan.sub_count = repair.sub_chunk_count
+            if plan.dec_fn is None:
+                plan.dec_fn = self.coder.batch_decoder(lost, helper)
+                if plan.dec_fn is not None:
+                    key = self.coder.decode_program_key(lost, helper)
+                    # id()-keyed fallbacks stay in the BACKEND's cache
+                    # (a process-wide id key could alias a dead object)
+                    plan.group_key = key if key is not None else None
         return plan
 
     def recover_shards(self, lost_shards: list[int],
@@ -857,7 +920,8 @@ class ECBackend(PGBackend):
                        batch: int = 128,
                        verify_hinfo: bool = True,
                        names: list[str] | None = None,
-                       helper_exclude: set[int] | None = None) -> dict:
+                       helper_exclude: set[int] | None = None,
+                       helper_costs: dict[int, int] | None = None) -> dict:
         """Rebuild every object's lost shard(s): the RecoveryOp loop,
         batched AND pipelined. Returns counters {objects, bytes,
         hinfo_failures}. One-plan convenience over plan_recovery +
@@ -883,7 +947,8 @@ class ECBackend(PGBackend):
         (other still-down OSDs during a partial rejoin).
         """
         plan = self.plan_recovery(lost_shards, replacement_osds,
-                                  verify_hinfo, names, helper_exclude)
+                                  verify_hinfo, names, helper_exclude,
+                                  helper_costs=helper_costs)
         RecoveryRunner([plan], batch=batch, perf=self.perf).run()
         return plan.counters
 
@@ -1030,6 +1095,78 @@ def _host_crc_available() -> bool:
         return False
 
 
+def _rows_crc32c(rows: np.ndarray) -> np.ndarray:
+    """(B, L) byte rows -> (B,) raw crc32c (seed -1, the HashInfo
+    convention); native SSE4.2 when built, batched device launch
+    otherwise."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    if _host_crc_available():
+        from .. import native
+        return native.native_crc32c_rows(0xFFFFFFFF, rows)
+    return np.asarray(PGBackend._batched_crcs(rows), dtype=np.uint32)
+
+
+def readv_ranges_host(store, cid: str, names: list[str], length: int,
+                      ranges, attr_key: str | None
+                      ) -> tuple[np.ndarray, np.ndarray | None,
+                                 list[int]]:
+    """Serve a ranged shard pull from a LOCAL store — the source half
+    of the sub-chunk wire read (ref: ErasureCodeClay's
+    minimum_to_decode sub-chunk ranges riding the ECSubRead).
+
+    Per object: verify the FULL stored row against its hinfo when
+    `attr_key` is given (rot detection stays at the source — the
+    receiver never sees the whole row, so the r10 whole-row fold can't
+    cover it), slice the planned `ranges`, and crc32c the shipped
+    bytes (range-level integrity the receiver's fold verify consumes;
+    CRC32C is GF(2)-linear at any row length, so H range rows still
+    verify with ONE fold CRC).
+
+    Returns (rows (B, rl) uint8, range CRCs (B,) uint32 | None,
+    indices of rows whose FULL shard failed its hinfo — their range
+    bytes ship anyway and the receiver plans around them)."""
+    ranges = [(int(o), int(ln)) for o, ln in ranges]
+    rl = sum(ln for _o, ln in ranges)
+    B = len(names)
+    rows = np.empty((B, rl), dtype=np.uint8)
+    bad: list[int] = []
+    if attr_key is not None:
+        full = np.empty((B, length), dtype=np.uint8)
+        for i, name in enumerate(names):
+            arr = store.read(cid, name)
+            if len(arr) != length:
+                # a stale/partial shard must fail LOUDLY — zero-
+                # filling would hand the decoder garbage (the readv
+                # contract)
+                raise ValueError(
+                    f"readv_ranges: {name!r} is {len(arr)} bytes, "
+                    f"expected {length}")
+            full[i] = arr
+        crcs = _rows_crc32c(full)
+        for i, name in enumerate(names):
+            hinfo = HashInfo.from_bytes(
+                store.getattr(cid, name, attr_key))
+            if int(crcs[i]) != hinfo.get_chunk_hash(0):
+                bad.append(i)
+        at = 0
+        for off, ln in ranges:
+            rows[:, at:at + ln] = full[:, off:off + ln]
+            at += ln
+        range_crcs = _rows_crc32c(rows)
+        return rows, range_crcs, bad
+    for i, name in enumerate(names):
+        at = 0
+        for off, ln in ranges:
+            got = store.read(cid, name, off, ln)
+            if len(got) != ln:
+                raise ValueError(
+                    f"readv_ranges: {name!r} range ({off},{ln}) "
+                    f"returned {len(got)} bytes")
+            rows[i, at:at + ln] = got
+            at += ln
+    return rows, None, bad
+
+
 @_functools.lru_cache(maxsize=256)
 def _fold_seed_const(sl: int) -> int:
     """shift^{sl}(0xFFFFFFFF): the seed contribution inside a raw
@@ -1075,11 +1212,13 @@ def _build_recover_program(dec_fn, verify: bool, host_crc: bool):
 
     from ..csum.kernels import crc32c_blocks
 
-    def fused(stack, expfold):         # (B, H, sl) u8, (B,) u32
+    def fused(stack, expfold):         # (B, H, rl) u8, (B,) u32
         B, H, L = stack.shape
-        rebuilt = dec_fn(stack)        # (B, E, L)
-        E = rebuilt.shape[1]
-        rcrc = crc32c_blocks(rebuilt.reshape(B * E, L),
+        rebuilt = dec_fn(stack)        # (B, E, sl) — sl may exceed
+        E = rebuilt.shape[1]           # the staged rl (range plans
+        out_len = rebuilt.shape[2]     # ship sub-chunks, rebuild
+        #                                whole rows)
+        rcrc = crc32c_blocks(rebuilt.reshape(B * E, out_len),
                              init=0xFFFFFFFF,
                              xorout=0).reshape(B, E)
         if verify:
@@ -1101,7 +1240,8 @@ class _RecoveryPlan:
 
     __slots__ = ("be", "lost", "helper", "survivors", "verify",
                  "full_plan", "provided", "counters", "names_by_len",
-                 "dec_fn", "group_key", "remaining", "done")
+                 "dec_fn", "group_key", "remaining", "done",
+                 "repair", "range_planes", "sub_count")
 
     def __init__(self, be, lost, helper, survivors, verify, full_plan,
                  provided):
@@ -1118,6 +1258,23 @@ class _RecoveryPlan:
         self.group_key = None
         self.remaining: set[str] = set()
         self.done = False
+        # repair-locality planner outputs: the RepairPlan that chose
+        # the helpers, plus the sub-chunk range shape when the wire
+        # ships less than full rows (range_planes None = full rows)
+        self.repair = None
+        self.range_planes: tuple[int, ...] | None = None
+        self.sub_count = 1
+
+    def row_ranges(self, sl: int):
+        """(row bytes shipped per helper, coalesced (off, len) ranges
+        or None) at shard length `sl` — the wire shape of one staged
+        helper row."""
+        if self.range_planes is None:
+            return sl, None
+        from .repairplan import coalesce_ranges
+        s = sl // self.sub_count
+        return (len(self.range_planes) * s,
+                coalesce_ranges((z * s, s) for z in self.range_planes))
 
     def finish(self) -> None:
         """Count the work done; advance applied cursors only when every
@@ -1176,6 +1333,7 @@ class RecoveryRunner:
         self._push_bytes = 0
         self.stats = {"batches": 0, "fused_batches": 0,
                       "generic_batches": 0, "cross_pg_batches": 0,
+                      "range_batches": 0, "helper_bytes_on_wire": 0,
                       "push_stalls": 0, "push_max_inflight_bytes": 0,
                       "skipped_stale": 0,
                       "host_crc": self._host_crc}
@@ -1214,12 +1372,14 @@ class RecoveryRunner:
         return (len(self._batches) - self._bi) + len(self._pending)
 
     def next_cost(self) -> int:
-        """Bytes the next step will move — the mClock cost input."""
+        """Bytes the next step will move — the mClock cost input
+        (range plans cost their PLANNED wire bytes, not full rows)."""
         if self._bi < len(self._batches):
             kind, plan, sl, payload = self._batches[self._bi]
-            return max(1, len(plan.helper)) * sl * len(payload)
+            rl, _ranges = plan.row_ranges(sl)
+            return max(1, len(plan.helper)) * rl * len(payload)
         if self._pending:
-            sl, pairs = self._pending[0][0], self._pending[0][1]
+            sl, pairs = self._pending[0][0], self._pending[0][2]
             return sl * len(pairs)
         return 1
 
@@ -1349,6 +1509,10 @@ class RecoveryRunner:
         proto = pairs[0][0]
         helper = proto.helper
         H = len(helper)
+        # the group key pins every plan in the batch to one program,
+        # hence one (H, range shape) — rl is the staged row width
+        # (full shard, or the planned sub-chunk ranges only)
+        rl, _ranges = proto.row_ranges(sl)
         # stage-time revalidation (see class docstring)
         live: list[tuple] = []   # (plan, name, version-at-stage)
         for plan, name in pairs:
@@ -1363,11 +1527,15 @@ class RecoveryRunner:
             return
         B = len(live)
         bucket = pow2_bucket(B)
-        stack = self._stage_buffer(bucket, H, sl)
+        stack = self._stage_buffer(bucket, H, rl)
         exp = np.zeros((B, H), dtype=np.uint32)
         with span("ecbackend.recover.stage", counters=self.perf,
                   key="recover_stage_time"):
-            self._stage(live, sl, stack, exp, proto.verify)
+            pre_bad = self._stage(live, sl, rl, stack, exp,
+                                  proto.verify)
+        wire = B * H * rl
+        self.stats["helper_bytes_on_wire"] += wire
+        self.perf.inc("recover_wire_bytes", wire)
         if bucket != B:
             stack[B:] = 0
         program = self._program(proto)
@@ -1379,20 +1547,22 @@ class RecoveryRunner:
             else:
                 expfold = np.zeros(bucket, dtype=np.uint32)
                 if proto.verify:
-                    expfold[:B] = _expected_fold_crcs(exp, sl)
+                    expfold[:B] = _expected_fold_crcs(exp, rl)
                     # a padded all-zero row folds to zero bytes, whose
-                    # raw CRC is just the seed shifted through sl zero
+                    # raw CRC is just the seed shifted through rl zero
                     # bytes — match it so padding never "fails"
-                    expfold[B:] = _fold_seed_const(sl)
+                    expfold[B:] = _fold_seed_const(rl)
                 handles = program(stack, expfold)
             for h in handles:
                 try:
                     h.copy_to_host_async()
                 except AttributeError:
                     break   # non-jax handle (test stub)
-        self._pending.append((sl, live, handles, exp))
+        self._pending.append((sl, rl, live, handles, exp, pre_bad))
         self.stats["batches"] += 1
         self.stats["fused_batches"] += 1
+        if proto.range_planes is not None:
+            self.stats["range_batches"] += 1
         if len({id(p) for p, _, _ in live}) > 1:
             self.stats["cross_pg_batches"] += 1
 
@@ -1406,30 +1576,63 @@ class RecoveryRunner:
             segs[-1][2].append(name)
         return segs
 
-    def _stage(self, live, sl: int, stack: np.ndarray, exp: np.ndarray,
-               verify: bool) -> None:
-        """Fill (B, H, sl) helper rows + expected hinfo CRCs. Remote
+    def _stage(self, live, sl: int, rl: int, stack: np.ndarray,
+               exp: np.ndarray, verify: bool) -> dict[int, set[int]]:
+        """Fill (B, H, rl) helper rows + expected fold inputs. Remote
         stores submit ONE readv frame per (PG, helper shard) — data
-        AND hinfo in the frame — all frames on the wire before any
+        AND integrity in the frame — all frames on the wire before any
         reply is collected (the windowed PULL: fetches from different
-        source OSDs overlap instead of serializing per object)."""
+        source OSDs overlap instead of serializing per object).
+
+        Full-row plans ship whole shards and `exp` carries the stored
+        hinfo CRCs (the r10 whole-row fold). Range plans ship only the
+        planned sub-chunk ranges; the SOURCE verifies each full shard
+        against its hinfo (rot detection moves to the helper), `exp`
+        carries the shipped ranges' CRCs, and rows whose full shard
+        failed at the source come back in the returned
+        {batch row: {helper slot}} map — the decode proceeds but those
+        objects re-decode through the full-row fallback."""
         waits: list[tuple] = []
+        pre_bad: dict[int, set[int]] = {}
         for plan, r0, names in self._segments(live):
             nb = len(names)
+            _rl, ranges = plan.row_ranges(sl)
             for hi, s in enumerate(plan.helper):
                 st = plan.be._store(s)
                 cid = shard_cid(plan.be.pg, s)
+                # chunk by the fetch byte budget so one source OSD
+                # never serializes a giant frame
+                per = max(1, RECOVERY_FETCH_BYTES // max(1, rl))
+                if ranges is not None:
+                    subr = getattr(st, "readv_ranges_submit", None)
+                    for c0 in range(0, nb, per):
+                        cnames = names[c0:c0 + per]
+                        if subr is not None:
+                            waits.append(
+                                (subr(cid, cnames, sl, ranges,
+                                      HINFO_KEY if verify else None),
+                                 r0 + c0, hi, len(cnames), s))
+                            continue
+                        rows, crcs, bad = readv_ranges_host(
+                            st, cid, cnames, sl, ranges,
+                            HINFO_KEY if verify else None)
+                        stack[r0 + c0:r0 + c0 + len(cnames), hi, :] \
+                            = rows
+                        if crcs is not None:
+                            exp[r0 + c0:r0 + c0 + len(cnames), hi] \
+                                = crcs
+                        for b in bad:
+                            pre_bad.setdefault(r0 + c0 + b,
+                                               set()).add(s)
+                    continue
                 subv = getattr(st, "readv_submit", None)
                 if subv is not None:
-                    # chunk by the fetch byte budget so one source OSD
-                    # never serializes a giant frame
-                    per = max(1, RECOVERY_FETCH_BYTES // max(1, sl))
                     for c0 in range(0, nb, per):
                         cnames = names[c0:c0 + per]
                         waits.append(
                             (subv(cid, cnames, sl,
                                   HINFO_KEY if verify else None),
-                             r0 + c0, hi, len(cnames)))
+                             r0 + c0, hi, len(cnames), None))
                     continue
                 out = stack[r0:r0 + nb, hi, :]
                 rb = getattr(st, "read_batch", None)
@@ -1443,7 +1646,21 @@ class RecoveryRunner:
                         hb = st.getattr(cid, name, HINFO_KEY)
                         exp[r0 + bi, hi] = HashInfo.from_bytes(
                             hb).get_chunk_hash(0)
-        for handle, r0, hi, nb in waits:
+        for handle, r0, hi, nb, range_slot in waits:
+            if range_slot is not None:
+                data, crcs, bad = handle.result()
+                rows = np.frombuffer(data, np.uint8)
+                if rows.size != nb * rl:
+                    raise ValueError(
+                        f"readv_ranges: got {rows.size} bytes, "
+                        f"expected {nb * rl}")
+                stack[r0:r0 + nb, hi, :] = rows.reshape(nb, rl)
+                if crcs is not None:
+                    exp[r0:r0 + nb, hi] = crcs
+                for b in bad:
+                    pre_bad.setdefault(r0 + int(b),
+                                       set()).add(range_slot)
+                continue
             data, attrs = handle.result()
             rows = np.frombuffer(data, np.uint8)
             if rows.size != nb * sl:
@@ -1454,28 +1671,38 @@ class RecoveryRunner:
                 for bi, hb in enumerate(attrs):
                     exp[r0 + bi, hi] = HashInfo.from_bytes(
                         hb).get_chunk_hash(0)
+        return pre_bad
 
     def _locate_bad_helpers(self, plan, name: str, bi: int,
                             exp: np.ndarray) -> set[int]:
         """Fold CRC mismatched for one object: re-read its helper rows
         and checksum each to find the rotten shard(s) — the rare path
-        pays the per-row pass the common path no longer does."""
+        pays the per-row pass the common path no longer does. For
+        range plans `exp` holds the SHIPPED ranges' CRCs (not hinfo),
+        so the re-read compares full rows against the stored hinfo
+        instead — same verdict, different oracle."""
         bad: set[int] = set()
         for hi, s in enumerate(plan.helper):
-            chunk = plan.be._store(s).read(
-                shard_cid(plan.be.pg, s), name)
+            st = plan.be._store(s)
+            cid = shard_cid(plan.be.pg, s)
+            chunk = st.read(cid, name)
             if self._host_crc:
                 from .. import native
                 crc = int(native.native_crc32c(0xFFFFFFFF, chunk))
             else:
                 crc = int(PGBackend._batched_crcs(chunk[None, :])[0])
-            if crc != int(exp[bi, hi]):
+            if plan.range_planes is not None:
+                want = HashInfo.from_bytes(
+                    st.getattr(cid, name, HINFO_KEY)).get_chunk_hash(0)
+            else:
+                want = int(exp[bi, hi])
+            if crc != want:
                 bad.add(s)
         return bad
 
     def _complete(self, entry) -> None:
         import jax
-        sl, live, handles, exp = entry
+        sl, rl, live, handles, exp, pre_bad = entry
         B = len(live)
         proto = live[0][0]
         with span("ecbackend.recover.fetch", counters=self.perf,
@@ -1490,7 +1717,7 @@ class RecoveryRunner:
             if proto.verify:
                 fold = np.asarray(got[1])[:B]
                 ok = (native.native_crc32c_rows(0xFFFFFFFF, fold)
-                      == _expected_fold_crcs(exp, sl))
+                      == _expected_fold_crcs(exp, rl))
             else:
                 ok = np.ones(B, dtype=bool)
         else:
@@ -1502,9 +1729,18 @@ class RecoveryRunner:
         rebuilt = np.array(rebuilt)
         rcrc = np.array(rcrc)
         bad_by_plan: dict[int, dict[str, set[int]]] = {}
+        # source-flagged rot (range plans: the helper's full shard
+        # failed its hinfo before slicing — the fold can't see it
+        # because the range CRC covers the rotten bytes as shipped)
+        for bi, bads in (pre_bad or {}).items():
+            plan, name, _v = live[bi]
+            plan.counters["hinfo_failures"] += len(bads)
+            bad_by_plan.setdefault(id(plan), {})[name] = set(bads)
         if proto.verify and not ok.all():
             for bi in np.nonzero(~ok)[0]:
                 plan, name, _v = live[bi]
+                if name in bad_by_plan.get(id(plan), {}):
+                    continue    # already flagged at the source
                 bad = self._locate_bad_helpers(plan, name, int(bi), exp)
                 if bad:
                     plan.counters["hinfo_failures"] += len(bad)
@@ -1562,6 +1798,9 @@ class RecoveryRunner:
         self.perf.inc("recover_launches")
         self.stats["batches"] += 1
         self.stats["generic_batches"] += 1
+        wire = len(plan.helper) * sl * len(names)
+        self.stats["helper_bytes_on_wire"] += wire
+        self.perf.inc("recover_wire_bytes", wire)
         stacks = {s: np.stack([be._store(s).read(
             shard_cid(be.pg, s), n) for n in names])
             for s in plan.helper}

@@ -514,14 +514,18 @@ class ReplicatedBackend(PGBackend):
 
     def read_objects(self, names, dead_osds=None,
                      verify: bool = True,
-                     repair: bool = True) -> dict[str, np.ndarray]:
+                     repair: bool = True,
+                     helper_costs=None) -> dict[str, np.ndarray]:
         """Serve each object from the first caught-up live replica
         (primary-first, the reference's default read path), with
         verify-on-read: a digest mismatch fails over to the next good
         replica and repairs the rotten copy in place (the read-error
         EIO path). repair=False fails over without the writeback — the
         read-only contract of a degraded-read view served by a
-        non-primary (only an activated primary may mutate shards)."""
+        non-primary (only an activated primary may mutate shards).
+        `helper_costs` (slot -> cost) reorders the candidate replicas
+        cheapest-first — the replicated twin of the EC planner's
+        cost-ranked helper pick."""
         alive = self._live_slots(dead_osds)
         out: dict[str, np.ndarray] = {}
         srcs_of: dict[str, list[int]] = {}
@@ -533,6 +537,9 @@ class ReplicatedBackend(PGBackend):
             if name not in self.object_sizes:
                 raise KeyError(f"no object {name!r}")
             srcs = self._fresh_for([name], alive)
+            if helper_costs:
+                srcs.sort(key=lambda s: (int(helper_costs.get(s, 0)),
+                                         s))
             if not srcs:
                 raise ValueError(f"no caught-up live replica for {name!r}")
             if not verify:
@@ -655,11 +662,13 @@ class ReplicatedBackend(PGBackend):
 
     def recover_shards(self, lost_shards, replacement_osds=None,
                        batch: int = 128, verify_hinfo: bool = True,
-                       names=None, helper_exclude=None) -> dict:
+                       names=None, helper_exclude=None,
+                       helper_costs=None) -> dict:
         """Rebuild lost replicas by pushing verified copies from a
         surviving replica (ref: ReplicatedBackend::recover_object /
         prep_push). Copies are batched per equal length so the source-
-        verify CRC is one device launch per group.
+        verify CRC is one device launch per group. `helper_costs`
+        orders the candidate push sources cheapest-first.
 
         Same signature/counters as ECBackend.recover_shards so
         SimCluster's repeer/backfill/catch-up paths drive either."""
@@ -676,6 +685,9 @@ class ReplicatedBackend(PGBackend):
             survivors = self._fresh_for(
                 rebuild, [s for s in range(self.n)
                           if s not in lost and s not in excluded])
+            if helper_costs:
+                survivors.sort(
+                    key=lambda s: (int(helper_costs.get(s, 0)), s))
             if not survivors:
                 raise ValueError(
                     "no caught-up surviving replica to push from")

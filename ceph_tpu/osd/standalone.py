@@ -826,6 +826,23 @@ class _ReadvOp:
         return data, (attrs if self._want_attrs else None)
 
 
+class _ReadvRangesOp:
+    """In-flight ranged readv (the sub-chunk pull frame): result() ->
+    (data bytes, range CRC list | None, source-flagged bad row
+    indices), same error surface as _AsyncStoreOp."""
+
+    def __init__(self, rs: "RemoteStore", body: bytes, want_crcs: bool):
+        self._op = _AsyncStoreOp(rs, "readv_ranges", body)
+        self._want_crcs = want_crcs
+
+    def result(self) -> tuple[bytes, list[int] | None, list[int]]:
+        d = Decoder(self._op.result())
+        data = d.blob()
+        crcs = d.list(Decoder.u32)
+        bad = d.list(Decoder.u32)
+        return data, (crcs if self._want_crcs else None), bad
+
+
 class RemoteStore:
     """ObjectStore proxy: the MOSDECSubOpWrite/Read role. Every method
     is one MStoreOp frame to the OSD owning the physical store."""
@@ -833,11 +850,15 @@ class RemoteStore:
     path = None
 
     def __init__(self, rpc: _Rpc, peer: str, timeout: float = 10.0,
-                 authorize=None):
+                 authorize=None, on_latency=None):
         self._rpc = rpc
         self._peer = peer
         self._timeout = timeout
         self._authorize = authorize   # cephx: establish session, retry
+        # on_latency(peer, seconds): per-reply round-trip report — the
+        # owning daemon folds it into its peer-latency EWMA, which the
+        # repair planner consumes as per-helper read costs
+        self._on_latency = on_latency
 
     def _submit(self, kind: str, body):
         return self._rpc.submit(
@@ -845,7 +866,11 @@ class RemoteStore:
 
     def _call(self, kind: str, body: bytes = b"") -> bytes:
         for attempt in range(2):
+            t0 = time.perf_counter()
             rep = self._submit(kind, body).wait(self._timeout)
+            if self._on_latency is not None:
+                self._on_latency(self._peer,
+                                 time.perf_counter() - t0)
             if rep.ok:
                 return rep.blob
             if (rep.err == "EPERM:unauthenticated"
@@ -897,6 +922,24 @@ class RemoteStore:
         body = self._co(cid, "", lambda e: e.string(attr_key or "")
                         .i64(length).list(list(oids), Encoder.string))
         return _ReadvOp(self, body, attr_key is not None)
+
+    def readv_ranges_submit(self, cid: str, oids: list[str],
+                            length: int, ranges,
+                            attr_key: str | None = None
+                            ) -> "_ReadvRangesOp":
+        """Pipelined sub-chunk fetch (the repair-locality planner's
+        wire frame): ONE frame names the (offset, length) ranges every
+        row ships — the helper moves only the planned bytes. With
+        `attr_key` the SOURCE verifies each full shard against its
+        stored hinfo (rot detection stays intact without the receiver
+        ever seeing the whole row) and ships per-row crc32c over the
+        planned bytes for the receiver's fold verify."""
+        body = self._co(cid, "", lambda e: e.string(attr_key or "")
+                        .i64(length)
+                        .list([(int(o), int(ln)) for o, ln in ranges],
+                              lambda en, r: en.i64(r[0]).i64(r[1]))
+                        .list(list(oids), Encoder.string))
+        return _ReadvRangesOp(self, body, attr_key is not None)
 
     def stat(self, cid: str, oid: str) -> int:
         return Decoder(self._call("stat", self._co(cid, oid))).i64()
@@ -1219,6 +1262,10 @@ class OSDDaemon:
         self._lock = threading.RLock()
         self._store_lock = threading.Lock()
         self._last_pong: dict[int, float] = {}
+        # per-peer store-op round-trip EWMA (seconds): the repair
+        # planner's per-helper read costs — suspects and slow peers
+        # rank behind fast trusted ones instead of uniform-cost picks
+        self._peer_lat: dict[int, float] = {}
         self._reported: set[int] = set()
         self._stop = threading.Event()
         # cephx (ref: OSD::ms_verify_authorizer): rotating secrets are
@@ -1544,8 +1591,8 @@ class OSDDaemon:
     # -- store service (the SubOp executor) ---------------------------------
 
     _STORE_READ_KINDS = frozenset(
-        {"read", "readv", "stat", "getattr", "exists", "ls",
-         "omap_get"})
+        {"read", "readv", "readv_ranges", "stat", "getattr", "exists",
+         "ls", "omap_get"})
 
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
         # the store plane is ticket-gated exactly like the client op
@@ -1618,6 +1665,26 @@ class OSDDaemon:
             e.list([st.getattr(cid, n, attr_key) for n in names]
                    if attr_key else [], Encoder.blob)
             return e.bytes()
+        if kind == "readv_ranges":
+            # sub-chunk shard fetch (repair-locality planner): ship
+            # only the planned (offset, length) ranges of every row.
+            # The full-row hinfo verify + range CRCs happen HERE at
+            # the source (readv_ranges_host) — the receiver fold-
+            # verifies the shipped bytes and plans around any row the
+            # source flagged rotten.
+            from .ecbackend import readv_ranges_host
+            attr_key = d.string()
+            length = d.i64()
+            ranges = d.list(lambda dd: (dd.i64(), dd.i64()))
+            names = d.list(Decoder.string)
+            rows, crcs, bad = readv_ranges_host(
+                st, cid, names, length, ranges, attr_key or None)
+            e = Encoder()
+            e.blob(rows.tobytes())
+            e.list([int(c) for c in crcs] if crcs is not None else [],
+                   Encoder.u32)
+            e.list([int(b) for b in bad], Encoder.u32)
+            return e.bytes()
         if kind == "stat":
             return Encoder().i64(st.stat(cid, oid)).bytes()
         if kind == "getattr":
@@ -1644,8 +1711,43 @@ class OSDDaemon:
             return RemoteStore(self.rpc, f"osd.{osd_id}",
                                timeout=self.c.op_timeout,
                                authorize=self._authorize_peer
-                               if self.verifier is not None else None)
+                               if self.verifier is not None else None,
+                               on_latency=self._note_peer_latency)
         return ShardSet(store_factory=factory)
+
+    def _note_peer_latency(self, peer: str, dt: float) -> None:
+        """Fold one store-op round trip into the peer's latency EWMA
+        (the r11 client ladder's 0.75/0.25 blend, daemon-side)."""
+        if not peer.startswith("osd."):
+            return
+        osd = int(peer[4:])
+        prev = self._peer_lat.get(osd)
+        self._peer_lat[osd] = dt if prev is None \
+            else 0.75 * prev + 0.25 * dt
+
+    def _helper_costs(self, be) -> dict[int, int]:
+        """Per-slot read costs for the repair-locality planner
+        (minimum_to_decode_with_cost units: integer microseconds).
+        Real signals, not uniform guesses: the peer-latency EWMA from
+        actual store-op round trips, plus a prohibitive surcharge for
+        anyone in the down/slow complaint memory — such slots are
+        usually excluded outright, but a cost keeps ties deterministic
+        when they must serve."""
+        n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
+            else 0
+        costs: dict[int, int] = {}
+        for s, osd in enumerate(be.acting):
+            if osd == self.osd_id:
+                cost = 0                  # our own store is free
+            else:
+                cost = int(self._peer_lat.get(osd, 0.001) * 1e6)
+            if osd in self.suspect or (
+                    _valid_osd(osd, n_osds)
+                    and self.osdmap is not None
+                    and not self.osdmap.osd_up[osd]):
+                cost += 1_000_000_000
+            costs[s] = cost
+        return costs
 
     def _acting(self, ps: int) -> list[int]:
         return self.osdmap.pg_to_up_acting_osds(1, ps)[2]
@@ -2310,7 +2412,8 @@ class OSDDaemon:
                          or not self.osdmap.osd_up[o])}
                 try:
                     plan = be.plan_recovery(
-                        rnd.lost_of(ps), helper_exclude=exclude)
+                        rnd.lost_of(ps), helper_exclude=exclude,
+                        helper_costs=self._helper_costs(be))
                     self._recovering[ps] = None   # round pending
                     new_plans.append((ps, plan, set()))
                 except (ValueError, ConnectionError, KeyError) as e:
@@ -2368,7 +2471,8 @@ class OSDDaemon:
                     if hasattr(be, "plan_recovery"):
                         plan = be.plan_recovery(
                             lost, replacement_osds=repl,
-                            helper_exclude=exclude)
+                            helper_exclude=exclude,
+                            helper_costs=self._helper_costs(be))
                         self._recovering[ps] = None  # round pending
                         new_plans.append((ps, plan, dead))
                     else:
@@ -3054,7 +3158,9 @@ class OSDDaemon:
             return b""
         if kind == "read":
             name = d.string()
-            data = be.read_object(name, dead_osds=set(self.suspect))
+            data = be.read_objects(
+                [name], dead_osds=set(self.suspect),
+                helper_costs=self._helper_costs(be))[name]
             return np.asarray(data, np.uint8).tobytes()
         if kind == "readv":
             # batched read: ONE decode launch serves the whole name
@@ -3064,7 +3170,8 @@ class OSDDaemon:
             for n in names:
                 if n not in be.object_sizes:
                     raise KeyError(n)
-            got = be.read_objects(names, dead_osds=set(self.suspect))
+            got = be.read_objects(names, dead_osds=set(self.suspect),
+                                  helper_costs=self._helper_costs(be))
             e = Encoder()
             e.list([np.asarray(got[n], np.uint8).tobytes()
                     for n in names], Encoder.blob_ref)
@@ -3185,8 +3292,13 @@ class OSDDaemon:
                 raise KeyError(n)
         with self.perf.time("degraded_read_time"):
             try:
-                got = src.read_objects(names, dead_osds=dead,
-                                       repair=repair)
+                # the repair-locality planner serves the degraded
+                # gather too: a single-shard LRC loss touches one
+                # local group instead of any-k, cost-biased by the
+                # same complaint/latency memory as recovery
+                got = src.read_objects(
+                    names, dead_osds=dead, repair=repair,
+                    helper_costs=self._helper_costs(src))
             except KeyError as e:
                 # names were just checked, so this KeyError is a
                 # SHARD-level store miss: the meta already names a

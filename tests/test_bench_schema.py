@@ -7,6 +7,8 @@ reads."""
 import json
 import os
 
+import pytest
+
 from tools import rados_bench
 
 PCT_KEYS = {"p50_ms", "p95_ms", "p99_ms", "p999_ms", "max_ms"}
@@ -93,6 +95,68 @@ def test_bench_r13_artifact_pinned():
     # the multi-process cell is present and annotated for 1-core
     assert "write_osd_procs_1core" in r13
     assert data["cells"]["write_osd_procs"]["config"]["osd_procs"]
+
+
+REPAIR_KEYS = {"family", "helper_count", "wire_fraction",
+               "helper_bytes_on_wire", "rebuilt_bytes",
+               "repair_bytes_on_wire_per_rebuilt_byte", "vs_full_k",
+               "vs_full_shard_reads", "range_batches",
+               "helper_set_histogram"}
+
+
+def test_bench_r14_artifact_pinned():
+    """The committed r14 repair-locality artifact: schema keys CI
+    parses, the per-cell `repair` blocks recovery_bench emits, and
+    the acceptance floors — LRC k8m4l4 single-shard repair bytes on
+    the wire <= 0.55x the RS full-k baseline, Clay helper bytes
+    <= 0.75x full-shard reads. The metric is a COUNT over the
+    planner's helper reads, so the floors are deterministic."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r14.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "recovery_r14/1"
+    for cell in ("rs_k8m4", "lrc_k8m4l4", "clay_k8m4"):
+        rep = data["cells"][cell]["repair"]
+        assert REPAIR_KEYS <= set(rep), cell
+        assert rep["helper_bytes_on_wire"] > 0
+        assert rep["repair_bytes_on_wire_per_rebuilt_byte"] > 0
+    assert data["cells"]["rs_k8m4"]["repair"]["family"] == "mds"
+    assert data["cells"]["lrc_k8m4l4"]["repair"]["family"] \
+        == "lrc_local"
+    clay = data["cells"]["clay_k8m4"]["repair"]
+    assert clay["family"] == "clay_planes"
+    assert clay["range_batches"] >= 1
+    acc = data["acceptance"]
+    assert acc["lrc_vs_rs_full_k"] <= 0.55
+    assert acc["clay_vs_full_shard_reads"] <= 0.75
+    # the full-k baseline really is k reads per rebuilt byte
+    assert acc["rs_full_k_bytes_per_rebuilt_byte"] == 8.0
+
+
+@pytest.mark.slow
+def test_recovery_bench_json_schema_live():
+    """Live run of the r14 bench surface (slow sweep cell; the
+    committed-artifact pin above is the tier-1 representative):
+    recovery_bench --json emits the `repair` block with a local-group
+    LRC plan and the bytes-on-wire ratio below full-k."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "recovery_bench.py"),
+         "-P", "plugin=lrc", "-P", "k=4", "-P", "m=2", "-P", "l=3",
+         "-P", "impl=bitlinear", "--objects", "4", "--size", "8192",
+         "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(out.stdout)
+    rep = data["repair"]
+    assert REPAIR_KEYS <= set(rep)
+    assert rep["family"] == "lrc_local"
+    assert rep["vs_full_k"] < 1.0
+    assert rep["helper_set_histogram"]["lrc_local"]
 
 
 REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",

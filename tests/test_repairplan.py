@@ -1,0 +1,403 @@
+"""Repair-locality planner (round 14): code-family-aware minimal
+helper sets, sub-chunk wire reads, cost-biased selection, and the
+range-integrity ladder.
+
+Bit-exactness contract: a planner-driven rebuild (local-group LRC
+decode, Clay repair-plane range reads, SHEC window reads) must produce
+EXACTLY the bytes the full-decode oracle produces — in both integrity
+modes (device fold and host-crc) — while moving fewer helper bytes.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.osd.ecbackend import (ECBackend, RecoveryRunner, ShardSet,
+                                    shard_cid)
+from ceph_tpu.osd.memstore import Transaction
+from ceph_tpu.osd.repairplan import (coalesce_ranges, plan_read,
+                                     plan_repair)
+
+
+def _host_crc_params():
+    from ceph_tpu.osd.ecbackend import _host_crc_available
+    return [False, True] if _host_crc_available() else [False]
+
+
+class TestPlanner:
+    """Pure planning: families, laddering, costs — no data moved."""
+
+    def test_lrc_single_loss_plans_local_group(self):
+        lrc = factory("plugin=lrc k=8 m=4 l=4 impl=bitlinear")
+        n = lrc.get_chunk_count()
+        rp = plan_repair(lrc, [1], [i for i in range(n) if i != 1])
+        assert rp.family == "lrc_local"
+        assert len(rp.helpers) == 4          # l, not k=8
+        # k8m4l4 groups are 5 slots wide; slot 1 lives in group 0
+        assert set(rp.helpers) <= set(range(5))
+        assert rp.planes is None and rp.integrity == "row"
+        assert rp.wire_fraction == 1.0
+
+    def test_lrc_second_loss_same_group_ladders(self):
+        """Broken locality: two losses in one local group can't be
+        served by that group — the structural walk ladders to the
+        global layer and the family says so."""
+        lrc = factory("plugin=lrc k=8 m=4 l=4 impl=bitlinear")
+        n = lrc.get_chunk_count()
+        rp = plan_repair(lrc, [1, 2],
+                         [i for i in range(n) if i not in (1, 2)])
+        assert rp.family == "lrc_multi"
+        assert not set(rp.helpers) <= set(range(5))   # left the group
+        # still a valid plan: helpers can actually reconstruct
+        assert set(lrc.minimum_to_decode([1, 2], sorted(
+            set(range(n)) - {1, 2}))) <= set(rp.helpers) | {1, 2}
+
+    def test_clay_single_loss_plans_repair_planes(self):
+        clay = factory("plugin=clay k=8 m=4 impl=bitlinear")
+        n = clay.get_chunk_count()
+        rp = plan_repair(clay, [3], [i for i in range(n) if i != 3])
+        assert rp.family == "clay_planes"
+        assert len(rp.helpers) == clay.d
+        assert rp.integrity == "range"
+        P = clay.get_sub_chunk_count()
+        assert len(rp.planes) == P // clay.q      # beta = q^(t-1)
+        assert rp.wire_fraction == pytest.approx(1 / clay.q)
+        sl = P * 128
+        ranges = rp.ranges(sl)
+        assert sum(ln for _o, ln in ranges) == rp.row_bytes(sl)
+        assert rp.row_bytes(sl) == sl // clay.q
+
+    def test_clay_multi_loss_ladders_to_full(self):
+        clay = factory("plugin=clay k=8 m=4 impl=bitlinear")
+        n = clay.get_chunk_count()
+        rp = plan_repair(clay, [3, 4],
+                         [i for i in range(n) if i not in (3, 4)])
+        assert rp.family == "clay_full"
+        assert rp.planes is None and rp.integrity == "row"
+
+    def test_mds_costs_bias_helper_pick(self):
+        rs = factory("plugin=tpu_rs k=4 m=2 impl=bitlinear")
+        rp = plan_repair(rs, [0], [1, 2, 3, 4, 5],
+                         costs={1: 10_000, 2: 1, 3: 1, 4: 1, 5: 1})
+        assert rp.cost_ranked
+        assert 1 not in rp.helpers           # the expensive one sat out
+        assert len(rp.helpers) == 4
+
+    def test_shec_cost_breaks_ties_structurally(self):
+        """SHEC stays structural (fewest reads first) — the cost only
+        picks among equally small workable sets, never an undecodable
+        'cheapest k'."""
+        shec = factory("plugin=shec k=4 m=3 c=2 impl=bitlinear")
+        n = shec.get_chunk_count()
+        avail = [i for i in range(n) if i != 0]
+        base = plan_repair(shec, [0], avail)
+        biased = plan_repair(shec, [0], avail,
+                             costs={c: 0 for c in avail})
+        assert len(biased.helpers) == len(base.helpers)
+        # and the set actually decodes chunk 0
+        assert set(shec.minimum_to_decode([0], sorted(
+            biased.helpers))) <= set(biased.helpers)
+
+    def test_clay_costs_never_evict_column_mates(self):
+        """Clay's surviving grid-column mates are structurally required
+        helpers; a hostile cost table must not push them out."""
+        clay = factory("plugin=clay k=4 m=2 impl=bitlinear")
+        n = clay.get_chunk_count()
+        lost = 0
+        avail = [i for i in range(n) if i != lost]
+        y0 = clay._xy(clay._node_of_chunk(lost))[1]
+        mates = {c for c in avail
+                 if clay._xy(clay._node_of_chunk(c))[1] == y0}
+        rp = plan_repair(clay, [lost], avail,
+                         costs={c: 10_000_000 for c in mates})
+        assert mates <= set(rp.helpers)
+
+    def test_unreconstructible_raises_value_error(self):
+        rs = factory("plugin=tpu_rs k=4 m=2 impl=bitlinear")
+        with pytest.raises(ValueError):
+            plan_repair(rs, [0, 1, 2], [3, 4])   # 2 survivors < k
+
+    def test_coalesce_ranges(self):
+        assert coalesce_ranges([(0, 4), (4, 4), (12, 4)]) \
+            == ((0, 8), (12, 4))
+        assert coalesce_ranges([(8, 4), (0, 4)]) == ((0, 4), (8, 4))
+        assert coalesce_ranges([(0, 8), (4, 8)]) == ((0, 12),)
+
+    def test_plan_read_lrc_degraded_gathers_local_group(self):
+        lrc = factory("plugin=lrc k=4 m=2 l=3 impl=bitlinear")
+        n = lrc.get_chunk_count()
+        # k4m2l3 layout: group0 = slots 0..3 (0 local parity, 1 global),
+        # group1 = 4..7; data positions are {2, 3, 6, 7}
+        want = list(lrc.data_positions)
+        lost = want[0]
+        need, family = plan_read(lrc, want,
+                                 [i for i in range(n) if i != lost])
+        assert family == "lrc_local"
+        group0 = set(range(4))
+        assert need <= (set(want) | group0) - {lost}
+        # and a fully-available read is a pass-through
+        need2, fam2 = plan_read(lrc, want, list(range(n)))
+        assert fam2 == "direct" and need2 == set(want)
+
+
+def _write_corpus(be, prefix, n=6,
+                  sizes=(4096, 4096, 1500, 4096, 900, 4096)):
+    rng = np.random.default_rng(hash(prefix) % (2**32))
+    objs = {f"{prefix}-{i}": rng.integers(0, 256, sizes[i % len(sizes)],
+                                          np.uint8)
+            for i in range(n)}
+    be.write_objects(objs)
+    return objs
+
+
+def _full_decode_oracle(be, lost, names):
+    """Full-k reference: decode from EVERY survivor, per object, no
+    planner — the bytes the planner-driven path must reproduce."""
+    out = {}
+    survivors = [s for s in range(be.n) if s not in lost]
+    for name in names:
+        stacks = {s: be._store(s).read(shard_cid(be.pg, s), name)
+                  for s in survivors}
+        rec = be.coder.decode_chunks(lost, stacks)
+        out[name] = {s: np.asarray(rec[s]) for s in lost}
+    return out
+
+
+GEOMETRIES = [
+    ("plugin=tpu_rs k=4 m=2 impl=bitlinear", [1]),
+    ("plugin=lrc k=4 m=2 l=3 impl=bitlinear", [2]),
+    ("plugin=lrc k=4 m=2 l=3 impl=bitlinear", [2, 3]),   # broken group
+    ("plugin=clay k=2 m=2 impl=bitlinear", [1]),
+    ("plugin=shec k=4 m=3 c=2 impl=bitlinear", [0]),
+]
+
+
+class TestPlannerRecoveryBitExact:
+    @pytest.mark.parametrize("host_crc", _host_crc_params())
+    @pytest.mark.parametrize("profile,lost", GEOMETRIES)
+    def test_rebuild_matches_full_decode_oracle(self, profile, lost,
+                                                host_crc):
+        cluster = ShardSet()
+        n = factory(profile).get_chunk_count()
+        be = ECBackend(profile, "1.0", list(range(n)), cluster,
+                       chunk_size=512)
+        objs = _write_corpus(be, f"bx-{lost}")
+        refs = _full_decode_oracle(be, lost, sorted(objs))
+        for s in lost:
+            cluster.stores.pop(s)
+        plan = be.plan_recovery(lost, replacement_osds={
+            s: 100 + s for s in lost})
+        runner = RecoveryRunner([plan], batch=4, host_crc=host_crc)
+        runner.run()
+        assert plan.counters["objects"] == len(objs)
+        assert not plan.remaining
+        for s in lost:
+            st = cluster.osd(100 + s)
+            cid = shard_cid("1.0", s)
+            for name in sorted(objs):
+                np.testing.assert_array_equal(
+                    st.read(cid, name), refs[name][s],
+                    err_msg=f"{profile} {name} slot {s}")
+        # the PG serves client reads again
+        got = be.read_objects(sorted(objs))
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data,
+                                          err_msg=name)
+
+    def test_planner_moves_fewer_bytes_than_full_k(self):
+        """The point of the subsystem: LRC local repair and Clay range
+        reads pull strictly fewer helper bytes than a full-k plan
+        would for the same rebuild."""
+        for profile, expect_frac in [
+                ("plugin=lrc k=8 m=4 l=4 impl=bitlinear", 0.55),
+                ("plugin=clay k=2 m=2 impl=bitlinear", 0.80)]:
+            cluster = ShardSet()
+            coder = factory(profile)
+            n = coder.get_chunk_count()
+            k = coder.get_data_chunk_count()
+            be = ECBackend(profile, "1.0", list(range(n)), cluster,
+                           chunk_size=512)
+            objs = _write_corpus(be, "wb", n=4, sizes=(4096,))
+            cluster.stores.pop(1)
+            plan = be.plan_recovery([1], replacement_osds={1: 50})
+            runner = RecoveryRunner([plan], batch=4)
+            runner.run()
+            rebuilt = plan.counters["bytes"]
+            wire = runner.stats["helper_bytes_on_wire"]
+            assert wire / (rebuilt * k) <= expect_frac, profile
+            got = be.read_objects(sorted(objs))
+            for name, data in objs.items():
+                np.testing.assert_array_equal(got[name], data)
+
+    def test_recover_shards_helper_costs_bias(self):
+        """recover_shards(helper_costs=...) routes the costs into the
+        planner: an expensively-priced survivor sits out when k others
+        are available."""
+        cluster = ShardSet()
+        be = ECBackend("plugin=tpu_rs k=4 m=2 impl=bitlinear", "1.0",
+                       list(range(6)), cluster, chunk_size=512)
+        _write_corpus(be, "hc", n=3, sizes=(2048,))
+        cluster.stores.pop(1)
+        plan = be.plan_recovery([1], replacement_osds={1: 60},
+                                helper_costs={0: 0, 2: 999_999, 3: 0,
+                                              4: 0, 5: 0})
+        RecoveryRunner([plan]).run()
+        assert 2 not in plan.helper
+        assert plan.repair.cost_ranked
+
+
+class TestRangeIntegrity:
+    """Sub-chunk reads break the whole-row fold — rot detection must
+    survive the move to the source + range CRCs."""
+
+    @pytest.mark.parametrize("host_crc", _host_crc_params())
+    def test_rot_in_shipped_plane_detected_and_decoded_around(
+            self, host_crc):
+        cluster = ShardSet()
+        be = ECBackend("plugin=clay k=2 m=2 impl=bitlinear", "1.0",
+                       list(range(4)), cluster, chunk_size=512)
+        objs = _write_corpus(be, "rot", n=4, sizes=(4096,))
+        refs = _full_decode_oracle(be, [1], sorted(objs))
+        # corrupt a byte INSIDE a repair plane of helper slot 2 —
+        # the shipped ranges carry the rot, and the range CRC matches
+        # the rotten bytes as shipped (the fold can't see it): only
+        # the source-side full-row hinfo verify catches it
+        rp = plan_repair(be.coder, [1], [0, 2, 3])
+        sl = be._shard_len(4096)
+        off = rp.ranges(sl)[0][0] + 3
+        cluster.osd(2).queue_transaction(
+            Transaction().write(shard_cid("1.0", 2), "rot-0", off,
+                                b"\xEE"))
+        cluster.stores.pop(1)
+        plan = be.plan_recovery([1], replacement_osds={1: 70})
+        assert plan.range_planes is not None      # range mode active
+        runner = RecoveryRunner([plan], batch=4, host_crc=host_crc)
+        runner.run()
+        assert plan.counters["hinfo_failures"] >= 1
+        st = cluster.osd(70)
+        cid = shard_cid("1.0", 1)
+        for name in sorted(objs):
+            np.testing.assert_array_equal(st.read(cid, name),
+                                          refs[name][1], err_msg=name)
+
+    def test_rot_outside_shipped_planes_still_flagged(self):
+        """The source verifies the FULL shard, so rot in bytes the
+        plan never ships is still caught (a later full-row read would
+        have tripped over it) and the rebuild decodes around it."""
+        cluster = ShardSet()
+        be = ECBackend("plugin=clay k=2 m=2 impl=bitlinear", "1.0",
+                       list(range(4)), cluster, chunk_size=512)
+        objs = _write_corpus(be, "rq", n=3, sizes=(4096,))
+        refs = _full_decode_oracle(be, [1], sorted(objs))
+        rp = plan_repair(be.coder, [1], [0, 2, 3])
+        sl = be._shard_len(4096)
+        shipped = rp.ranges(sl)
+        outside = next(o for o in range(sl)
+                       if not any(lo <= o < lo + ln
+                                  for lo, ln in shipped))
+        cluster.osd(3).queue_transaction(
+            Transaction().write(shard_cid("1.0", 3), "rq-1", outside,
+                                b"\x5A"))
+        cluster.stores.pop(1)
+        plan = be.plan_recovery([1], replacement_osds={1: 71})
+        RecoveryRunner([plan], batch=4).run()
+        assert plan.counters["hinfo_failures"] >= 1
+        st = cluster.osd(71)
+        for name in sorted(objs):
+            np.testing.assert_array_equal(
+                st.read(shard_cid("1.0", 1), name), refs[name][1],
+                err_msg=name)
+
+    def test_no_verify_skips_source_pass(self):
+        """verify_hinfo=False must not pay the source-side full-row
+        CRC pass (and still rebuild correctly on clean data)."""
+        cluster = ShardSet()
+        be = ECBackend("plugin=clay k=2 m=2 impl=bitlinear", "1.0",
+                       list(range(4)), cluster, chunk_size=512)
+        objs = _write_corpus(be, "nv", n=3, sizes=(4096,))
+        refs = _full_decode_oracle(be, [1], sorted(objs))
+        cluster.stores.pop(1)
+        plan = be.plan_recovery([1], replacement_osds={1: 72},
+                                verify_hinfo=False)
+        RecoveryRunner([plan], batch=4).run()
+        assert plan.counters["hinfo_failures"] == 0
+        st = cluster.osd(72)
+        for name in sorted(objs):
+            np.testing.assert_array_equal(
+                st.read(shard_cid("1.0", 1), name), refs[name][1])
+
+
+class TestDegradedLocalRead:
+    def test_lrc_degraded_read_touches_only_local_group(self):
+        """ROADMAP item 3 follow-up: a degraded read with one lost
+        LRC data shard gathers direct data + ONE local group — the
+        other group's parities are never touched."""
+        cluster = ShardSet()
+        be = ECBackend("plugin=lrc k=4 m=2 l=3 impl=bitlinear", "1.0",
+                       list(range(8)), cluster, chunk_size=512)
+        objs = _write_corpus(be, "dg", n=4, sizes=(4096,))
+        lost = be.data_slots[0]           # a data position in group 0
+        group0 = set(range(4))
+        assert lost in group0
+        before = be.perf.dump()["planner_local_plans"]
+        touched: set[int] = set()
+        for s in range(be.n):
+            st = be._store(s)
+            orig = st.read
+
+            def spy(cid, oid, *a, _orig=orig, _s=s, **kw):
+                touched.add(_s)
+                return _orig(cid, oid, *a, **kw)
+            st.read = spy
+        got = be.read_objects(sorted(objs), dead_osds={lost},
+                              repair=False)
+        for name, data in objs.items():
+            np.testing.assert_array_equal(got[name], data,
+                                          err_msg=name)
+        allowed = (set(be.data_slots) | group0) - {lost}
+        assert touched <= allowed, touched
+        assert be.perf.dump()["planner_local_plans"] > before
+
+
+class TestWireRangeRecovery:
+    """Tier-1 representative of the wire path: a real clay cluster
+    rebuilds a killed OSD over readv_ranges frames (sub-chunk pulls),
+    bit-exact, with the planner counters attributing the plan."""
+
+    def test_clay_wire_rebuild_over_range_frames(self):
+        from ceph_tpu.osd.standalone import StandaloneCluster
+        # 5 OSDs for a size-4 pool: the killed slot needs a spare OSD
+        # to re-home onto, or the PG can never go clean
+        c = StandaloneCluster(
+            n_osds=5, pg_num=2, op_timeout=5.0,
+            profile="plugin=clay k=2 m=2 impl=bitlinear",
+            chunk_size=512)
+        try:
+            c.wait_for_clean(timeout=30)
+            cl = c.client()
+            rng = np.random.default_rng(7)
+            objs = {f"wr-{i}": rng.integers(0, 256, 2048,
+                                            np.uint8).tobytes()
+                    for i in range(10)}
+            cl.write(objs)
+            primaries = {cl.osdmap.pg_to_up_acting_osds(1, ps)[2][0]
+                         for ps in range(2)}
+            victim = next(o for o in c.osd_ids()
+                          if o not in primaries)
+            c.kill_osd(victim)
+            c.wait_for_down(victim)
+            c.wait_for_clean(timeout=90)
+            cl2 = c.client("client.admin2")
+            for name, want in objs.items():
+                assert cl2.read(name) == want, name
+            plans = wire = 0
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                dump = d.ec_perf.dump()
+                plans += dump["planner_subchunk_plans"]
+                wire += dump["recover_wire_bytes"]
+            assert plans >= 1        # the rebuild went through planes
+            assert wire > 0
+        finally:
+            c.shutdown()

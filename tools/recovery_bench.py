@@ -58,7 +58,9 @@ def main(argv=None) -> None:
         pass
 
     from ceph_tpu.ec.interface import profile_from_string
+    from ceph_tpu.ec.registry import factory
     from ceph_tpu.osd.ecbackend import ECBackend, RecoveryRunner, ShardSet
+
     from ceph_tpu.osd.scheduler import MClockScheduler
 
     profile = profile_from_string(" ".join(args.parameter)) or {}
@@ -66,8 +68,12 @@ def main(argv=None) -> None:
     profile.setdefault("m", "3")
     try:
         cluster = ShardSet()
-        k, m = int(profile["k"]), int(profile["m"])
-        be = ECBackend(profile, "1.0", list(range(k + m)), cluster)
+        # the coder owns the slot count (LRC interleaves local
+        # parities into the position space, so n > k+m there)
+        coder = factory(dict(profile))
+        k, m = coder.get_data_chunk_count(), coder.get_coding_chunk_count()
+        n_slots = coder.get_chunk_count()
+        be = ECBackend(profile, "1.0", list(range(n_slots)), cluster)
         if args.lost > m:
             raise SystemExit(f"--lost {args.lost} exceeds m={m}")
     except ValueError as e:
@@ -125,7 +131,7 @@ def main(argv=None) -> None:
             queued = False
             more = got[1].step()
         runner.finish()
-        return plan.counters, runner, sched
+        return plan, runner, sched
 
     t0 = time.perf_counter()
     if args.trace:
@@ -133,15 +139,46 @@ def main(argv=None) -> None:
         # is out of frame, so the pipeline overlap (stage / launch /
         # fetch+writeback spans) is what the timeline shows
         with trace(args.trace) as traced:
-            counters, runner, sched = timed_recover()
+            timed = timed_recover()
         if not traced:
             print("warning: jax.profiler unavailable, no trace "
                   "captured", file=sys.stderr)
     else:
-        counters, runner, sched = timed_recover()
+        timed = timed_recover()
     t_rec = time.perf_counter() - t0
+    counters = timed[0].counters
 
     import jax
+    # repair-locality planner attribution (ROADMAP item 2's headline
+    # metric): helper bytes pulled per rebuilt byte — a pure COUNT, so
+    # it's deterministic and benchmarkable even on a loaded 1-core box.
+    # vs_full_k normalizes against the MDS baseline (k full rows per
+    # rebuilt row); vs_full_shard_reads against pulling this plan's
+    # helper set WITHOUT sub-chunk ranges (the Clay wire saving).
+    plan, runner, sched = timed
+    wire = runner.stats["helper_bytes_on_wire"]
+    rebuilt = max(1, counters["bytes"])
+    rp = plan.repair
+    histogram: dict = {}
+    if rp is not None:
+        histogram.setdefault(rp.family, {})
+        histogram[rp.family][str(len(rp.helpers))] = \
+            histogram[rp.family].get(str(len(rp.helpers)), 0) + 1
+    repair_stats = {
+        "family": rp.family if rp is not None else None,
+        "helper_count": len(plan.helper),
+        "wire_fraction": rp.wire_fraction if rp is not None else 1.0,
+        "helper_bytes_on_wire": wire,
+        "rebuilt_bytes": counters["bytes"],
+        "repair_bytes_on_wire_per_rebuilt_byte":
+            round(wire / rebuilt, 4),
+        "vs_full_k": round(wire / rebuilt / max(1, k), 4),
+        "vs_full_shard_reads": round(
+            wire / max(1, len(plan.helper) * rebuilt
+                       // max(1, len(plan.lost))), 4),
+        "range_batches": runner.stats["range_batches"],
+        "helper_set_histogram": histogram,
+    }
     stats = {
         "plugin": profile.get("plugin", "tpu_rs"), "k": k, "m": m,
         "objects": args.objects, "object_size": args.size,
@@ -151,6 +188,7 @@ def main(argv=None) -> None:
         "objects_per_s": round(args.objects / t_rec, 1),
         "recovered_MBps": round(counters["bytes"] / t_rec / 1e6, 1),
         "hinfo_failures": counters["hinfo_failures"],
+        "repair": repair_stats,
         "backend": jax.default_backend(),
         "jax_compile_cache": cache_dir,
         # per-stage attribution over the timed recovery (the "ec"
