@@ -89,12 +89,17 @@ KNOBS: dict[str, tuple[int, str]] = {
 
 
 def repro_command(seed: int, store: str, rounds: int, ops: int,
-                  op_shards: int = 1) -> str:
+                  op_shards: int = 1, osd_procs: bool = False,
+                  rotate_secrets: bool = False) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
     if op_shards != 1:
         cmd += f" --op-shards {op_shards}"
+    if osd_procs:
+        cmd += " --osd-procs"
+    if rotate_secrets:
+        cmd += " --rotate-secrets"
     return cmd
 
 
@@ -115,7 +120,8 @@ class Thrasher:
                  ops: int = 6, n_osds: int = 4, pg_num: int = 2,
                  store_dir: str | None = None, verbose: bool = False,
                  read_during_faults: bool = False,
-                 op_shards: int = 1):
+                 op_shards: int = 1, osd_procs: bool = False,
+                 rotate_secrets: bool = False):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -134,6 +140,18 @@ class Thrasher:
         # per-shard mClock queues; the exactly-once/no-resurrection
         # invariants must hold under sharded dispatch too
         self.op_shards = int(op_shards)
+        # r15: every OSD in its own OS process (multiproc.py); forces
+        # a real on-disk store so SIGKILL+revive survives the process
+        # boundary, and routes the RAM-reaching helpers (rotation
+        # push, store fsck) over the new control lines
+        self.osd_procs = bool(osd_procs)
+        if self.osd_procs:
+            self.store = store = "tin"
+        # deterministic per-round secret rotation (OUTSIDE the seeded
+        # action menu, so existing seed-pinned cells replay unchanged):
+        # rotate at every heal; live daemons — child processes
+        # included — must keep serving through the keep-window
+        self.rotate_secrets = bool(rotate_secrets)
         # deadline scaling, NOT schedule input: the RNG stream never
         # sees it, so a seed replays identically on an idle box
         self.load = load_factor()
@@ -146,8 +164,10 @@ class Thrasher:
         self.dead_mons: set[int] = set()
         self.schedule: list[str] = []        # the replayable fault log
         self._obj_i = 0
-        self.repro = repro_command(self.seed, store, rounds, ops,
-                                    op_shards=self.op_shards)
+        self.repro = repro_command(self.seed, self.store, rounds, ops,
+                                   op_shards=self.op_shards,
+                                   osd_procs=self.osd_procs,
+                                   rotate_secrets=self.rotate_secrets)
         self.c = None
         self.cl = None
 
@@ -189,6 +209,7 @@ class Thrasher:
             n_osds=self.n_osds, pg_num=self.pg_num, store=self.store,
             store_dir=self.store_dir, cephx=True, secret=secret,
             op_timeout=6.0, op_shards=self.op_shards,
+            osd_procs=self.osd_procs,
             # a loaded host stretches every ping round trip: scale the
             # grace with the observed load so CPU starvation doesn't
             # read as daemon death (the [41-tin] full-suite flake)
@@ -418,6 +439,13 @@ class Thrasher:
         for o in sorted(self.dead_osds):
             self.c.revive_osd(o)
         self.dead_osds.clear()
+        if self.rotate_secrets:
+            # deterministic per-round rotation (r15): every live
+            # daemon — --osd-procs children via the control-pipe push
+            # — refreshes its verifier; I/O must keep flowing through
+            # the keep-window and clients re-fetch past it
+            self.c.rotate_service_secrets("osd")
+            self._log(f"round {round_i}: rotated osd service secrets")
         self._log(f"round {round_i}: healed; checking invariants")
         # invariant: CONVERGENCE — recovery + activation (up_thru)
         # must settle with injection still live (deadline scaled by

@@ -90,6 +90,8 @@ from collections import deque
 
 from ..csum.reference import ceph_crc32c, ceph_crc32c_iov
 from ..utils.encoding import Decoder, Encoder
+from ..utils.flight_recorder import current_sampled as _ftrace_active
+from ..utils.flight_recorder import trace_span as _ftrace_span
 from ..utils.perf_counters import PerfCountersBuilder
 
 
@@ -562,6 +564,10 @@ class _Conn:
             wire = 14 + plen + 4
             nseg = len(segs)
         else:
+            # r15: when a sampled trace context is active on this
+            # thread (an op reply sealing inside the op's dynamic
+            # extent), the AEAD seal records as a crypto span — one
+            # contextvar read per frame otherwise
             with self.wlock:
                 # seal under the lock: the nonce counter must advance
                 # in transmit order or a reordered pair would reuse
@@ -570,9 +576,15 @@ class _Conn:
                     "<I", _NONCE + 10 + plen + _GCM_TAG)
                 t0 = _time_mod.perf_counter() \
                     if self.perf is not None else 0.0
-                plain = _flatten(
-                    [struct.pack("<QH", seq, type_id)] + segs)
-                sealed = self.box.seal(plain, hdr)
+                if _ftrace_active() is not None:
+                    with _ftrace_span("msgr.seal", nbytes=plen):
+                        plain = _flatten(
+                            [struct.pack("<QH", seq, type_id)] + segs)
+                        sealed = self.box.seal(plain, hdr)
+                else:
+                    plain = _flatten(
+                        [struct.pack("<QH", seq, type_id)] + segs)
+                    sealed = self.box.seal(plain, hdr)
                 if self.perf is not None:
                     self.perf.tinc("seal_time",
                                    _time_mod.perf_counter() - t0)

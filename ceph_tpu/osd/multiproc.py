@@ -29,8 +29,12 @@ Mechanics:
   N — the same trick that fixed r09's cold recovery;
 * the parent observes children through their admin sockets (bound in
   the cluster's shared admin_dir): `pg clean` drives wait_for_clean,
-  `perf dump` feeds bench attribution. RAM-reaching helpers
-  (rotate_service_secrets, Thrasher store fsck) are documented as
+  `perf dump` feeds bench attribution;
+* control-parity lines (r15): `rotate` pushes rotated service secrets
+  into the child's in-RAM verifier (rotate_service_secrets now works
+  against --osd-procs — secrets cross stdin, never argv), and `fsck`
+  runs a quiesced store audit inside the child and answers on stdout
+  — the two RAM-reaching helpers the r13 harness documented as
   in-process-only.
 """
 
@@ -188,6 +192,68 @@ class OSDProcHandle:
         behalf."""
         self._control({"cmd": "boot"})
 
+    # -- request/response control lines (r15 harness parity) ------------------
+
+    def _request(self, obj: dict, timeout: float = 30.0) -> dict:
+        """A control line that ANSWERS: ship {..., req: n} down stdin,
+        read stdout lines until {event, req: n} comes back. Serialized
+        under the control lock (the only other stdout traffic is the
+        one-shot ready line wait_ready consumed)."""
+        if self._stop.is_set():
+            raise ConnectionError(f"{self.name}: child is dead")
+        with self._ctl_lock:
+            self._req_seq = getattr(self, "_req_seq", 0) + 1
+            req = self._req_seq
+            try:
+                self._proc.stdin.write(
+                    json.dumps({**obj, "req": req}) + "\n")
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError):
+                raise ConnectionError(f"{self.name}: control pipe "
+                                      f"closed")
+            t_end = time.monotonic() + timeout
+            while time.monotonic() < t_end:
+                line = [None]
+
+                def _read():
+                    line[0] = self._proc.stdout.readline()
+                t = threading.Thread(target=_read, daemon=True)
+                t.start()
+                t.join(max(0.0, t_end - time.monotonic()))
+                if not line[0]:
+                    break
+                try:
+                    msg = json.loads(line[0])
+                except ValueError:
+                    continue
+                if msg.get("req") == req:
+                    return msg
+            raise TimeoutError(f"{self.name}: no reply to "
+                               f"{obj.get('cmd')!r} control line")
+
+    def push_rotating(self, service: str, rotating: list) -> None:
+        """Key-rotation push (the in-process verifier.refresh parity
+        path): rotated service secrets cross the child's stdin pipe —
+        never argv — and refresh its in-RAM ServiceVerifier, so
+        rotation composes with --osd-procs thrash cells."""
+        got = self._request({"cmd": "rotate", "service": service,
+                            "rotating": rotating})
+        if not got.get("ok"):
+            raise RuntimeError(f"{self.name}: rotation push failed: "
+                               f"{got.get('error')}")
+
+    def store_fsck(self, timeout: float = 60.0) -> dict:
+        """Online store audit (the Thrasher store-fsck parity path):
+        the child quiesces its store plane (store lock held) and runs
+        the offline TinStore fsck over its own directory; MemStore
+        children answer a trivial in-RAM audit. Returns the fsck
+        report dict."""
+        got = self._request({"cmd": "fsck"}, timeout=timeout)
+        if not got.get("ok"):
+            raise RuntimeError(f"{self.name}: store fsck failed: "
+                               f"{got.get('error')}")
+        return got["report"]
+
     def asok(self, cmd: str, timeout: float = 10.0):
         """Query the child's admin socket (shared admin_dir)."""
         from ..utils.admin_socket import admin_command
@@ -321,12 +387,36 @@ def child_main() -> int:
                 daemon.msgr.send(mon, MOSDBoot(daemon.osd_id))
             except (KeyError, OSError, ConnectionError):
                 pass
+    def _answer(req, ok, **fields) -> None:
+        print(json.dumps({"req": req, "ok": ok, **fields}),
+              flush=True)
+
+    def _fsck() -> dict:
+        """Online audit: quiesce the store plane (store lock), then
+        run the offline fsck over this child's own directory. A
+        concurrent local write can at worst leave a torn WAL tail,
+        which TinDB.fsck already classifies as recoverable — the
+        caller judges `errors`/`bad_objects`, not torn_tail."""
+        store = daemon.store
+        path = getattr(store, "path", None)
+        if path is None:
+            # MemStore: nothing on disk — answer the in-RAM shape
+            return {"format": "mem", "errors": [], "bad_objects": [],
+                    "extent_errors": [],
+                    "objects": sum(len(c) for c in
+                                   store.collections.values())
+                    if hasattr(store, "collections") else 0}
+        from .tinstore import TinStore
+        with daemon._store_lock:
+            return TinStore.fsck(path)
+
     for raw in sys.stdin:        # EOF = parent gone: die with it
         try:
             ctl = json.loads(raw)
         except ValueError:
             continue
         cmd = ctl.get("cmd")
+        req = ctl.get("req")
         try:
             if cmd == "add_peer":
                 daemon.msgr.add_peer(ctl["peer"], tuple(ctl["addr"]))
@@ -341,10 +431,25 @@ def child_main() -> int:
             elif cmd == "inject_delay":
                 daemon.msgr.set_inject_delay(ctl["every"],
                                              ctl["max_ms"])
+            elif cmd == "rotate":
+                # key-rotation push (r15 parity): refresh the live
+                # verifier AND the shim KeyServer, so the daemon's
+                # own _start/revive paths see the rotated export too
+                rot = [tuple(x) for x in ctl["rotating"]]
+                if shim.key_server is not None:
+                    shim.key_server._rot[ctl["service"]] = list(rot)
+                if daemon.verifier is not None:
+                    daemon.verifier.refresh(rot)
+                if req is not None:
+                    _answer(req, True)
+            elif cmd == "fsck":
+                _answer(req, True, report=_fsck())
             elif cmd == "shutdown":
                 break
         except Exception as e:   # noqa: BLE001 — a bad control line
             shim.log(f"control {cmd!r} failed: {e!r}")   # is not fatal
+            if req is not None:
+                _answer(req, False, error=f"{type(e).__name__}: {e}")
     daemon.kill()
     return 0
 

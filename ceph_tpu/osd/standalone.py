@@ -70,6 +70,9 @@ import numpy as np
 
 from ..msgr.messenger import Message, Messenger, register_message
 from ..utils.encoding import Decoder, Encoder
+from ..utils.flight_recorder import current_sampled as \
+    _trace_current_sampled
+from ..utils.flight_recorder import declare_span_names
 from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
 from .osdmap import Incremental, OSDMap, PGPool
@@ -85,6 +88,21 @@ PG_META_DELTA_KEY = b"pg_meta_delta"
 #: entries before the next write re-ships the full blob
 _META_DELTA_MAX = 32
 
+# every span name this module's hops may record into a flight ring
+# (the r9 no-undeclared-names invariant, extended to the trace plane;
+# ecbackend's span() sites declare themselves through the same call —
+# the observability smoke asserts no ring carries an undeclared name)
+declare_span_names(
+    "client.op", "client.hedge",
+    "osd.queue", "osd.op", "osd.subop", "store.apply",
+    "osd.recovery_round",
+    "msgr.seal",
+    "ecbackend.write.encode", "ecbackend.read.decode",
+    "ecbackend.recover.stage", "ecbackend.recover.launch",
+    "ecbackend.recover.fetch", "ecbackend.recover.writeback",
+    "ecbackend.recover.batch",
+)
+
 
 # -- typed frames (0x30 block) ----------------------------------------------
 
@@ -93,22 +111,41 @@ class _Blob(Message):
     one buffer or a segment list (Encoder.segments output): either way
     it is appended BY REFERENCE, so an op body carrying object data
     crosses the encode + framing path without a copy. Decoded messages
-    always carry contiguous bytes."""
+    always carry contiguous bytes.
+
+    `trace` (r15) is an OPTIONAL, VERSION-GATED tail field carrying a
+    distributed-tracing context (ref: MOSDOp::otel_trace riding the
+    message): a frame without one encodes the v1 section BIT-IDENTICAL
+    to the pre-r15 wire (pinned by tests/test_msgr_frames.py), a frame
+    with one encodes v2/compat-1 — a legacy decoder's finish() skips
+    the field, a new decoder reads it only when the writer declared
+    v >= 2 AND bytes remain in the section (legacy-sender interop)."""
 
     def __init__(self, req_id: int, ok: bool = True, kind: str = "",
-                 blob=b"", err: str = ""):
+                 blob=b"", err: str = "", trace=None):
         self.req_id, self.ok = req_id, ok
         self.kind, self.blob, self.err = kind, blob, err
+        self.trace = trace           # TraceContext | None
 
     def encode_payload(self, e: Encoder) -> None:
-        (e.start(1, 1).u64(self.req_id).boolean(self.ok)
+        if self.trace is None:
+            (e.start(1, 1).u64(self.req_id).boolean(self.ok)
+             .string(self.kind).blob_ref(self.blob).string(self.err)
+             .finish())
+            return
+        (e.start(2, 1).u64(self.req_id).boolean(self.ok)
          .string(self.kind).blob_ref(self.blob).string(self.err)
-         .finish())
+         .blob(self.trace.encode()).finish())
 
     @classmethod
     def decode_payload(cls, d: Decoder) -> "_Blob":
-        d.start(1)
+        v = d.start(2)
         m = cls(d.u64(), d.boolean(), d.string(), d.blob(), d.string())
+        if v >= 2 and d.remaining_in_section() >= 4:
+            raw = d.blob()
+            if raw:
+                from ..utils.flight_recorder import TraceContext
+                m.trace = TraceContext.decode(raw)
         d.finish()
         return m
 
@@ -861,8 +898,15 @@ class RemoteStore:
         self._on_latency = on_latency
 
     def _submit(self, kind: str, body):
+        # trace propagation (r15): whatever sampled context is active
+        # on THIS thread (a client op mid-fan-out, a recovery round
+        # mid-pull) rides the sub-op frame, so the helper's spans land
+        # under the same trace. Unsampled/absent context costs one
+        # contextvar read and zero wire bytes.
+        ctx = _trace_current_sampled()
         return self._rpc.submit(
-            self._peer, lambda rid: MStoreOp(rid, True, kind, body))
+            self._peer,
+            lambda rid: MStoreOp(rid, True, kind, body, trace=ctx))
 
     def _call(self, kind: str, body: bytes = b"") -> bytes:
         for attempt in range(2):
@@ -1026,6 +1070,21 @@ class _RecoveryRound:
             push_window_bytes=max_active
             * int(cfg["osd_recovery_max_chunk"]))
         self.failed = False
+        # r15: recovery rounds get their own sampled trace context
+        # (rate-gated) — every fused batch then records its stage/
+        # launch/fetch/writeback spans, and the readv/readv_ranges
+        # helper pulls carry the context to their sources, whose
+        # osd.subop spans land under the same trace.
+        from ..utils.flight_recorder import (TraceContext, coin,
+                                             new_trace_id)
+        self.trace_ctx = None
+        try:
+            rate = float(cfg["osd_trace_recovery_sample_rate"])
+        except (KeyError, ValueError):
+            rate = 0.0
+        if coin(rate):
+            self.trace_ctx = TraceContext(new_trace_id(), 0,
+                                          sampled=True)
 
     def lost_of(self, ps: int) -> list[int]:
         return self.plans[ps].lost
@@ -1043,6 +1102,18 @@ class _RecoveryRound:
                    / float(self.d.config["osd_recovery_max_chunk"]))
 
     def __call__(self) -> None:
+        # each grant executes one fused batch under the round's trace
+        # context (if sampled): the stage/launch/fetch/writeback spans
+        # and the helper pulls' osd.subop spans all land in one trace
+        from ..utils.flight_recorder import activate, trace_span
+        with activate(self.trace_ctx,
+                      self.d.flight if self.trace_ctx is not None
+                      else None):
+            with trace_span("osd.recovery_round",
+                            pgs=sorted(self.plans)):
+                self._grant()
+
+    def _grant(self) -> None:
         d = self.d
         # the daemon lock plus EVERY member PG's lock (ascending —
         # the one global order): a fused batch may touch any plan's
@@ -1157,14 +1228,20 @@ class _BatchJoin:
     single-shard path."""
 
     def __init__(self, daemon: "OSDDaemon", peer: str, msg,
-                 n_slots: int, n_groups: int):
+                 n_slots: int, n_groups: int,
+                 t_enq: float | None = None):
         self.d, self.peer, self.msg = daemon, peer, msg
         self.slots: list = [None] * n_slots
         self._left = n_groups
         self._lock = threading.Lock()
+        self.t_enq = t_enq
 
     def run(self, items: list) -> None:
         """items: [(slot, kind, body)] — one shard's share."""
+        with self.d._trace_enter(self.msg, self.t_enq):
+            self._run_inner(items)
+
+    def _run_inner(self, items: list) -> None:
         for slot, kind, body in items:
             try:
                 blob = self.d._one_client_op(self.peer, kind, body)
@@ -1266,6 +1343,13 @@ class OSDDaemon:
         # planner's per-helper read costs — suspects and slow peers
         # rank behind fast trusted ones instead of uniform-cost picks
         self._peer_lat: dict[int, float] = {}
+        # CLIENT-observed per-osd latency (r15, the r14 follow-up):
+        # sampled ops carry the client hedge ladder's EWMA/complaint
+        # snapshot; folded here as osd -> (seconds, wall stamp) so
+        # _helper_costs ranks by the slower of the daemon's own view
+        # and what clients actually experienced. Stamped so a stale
+        # client claim ages out instead of pinning costs forever.
+        self._client_lat: dict[int, tuple[float, float]] = {}
         self._reported: set[int] = set()
         self._stop = threading.Event()
         # cephx (ref: OSD::ms_verify_authorizer): rotating secrets are
@@ -1610,9 +1694,22 @@ class OSDDaemon:
                     pass
                 return
         try:
-            with self.perf.time("subop_latency"):
-                with self._store_lock:
-                    blob = self._store_op(msg.kind, msg.blob)
+            # r15: a sampled context on the frame puts this hop's
+            # spans under the originating trace — osd.subop covers the
+            # whole service (store-lock wait + reply encode), with the
+            # store apply itself a nested child, so the assembler can
+            # split store time from sub-op queueing.
+            from ..utils.flight_recorder import activate, trace_span
+            ctx = msg.trace if msg.trace is not None \
+                and msg.trace.sampled else None
+            with activate(ctx, self.flight if ctx is not None
+                          else None):
+                with trace_span("osd.subop", kind=msg.kind):
+                    with self.perf.time("subop_latency"):
+                        with self._store_lock:
+                            with trace_span("store.apply"):
+                                blob = self._store_op(msg.kind,
+                                                      msg.blob)
             self.perf.inc_many((("subop", 1),
                                 ("subop_in_bytes", len(msg.blob)),
                                 ("subop_out_bytes", len(blob))))
@@ -1725,22 +1822,54 @@ class OSDDaemon:
         self._peer_lat[osd] = dt if prev is None \
             else 0.75 * prev + 0.25 * dt
 
+    #: client-observed latency claims older than this are ignored (a
+    #: one-off slow window must not bias helper picks for hours)
+    _CLIENT_LAT_TTL = 30.0
+
+    def _note_client_costs(self, ctx) -> None:
+        """Fold a sampled op's client cost snapshot (per-osd read
+        EWMAs + the client's live complaint set) into this daemon's
+        helper cost table. Complaints fold as a 1s-equivalent floor —
+        well above any healthy round trip, well below the down
+        surcharge — so a client-suspected helper ranks last among the
+        live ones without being treated as dead."""
+        now = time.monotonic()
+        for osd, lat in (ctx.client_lat or {}).items():
+            osd = int(osd)
+            prev = self._client_lat.get(osd)
+            blend = float(lat) if prev is None \
+                else 0.75 * prev[0] + 0.25 * float(lat)
+            self._client_lat[osd] = (blend, now)
+        for osd in ctx.client_suspects:
+            cur = self._client_lat.get(int(osd))
+            base = cur[0] if cur is not None else 0.0
+            self._client_lat[int(osd)] = (max(base, 1.0), now)
+
     def _helper_costs(self, be) -> dict[int, int]:
         """Per-slot read costs for the repair-locality planner
         (minimum_to_decode_with_cost units: integer microseconds).
         Real signals, not uniform guesses: the peer-latency EWMA from
-        actual store-op round trips, plus a prohibitive surcharge for
-        anyone in the down/slow complaint memory — such slots are
-        usually excluded outright, but a cost keeps ties deterministic
-        when they must serve."""
+        actual store-op round trips, the CLIENT-observed EWMAs sampled
+        ops shipped (r15 — the slower of the two views wins, so a
+        helper that answers its peers fast but stalls clients still
+        ranks behind), plus a prohibitive surcharge for anyone in the
+        down/slow complaint memory — such slots are usually excluded
+        outright, but a cost keeps ties deterministic when they must
+        serve."""
         n_osds = len(self.osdmap.osd_up) if self.osdmap is not None \
             else 0
+        now = time.monotonic()
         costs: dict[int, int] = {}
         for s, osd in enumerate(be.acting):
             if osd == self.osd_id:
                 cost = 0                  # our own store is free
             else:
-                cost = int(self._peer_lat.get(osd, 0.001) * 1e6)
+                lat = self._peer_lat.get(osd, 0.001)
+                claim = self._client_lat.get(osd)
+                if claim is not None \
+                        and now - claim[1] < self._CLIENT_LAT_TTL:
+                    lat = max(lat, claim[0])
+                cost = int(lat * 1e6)
             if osd in self.suspect or (
                     _valid_osd(osd, n_osds)
                     and self.osdmap is not None
@@ -2534,10 +2663,16 @@ class OSDDaemon:
         this daemon's layered config (osd_op_complaint_time /
         osd_op_history_*), so a committed `config set` retunes it
         live."""
+        from ..utils.flight_recorder import FlightRecorder
         from ..utils.op_tracker import OpTracker
         from ..utils.perf_counters import PerfCountersBuilder
         from .ecbackend import ec_perf_counters
         self.op_tracker = OpTracker(config=self.config)
+        # per-daemon flight recorder (r15): bounded ring of finished
+        # trace spans, in-RAM like the rest of the observability plane
+        # (dies with the process; rebuilt here on revive). Dumped via
+        # `trace dump`, drained into MgrReports for the mon assembler.
+        self.flight = FlightRecorder(self.name, config=self.config)
         b = PerfCountersBuilder(f"osd.{self.osd_id}")
         for key in ("op", "op_r", "op_w", "op_in_bytes",
                     "op_out_bytes"):
@@ -2651,6 +2786,7 @@ class OSDDaemon:
                    "log dump",
                    "config show",
                    "config diff", "trace start", "trace stop",
+                   "trace dump",
                    "status")
 
     def _pg_states(self) -> dict:
@@ -2711,6 +2847,11 @@ class OSDDaemon:
             return self.config.dump()
         if cmd == "config diff":
             return self.config.diff()
+        if cmd.startswith("trace dump"):
+            # the flight-recorder ring (r15): finished per-op trace
+            # spans, optionally filtered to one trace id (hex)
+            arg = cmd[len("trace dump"):].strip() or None
+            return self.flight.dump(trace_id=arg)
         if cmd.startswith("trace start"):
             from ..utils.tracing import start_trace
             log_dir = cmd[len("trace start"):].strip() \
@@ -2867,12 +3008,14 @@ class OSDDaemon:
         # per client entity per shard), so a heavy tenant — hedged
         # duplicates and degraded decodes included — competes under
         # its own (ρ, w, λ) tags instead of starving the rest.
+        t_enq = time.time()     # r15: the osd.queue span's start mark
         if sub_ops is None:
             shard = self._shard_of(self._op_ps(msg.blob))
             cls = "scrub" if msg.kind in ("deep_scrub", "repair") \
                 else self._client_class(peer, shard)
             self._sched_enqueue(
-                cls, lambda: self._serve_client_op(peer, msg, None),
+                cls, lambda: self._serve_client_op(peer, msg, None,
+                                                   t_enq=t_enq),
                 shard=shard)
             return
         # batch frame: split the sub-ops by shard (a batch groups by
@@ -2887,18 +3030,54 @@ class OSDDaemon:
             shard = self.op_shards[next(iter(groups))]
             cls = self._client_class(peer, shard)
             self._sched_enqueue(
-                cls, lambda: self._serve_client_op(peer, msg, sub_ops),
+                cls, lambda: self._serve_client_op(peer, msg, sub_ops,
+                                                   t_enq=t_enq),
                 shard=shard)
             return
-        join = _BatchJoin(self, peer, msg, len(sub_ops), len(groups))
+        join = _BatchJoin(self, peer, msg, len(sub_ops), len(groups),
+                          t_enq=t_enq)
         for idx, items in groups.items():
             shard = self.op_shards[idx]
             cls = self._client_class(peer, shard)
             self._sched_enqueue(
                 cls, lambda items=items: join.run(items), shard=shard)
 
+    def _trace_enter(self, msg, t_enq: float | None):
+        """One op frame's trace arrival on a shard worker: fold the
+        client's cost snapshot (sampled first hops carry it), record
+        the mClock queue wait as an `osd.queue` span, and return the
+        activate() context manager execution should run under (a
+        no-op manager when the frame is untraced)."""
+        from ..utils.flight_recorder import activate
+        ctx = msg.trace
+        if ctx is None:
+            return activate(None, None)
+        if ctx.client_lat or ctx.client_suspects:
+            self._note_client_costs(ctx)
+        if ctx.sampled and t_enq is not None:
+            from ..utils.flight_recorder import new_trace_id
+            self.flight.record(ctx.trace_id, new_trace_id(),
+                               ctx.parent_span_id, "osd.queue",
+                               t_enq, max(0.0, time.time() - t_enq),
+                               {"kind": msg.kind})
+        return activate(ctx, self.flight)
+
+    def _maybe_retro_trace(self, op, ctx) -> None:
+        """Retroactive capture (r15): an UNSAMPLED op that crossed the
+        live complaint threshold converts its OpTracker events into
+        retro.* ring spans under the carried trace id — `ceph_cli
+        trace <id>` can then assemble a timeline nobody sampled."""
+        if (ctx is not None and not ctx.sampled and op.done
+                and op.duration > self.op_tracker.complaint_time):
+            self.flight.record_tracked(op, ctx)
+
     def _serve_client_op(self, peer: str, msg: MOSDOp,
-                         sub_ops) -> None:
+                         sub_ops, t_enq: float | None = None) -> None:
+        with self._trace_enter(msg, t_enq):
+            self._serve_client_op_inner(peer, msg, sub_ops)
+
+    def _serve_client_op_inner(self, peer: str, msg: MOSDOp,
+                               sub_ops) -> None:
         try:
             if sub_ops is not None:
                 # per-sub-op fault isolation: one bad sub-op fails its
@@ -2926,6 +3105,7 @@ class OSDDaemon:
             pass
 
     def _one_client_op(self, peer: str, kind: str, body: bytes) -> bytes:
+        from ..utils.flight_recorder import current
         from ..utils.tracing import span
         with span("osd.op", counters=self.perf, key="op_latency"):
             with self.op_tracker.create_op(
@@ -2938,6 +3118,7 @@ class OSDDaemon:
                     op.mark_event("reached_pg")
                     blob = self._client_op(kind, body)
                 op.mark_event("commit_sent")
+        self._maybe_retro_trace(op, current())
         self.perf.inc_many(
             (("op", 1),
              ("op_r" if kind in self._READ_KINDS else "op_w", 1),
@@ -3538,6 +3719,12 @@ class OSDDaemon:
         }
         if full:
             report["schema"] = self.perf_schema_all()
+        # r15: drain freshly finished flight-recorder spans into the
+        # same pipe (bounded per report; the mon-side TraceAssembler
+        # stitches rings across daemons into causal timelines)
+        spans = self.flight.drain(512)
+        if spans:
+            report["spans"] = spans
         self._mgr_last_perf = perf
         # PG states want the daemon lock; never stall the heartbeat
         # for them — a busy beat ships without, and the aggregator
@@ -3583,6 +3770,8 @@ class OSDDaemon:
         fresh._last_acting = {}
         fresh.suspect = set()
         fresh._last_pong = {}
+        fresh._peer_lat = {}
+        fresh._client_lat = {}
         fresh._reported = set()
         fresh._stop = threading.Event()
         # auth sessions die with the process; rotating secrets are
@@ -3690,6 +3879,11 @@ class MonDaemon:
                      .add_u64("osdmap_epoch", "committed map epoch")
                      .create_perf_counters())
         self.mgr = MgrReportAggregator()
+        # r15: per-monitor trace assembler — every monitor stitches
+        # the span streams riding the MgrReport pipe independently,
+        # so any one of them can answer `ceph_cli trace`
+        from ..mgr.tracing import TraceAssembler
+        self.traces = TraceAssembler()
         self._mgr_seq = 0
         self._mgr_last_sent = 0.0
         from ..utils.admin_socket import AdminSocket
@@ -3699,6 +3893,11 @@ class MonDaemon:
                      "mon_status", "log dump", "autoscale status"):
             self.asok.register(_cmd,
                                lambda args, c=_cmd: self._mon_cmd_obj(c))
+        # argumented: `trace slow` / `trace list` / `trace <id-hex>`
+        self.asok.register(
+            "trace",
+            lambda args: self._mon_cmd_obj(("trace " + args).strip()),
+            "assembled distributed traces: slow | list | <trace-id>")
         self.asok.start()
         m = self.msgr
         m.register_handler(MMgrReport.type_id, self._on_mgr_report)
@@ -4104,7 +4303,15 @@ class MonDaemon:
     def _on_mgr_report(self, peer: str, msg: MMgrReport) -> None:
         import json as _json
         try:
-            self.mgr.ingest(_json.loads(msg.blob.decode()))
+            report = _json.loads(msg.blob.decode())
+            # r15: span streams ride the same pipe — fold them into
+            # the trace assembler. Pure-trace reports (client flushes)
+            # must NOT touch the perf aggregation (they carry no
+            # counters and would churn the daemon staleness state).
+            if report.get("spans"):
+                self.traces.ingest(report["spans"])
+            if report.get("kind") != "trace":
+                self.mgr.ingest(report)
             self.perf.inc("mgr_reports_rx")
         except (ValueError, UnicodeDecodeError):
             pass                 # malformed report: drop, don't die
@@ -4240,6 +4447,16 @@ class MonDaemon:
             if self.osdmap is None:
                 return []
             return autoscale_from_reports(self.mgr, self.osdmap)
+        if kind == "trace list":
+            return {"traces": self.traces.list_traces()}
+        if kind == "trace slow":
+            # slowest assembled traces with their critical-path
+            # attribution — the cross-daemon complement of slow_ops
+            return {"traces": self.traces.slow()}
+        if kind.startswith("trace "):
+            # `trace <id-hex>`: one assembled causal timeline +
+            # attribution summary + Chrome trace-event JSON
+            return self.traces.assemble(kind[len("trace "):].strip())
         raise ValueError(f"unknown mon command {kind!r}")
 
     def _on_mon_cmd(self, peer: str, msg: MMonCmd) -> None:
@@ -4768,6 +4985,63 @@ class _WireOp:
         self.try_degraded = False
 
 
+class _TracedCall:
+    """A _PendingCall plus the client-side root span of its trace:
+    whatever way the handle retires (wait / take / cancel / timeout),
+    the span records EXACTLY ONCE — sampled frames record always,
+    unsampled ones retroactively when they crossed the client's
+    complaint threshold (the slow-op path `trace <id>` assembles)."""
+
+    __slots__ = ("_cl", "_p", "ctx", "_name", "_tags", "_t0w",
+                 "_t0m", "_done")
+
+    def __init__(self, client: "Client", pend: _PendingCall,
+                 ctx, name: str, tags: dict | None):
+        self._cl, self._p, self.ctx = client, pend, ctx
+        self._name, self._tags = name, tags
+        self._t0w, self._t0m = time.time(), time.monotonic()
+        self._done = False
+
+    def _finish(self) -> None:
+        ctx = self.ctx
+        if self._done or ctx is None:
+            return
+        self._done = True
+        dur = time.monotonic() - self._t0m
+        retro = not ctx.sampled
+        if retro and dur <= self._cl.op_tracker.complaint_time:
+            return
+        tags = dict(self._tags or {})
+        if retro:
+            tags["retro"] = True
+        self._cl.flight.record(ctx.trace_id, ctx.parent_span_id, 0,
+                               self._name, self._t0w, dur, tags)
+
+    # the _PendingCall surface the dispatch/hedge loops drive --------------
+
+    def wait(self, timeout: float = 10.0):
+        try:
+            return self._p.wait(timeout)
+        finally:
+            self._finish()
+
+    def ready(self, timeout: float | None = 0.0) -> bool:
+        return self._p.ready(timeout)
+
+    def take(self):
+        try:
+            return self._p.take()
+        finally:
+            self._finish()
+
+    def cancel(self) -> None:
+        self._p.cancel()
+        self._finish()
+
+    def add_waiter(self, ev: threading.Event) -> None:
+        self._p.add_waiter(ev)
+
+
 class Client:
     """librados over the wire: locate the PG from the cached map, talk
     to its primary, retry on map change / primary death. Ops dispatch
@@ -4786,7 +5060,9 @@ class Client:
                  secret: bytes | None = None,
                  window: int | None = None,
                  window_bytes: int = 64 << 20,
-                 hedge_delay_ms: float | None = None):
+                 hedge_delay_ms: float | None = None,
+                 trace_sample_rate: float | None = None):
+        from ..utils.flight_recorder import FlightRecorder
         from ..utils.op_tracker import OpTracker
         from ..utils.perf_counters import PerfCountersBuilder
         self.c = cluster
@@ -4808,6 +5084,16 @@ class Client:
         # delay derives from (submit->reply wall time per read frame)
         self.op_tracker = OpTracker(history_size=64,
                                     complaint_time=5.0)
+        # r15 distributed tracing: the client is the trace ORIGIN — it
+        # stamps a compact context on every op frame (live-resolved
+        # sample rate decides eager recording; hedged/degraded
+        # dispatches are always sampled) and keeps its own flight ring
+        # of client.op/client.hedge root spans, flushed to the
+        # monitors' assemblers after op rounds.
+        self.trace_sample_rate = trace_sample_rate
+        self.flight = FlightRecorder(name)
+        self.last_trace_id: int = 0     # newest SAMPLED trace stamped
+        self._trace_flushed = 0.0
         self.perf = (PerfCountersBuilder("client")
                      .add_u64_counter("hedge_issued",
                                       "duplicate shard reads sent "
@@ -5039,6 +5325,75 @@ class Client:
         p95 = hist[min(len(hist) - 1, int(0.95 * len(hist)))]
         return min(max(4.0 * p95, lo), hi)
 
+    # -- distributed tracing (r15): context stamping --------------------------
+
+    def _trace_rate(self) -> float:
+        """Live sample rate: constructor override, else the committed
+        central config (client_trace_sample_rate), else the schema
+        default. < 0 disables context stamping entirely (frames revert
+        to the bit-identical v1 encoding)."""
+        raw = self.trace_sample_rate
+        if raw is None and self.osdmap is not None:
+            raw = self.osdmap.config_kv.get("client_trace_sample_rate")
+        if raw is None:
+            raw = 0.01
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            return 0.01
+
+    def _make_trace_ctx(self, force: bool = False):
+        """The context one op frame carries, or None when stamping is
+        off. `force` (hedged/degraded dispatches) samples
+        unconditionally — those are exactly the multi-hop latency
+        stories the tracing plane exists for. A SAMPLED first hop also
+        ships this client's per-target latency EWMAs + live complaint
+        set, which the serving daemon folds into its repair-planner
+        cost table (the r14 follow-up)."""
+        from ..utils.flight_recorder import (TraceContext, coin,
+                                             new_trace_id)
+        rate = self._trace_rate()
+        if rate < 0:
+            return None
+        sampled = force or coin(rate)
+        lat = None
+        suspects: tuple[int, ...] = ()
+        if sampled:
+            lat = {int(t[4:]): v for t, v in self._lat_ewma.items()
+                   if t.startswith("osd.")}
+            suspects = tuple(sorted(
+                int(t[4:]) for t in self._tgt_suspect
+                if t.startswith("osd.")))
+            ctx = TraceContext(new_trace_id(), new_trace_id(), True,
+                               client_lat=lat or None,
+                               client_suspects=suspects)
+            self.last_trace_id = ctx.trace_id
+            return ctx
+        # unsampled: the id still travels, so every daemon can
+        # retroactively assemble this op if it turns out slow
+        return TraceContext(new_trace_id(), new_trace_id(), False)
+
+    def _flush_trace_spans(self, force: bool = False) -> None:
+        """Ship this client's freshly finished spans to the monitors'
+        assemblers (clients have no MgrReport heartbeat — they flush
+        after op rounds, throttled)."""
+        import json as _json
+        now = time.monotonic()
+        if not force and now - self._trace_flushed < 1.0:
+            return
+        if not self.flight.pending_ship():
+            return
+        self._trace_flushed = now
+        spans = self.flight.drain(512)
+        blob = _json.dumps({"name": self.msgr.name, "kind": "trace",
+                            "spans": spans},
+                           separators=(",", ":")).encode()
+        for mon in self.c.mon_names():
+            try:
+                self.msgr.send(mon, MMgrReport(0, True, "trace", blob))
+            except (KeyError, OSError, ConnectionError):
+                pass
+
     def _read_fallback(self, ps: int, avoid: set[str]) -> str | None:
         """Next-best acting shard for a degraded/hedged read: an
         acting member that is up in OUR map and not in `avoid`,
@@ -5082,11 +5437,14 @@ class Client:
             return False
         return True
 
-    def _submit_degraded(self, op: "_WireOp",
-                         tgt: str, hints: set[str]) -> _PendingCall:
+    def _submit_degraded(self, op: "_WireOp", tgt: str,
+                         hints: set[str],
+                         span_name: str = "client.op") -> _TracedCall:
         """One read re-issued as a `read_degraded` frame: names plus
         the osd ids being routed around (the server skips them in its
-        meta gather and decode instead of re-paying their timeouts)."""
+        meta gather and decode instead of re-paying their timeouts).
+        Degraded/hedged dispatches are ALWAYS-SAMPLED trace origins
+        (the multi-hop tail stories the tracing plane exists for)."""
         e = Encoder()
         e.u32(op.ps)
         e.list(op.names, Encoder.string)
@@ -5094,9 +5452,13 @@ class Client:
                       if t.startswith("osd.")),
                lambda en, v: en.i32(v))
         body = e.bytes()
-        return self.rpc.submit(
-            tgt, lambda rid: MOSDOp(rid, True, "read_degraded", body),
+        ctx = self._make_trace_ctx(force=True)
+        pend = self.rpc.submit(
+            tgt, lambda rid: MOSDOp(rid, True, "read_degraded", body,
+                                    trace=ctx),
             nbytes=len(body))
+        tags = {"tgt": tgt, "ops": len(op.names), "degraded": True}
+        return _TracedCall(self, pend, ctx, span_name, tags)
 
     def _settle_degraded(self, op: "_WireOp", ok: bool, blob: bytes,
                          err: str, tgt: str, need_auth: set) -> None:
@@ -5148,7 +5510,8 @@ class Client:
                     continue
                 self.perf.inc("hedge_issued")
                 hedges.append((op, alt, self._submit_degraded(
-                    op, alt, op.avoid | {tgt})))
+                    op, alt, op.avoid | {tgt},
+                    span_name="client.hedge")))
         ev = threading.Event()
         pend.add_waiter(ev)
         for _op, _alt, hp in hedges:
@@ -5290,13 +5653,20 @@ class Client:
                 by_tgt.setdefault(tgt, []).append(op)
             handles = []
             for tgt, group in by_tgt.items():
+                # r15: every frame carries a trace context (the id
+                # travels so slow ops assemble retroactively; the
+                # sampled flag — probabilistic — gates eager span
+                # recording at every hop). _TracedCall records the
+                # client.op root span however the handle retires.
+                ctx = self._make_trace_ctx()
                 if len(group) == 1:
                     op = group[0]
                     body = self._encode_op_body(op)
                     nbytes = sum(len(s) for s in body)
                     pend = self.rpc.submit(
-                        tgt, lambda rid, k=op.kind, b=body:
-                        MOSDOp(rid, True, k, b), nbytes=nbytes)
+                        tgt, lambda rid, k=op.kind, b=body, tr=ctx:
+                        MOSDOp(rid, True, k, b, trace=tr),
+                        nbytes=nbytes)
                 else:
                     # coalesce: one frame carries every outstanding op
                     # for this primary (small-op dispatch stops paying
@@ -5309,8 +5679,11 @@ class Client:
                     body = e.segments()
                     nbytes = sum(len(s) for s in body)
                     pend = self.rpc.submit(
-                        tgt, lambda rid, b=body:
-                        MOSDOp(rid, True, "batch", b), nbytes=nbytes)
+                        tgt, lambda rid, b=body, tr=ctx:
+                        MOSDOp(rid, True, "batch", b, trace=tr),
+                        nbytes=nbytes)
+                pend = _TracedCall(self, pend, ctx, "client.op",
+                                   {"tgt": tgt, "ops": len(group)})
                 handles.append((tgt, group, pend, time.monotonic()))
             deg_handles = []
             for op, alt in deg_ops:
@@ -5375,6 +5748,7 @@ class Client:
                 break
             if not need_auth:
                 time.sleep(retry_sleep)   # map may be in flight
+        self._flush_trace_spans()
         for op in ops:
             if op.fatal is not None and not isinstance(op.fatal,
                                                        KeyError):
@@ -5761,10 +6135,12 @@ class StandaloneCluster:
 
     def client(self, entity: str = "client.admin",
                secret: bytes | None = None,
-               hedge_delay_ms: float | None = None) -> Client:
+               hedge_delay_ms: float | None = None,
+               trace_sample_rate: float | None = None) -> Client:
         cl = Client(self, f"client.{len(self.clients)}",
                     entity=entity, secret=secret,
-                    hedge_delay_ms=hedge_delay_ms)
+                    hedge_delay_ms=hedge_delay_ms,
+                    trace_sample_rate=trace_sample_rate)
         self.clients.append(cl)
         self._wire_peers()
         # subscribe: any mon will answer with the current map
@@ -5789,11 +6165,16 @@ class StandaloneCluster:
         daemons = list(self.osds.values()) if service == "osd" \
             else self.mons if service == "mon" else []
         for d in daemons:
-            # proc-mode OSD handles have no in-RAM verifier to push
-            # to (rotation is in-process-only; see multiproc.py)
-            if getattr(d, "verifier", None) is not None \
-                    and not d._stop.is_set():
+            if d._stop.is_set():
+                continue
+            if getattr(d, "verifier", None) is not None:
                 d.verifier.refresh(rot)
+            elif hasattr(d, "push_rotating"):
+                # multi-process OSD (r15 parity): the rotated secrets
+                # cross the child's control pipe — stdin, never argv —
+                # and refresh the child's in-RAM verifier, exactly the
+                # push an in-process daemon gets
+                d.push_rotating(service, rot)
 
     # -- fault injection ------------------------------------------------------
 

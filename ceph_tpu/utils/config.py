@@ -150,6 +150,22 @@ OPTIONS: list[Option] = [
            "10k OSDs per-epoch churn is a few redirects, not a "
            "re-encode of the whole topology (1 = always full)",
            min=1),
+    Option("client_trace_sample_rate", float, 0.01,
+           "fraction of client op frames stamped as SAMPLED trace "
+           "contexts (every frame carries the compact context so slow "
+           "ops can be retroactively assembled; sampled ones record "
+           "spans eagerly at every hop). Hedged/degraded dispatches "
+           "are always sampled; < 0 disables context stamping "
+           "entirely", max=1.0),
+    Option("osd_trace_ring_size", int, 2048,
+           "finished spans a daemon's flight recorder keeps in RAM "
+           "(oldest evicted first; evicted-before-shipped spans are "
+           "counted in the trace dump's dropped_unshipped)", min=16),
+    Option("osd_trace_recovery_sample_rate", float, 1.0,
+           "fraction of mClock recovery-round grants that run under a "
+           "sampled trace context (the recovery/readv_ranges helper "
+           "pulls then record osd.subop spans at their sources)",
+           min=0.0, max=1.0),
     Option("mgr_report_interval", float, 2.0,
            "seconds between a daemon's MgrReports to the monitors "
            "(the reference defaults to 5; lower = fresher `ceph "

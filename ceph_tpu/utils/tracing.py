@@ -30,6 +30,8 @@ from __future__ import annotations
 import contextlib
 import time
 
+from . import flight_recorder as _fr
+
 
 #: memoized jax.profiler.TraceAnnotation class (False = unresolved):
 #: the old per-span() try/import ran the import machinery on EVERY
@@ -57,9 +59,19 @@ def _annotation(name: str):
 @contextlib.contextmanager
 def span(name: str, counters=None, key: str | None = None):
     """Named span: visible in jax.profiler traces; optionally tincs
-    `counters[key]` (a time_avg) with the wall duration."""
+    `counters[key]` (a time_avg) with the wall duration; and — when a
+    SAMPLED trace context is active (utils/flight_recorder) — recorded
+    into the executing daemon's flight ring under that trace. One
+    instrumentation point, three consumers (profiler timeline,
+    production counters, per-op distributed trace), so none of them
+    can drift from the others. Off-trace the extra cost is a single
+    contextvar read."""
     ann = _annotation(name)
     t0 = time.perf_counter() if counters is not None else 0.0
+    fspan = _fr.trace_span(name) \
+        if _fr.current_sampled() is not None else None
+    if fspan is not None:
+        fspan.__enter__()
     try:
         if ann is not None:
             with ann:
@@ -69,6 +81,8 @@ def span(name: str, counters=None, key: str | None = None):
     finally:
         # record even when the body raises — failing/slow-error ops are
         # exactly the ones worth timing (PerfCounters.time() semantics)
+        if fspan is not None:
+            fspan.__exit__(None, None, None)
         if counters is not None and key is not None:
             counters.tinc(key, time.perf_counter() - t0)
 

@@ -20,6 +20,21 @@ HEDGE_KEYS = {"hedge_issued", "hedge_wins", "hedge_losses",
 REACTOR_KEYS = {"loops", "wakeups", "loop_lag_ms_avg",
                 "writeq_flushes", "writeq_stalls"}
 
+# r15 critical-path attribution block (both benches emit it; the
+# categories are mgr/tracing.py CATEGORIES + total)
+TRACE_KEYS = {"trace_id", "found", "daemons", "spans",
+              "critical_path"}
+TRACE_CP_KEYS = {"queue", "crypto", "encode", "store", "wire",
+                 "other", "total"}
+
+
+def _check_trace_block(tr):
+    assert TRACE_KEYS <= set(tr)
+    assert tr["found"] is True
+    assert tr["spans"] > 0
+    assert set(tr["critical_path"]) == TRACE_CP_KEYS
+    assert tr["critical_path"]["total"] > 0
+
 
 def test_rados_bench_json_schema(capsys):
     rados_bench.main([
@@ -63,6 +78,11 @@ def test_rados_bench_json_schema(capsys):
     assert served_total > 0
     assert REACTOR_KEYS <= set(out["reactor"])
     assert out["reactor"]["loops"] > 0
+    # r15: the forced-sample probe's critical-path attribution — one
+    # assembled trace spanning the client and at least one OSD
+    _check_trace_block(out["trace"])
+    assert any(d.startswith("client.") for d in out["trace"]["daemons"])
+    assert any(d.startswith("osd.") for d in out["trace"]["daemons"])
 
 
 def test_bench_r13_artifact_pinned():
@@ -157,6 +177,9 @@ def test_recovery_bench_json_schema_live():
     assert rep["family"] == "lrc_local"
     assert rep["vs_full_k"] < 1.0
     assert rep["helper_set_histogram"]["lrc_local"]
+    # r15: the sampled recovery trace rides the same JSON
+    _check_trace_block(data["trace"])
+    assert data["trace"]["daemons"] == ["recovery_bench"]
 
 
 REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",

@@ -200,6 +200,109 @@ class TestFrameEquivalence:
             b.shutdown()
 
 
+class TestTraceContextFrameCompat:
+    """r15: the optional, version-gated trace-context tail of the
+    _Blob envelope. Contract: no context -> the v1 section encodes
+    BIT-IDENTICAL to the pre-r15 wire; with a context the section is
+    v2/compat-1, which a LEGACY decoder skips via the versioned-
+    section finish() and a NEW decoder reads only when present."""
+
+    @staticmethod
+    def _legacy_blob_decode(cls, d):
+        """The pre-r15 _Blob.decode_payload, verbatim (the frozen
+        legacy-receiver oracle)."""
+        d.start(1)
+        m = cls(d.u64(), d.boolean(), d.string(), d.blob(), d.string())
+        d.finish()
+        return m
+
+    def _ctx(self, sampled=True):
+        from ceph_tpu.utils.flight_recorder import TraceContext
+        return TraceContext(0x1234, 0x5678, sampled,
+                            client_lat={1: 0.002} if sampled else None)
+
+    def test_absent_context_is_bit_identical_v1(self):
+        from ceph_tpu.osd.standalone import MOSDOp
+        from ceph_tpu.utils.encoding import Encoder
+        e = Encoder()
+        MOSDOp(7, True, "write", b"body-bytes").encode_payload(e)
+        got = e.bytes()
+        # the frozen v1 layout: version/compat/len + fields, no tail
+        legacy = Encoder()
+        (legacy.start(1, 1).u64(7).boolean(True).string("write")
+         .blob(b"body-bytes").string("").finish())
+        assert got == legacy.bytes()
+
+    def test_legacy_receiver_skips_present_context(self):
+        from ceph_tpu.osd.standalone import MOSDOp
+        from ceph_tpu.utils.encoding import Decoder, Encoder
+        e = Encoder()
+        MOSDOp(7, True, "write", b"body-bytes",
+               trace=self._ctx()).encode_payload(e)
+        m = self._legacy_blob_decode(MOSDOp, Decoder(e.bytes()))
+        assert (m.req_id, m.kind, m.blob) == (7, "write",
+                                              b"body-bytes")
+        assert m.trace is None       # skipped, not choked on
+
+    def test_new_receiver_reads_present_and_absent(self):
+        from ceph_tpu.osd.standalone import MOSDOp
+        from ceph_tpu.utils.encoding import Decoder, Encoder
+        e = Encoder()
+        MOSDOp(7, True, "write", b"x", trace=self._ctx()).\
+            encode_payload(e)
+        m = MOSDOp.decode_payload(Decoder(e.bytes()))
+        assert m.trace is not None and m.trace.trace_id == 0x1234
+        assert m.trace.sampled and m.trace.client_lat[1] > 0
+        # legacy sender (v1 bytes): trace field absent -> None
+        e1 = Encoder()
+        MOSDOp(8, True, "read", b"y").encode_payload(e1)
+        assert MOSDOp.decode_payload(Decoder(e1.bytes())).trace is None
+
+    def test_unsampled_context_roundtrips_compactly(self):
+        from ceph_tpu.osd.standalone import MStoreOp
+        from ceph_tpu.utils.encoding import Decoder, Encoder
+        e = Encoder()
+        MStoreOp(9, True, "txn", b"z",
+                 trace=self._ctx(sampled=False)).encode_payload(e)
+        m = MStoreOp.decode_payload(Decoder(e.bytes()))
+        assert m.trace is not None and not m.trace.sampled
+        assert m.trace.client_lat is None
+
+    def test_mid_frame_kill_with_sampled_op_in_flight(self):
+        """The r8 mid-frame-kill scenario with a SAMPLED op in
+        flight: the connection dies with a partial frame on the wire,
+        the lossless replay redelivers the op EXACTLY once, and the
+        trace context survives the replay byte-for-byte (replay
+        re-sends the queued encoded payload)."""
+        from ceph_tpu.osd.standalone import MOSDOp
+        a, b = pair()
+        try:
+            got = []
+            b.register_handler(MOSDOp.type_id,
+                               lambda p, m: got.append(m))
+            a.send("osd.1", MOSDOp(1, True, "write", b"warm"))
+            assert wait_for(lambda: len(got) == 1)
+            conn = next(iter(a._conns.values()))
+            frame = legacy_frame(99, MOSDOp.type_id, b"garbage")
+            with conn.wlock:
+                conn.sock.sendall(frame[:len(frame) // 2])
+            conn.close()
+            time.sleep(0.05)
+            a.send("osd.1", MOSDOp(2, True, "write", b"sampled-op",
+                                   trace=self._ctx()))
+            assert a.flush("osd.1", timeout=15)
+            assert wait_for(lambda: len(got) == 2), len(got)
+            time.sleep(0.3)
+            assert len(got) == 2          # replay stayed exactly-once
+            m = got[-1]
+            assert m.blob == b"sampled-op"
+            assert m.trace is not None and m.trace.trace_id == 0x1234
+            assert m.trace.sampled
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
 class TestSecureEquivalenceLive:
     """End-to-end: a secure pair exchanging segment-encoded messages
     still authenticates/decrypts — the staged-seal path is live, not

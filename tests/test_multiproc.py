@@ -50,6 +50,20 @@ def test_multiproc_boot_rw_smoke(tmp_path):
         assert "msgr" in dump and dump["msgr"]["frames_rx"] > 0
         shards = h.asok("dump_op_shards")
         assert set(shards) == {"shard_0", "shard_1"}
+        # r15 control parity: key rotation pushes cross the child
+        # control pipe (stdin, never argv) — IO keeps flowing through
+        # the keep-window, and a SECOND rotation still serves (the
+        # refreshed verifier accepted tickets minted pre-rotation)
+        c.rotate_service_secrets("osd")
+        cl.write({"mp-rot": b"R" * 1024})
+        c.rotate_service_secrets("osd")
+        assert bytes(cl.read("mp-rot")) == b"R" * 1024
+        # store-fsck control line: a quiesced online audit inside the
+        # child answers on stdout — TinStore children run the real
+        # offline audit over their mounted directory
+        rep = h.store_fsck()
+        assert rep["errors"] == [] and rep["bad_objects"] == []
+        assert rep.get("format", "kv") in ("kv", "legacy")
     finally:
         c.shutdown()
 

@@ -108,6 +108,59 @@ def cmd_live_health(asok_dir: str, args, detail: bool) -> None:
             print(f"      {line}")
 
 
+def cmd_live_trace(asok_dir: str, args) -> None:
+    """`ceph_cli trace ...` — the r15 distributed-tracing surface:
+    answered from any monitor's TraceAssembler (daemon flight rings
+    stitched over the MgrReport pipe)."""
+    out = live_mon_command(asok_dir, f"trace {args.trace_arg}")
+    if args.chrome is not None:
+        if "chrome" not in out:
+            raise SystemExit("--chrome needs a trace id "
+                             "(`trace <id-hex>`)")
+        with open(args.chrome, "w") as f:
+            json.dump(out["chrome"], f)
+        print(f"wrote {len(out['chrome']['traceEvents'])} events "
+              f"to {args.chrome}")
+        return
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+        return
+    if "traces" in out:          # slow / list views
+        for t in out["traces"]:
+            line = (f"  {t['trace_id']}  {t['duration_s'] * 1e3:9.3f} ms "
+                    f" spans={t['spans']:<4} "
+                    f"daemons={','.join(t['daemons'])}")
+            cp = t.get("critical_path")
+            if cp:
+                parts = ", ".join(
+                    f"{k}={cp[k] * 1e3:.2f}ms"
+                    for k in ("queue", "crypto", "encode", "store",
+                              "wire", "other") if cp.get(k, 0) > 0)
+                line += f"\n      [{parts}]"
+            print(line)
+        if not out["traces"]:
+            print("  (no assembled traces yet)")
+        return
+    # one assembled trace
+    if not out.get("found"):
+        raise SystemExit(f"trace {args.trace_arg!r} not assembled "
+                         f"(evicted, never sampled, or still in "
+                         f"flight)")
+    cp = out["critical_path"]
+    print(f"trace {out['trace_id']}  total "
+          f"{cp['total'] * 1e3:.3f} ms  daemons: "
+          f"{', '.join(out['daemons'])}")
+    print("  attribution: " + ", ".join(
+        f"{k}={cp[k] * 1e3:.3f}ms"
+        for k in ("queue", "crypto", "encode", "store", "wire",
+                  "other")))
+    t0 = min((s["start"] for s in out["spans"]), default=0.0)
+    for s in out["spans"]:
+        print(f"  {(s['start'] - t0) * 1e3:9.3f}ms "
+              f"+{s['dur'] * 1e3:8.3f}ms  {s['daemon']:<10} "
+              f"{s['name']}")
+
+
 def build_cluster(name: str, n_osds: int, pg_num: int):
     from ceph_tpu.osd.cluster import SimCluster
     c = SimCluster(n_osds=n_osds, pg_num=pg_num,
@@ -334,6 +387,18 @@ def main(argv=None) -> None:
     dm.add_argument("name", help="daemon name, e.g. osd.0 / mon.1")
     dm.add_argument("daemon_cmd", nargs=argparse.REMAINDER,
                     help="command words, e.g. perf dump")
+    tr = sub.add_parser(
+        "trace", help="LIVE mode: assembled distributed traces from "
+                      "the monitors' span aggregation — `trace slow` "
+                      "(slowest traces + critical-path attribution), "
+                      "`trace list`, or `trace <id-hex>` (one causal "
+                      "timeline; --chrome FILE exports Chrome "
+                      "trace-event JSON for chrome://tracing)")
+    tr.add_argument("trace_arg", nargs="?", default="slow",
+                    help="slow | list | <trace-id-hex>")
+    tr.add_argument("--chrome", metavar="FILE", default=None,
+                    help="write the trace's Chrome trace-event JSON "
+                         "to FILE (requires a trace id)")
     sub.add_parser("df")
     sub.add_parser("osd-df")
     pg = sub.add_parser("pg")
@@ -354,8 +419,9 @@ def main(argv=None) -> None:
     cfg.add_argument("value", nargs="?")
     args = ap.parse_args(argv)
 
-    if args.cmd == "daemon" and not args.asok_dir:
-        raise SystemExit("`daemon` needs --asok-dir (live mode only)")
+    if args.cmd in ("daemon", "trace") and not args.asok_dir:
+        raise SystemExit(f"`{args.cmd}` needs --asok-dir (live mode "
+                         f"only)")
     if args.asok_dir:
         # LIVE mode: no hermetic cluster — answer over admin sockets
         if args.cmd == "status":
@@ -387,6 +453,8 @@ def main(argv=None) -> None:
                                       " ".join(args.daemon_cmd))
             print(json.dumps(out, indent=None if args.json else 2,
                              sort_keys=True))
+        elif args.cmd == "trace":
+            cmd_live_trace(args.asok_dir, args)
         else:
             raise SystemExit(f"{args.cmd!r} has no live-mode "
                              f"implementation; drop --asok-dir")

@@ -92,6 +92,13 @@ def main(argv=None) -> None:
                          "(the degraded-read fast-path scenario: "
                          "reads must keep flowing through hedged "
                          "shard requests, not wait for recovery)")
+    ap.add_argument("--trace-sample-rate", type=float, default=None,
+                    help="standalone: client_trace_sample_rate, "
+                         "committed live (fraction of op frames "
+                         "sampled for distributed tracing; < 0 "
+                         "disables context stamping entirely — the "
+                         "off-sample overhead-guard comparison knob; "
+                         "default: leave the cluster default)")
     ap.add_argument("--hedge-delay-ms", type=float, default=None,
                     help="standalone: client hedged-read delay in ms, "
                          "committed live via client_hedge_delay_ms "
@@ -184,6 +191,9 @@ def main(argv=None) -> None:
             # this cluster resolves it live (the config-observer path)
             wire_client.config_set("client_hedge_delay_ms",
                                    args.hedge_delay_ms)
+        if args.trace_sample_rate is not None:
+            wire_client.config_set("client_trace_sample_rate",
+                                   args.trace_sample_rate)
         # per-tenant clients: each is its own cephx entity (its own
         # messenger peer without cephx), so every OSD's mClock gives
         # it its own tenant class — the per-tenant QoS under test
@@ -476,6 +486,7 @@ def main(argv=None) -> None:
         # so CI can parse them (tier-1 smoke asserts this schema)
         out["config"]["tenants"] = args.tenants
         out["config"]["hedge_delay_ms"] = args.hedge_delay_ms
+        out["config"]["trace_sample_rate"] = args.trace_sample_rate
         # r13 concurrency shape + its attribution: per-shard op-queue
         # occupancy and the reactors' loop-lag (time a loop spent out
         # of select — what concurrent connections wait on)
@@ -496,6 +507,43 @@ def main(argv=None) -> None:
             "loop_lag_ms_avg": _avg_ms("reactor_stall_time"),
             "writeq_flushes": msgr_d.get("writeq_flushes", 0),
             "writeq_stalls": msgr_d.get("writeq_stalls", 0),
+        }
+        # r15: critical-path attribution block — run ONE forced-sample
+        # probe op round AFTER the timed window (the window itself ran
+        # at the default sample rate, so the MB/s numbers carry only
+        # off-sample cost), assemble its trace from the in-process
+        # flight rings (asok for --osd-procs children), and attach the
+        # queue/crypto/encode/store/wire split. Schema pinned by
+        # tests/test_bench_schema.py.
+        from ceph_tpu.mgr.tracing import TraceAssembler
+        wire_client.trace_sample_rate = 1.0
+        probe = {f"traceprobe-{j}": rng.integers(
+            0, 256, args.object_size, np.uint8).tobytes()
+            for j in range(2)}
+        try:
+            wire_client.write(probe)
+            wire_client.read_many(sorted(probe))
+        except (ConnectionError, OSError, RuntimeError, KeyError):
+            pass                   # a dying cluster: block says so
+        asm = TraceAssembler()
+        asm.ingest(wire_client.flight.dump()["spans"])
+        for d in c.osds.values():
+            if d._stop.is_set():
+                continue
+            try:
+                dump = d.flight.dump() if hasattr(d, "flight") \
+                    else d.asok("trace dump")
+            except Exception:   # noqa: BLE001 — a dying daemon drops
+                continue        # out of the attribution
+            asm.ingest(dump["spans"])
+        tid = f"{wire_client.last_trace_id:016x}"
+        probe_asm = asm.assemble(tid)
+        out["trace"] = {
+            "trace_id": tid,
+            "found": probe_asm["found"],
+            "daemons": probe_asm["daemons"],
+            "spans": len(probe_asm["spans"]),
+            "critical_path": probe_asm["critical_path"],
         }
         agg = {k: 0 for k in ("hedge_issued", "hedge_wins",
                               "hedge_losses", "hedge_cancelled",

@@ -133,18 +133,35 @@ def main(argv=None) -> None:
         runner.finish()
         return plan, runner, sched
 
+    # r15: the timed recovery runs under a SAMPLED flight-recorder
+    # context, so the ecbackend.recover.* spans assemble into one
+    # causal timeline with critical-path attribution — the same
+    # instrumentation points feed the jax.profiler trace, the perf
+    # counters, and this block (schema pinned by test_bench_schema)
+    from ceph_tpu.utils.flight_recorder import (FlightRecorder,
+                                                TraceContext, activate,
+                                                new_trace_id,
+                                                trace_span)
+    flight = FlightRecorder("recovery_bench")
+    trace_ctx = TraceContext(new_trace_id(), 0, sampled=True)
+
+    def traced_recover():
+        with activate(trace_ctx, flight):
+            with trace_span("osd.recovery_round"):
+                return timed_recover()
+
     t0 = time.perf_counter()
     if args.trace:
         # trace ONLY the recovery phase: the write-path compile noise
         # is out of frame, so the pipeline overlap (stage / launch /
         # fetch+writeback spans) is what the timeline shows
         with trace(args.trace) as traced:
-            timed = timed_recover()
+            timed = traced_recover()
         if not traced:
             print("warning: jax.profiler unavailable, no trace "
                   "captured", file=sys.stderr)
     else:
-        timed = timed_recover()
+        timed = traced_recover()
     t_rec = time.perf_counter() - t0
     counters = timed[0].counters
 
@@ -202,6 +219,19 @@ def main(argv=None) -> None:
         # mClock class occupancy/grants for the timed phase (the
         # admission layer the wire tier runs recovery under)
         "mclock": sched.dump(),
+    }
+    # r15 critical-path attribution over the recovery trace
+    from ceph_tpu.mgr.tracing import TraceAssembler
+    asm = TraceAssembler()
+    asm.ingest(flight.dump()["spans"])
+    tid = f"{trace_ctx.trace_id:016x}"
+    rec_asm = asm.assemble(tid)
+    stats["trace"] = {
+        "trace_id": tid,
+        "found": rec_asm["found"],
+        "daemons": rec_asm["daemons"],
+        "spans": len(rec_asm["spans"]),
+        "critical_path": rec_asm["critical_path"],
     }
     if args.json:
         print(json.dumps(stats))

@@ -26,12 +26,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_cell(seed: int, store: str, rounds: int, ops: int,
-             verbose: bool, op_shards: int = 1) -> dict:
+             verbose: bool, op_shards: int = 1,
+             osd_procs: bool = False,
+             rotate_secrets: bool = False) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
+    if osd_procs:
+        store = "tin"            # children need a real on-disk store
     tmp = tempfile.mkdtemp(prefix=f"thrash-{seed}-") \
         if store == "tin" else None
     th = Thrasher(seed, store=store, rounds=rounds, ops=ops,
-                  store_dir=tmp, verbose=verbose, op_shards=op_shards)
+                  store_dir=tmp, verbose=verbose, op_shards=op_shards,
+                  osd_procs=osd_procs, rotate_secrets=rotate_secrets)
     try:
         report = th.run()
         report["ok"] = True
@@ -59,6 +64,16 @@ def main() -> int:
     ap.add_argument("--op-shards", type=int, default=1,
                     help="osd_op_num_shards on every OSD (r13 "
                          "sharded dispatch under chaos)")
+    ap.add_argument("--osd-procs", action="store_true",
+                    help="every OSD in its own OS process (r15 "
+                         "control parity: rotation pushes + store "
+                         "fsck cross the child control pipe); "
+                         "implies --store tin")
+    ap.add_argument("--rotate-secrets", action="store_true",
+                    help="rotate the osd service secrets at every "
+                         "round's heal (deterministic — outside the "
+                         "seeded action menu, so seed replays are "
+                         "unchanged)")
     ap.add_argument("--matrix", type=int, metavar="N",
                     help="run seeds 1..N instead of one --seed")
     ap.add_argument("--repro", action="store_true",
@@ -83,7 +98,9 @@ def main() -> int:
     failed = 0
     for seed in seeds:
         rep = run_cell(seed, args.store, args.rounds, args.ops,
-                       verbose=args.repro, op_shards=args.op_shards)
+                       verbose=args.repro, op_shards=args.op_shards,
+                       osd_procs=args.osd_procs,
+                       rotate_secrets=args.rotate_secrets)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
