@@ -90,7 +90,8 @@ KNOBS: dict[str, tuple[int, str]] = {
 
 def repro_command(seed: int, store: str, rounds: int, ops: int,
                   op_shards: int = 1, osd_procs: bool = False,
-                  rotate_secrets: bool = False) -> str:
+                  rotate_secrets: bool = False,
+                  overwrite_during_faults: bool = False) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
@@ -100,6 +101,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
         cmd += " --osd-procs"
     if rotate_secrets:
         cmd += " --rotate-secrets"
+    if overwrite_during_faults:
+        cmd += " --overwrite-during-faults"
     return cmd
 
 
@@ -121,7 +124,8 @@ class Thrasher:
                  store_dir: str | None = None, verbose: bool = False,
                  read_during_faults: bool = False,
                  op_shards: int = 1, osd_procs: bool = False,
-                 rotate_secrets: bool = False):
+                 rotate_secrets: bool = False,
+                 overwrite_during_faults: bool = False):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -152,6 +156,14 @@ class Thrasher:
         # rotate at every heal; live daemons — child processes
         # included — must keep serving through the keep-window
         self.rotate_secrets = bool(rotate_secrets)
+        # r16: partial overwrites WITH the round's faults still live —
+        # SIGKILL lands mid-RMW, exercising the stripe journal's
+        # replay under the exactly-once/no-resurrection checkers. Like
+        # rotate_secrets, the sweep draws from its OWN seeded stream
+        # (OUTSIDE the action menu) so pinned cells replay unchanged.
+        self.overwrite_during_faults = bool(overwrite_during_faults)
+        self.rmw_rng = random.Random(self.seed ^ 0x5EED)
+        self.rmw_overwrite_checks = 0
         # deadline scaling, NOT schedule input: the RNG stream never
         # sees it, so a seed replays identically on an idle box
         self.load = load_factor()
@@ -164,10 +176,11 @@ class Thrasher:
         self.dead_mons: set[int] = set()
         self.schedule: list[str] = []        # the replayable fault log
         self._obj_i = 0
-        self.repro = repro_command(self.seed, self.store, rounds, ops,
-                                   op_shards=self.op_shards,
-                                   osd_procs=self.osd_procs,
-                                   rotate_secrets=self.rotate_secrets)
+        self.repro = repro_command(
+            self.seed, self.store, rounds, ops,
+            op_shards=self.op_shards, osd_procs=self.osd_procs,
+            rotate_secrets=self.rotate_secrets,
+            overwrite_during_faults=self.overwrite_during_faults)
         self.c = None
         self.cl = None
 
@@ -394,6 +407,8 @@ class Thrasher:
                 for _ in range(self.ops):
                     menu[self.rng.randrange(len(menu))]()
                     time.sleep(0.15)
+                if self.overwrite_during_faults:
+                    self._overwrite_sweep_during_faults(round_i)
                 if self.read_during_faults:
                     self._read_sweep_during_faults(round_i)
                 self._heal_and_check(round_i)
@@ -431,6 +446,43 @@ class Thrasher:
             self.degraded_read_checks += 1
         self._log(f"round {round_i}: degraded-read sweep ok "
                   f"({len(names)} objects, faults live)")
+
+    def _overwrite_sweep_during_faults(self, round_i: int) -> None:
+        """r16 invariant input: partial overwrites (write_at) WITH the
+        round's faults still live — dead OSDs un-revived, injection
+        running — so kills land mid-RMW and the stripe journal's
+        replay has to hold the exactly-once/no-resurrection line.
+        Draws come from the dedicated rmw stream and never read
+        ack-dependent state, so a seed replays the identical sweep."""
+        n = self.rmw_rng.randrange(2, 5)
+        for _ in range(n):
+            if not self._obj_i:
+                return
+            name = f"thrash-{self.seed}-" \
+                   f"{self.rmw_rng.randrange(self._obj_i)}"
+            off = self.rmw_rng.randrange(0, 700)
+            patch = self.rmw_rng.randbytes(
+                self.rmw_rng.randrange(8, 200))
+            try:
+                self.cl.write_at(name, off, patch)
+            except (ConnectionError, OSError, RuntimeError,
+                    KeyError) as e:
+                self.unknown.add(name)
+                self._parked(f"write_at {name}", e)
+                continue
+            if name in self.unknown:
+                # base bytes unknowable: a patch over them proves
+                # nothing either way — the object stays unclaimed
+                continue
+            old = self.shadow.get(name, b"")
+            buf = bytearray(max(len(old), off + len(patch)))
+            buf[:len(old)] = old
+            buf[off:off + len(patch)] = patch
+            self.shadow[name] = bytes(buf)
+            self.removed.discard(name)
+            self.rmw_overwrite_checks += 1
+            self._log(f"round {round_i}: write_at {name} "
+                      f"[{off},{off + len(patch)})")
 
     def _heal_and_check(self, round_i: int) -> None:
         for r in sorted(self.dead_mons):
@@ -489,6 +541,7 @@ class Thrasher:
             "removes_verified": len(self.removed - self.unknown),
             "unknown_fate": len(self.unknown),
             "degraded_read_checks": self.degraded_read_checks,
+            "rmw_overwrite_checks": self.rmw_overwrite_checks,
             "schedule_len": len(self.schedule),
             "elapsed_s": round(elapsed, 2),
             "repro": self.repro,

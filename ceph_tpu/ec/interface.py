@@ -122,6 +122,73 @@ class ErasureCode(abc.ABC):
                         "lin", R.tobytes(), R.shape, impl)
         return fn or None
 
+    # -- parity-delta fast path (partial-stripe RMW) -----------------------
+
+    def delta_matrix(self, touched: Sequence[int]):
+        """(m, len(touched)) GF matrix D with parity_delta =
+        D (GF@) data_delta byte-wise, or None when the codec has no
+        static scalar form (vector codes, bitmatrix techniques) —
+        callers then use parity_delta's generic XOR-linear path.
+        `touched` names DENSE data rows (encode_chunks order).
+        Cached per instance; derivation is probe-verified."""
+        if not getattr(self, "positionwise", True):
+            return None
+        touched = tuple(int(t) for t in touched)
+        cache = self.__dict__.setdefault("_dm_cache", {})
+        if touched not in cache:
+            from .linearize import derive_delta_matrix
+            try:
+                cache[touched] = derive_delta_matrix(self, touched)
+            except ValueError:
+                cache[touched] = None
+        return cache[touched]
+
+    def delta_program_key(self, touched: Sequence[int]):
+        """Hashable identity of the fused delta-encode program, EQUAL
+        across coder instances with the same geometry — the
+        process-wide RMW program cache key (same sharing contract as
+        decode_program_key: identical HLO compiles ONCE per process,
+        not once per PG per daemon). None when there is no static
+        form (callers cache the generic path per coder instance)."""
+        touched = tuple(int(t) for t in touched)
+        D = self.delta_matrix(touched)
+        if D is None:
+            return None
+        impl = getattr(self, "impl", None) or "mxu"
+        return ("delta", D.tobytes(), D.shape, impl)
+
+    def parity_delta(self, touched: Sequence[int],
+                     deltas: np.ndarray) -> np.ndarray:
+        """(B, len(touched), L) data-shard deltas (new ^ old, DENSE
+        row order per `touched`) -> (B, m, L) parity deltas: XOR each
+        into its parity shard and the stripe re-encodes to the new
+        bytes. Correct for EVERY additive (XOR-linear) code — all GF
+        codes here, Clay included (whose sub-chunk coupling only
+        requires L to be the FULL chunk length; positionwise callers
+        may pass any sub-window). Uses the static delta matrix when
+        one exists, else encodes the zero-padded delta through
+        encode_chunks (linearity: encode(new^old) = parity(new) ^
+        parity(old))."""
+        deltas = np.asarray(deltas, np.uint8)
+        touched = tuple(int(t) for t in touched)
+        if deltas.ndim != 3 or deltas.shape[1] != len(touched):
+            raise ValueError(
+                f"deltas must be (B, {len(touched)}, L), "
+                f"got {deltas.shape}")
+        D = self.delta_matrix(touched)
+        if D is not None:
+            from ..gf.numpy_ref import gf_matmul
+            B, t, L = deltas.shape
+            out = np.empty((B, self.m, L), np.uint8)
+            for bi in range(B):
+                out[bi] = gf_matmul(D, deltas[bi])
+            return out
+        B, t, L = deltas.shape
+        full = np.zeros((B, self.k, L), np.uint8)
+        for ti, tr in enumerate(touched):
+            full[:, tr, :] = deltas[:, ti, :]
+        return np.asarray(self.encode_chunks(full))
+
     def range_batch_decoder(self, erasures: Sequence[int],
                             survivors: Sequence[int]):
         """Optional sub-chunk fast path: a jitted fn mapping the

@@ -23,6 +23,60 @@ from ..gf.numpy_ref import gf_inv_matrix, gf_matmul
 from .interface import CHUNK_ALIGNMENT, ErasureCode
 
 
+def derive_delta_matrix(coder: ErasureCode,
+                        touched: Sequence[int]) -> np.ndarray:
+    """(m, len(touched)) GF matrix D with
+    parity_delta = D (GF@) data_delta, byte-wise — the parity-update
+    rule of a partial-stripe overwrite (delta_j = G[j,i] (x) (new_i ^
+    old_i), ref: the RMW parity math in ECCommon; arxiv 1709.05365's
+    online-EC overwrite cost model). `touched` names DENSE data rows
+    (encode_chunks order).
+
+    Probed, not assumed: unit vectors recover the candidate columns,
+    then a random held-out delta must reproduce encode_chunks exactly
+    — codecs whose per-byte map is not a GF(2^8) scalar (bitmatrix
+    techniques) fail the verify and callers fall back to the generic
+    XOR-linear path (encode_chunks of the zero-padded delta), which
+    is always correct for additive codes.
+
+    Raises ValueError when the codec is not positionwise or the probe
+    verify fails."""
+    if not getattr(coder, "positionwise", True):
+        raise ValueError("codec couples byte positions (not positionwise); "
+                         "no per-byte delta matrix exists")
+    touched = [int(t) for t in touched]
+    k = coder.get_data_chunk_count()
+    m = coder.get_coding_chunk_count()
+    bad = [t for t in touched if not 0 <= t < k]
+    if bad:
+        raise ValueError(f"touched rows must be data rows in [0, {k}), "
+                         f"got {sorted(bad)}")
+    L = 128     # any length works for a positionwise code
+    D = np.zeros((m, len(touched)), np.uint8)
+    probe = np.zeros((len(touched), k, L), np.uint8)
+    for ti, t in enumerate(touched):
+        probe[ti, t, :] = 1     # GF multiplicative identity
+    parity = np.asarray(coder.encode_chunks(probe))     # (t, m, L)
+    for ti in range(len(touched)):
+        col = parity[ti, :, 0]
+        if not np.array_equal(parity[ti],
+                              np.repeat(col[:, None], L, axis=1)):
+            raise ValueError("per-byte parity map is not constant "
+                             "across positions; no scalar delta matrix")
+        D[:, ti] = col
+    # verify: a random delta through D must equal encode_chunks
+    rng = np.random.default_rng(1)
+    delta = rng.integers(0, 256, (len(touched), L), np.uint8)
+    full = np.zeros((1, k, L), np.uint8)
+    for ti, t in enumerate(touched):
+        full[0, t] = delta[ti]
+    want = np.asarray(coder.encode_chunks(full))[0]     # (m, L)
+    if not np.array_equal(gf_matmul(D, delta), want):
+        raise ValueError("delta matrix failed the held-out verify; "
+                         "codec's per-byte map is not a GF(2^8) scalar")
+    return D
+
+
 def derive_repair_matrix(coder: ErasureCode, lost: Sequence[int],
                          helpers: Sequence[int],
                          seed: int = 0) -> np.ndarray:

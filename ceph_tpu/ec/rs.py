@@ -47,6 +47,15 @@ class ReedSolomon(ErasureCode):
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(self._encode_fn(np.asarray(data, np.uint8)))
 
+    def delta_matrix(self, touched):
+        # exact: the parity-delta matrix IS the coding matrix's
+        # touched columns (no probe needed; bit-parity with the probe
+        # path is pinned by tests/test_rmw_delta.py)
+        touched = tuple(int(t) for t in touched)
+        if any(not 0 <= t < self.k for t in touched):
+            raise ValueError(f"touched rows must be in [0, {self.k})")
+        return np.ascontiguousarray(self.matrix[:, list(touched)])
+
     def _decoder_for(self, erasures: tuple[int, ...], survivors: tuple[int, ...]):
         key = (erasures, survivors)
         hit = self._decode_cache.get(key)

@@ -39,6 +39,7 @@ measures (objects/s).
 from __future__ import annotations
 
 import functools as _functools
+import struct as _struct
 import threading as _threading
 from dataclasses import dataclass, field
 
@@ -113,6 +114,40 @@ def ec_perf_counters():
                           "(overlap eats the rest)")
             .add_time_avg("recover_writeback_time",
                           "rebuilt-shard writeback fan-out")
+            .add_u64_counter("rmw_ops",
+                             "partial-stripe overwrites served by the "
+                             "parity-delta fast path")
+            .add_u64_counter("rmw_delta_launches",
+                             "fused delta-encode launches (device or "
+                             "native host)")
+            .add_u64_counter("rmw_wire_bytes",
+                             "journal + delta payload bytes shipped "
+                             "to participating shards (the RMW "
+                             "amplification numerator)")
+            .add_u64_counter("rmw_preread_bytes",
+                             "pre-image bytes read for delta "
+                             "construction (zero on the append path)")
+            .add_u64_counter("rmw_shard_ios",
+                             "participating shards per RMW op, summed "
+                             "(the shard-IO amplification counter: "
+                             "1 data + m parity on the fast path)")
+            .add_u64_counter("rmw_full_fallbacks",
+                             "RMW jobs laddered to the full-stripe "
+                             "path (degraded/stale stripe, stripe-"
+                             "spanning or overlapping writes)")
+            .add_u64_counter("rmw_append_fast",
+                             "delta jobs whose pre-image was pure "
+                             "padding (appends: no read phase at all)")
+            .add_u64_counter("journal_entries",
+                             "stripe-journal intents logged")
+            .add_u64_counter("journal_replay_forward",
+                             "journaled RMWs rolled forward on replay")
+            .add_u64_counter("journal_replay_rollback",
+                             "journaled RMWs rolled back on replay")
+            .add_u64_counter("write_wire_bytes",
+                             "full-path shard write bytes shipped "
+                             "(the full-stripe amplification "
+                             "numerator the RMW ratio divides by)")
             .add_u64_counter("stream_launches",
                              "StreamingCodec tile launches")
             .add_u64_counter("stream_bytes",
@@ -179,6 +214,11 @@ class ECBackend(PGBackend):
         self._init_common(pg, acting, cluster or ShardSet(),
                           ensure_collections=ensure_collections)
         self._fused_cache: dict = {}
+        # partial-stripe RMW state: per-PG stripe-journal sequence
+        # (replay re-anchors it past every seq seen on disk) and the
+        # crash hook the phase-boundary tests drive (None in prod)
+        self._rmw_seq = 0
+        self._rmw_crash_hook = None
         # read-path EIO accounting (verify-on-read mismatches + the
         # in-place rewrites they triggered)
         self.eio_stats = {"read_eio": 0, "repaired": 0}
@@ -415,6 +455,7 @@ class ECBackend(PGBackend):
                 if add is not None:
                     add(shard, t)
                 txns.append((shard, t))
+            self.perf.inc("write_wire_bytes", len(group) * len(live) * sl)
             self._fanout_txns(txns)
             if shard_txn_extra is None:
                 for name, _ in group:
@@ -493,10 +534,32 @@ class ECBackend(PGBackend):
 
     def write_ranges(self, ops: list[tuple[str, int, bytes | np.ndarray]],
                      dead_osds: set[int] | None = None) -> None:
-        """Batched RMW: for every (name, offset, bytes) op, read the
-        touched stripe window, overlay, re-encode, and emit per-shard
-        sub-range writes + hinfo updates. Encode launches are batched
-        across objects whose windows have equal chunk length."""
+        """Batched RMW dispatcher: every (name, offset, bytes) op goes
+        to the PARITY-DELTA fast path when the stripe is clean (all
+        shards live + caught up, write within one stripe, touched data
+        columns < k) — only the touched data shard(s) plus the m
+        parity shards move on the wire, crash-consistent through the
+        per-PG stripe journal — and ladders to the full-stripe RMW
+        (`_write_ranges_full`, the pre-r16 path) otherwise: degraded
+        or stale stripes, object creation, stripe-spanning or
+        overlapping writes, vector-code geometry changes."""
+        dead = dead_osds or set()
+        delta_jobs, full_ops = self._partition_rmw(ops, dead)
+        if delta_jobs:
+            self._write_ranges_delta(delta_jobs)
+        if full_ops:
+            self.perf.inc("rmw_full_fallbacks",
+                          len({n for n, _o, _d in full_ops}))
+            self._write_ranges_full(full_ops, dead_osds)
+
+    def _write_ranges_full(self,
+                           ops: list[tuple[str, int, bytes | np.ndarray]],
+                           dead_osds: set[int] | None = None) -> None:
+        """Full-stripe RMW: read the touched stripe window, overlay,
+        re-encode, and emit per-shard sub-range writes + hinfo
+        updates. Encode launches are batched across objects whose
+        windows have equal chunk length. Handles every case the delta
+        path refuses (degraded pre-image reconstruction included)."""
         dead = dead_osds or set()
         k, si = self.k, self.sinfo
         live = [s for s in range(self.n) if self.acting[s] not in dead]
@@ -596,10 +659,551 @@ class ECBackend(PGBackend):
                                         shards[bi, s]) \
                         .setattr(shard_cid(self.pg, s), name,
                                  HINFO_KEY, hinfo.to_bytes())
+            self.perf.inc("write_wire_bytes",
+                          len(group) * len(live) * clen)
             self._fanout_txns(list(shard_txns.items()))
             for bi, (name, writes, _, new_size, s0, _) in enumerate(group):
                 self.object_sizes[name] = new_size
                 self._log_write(name, live)
+
+    # -- write path (parity-delta fast path + stripe journal) ----------------
+    #
+    # The small-overwrite/append data path (ROADMAP item 3; the
+    # online-EC measurement arxiv 1709.05365 shows write amplification
+    # dominating this workload): delta_j = G[j,i] (x) (new_i ^ old_i)
+    # folded into each parity shard, so only the touched data shard(s)
+    # plus m parity shards move — not k+m. Crash consistency comes
+    # from a per-PG stripe journal (intent logged durably on every
+    # participating shard BEFORE any in-place XOR; an applied shard
+    # atomically bumps its watermark and drops the entry), replayed by
+    # stripe_journal_replay: SIGKILL anywhere leaves the stripe
+    # bit-exact with either the old or the new bytes, never torn.
+
+    JOURNAL_OBJ = "__stripe_journal__"
+    _J_APPLIED = b"applied"
+
+    @staticmethod
+    def _jkey(seq: int) -> bytes:
+        return b"e%016x" % seq
+
+    @staticmethod
+    def _encode_jentry(seq: int, name: str, slot: int,
+                       participants, new_size: int, osl: int, nsl: int,
+                       a: int, delta: bytes, new_crc: int,
+                       version: int) -> bytes:
+        from ..utils.encoding import Encoder
+        e = Encoder()
+        e.u32(1)                        # entry codec version
+        e.u64(seq).string(name).u32(slot)
+        e.list([int(p) for p in participants], Encoder.u32)
+        e.u64(new_size).u64(osl).u64(nsl)
+        e.u64(a).blob(delta)
+        e.u32(new_crc)
+        e.u64(version)                  # the PG-log version this RMW
+        #                                 creates: replay drops entries
+        #                                 a later write superseded
+        return e.bytes()
+
+    @staticmethod
+    def _decode_jentry(raw: bytes) -> dict:
+        from ..utils.encoding import Decoder
+        d = Decoder(raw)
+        v = d.u32()
+        if v != 1:
+            raise ValueError(f"stripe-journal entry version {v}")
+        return {"seq": d.u64(), "name": d.string(), "slot": d.u32(),
+                "participants": d.list(Decoder.u32),
+                "new_size": d.u64(), "osl": d.u64(), "nsl": d.u64(),
+                "a": d.u64(), "delta": d.blob(), "new_crc": d.u32(),
+                "version": d.u64()}
+
+    def _partition_rmw(self, ops, dead: set[int]):
+        """Split a write_ranges op list into delta-eligible jobs and
+        the ops the full path must carry. One job per object (ops
+        merged); a job is delta-eligible when the stripe is CLEAN
+        (every slot live and caught up — a delta against a stale or
+        reconstructed pre-image would fold garbage into parity, so
+        degraded stripes refuse and ladder down), the object exists,
+        the merged writes don't overlap or span a full stripe, fewer
+        than k data columns are touched, and (vector codes) the shard
+        length doesn't change under the sub-chunk geometry."""
+        k, si = self.k, self.sinfo
+        per_obj: dict[str, list[tuple[int, np.ndarray]]] = {}
+        order: list[str] = []
+        raw: dict[str, list[tuple]] = {}
+        for name, offset, data in ops:
+            if offset < 0:
+                raise ValueError(f"negative offset {offset}")
+            if name not in per_obj:
+                order.append(name)
+            per_obj.setdefault(name, []).append(
+                (int(offset), as_flat_u8(data)))
+            raw.setdefault(name, []).append((name, offset, data))
+        all_live = len(self._live_slots(dead)) == self.n
+        jobs, full_ops = [], []
+        for name in order:
+            writes = [(o, a) for o, a in per_obj[name] if len(a)]
+            old_size = self.object_sizes.get(name, 0)
+            job = None
+            if writes and all_live and old_size > 0:
+                job = self._delta_job(name, writes, old_size)
+            if job is not None \
+                    and len(self._fresh_for([name],
+                                            list(range(self.n)))) \
+                    == self.n:
+                jobs.append(job)
+            else:
+                full_ops.extend(raw[name])
+        return jobs, full_ops
+
+    def _delta_job(self, name: str, writes, old_size: int):
+        """Geometry of one delta-eligible overwrite, or None. A job is
+        (name, writes, old_size, new_size, osl, nsl, touched, spans,
+        a, b): `spans` are per-write (col, chunk_off, len, log_off)
+        chunk sub-ranges, (a, b) the common shard-offset window the
+        delta rows are positioned in."""
+        si, k = self.sinfo, self.k
+        sw = si.stripe_width
+        lo = min(o for o, _a in writes)
+        hi = max(o + len(a) for o, a in writes)
+        if hi - lo >= sw or lo >= old_size + sw:
+            return None     # stripe-spanning, or a hole of untouched
+        #                     stripes past the tail: full path
+        # overlap check: delta composition is XOR — overlapping writes
+        # in one wave would double-fold
+        ivs = sorted((o, o + len(a)) for o, a in writes)
+        for (s1, e1), (s2, _e2) in zip(ivs, ivs[1:]):
+            if s2 < e1:
+                return None
+        new_size = max(old_size, hi)
+        osl = self._shard_len(old_size)
+        nsl = self._shard_len(new_size)
+        spans = []
+        touched: set[int] = set()
+        for off, arr in writes:
+            at = off
+            end = off + len(arr)
+            while at < end:
+                stripe, rem = divmod(at, sw)
+                col = rem // si.chunk_size
+                in_chunk = rem % si.chunk_size
+                ln = min(end - at, si.chunk_size - in_chunk)
+                spans.append((col, stripe * si.chunk_size + in_chunk,
+                              ln, at))
+                touched.add(col)
+                at += ln
+        if len(touched) >= k:
+            return None     # every data shard moves anyway
+        if not getattr(self.coder, "positionwise", True):
+            if nsl != osl:
+                return None     # sub-chunk geometry changes with
+            #                     length: ladder to full re-encode
+            a, b = 0, osl       # byte positions couple: the delta
+            #                     window is the whole chunk
+        else:
+            a = min(c0 for _col, c0, _ln, _lo in spans)
+            b = max(c0 + ln for _col, c0, ln, _lo in spans)
+        return (name, writes, old_size, new_size, osl, nsl,
+                tuple(sorted(touched)), spans, a, b)
+
+    @staticmethod
+    @_functools.lru_cache(maxsize=256)
+    def _fused_delta_fn(matrix_bytes: bytes, m: int, t: int, impl: str,
+                        wl: int, bucket: int):
+        """Process-wide fused delta-encode program (the r10 recovery-
+        program sharing rule): every PG backend whose coder exposes
+        the same delta_program_key shares ONE compiled program per
+        (window len, batch bucket). delta rows (bucket, t, wl) ->
+        (parity deltas (bucket, m, wl), zero-seed CRCs of all t+m
+        rows) in a single launch — the CRCs feed the incremental
+        hinfo update."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..csum.kernels import crc32c_blocks
+        from ..ops.rs_kernels import make_encoder
+        D = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, t)
+        enc = make_encoder(D, impl, bucket_batch=False)
+        n = m + t
+
+        def fused(d):                   # (bucket, t, wl) u8
+            parity = enc(d)             # (bucket, m, wl)
+            rows = jnp.concatenate([d, parity], axis=1)
+            crcs = crc32c_blocks(rows.reshape(bucket * n, wl),
+                                 init=0, xorout=0).reshape(bucket, n)
+            return parity, crcs
+        return jax.jit(fused)
+
+    def _delta_parity_crcs(self, touched: tuple, deltas: np.ndarray
+                           ) -> tuple[np.ndarray, np.ndarray]:
+        """(B, t, wl) data deltas -> ((B, m, wl) parity deltas,
+        (B, t+m) zero-seed CRCs of data+parity delta rows). Static-
+        matrix coders take the native host codec (CPU backend, the
+        r13 host-encode mode) or the fused device program; the rest
+        (bitmatrix, clay) go through parity_delta's generic
+        XOR-linear encode."""
+        B, t, wl = deltas.shape
+        D = self.coder.delta_matrix(touched)
+        if D is not None and _host_crc_available():
+            handle = _host_encoder_handle(
+                np.ascontiguousarray(D, np.uint8).tobytes(), t, self.m)
+            if handle is not None:
+                from .. import native as _native
+                import ctypes as _ctypes
+                data_c = np.ascontiguousarray(deltas)
+                parity = np.zeros((B, self.m, wl), np.uint8)
+                rc = _native.lib().ec_encode(
+                    handle,
+                    data_c.ctypes.data_as(_ctypes.c_char_p),
+                    parity.ctypes.data_as(_ctypes.c_char_p), wl, B)
+                if rc == 0:
+                    self.perf.inc("rmw_delta_launches")
+                    rows = np.concatenate([deltas, parity], axis=1)
+                    crcs = _native.native_crc32c_rows(
+                        0, np.ascontiguousarray(rows).reshape(
+                            B * (t + self.m), wl)).reshape(
+                                B, t + self.m)
+                    return parity, crcs
+        if D is not None:
+            import jax
+
+            from ..ops.rs_kernels import pow2_bucket
+            bucket = pow2_bucket(B)
+            ci0 = self._fused_delta_fn.cache_info()
+            fn = self._fused_delta_fn(
+                np.ascontiguousarray(D, np.uint8).tobytes(), self.m,
+                t, getattr(self.coder, "impl", None) or "mxu", wl,
+                bucket)
+            ci1 = self._fused_delta_fn.cache_info()
+            self.perf.inc_many(
+                (("rmw_delta_launches", 1),
+                 ("program_cache_hits", ci1.hits - ci0.hits),
+                 ("program_cache_misses", ci1.misses - ci0.misses)))
+            padded = deltas
+            if bucket != B:
+                padded = np.zeros((bucket, t, wl), np.uint8)
+                padded[:B] = deltas
+            parity_d, crcs_d = fn(padded)
+            parity, crcs = jax.device_get((parity_d, crcs_d))
+            return (np.asarray(parity)[:B], np.asarray(crcs)[:B])
+        self.perf.inc("rmw_delta_launches")
+        parity = self.coder.parity_delta(touched, deltas)
+        rows = np.concatenate([deltas, parity], axis=1)
+        crcs = _rows_crc0(rows.reshape(B * (t + self.m), wl)).reshape(
+            B, t + self.m)
+        return parity, crcs
+
+    def _shard_old_crcs(self, name: str, slots) -> dict[int, int] | None:
+        """Current hinfo CRC per slot, or None when any slot's stored
+        hinfo is absent/odd (the delta path then refuses the job —
+        an incremental update against a wrong base would stamp a
+        corrupt CRC that verifies forever)."""
+        osl = self._shard_len(self.object_sizes[name])
+        out: dict[int, int] = {}
+        for s in slots:
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            try:
+                hinfo = HashInfo.from_bytes(st.getattr(cid, name,
+                                                       HINFO_KEY))
+            except KeyError:
+                return None
+            if hinfo.total_chunk_size != osl:
+                return None
+            out[s] = hinfo.get_chunk_hash(0)
+        return out
+
+    def _write_ranges_delta(self, jobs) -> None:
+        """Execute delta-eligible RMW jobs: build the delta rows
+        (reading only the touched sub-ranges' pre-image — none at all
+        for appends into padding), one fused delta-encode launch per
+        (touched-columns, window) group, then the journaled two-phase
+        shard update. Jobs whose stored hinfo refuses the incremental
+        update reroute through the full path."""
+        by_shape: dict[tuple, list] = {}
+        for job in jobs:
+            _n, _w, _os, _ns, _osl, _nsl, touched, _sp, a, b = job
+            by_shape.setdefault((touched, b - a), []).append(job)
+        for (touched, wl), group in by_shape.items():
+            self._delta_group(touched, wl, group)
+
+    def _delta_group(self, touched: tuple, wl: int, group) -> None:
+        t = len(touched)
+        col_of = {c: i for i, c in enumerate(touched)}
+        parity_slots = [self.chunk_mapping[self.k + j]
+                        for j in range(self.m)]
+        B = len(group)
+        deltas = np.zeros((B, t, wl), np.uint8)
+        append_fast = 0
+        preread = 0
+        for bi, job in enumerate(group):
+            name, writes, old_size, _ns, osl, _nsl, _t, spans, a, _b \
+                = job
+            pure_append = all(lo >= old_size
+                              for _c, _c0, _ln, lo in spans)
+            for col, c0, ln, lo in spans:
+                off, arr = next((o, w) for o, w in writes
+                                if o <= lo and lo + ln <= o + len(w))
+                newb = arr[lo - off:lo - off + ln]
+                row = deltas[bi, col_of[col]]
+                if lo >= old_size:
+                    # append into padding: the pre-image is zeros by
+                    # the layout rule — no read phase
+                    row[c0 - a:c0 - a + ln] = newb
+                    continue
+                st = self._store(self.data_slots[col])
+                cid = shard_cid(self.pg, self.data_slots[col])
+                oldb = np.zeros(ln, np.uint8)
+                got = st.read(cid, name, c0, ln)
+                oldb[:len(got)] = got
+                preread += ln
+                row[c0 - a:c0 - a + ln] = np.asarray(newb) ^ oldb
+            if pure_append:
+                append_fast += 1
+        parity, crcs = self._delta_parity_crcs(touched, deltas)
+        self.perf.inc_many((("rmw_preread_bytes", preread),
+                            ("rmw_append_fast", append_fast)))
+        self._delta_commit(touched, wl, group, deltas, parity, crcs,
+                           parity_slots)
+
+    def _delta_commit(self, touched: tuple, wl: int, group,
+                      deltas, parity, crcs, parity_slots) -> None:
+        """The journaled two-phase shard update of one delta batch:
+        intent entries (delta payload + new hinfo) durably on every
+        participating shard, then the atomic per-shard apply (XOR +
+        hinfo + watermark bump + entry drop in ONE transaction)."""
+        t = len(touched)
+        hook = self._rmw_crash_hook
+        # per job: rows per slot, new crcs per slot, participants
+        waves = []       # (job, seq, {slot: (row|None, new_crc)})
+        wire = 0
+        shard_prep: dict[int, Transaction] = {}
+        shard_apply: dict[int, Transaction] = {}
+        max_seq_of: dict[int, int] = {}
+        keys_of: dict[int, list[bytes]] = {}
+        for bi, job in enumerate(group):
+            name, _w, _os, new_size, osl, nsl, _t, _sp, a, b = job
+            parts = ([self.data_slots[c] for c in touched]
+                     + list(parity_slots))
+            if nsl != osl:
+                # growth touches every shard (zero-extension + hinfo
+                # shift) — the others ride payload-free entries
+                parts = parts + [s for s in range(self.n)
+                                 if s not in set(parts)]
+            old = self._shard_old_crcs(name, parts)
+            if old is None:
+                # stored hinfo refuses the incremental base: reroute
+                # this job through the full path (rare — e.g. a
+                # legacy object written before hinfo discipline)
+                self.perf.inc("rmw_full_fallbacks")
+                self._write_ranges_full(
+                    [(name, o, w) for o, w in job[1]], None)
+                continue
+            self._rmw_seq += 1
+            seq = self._rmw_seq
+            # the PG-log version this job will create (jobs log in
+            # wave order right after the apply fan-out)
+            pred_version = self.pg_log.head + len(waves) + 1
+            plan: dict[int, tuple] = {}
+            for ti, c in enumerate(touched):
+                s = self.data_slots[c]
+                crc0 = int(crcs[bi, ti])
+                plan[s] = (deltas[bi, ti], crc0)
+            for j, s in enumerate(parity_slots):
+                plan[s] = (parity[bi, j], int(crcs[bi, t + j]))
+            for s in parts:
+                row, crc0 = plan.get(s, (None, None))
+                if crc0 is None:
+                    new_crc = _crc_shift(old[s], nsl - osl)
+                else:
+                    new_crc = (_crc_shift(old[s], nsl - osl)
+                               ^ _crc_shift(crc0, nsl - b))
+                delta_b = b"" if row is None else row.tobytes()
+                entry = self._encode_jentry(
+                    seq, name, s, parts, new_size, osl, nsl, a,
+                    delta_b, new_crc, pred_version)
+                cid = shard_cid(self.pg, s)
+                shard_prep.setdefault(s, Transaction()).omap_set(
+                    cid, self.JOURNAL_OBJ,
+                    {self._jkey(seq): entry})
+                at = shard_apply.setdefault(s, Transaction())
+                if row is not None:
+                    at.xor(cid, name, a, row)
+                if nsl != osl:
+                    at.truncate(cid, name, nsl)
+                at.setattr(cid, name, HINFO_KEY,
+                           HashInfo(1, nsl, [new_crc]).to_bytes())
+                max_seq_of[s] = max(max_seq_of.get(s, 0), seq)
+                keys_of.setdefault(s, []).append(self._jkey(seq))
+                wire += len(entry) + len(delta_b)
+            waves.append((job, seq, plan, parts))
+        if not waves:
+            return
+        for s, at in shard_apply.items():
+            cid = shard_cid(self.pg, s)
+            at.omap_set(cid, self.JOURNAL_OBJ,
+                        {self._J_APPLIED:
+                         _struct.pack("<Q", max_seq_of[s])})
+            at.omap_rmkeys(cid, self.JOURNAL_OBJ, keys_of[s])
+        try:
+            if hook is not None:
+                hook("before_prepare")
+                # sequential fan-outs under the hook so the crash
+                # matrix can land BETWEEN shards (a pipelined wave
+                # has no observable mid-point)
+                for idx, (s, pt) in enumerate(
+                        sorted(shard_prep.items())):
+                    self._store(s).queue_transaction(pt)
+                    if idx == 0:
+                        hook("mid_prepare")
+            else:
+                self._fanout_txns(list(shard_prep.items()))
+            self.perf.inc("journal_entries",
+                          sum(len(v) for v in keys_of.values()))
+            if hook is not None:
+                hook("after_prepare")
+                for idx, (s, at) in enumerate(
+                        sorted(shard_apply.items())):
+                    self._store(s).queue_transaction(at)
+                    if idx == 0:
+                        hook("mid_apply")
+            else:
+                self._fanout_txns(list(shard_apply.items()))
+            if hook is not None:
+                hook("after_apply")
+        except (ConnectionError, OSError):
+            # a participant died mid-wave: best-effort drop of the
+            # wave's intents on every reachable shard (an applied
+            # shard holds none — rmkeys no-ops). The caller's
+            # degraded retry then rewrites the window through the
+            # full path, and the superseded-version guard makes any
+            # entry this cleanup missed a replay no-op.
+            for s, keys in keys_of.items():
+                try:
+                    self._store(s).queue_transaction(
+                        Transaction().omap_rmkeys(
+                            shard_cid(self.pg, s),
+                            self.JOURNAL_OBJ, keys))
+                except (ConnectionError, OSError, KeyError):
+                    pass
+            raise
+        live = list(range(self.n))
+        ios = 0
+        for job, _seq, _plan, parts in waves:
+            name = job[0]
+            self.object_sizes[name] = job[3]
+            self._log_write(name, live)
+            ios += len(parts)
+        self.perf.inc_many((("rmw_ops", len(waves)),
+                            ("rmw_shard_ios", ios),
+                            ("rmw_wire_bytes", wire)))
+
+    def stripe_journal_replay(self, dead_osds: set[int] | None = None
+                              ) -> dict:
+        """Replay the per-PG stripe journal after a crash/remount
+        (ref: the PGLog-driven divergent-entry resolution, applied to
+        RMW intents). Decision per pending seq: roll FORWARD when any
+        live participant already applied it (its watermark proves the
+        prepare phase completed everywhere) or when every live
+        participant still holds the intent (prepare complete, crash
+        before any apply — forward and backward are both consistent;
+        forward matches the ack the client may have seen); roll BACK
+        otherwise (prepare incomplete: applying would tear the
+        stripe). Apply is idempotent — an applied shard holds no
+        entry and is never re-XORed. Returns {forward, rolled_back,
+        entries}."""
+        dead = dead_osds or set()
+        live = self._live_slots(dead)
+        live_set = set(live)
+        pending: dict[int, dict[int, dict]] = {}
+        watermark: dict[int, int] = {}
+        # the existence probe fans out PIPELINED (one overlapped round
+        # trip, not n sequential ones — restores run this on every
+        # reconcile and most PGs have no journal at all)
+        probes: list[tuple[int, object]] = []
+        sync_exists: dict[int, bool] = {}
+        for s in list(live):
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            sub = getattr(st, "exists_submit", None)
+            try:
+                if sub is not None:
+                    probes.append((s, sub(cid, self.JOURNAL_OBJ)))
+                else:
+                    sync_exists[s] = st.exists(cid, self.JOURNAL_OBJ)
+            except (ConnectionError, OSError, KeyError):
+                live_set.discard(s)
+        for s, h in probes:
+            try:
+                sync_exists[s] = bool(h.result()[0])
+            except (ConnectionError, OSError, KeyError):
+                # an unreachable-but-not-yet-marked shard: scan
+                # around it like a dead one (its intents settle on
+                # the next restore's replay)
+                live_set.discard(s)
+        for s in list(live):
+            if not sync_exists.get(s, False):
+                continue
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            try:
+                page = st.omap_iter(cid, self.JOURNAL_OBJ)
+            except (ConnectionError, OSError, KeyError):
+                live_set.discard(s)
+                continue
+            for key, val in page:
+                if key == self._J_APPLIED:
+                    watermark[s] = _struct.unpack("<Q", val)[0]
+                elif key.startswith(b"e"):
+                    ent = self._decode_jentry(val)
+                    pending.setdefault(ent["seq"], {})[s] = ent
+        forward = rolled_back = 0
+        for seq in sorted(pending):
+            holders = pending[seq]
+            ent0 = next(iter(holders.values()))
+            parts = [p for p in ent0["participants"] if p in live_set]
+            applied_any = any(watermark.get(p, -1) >= seq
+                              for p in parts)
+            all_logged = all(p in holders for p in parts)
+            name = ent0["name"]
+            # superseded entries (a later write — e.g. the degraded
+            # full-path retry of this very RMW — already bumped the
+            # object's version) must never re-fold their delta
+            roll = (applied_any or all_logged) \
+                and name in self.object_sizes \
+                and ent0["version"] > self.object_versions.get(name, 0)
+            for s, ent in holders.items():
+                st = self._store(s)
+                cid = shard_cid(self.pg, s)
+                txn = Transaction()
+                if roll:
+                    if ent["delta"]:
+                        txn.xor(cid, name, ent["a"], np.frombuffer(
+                            ent["delta"], np.uint8))
+                    if ent["nsl"] != ent["osl"]:
+                        txn.truncate(cid, name, ent["nsl"])
+                    txn.setattr(cid, name, HINFO_KEY, HashInfo(
+                        1, ent["nsl"], [ent["new_crc"]]).to_bytes())
+                    txn.omap_set(cid, self.JOURNAL_OBJ,
+                                 {self._J_APPLIED:
+                                  _struct.pack("<Q", max(
+                                      watermark.get(s, 0), seq))})
+                    watermark[s] = max(watermark.get(s, 0), seq)
+                txn.omap_rmkeys(cid, self.JOURNAL_OBJ,
+                                [self._jkey(seq)])
+                st.queue_transaction(txn)
+            if roll:
+                forward += 1
+                self.object_sizes[name] = max(
+                    self.object_sizes.get(name, 0), ent0["new_size"])
+            else:
+                rolled_back += 1
+        self._rmw_seq = max([self._rmw_seq] + list(pending)
+                            + list(watermark.values()))
+        self.perf.inc_many((("journal_replay_forward", forward),
+                            ("journal_replay_rollback", rolled_back)))
+        return {"forward": forward, "rolled_back": rolled_back,
+                "entries": sum(len(h) for h in pending.values())}
 
     # -- read path -----------------------------------------------------------
 
@@ -1093,6 +1697,46 @@ def _host_crc_available() -> bool:
         return native.ready() and native.crc32c_hw()
     except Exception:   # noqa: BLE001 — any native trouble = no mode
         return False
+
+
+@_functools.lru_cache(maxsize=4096)
+def _shift_cols(nbytes: int) -> tuple:
+    """Packed GF(2) column constants of the CRC32C shift-by-nbytes
+    matrix (cached: the RMW path shifts through the same tail
+    distances over and over)."""
+    from ..csum.reference import matrix_cols_u32, shift_matrix
+    return tuple(int(c) for c in matrix_cols_u32(shift_matrix(nbytes)))
+
+
+def _crc_shift(reg: int, nbytes: int) -> int:
+    """Advance a raw CRC32C register through nbytes zero bytes — the
+    O(1) building block of the incremental hinfo update (CRC32C is
+    GF(2)-linear in the message AND the seed, so
+    crc(new_row) = shift^{tail}(crc(old_row)) ^ shift^{tail'}(crc0(delta)))."""
+    if nbytes == 0 or reg == 0:
+        return int(reg)
+    cols = _shift_cols(int(nbytes))
+    out = 0
+    for b in range(32):
+        if (reg >> b) & 1:
+            out ^= cols[b]
+    return out
+
+
+def _rows_crc0(rows: np.ndarray) -> np.ndarray:
+    """(N, L) byte rows -> (N,) ZERO-seed crc32c (the delta-row
+    convention: a zero seed composes under XOR and position shifts);
+    native SSE4.2 when built, batched device launch otherwise."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    if _host_crc_available():
+        from .. import native
+        return np.asarray(native.native_crc32c_rows(0, rows),
+                          dtype=np.uint32)
+    from ..csum.kernels import crc32c_blocks
+    from ..ops.rs_kernels import run_bucketed
+    return np.asarray(run_bucketed(
+        lambda b: crc32c_blocks(b, init=0, xorout=0), rows),
+        dtype=np.uint32)
 
 
 def _rows_crc32c(rows: np.ndarray) -> np.ndarray:

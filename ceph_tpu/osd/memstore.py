@@ -63,6 +63,22 @@ class Transaction:
         self.ops.append(("write", cid, oid, int(offset), arr))
         return self
 
+    def xor(self, cid: str, oid: str, offset: int, data):
+        """XOR `data` into the object at `offset`, zero-extending past
+        EOF (ref: the parity-delta apply of EC partial-stripe
+        overwrites — MOSDECSubOpWrite carrying ECTransaction deltas).
+        XOR into a zero-extended region degenerates to a plain write,
+        so the op also serves delta writes past the old tail."""
+        if int(offset) < 0:
+            raise ValueError(f"xor offset {offset} < 0")
+        arr = (np.frombuffer(data, dtype=np.uint8).copy()
+               if isinstance(data, (bytes, bytearray, memoryview))
+               else np.asarray(data, np.uint8).copy())
+        if arr.ndim != 1:
+            raise ValueError(f"xor data must be flat bytes, got {arr.shape}")
+        self.ops.append(("xor", cid, oid, int(offset), arr))
+        return self
+
     def truncate(self, cid: str, oid: str, size: int):
         if int(size) < 0:
             raise ValueError(f"truncate size {size} < 0")
@@ -173,6 +189,15 @@ class MemStore:
                 grown[:len(o.data)] = o.data
                 o.data = grown
             o.data[off:end] = data
+        elif kind == "xor":
+            _, cid, oid, off, data = op
+            o = self._obj(cid, oid, create=True)
+            end = off + len(data)
+            if end > len(o.data):
+                grown = np.zeros(end, dtype=np.uint8)
+                grown[:len(o.data)] = o.data
+                o.data = grown
+            o.data[off:end] ^= data
         elif kind == "truncate":
             _, cid, oid, size = op
             o = self._obj(cid, oid, create=True)

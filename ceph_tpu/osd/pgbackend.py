@@ -201,6 +201,16 @@ class PGBackend:
                  dead_osds: set[int] | None = None) -> None:
         self.write_ranges([(name, offset, data)], dead_osds)
 
+    def append_objects(self, appends, dead_osds=None) -> None:
+        """Append streams: each name's bytes land at its current tail
+        (creating absent objects at offset 0). On an EC pool a tail
+        landing inside the padded stripe is the RMW append fast path:
+        the pre-image is zeros by the layout rule, so no read phase
+        and only the tail data shard + m parity shards move."""
+        self.write_ranges(
+            [(name, self.object_sizes.get(name, 0), data)
+             for name, data in appends.items()], dead_osds)
+
     def read_objects(self, names, dead_osds=None) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
@@ -222,14 +232,24 @@ class PGBackend:
         for name in names:
             if name not in self.object_sizes:
                 raise KeyError(f"no object {name!r}")
+        # ONE combined txn per shard for the whole batch (the window's
+        # store-apply unit — the per-name loop cost B*n transactions
+        # and B*n wire frames where n now suffice; ROADMAP item 2b's
+        # `store.apply` wall), fanned out pipelined
         seen: set[str] = set()
+        doomed: list[str] = []
         for name in names:
-            if name in seen:
-                continue
-            seen.add(name)
-            for s in live:
-                t = Transaction().remove(shard_cid(self.pg, s), name)
-                self._store(s).queue_transaction(t)
+            if name not in seen:
+                seen.add(name)
+                doomed.append(name)
+        txns = []
+        for s in live:
+            t = Transaction()
+            for name in doomed:
+                t.remove(shard_cid(self.pg, s), name)
+            txns.append((s, t))
+        self._fanout_txns(txns)
+        for name in doomed:
             del self.object_sizes[name]
             self._log_write(name, live)
 
@@ -388,6 +408,11 @@ class PGBackend:
                         (name, s, f"hinfo len {hinfo.total_chunk_size} "
                                   f"!= {want}"))
             for stray in on_disk - set(self.object_sizes):
+                # "__"-prefixed names are PG-internal bookkeeping
+                # (stripe journal, standalone __pg_meta__): never
+                # client data, never stray
+                if stray.startswith("__"):
+                    continue
                 # a behind shard may hold an object whose delete it
                 # hasn't replayed yet — lag, not corruption (same
                 # excuse the missing/size checks apply above)
@@ -434,21 +459,33 @@ class ReplicatedBackend(PGBackend):
 
     def _put_full(self, name: str, arr: np.ndarray, crc: int,
                   live: list[int]) -> None:
-        hinfo = HashInfo(1, len(arr), [crc])
-        self._fanout_txns(
-            [(s, Transaction()
-              .write(shard_cid(self.pg, s), name, 0, arr)
-              .truncate(shard_cid(self.pg, s), name, len(arr))
-              .setattr(shard_cid(self.pg, s), name,
-                       HINFO_KEY, hinfo.to_bytes()))
-             for s in live])
-        self.object_sizes[name] = len(arr)
-        self._log_write(name, live)
+        self._put_group([(name, arr, crc)], live)
+
+    def _put_group(self, items, live: list[int]) -> None:
+        """Fan a group of (name, bytes, crc) puts out as ONE combined
+        transaction per replica (the window's store-apply unit;
+        ROADMAP item 2b — the per-object fan-out cost B*n store
+        transactions and B*n `store.apply` passes where n suffice)."""
+        txns = []
+        for s in live:
+            cid = shard_cid(self.pg, s)
+            t = Transaction()
+            for name, arr, crc in items:
+                hinfo = HashInfo(1, len(arr), [crc])
+                t.write(cid, name, 0, arr) \
+                 .truncate(cid, name, len(arr)) \
+                 .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
+            txns.append((s, t))
+        self._fanout_txns(txns)
+        for name, arr, _crc in items:
+            self.object_sizes[name] = len(arr)
+            self._log_write(name, live)
 
     def write_objects(self, objects, dead_osds=None) -> None:
         """Full-object writes: digest every equal-length group in one
         batched CRC launch, then fan identical bytes to each live
-        replica (the repop fan-out, minus the network)."""
+        replica (the repop fan-out, minus the network) — one combined
+        transaction per replica per group."""
         live = self._live_slots(dead_osds)
         self._check_min_size(live)
         by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
@@ -457,12 +494,12 @@ class ReplicatedBackend(PGBackend):
             by_len.setdefault(len(arr), []).append((name, arr))
         for olen, group in by_len.items():
             if olen == 0:
-                for name, arr in group:
-                    self._put_full(name, arr, 0xFFFFFFFF, live)
+                self._put_group([(n, a, 0xFFFFFFFF) for n, a in group],
+                                live)
                 continue
             crcs = self._batched_crcs(np.stack([a for _, a in group]))
-            for (name, arr), crc in zip(group, crcs):
-                self._put_full(name, arr, int(crc), live)
+            self._put_group([(n, a, int(c))
+                             for (n, a), c in zip(group, crcs)], live)
 
     def write_ranges(self, ops, dead_osds=None) -> None:
         """Arbitrary (offset, len) overwrites. Replication needs no RMW
@@ -500,15 +537,16 @@ class ReplicatedBackend(PGBackend):
             for off, arr in writes:
                 buf[off:off + len(arr)] = arr
             staged.append((name, buf))
-        # batched digest per equal new-length group, then fan out
+        # batched digest per equal new-length group, then ONE combined
+        # txn per replica per group (the grouped put fan-out)
         by_len: dict[int, list[tuple[str, np.ndarray]]] = {}
         for name, buf in staged:
             by_len.setdefault(len(buf), []).append((name, buf))
         for olen, group in by_len.items():
             crcs = (self._batched_crcs(np.stack([b for _, b in group]))
                     if olen else [0xFFFFFFFF] * len(group))
-            for (name, buf), crc in zip(group, crcs):
-                self._put_full(name, buf, int(crc), live)
+            self._put_group([(n, b, int(c))
+                             for (n, b), c in zip(group, crcs)], live)
 
     # -- read path -----------------------------------------------------------
 
@@ -742,16 +780,21 @@ class ReplicatedBackend(PGBackend):
                 else:
                     raise ValueError(
                         f"all surviving replicas of {name!r} fail digest")
-            hinfo = HashInfo(1, olen, [crcs[ni]])
-            for s in lost:
-                t = (Transaction()
-                     .write(shard_cid(self.pg, s), name, 0, data[ni])
-                     .truncate(shard_cid(self.pg, s), name, olen)
-                     .setattr(shard_cid(self.pg, s), name,
-                              HINFO_KEY, hinfo.to_bytes()))
-                self._store(s).queue_transaction(t)
+        # ONE combined txn per recovering replica for the whole batch
+        # (was one per (object, slot)), fanned out pipelined
+        txns = []
+        for s in lost:
+            cid = shard_cid(self.pg, s)
+            t = Transaction()
+            for ni, name in enumerate(sub):
+                hinfo = HashInfo(1, olen, [crcs[ni]])
+                t.write(cid, name, 0, data[ni]) \
+                 .truncate(cid, name, olen) \
+                 .setattr(cid, name, HINFO_KEY, hinfo.to_bytes())
                 counters["bytes"] += olen
-            counters["objects"] += 1
+            txns.append((s, t))
+        self._fanout_txns(txns)
+        counters["objects"] += len(sub)
 
     # -- scrub ---------------------------------------------------------------
 

@@ -995,6 +995,12 @@ class RemoteStore:
     def exists(self, cid: str, oid: str) -> bool:
         return bool(self._call("exists", self._co(cid, oid))[0])
 
+    def exists_submit(self, cid: str, oid: str) -> "_AsyncStoreOp":
+        """Pipelined existence probe: transmit now, collect later —
+        the stripe-journal replay scan probes every shard in ONE
+        overlapped round trip instead of n sequential ones."""
+        return _AsyncStoreOp(self, "exists", self._co(cid, oid))
+
     def list_objects(self, cid: str) -> list[str]:
         d = Decoder(self._call("ls", self._co(cid)))
         return d.list(Decoder.string)
@@ -1002,6 +1008,18 @@ class RemoteStore:
     def omap_get(self, cid: str, oid: str, key: bytes) -> bytes:
         return self._call(
             "omap_get", self._co(cid, oid, lambda e: e.blob(key)))
+
+    def omap_iter(self, cid: str, oid: str,
+                  start_after: bytes | None = None,
+                  limit: int | None = None) -> list[tuple[bytes, bytes]]:
+        """Ordered omap page — the stripe-journal replay scan's frame
+        (one page per call, same contract as the local stores)."""
+        body = self._co(cid, oid, lambda e: e
+                        .boolean(start_after is not None)
+                        .blob(start_after or b"")
+                        .i64(-1 if limit is None else int(limit)))
+        d = Decoder(self._call("omap_iter", body))
+        return d.list(lambda dd: (dd.blob(), dd.blob()))
 
 
 # -- daemons -----------------------------------------------------------------
@@ -1676,7 +1694,7 @@ class OSDDaemon:
 
     _STORE_READ_KINDS = frozenset(
         {"read", "readv", "readv_ranges", "stat", "getattr", "exists",
-         "ls", "omap_get"})
+         "ls", "omap_get", "omap_iter"})
 
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
         # the store plane is ticket-gated exactly like the client op
@@ -1797,6 +1815,16 @@ class OSDDaemon:
             if obj is None or key not in obj.omap:
                 raise KeyError(f"{cid}/{oid}:{key!r}")
             return obj.omap[key]
+        if kind == "omap_iter":
+            has_start = d.boolean()
+            start = d.blob()
+            limit = d.i64()
+            page = st.omap_iter(cid, oid,
+                                start_after=start if has_start else None,
+                                limit=None if limit < 0 else limit)
+            e = Encoder()
+            e.list(page, lambda en, kv: en.blob(kv[0]).blob(kv[1]))
+            return e.bytes()
         raise ValueError(f"unknown store op {kind!r}")
 
     # -- PG hosting ----------------------------------------------------------
@@ -2268,6 +2296,24 @@ class OSDDaemon:
                                    f"errored ({e}); queued for retry")
                         self._rewind_pending.setdefault(
                             ps, set()).update(div)
+        # stripe-journal replay (r16): a primary crash mid-RMW leaves
+        # intents on the participating shards — settle them (forward
+        # or back, never torn) BEFORE this backend serves a single op.
+        # Map-known-down and suspected OSDs are skipped up front (a
+        # sync scan frame to a dead peer would stall a whole
+        # op_timeout); shards that fail mid-scan are skipped the same
+        # way, and the next reconcile's restore retries them.
+        try:
+            down = {o for o in range(len(self.osdmap.osd_up))
+                    if not self.osdmap.osd_up[o]}
+            rep = be.stripe_journal_replay(
+                dead_osds=down | set(self.suspect))
+            if rep["entries"]:
+                self.c.log(f"{self.name}: pg 1.{ps} stripe-journal "
+                           f"replay: {rep}")
+        except (ConnectionError, OSError, KeyError) as e:
+            self.c.log(f"{self.name}: pg 1.{ps} stripe-journal "
+                       f"replay deferred: {e}")
         return be
 
     def _quarantine_divergent(self, ps: int, be,
@@ -3323,6 +3369,28 @@ class OSDDaemon:
                                  **kw)
             if not fused:
                 self._persist_meta(ps)
+            return b""
+        if kind in ("write_at", "append"):
+            # partial-stripe writes (r16): the backend routes each op
+            # through the parity-delta RMW fast path (journaled, only
+            # touched + parity shards move) or the full-stripe ladder
+            self._check_snapc(d.u64())
+            trips = d.list(lambda dd: (dd.string(), dd.u64(),
+                                       dd.blob()))
+            self._snap_guard(ps, be, [n for n, _o, _b in trips])
+            ops = [(n, be.object_sizes.get(n, 0) if kind == "append"
+                    else off, blob) for n, off, blob in trips]
+            try:
+                be.write_ranges(ops, dead_osds=set(self.suspect))
+            except (ConnectionError, OSError):
+                # a shard holder died mid-fan-out: suspect it and
+                # retry once degraded — the delta path refuses a
+                # degraded stripe, so the retry rides the full-stripe
+                # RMW (and the journal's abort + superseded-version
+                # guard keep any half-logged intents inert)
+                self._mark_suspects(be)
+                be.write_ranges(ops, dead_osds=set(self.suspect))
+            self._persist_meta(ps)
             return b""
         if kind == "remove":
             self._check_snapc(d.u64())
@@ -5780,6 +5848,30 @@ class Client:
                     lambda e, g=group: e.u64(self._snapc()).mapping(
                         g, Encoder.string, Encoder.blob_ref))
             for ps, group in by_pg.items()])
+
+    def write_at(self, name: str, offset: int, data: bytes) -> None:
+        """Partial overwrite (the rados write-at-offset role): the
+        primary serves it through the parity-delta RMW fast path when
+        the stripe is clean — only the touched data shard(s) + m
+        parity shards move on its fan-out — laddering to the
+        full-stripe RMW otherwise."""
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        self._op("write_at", ps,
+                 lambda e: e.u64(self._snapc()).list(
+                     [(name, int(offset), bytes(data))],
+                     lambda en, t: en.string(t[0]).u64(t[1])
+                     .blob_ref(t[2])))
+
+    def append(self, name: str, data: bytes) -> None:
+        """Append to a stream object: lands at the primary-known tail
+        (the append-optimized layout — successive appends ride the
+        RMW fast path with no pre-image read at all)."""
+        ps = self.osdmap.object_to_pg(1, name)[1]
+        self._op("append", ps,
+                 lambda e: e.u64(self._snapc()).list(
+                     [(name, 0, bytes(data))],
+                     lambda en, t: en.string(t[0]).u64(t[1])
+                     .blob_ref(t[2])))
 
     def read(self, name: str) -> bytes:
         ps = self.osdmap.object_to_pg(1, name)[1]

@@ -141,7 +141,7 @@ def _encode_op(e: Encoder, op: tuple) -> None:
         e.string(op[1])
     elif kind in ("touch", "remove", "omap_clear"):
         e.string(op[1]).string(op[2])
-    elif kind == "write":
+    elif kind in ("write", "xor"):
         # data by REFERENCE (no tobytes copy): the buffer rides the
         # encoder's segment list; wire callers keep it alive/unmodified
         # until the frame is acked (the bufferlist aliasing contract),
@@ -172,7 +172,7 @@ def _decode_op(d: Decoder) -> tuple:
         return (kind, d.string())
     if kind in ("touch", "remove", "omap_clear"):
         return (kind, d.string(), d.string())
-    if kind == "write":
+    if kind in ("write", "xor"):
         cid, oid, off = d.string(), d.string(), d.u64()
         # d.blob() already copied the bytes out of the frame; the op
         # tuple owns them exclusively, so wrapping without a second
@@ -946,7 +946,7 @@ class TinStore:
                         gone_colls.add(op[1])
                         for key in [k for k in staged if k[0] == op[1]]:
                             del staged[key]
-                    if kind == "write":
+                    if kind in ("write", "xor"):
                         _, cid, oid, woff, data = op
                         cur = self._staged_bytes(staged, gone,
                                                  gone_colls, cid, oid)
@@ -957,7 +957,10 @@ class TinStore:
                             cur = grown
                         else:
                             cur = cur.copy()
-                        cur[woff:end] = data
+                        if kind == "xor":
+                            cur[woff:end] ^= data
+                        else:
+                            cur[woff:end] = data
                         meta_ops.append(self._stage(
                             staged, new_extents, cid, oid, cur))
                     elif kind == "truncate":

@@ -37,12 +37,17 @@ def _check_trace_block(tr):
 
 
 def test_rados_bench_json_schema(capsys):
+    # the 0.4 s window alone can finish ZERO ops under full-suite
+    # load; the bench's min-ops guard (r16 deflake) keeps the window
+    # open — load_factor-scaled — until every tenant owns an op, so
+    # the percentile assertions below are never vacuous
     rados_bench.main([
         "seq", "--transport", "standalone", "--insecure",
         "--seconds", "0.4", "--object-size", "2048", "--batch", "2",
         "--num-osds", "4", "--pg-num", "2", "--op-shards", "2",
         "--profile", "plugin=tpu_rs k=2 m=1 impl=bitlinear",
-        "--tenants", "2", "--hedge-delay-ms", "30", "--json"])
+        "--tenants", "2", "--hedge-delay-ms", "30", "--min-ops", "2",
+        "--json"])
     out = json.loads(capsys.readouterr().out)
     # core stats + tail percentiles
     assert PCT_KEYS <= set(out)
@@ -180,6 +185,84 @@ def test_recovery_bench_json_schema_live():
     # r15: the sampled recovery trace rides the same JSON
     _check_trace_block(data["trace"])
     assert data["trace"]["daemons"] == ["recovery_bench"]
+
+
+RMW_KEYS = {"ops", "logical_bytes", "wire_bytes",
+            "wire_bytes_per_logical_byte", "wire_bytes_per_op",
+            "shard_ios", "shard_ios_per_op", "participants_expected",
+            "preread_bytes", "append_fast_ops", "full_fallbacks",
+            "journal_entries", "delta_launches"}
+FULL_KEYS = {"logical_bytes", "wire_bytes",
+             "wire_bytes_per_logical_byte", "wire_bytes_per_op"}
+
+
+def test_bench_r16_artifact_pinned():
+    """The committed r16 partial-stripe-write artifact: schema keys
+    CI parses, the per-cell amplification blocks rados_bench emits,
+    and the acceptance floors — for 4 KiB overwrites at k=8 m=3
+    (4 MiB stripes, cephx+secure), bytes-on-wire per logical byte on
+    the RMW path <= 0.25x the full-stripe-encode baseline measured
+    in the same run, and exactly 1 data + m parity shards transact
+    per op. Every metric is a COUNT, so the floors are
+    deterministic."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r16.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "rmw_r16/1"
+    for cname in ("overwrite_4k_k8m3", "append_4k_k8m3"):
+        cell = data["cells"][cname]
+        amp = cell["amplification"]
+        assert RMW_KEYS <= set(amp["rmw"]), cname
+        assert FULL_KEYS <= set(amp["full_stripe_baseline"]), cname
+        assert amp["rmw"]["ops"] > 0
+        assert amp["rmw"]["wire_bytes"] > 0
+        assert cell["config"]["cephx"] and cell["config"]["secure"]
+        assert cell["config"]["profile"] \
+            == "plugin=tpu_rs k=8 m=3 impl=bitlinear"
+        assert cell["config"]["chunk_size"] == 512 * 1024
+        assert cell["config"]["overwrite_size"] == 4096
+    acc = data["acceptance"]
+    assert acc["overwrite_wire_vs_full_stripe"] <= 0.25
+    assert acc["append_wire_vs_full_stripe"] <= 0.25
+    # exactly 1 data + m parity shards move per RMW op, and the clean
+    # overwrite cell never laddered to the full path
+    assert acc["overwrite_shard_ios_per_op"] == 4.0
+    assert acc["shard_ios_expected"] == 4
+    assert acc["overwrite_full_fallbacks"] == 0
+    # appends into stripe padding read no pre-image at all
+    assert acc["append_preread_bytes"] == 0
+
+
+@pytest.mark.slow
+def test_rados_bench_overwrite_schema_live():
+    """Live run of the r16 bench surface (slow sweep cell; the
+    committed-artifact pin above is the tier-1 representative): the
+    overwrite workload emits the amplification block, the RMW path
+    beats the full-stripe baseline, and the shard-IO counter shows
+    exactly 1 data + m parity participants."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "rados_bench.py"),
+         "overwrite", "--transport", "standalone", "--insecure",
+         "--object-size", "65536", "--batch", "2", "--num-osds", "8",
+         "--pg-num", "2", "--rmw-ops", "8", "--overwrite-size",
+         "2048", "--chunk-size", "8192",
+         "--profile", "plugin=tpu_rs k=4 m=2 impl=bitlinear",
+         "--json"],
+        capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(out.stdout)
+    amp = data["amplification"]
+    assert RMW_KEYS <= set(amp["rmw"])
+    assert amp["rmw"]["ops"] == 8
+    assert amp["rmw"]["shard_ios_per_op"] == 3.0   # 1 data + m=2
+    assert amp["rmw"]["full_fallbacks"] == 0
+    assert amp["ratio_vs_full_stripe"] < 1.0
+    _check_trace_block(data["trace"])
 
 
 REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",

@@ -1,0 +1,373 @@
+"""Partial-stripe write fast path (r16): GF parity-delta RMW, the
+per-PG stripe journal, and append streams.
+
+Contracts under test:
+  * BIT-EXACTNESS — parity after `apply_delta` (the xor store op fed
+    by the fused delta encode) is bit-identical to a full re-encode
+    oracle of the final logical bytes, across RS/LRC/Clay geometries
+    and both integrity modes (native host crc32c and the device
+    launch), including the incremental hinfo CRCs (CRC32C
+    GF(2)-linearity — no full-shard re-read ever happens);
+  * REFUSAL — a degraded stripe refuses the delta path and ladders to
+    the full-stripe RMW (a delta against a reconstructed pre-image
+    would fold garbage into parity);
+  * CRASH CONSISTENCY — SIGKILL at every stripe-journal phase
+    boundary recovers (TinStore remount + `stripe_journal_replay`) to
+    a state bit-exact with either the old or the new stripe, never a
+    torn mix, fsck-clean;
+  * APPEND — tail appends into stripe padding skip the read phase
+    entirely and never re-encode previously appended bytes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.osd import ecbackend as ecb
+from ceph_tpu.osd.ecbackend import ECBackend, ShardSet, shard_cid
+from ceph_tpu.osd.pgbackend import HINFO_KEY
+from ceph_tpu.osd.stripe import HashInfo
+
+
+def _integrity_modes(tier1_device: bool = True):
+    """Both integrity modes when the native host path is built. The
+    device mode duplicates ride the nightly (-m slow) except where
+    `tier1_device` keeps one tier-1 representative — the 870 s tier-1
+    budget is nearly full and the device path is one code path, not
+    one per geometry."""
+    from ceph_tpu.osd.ecbackend import _host_crc_available
+    if not _host_crc_available():
+        return ["device"]
+    dev = pytest.param("device", marks=()) if tier1_device \
+        else pytest.param("device", marks=pytest.mark.slow)
+    return ["host", dev]
+
+
+@pytest.fixture
+def integrity(request, monkeypatch):
+    """Force the RMW integrity mode: 'device' pins every CRC and
+    delta encode onto the batched launches even when the native host
+    path is built."""
+    if request.param == "device":
+        monkeypatch.setattr(ecb, "_host_crc_available", lambda: False)
+    return request.param
+
+
+GEOMETRIES = [
+    ("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256),
+    pytest.param("plugin=tpu_rs k=3 m=3 technique=cauchy_good "
+                 "impl=logexp", 256, marks=pytest.mark.slow),
+    ("plugin=lrc k=4 m=2 l=3 impl=bitlinear", 256),
+    ("plugin=clay k=4 m=2 impl=bitlinear", 512),
+    pytest.param("plugin=clay k=4 m=2 d=5 impl=ref", None,
+                 marks=pytest.mark.slow),
+]
+
+
+def _make(profile, chunk_size):
+    coder = factory(profile)
+    n = coder.get_chunk_count()
+    cluster = ShardSet()
+    be = ECBackend(profile, "1.0", list(range(n)), cluster,
+                   chunk_size=chunk_size)
+    return be, cluster
+
+
+def _assert_stores_match_oracle(be, name, logical):
+    """Every live shard's bytes AND hinfo CRC must equal a from-
+    scratch re-encode of the final logical content."""
+    sl = be._shard_len(len(logical))
+    dshards = be.sinfo.object_to_shards(
+        np.asarray(logical, np.uint8)[None, :])
+    parity = np.asarray(be.coder.encode_chunks(dshards))
+    full = be._slots_from_dense(
+        np.concatenate([dshards, parity], axis=1))[0]       # (n, sl)
+    crcs = be._batched_hinfo_crcs(full)
+    for s in range(be.n):
+        st = be._store(s)
+        cid = shard_cid(be.pg, s)
+        np.testing.assert_array_equal(
+            st.read(cid, name), full[s],
+            err_msg=f"shard {s} bytes diverge from re-encode oracle")
+        hinfo = HashInfo.from_bytes(st.getattr(cid, name, HINFO_KEY))
+        assert hinfo.total_chunk_size == sl, f"shard {s} hinfo len"
+        assert hinfo.get_chunk_hash(0) == int(crcs[s]), \
+            f"shard {s}: incremental hinfo CRC != recomputed CRC"
+
+
+class TestDeltaBitExact:
+    @pytest.mark.parametrize("integrity",
+                             _integrity_modes(tier1_device=False),
+                             indirect=True)
+    @pytest.mark.parametrize("profile,chunk", GEOMETRIES)
+    def test_parity_after_delta_matches_reencode_oracle(
+            self, profile, chunk, integrity):
+        be, _ = _make(profile, chunk)
+        rng = np.random.default_rng(42)
+        size = be.sinfo.stripe_width * 2 + 123
+        base = rng.integers(0, 256, size, np.uint8)
+        be.write_objects({"o": base})
+        shadow = base.copy()
+        # several partial overwrites: single-column, cross-column,
+        # second-stripe, and an in-padding extension
+        cs = be.sinfo.chunk_size
+        for off, ln in [(10, 50), (cs - 7, 30),
+                        (be.sinfo.stripe_width + 5, 2 * cs - 9),
+                        (size - 3, 40)]:
+            patch = rng.integers(0, 256, ln, np.uint8)
+            be.write_at("o", off, patch)
+            if off + ln > len(shadow):
+                grown = np.zeros(off + ln, np.uint8)
+                grown[:len(shadow)] = shadow
+                shadow = grown
+            shadow[off:off + ln] = patch
+            np.testing.assert_array_equal(be.read_object("o"), shadow)
+        d = be.perf.dump()
+        assert d["rmw_ops"] >= 4, "writes did not ride the delta path"
+        assert d["rmw_full_fallbacks"] == 0
+        _assert_stores_match_oracle(be, "o", shadow)
+        assert be.deep_scrub()["inconsistent"] == []
+
+    @pytest.mark.parametrize("integrity", _integrity_modes(),
+                             indirect=True)
+    def test_only_touched_plus_parity_shards_move(self, integrity):
+        """The wire contract: a single-column overwrite transacts on
+        exactly 1 data + m parity shards — untouched data shards see
+        no store transaction at all."""
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, 256, 3000, np.uint8)
+        be.write_objects({"o": base})
+        before = {s: be._store(s).committed_txns for s in range(be.n)}
+        patch = rng.integers(0, 256, 64, np.uint8)
+        be.write_at("o", 300, patch)    # column 1, stripe 0
+        touched = {s for s in range(be.n)
+                   if be._store(s).committed_txns != before[s]}
+        parity_slots = {be.chunk_mapping[be.k + j]
+                        for j in range(be.m)}
+        assert touched == {be.data_slots[1]} | parity_slots
+        d = be.perf.dump()
+        assert d["rmw_shard_ios"] == 1 + be.m
+        assert d["rmw_ops"] == 1
+
+    def test_delta_program_key_shared_across_instances(self):
+        """The process-wide program contract: two coders with one
+        geometry expose EQUAL delta keys (the r10 sharing rule — one
+        compiled program per process, not per PG per daemon); a
+        different geometry does not."""
+        a = factory("plugin=tpu_rs k=4 m=2 impl=bitlinear")
+        b = factory("plugin=tpu_rs k=4 m=2 impl=bitlinear")
+        c = factory("plugin=tpu_rs k=4 m=2 impl=bitlinear "
+                    "technique=cauchy_good")
+        assert a.delta_program_key((1,)) == b.delta_program_key((1,))
+        assert a.delta_program_key((1,)) != c.delta_program_key((1,))
+        # vector codes have no static form; the generic path serves
+        clay = factory("plugin=clay k=4 m=2 d=5 impl=ref")
+        assert clay.delta_program_key((1,)) is None
+
+
+class TestDeltaRefusal:
+    def test_degraded_stripe_refuses_and_ladders_to_full(self):
+        be, cluster = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear",
+                            256)
+        rng = np.random.default_rng(9)
+        base = rng.integers(0, 256, 3000, np.uint8)
+        be.write_objects({"o": base})
+        dead_osd = be.acting[1]
+        cluster.stores.pop(dead_osd)
+        patch = rng.integers(0, 256, 64, np.uint8)
+        be.write_at("o", 10, patch, dead_osds={dead_osd})
+        want = base.copy()
+        want[10:74] = patch
+        np.testing.assert_array_equal(
+            be.read_object("o", dead_osds={dead_osd}), want)
+        d = be.perf.dump()
+        assert d["rmw_full_fallbacks"] >= 1
+        assert d["rmw_ops"] == 0, \
+            "a degraded stripe must never take the delta path"
+
+    def test_stale_shard_refuses_delta(self):
+        """A revived-but-behind shard (cursor below the object's
+        version) is as unsafe a delta base as a dead one."""
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(10)
+        be.write_objects({"o": rng.integers(0, 256, 2000, np.uint8)})
+        be.shard_applied[2] = 0          # simulate a lagging shard
+        be.write_at("o", 5, rng.integers(0, 256, 40, np.uint8))
+        d = be.perf.dump()
+        assert d["rmw_ops"] == 0 and d["rmw_full_fallbacks"] >= 1
+
+    def test_overlapping_writes_in_one_wave_refuse(self):
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(11)
+        base = rng.integers(0, 256, 2000, np.uint8)
+        be.write_objects({"o": base})
+        a = rng.integers(0, 256, 50, np.uint8)
+        b = rng.integers(0, 256, 50, np.uint8)
+        be.write_ranges([("o", 100, a), ("o", 120, b)])
+        want = base.copy()
+        want[100:150] = a
+        want[120:170] = b                # later op wins the overlap
+        np.testing.assert_array_equal(be.read_object("o"), want)
+        assert be.perf.dump()["rmw_ops"] == 0
+
+    def test_clay_length_change_refuses(self):
+        """Vector codes couple bytes across the chunk: an extension
+        that changes shard length must re-encode, not delta."""
+        be, _ = _make("plugin=clay k=2 m=2 impl=ref", 512)
+        rng = np.random.default_rng(12)
+        sw = be.sinfo.stripe_width
+        base = rng.integers(0, 256, sw, np.uint8)
+        be.write_objects({"o": base})
+        tail = rng.integers(0, 256, 300, np.uint8)
+        be.write_at("o", sw, tail)       # grows the shard
+        want = np.concatenate([base, tail])
+        np.testing.assert_array_equal(be.read_object("o"), want)
+        assert be.perf.dump()["rmw_ops"] == 0
+        assert be.deep_scrub()["inconsistent"] == []
+
+
+class TestAppendStreams:
+    @pytest.mark.parametrize("integrity",
+                             _integrity_modes(tier1_device=False),
+                             indirect=True)
+    def test_appends_skip_preread_and_reencode(self, integrity):
+        """The append-optimized layout: successive tail appends into
+        the padded stripe read NOTHING (the pre-image is zeros by the
+        layout rule) and never re-encode previously appended bytes —
+        no full-stripe encode launches after the create."""
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(13)
+        first = rng.integers(0, 256, 100, np.uint8)
+        be.write_objects({"log": first})
+        d0 = be.perf.dump()
+        shadow = first
+        for _ in range(6):
+            chunk = rng.integers(0, 256,
+                                 int(rng.integers(30, 200)), np.uint8)
+            be.append_objects({"log": chunk})
+            shadow = np.concatenate([shadow, chunk])
+        d1 = be.perf.dump()
+        assert d1["rmw_append_fast"] - d0["rmw_append_fast"] == 6
+        assert d1["rmw_preread_bytes"] == d0["rmw_preread_bytes"], \
+            "appends into padding must not read a pre-image"
+        # no full-stripe encode after the create: the tail stripe is
+        # never re-encoded, only delta-folded
+        for key in ("fused_write_launches", "host_encode_launches",
+                    "encode_launches", "write_wire_bytes"):
+            assert d1[key] == d0[key], key
+        np.testing.assert_array_equal(be.read_object("log"), shadow)
+        _assert_stores_match_oracle(be, "log", shadow)
+        assert be.deep_scrub()["inconsistent"] == []
+
+
+class _SimulatedKill(Exception):
+    pass
+
+
+def _tin_cluster(root):
+    from ceph_tpu.osd.tinstore import TinStore
+    return ShardSet(store_factory=lambda osd: TinStore(
+        os.path.join(root, f"osd.{osd}")))
+
+
+def _rebuild(cluster, meta_src):
+    """A post-crash primary: fresh backend view over the remounted
+    stores, carrying the persisted-metadata analog (sizes/versions/
+    log/cursors survive on the wire tier's meta plane)."""
+    be2 = ECBackend("plugin=tpu_rs k=4 m=2 impl=bitlinear", "1.0",
+                    list(range(6)), cluster, chunk_size=256,
+                    ensure_collections=False)
+    be2.object_sizes = dict(meta_src.object_sizes)
+    be2.object_versions = dict(meta_src.object_versions)
+    be2.pg_log = meta_src.pg_log
+    be2.shard_applied = list(meta_src.shard_applied)
+    return be2
+
+
+PHASES = ["before_prepare", "mid_prepare", "after_prepare",
+          "mid_apply", "after_apply"]
+
+
+class TestStripeJournalCrashMatrix:
+    @pytest.mark.parametrize("phase", PHASES)
+    def test_sigkill_at_phase_boundary_never_tears(self, phase,
+                                                   tmp_path):
+        """Kill the whole store set at each journal phase boundary;
+        after remount + replay the stripe is bit-exact with either
+        the OLD or the NEW content (prepare incomplete -> old;
+        prepare complete -> new), hinfo verifies, deep scrub is
+        clean, and offline fsck finds nothing."""
+        from ceph_tpu.osd.tinstore import TinStore
+        root = str(tmp_path)
+        cluster = _tin_cluster(root)
+        be = ECBackend("plugin=tpu_rs k=4 m=2 impl=bitlinear", "1.0",
+                       list(range(6)), cluster, chunk_size=256)
+        rng = np.random.default_rng(21)
+        base = rng.integers(0, 256, 3000, np.uint8)
+        be.write_objects({"o": base})
+        patch = rng.integers(0, 256, 100, np.uint8)
+        new = base.copy()
+        new[500:600] = patch
+
+        def hook(p):
+            if p == phase:
+                for st in cluster.stores.values():
+                    st.crash()           # SIGKILL semantics: RAM gone
+                raise _SimulatedKill(p)
+        be._rmw_crash_hook = hook
+        with pytest.raises(_SimulatedKill):
+            be.write_at("o", 500, patch)
+        for st in cluster.stores.values():
+            st.remount()
+        be2 = _rebuild(cluster, be)
+        rep = be2.stripe_journal_replay()
+        got = be2.read_object("o")
+        if np.array_equal(got, new):
+            state = "new"
+        elif np.array_equal(got, base):
+            state = "old"
+        else:
+            state = "torn"
+        assert state != "torn", f"phase {phase}: torn stripe"
+        # prepare-incomplete phases MUST resolve old; post-prepare
+        # phases MUST roll forward to new
+        want = {"before_prepare": "old", "mid_prepare": "old",
+                "after_prepare": "new", "mid_apply": "new",
+                "after_apply": "new"}[phase]
+        assert state == want, (phase, state, rep)
+        oracle = new if state == "new" else base
+        _assert_stores_match_oracle(be2, "o", oracle)
+        assert be2.deep_scrub()["inconsistent"] == []
+        # replay is idempotent: a second crash-during-replay rerun
+        # must be a no-op
+        rep2 = be2.stripe_journal_replay()
+        assert rep2["entries"] == 0
+        np.testing.assert_array_equal(be2.read_object("o"), oracle)
+        for osd in range(6):
+            path = os.path.join(root, f"osd.{osd}")
+            fr = TinStore.fsck(path)
+            assert not (fr["errors"] or fr["extent_errors"]
+                        or fr["bad_objects"]), (osd, fr)
+
+    def test_replay_seq_reanchors_past_crash(self, tmp_path):
+        """New RMWs after a replay must not reuse journal sequence
+        numbers an old watermark already covers (a reused seq would
+        fake the roll-forward evidence)."""
+        cluster = _tin_cluster(str(tmp_path))
+        be = ECBackend("plugin=tpu_rs k=4 m=2 impl=bitlinear", "1.0",
+                       list(range(6)), cluster, chunk_size=256)
+        rng = np.random.default_rng(22)
+        base = rng.integers(0, 256, 2000, np.uint8)
+        be.write_objects({"o": base})
+        be.write_at("o", 10, rng.integers(0, 256, 40, np.uint8))
+        be.write_at("o", 90, rng.integers(0, 256, 40, np.uint8))
+        high = be._rmw_seq
+        be2 = _rebuild(cluster, be)
+        be2.stripe_journal_replay()
+        assert be2._rmw_seq >= high
+        patch = rng.integers(0, 256, 40, np.uint8)
+        be2.write_at("o", 200, patch)    # must journal cleanly
+        assert be2.deep_scrub()["inconsistent"] == []

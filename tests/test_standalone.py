@@ -458,9 +458,12 @@ class TestMonitorFailover:
             c.shutdown()
 
     def test_no_quorum_freezes_commits_then_revive_heals(self):
+        # deadlines load-scaled (the r11 deflake rule): this cell's
+        # fixed 40 s heal window flaked in-suite at r16 when the
+        # 1-core host was oversubscribed — it passes alone
         c = StandaloneCluster(n_osds=6, pg_num=4, op_timeout=3.0)
         try:
-            c.wait_for_clean(timeout=20)
+            c.wait_for_clean(timeout=20 * _LF)
             cl = c.client()
             objs = corpus(21, n=8)
             cl.write(objs)
@@ -478,8 +481,8 @@ class TestMonitorFailover:
                        for d in c.osds.values()
                        if not d._stop.is_set())
             c.revive_mon(1)      # quorum restored: 2 of 3
-            c.wait_for_down(victim, timeout=20)
-            c.wait_for_clean(timeout=40)
+            c.wait_for_down(victim, timeout=20 * _LF)
+            c.wait_for_clean(timeout=40 * _LF)
             for name, want in objs.items():
                 assert cl.read(name) == want
         finally:

@@ -83,6 +83,44 @@ def test_thrash_sharded_matrix(seed, store, tmp_path):
 
 @pytest.mark.chaos
 @pytest.mark.slow
+@pytest.mark.parametrize("seed,store", [(47, "tin")])
+def test_thrash_overwrite_during_faults(seed, store, tmp_path):
+    """r16 cell (`-m chaos`): seed-deterministic partial overwrites
+    (write_at) land WITH the round's faults still live, so SIGKILLs
+    catch RMWs mid-flight — the stripe journal's remount replay must
+    keep every acked overwrite exactly-once (last acked bytes,
+    byte-exact), removed objects removed, and the TinStore
+    directories fsck-clean after the final crash-shutdown. The
+    tier-1 representative of the journal's crash contract is the
+    hermetic SIGKILL-at-every-phase-boundary matrix in
+    tests/test_rmw_delta.py (TinStore remount + fsck included) —
+    this cell adds the live-wire concurrency on the chaos tier,
+    where the 870 s tier-1 budget has no headroom left."""
+    th = Thrasher(seed, store=store, rounds=1, ops=6,
+                  overwrite_during_faults=True,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["rmw_overwrite_checks"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store", [(67, "mem"), (79, "tin")])
+def test_thrash_overwrite_matrix(seed, store, tmp_path):
+    """Deeper overwrite-during-faults cells (`-m chaos`): more rounds,
+    both stores, beyond the tier-1 tin representative."""
+    th = Thrasher(seed, store=store, rounds=3, ops=6,
+                  overwrite_during_faults=True,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["rmw_overwrite_checks"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 @pytest.mark.parametrize("seed,store", [(19, "mem"), (31, "tin")])
 def test_thrash_degraded_reads_never_block(seed, store, tmp_path):
     """Round-11 invariant cell: with each round's faults still LIVE

@@ -53,10 +53,36 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("workload", choices=["write", "seq"],
+    ap.add_argument("workload",
+                    choices=["write", "seq", "overwrite", "append"],
                     help="write: timed writes; seq: write a working "
-                         "set, then timed sequential reads")
+                         "set, then timed sequential reads; "
+                         "overwrite: stage objects, then FIXED-COUNT "
+                         "partial overwrites through the RMW fast "
+                         "path with deterministic amplification "
+                         "counters (bytes-on-wire per logical byte, "
+                         "shard IOs per op) vs a full-stripe-rewrite "
+                         "baseline measured in the same run; append: "
+                         "same counters for tail appends to stream "
+                         "objects (the no-preread path)")
     ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--min-ops", type=int, default=2,
+                    help="timed workloads: extend the window (up to "
+                         "~4x, scaled by host load) until at least "
+                         "this many ops — and one per tenant — "
+                         "completed; a fully-loaded CI host can "
+                         "otherwise finish 0 ops in a short window "
+                         "and the percentile blocks are vacuous")
+    ap.add_argument("--rmw-ops", type=int, default=24,
+                    help="overwrite/append: exact op count (the "
+                         "amplification metrics are COUNTS, so the "
+                         "cell is deterministic, not timed)")
+    ap.add_argument("--overwrite-size", type=int, default=4096,
+                    help="overwrite/append: logical bytes per RMW op")
+    ap.add_argument("--chunk-size", type=int, default=4096,
+                    help="EC chunk size (stripe = k * chunk); the "
+                         "r16 artifact runs 512 KiB chunks = 4 MiB "
+                         "stripes at k=8")
     ap.add_argument("--object-size", type=int, default=64 * 1024)
     ap.add_argument("--num-osds", type=int, default=12)
     ap.add_argument("--pg-num", type=int, default=8)
@@ -135,6 +161,17 @@ def main(argv=None) -> None:
     if args.recovery_kill and args.transport != "standalone":
         raise SystemExit("rados_bench: --recovery-kill needs "
                          "--transport standalone")
+    if args.workload in ("overwrite", "append"):
+        if args.transport != "standalone":
+            raise SystemExit("rados_bench: overwrite/append measure "
+                             "the wire RMW path; use --transport "
+                             "standalone")
+        if args.pool != "ec":
+            raise SystemExit("rados_bench: overwrite/append are the "
+                             "EC parity-delta cells; --pool ec")
+        if args.rmw_ops <= 0 or args.overwrite_size <= 0:
+            raise SystemExit("rados_bench: --rmw-ops/--overwrite-size "
+                             "must be positive")
     if (args.tenants > 1 or args.hedge_delay_ms is not None) \
             and args.transport != "standalone":
         raise SystemExit("rados_bench: --tenants/--hedge-delay-ms "
@@ -168,14 +205,20 @@ def main(argv=None) -> None:
         try:
             c = StandaloneCluster(
                 n_osds=args.num_osds, pg_num=args.pg_num,
-                profile=profile, chunk_size=4096,
+                profile=profile, chunk_size=args.chunk_size,
                 secret=None if args.insecure else _os.urandom(32),
                 cephx=not args.insecure,
                 # 3s (the test tier's value), not 15: a dead shard
                 # holder stalls the unlucky in-flight fan-out for ONE
                 # rpc timeout before the suspect-marked degraded retry
-                # — at 15s that single stall eats a whole bench window
-                op_timeout=3.0,
+                # — at 15s that single stall eats a whole bench window.
+                # The fixed-count RMW cells are the exception: nothing
+                # is killed there, and a timeout-retried 4 MiB staging
+                # write would double-count ops in the deterministic
+                # amplification counters
+                op_timeout=30.0 if args.workload in ("overwrite",
+                                                     "append")
+                else 3.0,
                 op_window=args.window,
                 op_shards=args.op_shards,
                 msgr_workers=args.msgr_workers,
@@ -244,6 +287,18 @@ def main(argv=None) -> None:
                 "msgr": wire_client.msgr.perf.dump(),
                 "hedge": wire_client.perf.dump()}
             return snap
+
+        def ec_totals():
+            """Summed `ec` logger counters over live daemons — the
+            deterministic amplification inputs (counts, not timers)."""
+            tot: dict = {}
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                for key, v in _osd_perf(d).get("ec", {}).items():
+                    if isinstance(v, (int, float)):
+                        tot[key] = tot.get(key, 0) + v
+            return tot
 
         def shard_occupancy():
             """Per-OSD, per-shard grant counts (the hash-spread view):
@@ -323,6 +378,26 @@ def main(argv=None) -> None:
     nobj = 0
     killed_at = None
     op_errors = 0
+    amplification = None
+
+    def window_open(t_end, hard_end):
+        """The min-ops/extend-window guard: a short timed window on a
+        fully-loaded host can complete ZERO ops, leaving the
+        percentile blocks vacuous — keep the window open (up to the
+        load-scaled hard cap) until --min-ops landed and every tenant
+        owns at least one."""
+        now = time.perf_counter()
+        if now < t_end:
+            return True
+        if now >= hard_end:
+            return False
+        if len(lat) < max(1, args.min_ops):
+            return True
+        return args.tenants > 1 and any(not tl for tl in lat_tenant)
+
+    def hard_cap(t_start):
+        from ceph_tpu.chaos.thrasher import load_factor
+        return t_start + args.seconds * (1.0 + 3.0 * load_factor())
 
     def maybe_kill(t_kill, want_primary: bool):
         """--recovery-kill victim selection: a pure shard holder for
@@ -354,9 +429,10 @@ def main(argv=None) -> None:
         perf_before = perf_snapshot()
         t_start = time.perf_counter()
         t_end = t_start + args.seconds
+        t_hard = hard_cap(t_start)
         t_kill = t_start + args.seconds / 3.0
         i = 0
-        while time.perf_counter() < t_end:
+        while window_open(t_end, t_hard):
             # kill a NON-PRIMARY (pure shard holder): every PG it
             # held a shard for starts an mClock-governed recovery
             # round that now COMPETES with this loop's ops. A
@@ -388,7 +464,7 @@ def main(argv=None) -> None:
         # deadline still counts its real time (keeps write comparable
         # to seq and the MB/s honest)
         dt = time.perf_counter() - t_start
-    else:
+    elif args.workload == "seq":
         # stage a working set, then timed sequential reads
         staged = {}
         for i in range(8):
@@ -400,9 +476,10 @@ def main(argv=None) -> None:
         perf_before = perf_snapshot()
         t_start = t0_all = time.perf_counter()
         t_end = t0_all + args.seconds
+        t_hard = hard_cap(t_start)
         t_kill = t0_all + args.seconds / 3.0
         k = 0
-        while time.perf_counter() < t_end:
+        while window_open(t_end, t_hard):
             # seq + --recovery-kill: kill a PRIMARY — the degraded-
             # read scenario. Reads must keep completing through
             # hedged shard requests + any-k decode, not wait out
@@ -429,6 +506,111 @@ def main(argv=None) -> None:
                     traceback.print_exc()
             k += 1
         dt = time.perf_counter() - t0_all
+    else:
+        # overwrite / append: FIXED-COUNT RMW cells with count-metric
+        # amplification — bytes-on-wire per logical byte written and
+        # shard IOs per op are deterministic counters (the only
+        # trustworthy headline on a loaded 1-core host; the r14
+        # repair-metric discipline applied to the write path), with a
+        # full-stripe-rewrite baseline measured in the SAME run.
+        prof_kv = dict(tok.split("=", 1) for tok in profile.split()
+                       if "=" in tok)
+        prof_k = int(prof_kv.get("k", 4))
+        prof_m = int(prof_kv.get("m", 2))
+        chunk = args.object_size // prof_k if args.object_size \
+            >= prof_k else args.chunk_size
+        staged_names = [f"rmw-{j}" for j in range(args.batch)]
+        for nm in staged_names:
+            wire_client.write({nm: rng.integers(
+                0, 256, args.object_size, np.uint8).tobytes()})
+        # warm the delta programs / native handles outside the counted
+        # window (one op per distinct touched column the loop uses)
+        wire_client.write_at(staged_names[0], 0,
+                             rng.integers(0, 256, args.overwrite_size,
+                                          np.uint8).tobytes())
+        # baseline: full-object rewrite = the full-stripe encode a
+        # 4 KiB change costs WITHOUT the RMW path (k+m shards move)
+        ec0 = ec_totals()
+        for nm in staged_names:
+            wire_client.write({nm: rng.integers(
+                0, 256, args.object_size, np.uint8).tobytes()})
+        ec1 = ec_totals()
+        full_wire = ec1.get("write_wire_bytes", 0) \
+            - ec0.get("write_wire_bytes", 0)
+        full_logical = len(staged_names) * args.object_size
+        # the RMW cell proper
+        perf_before = perf_snapshot()
+        ec2 = ec_totals()
+        t_start = time.perf_counter()
+        stream_i = 0
+        for i in range(args.rmw_ops):
+            nm = staged_names[i % len(staged_names)]
+            t0 = time.perf_counter()
+            if args.workload == "overwrite":
+                # offset pinned inside ONE data column (deterministic
+                # 1-data+m-parity shard IOs): column walks round-robin,
+                # in-chunk offset strides without crossing the chunk
+                col = i % prof_k
+                span = max(1, chunk - args.overwrite_size + 1)
+                in_chunk = (i * 8192) % span
+                off = col * chunk + in_chunk
+                wire_client.write_at(nm, off, rng.integers(
+                    0, 256, args.overwrite_size, np.uint8).tobytes())
+            else:
+                sname = f"stream-{stream_i % max(1, args.batch)}"
+                stream_i += 1
+                wire_client.append(sname, rng.integers(
+                    0, 256, args.overwrite_size, np.uint8).tobytes())
+            dt0 = time.perf_counter() - t0
+            lat.append(dt0)
+            lat_tenant[0].append(dt0)
+            lat_stamp.append(time.perf_counter())
+            nobj += 1
+        dt = time.perf_counter() - t_start
+        ec3 = ec_totals()
+
+        def delta(key):
+            return ec3.get(key, 0) - ec2.get(key, 0)
+        rmw_logical = args.rmw_ops * args.overwrite_size
+        rmw_wire = delta("rmw_wire_bytes")
+        rmw_per_byte = rmw_wire / max(1, rmw_logical)
+        full_per_byte = full_wire / max(1, full_logical)
+        # per-OP comparison: what ONE overwrite ships on the RMW path
+        # vs what the full-stripe encode ships to land the same
+        # logical bytes (one full rewrite per staged object above)
+        rmw_per_op = rmw_wire / max(1, delta("rmw_ops"))
+        full_per_op = full_wire / max(1, len(staged_names))
+        amplification = {
+            "rmw": {
+                "ops": delta("rmw_ops"),
+                "logical_bytes": rmw_logical,
+                "wire_bytes": rmw_wire,
+                "wire_bytes_per_logical_byte": round(rmw_per_byte, 4),
+                "wire_bytes_per_op": round(rmw_per_op, 1),
+                "shard_ios": delta("rmw_shard_ios"),
+                "shard_ios_per_op": round(
+                    delta("rmw_shard_ios")
+                    / max(1, delta("rmw_ops")), 3),
+                "participants_expected": 1 + prof_m,
+                "preread_bytes": delta("rmw_preread_bytes"),
+                "append_fast_ops": delta("rmw_append_fast"),
+                "full_fallbacks": delta("rmw_full_fallbacks"),
+                "journal_entries": delta("journal_entries"),
+                "delta_launches": delta("rmw_delta_launches"),
+            },
+            "full_stripe_baseline": {
+                "logical_bytes": full_logical,
+                "wire_bytes": full_wire,
+                "wire_bytes_per_logical_byte": round(
+                    full_per_byte, 4),
+                "wire_bytes_per_op": round(full_per_op, 1),
+            },
+            # the acceptance headline: bytes-on-wire to land one
+            # overwrite's logical bytes through the RMW path vs
+            # through a full-stripe encode, same run, pure counts
+            "ratio_vs_full_stripe": round(
+                rmw_per_op / max(1e-9, full_per_op), 6),
+        }
 
     from ceph_tpu.utils.perf_counters import dump_delta
     perf_delta = dump_delta(perf_before, perf_snapshot())
@@ -480,6 +662,13 @@ def main(argv=None) -> None:
     }
     if jax_cache_dir is not None:
         out["config"]["jax_compile_cache"] = jax_cache_dir
+    if amplification is not None:
+        # r16: the partial-stripe write cell's count-metric block —
+        # schema pinned by tests/test_bench_schema.py
+        out["amplification"] = amplification
+        out["config"]["rmw_ops"] = args.rmw_ops
+        out["config"]["overwrite_size"] = args.overwrite_size
+        out["config"]["chunk_size"] = args.chunk_size
     if args.transport == "standalone":
         # hedge/degraded accounting + per-tenant percentiles: the
         # degraded-read and per-tenant-QoS acceptance numbers, keyed

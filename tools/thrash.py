@@ -28,7 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def run_cell(seed: int, store: str, rounds: int, ops: int,
              verbose: bool, op_shards: int = 1,
              osd_procs: bool = False,
-             rotate_secrets: bool = False) -> dict:
+             rotate_secrets: bool = False,
+             overwrite_during_faults: bool = False) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     if osd_procs:
         store = "tin"            # children need a real on-disk store
@@ -36,7 +37,8 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
         if store == "tin" else None
     th = Thrasher(seed, store=store, rounds=rounds, ops=ops,
                   store_dir=tmp, verbose=verbose, op_shards=op_shards,
-                  osd_procs=osd_procs, rotate_secrets=rotate_secrets)
+                  osd_procs=osd_procs, rotate_secrets=rotate_secrets,
+                  overwrite_during_faults=overwrite_during_faults)
     try:
         report = th.run()
         report["ok"] = True
@@ -74,6 +76,13 @@ def main() -> int:
                          "round's heal (deterministic — outside the "
                          "seeded action menu, so seed replays are "
                          "unchanged)")
+    ap.add_argument("--overwrite-during-faults", action="store_true",
+                    help="r16: per-round partial-overwrite sweep "
+                         "(write_at) with the faults still live — "
+                         "SIGKILL lands mid-RMW and the stripe "
+                         "journal must replay clean (drawn from a "
+                         "dedicated seeded stream; pinned cells "
+                         "replay unchanged)")
     ap.add_argument("--matrix", type=int, metavar="N",
                     help="run seeds 1..N instead of one --seed")
     ap.add_argument("--repro", action="store_true",
@@ -100,7 +109,8 @@ def main() -> int:
         rep = run_cell(seed, args.store, args.rounds, args.ops,
                        verbose=args.repro, op_shards=args.op_shards,
                        osd_procs=args.osd_procs,
-                       rotate_secrets=args.rotate_secrets)
+                       rotate_secrets=args.rotate_secrets,
+                       overwrite_during_faults=args.overwrite_during_faults)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
