@@ -91,7 +91,8 @@ KNOBS: dict[str, tuple[int, str]] = {
 def repro_command(seed: int, store: str, rounds: int, ops: int,
                   op_shards: int = 1, osd_procs: bool = False,
                   rotate_secrets: bool = False,
-                  overwrite_during_faults: bool = False) -> str:
+                  overwrite_during_faults: bool = False,
+                  transient_fraction: float = 0.0) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
@@ -103,6 +104,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
         cmd += " --rotate-secrets"
     if overwrite_during_faults:
         cmd += " --overwrite-during-faults"
+    if transient_fraction:
+        cmd += f" --transient-fraction {transient_fraction}"
     return cmd
 
 
@@ -125,7 +128,9 @@ class Thrasher:
                  read_during_faults: bool = False,
                  op_shards: int = 1, osd_procs: bool = False,
                  rotate_secrets: bool = False,
-                 overwrite_during_faults: bool = False):
+                 overwrite_during_faults: bool = False,
+                 transient_fraction: float = 0.0,
+                 profile: str | None = None):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -164,9 +169,30 @@ class Thrasher:
         self.overwrite_during_faults = bool(overwrite_during_faults)
         self.rmw_rng = random.Random(self.seed ^ 0x5EED)
         self.rmw_overwrite_checks = 0
+        # r17: transient-vs-real failure mix — a seeded fraction of
+        # extra kills AUTO-REVIVE inside or outside the repair delay
+        # window, exercising the lazy-repair policy under chaos. The
+        # sweep draws from its OWN stream (OUTSIDE the action menu,
+        # like rmw_rng) so pinned cells replay unchanged; victims are
+        # tracked apart from dead_osds so the menu's draws stay
+        # schedule-deterministic. Requires in-process daemons (the
+        # invariant checkers read policy counters from daemon RAM).
+        self.transient_fraction = float(transient_fraction)
+        self.profile = profile
+        self.trans_rng = random.Random(self.seed ^ 0x7AB5)
+        # victim -> (revive deadline, inside_window, quiet_start,
+        #            kill schedule idx, repair-bytes snapshot at kill)
+        self.transient_dead: dict[int, tuple] = {}
+        self.transient_kills = 0
+        self.transient_revives_inside = 0
+        self.transient_noop_checks = 0
+        self.transient_noop_skips = 0
         # deadline scaling, NOT schedule input: the RNG stream never
         # sees it, so a seed replays identically on an idle box
         self.load = load_factor()
+        # wall seconds of the r17 repair delay the transient cells run
+        # under (load-scaled at execution, never an RNG input)
+        self.repair_delay = 5.0 * self.load
         self.rng = random.Random(self.seed)
         # shadow state (the invariant oracles)
         self.shadow: dict[str, bytes] = {}   # name -> last ACKED bytes
@@ -180,7 +206,8 @@ class Thrasher:
             self.seed, self.store, rounds, ops,
             op_shards=self.op_shards, osd_procs=self.osd_procs,
             rotate_secrets=self.rotate_secrets,
-            overwrite_during_faults=self.overwrite_during_faults)
+            overwrite_during_faults=self.overwrite_during_faults,
+            transient_fraction=self.transient_fraction)
         self.c = None
         self.cl = None
 
@@ -218,6 +245,9 @@ class Thrasher:
         secret = bytes(self.rng.randrange(256) for _ in range(32))
         self._log(f"setup n_osds={self.n_osds} pg_num={self.pg_num} "
                   f"store={self.store} cephx+secure on")
+        kwargs = {}
+        if self.profile is not None:
+            kwargs["profile"] = self.profile
         self.c = StandaloneCluster(
             n_osds=self.n_osds, pg_num=self.pg_num, store=self.store,
             store_dir=self.store_dir, cephx=True, secret=secret,
@@ -226,7 +256,7 @@ class Thrasher:
             # a loaded host stretches every ping round trip: scale the
             # grace with the observed load so CPU starvation doesn't
             # read as daemon death (the [41-tin] full-suite flake)
-            hb_grace=1.2 * self.load)
+            hb_grace=1.2 * self.load, **kwargs)
         self.m = self.c.pool_size - self.c.pool_min_size
         self.c.wait_for_clean(timeout=40 * self.load)
         self.cl = self.c.client()
@@ -238,6 +268,16 @@ class Thrasher:
                                timeout=20)
         except TimeoutError as e:
             self._parked("config_set scrub", e)
+        if self.transient_fraction > 0:
+            if self.osd_procs:
+                raise ValueError("transient_fraction needs in-process "
+                                 "daemons (policy counters live in "
+                                 "daemon RAM)")
+            try:
+                self.cl.config_set("osd_repair_delay",
+                                   self.repair_delay, timeout=20)
+            except TimeoutError as e:
+                self._parked("config_set osd_repair_delay", e)
         return self
 
     def teardown(self) -> None:
@@ -310,8 +350,14 @@ class Thrasher:
         self._log(f"remove {name}")
 
     def act_kill_osd(self) -> None:
-        alive = sorted(set(self.c.osd_ids()) - self.dead_osds)
-        if len(self.dead_osds) >= self.m or not alive:
+        # transient victims count against the concurrent-death budget
+        # (data safety) but are DRAWN from their own stream — with
+        # transient_fraction=0 this is bit-identical to the pre-r17
+        # schedule
+        alive = sorted(set(self.c.osd_ids()) - self.dead_osds
+                       - set(self.transient_dead))
+        if len(self.dead_osds) + len(self.transient_dead) >= self.m \
+                or not alive:
             return
         victim = alive[self.rng.randrange(len(alive))]
         self.c.kill_osd(victim)
@@ -337,8 +383,10 @@ class Thrasher:
         """Kill + immediate revive: on TinStore this is a real WAL+
         checkpoint remount under traffic; on MemStore a process
         restart with state kept by fiat."""
-        alive = sorted(set(self.c.osd_ids()) - self.dead_osds)
-        if len(self.dead_osds) >= self.m or not alive:
+        alive = sorted(set(self.c.osd_ids()) - self.dead_osds
+                       - set(self.transient_dead))
+        if len(self.dead_osds) + len(self.transient_dead) >= self.m \
+                or not alive:
             return
         victim = alive[self.rng.randrange(len(alive))]
         self.c.kill_osd(victim)
@@ -385,6 +433,177 @@ class Thrasher:
         # line must stay replay-identical
         self._log(f"deep_scrub pg 1.{ps}")
 
+    # -- transient failures (r17) -------------------------------------------
+
+    _QUIET_PREFIXES = ("inject", "parked", "transient")
+
+    def _live_daemons(self):
+        return [d for d in self.c.osds.values() if not d._stop.is_set()]
+
+    def _repair_bytes(self) -> int:
+        """Cluster-wide repair traffic counter: decode rebuilds +
+        helper pulls + backfill copies (the storm bench's metric)."""
+        return sum(d.ec_perf.get("recovered_bytes")
+                   + d.ec_perf.get("recover_wire_bytes")
+                   + d.perf.get("move_bytes")
+                   for d in self._live_daemons())
+
+    def _policy_counter(self, key: str) -> int:
+        return sum(d.repair_policy.counters.get(key, 0)
+                   for d in self._live_daemons())
+
+    def _transient_sweep(self, round_i: int) -> None:
+        """Seeded transient kills: each victim auto-revives at a drawn
+        fraction of the repair delay — inside the window (the policy
+        must cancel with zero moved bytes) or outside it (the window
+        expires, the rebuild runs, the revive copies back: the eager
+        cost lazy repair avoids for the inside draws). Draw VALUES
+        come from trans_rng only; wall-clock execution (load) never
+        feeds back into any RNG stream."""
+        if self.transient_fraction <= 0:
+            return
+        n = self.trans_rng.randrange(1, 3)
+        for _ in range(n):
+            if self.trans_rng.random() >= self.transient_fraction:
+                continue
+            alive = sorted(set(self.c.osd_ids()) - self.dead_osds
+                           - set(self.transient_dead))
+            if (len(self.dead_osds) + len(self.transient_dead)
+                    >= max(1, self.m - 1)) or not alive:
+                # keep >= 1 spare redundancy so deferral (not the m-1
+                # override) is what these kills exercise
+                continue
+            victim = alive[self.trans_rng.randrange(len(alive))]
+            inside = self.trans_rng.random() < 0.7
+            frac = self.trans_rng.uniform(0.35, 0.6) if inside \
+                else self.trans_rng.uniform(1.3, 1.7)
+            # quiet probe: half the inside draws BLOCK the schedule
+            # until the revive deadline — a guaranteed quiet window,
+            # so invariant (a)'s zero-byte check actually fires under
+            # chaos instead of waiting for the menu to go silent. The
+            # check needs a QUIET START too: background recovery
+            # already in flight (an injection-suspected peer's
+            # catch-up) would move bytes the victim never caused.
+            probe = inside and self.trans_rng.random() < 0.5
+            b0 = self._repair_bytes()
+            base = (self._policy_counter("repair_urgent_overrides"),
+                    self._policy_counter("repair_deferred_confirmed"))
+            quiet_start = (not self.dead_osds and not self.dead_mons
+                           and not self.transient_dead and all(
+                               not d._recovering
+                               and not d.repair_policy.parked
+                               and not d.suspect
+                               for d in self._live_daemons()))
+            self.c.kill_osd(victim)
+            deadline = time.monotonic() + frac * self.repair_delay
+            self.transient_dead[victim] = (
+                deadline, inside, quiet_start, len(self.schedule),
+                b0, base)
+            self.transient_kills += 1
+            self._log(f"transient kill osd.{victim} "
+                      f"({'inside' if inside else 'outside'} window, "
+                      f"revive at {frac:.2f}x delay"
+                      f"{', quiet probe' if probe else ''})")
+            if probe:
+                while time.monotonic() < deadline:
+                    time.sleep(0.1)
+                self._tick_transients()
+
+    def _tick_transients(self, final: bool = False) -> None:
+        """Revive due transient victims; `final` (the heal) waits out
+        and revives everything still pending. An inside-window revive
+        whose down-window was QUIET (no other fault or client
+        mutation in the schedule since the kill) runs invariant (a):
+        the policy must cancel the parked rebuild on a cursor
+        re-check alone — ZERO repair bytes moved."""
+        if not self.transient_dead:
+            return
+        now = time.monotonic()
+        for victim in sorted(self.transient_dead):
+            deadline, inside, quiet_start, kill_idx, b0, base = \
+                self.transient_dead[victim]
+            if not final and now < deadline:
+                continue
+            if final and now < deadline:
+                # the heal waits the window out so outside-window
+                # draws really see their deferral expire (bounded:
+                # draws cap at 1.7x delay)
+                time.sleep(min(max(0.0, deadline - now),
+                               2.0 * self.repair_delay))
+            del self.transient_dead[victim]
+            quiet = quiet_start and all(
+                line.startswith(self._QUIET_PREFIXES)
+                for line in self.schedule[kill_idx + 1:])
+            self.c.revive_osd(victim)
+            if inside:
+                self.transient_revives_inside += 1
+            self._log(f"transient revive osd.{victim} "
+                      f"({'inside' if inside else 'outside'} window, "
+                      f"quiet={quiet})")
+            if inside and quiet:
+                self._check_inside_revive_noop(victim, b0, base)
+            now = time.monotonic()
+
+    def _check_inside_revive_noop(self, victim: int, b0: int,
+                                  base: tuple) -> None:
+        """Invariant (a): a within-window revive of a quiet PG set
+        moves NO repair bytes — the cancel is a cursor/version
+        re-check. Waits (load-scaled) for the cancel to land, then
+        compares the cluster repair-bytes counter to the at-kill
+        snapshot."""
+        deadline = time.monotonic() + 10.0 * self.load
+        while time.monotonic() < deadline:
+            parked = any(victim in ent["dead"]
+                         for d in self._live_daemons()
+                         for ent in d.repair_policy.parked.values())
+            if not parked and all(
+                    d.osdmap is not None and d.osdmap.osd_up[victim]
+                    for d in self._live_daemons()):
+                break
+            time.sleep(0.1)
+        time.sleep(0.3 * self.load)      # let an (illegal) rebuild
+        b1 = self._repair_bytes()        # actually show up
+        # a spurious down-mark of ANOTHER osd during the window (load
+        # + injection stretching heartbeats) can legitimately move
+        # bytes: a second loss fires the m-1 override, or an expired
+        # window confirms. Those are the policy WORKING — skip the
+        # zero-byte claim, don't fail it.
+        overrides = (self._policy_counter("repair_urgent_overrides"),
+                     self._policy_counter("repair_deferred_confirmed"))
+        if overrides != base:
+            self.transient_noop_skips += 1
+            self._log(f"transient noop check osd.{victim}: skipped "
+                      f"(concurrent override/confirm)")
+            return
+        if b1 != b0:
+            self._violate(
+                f"transient revive of osd.{victim} inside the repair "
+                f"window moved {b1 - b0} repair bytes over a quiet "
+                f"window (lazy repair must cancel with a cursor "
+                f"re-check only)")
+        self.transient_noop_checks += 1
+        self._log(f"transient noop check osd.{victim}: 0 bytes ok")
+
+    def _check_policy_invariants(self, round_i: int) -> None:
+        """Invariant (b): no stripe waits at m-1 while the queue holds
+        healthier stripes — structurally, the policy never PARKS an
+        at-risk stripe (repair_urgent_parked == 0) and never ships a
+        risk-inverted queue under risk order (repair_risk_inversions
+        == 0). Asserted every heal, transient mode or not."""
+        parked = self._policy_counter("repair_urgent_parked")
+        if parked:
+            self._violate(f"round {round_i}: {parked} at-m-1 "
+                          f"stripe(s) were parked behind the repair "
+                          f"delay")
+        live = self._live_daemons()
+        order = str(live[0].config["osd_repair_queue_order"]) \
+            if live else "risk"
+        inv = self._policy_counter("repair_risk_inversions")
+        if inv and order == "risk":
+            self._violate(f"round {round_i}: {inv} risk "
+                          f"inversion(s) in the rebuild queue under "
+                          f"risk order")
+
     # -- the schedule --------------------------------------------------------
 
     def _menu(self):
@@ -404,9 +623,11 @@ class Thrasher:
             menu = self._menu()
             for round_i in range(self.rounds):
                 self.act_write()     # every round has data on the line
+                self._transient_sweep(round_i)
                 for _ in range(self.ops):
                     menu[self.rng.randrange(len(menu))]()
                     time.sleep(0.15)
+                    self._tick_transients()
                 if self.overwrite_during_faults:
                     self._overwrite_sweep_during_faults(round_i)
                 if self.read_during_faults:
@@ -485,6 +706,9 @@ class Thrasher:
                       f"[{off},{off + len(patch)})")
 
     def _heal_and_check(self, round_i: int) -> None:
+        # transient victims first: the heal waits their windows out so
+        # outside-window draws exercise the expire->rebuild path
+        self._tick_transients(final=True)
         for r in sorted(self.dead_mons):
             self.c.revive_mon(r)
         self.dead_mons.clear()
@@ -531,6 +755,10 @@ class Thrasher:
                     f"errored oddly ({e})")
             self._violate(f"round {round_i}: removed object "
                           f"{name!r} resurrected")
+        # r17 policy invariants hold after every heal (transient mode
+        # or not; counters are 0 when the policy never engaged)
+        if not self.osd_procs:
+            self._check_policy_invariants(round_i)
 
     def _final_report(self, elapsed: float) -> dict:
         return {
@@ -542,6 +770,16 @@ class Thrasher:
             "unknown_fate": len(self.unknown),
             "degraded_read_checks": self.degraded_read_checks,
             "rmw_overwrite_checks": self.rmw_overwrite_checks,
+            "transient_kills": self.transient_kills,
+            "transient_revives_inside": self.transient_revives_inside,
+            "transient_noop_checks": self.transient_noop_checks,
+            "transient_noop_skips": self.transient_noop_skips,
+            "repair_deferred_stripes":
+                self._policy_counter("repair_deferred_stripes")
+                if self.c is not None and not self.osd_procs else 0,
+            "repair_deferred_cancelled":
+                self._policy_counter("repair_deferred_cancelled")
+                if self.c is not None and not self.osd_procs else 0,
             "schedule_len": len(self.schedule),
             "elapsed_s": round(elapsed, 2),
             "repro": self.repro,

@@ -225,6 +225,39 @@ class CrushMap:
             return 0
         return self.buckets[item].type_id
 
+    def parent_of(self, item: int) -> int | None:
+        """The bucket directly containing `item` (device or bucket),
+        or None at the root. Reverse map built lazily and rebuilt
+        whenever the bucket set changed — topology edits are rare,
+        lookups ride every repair-budget grant."""
+        cache = getattr(self, "_parent_cache", None)
+        if cache is None or cache[0] != len(self.buckets):
+            parents: dict[int, int] = {}
+            for bid, b in self.buckets.items():
+                for it in b.items:
+                    parents[it] = bid
+            cache = (len(self.buckets), parents)
+            self._parent_cache = cache
+        return cache[1].get(item)
+
+    def domain_of(self, item: int, type_id: int = 2) -> int:
+        """The ancestor bucket of `type_id` (rack by default — the
+        failure-domain key the repair bandwidth budgets bucket by).
+        Falls back to the highest ancestor found when the hierarchy
+        has no bucket of that type (flat test maps: everything shares
+        one domain, budgets degrade to a single global bucket)."""
+        cur = item
+        seen = 0
+        while seen < 64:                # cycle guard
+            parent = self.parent_of(cur)
+            if parent is None:
+                return cur if cur < 0 else 0
+            if self.buckets[parent].type_id == type_id:
+                return parent
+            cur = parent
+            seen += 1
+        return cur
+
     @property
     def n_devices(self) -> int:
         return self.max_device + 1

@@ -76,6 +76,18 @@ def health_checks(osdmap=None, quorum: list[int] | None = None,
                 "PG_DEGRADED", HEALTH_WARN,
                 f"{len(degraded)} pgs degraded",
                 [f"pg {pg} is {states[pg]}" for pg in degraded]))
+        # PG_EXPOSED (r17): a PG at m-1 surviving redundancy — one
+        # more failure loses data. Louder than plain degradation (the
+        # repair policy's m-1 override is already rebuilding these
+        # first; the check is the operator-visible exposure window)
+        exposed = sorted(pg for pg, st in states.items()
+                         if "exposed" in st)
+        if exposed:
+            checks.append(_check(
+                "PG_EXPOSED", HEALTH_WARN,
+                f"{len(exposed)} pgs at m-1 redundancy (one more "
+                f"failure loses data)",
+                [f"pg {pg} is {states[pg]}" for pg in exposed]))
         peering = sorted(pg for pg, st in states.items()
                          if "peering" in st or "needs_up_thru" in st)
         if peering:

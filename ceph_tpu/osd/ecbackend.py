@@ -127,6 +127,16 @@ def ec_perf_counters():
             .add_u64_counter("rmw_preread_bytes",
                              "pre-image bytes read for delta "
                              "construction (zero on the append path)")
+            .add_u64_counter("rmw_fetch_waves",
+                             "combined RMW prepare-fetch waves (one "
+                             "per delta group: hinfo attrs + pre-"
+                             "image ranges gathered in a single "
+                             "overlapped round trip)")
+            .add_u64_counter("rmw_fetch_frames",
+                             "prepare-fetch frames issued (one per "
+                             "participant shard per wave — the 1+m "
+                             "sequential getattrs + per-span reads "
+                             "these replaced counted 1 frame each)")
             .add_u64_counter("rmw_shard_ios",
                              "participating shards per RMW op, summed "
                              "(the shard-IO amplification counter: "
@@ -913,6 +923,103 @@ class ECBackend(PGBackend):
             out[s] = hinfo.get_chunk_hash(0)
         return out
 
+    def _rmw_participants(self, touched: tuple, osl: int,
+                          nsl: int) -> list[int]:
+        """Participant shard slots of one delta job: the touched data
+        columns + every parity slot; growth (nsl != osl) adds the
+        rest with payload-free entries (zero-extension + hinfo
+        shift). ONE derivation shared by the prepare fetch and the
+        commit fan-out so the two cannot drift."""
+        parts = ([self.data_slots[c] for c in touched]
+                 + [self.chunk_mapping[self.k + j]
+                    for j in range(self.m)])
+        if nsl != osl:
+            parts = parts + [s for s in range(self.n)
+                             if s not in set(parts)]
+        return parts
+
+    def _rmw_prefetch(self, touched: tuple, group):
+        """One pipelined wave of combined (hinfo attr + pre-image
+        sub-range) fetches for a whole delta group — r16's prepare
+        phase paid 1+m tiny sequential getattrs plus one read RTT per
+        touched span per job; this pays ONE overlapped frame per
+        participant shard for the group (RemoteStore.rmw_fetch_submit;
+        in-process stores take the direct path, same accounting).
+
+        Returns (old_crcs, prereads) aligned with `group`:
+        old_crcs[i] = {slot: stored hinfo crc} or None when any
+        participant's hinfo refuses the incremental base (the job
+        then reroutes through the full path, exactly like
+        _shard_old_crcs); prereads[i] = {(slot, off, len): bytes}
+        for every touched sub-range below the old tail."""
+        per_slot: dict[int, list[tuple[str, list]]] = {}
+        parts_of: list[list[int]] = []
+        ranges_of: list[dict[int, list]] = []
+        for job in group:
+            name, _w, old_size, _ns, osl, nsl, _t, spans, _a, _b = job
+            parts = self._rmw_participants(touched, osl, nsl)
+            parts_of.append(parts)
+            need: dict[int, list] = {}
+            for col, c0, ln, lo in spans:
+                if lo < old_size:
+                    need.setdefault(self.data_slots[col],
+                                    []).append((c0, ln))
+            ranges_of.append(need)
+            for s in parts:
+                per_slot.setdefault(s, []).append(
+                    (name, need.get(s, [])))
+        handles: list[tuple[int, object]] = []
+        results: dict[int, list] = {}
+        for s, items in sorted(per_slot.items()):
+            st = self._store(s)
+            cid = shard_cid(self.pg, s)
+            sub = getattr(st, "rmw_fetch_submit", None)
+            if sub is not None:
+                handles.append((s, sub(cid, HINFO_KEY, items)))
+                continue
+            out = []
+            for name, ranges in items:
+                try:
+                    attr, ok = st.getattr(cid, name, HINFO_KEY), True
+                except KeyError:
+                    attr, ok = b"", False
+                out.append((ok, attr,
+                            [np.asarray(st.read(cid, name, off, ln),
+                                        np.uint8).tobytes()
+                             for off, ln in ranges]))
+            results[s] = out
+        for s, h in handles:
+            results[s] = h.result()
+        self.perf.inc_many((("rmw_fetch_waves", 1),
+                            ("rmw_fetch_frames", len(per_slot))))
+        cursor = {s: 0 for s in per_slot}
+        old_crcs: list[dict | None] = []
+        prereads: list[dict] = []
+        for ji, job in enumerate(group):
+            osl = job[4]
+            crcs: dict[int, int] | None = {}
+            pre: dict = {}
+            for s in parts_of[ji]:
+                ok, attr, rows = results[s][cursor[s]]
+                cursor[s] += 1
+                if crcs is not None:
+                    hinfo = None
+                    if ok:
+                        try:
+                            hinfo = HashInfo.from_bytes(attr)
+                        except Exception:   # noqa: BLE001 — odd
+                            hinfo = None    # stored attr: refuse
+                    if hinfo is None or hinfo.total_chunk_size != osl:
+                        crcs = None
+                    else:
+                        crcs[s] = hinfo.get_chunk_hash(0)
+                for (off, ln), blob in zip(ranges_of[ji].get(s, []),
+                                           rows):
+                    pre[(s, off, ln)] = np.frombuffer(blob, np.uint8)
+            old_crcs.append(crcs)
+            prereads.append(pre)
+        return old_crcs, prereads
+
     def _write_ranges_delta(self, jobs) -> None:
         """Execute delta-eligible RMW jobs: build the delta rows
         (reading only the touched sub-ranges' pre-image — none at all
@@ -936,6 +1043,12 @@ class ECBackend(PGBackend):
         deltas = np.zeros((B, t, wl), np.uint8)
         append_fast = 0
         preread = 0
+        # r17: ONE overlapped prepare-fetch wave for the whole group —
+        # hinfo attrs (the incremental-update base _delta_commit
+        # verifies) and pre-image sub-ranges arrive together, one
+        # frame per participant shard instead of 1+m sequential
+        # getattrs + a read RTT per span per job
+        old_crcs, prereads = self._rmw_prefetch(touched, group)
         for bi, job in enumerate(group):
             name, writes, old_size, _ns, osl, _nsl, _t, spans, a, _b \
                 = job
@@ -951,10 +1064,8 @@ class ECBackend(PGBackend):
                     # the layout rule — no read phase
                     row[c0 - a:c0 - a + ln] = newb
                     continue
-                st = self._store(self.data_slots[col])
-                cid = shard_cid(self.pg, self.data_slots[col])
+                got = prereads[bi][(self.data_slots[col], c0, ln)]
                 oldb = np.zeros(ln, np.uint8)
-                got = st.read(cid, name, c0, ln)
                 oldb[:len(got)] = got
                 preread += ln
                 row[c0 - a:c0 - a + ln] = np.asarray(newb) ^ oldb
@@ -964,14 +1075,19 @@ class ECBackend(PGBackend):
         self.perf.inc_many((("rmw_preread_bytes", preread),
                             ("rmw_append_fast", append_fast)))
         self._delta_commit(touched, wl, group, deltas, parity, crcs,
-                           parity_slots)
+                           parity_slots, old_crcs=old_crcs)
 
     def _delta_commit(self, touched: tuple, wl: int, group,
-                      deltas, parity, crcs, parity_slots) -> None:
+                      deltas, parity, crcs, parity_slots,
+                      old_crcs: list | None = None) -> None:
         """The journaled two-phase shard update of one delta batch:
         intent entries (delta payload + new hinfo) durably on every
         participating shard, then the atomic per-shard apply (XOR +
-        hinfo + watermark bump + entry drop in ONE transaction)."""
+        hinfo + watermark bump + entry drop in ONE transaction).
+        `old_crcs` carries the prefetched per-job hinfo bases from
+        _rmw_prefetch (None entries reroute through the full path);
+        absent, the per-job sync getattr loop serves (bare-backend
+        callers)."""
         t = len(touched)
         hook = self._rmw_crash_hook
         # per job: rows per slot, new crcs per slot, participants
@@ -983,14 +1099,11 @@ class ECBackend(PGBackend):
         keys_of: dict[int, list[bytes]] = {}
         for bi, job in enumerate(group):
             name, _w, _os, new_size, osl, nsl, _t, _sp, a, b = job
-            parts = ([self.data_slots[c] for c in touched]
-                     + list(parity_slots))
-            if nsl != osl:
-                # growth touches every shard (zero-extension + hinfo
-                # shift) — the others ride payload-free entries
-                parts = parts + [s for s in range(self.n)
-                                 if s not in set(parts)]
-            old = self._shard_old_crcs(name, parts)
+            # growth touches every shard (zero-extension + hinfo
+            # shift) — the others ride payload-free entries
+            parts = self._rmw_participants(touched, osl, nsl)
+            old = old_crcs[bi] if old_crcs is not None \
+                else self._shard_old_crcs(name, parts)
             if old is None:
                 # stored hinfo refuses the incremental base: reroute
                 # this job through the full path (rare — e.g. a
@@ -1616,11 +1729,85 @@ class ECBackend(PGBackend):
 
     # -- deep scrub ----------------------------------------------------------
 
+    def _scrub_journal(self, live_slots: list[int]) -> dict:
+        """Journal-aware deep scrub (r17): audit pending
+        __stripe_journal__ intents instead of skipping the collection
+        with the other "__" internals. Per live slot, every entry must
+        decode (codec version 1), agree with its omap key, name this
+        slot as a participant, fit its own geometry (the delta payload
+        inside the new shard length), and sit ABOVE the slot's applied
+        watermark (an entry at-or-below the watermark was applied but
+        never dropped — the apply txn is atomic, so that's store
+        corruption, not lag). Intents a later write superseded are
+        counted stale — inert by the replay's version guard, not
+        corrupt. Findings stay OUT of the `inconsistent` list: a
+        pending intent is crash-recovery state, and auto_repair's
+        decode-rebuild must never chew on the journal object."""
+        pending = stale = 0
+        bad: list[tuple[int, str]] = []      # (slot, why)
+        for s in live_slots:
+            store = self._store(s)
+            cid = shard_cid(self.pg, s)
+            try:
+                if not store.exists(cid, self.JOURNAL_OBJ):
+                    continue
+                page = store.omap_iter(cid, self.JOURNAL_OBJ)
+            except (ConnectionError, OSError, KeyError):
+                continue                      # unreachable: lag excuse
+            watermark = None
+            entries: list[tuple[bytes, bytes]] = []
+            for key, val in page:
+                if key == self._J_APPLIED:
+                    if len(val) == 8:
+                        watermark = _struct.unpack("<Q", val)[0]
+                    else:
+                        bad.append((s, "watermark not 8 bytes"))
+                elif key.startswith(b"e"):
+                    entries.append((key, val))
+                else:
+                    bad.append((s, f"unknown journal key {key!r}"))
+            for key, val in entries:
+                try:
+                    ent = self._decode_jentry(val)
+                except Exception as e:   # noqa: BLE001 — ANY decode
+                    # failure is the corruption this audit exists for
+                    bad.append((s, f"undecodable intent {key!r}: "
+                                   f"{type(e).__name__}"))
+                    continue
+                if self._jkey(ent["seq"]) != key:
+                    bad.append((s, f"intent seq {ent['seq']} "
+                                   f"disagrees with key {key!r}"))
+                    continue
+                if s not in ent["participants"]:
+                    bad.append((s, f"intent seq {ent['seq']} does "
+                                   f"not name slot {s} a participant"))
+                    continue
+                if ent["delta"] and \
+                        ent["a"] + len(ent["delta"]) > ent["nsl"]:
+                    bad.append((s, f"intent seq {ent['seq']} delta "
+                                   f"overruns shard length "
+                                   f"{ent['nsl']}"))
+                    continue
+                if watermark is not None and ent["seq"] <= watermark:
+                    bad.append((s, f"intent seq {ent['seq']} at or "
+                                   f"below applied watermark "
+                                   f"{watermark} (apply is atomic "
+                                   f"with the entry drop)"))
+                    continue
+                if ent["version"] <= self.object_versions.get(
+                        ent["name"], 0):
+                    stale += 1                # superseded: inert
+                else:
+                    pending += 1              # legitimate in-flight
+        return {"journal_pending": pending, "journal_stale": stale,
+                "journal_bad": bad}
+
     def deep_scrub(self, dead_osds: set[int] | None = None) -> dict:
         """Read every LIVE shard of every object, verify stored hinfo
         CRCs (the be_deep_scrub bulk-checksum audit), batched per
         shard. Dead slots are skipped — even touching their stores
-        would resurrect destroyed OSD ids."""
+        would resurrect destroyed OSD ids. The per-PG stripe journal
+        is audited too (see _scrub_journal) instead of skipped."""
         from ..csum.kernels import crc32c_blocks
         dead = dead_osds or set()
         bad: list[tuple[str, int]] = []
@@ -1655,7 +1842,10 @@ class ECBackend(PGBackend):
                     checked += 1
                     if hinfo.get_chunk_hash(0) != int(crcs[bi]):
                         bad.append((n, s))
-        return {"checked": checked, "inconsistent": bad}
+        rep = {"checked": checked, "inconsistent": bad}
+        rep.update(self._scrub_journal(
+            [s for s in range(self.n) if self.acting[s] not in dead]))
+        return rep
 
 
 # -- cross-PG recovery engine -------------------------------------------------
@@ -2026,6 +2216,22 @@ class RecoveryRunner:
             sl, pairs = self._pending[0][0], self._pending[0][2]
             return sl * len(pairs)
         return 1
+
+    def next_helper_osds(self) -> list[int]:
+        """Distinct source OSD ids the NEXT batch pulls helper rows
+        from — the key set the r17 per-failure-domain repair budgets
+        bucket next_cost()'s bytes by. Empty when the pipeline is
+        drained or the next step is a completion (no new reads)."""
+        if self._bi >= len(self._batches):
+            return []
+        kind, plan, _sl, payload = self._batches[self._bi]
+        plans = [plan] if kind == "generic" else \
+            list({id(p): p for p, _n in payload}.values())
+        out: set[int] = set()
+        for p in plans:
+            for h in p.helper:
+                out.add(int(p.be.acting[h]))
+        return sorted(out)
 
     # -- pipeline ----------------------------------------------------------
 

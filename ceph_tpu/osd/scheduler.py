@@ -108,6 +108,109 @@ def parse_profile_table(spec: str) -> dict[str, ClientProfile]:
     return out
 
 
+class TokenBucket:
+    """Clock-agnostic token bucket (rate units/s, burst capacity).
+    `take(cost, now)` returns 0.0 when the tokens were granted, else
+    the seconds until `cost` tokens will exist — the caller defers
+    that long instead of busy-polling. Like the mClock tags, `now` is
+    the caller's clock, so SimCluster/scale_sim drive it in virtual
+    time and the wire tier in wall time."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp", "granted",
+                 "throttled")
+
+    def __init__(self, rate: float, burst: float,
+                 now: float = 0.0):
+        if rate <= 0 or burst <= 0:
+            raise ValueError(f"rate {rate} / burst {burst} must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)      # start full: the first burst
+        #                                 after an idle period is free
+        self.stamp = float(now)
+        self.granted = 0.0
+        self.throttled = 0
+
+    def _refill(self, now: float) -> None:
+        if now > self.stamp:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.stamp)
+                              * self.rate)
+        self.stamp = max(self.stamp, now)
+
+    def take(self, cost: float, now: float) -> float:
+        """Grant `cost` tokens (0.0) or the wait until they refill.
+        Costs above the burst still clear — the bucket goes negative
+        ONCE and the debt repays at `rate` (one oversized recovery
+        batch must throttle the NEXT grant, not deadlock forever)."""
+        self._refill(now)
+        if self.tokens >= cost or self.tokens >= self.burst:
+            self.tokens -= cost
+            self.granted += cost
+            return 0.0
+        self.throttled += 1
+        return (cost - self.tokens) / self.rate
+
+    def retune(self, rate: float, burst: float) -> None:
+        """Live budget change: tokens clamp into the new burst."""
+        if rate <= 0 or burst <= 0:
+            raise ValueError(f"rate {rate} / burst {burst} must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = min(self.tokens, self.burst)
+
+    def dump(self) -> dict:
+        return {"rate": self.rate, "burst": self.burst,
+                "tokens": round(self.tokens, 1),
+                "granted": round(self.granted, 1),
+                "throttled": self.throttled}
+
+
+class DomainBudgets:
+    """Per-failure-domain repair bandwidth budgets: one TokenBucket
+    per CRUSH domain (rack by default), created lazily on first grant.
+    Buckets are INDEPENDENT — domain A draining to zero never delays a
+    grant whose helpers live in domain B (the starvation-freedom
+    property the repair-policy tests pin). Rate/burst re-resolve on
+    every request so a committed `config set
+    osd_repair_domain_budget_mbps` retunes live buckets in place."""
+
+    def __init__(self):
+        self._buckets: dict = {}
+
+    def request(self, domain_bytes: "dict[object, float]", rate: float,
+                burst: float, now: float) -> float:
+        """Draw `domain_bytes[d]` bytes from every involved domain's
+        bucket. Returns 0.0 when every domain granted, else the
+        longest wait among the refusing domains — and REFUNDS the
+        domains that did grant (an all-or-nothing draw, so a
+        two-domain pull cannot leak tokens it never used)."""
+        taken: list[tuple[TokenBucket, float]] = []
+        wait = 0.0
+        for dom, nbytes in domain_bytes.items():
+            b = self._buckets.get(dom)
+            if b is None:
+                b = self._buckets[dom] = TokenBucket(rate, burst,
+                                                     now=now)
+            elif b.rate != rate or b.burst != burst:
+                b.retune(rate, burst)
+            w = b.take(float(nbytes), now)
+            if w > 0.0:
+                wait = max(wait, w)
+            else:
+                taken.append((b, float(nbytes)))
+        if wait > 0.0:
+            for b, nbytes in taken:
+                b.tokens = min(b.burst, b.tokens + nbytes)
+                b.granted -= nbytes
+        return wait
+
+    def dump(self) -> dict:
+        return {str(d): b.dump()
+                for d, b in sorted(self._buckets.items(),
+                                   key=lambda kv: str(kv[0]))}
+
+
 class _ClassQueue:
     __slots__ = ("profile", "items", "r_prev", "l_prev", "p_prev",
                  "busy", "served", "served_cost")

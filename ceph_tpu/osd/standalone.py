@@ -96,6 +96,7 @@ declare_span_names(
     "client.op", "client.hedge",
     "osd.queue", "osd.op", "osd.subop", "store.apply",
     "osd.recovery_round",
+    "osd.repair_policy", "osd.repair_throttle",
     "msgr.seal",
     "ecbackend.write.encode", "ecbackend.read.decode",
     "ecbackend.recover.stage", "ecbackend.recover.launch",
@@ -880,6 +881,20 @@ class _ReadvRangesOp:
         return data, (crcs if self._want_crcs else None), bad
 
 
+class _RmwFetchOp:
+    """In-flight combined RMW prepare fetch: result() -> per item
+    (attr_present, attr bytes, [range bytes]), same error surface as
+    _AsyncStoreOp (incl. the one cephx re-authorize retry)."""
+
+    def __init__(self, rs: "RemoteStore", body: bytes):
+        self._op = _AsyncStoreOp(rs, "rmw_fetch", body)
+
+    def result(self) -> list[tuple[bool, bytes, list[bytes]]]:
+        d = Decoder(self._op.result())
+        return d.list(lambda dd: (dd.boolean(), dd.blob(),
+                                  dd.list(Decoder.blob)))
+
+
 class RemoteStore:
     """ObjectStore proxy: the MOSDECSubOpWrite/Read role. Every method
     is one MStoreOp frame to the OSD owning the physical store."""
@@ -985,6 +1000,24 @@ class RemoteStore:
                         .list(list(oids), Encoder.string))
         return _ReadvRangesOp(self, body, attr_key is not None)
 
+    def rmw_fetch_submit(self, cid: str, attr_key: str,
+                         items) -> "_RmwFetchOp":
+        """Pipelined combined RMW prepare fetch (r17): ONE frame per
+        participant shard carries, for every delta job in the wave,
+        the hinfo attr probe AND the touched pre-image sub-ranges —
+        collapsing the 1+m tiny sequential getattrs plus per-span
+        pre-reads that used to precede every partial-stripe fan-out
+        into one overlapped round trip per shard.
+        items: [(name, [(off, len), ...])] — ranges may be empty
+        (attr-only probe: parity shards and growth participants)."""
+        def enc(e: Encoder) -> None:
+            e.string(attr_key)
+            e.list(list(items), lambda en, it: (
+                en.string(it[0])
+                .list([(int(o), int(ln)) for o, ln in it[1]],
+                      lambda e2, r: e2.i64(r[0]).i64(r[1]))))
+        return _RmwFetchOp(self, self._co(cid, "", enc))
+
     def stat(self, cid: str, oid: str) -> int:
         return Decoder(self._call("stat", self._co(cid, oid))).i64()
 
@@ -1080,13 +1113,24 @@ class _RecoveryRound:
             self.dead |= dead
         cfg = daemon.config
         max_active = int(cfg["osd_recovery_max_active"])
+        # r17: the integrity mode resolves through config (auto keeps
+        # the pre-r17 native-detect; 'device' forces the fused
+        # decode+fold on-device; 'host' asserts the native crc path
+        # when the lib is present — the storm bench verifies rebuilt
+        # bytes against the full-decode oracle in both modes)
+        from .ecbackend import _host_crc_available
+        integ = str(cfg["osd_recovery_integrity"]).lower()
+        host_crc = (False if integ == "device"
+                    else True if integ == "host"
+                    and _host_crc_available() else None)
         self.runner = RecoveryRunner(
             [plan for _ps, plan, _dead in entries],
             batch=int(cfg["osd_recovery_batch"]),
             perf=daemon.ec_perf,
             push_window_ops=max_active,
             push_window_bytes=max_active
-            * int(cfg["osd_recovery_max_chunk"]))
+            * int(cfg["osd_recovery_max_chunk"]),
+            host_crc=host_crc)
         self.failed = False
         # r15: recovery rounds get their own sampled trace context
         # (rate-gated) — every fused batch then records its stage/
@@ -1131,8 +1175,52 @@ class _RecoveryRound:
                             pgs=sorted(self.plans)):
                 self._grant()
 
+    def _domain_throttle(self) -> float:
+        """r17 per-failure-domain repair budget: the next batch's
+        helper bytes draw from token buckets keyed by each helper's
+        CRUSH rack. Returns 0.0 (granted) or the seconds to defer —
+        the grant re-queues instead of executing, so enforcement rides
+        the existing mClock background_recovery path and one rack's
+        burst cannot saturate another rack's uplinks. Budgets resolve
+        through config at every grant (live retune)."""
+        d = self.d
+        mbps = float(d.config["osd_repair_domain_budget_mbps"])
+        if mbps <= 0 or d.osdmap is None:
+            return 0.0
+        helpers = self.runner.next_helper_osds()
+        if not helpers:
+            return 0.0
+        nbytes = float(self.runner.next_cost())
+        crush = d.osdmap.crush
+        share = nbytes / len(helpers)
+        domain_bytes: dict = {}
+        for o in helpers:
+            dom = crush.domain_of(int(o))
+            domain_bytes[dom] = domain_bytes.get(dom, 0.0) + share
+        wait = d.domain_budgets.request(
+            domain_bytes, mbps * 1e6,
+            float(d.config["osd_repair_domain_burst_mb"]) * 1e6,
+            time.monotonic())
+        if wait > 0.0:
+            d.repair_policy._count("repair_domain_throttles")
+            from ..utils.flight_recorder import trace_span
+            with trace_span("osd.repair_throttle",
+                            wait_ms=int(wait * 1000),
+                            domains=len(domain_bytes)):
+                pass
+        return wait
+
     def _grant(self) -> None:
         d = self.d
+        wait = self._domain_throttle()
+        if wait > 0.0:
+            # out of domain tokens: yield the shard worker and come
+            # back when the bucket has refilled (bounded nap so a
+            # live budget raise is picked up promptly)
+            t = threading.Timer(min(wait, 0.5), self._requeue)
+            t.daemon = True
+            t.start()
+            return
         # the daemon lock plus EVERY member PG's lock (ascending —
         # the one global order): a fused batch may touch any plan's
         # PG, and client ops on other shards hold only pg locks now
@@ -1175,15 +1263,20 @@ class _RecoveryRound:
     def _settle_locked(self) -> None:
         d = self.d
         d.suspect -= self.dead
+        now_m = time.monotonic()
         for ps, _plan, _dead in self.entries:
             if d._recovering.get(ps) is self:
                 d._recovering.pop(ps, None)
+            # r17 exposure accounting: the stripe left m-1 when its
+            # rebuild landed — close its time-at-m-1 interval
+            d.repair_policy.note_exposure(ps, False, now=now_m)
             try:
                 d._persist_meta(ps)
             except (ConnectionError, OSError, KeyError) as e:
                 d.c.log(f"{d.name}: pg 1.{ps} post-recovery persist "
                         f"deferred: {e}")
         d.perf.inc("recovery_rounds")
+        d._note_repair_gauges()
 
 
 class _OpShard:
@@ -1417,6 +1510,18 @@ class OSDDaemon:
         self._pg_locks: dict[int, threading.RLock] = {}
         self._pg_locks_guard = threading.Lock()
         self._recovering: dict[int, "_RecoveryRound"] = {}
+        # r17 repair policy plane: per-peer DownClocks + parked
+        # rebuilds + exposure accounting, and the per-failure-domain
+        # repair token buckets. Built per boot (in-RAM policy state
+        # dies with the process — a restarted primary is eager about
+        # peers whose down window it cannot date; see
+        # RepairPolicy.observe_map).
+        from .repairpolicy import RepairPolicy
+        from .scheduler import DomainBudgets
+        self.repair_policy = RepairPolicy(config=self.config,
+                                          perf=self.perf,
+                                          now_fn=time.monotonic)
+        self.domain_budgets = DomainBudgets()
         for sh in self.op_shards:
             sh.start()
         from ..utils.admin_socket import AdminSocket
@@ -1693,8 +1798,8 @@ class OSDDaemon:
     # -- store service (the SubOp executor) ---------------------------------
 
     _STORE_READ_KINDS = frozenset(
-        {"read", "readv", "readv_ranges", "stat", "getattr", "exists",
-         "ls", "omap_get", "omap_iter"})
+        {"read", "readv", "readv_ranges", "rmw_fetch", "stat",
+         "getattr", "exists", "ls", "omap_get", "omap_iter"})
 
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
         # the store plane is ticket-gated exactly like the client op
@@ -1799,6 +1904,30 @@ class OSDDaemon:
             e.list([int(c) for c in crcs] if crcs is not None else [],
                    Encoder.u32)
             e.list([int(b) for b in bad], Encoder.u32)
+            return e.bytes()
+        if kind == "rmw_fetch":
+            # combined RMW prepare fetch (r17): per delta job, the
+            # hinfo attr (present flag + bytes) and the touched
+            # pre-image sub-ranges, in ONE frame per participant
+            # shard — the reply mirrors the item order. A short read
+            # (write past the old tail) returns the short bytes; the
+            # receiver zero-pads, exactly like the old per-span read.
+            attr_key = d.string()
+            items = d.list(lambda dd: (
+                dd.string(), dd.list(lambda d2: (d2.i64(), d2.i64()))))
+            e = Encoder()
+
+            def one(en: Encoder, item) -> None:
+                name, ranges = item
+                try:
+                    attr, ok = st.getattr(cid, name, attr_key), True
+                except KeyError:
+                    attr, ok = b"", False
+                en.boolean(ok).blob(attr)
+                en.list([np.asarray(st.read(cid, name, off, ln),
+                                    np.uint8).tobytes()
+                         for off, ln in ranges], Encoder.blob)
+            e.list(items, one)
             return e.bytes()
         if kind == "stat":
             return Encoder().i64(st.stat(cid, oid)).bytes()
@@ -2444,6 +2573,18 @@ class OSDDaemon:
                     self._last_pong[osd] = now
                 self._reported.discard(osd)
                 self.suspect.discard(osd)
+        # r17: fold the committed liveness into the repair policy's
+        # DownClocks BEFORE reconciling — a down mark starts a
+        # deferral window, a revive cancels the parked work and queues
+        # the cursor re-check the reconcile below will consume. Only
+        # an ADMIN out (`osd out`, sticky) confirms instantly: the
+        # harness's automatic down+out rides EVERY down mark and is
+        # exactly the transient evidence the delay exists to absorb.
+        self.repair_policy.observe_map(
+            self.osdmap.osd_up,
+            out_osds=sorted(getattr(self.osdmap, "osd_admin_out",
+                                    None) or ()),
+            now=now, suspect=self.suspect)
         self._apply_central_config()
         self._reconcile()
         self.perf.set("osdmap_epoch", self.osdmap.epoch)
@@ -2493,11 +2634,43 @@ class OSDDaemon:
             with self._pg_lock(ps):
                 self._reconcile_pg(ps, new_plans)
         if new_plans:
+            # r17 risk order: most exposed stripes first (fewest
+            # surviving redundancy shards), r14 helper cost second,
+            # PG id last — the runner drains batches in plan order,
+            # so this IS the exposure schedule. 'pgid' keeps the
+            # pre-r17 order selectable (the exposure A/B the bench
+            # measures) but still counts the inversions it ships.
+            from .repairpolicy import order_plans
+            new_plans = order_plans(
+                new_plans, self._plan_redundancy,
+                mode=str(self.config["osd_repair_queue_order"]),
+                counter=self.repair_policy._count)
+            now_m = time.monotonic()
+            for ps, plan, _dead in new_plans:
+                self.repair_policy.note_exposure(
+                    ps, self._plan_redundancy(ps, plan) <= 1,
+                    now=now_m)
             rnd = _RecoveryRound(self, new_plans)
             for ps, _plan, _dead in new_plans:
                 self._recovering[ps] = rnd
             self._sched_enqueue("background_recovery", rnd,
                                 rnd.next_cost(), shard=rnd.shard())
+        self._note_repair_gauges()
+
+    def _plan_redundancy(self, ps: int, plan) -> int:
+        """Surviving redundancy of one planned rebuild: failures the
+        PG can still absorb while the plan is queued (EC: m - lost;
+        replicated: spare copies). The risk key's first component."""
+        be = self.backends.get(ps)
+        if be is None:
+            return 0
+        return (be.n - be.min_live) - len(getattr(plan, "lost", ()))
+
+    def _note_repair_gauges(self) -> None:
+        self.perf.set("repair_parked_pgs",
+                      len(self.repair_policy.parked))
+        self.perf.set("repair_exposed_pgs",
+                      self.repair_policy.exposed_pgs())
 
     def _reconcile_pg(self, ps: int, new_plans: list) -> None:
         """One PG's slice of _reconcile. Caller holds self._lock and
@@ -2516,6 +2689,12 @@ class OSDDaemon:
                 self._meta_delta.pop(ps, None)
             self._interval_start.pop(ps, None)
             self._last_acting.pop(ps, None)
+            # not our PG: drop any repair-policy bookkeeping for it
+            # (the new primary re-derives its own)
+            self.repair_policy.note_planned(ps)
+            self.repair_policy.take_recheck(ps)
+            self.repair_policy.note_exposure(ps, False,
+                                             now=time.monotonic())
             return
         # interval detection: any acting change starts a NEW
         # INTERVAL whose primary must re-prove freshness — its
@@ -2572,6 +2751,12 @@ class OSDDaemon:
                 ps, be, sorted(self._rewind_pending[ps]))
         if be.acting == acting:
             self._snap_trim(ps, be)   # snaps may have left the map
+            # r17 lazy repair, the payoff branch: a parked OSD revived
+            # inside its window and the map folded back to the old
+            # acting — cancel cost is a CURSOR re-check, not a rebuild
+            recheck = self.repair_policy.take_recheck(ps)
+            if recheck:
+                self._revive_recheck(ps, be, recheck, new_plans)
             rnd = self._recovering.get(ps)
             if rnd is not None and getattr(rnd, "failed", False):
                 # a round died mid-way (helper lost, push refused):
@@ -2620,10 +2805,39 @@ class OSDDaemon:
                     # unfillable has no old bytes anywhere and
                     # must decode-rebuild, not copy
                     lost.append(s)
+            # r17 lazy repair: while EVERY dead old holder is inside
+            # its osd_repair_delay window (down_deferred) and no
+            # override fires (m-1 exposure, stripe budget, out mark),
+            # PARK this PG's rebuild — plan nothing, move nothing.
+            # Holes (slots born unfillable) never defer: there is no
+            # OSD to wait for. Deferral re-evaluates on every map fold
+            # and heartbeat reconcile, so the window expiring, a
+            # second failure, or a revive all resolve it within a beat.
+            if lost:
+                dead_hold = {be.acting[s] for s in lost
+                             if _valid_osd(be.acting[s], n_osds)}
+                holes = len(dead_hold) < len(lost)
+                fresh_park = ps not in self.repair_policy.parked
+                if (not holes
+                        and self.repair_policy.should_defer(
+                            ps, dead_hold, len(lost),
+                            be.n - be.min_live,
+                            max(1, len(be.object_sizes)))):
+                    if fresh_park:
+                        self.c.log(
+                            f"{self.name}: pg 1.{ps} rebuild parked "
+                            f"(lazy repair, dead={sorted(dead_hold)}, "
+                            f"delay="
+                            f"{self.config['osd_repair_delay']}s)")
+                    return
+            # an acting change subsumes any queued revive re-check
+            # (the move/loss handling below re-derives freshness)
+            self.repair_policy.take_recheck(ps)
             try:
                 for s, o, n in moves:
                     self._move_shard(be, s, o, n)
                 if lost:
+                    self.repair_policy.note_planned(ps)
                     repl = {s: acting[s] for s in lost}
                     dead = {be.acting[s] for s in lost}
                     exclude = {
@@ -2661,6 +2875,57 @@ class OSDDaemon:
                 self.c.log(f"{self.name}: pg 1.{ps} recovery "
                            f"deferred: {e}")
 
+    def _revive_recheck(self, ps: int, be, revived: set[int],
+                        new_plans: list) -> None:
+        """Cancel cost of lazy repair: for every slot whose OSD came
+        back inside its deferral window, walk the PG log from the
+        slot's applied cursor (the cursor/version re-check). A quiet
+        window proves the shard current — ZERO bytes move, counted in
+        repair_cancel_noop. Writes that landed inside the window
+        replay through the existing names= delta-recovery path (only
+        the missed objects, not a rebuild). A log trimmed past the
+        cursor cannot prove either way and falls back to a full plan.
+        Caller holds self._lock and the PG lock."""
+        slots = [s for s, o in enumerate(be.acting) if o in revived]
+        if not slots:
+            self.repair_policy.note_recheck(0)
+            return
+        names: set[str] | None = set()
+        for s in slots:
+            missing = be.pg_log.missing_since(be.shard_applied[s])
+            if missing is None:
+                names = None            # log trimmed: full rebuild
+                break
+            names.update(missing)
+        if names is not None and not names:
+            self.repair_policy.note_recheck(0)
+            self.c.log(f"{self.name}: pg 1.{ps} parked rebuild "
+                       f"cancelled by revive (cursor re-check clean, "
+                       f"0 bytes)")
+            return
+        n_catchup = len(names) if names is not None \
+            else len(be.object_sizes)
+        self.repair_policy.note_recheck(n_catchup)
+        try:
+            if hasattr(be, "plan_recovery"):
+                plan = be.plan_recovery(
+                    slots,
+                    names=sorted(names) if names is not None else None,
+                    helper_costs=self._helper_costs(be))
+                self._recovering[ps] = None      # round pending
+                new_plans.append((ps, plan, set()))
+            else:
+                be.recover_shards(
+                    slots,
+                    names=sorted(names) if names is not None else None)
+                self.perf.inc("recovery_rounds")
+            self.c.log(f"{self.name}: pg 1.{ps} revive catch-up: "
+                       f"{n_catchup} object(s) missed inside the "
+                       f"window")
+        except (ValueError, ConnectionError, KeyError) as e:
+            self.c.log(f"{self.name}: pg 1.{ps} revive catch-up "
+                       f"deferred: {e}")
+
     def _request_up_thru(self, want: int) -> None:
         """Ask every monitor to record our up_thru through `want` (the
         MOSDAlive flow): broadcast so whoever leads proposes; the
@@ -2684,17 +2949,24 @@ class OSDDaemon:
         src = be.cluster.osd(old_osd)
         dst = be.cluster.osd(new_osd)
         t = Transaction().create_collection(cid)
+        moved_objs = moved_bytes = 0
         for name in be.list_pg_objects():
             if not src.exists(cid, name):
                 continue
             data = np.asarray(src.read(cid, name), np.uint8)
             t.write(cid, name, 0, data).truncate(cid, name, len(data))
+            moved_objs += 1
+            moved_bytes += len(data)
             try:
                 t.setattr(cid, name, HINFO_KEY,
                           src.getattr(cid, name, HINFO_KEY))
             except KeyError:
                 pass
         dst.queue_transaction(t)
+        # repair-traffic accounting (r17): backfill copies are repair
+        # bytes too — the storm bench sums them with recovered_bytes
+        self.perf.inc_many((("move_objects", moved_objs),
+                            ("move_bytes", moved_bytes)))
         be.acting[slot] = new_osd
         self.c.log(f"{self.name}: pg {be.pg} slot {slot} moved "
                    f"osd.{old_osd} -> osd.{new_osd}")
@@ -2754,6 +3026,21 @@ class OSDDaemon:
          .add_u64("op_shard_imbalance",
                   "grant spread across shards (max-min served — the "
                   "PG-hash skew signal)")
+         .add_u64_counter("move_objects",
+                          "objects copied by backfill-by-copy shard "
+                          "moves (a re-slotted LIVE member)")
+         .add_u64_counter("move_bytes",
+                          "bytes copied by backfill-by-copy shard "
+                          "moves (with ec.recovered_bytes and "
+                          "ec.recover_wire_bytes: the repair-traffic "
+                          "total the r17 policy plane prices)")
+         .add_u64("repair_parked_pgs",
+                  "PGs whose rebuild is parked behind "
+                  "osd_repair_delay right now (lazy repair)")
+         .add_u64("repair_exposed_pgs",
+                  "PGs at m-1 surviving redundancy right now (the "
+                  "PG_EXPOSED health source; risk ordering drains "
+                  "these first)")
          .add_u64("numpg", "PGs this daemon primaries")
          .add_u64("osdmap_epoch", "newest folded map epoch")
          .add_u64_counter("map_incs_applied",
@@ -2766,6 +3053,13 @@ class OSDDaemon:
                        "client op wall time (tracker enter to reply "
                        "built)")
          .add_time_avg("subop_latency", "store sub-op service time"))
+        # r17 repair-policy counters: declared from the policy
+        # module's ONE list so the daemon schema and the policy's own
+        # counter dict cannot drift (the r9 declared-names rule)
+        from .repairpolicy import POLICY_COUNTERS
+        for key in POLICY_COUNTERS:
+            b.add_u64_counter(key, "repair policy plane (r17) — see "
+                                   "osd/repairpolicy.py")
         self.perf = b.create_perf_counters()
         # ONE "ec" logger shared by every PG backend this daemon
         # hosts (per-PG loggers would explode the metric space)
@@ -2829,6 +3123,7 @@ class OSDDaemon:
                    "dump_ops_in_flight", "slow_ops", "pg stat",
                    "pg clean",
                    "dump_mclock", "dump_op_shards", "dump_scrubs",
+                   "dump_repair",
                    "log dump",
                    "config show",
                    "config diff", "trace start", "trace stop",
@@ -2846,11 +3141,22 @@ class OSDDaemon:
         alive = [bool(u) and o not in self.suspect
                  for o, u in enumerate(self.osdmap.osd_up)]
         my_ut = int(self.osdmap.osd_up_thru[self.osd_id])
-        return {f"1.{ps}": _peer(
-                    be, alive, compute_missing=False,
-                    interval_start=self._interval_start.get(ps, 0),
-                    up_thru=my_ut).state
-                for ps, be in sorted(self.backends.items())}
+        n_osds = len(alive)
+        out = {}
+        for ps, be in sorted(self.backends.items()):
+            state = _peer(
+                be, alive, compute_missing=False,
+                interval_start=self._interval_start.get(ps, 0),
+                up_thru=my_ut).state
+            # r17: "+exposed" marks a PG at m-1 surviving redundancy
+            # (one more failure loses data) — the PG_EXPOSED health
+            # source, and what risk-ordered recovery drains first
+            lost = sum(1 for o in be.acting
+                       if not _valid_osd(o, n_osds) or not alive[o])
+            if lost and (be.n - be.min_live) - lost <= 1:
+                state += "+exposed"
+            out[f"1.{ps}"] = state
+        return out
 
     def _pool_bytes(self) -> dict:
         """Logical bytes per pool across the PGs this daemon primaries
@@ -2919,6 +3225,13 @@ class OSDDaemon:
             with self._lock:   # heartbeat inserts concurrently
                 return {"scrubs": {f"1.{ps}": r for ps, r in
                                    sorted(self.scrub_reports.items())}}
+        if cmd == "dump_repair":
+            # the r17 repair policy plane: DownClocks, parked
+            # rebuilds, exposure + deferral counters, and the
+            # per-failure-domain token buckets
+            with self._lock:
+                return {"policy": self.repair_policy.dump(),
+                        "domains": self.domain_budgets.dump()}
         if cmd == "status":
             with self._lock:
                 return {
@@ -3718,6 +4031,10 @@ class OSDDaemon:
                 if stale and osd not in self._reported:
                     self._reported.add(osd)
                     self.suspect.add(osd)
+                    # heartbeat silence is the DownClock's suspect
+                    # evidence (map still up — repair parks nothing
+                    # yet; the mon's down mark starts the window)
+                    self.repair_policy.note_suspect(osd)
                     # broadcast to EVERY monitor: whoever currently
                     # leads acts, so leader failover needs no OSD-side
                     # coordination (the reference forwards via the

@@ -166,6 +166,41 @@ OPTIONS: list[Option] = [
            "sampled trace context (the recovery/readv_ranges helper "
            "pulls then record osd.subop spans at their sources)",
            min=0.0, max=1.0),
+    Option("osd_repair_delay", float, 0.0,
+           "seconds a rebuild for a freshly down OSD stays PARKED "
+           "(lazy repair, the r17 policy plane): a revive inside the "
+           "window cancels the parked work with only a cursor/version "
+           "re-check — no bytes move. 0 = eager (pre-r17 behavior). "
+           "Overridden immediately for stripes at m-1 surviving "
+           "redundancy, for OSDs marked out, and past the deferred-"
+           "stripe budget", min=0.0),
+    Option("osd_repair_deferred_max_stripes", int, 512,
+           "outstanding-stripe budget of lazy repair: when the parked "
+           "rebuilds across a primary exceed this many stripes, new "
+           "deferrals confirm instead (bounds the exposure a patient "
+           "policy can accumulate)", min=1),
+    Option("osd_repair_queue_order", str, "risk",
+           "rebuild queue order on multi-failure events: 'risk' = "
+           "fewest surviving redundancy shards first (ties broken by "
+           "r14 helper cost, then PG id), 'pgid' = the pre-r17 PG-id "
+           "order (kept selectable so the exposure comparison stays "
+           "measurable; risk inversions are counted either way)"),
+    Option("osd_repair_domain_budget_mbps", float, 0.0,
+           "per-CRUSH-failure-domain repair read budget in MB/s: "
+           "recovery grants draw helper bytes from a token bucket "
+           "keyed by each helper's rack, so one rack's burst rebuild "
+           "cannot saturate another rack's uplinks. Enforced through "
+           "the mClock background_recovery grant path (an out-of-"
+           "tokens grant re-queues). 0 = unlimited", min=0.0),
+    Option("osd_repair_domain_burst_mb", float, 16.0,
+           "token-bucket burst capacity per failure domain in MB "
+           "(how much a cold domain may pull before the rate gate "
+           "engages)", min=0.001),
+    Option("osd_recovery_integrity", str, "auto",
+           "recovery integrity mode: 'host' verifies helper CRCs with "
+           "the native SSE4.2 crc32c off-device, 'device' keeps the "
+           "fused decode+fold on-device (the r10 path), 'auto' picks "
+           "host when the native lib is available"),
     Option("mgr_report_interval", float, 2.0,
            "seconds between a daemon's MgrReports to the monitors "
            "(the reference defaults to 5; lower = fresher `ceph "

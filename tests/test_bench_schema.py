@@ -261,8 +261,101 @@ def test_rados_bench_overwrite_schema_live():
     assert amp["rmw"]["ops"] == 8
     assert amp["rmw"]["shard_ios_per_op"] == 3.0   # 1 data + m=2
     assert amp["rmw"]["full_fallbacks"] == 0
+    # r17 prepare coalescing: one fetch wave per delta group, frames
+    # bounded by the participant count (vs 1+m getattrs + a pre-read
+    # RTT per span before)
+    assert amp["rmw"]["prepare_fetch_waves"] > 0
+    assert amp["rmw"]["prepare_fetch_frames_per_op"] <= 3.0
     assert amp["ratio_vs_full_stripe"] < 1.0
     _check_trace_block(data["trace"])
+
+
+STORM_PASS_KEYS = {"seed", "delay_s", "integrity", "pulses",
+                   "revives_inside", "revives_inside_fraction",
+                   "repair_bytes", "policy_counters", "verify"}
+RACK_KEYS = {"downed_rack_osds", "pgs_touched", "lost_histogram",
+             "stripes_at_m1", "exposure_pgid", "exposure_risk",
+             "ratio_risk_vs_pgid"}
+
+
+def test_bench_r17_artifact_pinned():
+    """The committed r17 repair-policy storm artifact: schema keys CI
+    parses and the acceptance floors — under a seeded transient-heavy
+    kill/revive storm (>= 50% revives inside the window, cephx +
+    secure), deferred repair moves <= 0.5x the eager baseline's
+    repair bytes with ZERO data-loss/resurrection violations and
+    every object bit-exact vs the full-decode oracle in BOTH
+    integrity modes; under a simulated rack loss, cumulative
+    stripe-time at m-1 with risk ordering <= 0.5x PG-id ordering.
+    Every metric is a COUNT, so the floors are deterministic."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r17.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "repair_r17/1"
+    assert data["config"]["cephx"] and data["config"]["secure"]
+    storm = data["cells"]["transient_storm"]
+    for pname in ("eager", "deferred_host", "deferred_device"):
+        p = storm[pname]
+        assert STORM_PASS_KEYS <= set(p), pname
+        assert p["verify"]["violations"] == 0
+        assert p["verify"]["oracle_checked"] > 0
+    assert storm["deferred_host"]["integrity"] == "host"
+    assert storm["deferred_device"]["integrity"] == "device"
+    # the same seeded schedule ran every pass, >= 50% inside
+    assert storm["eager"]["seed"] == storm["deferred_host"]["seed"]
+    assert storm["deferred_host"]["revives_inside_fraction"] >= 0.5
+    # lazy repair engaged: stripes parked, inside revives cancelled
+    # with zero-byte cursor re-checks
+    for pname in ("deferred_host", "deferred_device"):
+        pc = storm[pname]["policy_counters"]
+        assert pc["repair_deferred_stripes"] > 0
+        assert pc["repair_deferred_cancelled"] > 0
+        assert pc["repair_cancel_noop"] > 0
+        assert "repair_urgent_parked" not in pc     # invariant (b)
+    assert RACK_KEYS <= set(data["cells"]["rack_loss"])
+    assert data["cells"]["rack_loss"]["stripes_at_m1"] > 0
+    acc = data["acceptance"]
+    assert acc["deferred_vs_eager_repair_bytes"] <= 0.5
+    assert acc["risk_vs_pgid_exposure"] <= 0.5
+    assert acc["revives_inside_fraction"] >= 0.5
+    assert acc["invariant_violations"] == 0
+    assert acc["bit_exact_both_integrity_modes"] is True
+
+
+CHURN_KEYS = {"events", "transient", "permanent", "confirmed",
+              "cancelled", "urgent", "revives_inside",
+              "revives_outside", "eager_bytes", "deferred_bytes",
+              "catchup_bytes", "ratio_deferred_vs_eager", "config",
+              "policy_counters"}
+
+
+def test_scale_r17_repair_churn_pinned():
+    """The committed 10k-OSD repair-churn day replay (r17): a day of
+    transient+permanent failures at warehouse rates (arxiv 1309.0186
+    shape: >= 90% transient, short downtimes) through the REAL
+    RepairPolicy in virtual time. Floors: deferred repair prices at
+    <= 0.5x the eager baseline, a majority of transient events
+    cancel, and the no-delay control proves the model's two paths
+    agree when the policy is off."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "SCALE_r17.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "scale_sim_r17/1"
+    churn = data["cells"]["repair_churn_day"]
+    control = data["cells"]["repair_churn_eager_control"]
+    for cell in (churn, control):
+        assert CHURN_KEYS <= set(cell)
+    assert churn["config"]["osds"] == 10000
+    assert churn["config"]["transient_fraction"] >= 0.9
+    assert churn["config"]["osd_repair_delay_s"] > 0
+    assert churn["policy_counters"]["repair_deferred_cancelled"] \
+        == churn["cancelled"]
+    acc = data["acceptance"]
+    assert acc["deferred_vs_eager_bytes"] <= 0.5
+    assert acc["cancelled_fraction"] >= 0.5
+    assert acc["eager_control_ratio"] == 1.0
 
 
 REBALANCE_KEYS = {"moves", "rounds", "candidates_scored",

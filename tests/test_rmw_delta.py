@@ -371,3 +371,139 @@ class TestStripeJournalCrashMatrix:
         patch = rng.integers(0, 256, 40, np.uint8)
         be2.write_at("o", 200, patch)    # must journal cleanly
         assert be2.deep_scrub()["inconsistent"] == []
+
+
+class TestPrepareFetchCoalescing:
+    """r17 follow-up: the delta prepare's 1+m tiny per-shard getattrs
+    and per-span pre-reads coalesce into ONE combined fetch wave per
+    delta group — one frame per participant shard, however many jobs
+    and spans the group carries."""
+
+    def test_one_wave_one_frame_per_participant(self):
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(31)
+        base = rng.integers(0, 256, 3000, np.uint8)
+        be.write_objects({"a": base, "b": base[::-1].copy()})
+        w0, f0 = (be.perf.get("rmw_fetch_waves"),
+                  be.perf.get("rmw_fetch_frames"))
+        # two jobs, same (touched, window) shape -> ONE group, ONE
+        # wave; participants = 1 data + m parity = 3 shards
+        pa = rng.integers(0, 256, 40, np.uint8)
+        pb = rng.integers(0, 256, 40, np.uint8)
+        be.write_ranges([("a", 10, pa), ("b", 10, pb)])
+        assert be.perf.get("rmw_fetch_waves") - w0 == 1
+        assert be.perf.get("rmw_fetch_frames") - f0 == 1 + be.m
+        want = base.copy()
+        want[10:50] = pa
+        _assert_stores_match_oracle(be, "a", want)
+
+    def test_growth_wave_touches_every_shard_once(self):
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(32)
+        base = rng.integers(0, 256, 900, np.uint8)
+        be.write_objects({"g": base})
+        f0 = be.perf.get("rmw_fetch_frames")
+        # growth (nsl != osl: the append lands in the NEXT stripe, so
+        # every shard zero-extends): all n participate, one frame each
+        be.write_at("g", 1100, rng.integers(0, 256, 30, np.uint8))
+        assert be.perf.get("rmw_fetch_frames") - f0 == be.n
+
+    def test_wire_tier_prefetch_round_trips(self):
+        """On the wire tier the wave really is pipelined RemoteStore
+        frames: rmw_fetch store ops serve it, and the overwrite's
+        bytes land bit-exact."""
+        from ceph_tpu.osd.standalone import StandaloneCluster
+        c = StandaloneCluster(n_osds=5,
+                              profile="plugin=tpu_rs k=2 m=1 "
+                                      "impl=bitlinear",
+                              pg_num=2)
+        try:
+            cl = c.client()
+            rng = np.random.default_rng(33)
+            base = rng.integers(0, 256, 1500, np.uint8).tobytes()
+            cl.write({"w": base})
+            def waves():
+                return sum(d.ec_perf.get("rmw_fetch_waves")
+                           for d in c.osds.values()
+                           if not d._stop.is_set())
+            w0 = waves()
+            patch = rng.integers(0, 256, 64, np.uint8).tobytes()
+            cl.write_at("w", 100, patch)
+            assert waves() > w0
+            want = bytearray(base)
+            want[100:164] = patch
+            assert cl.read("w") == bytes(want)
+        finally:
+            c.shutdown()
+
+
+class TestJournalAwareDeepScrub:
+    """r17 follow-up: deep scrub audits pending __stripe_journal__
+    intents (seq/version/geometry consistency against the applied
+    watermark) instead of skipping the collection."""
+
+    def test_clean_pg_reports_empty_journal_blocks(self):
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(41)
+        be.write_objects({"o": rng.integers(0, 256, 2000, np.uint8)})
+        be.write_at("o", 10, rng.integers(0, 256, 40, np.uint8))
+        rep = be.deep_scrub()
+        assert rep["inconsistent"] == []
+        assert rep["journal_bad"] == []
+        assert rep["journal_pending"] == 0     # applied + dropped
+
+    def test_corrupt_intent_detected(self):
+        from ceph_tpu.osd.memstore import Transaction
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(42)
+        be.write_objects({"o": rng.integers(0, 256, 2000, np.uint8)})
+        s = 0
+        cid = shard_cid(be.pg, s)
+        # (1) garbage bytes under a journal key
+        be._store(s).queue_transaction(Transaction().omap_set(
+            cid, be.JOURNAL_OBJ, {be._jkey(99): b"\x07garbage"}))
+        rep = be.deep_scrub()
+        assert any("undecodable" in why for sl, why in
+                   rep["journal_bad"] if sl == s), rep
+        assert rep["inconsistent"] == []       # journal findings stay
+        #                                        out of auto-repair's
+        #                                        rebuild list
+        # (2) a decodable intent whose seq sits below the watermark
+        be._store(s).queue_transaction(Transaction().omap_set(
+            cid, be.JOURNAL_OBJ,
+            {be._J_APPLIED: __import__("struct").pack("<Q", 50),
+             be._jkey(7): be._encode_jentry(
+                 7, "o", s, [s], 2000, 500, 500, 0, b"", 0, 1)}))
+        rep2 = be.deep_scrub()
+        assert any("watermark" in why for sl, why in
+                   rep2["journal_bad"] if sl == s), rep2
+        # (3) a geometry overrun: delta runs past the shard length
+        be._store(s).queue_transaction(Transaction().omap_set(
+            cid, be.JOURNAL_OBJ,
+            {be._jkey(60): be._encode_jentry(
+                60, "o", s, [s], 2000, 500, 500, 400,
+                b"\x00" * 200, 0, 999)}))
+        rep3 = be.deep_scrub()
+        assert any("overruns" in why for sl, why in
+                   rep3["journal_bad"] if sl == s), rep3
+
+    def test_pending_intent_counts_not_flags(self):
+        """A legitimate in-flight intent (prepare done, apply not) is
+        journal_pending — crash-recovery state, never 'bad'."""
+        be, _ = _make("plugin=tpu_rs k=4 m=2 impl=bitlinear", 256)
+        rng = np.random.default_rng(43)
+        be.write_objects({"o": rng.integers(0, 256, 2000, np.uint8)})
+
+        class _Stop(Exception):
+            pass
+
+        def hook(p):
+            if p == "after_prepare":
+                raise _Stop()
+        be._rmw_crash_hook = hook
+        with pytest.raises(_Stop):
+            be.write_at("o", 10, rng.integers(0, 256, 40, np.uint8))
+        be._rmw_crash_hook = None
+        rep = be.deep_scrub()
+        assert rep["journal_bad"] == []
+        assert rep["journal_pending"] > 0

@@ -137,6 +137,51 @@ def test_thrash_degraded_reads_never_block(seed, store, tmp_path):
     assert report["objects_verified"] > 0, report
 
 
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [311])
+def test_thrash_transient_smoke(seed, tmp_path):
+    """r17 tier-1 cell: the transient-vs-real failure mix — a seeded
+    kill stream whose victims auto-revive inside/outside the
+    osd_repair_delay window (k=2 m=3 so single losses keep >= 2 spare
+    redundancy and really defer). The run itself asserts the two
+    policy invariants after every heal: (a) an inside-window revive
+    over a quiet window moves ZERO repair bytes (the cursor re-check
+    cancel), (b) no at-m-1 stripe is ever parked and the rebuild
+    queue ships no risk inversions. This seed's draws include a quiet
+    probe, so the zero-byte check provably fired."""
+    th = Thrasher(seed, store="mem", rounds=1, ops=4,
+                  transient_fraction=0.9, n_osds=7,
+                  profile="plugin=tpu_rs k=2 m=3 impl=bitlinear")
+    report = th.run()
+    assert report["transient_kills"] > 0, report
+    # the zero-byte claim fired — or was provably skipped because the
+    # policy was mid-override (a loaded box stretching heartbeats into
+    # spurious down-marks; the skip is logged, never silent)
+    assert report["transient_noop_checks"] \
+        + report["transient_noop_skips"] > 0, report
+    assert report["repair_deferred_stripes"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store,fraction", [(313, "mem", 0.9),
+                                                 (317, "tin", 0.6)])
+def test_thrash_transient_matrix(seed, store, fraction, tmp_path):
+    """Deeper transient-mix cells (`-m chaos`): more rounds, a lower
+    transient fraction (real + transient kills interleave), and the
+    TinStore remount path under the auto-revive stream. Same policy
+    invariants as the smoke, checked after every heal."""
+    th = Thrasher(seed, store=store, rounds=2, ops=5,
+                  transient_fraction=fraction, n_osds=7,
+                  profile="plugin=tpu_rs k=2 m=3 impl=bitlinear",
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["transient_kills"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
 def test_same_seed_same_schedule(tmp_path):
     """Reproducibility contract: two Thrashers with one seed draw the
     IDENTICAL fault schedule (victims, knob values, data sizes) —

@@ -597,6 +597,15 @@ def main(argv=None) -> None:
                 "full_fallbacks": delta("rmw_full_fallbacks"),
                 "journal_entries": delta("journal_entries"),
                 "delta_launches": delta("rmw_delta_launches"),
+                # r17 prepare coalescing: ONE overlapped fetch wave
+                # per delta group (frames = participant shards), vs
+                # the 1+m sequential getattrs + a read RTT per span
+                # the r16 prepare paid per op
+                "prepare_fetch_waves": delta("rmw_fetch_waves"),
+                "prepare_fetch_frames": delta("rmw_fetch_frames"),
+                "prepare_fetch_frames_per_op": round(
+                    delta("rmw_fetch_frames")
+                    / max(1, delta("rmw_ops")), 3),
             },
             "full_stripe_baseline": {
                 "logical_bytes": full_logical,

@@ -32,6 +32,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCHEMA = "scale_sim_r12/1"
+REPAIR_SCHEMA = "scale_sim_r17/1"
 
 
 def _imports():
@@ -254,6 +255,217 @@ def run_balancer_2x(n_osds: int, pg_num: int, budget: int,
     return out
 
 
+def run_repair_churn(n_osds: int, pg_num: int, size: int, m: int,
+                     hours: float, seed: int, delay_s: float,
+                     shard_mb: float, events_per_osd_day: float,
+                     transient_fraction: float,
+                     write_mbps_per_osd: float, log=print) -> dict:
+    """Price repair bytes under warehouse-rate churn (r17): replay a
+    day of transient+permanent failure events through the REAL
+    repair-policy objects (DownClock + should_defer, virtual clock —
+    the same code the live daemon runs in `_reconcile_pg`), and
+    compare the bytes a lazy policy moves against the eager baseline
+    that rebuilds on every down mark.
+
+    The event shape follows the Facebook warehouse study (arxiv
+    1309.0186): the large majority of unavailability events are
+    transient with downtimes well under the 15-minute mark, so an
+    eager policy rebuilds terabytes that a short delay writes off.
+    Costs are COUNTS — per confirmed OSD: shards x shard_bytes x k
+    helper reads (+ the copy-back when a rebuilt OSD revives); per
+    cancelled deferral: only the cursor re-check's catch-up bytes
+    (cluster write throughput apportioned over the down window).
+    Concurrently-down OSDs model the m-1 override: the second loss
+    confirms BOTH immediately (exactly the policy's urgent path)."""
+    import random as _random
+
+    from ceph_tpu.osd.repairpolicy import RepairPolicy
+    from ceph_tpu.utils.config import Config
+
+    rng = _random.Random(seed)
+    cfg = Config()
+    cfg.set("osd_repair_delay", delay_s)
+    cfg.set("osd_repair_deferred_max_stripes", 1 << 30)
+    policy = RepairPolicy(config=cfg)
+    policy.observe_map([True] * n_osds, now=0.0)
+
+    k = size - m
+    shards_per_osd = pg_num * size / n_osds
+    shard_bytes = shard_mb * 1e6
+    rebuild_cost = shards_per_osd * shard_bytes * k   # helper reads
+    copyback_cost = shards_per_osd * shard_bytes      # revive move
+
+    horizon = hours * 3600.0
+    n_events = max(1, int(n_osds * events_per_osd_day * hours / 24.0))
+    events = []       # (t, kind, osd)
+    n_transient = 0
+    for _ in range(n_events):
+        osd = rng.randrange(n_osds)
+        t = rng.uniform(0.0, horizon)
+        if rng.random() < transient_fraction:
+            n_transient += 1
+            # log-uniform 30 s .. 30 min: median ~2.5 min, the
+            # short-transient-dominated shape of the warehouse study
+            import math as _math
+            dt = _math.exp(rng.uniform(_math.log(30.0),
+                                       _math.log(1800.0)))
+            events.append((t, "down", osd))
+            events.append((t + dt, "up", osd))
+        else:
+            events.append((t, "down", osd))  # permanent: no revive
+    events.sort()
+
+    up = [True] * n_osds
+    stats = {"events": n_events, "transient": n_transient,
+             "permanent": n_events - n_transient,
+             "confirmed": 0, "cancelled": 0, "urgent": 0,
+             "revives_inside": 0, "revives_outside": 0,
+             "eager_bytes": 0.0, "deferred_bytes": 0.0,
+             "catchup_bytes": 0.0}
+    down_since: dict = {}
+    repaired: set = set()            # rebuilt while down (copy-back
+    #                                  owed on revive, both modes)
+    pending: list = []               # (expiry t, osd) deferral checks
+    eager_repaired: set = set()
+    # expected PGs a SPECIFIC pair of OSDs co-hosts — the stripes the
+    # per-PG m-1 override urgently repairs when both are down (at 10k
+    # OSDs this is well under one PG per pair; the override is a
+    # per-stripe emergency, never a full-OSD rebuild)
+    shared_pgs = pg_num * size * (size - 1) / (n_osds * (n_osds - 1))
+
+    def confirm(osd: int, now: float, urgent: bool = False) -> None:
+        if osd in repaired:
+            return
+        stats["confirmed"] += 1
+        if urgent:
+            stats["urgent"] += 1
+        stats["deferred_bytes"] += rebuild_cost
+        repaired.add(osd)
+        policy.note_planned(osd)
+
+    ei = 0
+    while ei < len(events) or pending:
+        if pending and (ei >= len(events)
+                        or pending[0][0] <= events[ei][0]):
+            t, osd = pending.pop(0)
+            if up[osd] or osd in repaired:
+                continue
+            # window expired? the policy's own clock decides
+            if not policy.should_defer(osd, {osd}, 1, m,
+                                       int(shards_per_osd), now=t):
+                confirm(osd, t)
+            else:
+                pending.append((t + 1.0, osd))
+                pending.sort()
+            continue
+        t, kind, osd = events[ei]
+        ei += 1
+        if kind == "down":
+            if not up[osd]:
+                continue
+            up[osd] = False
+            down_since[osd] = t
+            policy.observe_map(up, now=t)
+            # eager baseline: every down mark rebuilds, full stop
+            if osd not in eager_repaired:
+                stats["eager_bytes"] += rebuild_cost
+                eager_repaired.add(osd)
+            if not policy.should_defer(osd, {osd}, 1, m,
+                                       int(shards_per_osd), now=t):
+                confirm(osd, t)
+            else:
+                # per-PG m-1 override: stripes this OSD co-hosts with
+                # another concurrently-down unrepaired OSD are one
+                # loss from the cliff — those (and only those) repair
+                # NOW, while the rest of both OSDs stays parked
+                others = [o for o in down_since
+                          if o != osd and not up[o]
+                          and o not in repaired]
+                if others and m - 2 <= 1:
+                    stats["urgent"] += len(others)
+                    stats["deferred_bytes"] += (len(others)
+                                                * shared_pgs
+                                                * shard_bytes * k)
+                pending.append((t + delay_s, osd))
+                pending.sort()
+        else:                        # revive
+            if up[osd]:
+                continue
+            dt = t - down_since.pop(osd, t)
+            up[osd] = True
+            policy.observe_map(up, now=t)
+            if osd in repaired:
+                stats["revives_outside"] += 1
+                # rebuilt while down: the map reverts, the shard
+                # copies back (both modes pay it)
+                stats["deferred_bytes"] += copyback_cost
+                stats["eager_bytes"] += copyback_cost
+                repaired.discard(osd)
+            else:
+                stats["revives_inside"] += 1
+                stats["cancelled"] += 1
+                # cancel cost: only what was WRITTEN into the window
+                # (the cursor re-check's catch-up), not a rebuild
+                catchup = write_mbps_per_osd * 1e6 * dt
+                stats["catchup_bytes"] += catchup
+                stats["deferred_bytes"] += catchup
+            if osd in eager_repaired:
+                eager_repaired.discard(osd)
+    stats["ratio_deferred_vs_eager"] = round(
+        stats["deferred_bytes"] / max(1.0, stats["eager_bytes"]), 4)
+    stats["eager_tb"] = round(stats["eager_bytes"] / 1e12, 2)
+    stats["deferred_tb"] = round(stats["deferred_bytes"] / 1e12, 2)
+    stats["config"] = {
+        "osds": n_osds, "pg_num": pg_num, "size": size, "m": m,
+        "hours": hours, "seed": seed, "osd_repair_delay_s": delay_s,
+        "shard_mb": shard_mb,
+        "events_per_osd_day": events_per_osd_day,
+        "transient_fraction": transient_fraction,
+        "write_mbps_per_osd": write_mbps_per_osd}
+    stats["policy_counters"] = {
+        kk: v for kk, v in policy.counters.items() if v}
+    log(f"repair churn: {n_events} events ({n_transient} transient), "
+        f"eager {stats['eager_tb']} TB vs deferred "
+        f"{stats['deferred_tb']} TB "
+        f"({100 * stats['ratio_deferred_vs_eager']:.1f}%), "
+        f"{stats['cancelled']} cancelled / {stats['confirmed']} "
+        f"confirmed / {stats['urgent']} urgent")
+    return stats
+
+
+def run_repair(args) -> dict:
+    """--repair mode: the r17 day-replay cell pair (a warehouse-rate
+    day at 10k OSDs, plus a no-delay control proving the model's
+    eager and deferred paths agree when the policy is off)."""
+    t0 = time.monotonic()
+    log = (lambda *a: None) if args.json_only else print
+    churn = run_repair_churn(
+        n_osds=args.osds, pg_num=args.pg_num, size=5, m=3,
+        hours=24.0, seed=args.seed, delay_s=args.repair_delay,
+        shard_mb=args.shard_mb, events_per_osd_day=0.05,
+        transient_fraction=0.9, write_mbps_per_osd=0.5, log=log)
+    control = run_repair_churn(
+        n_osds=args.osds, pg_num=args.pg_num, size=5, m=3,
+        hours=24.0, seed=args.seed, delay_s=0.0,
+        shard_mb=args.shard_mb, events_per_osd_day=0.05,
+        transient_fraction=0.9, write_mbps_per_osd=0.5, log=log)
+    result = {
+        "schema": REPAIR_SCHEMA,
+        "cells": {"repair_churn_day": churn,
+                  "repair_churn_eager_control": control},
+        "acceptance": {
+            "deferred_vs_eager_bytes":
+                churn["ratio_deferred_vs_eager"],
+            "cancelled_fraction": round(
+                churn["cancelled"] / max(1, churn["events"]), 4),
+            "eager_control_ratio":
+                control["ratio_deferred_vs_eager"],
+        },
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    return result
+
+
 def run(args) -> dict:
     import jax
     t_all = time.monotonic()
@@ -310,6 +522,20 @@ def main(argv=None) -> None:
     ap.add_argument("--budget-2x", type=int, default=1 << 15)
     ap.add_argument("--quick", action="store_true",
                     help="tier-1 representative scale (<=1k OSDs)")
+    ap.add_argument("--repair", action="store_true",
+                    help="r17 mode: replay a day of transient+"
+                         "permanent failures at warehouse rates "
+                         "(arxiv 1309.0186) through the REAL repair "
+                         "policy in virtual time and price deferred "
+                         "vs eager repair bytes (SCALE_r17.json)")
+    ap.add_argument("--repair-delay", type=float, default=600.0,
+                    help="osd_repair_delay the --repair replay runs "
+                         "under (seconds; the reference down-out "
+                         "interval order of magnitude)")
+    ap.add_argument("--shard-mb", type=float, default=64.0,
+                    help="--repair: bytes per PG shard (MB)")
+    ap.add_argument("--seed", type=int, default=17,
+                    help="--repair: failure-trace seed")
     ap.add_argument("--out", default=None, metavar="JSON")
     ap.add_argument("--json-only", action="store_true")
     args = ap.parse_args(argv)
@@ -318,7 +544,7 @@ def main(argv=None) -> None:
         args.spare, args.fail, args.chunk = 16, 2, 1 << 11
         args.osds_2x, args.pg_num_2x = 64, 1 << 11
         args.budget_2x = 1 << 11
-    result = run(args)
+    result = run_repair(args) if args.repair else run(args)
     text = json.dumps(result, indent=1, sort_keys=True)
     if args.out:
         with open(args.out, "w") as f:

@@ -29,16 +29,30 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
              verbose: bool, op_shards: int = 1,
              osd_procs: bool = False,
              rotate_secrets: bool = False,
-             overwrite_during_faults: bool = False) -> dict:
+             overwrite_during_faults: bool = False,
+             transient_fraction: float = 0.0,
+             n_osds: int | None = None,
+             profile: str | None = None) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     if osd_procs:
         store = "tin"            # children need a real on-disk store
     tmp = tempfile.mkdtemp(prefix=f"thrash-{seed}-") \
         if store == "tin" else None
+    kwargs = {}
+    if transient_fraction:
+        # transient cells default to a wide code (m=3) so single
+        # losses keep >= 2 spare redundancy and really defer
+        kwargs["transient_fraction"] = transient_fraction
+        kwargs["n_osds"] = n_osds if n_osds is not None else 7
+        kwargs["profile"] = profile or \
+            "plugin=tpu_rs k=2 m=3 impl=bitlinear"
+    elif n_osds is not None:
+        kwargs["n_osds"] = n_osds
     th = Thrasher(seed, store=store, rounds=rounds, ops=ops,
                   store_dir=tmp, verbose=verbose, op_shards=op_shards,
                   osd_procs=osd_procs, rotate_secrets=rotate_secrets,
-                  overwrite_during_faults=overwrite_during_faults)
+                  overwrite_during_faults=overwrite_during_faults,
+                  **kwargs)
     try:
         report = th.run()
         report["ok"] = True
@@ -83,6 +97,12 @@ def main() -> int:
                          "journal must replay clean (drawn from a "
                          "dedicated seeded stream; pinned cells "
                          "replay unchanged)")
+    ap.add_argument("--transient-fraction", type=float, default=0.0,
+                    help="r17: fraction of a dedicated seeded kill "
+                         "stream whose victims AUTO-REVIVE inside/"
+                         "outside the osd_repair_delay window — the "
+                         "lazy-repair policy must cancel inside "
+                         "revives with zero moved bytes (checked)")
     ap.add_argument("--matrix", type=int, metavar="N",
                     help="run seeds 1..N instead of one --seed")
     ap.add_argument("--repro", action="store_true",
@@ -110,7 +130,8 @@ def main() -> int:
                        verbose=args.repro, op_shards=args.op_shards,
                        osd_procs=args.osd_procs,
                        rotate_secrets=args.rotate_secrets,
-                       overwrite_during_faults=args.overwrite_during_faults)
+                       overwrite_during_faults=args.overwrite_during_faults,
+                       transient_fraction=args.transient_fraction)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
