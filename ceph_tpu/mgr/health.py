@@ -26,11 +26,21 @@ def _check(code: str, severity: str, summary: str,
 def health_checks(osdmap=None, quorum: list[int] | None = None,
                   mon_members: list[int] | None = None,
                   reports=None, stale_grace: float = 15.0,
-                  pg_num: int | None = None) -> dict:
+                  pg_num: int | None = None,
+                  telemetry=None) -> dict:
     """-> {"status", "checks": [check...]}. Any argument may be None
     (a monitor answering before its first map simply has fewer
-    producers)."""
+    producers). `telemetry` (r18, a TelemetryAggregator) contributes
+    SLO_BURN / LATENCY_REGRESSION / TRACE_RING_OVERFLOW from the
+    retained metric history — quiet unless SLO rules are declared
+    (mgr_slo_rules) or a flight ring persistently overflows."""
     checks: list[dict] = []
+
+    if telemetry is not None:
+        try:
+            checks.extend(telemetry.health_checks())
+        except Exception:   # noqa: BLE001 — a telemetry bug must not
+            pass            # take down status/health itself
 
     if osdmap is not None:
         down = [o for o, up in enumerate(osdmap.osd_up) if not up]

@@ -187,12 +187,40 @@ class BalanceResult:
         }
 
 
+def telemetry_movement_budget(telemetry, base_budget: int,
+                              pool_id: int = 1,
+                              p99_ceiling_s: float | None = None) -> int:
+    """Movement budget derived from live client latency (r18 — the
+    ROADMAP item 5 hook): the base budget shrinks linearly with the
+    telemetry plane's hottest fast-window SLO burn rate (rebalancing
+    yields to suffering traffic; a fully burning SLO stops movement
+    entirely), and `p99_ceiling_s` adds a rule-free guard — when the
+    observed_client_latency feed's p99 exceeds it, movement stops
+    regardless of declared rules.
+
+    telemetry is a mgr/telemetry.TelemetryAggregator (or None: the
+    base budget passes through — offline tools without a live feed
+    keep their old semantics)."""
+    if telemetry is None or base_budget is None:
+        return base_budget
+    burn = float(telemetry.burn_rate())
+    if p99_ceiling_s is not None:
+        ocl = telemetry.observed_client_latency(pool_id)
+        if ocl.get("count") and ocl.get("p99_ms", 0.0) / 1e3 \
+                > p99_ceiling_s:
+            burn = 1.0
+    return max(0, int(base_budget * (1.0 - min(1.0, burn))))
+
+
 def batch_calc_pg_upmaps(osdmap, pool_id: int, max_deviation: int = 1,
                          max_movement: int | None = None,
                          max_src: int = 64, max_dst: int = 64,
                          max_rounds: int = 256, chunk: int = 1 << 16,
                          apply: bool = True,
-                         raw: np.ndarray | None = None) -> BalanceResult:
+                         raw: np.ndarray | None = None,
+                         telemetry=None,
+                         p99_ceiling_s: float | None = None
+                         ) -> BalanceResult:
     """One device-batched optimization run over a whole pool.
 
     max_movement is the data-movement budget in PG shards (each move
@@ -201,9 +229,19 @@ def batch_calc_pg_upmaps(osdmap, pool_id: int, max_deviation: int = 1,
     — the scale sim reuses one launch across balancer calls on an
     unchanged topology.
 
+    telemetry (r18): a TelemetryAggregator whose SLO burn rate /
+    observed client latency SHRINKS the movement budget before the
+    run (telemetry_movement_budget) — the live balancer's
+    yield-to-traffic gate. Requires max_movement (an unbounded run
+    has no budget to shrink).
+
     Returns a BalanceResult; with apply=True the winning upmap set is
     landed on the map as ONE epoch (set_pg_upmap_bulk).
     """
+    if telemetry is not None and max_movement is not None:
+        max_movement = telemetry_movement_budget(
+            telemetry, max_movement, pool_id=pool_id,
+            p99_ceiling_s=p99_ceiling_s)
     t_all = time.monotonic()
     crush = osdmap.crush
     pool = osdmap.pools[pool_id]

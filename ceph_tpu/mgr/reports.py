@@ -192,6 +192,25 @@ def prometheus_text(agg: MgrReportAggregator,
                                  f"{val.get('sum', 0)!r}")
                     lines.append(f"{metric}_count{label} "
                                  f"{val.get('avgcount', 0)}")
+                elif kind == "lhist":
+                    # r18: REAL `# TYPE ... histogram` exposition for
+                    # the mergeable latency histograms — cumulative
+                    # _bucket/_sum/_count with le in SECONDS, never
+                    # flattened to gauges
+                    from ..utils.perf_counters import lhist_bucket_le
+                    buckets = (val or {}).get("buckets") or []
+                    total = 0
+                    for i, b in enumerate(buckets[:-1]):
+                        total += b
+                        lines.append(
+                            f'{metric}_bucket{{daemon="{dname}",'
+                            f'le="{lhist_bucket_le(i)!r}"}} {total}')
+                    total += buckets[-1] if buckets else 0
+                    lines.append(f'{metric}_bucket{{daemon="{dname}",'
+                                 f'le="+Inf"}} {total}')
+                    lines.append(f"{metric}_sum{label} "
+                                 f"{(val or {}).get('sum', 0.0)!r}")
+                    lines.append(f"{metric}_count{label} {total}")
                 elif kind == "histogram":
                     total = 0
                     for i, b in enumerate(val[:-1]):
@@ -212,7 +231,7 @@ def prometheus_text(agg: MgrReportAggregator,
 
 def _guess_kind(val) -> str:
     if isinstance(val, dict):
-        return "time_avg"
+        return "lhist" if "buckets" in val else "time_avg"
     if isinstance(val, list):
         return "histogram"
     return "counter"
@@ -220,4 +239,5 @@ def _guess_kind(val) -> str:
 
 def _prom_type(kind: str) -> str:
     return {"counter": "counter", "gauge": "gauge",
-            "time_avg": "summary", "histogram": "histogram"}[kind]
+            "time_avg": "summary", "histogram": "histogram",
+            "lhist": "histogram"}[kind]

@@ -11,14 +11,27 @@ spans ordered causally across daemons, a queue/crypto/encode/store/
 wire attribution summary, and Chrome trace-event JSON for
 chrome://tracing / Perfetto.
 
-Gap semantics (disclosed; ARCHITECTURE "Distributed tracing (r15)"):
-spans arrive best-effort — a ring may evict before shipping, an
-unsampled hop records nothing, a retro trace carries only the hops
-that kept OpTracker history. The assembler therefore never interpolates:
-time inside the root not covered by any recorded span is reported as
-`wire` (wire + untraced host work), and a trace whose root never
-arrived is summarized over its longest span instead. Wall-clock
-ordering across daemons leans on the single-host shared clock.
+Gap semantics (disclosed; ARCHITECTURE "Distributed tracing (r15)",
+updated r18): spans arrive best-effort — a ring may evict before
+shipping, an unsampled hop records nothing. The assembler never
+interpolates: time inside the root not covered by any recorded span
+is reported as `wire` — which since r18 means WIRE SERIALIZATION plus
+untraced host work only: retro traces now cover replica hops too
+(sub-op service windows published from the daemons' retro rings as
+retro.subop / retro.store.apply spans under the deterministic retro
+root), so replica store time no longer masquerades as wire. A trace
+whose root never arrived is summarized over its longest span instead.
+Wall-clock ordering across daemons leans on the single-host shared
+clock.
+
+r18 additionally folds sampled traces into CONTINUOUS critical-path
+profiles: per wall-clock interval, the summed per-category self time
+across every trace whose root started in that interval — attribution
+drift (queue share creeping up, store share exploding after a device
+change) becomes a first-class time-series instead of a one-off
+`trace <id>` (the 1709.05365 bottleneck-migration lesson). Evicted
+traces fold into the profile PERMANENTLY before leaving the LRU, so
+the profile's horizon outlives the trace store's.
 """
 
 from __future__ import annotations
@@ -51,6 +64,9 @@ CATEGORY_OF = {
     "retro.reached_pg": "queue",
     "retro.commit_sent": "other",
     "retro.done": "other",
+    # r18: replica-published retro sub-op spans (the subop retro ring)
+    "retro.subop": "store",
+    "retro.store.apply": "store",
 }
 
 #: every summary carries exactly these keys (schema-pinned by
@@ -151,13 +167,25 @@ class TraceAssembler:
     the benches to assemble in-process rings)."""
 
     def __init__(self, max_traces: int = 512,
-                 max_spans_per_trace: int = 4096):
+                 max_spans_per_trace: int = 4096,
+                 config=None, profile_interval: float = 10.0,
+                 max_profile_intervals: int = 256):
         self._max_traces = int(max_traces)
         self._max_spans = int(max_spans_per_trace)
         #: trace_id(hex) -> {"spans": [..], "stamp": monotone counter}
         self._traces: dict[str, dict] = {}
         self._tick = 0
         self._lock = threading.Lock()
+        # r18 continuous critical-path profile: interval bucket ->
+        # settled per-category self-time sums (traces fold here
+        # PERMANENTLY on LRU eviction; live traces fold on demand in
+        # profile()). Interval tracks mgr_history_interval when a
+        # config is given so the profile series aligns with the
+        # telemetry plane's metric series.
+        self._config = config
+        self._profile_interval = float(profile_interval)
+        self._max_profile = int(max_profile_intervals)
+        self._settled: dict[int, dict] = {}
 
     def ingest(self, spans: list[dict]) -> None:
         """Fold a daemon's drained spans (dicts in FlightRecorder
@@ -184,7 +212,78 @@ class TraceAssembler:
                 for tid in sorted(self._traces,
                                   key=lambda t:
                                   self._traces[t]["stamp"])[:over]:
+                    # settle the evicted trace into the continuous
+                    # profile first — the rollup's horizon must
+                    # outlive the LRU
+                    self._settle_profile_locked(
+                        self._traces[tid]["spans"])
                     del self._traces[tid]
+
+    # -- continuous critical-path profile (r18) -------------------------------
+
+    def _iv(self) -> float:
+        if self._config is not None:
+            try:
+                iv = float(self._config.get("mgr_history_interval"))
+                if iv > 0:
+                    return iv
+            except (KeyError, TypeError, ValueError):
+                pass
+        return self._profile_interval
+
+    def _settle_profile_locked(self, spans: list[dict]) -> None:
+        if not spans:
+            return
+        cp = critical_path(spans)
+        bucket = int(min(s["start"] for s in spans) / self._iv())
+        row = self._settled.setdefault(
+            bucket, {c: 0.0 for c in CATEGORIES}
+            | {"total": 0.0, "traces": 0})
+        for c in CATEGORIES:
+            row[c] += cp.get(c, 0.0)
+        row["total"] += cp.get("total", 0.0)
+        row["traces"] += 1
+        over = len(self._settled) - self._max_profile
+        if over > 0:
+            for b in sorted(self._settled)[:over]:
+                del self._settled[b]
+
+    def profile(self, limit: int = 32) -> dict:
+        """Per-interval critical-path attribution series (the
+        `profile` mon command / `ceph_cli profile` body): settled
+        (evicted) traces + an on-demand fold of every trace still in
+        the store. Shares are per-category self time over the
+        interval's summed root time — the drift signal."""
+        iv = self._iv()
+        with self._lock:
+            rows = {b: dict(r) for b, r in self._settled.items()}
+            live = [list(e["spans"]) for e in self._traces.values()]
+        for spans in live:
+            if not spans:
+                continue
+            cp = critical_path(spans)
+            bucket = int(min(s["start"] for s in spans) / iv)
+            row = rows.setdefault(
+                bucket, {c: 0.0 for c in CATEGORIES}
+                | {"total": 0.0, "traces": 0})
+            for c in CATEGORIES:
+                row[c] += cp.get(c, 0.0)
+            row["total"] += cp.get("total", 0.0)
+            row["traces"] += 1
+        out = []
+        for b in sorted(rows)[-int(limit):]:
+            row = rows[b]
+            total = row["total"] or 1e-12
+            out.append({
+                "bucket": b,
+                "t": round(b * iv, 3),
+                "traces": row["traces"],
+                "self_s": {c: round(row[c], 6) for c in CATEGORIES},
+                "total_s": round(row["total"], 6),
+                "share": {c: round(row[c] / total, 4)
+                          for c in CATEGORIES},
+            })
+        return {"interval_s": iv, "intervals": out}
 
     # -- views ----------------------------------------------------------------
 

@@ -118,7 +118,8 @@ def msgr_perf_counters():
             .add_u64_counter("rx_compressed", "frames inflated on rx")
             .add_time_avg("crc_time", "frame crc32c compute (crc mode)")
             .add_time_avg("seal_time",
-                          "AEAD seal incl. staging (secure mode)")
+                          "AEAD seal incl. staging (secure mode)",
+                          hist=True)
             .add_time_avg("open_time", "AEAD open (secure mode)")
             # reactor event-loop occupancy (the AsyncMessenger worker
             # counters: msgr_active_connections / worker event time)

@@ -103,12 +103,15 @@ def ec_perf_counters():
             .add_u64_counter("recover_wire_bytes",
                              "helper bytes pulled for recovery (the "
                              "repair-bytes-on-wire numerator)")
-            .add_time_avg("encode_time", "write-path encode wall time")
-            .add_time_avg("decode_time", "read-path decode wall time")
+            .add_time_avg("encode_time", "write-path encode wall time",
+                          hist=True)
+            .add_time_avg("decode_time", "read-path decode wall time",
+                          hist=True)
             .add_time_avg("recover_stage_time",
                           "recovery host staging (producer thread)")
             .add_time_avg("recover_launch_time",
-                          "recovery launch enqueue + async D2H start")
+                          "recovery launch enqueue + async D2H start",
+                          hist=True)
             .add_time_avg("recover_fetch_time",
                           "blocking remainder of the D2H fetch "
                           "(overlap eats the rest)")

@@ -70,8 +70,7 @@ import numpy as np
 
 from ..msgr.messenger import Message, Messenger, register_message
 from ..utils.encoding import Decoder, Encoder
-from ..utils.flight_recorder import current_sampled as \
-    _trace_current_sampled
+from ..utils.flight_recorder import current as _trace_current
 from ..utils.flight_recorder import declare_span_names
 from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
@@ -913,12 +912,16 @@ class RemoteStore:
         self._on_latency = on_latency
 
     def _submit(self, kind: str, body):
-        # trace propagation (r15): whatever sampled context is active
-        # on THIS thread (a client op mid-fan-out, a recovery round
-        # mid-pull) rides the sub-op frame, so the helper's spans land
-        # under the same trace. Unsampled/absent context costs one
+        # trace propagation (r15/r18): whatever context is active on
+        # THIS thread (a client op mid-fan-out, a recovery round
+        # mid-pull) rides the sub-op frame. Sampled contexts make the
+        # helper's spans land under the same trace eagerly; since r18
+        # an UNSAMPLED context travels too (17 bytes) so the serving
+        # hop can remember its window in the sub-op retro ring — what
+        # lets a later slow-op retro assembly cover replicas instead
+        # of reporting their time as wire. Absent context costs one
         # contextvar read and zero wire bytes.
-        ctx = _trace_current_sampled()
+        ctx = _trace_current()
         return self._rpc.submit(
             self._peer,
             lambda rid: MStoreOp(rid, True, kind, body, trace=ctx))
@@ -1799,7 +1802,8 @@ class OSDDaemon:
 
     _STORE_READ_KINDS = frozenset(
         {"read", "readv", "readv_ranges", "rmw_fetch", "stat",
-         "getattr", "exists", "ls", "omap_get", "omap_iter"})
+         "getattr", "exists", "ls", "omap_get", "omap_iter",
+         "retro_publish"})
 
     def _on_store_op(self, peer: str, msg: MStoreOp) -> None:
         # the store plane is ticket-gated exactly like the client op
@@ -1817,6 +1821,19 @@ class OSDDaemon:
                     pass
                 return
         try:
+            # r18: retro span publication is a daemon-level command,
+            # not a store op — answer before the store lock
+            if msg.kind == "retro_publish":
+                d = Decoder(msg.blob)
+                e = Encoder()
+                e.u32(self._retro_publish(d.u64()))
+                rep = MStoreReply(msg.req_id, True, msg.kind,
+                                  e.bytes())
+                try:
+                    self.msgr.send(peer, rep)
+                except (KeyError, OSError, ConnectionError):
+                    pass
+                return
             # r15: a sampled context on the frame puts this hop's
             # spans under the originating trace — osd.subop covers the
             # whole service (store-lock wait + reply encode), with the
@@ -1825,14 +1842,25 @@ class OSDDaemon:
             from ..utils.flight_recorder import activate, trace_span
             ctx = msg.trace if msg.trace is not None \
                 and msg.trace.sampled else None
+            t0w, t0 = time.time(), time.perf_counter()
+            apply_s = 0.0
             with activate(ctx, self.flight if ctx is not None
                           else None):
                 with trace_span("osd.subop", kind=msg.kind):
                     with self.perf.time("subop_latency"):
                         with self._store_lock:
+                            ta = time.perf_counter()
                             with trace_span("store.apply"):
                                 blob = self._store_op(msg.kind,
                                                       msg.blob)
+                            apply_s = time.perf_counter() - ta
+            # r18: an UNSAMPLED context still carries the trace id —
+            # remember this hop's window so a later slow-op retro
+            # assembly covers the replica too (the sampled case
+            # already recorded eagerly above)
+            if msg.trace is not None and not msg.trace.sampled:
+                self._subop_note(msg.trace, msg.kind, t0w,
+                                 time.perf_counter() - t0, apply_s)
             self.perf.inc_many((("subop", 1),
                                 ("subop_in_bytes", len(msg.blob)),
                                 ("subop_out_bytes", len(blob))))
@@ -3051,8 +3079,23 @@ class OSDDaemon:
                           "unchainable incremental (gap/fresh boot)")
          .add_time_avg("op_latency",
                        "client op wall time (tracker enter to reply "
-                       "built)")
-         .add_time_avg("subop_latency", "store sub-op service time"))
+                       "built)", hist=True)
+         .add_time_avg("op_r_latency",
+                       "read-kind client op wall time (the "
+                       "client_read SLO feed)", hist=True)
+         .add_time_avg("op_w_latency",
+                       "write-kind client op wall time (the "
+                       "client_write SLO feed)", hist=True)
+         .add_time_avg("subop_latency", "store sub-op service time",
+                       hist=True)
+         .add_u64("trace_dropped_unshipped",
+                  "flight-ring spans evicted before an MgrReport "
+                  "shipped them (persistent growth -> "
+                  "TRACE_RING_OVERFLOW)")
+         .add_u64_counter("retro_subop_published",
+                          "retro.subop spans published from the "
+                          "sub-op retro ring on a peer's slow-op "
+                          "fan-out"))
         # r17 repair-policy counters: declared from the policy
         # module's ONE list so the daemon schema and the policy's own
         # counter dict cannot drift (the r9 declared-names rule)
@@ -3068,6 +3111,19 @@ class OSDDaemon:
         self._mgr_seq = 0
         self._mgr_last_perf: dict | None = None
         self._mgr_last_sent = 0.0
+        # r18 telemetry plane: per-interval counter/histogram deltas,
+        # bounded, live-tuned (mgr_history_interval/_len); entries
+        # drain into MgrReports, `perf history` answers locally
+        from ..utils.perf_counters import MetricsHistory
+        self.metrics_history = MetricsHistory(self.perf_dump_all,
+                                              config=self.config)
+        # r18 sub-op retro ring (the r15 replica gap): completed store
+        # sub-ops remembered by carried trace id so a primary's slow-op
+        # retro assembly can pull this hop's timing after the fact
+        # (retro_publish). In-RAM, dies with the process like the
+        # flight ring.
+        self._subop_ring: list[dict] = []
+        self._subop_ring_lock = threading.Lock()
 
     # -- perf dump assembly (admin socket + wire admin op + MgrReport) -------
 
@@ -3118,6 +3174,7 @@ class OSDDaemon:
                              "snap_read", "admin"})
 
     _ADMIN_CMDS = ("perf dump", "perf reset", "perf schema",
+                   "perf history",
                    "dump_historic_ops",
                    "dump_historic_ops_by_duration",
                    "dump_ops_in_flight", "slow_ops", "pg stat",
@@ -3181,6 +3238,12 @@ class OSDDaemon:
         if cmd == "perf reset":
             self.perf_reset_all()
             return {"success": True}
+        if cmd.startswith("perf history"):
+            # the r18 metric-history ring: per-interval deltas,
+            # optional trailing-entry limit
+            arg = cmd[len("perf history"):].strip()
+            return self.metrics_history.dump(
+                limit=int(arg) if arg else None)
         if cmd == "dump_historic_ops":
             return self.op_tracker.dump_historic_ops()
         if cmd == "dump_historic_ops_by_duration":
@@ -3421,14 +3484,95 @@ class OSDDaemon:
                                {"kind": msg.kind})
         return activate(ctx, self.flight)
 
-    def _maybe_retro_trace(self, op, ctx) -> None:
+    def _maybe_retro_trace(self, op, ctx, ps: int | None = None) -> None:
         """Retroactive capture (r15): an UNSAMPLED op that crossed the
         live complaint threshold converts its OpTracker events into
         retro.* ring spans under the carried trace id — `ceph_cli
-        trace <id>` can then assemble a timeline nobody sampled."""
-        if (ctx is not None and not ctx.sampled and op.done
-                and op.duration > self.op_tracker.complaint_time):
-            self.flight.record_tracked(op, ctx)
+        trace <id>` can then assemble a timeline nobody sampled.
+
+        r18 closes the replica gap: the primary additionally asks the
+        PG's acting set to publish matching retro.subop spans from
+        their sub-op retro rings (fire-and-forget retro_publish store
+        frames; the spans drain through each replica's OWN MgrReports
+        under the deterministic retro root id), so the assembled
+        timeline covers client + primary + replicas instead of
+        reporting replica time as wire."""
+        if (ctx is None or ctx.sampled or not op.done
+                or op.duration <= self.op_tracker.complaint_time):
+            return
+        self.flight.record_tracked(op, ctx)
+        if ps is None \
+                or int(self.config["osd_subop_retro_ring"]) <= 0:
+            return
+        with self._lock:
+            be = self.backends.get(ps)
+            acting = list(dict.fromkeys(be.acting)) if be is not None \
+                else []
+        e = Encoder()
+        e.u64(ctx.trace_id)
+        body = e.bytes()
+        n = len(self.osdmap.osd_up) if self.osdmap is not None else 0
+        for o in acting:
+            if not _valid_osd(o, n) or o == self.osd_id:
+                continue
+            try:
+                # submit-and-cancel: the frame is transmitted now, the
+                # window slot freed immediately, the reply dropped —
+                # the publish happens replica-side regardless, and a
+                # dead replica costs nothing here
+                self.rpc.submit(
+                    f"osd.{o}",
+                    lambda rid, b=body: MStoreOp(rid, True,
+                                                 "retro_publish",
+                                                 b)).cancel()
+            except (KeyError, OSError, ConnectionError):
+                continue
+
+    def _subop_note(self, ctx, kind: str, start_wall: float,
+                    dur: float, apply_s: float) -> None:
+        """Remember one completed UNSAMPLED sub-op keyed by its
+        carried trace id (the minimal OpTracker-style event ring of
+        the r18 satellite) — retro_publish converts matches into
+        flight-ring spans when the origin op turns out slow."""
+        cap = int(self.config["osd_subop_retro_ring"])
+        if cap <= 0:
+            return
+        rec = {"tid": ctx.trace_id, "parent": ctx.parent_span_id,
+               "kind": kind, "start": start_wall,
+               "dur": dur, "apply": apply_s}
+        with self._subop_ring_lock:
+            self._subop_ring.append(rec)
+            over = len(self._subop_ring) - cap
+            if over > 0:
+                del self._subop_ring[:over]
+
+    def _retro_publish(self, trace_id: int) -> int:
+        """Publish this daemon's remembered sub-op windows for one
+        trace into its flight ring as retro.subop (+ nested
+        retro.store.apply) spans under the deterministic retro root —
+        they reach the monitors' assemblers through the normal
+        MgrReport drain."""
+        from ..utils.flight_recorder import new_trace_id, retro_root_id
+        root = retro_root_id(trace_id)
+        with self._subop_ring_lock:
+            matches = [r for r in self._subop_ring
+                       if r["tid"] == trace_id]
+        for r in matches:
+            sid = new_trace_id()
+            self.flight.record(trace_id, sid, root, "retro.subop",
+                               r["start"], r["dur"],
+                               {"kind": r["kind"], "retro": True})
+            if r["apply"] > 0:
+                # the apply is the service tail (store-lock wait
+                # precedes it)
+                self.flight.record(
+                    trace_id, new_trace_id(), sid,
+                    "retro.store.apply",
+                    r["start"] + max(0.0, r["dur"] - r["apply"]),
+                    r["apply"])
+        if matches:
+            self.perf.inc("retro_subop_published", len(matches))
+        return len(matches)
 
     def _serve_client_op(self, peer: str, msg: MOSDOp,
                          sub_ops, t_enq: float | None = None) -> None:
@@ -3466,21 +3610,36 @@ class OSDDaemon:
     def _one_client_op(self, peer: str, kind: str, body: bytes) -> bytes:
         from ..utils.flight_recorder import current
         from ..utils.tracing import span
+        ps = self._op_ps(body)
+        is_read = kind in self._READ_KINDS
+        t0 = time.perf_counter()
         with span("osd.op", counters=self.perf, key="op_latency"):
             with self.op_tracker.create_op(
                     f"osd_op({kind}) client={peer}") as op:
+                # DEBUG latency injection (osd_inject_op_delay, live
+                # central config): the deterministic slowness source
+                # the SLO-burn tests drive — inside the tracked op so
+                # history/complaints/histograms all see it, before
+                # the PG lock so independent PGs aren't convoyed
+                inject = float(self.config["osd_inject_op_delay"])
+                if inject > 0:
+                    time.sleep(inject)
                 # per-PG execution lock, not the daemon lock: ops to
                 # independent PGs really do run concurrently across
                 # shards; reconcile/recovery exclude themselves per PG
                 # (they take self._lock THEN the PG locks they touch)
-                with self._pg_lock(self._op_ps(body)):
+                with self._pg_lock(ps):
                     op.mark_event("reached_pg")
                     blob = self._client_op(kind, body)
                 op.mark_event("commit_sent")
-        self._maybe_retro_trace(op, current())
+        # r18: the read/write split the client_read/client_write SLO
+        # feeds merge (same sample the op_latency pair took)
+        self.perf.tinc("op_r_latency" if is_read else "op_w_latency",
+                       time.perf_counter() - t0)
+        self._maybe_retro_trace(op, current(), ps)
         self.perf.inc_many(
             (("op", 1),
-             ("op_r" if kind in self._READ_KINDS else "op_w", 1),
+             ("op_r" if is_read else "op_w", 1),
              ("op_in_bytes", len(body)),
              ("op_out_bytes", len(blob))))
         return blob
@@ -4067,6 +4226,10 @@ class OSDDaemon:
             # deep scrub cannot push our liveness past peers' grace
             self._maybe_scheduled_scrub()
             try:
+                # r18: close the current metric-history interval (if
+                # its wall-clock boundary passed) BEFORE reporting so
+                # the fresh entry ships on this same beat
+                self.metrics_history.maybe_tick()
                 self._maybe_mgr_report()
             except Exception as e:  # noqa: BLE001 — stats shipping
                 # must never kill the heartbeat thread
@@ -4110,6 +4273,19 @@ class OSDDaemon:
         spans = self.flight.drain(512)
         if spans:
             report["spans"] = spans
+        # r18: freshly recorded metric-history intervals ride along
+        # (normally 0-1 entries per report) into the monitors'
+        # TelemetryAggregators, plus the flight ring's overflow
+        # accounting (the TRACE_RING_OVERFLOW source — a declared
+        # gauge AND a report field, so the aggregation never scrapes
+        # ring internals)
+        history = self.metrics_history.drain_unshipped()
+        if history:
+            report["history"] = history
+        fstats = self.flight.stats()
+        self.perf.set("trace_dropped_unshipped",
+                      fstats["dropped_unshipped"])
+        report["flight"] = fstats
         self._mgr_last_perf = perf
         # PG states want the daemon lock; never stall the heartbeat
         # for them — a busy beat ships without, and the aggregator
@@ -4171,6 +4347,30 @@ class OSDDaemon:
                 "osd", self.c.key_server.export_rotating("osd"))
         fresh._start()
         return fresh
+
+
+class _MonConfigView:
+    """Read-only config resolver for a monitor (r18): committed-map
+    config KV (coerced through the option schema) over g_conf's
+    file/default layers. Monitors never carried a per-daemon Config;
+    the telemetry plane's live options (mgr_slo_rules,
+    mgr_history_interval, ...) need the committed layer visible."""
+
+    def __init__(self, mon: "MonDaemon"):
+        self._mon = mon
+
+    def get(self, name: str):
+        from ..utils.config import g_conf
+        osdmap = self._mon.osdmap
+        kv = osdmap.config_kv if osdmap is not None else {}
+        if name in kv:
+            opt = g_conf.schema.get(name)
+            return opt.coerce(kv[name]) if opt is not None \
+                else kv[name]
+        return g_conf.get(name)
+
+    def __getitem__(self, name: str):
+        return self.get(name)
 
 
 class MonDaemon:
@@ -4264,18 +4464,38 @@ class MonDaemon:
                      .add_u64("osdmap_epoch", "committed map epoch")
                      .create_perf_counters())
         self.mgr = MgrReportAggregator()
+        # r18: a monitor config view layering the COMMITTED map's
+        # config KV over g_conf defaults — what lets `config set
+        # mgr_slo_rules ...` retune a running monitor's telemetry
+        # evaluation (daemons get the same via their own layered
+        # config; monitors never built one)
+        self.conf_view = _MonConfigView(self)
         # r15: per-monitor trace assembler — every monitor stitches
         # the span streams riding the MgrReport pipe independently,
-        # so any one of them can answer `ceph_cli trace`
+        # so any one of them can answer `ceph_cli trace`; r18 gives it
+        # the config view so its continuous critical-path profile
+        # aligns with the telemetry plane's history intervals
         from ..mgr.tracing import TraceAssembler
-        self.traces = TraceAssembler()
+        self.traces = TraceAssembler(config=self.conf_view)
+        # r18 telemetry plane: every monitor independently folds the
+        # history entries riding MgrReports into cluster time-series,
+        # merged quantiles, SLO burn verdicts, and the observed-
+        # client-latency feed
+        from ..mgr.telemetry import TelemetryAggregator
+        self.telemetry = TelemetryAggregator(config=self.conf_view)
+        from ..utils.perf_counters import MetricsHistory
+        self.metrics_history = MetricsHistory(
+            lambda: {self.perf.name: self.perf.dump(),
+                     "msgr": self.msgr.perf.dump()},
+            config=self.conf_view)
         self._mgr_seq = 0
         self._mgr_last_sent = 0.0
         from ..utils.admin_socket import AdminSocket
         self.asok = AdminSocket(cluster.asok_path(self.name))
         for _cmd in ("status", "health", "health detail", "prometheus",
                      "perf dump", "perf schema", "report dump",
-                     "mon_status", "log dump", "autoscale status"):
+                     "mon_status", "log dump", "autoscale status",
+                     "telemetry", "slo", "top", "profile"):
             self.asok.register(_cmd,
                                lambda args, c=_cmd: self._mon_cmd_obj(c))
         # argumented: `trace slow` / `trace list` / `trace <id-hex>`
@@ -4695,6 +4915,18 @@ class MonDaemon:
             # counters and would churn the daemon staleness state).
             if report.get("spans"):
                 self.traces.ingest(report["spans"])
+            # r18: history entries, flight overflow accounting, and
+            # client-shipped observed-latency histograms feed the
+            # telemetry plane (same pipe, independent consumers)
+            if report.get("history"):
+                self.telemetry.ingest(report.get("name", "?"),
+                                      report["history"])
+            if report.get("flight") is not None:
+                self.telemetry.note_flight(report.get("name", "?"),
+                                           report["flight"])
+            if report.get("client_perf"):
+                self.telemetry.ingest_client(report.get("name", "?"),
+                                             report["client_perf"])
             if report.get("kind") != "trace":
                 self.mgr.ingest(report)
             self.perf.inc("mgr_reports_rx")
@@ -4725,6 +4957,19 @@ class MonDaemon:
             "schema": {self.perf.name: self.perf.schema(),
                        "msgr": self.msgr.perf.schema()},
         }
+        # r18: the monitor is a telemetry citizen too — on the
+        # broadcast cadence, tick its own history ring, fold fresh
+        # entries into its OWN aggregator (no wire hop) and ship them
+        # to peers with the report
+        if broadcast:
+            try:
+                self.metrics_history.maybe_tick()
+                history = self.metrics_history.drain_unshipped()
+                if history:
+                    report["history"] = history
+                    self.telemetry.ingest(self.name, history)
+            except Exception:   # noqa: BLE001 — observability must
+                pass            # not break the monitor's reporting
         self.mgr.ingest(report)
         if broadcast:
             import json as _json
@@ -4753,7 +4998,8 @@ class MonDaemon:
             mon_members=self._members(),
             reports=self.mgr,
             stale_grace=float(g_conf["mgr_stale_report_grace"]),
-            pg_num=self.c.pg_num)
+            pg_num=self.c.pg_num,
+            telemetry=self.telemetry)
         if not detail:
             for c in res["checks"]:
                 c.pop("detail", None)
@@ -4832,6 +5078,21 @@ class MonDaemon:
             if self.osdmap is None:
                 return []
             return autoscale_from_reports(self.mgr, self.osdmap)
+        if kind == "telemetry":
+            # r18: cluster time-series + merged quantiles + the
+            # observed-client-latency feed + SLO verdicts
+            return self.telemetry.dump()
+        if kind == "slo":
+            return {"rules": self.telemetry.slo_status(),
+                    "burn_rate": self.telemetry.burn_rate(),
+                    "regressions": self.telemetry.regressions()}
+        if kind == "top":
+            # per-daemon rates over the newest history interval
+            return self.telemetry.top(reports=self.mgr)
+        if kind == "profile":
+            # continuous critical-path attribution series (sampled
+            # traces folded per interval — the drift view)
+            return self.traces.profile()
         if kind == "trace list":
             return {"traces": self.traces.list_traces()}
         if kind == "trace slow":
@@ -5479,6 +5740,7 @@ class Client:
         self.flight = FlightRecorder(name)
         self.last_trace_id: int = 0     # newest SAMPLED trace stamped
         self._trace_flushed = 0.0
+        self._perf_shipped_count = 0    # op_lat samples last shipped
         self.perf = (PerfCountersBuilder("client")
                      .add_u64_counter("hedge_issued",
                                       "duplicate shard reads sent "
@@ -5500,6 +5762,12 @@ class Client:
                      .add_u64_counter("degraded_served",
                                       "ops settled by a degraded "
                                       "shard reply")
+                     .add_time_avg("op_lat",
+                                   "client-observed frame time "
+                                   "(submit -> reply, wire and "
+                                   "window wait included) — the r18 "
+                                   "observed_client_latency feed",
+                                   hist=True)
                      .create_perf_counters())
         # per-target read-latency EWMA: orders the fallback/hedge
         # candidates ("next-best shard")
@@ -5759,19 +6027,26 @@ class Client:
         return TraceContext(new_trace_id(), new_trace_id(), False)
 
     def _flush_trace_spans(self, force: bool = False) -> None:
-        """Ship this client's freshly finished spans to the monitors'
-        assemblers (clients have no MgrReport heartbeat — they flush
+        """Ship this client's freshly finished spans — plus its
+        CUMULATIVE observed-latency counters (r18: the true
+        client-side half of observed_client_latency) — to the
+        monitors (clients have no MgrReport heartbeat — they flush
         after op rounds, throttled)."""
         import json as _json
         now = time.monotonic()
         if not force and now - self._trace_flushed < 1.0:
             return
-        if not self.flight.pending_ship():
+        perf = self.perf.dump()
+        new_samples = (perf.get("op_lat") or {}).get("avgcount", 0) \
+            != self._perf_shipped_count
+        if not self.flight.pending_ship() and not new_samples:
             return
         self._trace_flushed = now
+        self._perf_shipped_count = \
+            (perf.get("op_lat") or {}).get("avgcount", 0)
         spans = self.flight.drain(512)
         blob = _json.dumps({"name": self.msgr.name, "kind": "trace",
-                            "spans": spans},
+                            "spans": spans, "client_perf": perf},
                            separators=(",", ":")).encode()
         for mon in self.c.mon_names():
             try:
@@ -5805,6 +6080,10 @@ class Client:
         prev = self._lat_ewma.get(tgt)
         self._lat_ewma[tgt] = dt if prev is None \
             else 0.75 * prev + 0.25 * dt
+        # r18: the same sample feeds the mergeable client-observed
+        # histogram (ships with trace flushes -> the monitors'
+        # observed_client_latency feed)
+        self.perf.tinc("op_lat", dt)
         self._tgt_suspect.pop(tgt, None)   # it answered: complaint over
 
     def _suspect_target(self, tgt: str) -> None:

@@ -208,6 +208,45 @@ OPTIONS: list[Option] = [
     Option("mgr_stale_report_grace", float, 15.0,
            "report age past which a daemon's PGs count as stale "
            "(the PG_STALE health source)", min=0.1),
+    Option("mgr_history_interval", float, 10.0,
+           "seconds per metric-history interval (r18 telemetry "
+           "plane): each daemon's MetricsHistory ring records one "
+           "counter/histogram delta per wall-clock-aligned interval "
+           "and ships new entries in its MgrReports; 0 disables the "
+           "ring entirely (the overhead-guard OFF arm). Live: a "
+           "committed `config set` retunes running rings", min=0.0),
+    Option("mgr_history_len", int, 90,
+           "per-daemon MetricsHistory ring length in intervals "
+           "(bounds daemon memory; the monitors' cluster series are "
+           "bounded separately)", min=4),
+    Option("mgr_slo_rules", str, "",
+           "declared latency SLO rules, ';'-separated, each "
+           "'<feed>_p<Q> < <value><us|ms|s> over <window><s|m|h>' — "
+           "e.g. 'client_read_p99 < 50ms over 5m'. Feeds: "
+           "client_read/client_write/client_op/subop (merged OSD "
+           "histograms), client_observed (client-shipped), or an "
+           "explicit <logger>.<lhist-key>. Evaluated per history "
+           "interval into fast/slow burn-rate windows; breaches "
+           "surface as the SLO_BURN health check and shrink the "
+           "balancer movement budget. Empty = no SLO evaluation"),
+    Option("mgr_latency_regression_factor", float, 4.0,
+           "LATENCY_REGRESSION sensitivity: warn when a declared SLO "
+           "feed's newest-interval p99 exceeds this multiple of the "
+           "trailing-interval median (needs >= 3 baseline intervals "
+           "and >= 16 samples in the newest; 0 disables the check)",
+           min=0.0),
+    Option("osd_subop_retro_ring", int, 256,
+           "completed store sub-ops a daemon remembers (trace id + "
+           "service/apply windows) so RETRO trace assembly covers "
+           "replica hops too — the r15 gap where replica time "
+           "reported as wire. A primary crossing the complaint "
+           "threshold asks its acting set to publish matching "
+           "retro.subop spans from this ring. 0 disables", min=0),
+    Option("osd_inject_op_delay", float, 0.0,
+           "DEBUG: seconds of sleep injected into every client op's "
+           "execution (the deterministic slowness source the SLO-burn "
+           "tests drive; the osd_debug_inject_dispatch_delay role). "
+           "Live via central config; 0 = off", min=0.0),
 ]
 
 

@@ -59,6 +59,7 @@ __all__ = [
     "TraceContext", "FlightRecorder", "trace_span", "activate",
     "current", "current_sampled", "declare_span_names",
     "is_span_declared", "declared_span_names", "new_trace_id",
+    "retro_root_id",
 ]
 
 #: every span name the flight recorder may record — the span-name
@@ -81,9 +82,26 @@ def is_span_declared(name: str) -> bool:
 
 # names this module itself emits (the retro.* family from
 # record_tracked; retro event names outside the allowlist fold into
-# the root span's tags instead of minting undeclared span names)
+# the root span's tags instead of minting undeclared span names).
+# retro.subop / retro.store.apply are the r18 replica-hop spans: a
+# primary crossing the complaint threshold asks its acting set to
+# publish them from their sub-op retro rings (standalone's
+# retro_publish store op), closing the r15 gap where replica time
+# retro-assembled as "wire".
 _RETRO_EVENTS = ("reached_pg", "commit_sent", "done")
-declare_span_names("retro.op", *(f"retro.{e}" for e in _RETRO_EVENTS))
+declare_span_names("retro.op", "retro.subop", "retro.store.apply",
+                   *(f"retro.{e}" for e in _RETRO_EVENTS))
+
+
+def retro_root_id(trace_id: int) -> int:
+    """The DETERMINISTIC span id of a trace's retro.op root: derived
+    from the trace id alone, so replicas publishing retro.subop spans
+    (which never saw the primary's retro conversion) parent them
+    under the same root the primary minted — the assembler then
+    subtracts sub-op time from the root's self time instead of
+    double-counting it."""
+    return ((int(trace_id) ^ 0x9E3779B97F4A7C15)
+            & 0x7FFFFFFFFFFFFFFF) | 1
 
 
 #: ids come from a module-level RNG seeded from the OS, never the
@@ -259,7 +277,9 @@ class FlightRecorder:
         dur = op.duration
         end_wall = getattr(op, "t_end_wall", time.time())
         start_wall = end_wall - dur
-        root = new_trace_id()
+        # deterministic root id: replica-published retro.subop spans
+        # parent under this same id without any coordination
+        root = retro_root_id(ctx.trace_id)
         extra = []
         prev_t = 0.0
         for t_rel, ev in op.events:
@@ -318,6 +338,17 @@ class FlightRecorder:
         with self._lock:
             return sum(1 for s in self._ring
                        if s["seq"] > self._shipped)
+
+    def stats(self) -> dict:
+        """Ring accounting without the spans (what every MgrReport
+        carries so the monitor-side overflow tracker never scrapes
+        ring internals)."""
+        with self._lock:
+            return {"recorded": self._seq,
+                    "dropped": self._dropped,
+                    "dropped_unshipped": self._dropped_unshipped,
+                    "pending": sum(1 for s in self._ring
+                                   if s["seq"] > self._shipped)}
 
 
 # -- ambient context (what makes span() sites trace-aware) --------------------

@@ -12,14 +12,100 @@ Counter kinds:
   * time_avg  — (sum_seconds, count) pair; tinc(seconds) adds a sample,
                 dump reports sum + count + avg (latency counters)
   * histogram — fixed power-of-two-bucket latency/size histogram
+  * lhist     — log2-bucketed LATENCY histogram (r18): bucket i counts
+                samples in [2^i, 2^(i+1)) microseconds, fixed
+                LHIST_BUCKETS slots covering ~1 µs .. >4000 s. The
+                t-digest-lite of the telemetry plane: snapshots merge
+                EXACTLY by element-wise bucket addition (dump_delta /
+                fold_delta already do this), so a cluster-wide p99 is
+                computable from per-daemon dumps with zero loss
+                relative to any single merged collector. Declared via
+                add_time_avg(..., hist=True): the paired `<key>_hist`
+                lhist is fed by the SAME tinc() call, so histogram
+                sites can never drift from the time_avg sites.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from dataclasses import dataclass, field
+
+#: lhist geometry: bucket i holds samples in [2^i, 2^(i+1)) µs.
+#: 40 slots span 1 µs .. 2^40 µs (~12.7 days) — every latency this
+#: harness can produce lands in a real bucket, the last slot is the
+#: overflow clamp. Fixed across the cluster so merge = bucket add.
+LHIST_BUCKETS = 40
+
+
+def lhist_bucket(seconds: float) -> int:
+    """Bucket index for one latency sample (µs log2, clamped)."""
+    us = seconds * 1e6
+    if us < 2.0:
+        return 0
+    return min(LHIST_BUCKETS - 1, int(us).bit_length() - 1)
+
+
+def lhist_bucket_le(i: int) -> float:
+    """Upper bound of bucket i in SECONDS (the prometheus `le`)."""
+    return (1 << (i + 1)) / 1e6
+
+
+def lhist_quantile(hist: dict, q: float) -> float:
+    """Quantile estimate in SECONDS from one lhist dump
+    ({"buckets", "sum", "count"}): find the bucket holding the q-th
+    sample, interpolate GEOMETRICALLY inside it (log-uniform
+    assumption matches the log2 bucketing). Deterministic: the same
+    buckets always give the same estimate, so a cluster-merged
+    quantile is bit-exactly reproducible from the per-daemon merge."""
+    buckets = hist.get("buckets") or []
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    seen = 0.0
+    for i, b in enumerate(buckets):
+        if b <= 0:
+            continue
+        if seen + b >= rank:
+            frac = min(1.0, max(0.0, (rank - seen) / b))
+            lo_us = float(1 << i) if i else 1.0
+            hi_us = float(1 << (i + 1))
+            return lo_us * math.pow(hi_us / lo_us, frac) / 1e6
+        seen += b
+    return lhist_bucket_le(len(buckets) - 1)
+
+
+def lhist_merge(*hists: dict) -> dict:
+    """Exact merge of lhist dumps: element-wise bucket add + sum/count
+    add. The merge the mon-side telemetry aggregation runs — and the
+    one the bit-exactness test replays by hand."""
+    out = {"buckets": [0] * LHIST_BUCKETS, "sum": 0.0, "count": 0}
+    for h in hists:
+        if not h:
+            continue
+        for i, b in enumerate(h.get("buckets") or []):
+            if i < LHIST_BUCKETS:
+                out["buckets"][i] += b
+        out["sum"] += h.get("sum", 0.0)
+        out["count"] += h.get("count", 0)
+    return out
+
+
+def lhist_quantiles(hist: dict,
+                    qs: tuple = (0.5, 0.95, 0.99)) -> dict:
+    out = {f"p{round(q * 100)}_ms":
+           round(lhist_quantile(hist, q) * 1e3, 3) for q in qs}
+    out["count"] = int(hist.get("count", 0) if hist else 0)
+    return out
+
+
+#: process-wide kill switch for lhist feeding (the r18 overhead-guard
+#: OFF arm: benches flip it to measure the histograms' cost against
+#: the same binary; tinc() itself — the time_avg — is unaffected)
+LHIST_ENABLED = True
 
 
 @dataclass
@@ -65,8 +151,23 @@ class PerfCountersBuilder:
     def add_u64(self, key: str, description: str = ""):
         return self._declare(key, _Counter("gauge", description))
 
-    def add_time_avg(self, key: str, description: str = ""):
-        return self._declare(key, _Counter("time_avg", description))
+    def add_time_avg(self, key: str, description: str = "",
+                     hist: bool = False):
+        """hist=True additionally declares `<key>_hist`, a mergeable
+        log2 latency histogram fed by the SAME tinc() call — the r18
+        one-flag wiring for the hot sites that already carry a
+        time_avg (op/subop latency, encode/decode, msgr seal)."""
+        self._declare(key, _Counter("time_avg", description))
+        if hist:
+            self.add_latency_histogram(f"{key}_hist",
+                                       description and
+                                       f"{description} (log2 µs "
+                                       f"buckets, merge = bucket add)")
+        return self
+
+    def add_latency_histogram(self, key: str, description: str = ""):
+        return self._declare(key, _Counter("lhist", description,
+                                           buckets=[0] * LHIST_BUCKETS))
 
     def add_histogram(self, key: str, description: str = "",
                       n_buckets: int = 32):
@@ -113,6 +214,24 @@ class PerfCounters:
             c = self._get(key, ("time_avg",))
             c.sum_s += seconds
             c.count += 1
+            # paired lhist (declared via add_time_avg(hist=True)):
+            # fed inside the SAME lock acquisition — one dict probe +
+            # one bit_length when present, nothing when not
+            h = self._c.get(key + "_hist")
+            if h is not None and LHIST_ENABLED:
+                h.buckets[lhist_bucket(seconds)] += 1
+                h.sum_s += seconds
+                h.count += 1
+
+    def linc(self, key: str, seconds: float) -> None:
+        """One latency sample straight into a standalone lhist."""
+        if not LHIST_ENABLED:
+            return
+        with self._lock:
+            c = self._get(key, ("lhist",))
+            c.buckets[lhist_bucket(seconds)] += 1
+            c.sum_s += seconds
+            c.count += 1
 
     def hinc(self, key: str, value: float) -> None:
         """Histogram sample: bucket = floor(log2(value)) clamped."""
@@ -129,6 +248,9 @@ class PerfCounters:
             if c.kind == "time_avg":
                 return {"sum": c.sum_s, "count": c.count,
                         "avg": c.sum_s / c.count if c.count else 0.0}
+            if c.kind == "lhist":
+                return {"buckets": list(c.buckets),
+                        "sum": c.sum_s, "count": c.count}
             if c.kind == "histogram":
                 return list(c.buckets)
             return c.value
@@ -154,6 +276,14 @@ class PerfCounters:
             for key, c in self._c.items():
                 if c.kind == "time_avg":
                     out[key] = {"avgcount": c.count, "sum": round(c.sum_s, 9)}
+                elif c.kind == "lhist":
+                    # dict-of-list shape folds EXACTLY through
+                    # dump_delta/fold_delta (buckets element-wise,
+                    # sum/count numeric) — what makes per-interval
+                    # history deltas and cluster merges lossless
+                    out[key] = {"buckets": list(c.buckets),
+                                "sum": round(c.sum_s, 9),
+                                "count": c.count}
                 elif c.kind == "histogram":
                     out[key] = list(c.buckets)
                 else:
@@ -246,6 +376,23 @@ class PerfCountersCollection:
                     lines.append(f"# TYPE {metric} summary")
                     lines.append(f"{metric}_sum {sum_s!r}")
                     lines.append(f"{metric}_count {count}")
+                elif kind == "lhist":
+                    # REAL prometheus histogram (r18): cumulative
+                    # _bucket series with le in SECONDS (the lhist
+                    # bucket's true upper bound), so
+                    # histogram_quantile() answers in seconds. Last
+                    # slot is the overflow clamp -> +Inf only.
+                    lines.append(f"# TYPE {metric} histogram")
+                    total = 0
+                    for i, b in enumerate(buckets[:-1]):
+                        total += b
+                        lines.append(
+                            f'{metric}_bucket{{le="'
+                            f'{lhist_bucket_le(i)!r}"}} {total}')
+                    total += buckets[-1] if buckets else 0
+                    lines.append(f'{metric}_bucket{{le="+Inf"}} {total}')
+                    lines.append(f"{metric}_sum {sum_s!r}")
+                    lines.append(f"{metric}_count {total}")
                 elif kind == "histogram":
                     # slot i holds samples in [2^i, 2^(i+1)), so the
                     # cumulative le bound is the slot's real upper
@@ -307,6 +454,110 @@ def fold_delta(base: dict, delta: dict) -> dict:
         else:
             out[key] = b + d
     return out
+
+
+class MetricsHistory:
+    """Per-daemon ring of interval-aligned counter/histogram DELTAS —
+    the retained-history half of the r18 telemetry plane (the role of
+    the mgr's per-daemon time-series cache fed by MMgrReport, kept in
+    the daemon so `perf history` answers even with no monitor
+    reachable).
+
+    Every `mgr_history_interval` seconds (live via config; <= 0
+    disables ticking entirely — the overhead-guard OFF arm),
+    maybe_tick() snapshots dump_fn() and appends ONE entry holding the
+    dump_delta since the previous snapshot, stamped with the
+    wall-clock-aligned interval index (`bucket` = floor(t/interval)) —
+    the single-host shared clock is what lets the mon-side aggregation
+    align entries ACROSS daemons without negotiation. Memory is
+    bounded by `mgr_history_len` entries (live too: shrinking the
+    option trims a running ring on the next tick)."""
+
+    def __init__(self, dump_fn, config=None, interval: float = 10.0,
+                 length: int = 90, now_fn=time.time):
+        self._dump_fn = dump_fn
+        self._config = config
+        self._interval = float(interval)
+        self._length = int(length)
+        self._now = now_fn
+        self._prev: dict | None = None
+        self._prev_t = 0.0
+        self._ring: list[dict] = []
+        self._seq = 0
+        self._shipped = 0            # MgrReport drain cursor
+        self._lock = threading.Lock()
+
+    def _opt(self, name: str, fallback):
+        if self._config is not None:
+            try:
+                return self._config.get(name)
+            except (KeyError, ValueError, TypeError):
+                pass
+        return fallback
+
+    @property
+    def interval(self) -> float:
+        return float(self._opt("mgr_history_interval", self._interval))
+
+    @property
+    def length(self) -> int:
+        return int(self._opt("mgr_history_len", self._length))
+
+    def maybe_tick(self) -> bool:
+        """Tick iff the current wall-clock interval bucket is newer
+        than the last recorded one. Returns True when an entry was
+        appended. Cheap when idle: one clock read + one divide."""
+        iv = self.interval
+        if iv <= 0:
+            return False
+        now = self._now()
+        if self._prev is not None and int(now / iv) \
+                == int(self._prev_t / iv):
+            return False
+        return self.tick(now)
+
+    def tick(self, now: float | None = None) -> bool:
+        """Force one snapshot/delta entry (benches use this to close
+        the final partial interval deterministically)."""
+        iv = self.interval if self.interval > 0 else self._interval
+        now = self._now() if now is None else now
+        cur = self._dump_fn()
+        with self._lock:
+            prev, prev_t = self._prev, self._prev_t
+            self._prev, self._prev_t = cur, now
+            if prev is None:
+                return False         # baseline snapshot, no delta yet
+            self._seq += 1
+            self._ring.append({
+                "seq": self._seq,
+                "t": round(now, 3),
+                "bucket": int(now / iv),
+                "interval_s": round(now - prev_t, 3),
+                "delta": dump_delta(prev, cur),
+            })
+            over = len(self._ring) - self.length
+            if over > 0:
+                del self._ring[:over]
+        return True
+
+    def dump(self, limit: int | None = None) -> dict:
+        """The `perf history` admin-command body."""
+        with self._lock:
+            entries = list(self._ring)
+        if limit is not None:
+            entries = entries[-int(limit):]
+        return {"interval": self.interval, "len": self.length,
+                "recorded": self._seq, "entries": entries}
+
+    def drain_unshipped(self, limit: int = 8) -> list[dict]:
+        """Entries recorded since the last drain — what one MgrReport
+        ships (normally 0 or 1 per report; bounded for report size)."""
+        with self._lock:
+            out = [e for e in self._ring if e["seq"] > self._shipped]
+            out = out[:int(limit)]
+            if out:
+                self._shipped = out[-1]["seq"]
+            return out
 
 
 # the default process-wide collection (role of CephContext's collection)

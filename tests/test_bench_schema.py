@@ -27,6 +27,62 @@ TRACE_KEYS = {"trace_id", "found", "daemons", "spans",
 TRACE_CP_KEYS = {"queue", "crypto", "encode", "store", "wire",
                  "other", "total"}
 
+# r18 telemetry block (both benches emit it): interval series +
+# merged lhist quantiles + SLO verdicts; rados_bench adds the
+# observed-client-latency feed
+TELEMETRY_KEYS = {"interval_s", "series", "quantiles", "slo"}
+QUANTILE_KEYS = {"p50_ms", "p95_ms", "p99_ms", "count"}
+SLO_VERDICT_KEYS = {"name", "logger", "key", "quantile",
+                    "threshold_ms", "window_s", "intervals",
+                    "samples", "current_ms", "burn_fast",
+                    "burn_slow", "breach"}
+OCL_KEYS = {"source", "pool"} | QUANTILE_KEYS
+
+
+def _check_telemetry_block(tel, want_ocl=False):
+    assert TELEMETRY_KEYS <= set(tel)
+    for series in tel["series"].values():
+        for pt in series:
+            assert {"bucket", "t", "interval_s", "value"} <= set(pt)
+    for q in tel["quantiles"].values():
+        assert set(q) == QUANTILE_KEYS
+    for v in tel["slo"]:
+        assert SLO_VERDICT_KEYS <= set(v)
+        assert isinstance(v["breach"], bool)
+    if want_ocl:
+        assert set(tel["observed_client_latency"]) == OCL_KEYS
+
+
+def test_bench_r18_artifact_pinned():
+    """The committed r18 telemetry overhead-guard artifact: the
+    history-ring + latency-histogram plane ON at defaults holds wire
+    write MB/s and recovery obj/s at parity with OFF (median of >= 6
+    interleaved same-binary pairs inside the r15 noise envelope)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r18.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "telemetry_r18/1"
+    for cell in ("wire_write", "recovery"):
+        c = data["cells"][cell]
+        assert len(c["pairs"]) >= 6
+        assert all(p["on"] > 0 and p["off"] > 0 for p in c["pairs"])
+        assert 0.95 <= c["median_pairwise_on_over_off"] <= 1.10
+    acc = data["acceptance"]
+    assert 0.95 <= acc["wire_write_median_pairwise"] <= 1.10
+    assert 0.95 <= acc["recovery_median_pairwise"] <= 1.10
+
+
+def test_slo_rule_schema_pinned():
+    """The mgr_slo_rules grammar and the parsed-rule dict schema the
+    `slo` mon command / bench verdicts render from."""
+    from ceph_tpu.mgr.telemetry import parse_slo_rules
+    rules = parse_slo_rules("client_read_p99 < 50ms over 5m")
+    assert [r.to_dict() for r in rules] == [{
+        "name": "client_read_p99", "logger": "osd",
+        "key": "op_r_latency_hist", "quantile": 0.99,
+        "threshold_ms": 50.0, "window_s": 300.0}]
+
 
 def _check_trace_block(tr):
     assert TRACE_KEYS <= set(tr)
@@ -88,6 +144,16 @@ def test_rados_bench_json_schema(capsys):
     _check_trace_block(out["trace"])
     assert any(d.startswith("client.") for d in out["trace"]["daemons"])
     assert any(d.startswith("osd.") for d in out["trace"]["daemons"])
+    # r18: the telemetry block — series/quantiles/SLO verdicts from
+    # the daemons' history rings, plus the observed-client-latency
+    # feed (client-shipped histograms in this in-process run)
+    _check_telemetry_block(out["telemetry"], want_ocl=True)
+    assert out["telemetry"]["quantiles"][
+        "osd.op_latency_hist"]["count"] > 0
+    assert out["telemetry"]["observed_client_latency"]["count"] > 0
+    assert {r["name"] for r in out["telemetry"]["slo"]} \
+        == {"client_read_p99", "client_write_p99"}
+    assert out["config"]["telemetry_off"] is False
 
 
 def test_bench_r13_artifact_pinned():
@@ -185,6 +251,10 @@ def test_recovery_bench_json_schema_live():
     # r15: the sampled recovery trace rides the same JSON
     _check_trace_block(data["trace"])
     assert data["trace"]["daemons"] == ["recovery_bench"]
+    # r18: the telemetry block over the run's local history ring
+    _check_telemetry_block(data["telemetry"])
+    assert data["telemetry"]["quantiles"][
+        "ec.recover_launch_time_hist"]["count"] > 0
 
 
 RMW_KEYS = {"ops", "logical_bytes", "wire_bytes",

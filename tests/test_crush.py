@@ -85,7 +85,14 @@ def test_bad_maps_rejected():
     pytest.param("list", marks=pytest.mark.slow),
     pytest.param("tree", marks=pytest.mark.slow),
     pytest.param("straw", marks=pytest.mark.slow)])
-@pytest.mark.parametrize("rule_id,n", [(0, 3), (1, 4)])
+@pytest.mark.parametrize("rule_id,n", [
+    (0, 3),
+    # the (1,4) rule repeats the (0,3) parity at a wider width and
+    # held the file's slowest tier-1 cells (~20 s for the pair);
+    # (0,3) x {straw2, uniform} stays the tier-1 representative, the
+    # full width sweep runs with -m slow (r18 CI-budget trim —
+    # tier-1 runs within a few % of the 870 s cap)
+    pytest.param(1, 4, marks=pytest.mark.slow)])
 def test_parity_oracle_vs_vectorized(alg, rule_id, n):
     m = make_map(32, 4, 4, alg=alg)
     om = OracleMapper(m)

@@ -1,0 +1,637 @@
+"""Telemetry time-series plane (r18), units + one live cell.
+
+* lhist — log2-bucketed mergeable latency histograms: bucket
+  geometry, tinc pairing, EXACT merge (bucket add), deterministic
+  quantiles, the process-wide off switch, real prometheus histogram
+  exposition;
+* MetricsHistory — interval-aligned delta ring: tick alignment,
+  bounded memory, the MgrReport drain cursor, live option resize;
+* SLO rules — grammar, burn-window evaluation (breach after two hot
+  intervals, clear after one clean), LATENCY_REGRESSION drift,
+  TRACE_RING_OVERFLOW streaks;
+* the balancer movement-budget feed — batch_calc_pg_upmaps consumes
+  observed client latency / burn rate through
+  telemetry_movement_budget (ROADMAP item 5's hook);
+* LIVE (tier-1 representative; the heavier soak/profile sweeps are
+  `slow`): a cephx+secure cluster drives injected client-op slowness
+  until SLO_BURN flips (within two evaluation intervals by
+  construction), proves the merged cluster p99 agrees bit-exactly
+  with the per-daemon histogram merge, covers the retro.subop
+  replica publication, then clears the injection and watches the
+  check clear.
+"""
+
+import os
+import time
+
+import pytest
+
+from ceph_tpu.mgr.telemetry import (FEED_ALIASES, TelemetryAggregator,
+                                    parse_slo_rules)
+from ceph_tpu.utils.perf_counters import (LHIST_BUCKETS,
+                                          MetricsHistory,
+                                          PerfCountersBuilder,
+                                          lhist_bucket, lhist_merge,
+                                          lhist_quantile,
+                                          lhist_quantiles)
+
+
+class _Cfg:
+    """Minimal config stub (get/[] by name, KeyError when unset)."""
+
+    def __init__(self, **kv):
+        self.kv = kv
+
+    def get(self, name):
+        if name in self.kv:
+            return self.kv[name]
+        raise KeyError(name)
+
+    __getitem__ = get
+
+
+def _hist(ms: float, n: int) -> dict:
+    buckets = [0] * LHIST_BUCKETS
+    buckets[lhist_bucket(ms / 1e3)] = n
+    return {"buckets": buckets, "sum": n * ms / 1e3, "count": n}
+
+
+def _entry(bucket: int, ms: float, n: int = 32, t: float | None = None,
+           key: str = "op_r_latency_hist", logger: str = "osd") -> dict:
+    return {"seq": bucket, "t": time.time() if t is None else t,
+            "bucket": bucket, "interval_s": 1.0,
+            "delta": {logger: {key: _hist(ms, n), "op": n}}}
+
+
+class TestLhist:
+    def test_bucket_geometry(self):
+        # bucket i holds [2^i, 2^(i+1)) microseconds
+        assert lhist_bucket(0.0) == 0
+        assert lhist_bucket(1e-6) == 0
+        assert lhist_bucket(2e-6) == 1
+        assert lhist_bucket(1e-3) == 9          # 1000us in [512,1024)
+        assert lhist_bucket(1.0) == 19          # 1e6us in [2^19, 2^20)
+        assert lhist_bucket(1e9) == LHIST_BUCKETS - 1   # clamp
+
+    def test_tinc_feeds_paired_hist_same_sample(self):
+        pc = (PerfCountersBuilder("t")
+              .add_time_avg("lat", "x", hist=True)
+              .create_perf_counters())
+        pc.tinc("lat", 0.004)
+        pc.tinc("lat", 0.004)
+        d = pc.dump()
+        assert d["lat"]["avgcount"] == 2
+        assert d["lat_hist"]["count"] == 2
+        assert d["lat_hist"]["buckets"][lhist_bucket(0.004)] == 2
+
+    def test_merge_is_exact_bucket_add(self):
+        a, b = _hist(5, 10), _hist(80, 3)
+        m = lhist_merge(a, b)
+        assert m["count"] == 13
+        assert sum(m["buckets"]) == 13
+        # merge commutes bit-exactly on the integer buckets
+        assert lhist_merge(b, a)["buckets"] == m["buckets"]
+        # and the quantile of a merge is deterministic
+        assert lhist_quantile(m, 0.99) == lhist_quantile(
+            lhist_merge(b, a), 0.99)
+
+    def test_quantiles_order_and_units(self):
+        h = lhist_merge(_hist(2, 50), _hist(100, 50))
+        q = lhist_quantiles(h)
+        assert q["count"] == 100
+        assert 1 <= q["p50_ms"] <= 10
+        assert 50 <= q["p99_ms"] <= 300
+        assert q["p50_ms"] <= q["p95_ms"] <= q["p99_ms"]
+
+    def test_process_wide_off_switch(self):
+        import ceph_tpu.utils.perf_counters as pcmod
+        pc = (PerfCountersBuilder("t2")
+              .add_time_avg("lat", "x", hist=True)
+              .create_perf_counters())
+        pcmod.LHIST_ENABLED = False
+        try:
+            pc.tinc("lat", 0.004)
+        finally:
+            pcmod.LHIST_ENABLED = True
+        d = pc.dump()
+        assert d["lat"]["avgcount"] == 1        # time_avg unaffected
+        assert d["lat_hist"]["count"] == 0      # hist skipped
+
+    def test_prometheus_real_histogram_exposition(self):
+        """Satellite: lhists render as `# TYPE ... histogram` with
+        cumulative _bucket/_sum/_count and le in SECONDS — in BOTH
+        expositions (collection-local and mgr-aggregated)."""
+        from ceph_tpu.mgr.reports import (MgrReportAggregator,
+                                          prometheus_text)
+        from ceph_tpu.utils.perf_counters import PerfCountersCollection
+        b = PerfCountersBuilder("osd.9")
+        b.add_time_avg("op_latency", "x", hist=True)
+        pc = b.create_perf_counters()
+        pc.tinc("op_latency", 0.004)
+        coll = PerfCountersCollection()
+        coll.add(pc)
+        text = coll.prometheus_text()
+        assert "# TYPE ceph_tpu_osd_9_op_latency_hist histogram" \
+            in text
+        assert 'op_latency_hist_bucket{le="+Inf"} 1' in text
+        assert "op_latency_hist_sum" in text
+        agg = MgrReportAggregator()
+        agg.ingest({"name": "osd.9", "seq": 1, "kind": "full",
+                    "perf": {"osd.9": pc.dump()},
+                    "schema": {"osd.9": pc.schema()}})
+        text = prometheus_text(agg)
+        assert "# TYPE ceph_tpu_osd_op_latency_hist histogram" in text
+        assert 'le="+Inf"' in text
+        assert 'daemon="osd.9"' in text
+        # never flattened to a gauge
+        assert "# TYPE ceph_tpu_osd_op_latency_hist gauge" not in text
+
+
+class TestMetricsHistory:
+    def test_tick_alignment_and_delta(self):
+        pc = (PerfCountersBuilder("h")
+              .add_u64_counter("n").create_perf_counters())
+        clock = [1000.0]
+        h = MetricsHistory(pc.dump, interval=10.0, length=4,
+                           now_fn=lambda: clock[0])
+        assert h.maybe_tick() is False          # baseline snapshot
+        pc.inc("n", 5)
+        clock[0] = 1004.0
+        assert h.maybe_tick() is False          # same bucket
+        clock[0] = 1011.0
+        assert h.maybe_tick() is True           # boundary crossed
+        e = h.dump()["entries"][-1]
+        assert e["bucket"] == 101
+        assert e["delta"]["n"] == 5
+
+    def test_ring_bounded_and_drain_cursor(self):
+        pc = (PerfCountersBuilder("h2")
+              .add_u64_counter("n").create_perf_counters())
+        clock = [0.0]
+        h = MetricsHistory(pc.dump, interval=1.0, length=3,
+                           now_fn=lambda: clock[0])
+        for i in range(8):
+            clock[0] = float(i + 1)
+            pc.inc("n")
+            h.maybe_tick()
+        assert len(h.dump()["entries"]) == 3    # bounded
+        got = h.drain_unshipped(limit=2)
+        assert [e["seq"] for e in got] == [5, 6]
+        got = h.drain_unshipped(limit=8)
+        assert [e["seq"] for e in got] == [7]
+        assert h.drain_unshipped() == []        # cursor advanced
+
+    def test_live_options_via_config(self):
+        cfg = _Cfg(mgr_history_interval=0.0, mgr_history_len=5)
+        pc = (PerfCountersBuilder("h3")
+              .add_u64_counter("n").create_perf_counters())
+        h = MetricsHistory(pc.dump, config=cfg)
+        assert h.maybe_tick() is False          # 0 = disabled
+        cfg.kv["mgr_history_interval"] = 0.01
+        h.maybe_tick()                          # baseline
+        time.sleep(0.02)
+        assert h.maybe_tick() is True           # re-enabled live
+
+
+class TestSLORules:
+    def test_grammar_aliases_and_explicit_paths(self):
+        rules = parse_slo_rules(
+            "client_read_p99 < 50ms over 5m;"
+            "ec.decode_time_hist_p95<2s over 60s;"
+            " client_observed_p50 < 900us over 30s ")
+        assert [r.name for r in rules] == [
+            "client_read_p99", "ec.decode_time_hist_p95",
+            "client_observed_p50"]
+        assert (rules[0].logger, rules[0].key) \
+            == FEED_ALIASES["client_read"]
+        assert rules[0].threshold_s == pytest.approx(0.05)
+        assert rules[0].window_s == 300.0
+        assert rules[1].logger == "ec"
+        assert rules[2].threshold_s == pytest.approx(900e-6)
+        assert parse_slo_rules("") == []
+
+    def test_grammar_rejects_malformed(self):
+        for bad in ("client_read_p99 < 50 over 5m",     # no unit
+                    "mystery_feed_p99 < 50ms over 5m",  # unknown feed
+                    "client_read_p0 < 50ms over 5m",    # bad quantile
+                    "client_read < 50ms over 5m"):      # no quantile
+            with pytest.raises(ValueError):
+                parse_slo_rules(bad)
+
+
+class TestSLOBurn:
+    RULE = "client_read_p99 < 20ms over 60s"
+
+    def _agg(self, **kv):
+        return TelemetryAggregator(
+            config=_Cfg(mgr_slo_rules=self.RULE,
+                        mgr_latency_regression_factor=0.0, **kv))
+
+    def test_breach_after_two_hot_intervals_then_clears(self):
+        agg = self._agg()
+        now = time.time()
+        agg.ingest("osd.0", [_entry(1, ms=2, t=now - 5)])
+        assert agg.slo_status()[0]["breach"] is False
+        agg.ingest("osd.0", [_entry(2, ms=100, t=now - 4)])
+        v = agg.slo_status()[0]
+        assert v["breach"] is False             # one hot interval
+        assert v["burn_fast"] == 0.5
+        agg.ingest("osd.0", [_entry(3, ms=100, t=now - 3)])
+        v = agg.slo_status()[0]
+        assert v["breach"] is True              # two hot = flip
+        assert v["burn_fast"] == 1.0
+        assert 0 < v["burn_slow"] < 1.0
+        assert agg.burn_rate() == 1.0
+        codes = [c["code"] for c in agg.health_checks()]
+        assert "SLO_BURN" in codes
+        agg.ingest("osd.0", [_entry(4, ms=2, t=now - 2)])
+        v = agg.slo_status()[0]
+        assert v["breach"] is False             # one clean clears
+        assert "SLO_BURN" not in [c["code"]
+                                  for c in agg.health_checks()]
+
+    def test_cluster_fold_spans_daemons(self):
+        """An interval hot only because BOTH daemons contribute: the
+        merge happens before the quantile, not after."""
+        agg = self._agg()
+        now = time.time()
+        for b in (1, 2):
+            # each daemon alone: 50% fast samples -> p99 hot only in
+            # the merged view when the slow half dominates the tail
+            agg.ingest("osd.0", [_entry(b, ms=1, n=5, t=now - 3 + b)])
+            agg.ingest("osd.1", [_entry(b, ms=200, n=50,
+                                        t=now - 3 + b)])
+        assert agg.slo_status()[0]["breach"] is True
+
+    def test_latency_regression_drift(self):
+        agg = TelemetryAggregator(
+            config=_Cfg(mgr_slo_rules=self.RULE,
+                        mgr_latency_regression_factor=4.0))
+        now = time.time()
+        for b in range(4):
+            agg.ingest("osd.0", [_entry(b, ms=4, t=now - 8 + b)])
+        assert agg.regressions() == []          # flat baseline
+        agg.ingest("osd.0", [_entry(9, ms=400, t=now - 1)])
+        regs = agg.regressions()
+        assert len(regs) == 1
+        assert regs[0]["factor"] > 4.0
+        assert "LATENCY_REGRESSION" in [
+            c["code"] for c in agg.health_checks()]
+        # factor 0 disables the probe entirely
+        agg._config.kv["mgr_latency_regression_factor"] = 0.0
+        assert agg.regressions() == []
+
+    def test_trace_ring_overflow_streaks(self):
+        agg = TelemetryAggregator(config=_Cfg(mgr_slo_rules=""))
+        agg.note_flight("osd.2", {"dropped_unshipped": 0})
+        agg.note_flight("osd.2", {"dropped_unshipped": 4})
+        assert agg.health_checks() == []        # one growth: noise
+        agg.note_flight("osd.2", {"dropped_unshipped": 9})
+        checks = agg.health_checks()
+        assert [c["code"] for c in checks] == ["TRACE_RING_OVERFLOW"]
+        assert "osd.2" in checks[0]["detail"][0]
+        # a flat report resets the streak (and a restart counts down)
+        agg.note_flight("osd.2", {"dropped_unshipped": 9})
+        assert agg.health_checks() == []
+
+
+class TestMergeBitExact:
+    def test_cluster_merge_equals_per_daemon_fold(self):
+        agg = TelemetryAggregator()
+        now = time.time()
+        agg.ingest("osd.0", [_entry(1, ms=3, n=7, t=now),
+                             _entry(2, ms=9, n=5, t=now)])
+        agg.ingest("osd.1", [_entry(1, ms=50, n=11, t=now)])
+        per = agg.per_daemon_hist("osd", "op_r_latency_hist")
+        merged = agg.merged_hist("osd", "op_r_latency_hist")
+        hand = lhist_merge(*per.values())
+        assert merged["buckets"] == hand["buckets"]     # bit-exact
+        assert merged["count"] == hand["count"] == 23
+        assert lhist_quantile(merged, 0.99) \
+            == lhist_quantile(hand, 0.99)
+
+
+class TestMovementBudgetFeed:
+    """ROADMAP item 5's hook: batch_calc_pg_upmaps consumes the
+    observed-client-latency feed through telemetry_movement_budget."""
+
+    def _hot_agg(self):
+        agg = TelemetryAggregator(
+            config=_Cfg(mgr_slo_rules="client_read_p99 < 5ms over 60s",
+                        mgr_latency_regression_factor=0.0))
+        now = time.time()
+        for b in (1, 2):
+            agg.ingest("osd.0", [_entry(b, ms=300, t=now - 3 + b)])
+        return agg
+
+    def test_budget_shrinks_with_burn(self):
+        from ceph_tpu.mgr.placement import telemetry_movement_budget
+        agg = self._hot_agg()
+        assert agg.burn_rate() == 1.0
+        assert telemetry_movement_budget(agg, 40) == 0
+        cold = TelemetryAggregator(config=_Cfg(mgr_slo_rules=""))
+        assert telemetry_movement_budget(cold, 40) == 40
+        assert telemetry_movement_budget(None, 40) == 40
+
+    def test_p99_ceiling_guards_without_rules(self):
+        from ceph_tpu.mgr.placement import telemetry_movement_budget
+        agg = TelemetryAggregator(config=_Cfg(mgr_slo_rules=""))
+        now = time.time()
+        agg.ingest("osd.0", [_entry(1, ms=300, t=now,
+                                    key="op_latency_hist")])
+        # the feed itself (not a rule) crosses the ceiling
+        ocl = agg.observed_client_latency()
+        assert ocl["source"] == "osd" and ocl["count"] == 32
+        assert telemetry_movement_budget(agg, 40,
+                                         p99_ceiling_s=0.1) == 0
+        assert telemetry_movement_budget(agg, 40,
+                                         p99_ceiling_s=5.0) == 40
+        with pytest.raises(KeyError):
+            agg.observed_client_latency(pool=7)
+
+    def test_batch_calc_pg_upmaps_consumes_feed(self):
+        from ceph_tpu.mgr.placement import (batch_calc_pg_upmaps,
+                                            telemetry_movement_budget)
+        from tests.test_placement import make_map
+        hot = self._hot_agg()
+        om = make_map()
+        res = batch_calc_pg_upmaps(om, 1, max_deviation=0,
+                                   max_movement=3, telemetry=hot)
+        assert res.budget == 0                  # burned to zero
+        assert res.budget_used == 0
+        assert len(om.pg_upmap_items) == 0      # nothing moved
+        # the cold path passes the budget through untouched (the
+        # actual balancer run under a real budget is
+        # test_placement's budget test — no need to re-pay it here)
+        cold = TelemetryAggregator(config=_Cfg(mgr_slo_rules=""))
+        assert telemetry_movement_budget(cold, 3) == 3
+
+
+class TestProfileRollup:
+    def _span(self, tid, sid, parent, name, daemon, start, dur):
+        return {"trace_id": tid, "span_id": sid, "parent_id": parent,
+                "name": name, "daemon": daemon, "start": start,
+                "dur": dur}
+
+    def test_profile_series_and_eviction_settling(self):
+        """The continuous critical-path profile: per-interval category
+        shares, with evicted traces folded PERMANENTLY (the horizon
+        outlives the trace LRU)."""
+        from ceph_tpu.mgr.tracing import TraceAssembler
+        asm = TraceAssembler(max_traces=2,
+                             config=_Cfg(mgr_history_interval=10.0))
+        for i in range(4):
+            tid = f"{i:016x}"
+            t0 = 1000.0 + i * 10.0          # one trace per interval
+            asm.ingest([
+                self._span(tid, "1", "0", "client.op", "client",
+                           t0, 0.100),
+                self._span(tid, "2", "1", "store.apply", "osd.0",
+                           t0 + 0.010, 0.040),
+            ])
+        prof = asm.profile()
+        assert prof["interval_s"] == 10.0
+        assert len(prof["intervals"]) == 4      # 2 evicted + 2 live
+        for iv in prof["intervals"]:
+            assert iv["traces"] == 1
+            assert iv["self_s"]["store"] == pytest.approx(0.04)
+            assert iv["share"]["store"] == pytest.approx(0.4)
+            assert iv["share"]["wire"] == pytest.approx(0.6)
+
+    def test_retro_subop_categorized_as_store(self):
+        from ceph_tpu.mgr.tracing import CATEGORY_OF, critical_path
+        assert CATEGORY_OF["retro.subop"] == "store"
+        assert CATEGORY_OF["retro.store.apply"] == "store"
+        from ceph_tpu.utils.flight_recorder import retro_root_id
+        root = f"{retro_root_id(0xabc):016x}"
+        spans = [
+            self._span("t", "c", "0", "client.op", "client",
+                       100.0, 1.0),
+            self._span("t", root, "c", "retro.op", "osd.0",
+                       100.1, 0.8),
+            self._span("t", "s", root, "retro.subop", "osd.1",
+                       100.2, 0.5),
+        ]
+        cp = critical_path(spans)
+        # replica time attributes as store, and SUBTRACTS from the
+        # retro root's self time (deterministic root id linkage) —
+        # the r15 "replica time reported as wire" gap, closed
+        assert cp["store"] == pytest.approx(0.5)
+        assert cp["other"] == pytest.approx(0.3)    # retro.op self
+        assert cp["wire"] == pytest.approx(0.2, abs=1e-6)
+
+
+@pytest.fixture(scope="module")
+def live_cluster():
+    from ceph_tpu.osd.standalone import StandaloneCluster
+    c = StandaloneCluster(n_osds=3, pg_num=2, cephx=True,
+                          secret=os.urandom(32))
+    c.wait_for_clean(timeout=40)
+    yield c
+    c.shutdown()
+
+
+def _lf() -> float:
+    from ceph_tpu.chaos.thrasher import load_factor
+    return load_factor()
+
+
+def _wait_for(pred, timeout, what):
+    t_end = time.monotonic() + timeout * _lf()
+    while time.monotonic() < t_end:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.25)
+    raise TimeoutError(what)
+
+
+class TestLiveSLOBurn:
+    """The acceptance cell: injected client-op slowness flips
+    SLO_BURN within two evaluation intervals, the merged cluster p99
+    agrees with the per-daemon histogram merge bit-exactly, replica
+    retro.subop spans publish for slow unsampled ops, and the check
+    clears after the injection stops."""
+
+    def test_slo_burn_flips_and_clears(self, live_cluster):
+        c = live_cluster
+        cl = c.client()
+        cl.config_set("mgr_history_interval", 0.5)
+        cl.config_set("mgr_slo_rules",
+                      "client_read_p99 < 40ms over 8s")
+        objs = {f"slo-{i}": bytes([i % 251]) * 256 for i in range(6)}
+        cl.write(objs)
+        names = sorted(objs)
+
+        def read_round():
+            for n in names:
+                assert cl.read(n) == objs[n]
+
+        # baseline: clean intervals, health quiet, telemetry flowing
+        _wait_for(lambda: (read_round() or
+                           cl.mon_command("telemetry")
+                           ["quantiles"]["osd.op_latency_hist"]
+                           ["count"] > 0),
+                  20, "telemetry baseline data")
+        assert "SLO_BURN" not in [x["code"] for x in
+                                  cl.health(detail=True)["checks"]]
+
+        # inject 120ms per op (3x the 40ms threshold) + a complaint
+        # threshold UNDER the injection so retro assembly triggers
+        cl.config_set("osd_inject_op_delay", 0.12)
+        cl.config_set("osd_op_complaint_time", 0.08)
+
+        def burning():
+            read_round()
+            return "SLO_BURN" in [x["code"] for x in
+                                  cl.health(detail=True)["checks"]]
+        _wait_for(burning, 30, "SLO_BURN flip under injection")
+        verdicts = cl.mon_command("slo")
+        assert verdicts["burn_rate"] == 1.0
+        rule = verdicts["rules"][0]
+        assert rule["breach"] is True
+        assert rule["current_ms"] > 40.0
+
+        # merged cluster p99 == per-daemon histogram merge, bit-exact
+        # (retry: ingestion races between the two snapshot calls)
+        from ceph_tpu.utils.perf_counters import (lhist_merge,
+                                                  lhist_quantile)
+        mon = next(m for m in c.mons if not m._stop.is_set())
+        ok = False
+        for _ in range(10):
+            per = mon.telemetry.per_daemon_hist("osd",
+                                                "op_latency_hist")
+            merged = mon.telemetry.merged_hist("osd",
+                                               "op_latency_hist")
+            hand = lhist_merge(*per.values())
+            if merged["buckets"] == hand["buckets"]:
+                ok = True
+                break
+            time.sleep(0.2)
+        assert ok, "cluster merge never matched per-daemon fold"
+        assert merged["count"] == hand["count"] > 0
+        assert lhist_quantile(merged, 0.99) \
+            == lhist_quantile(hand, 0.99) > 0.04
+        # the subop histograms prove a REAL multi-daemon merge (every
+        # write fans store sub-ops to both replicas; client-op
+        # primaries may all hash to one daemon at pg_num=2)
+        per_sub = mon.telemetry.per_daemon_hist(
+            "osd", "subop_latency_hist")
+        assert len(per_sub) >= 2
+        assert lhist_merge(*per_sub.values())["count"] > 0
+
+        # movement budget: the live burn zeroes it (the balancer
+        # yield-to-traffic gate over this same aggregator)
+        from ceph_tpu.mgr.placement import telemetry_movement_budget
+        assert telemetry_movement_budget(mon.telemetry, 64) == 0
+
+        # retro replica coverage: slow UNSAMPLED ops (complaint 80ms
+        # < 120ms injection) retro-assemble with retro.subop spans
+        # published by a NON-primary daemon out of its sub-op ring
+        def retro_covered():
+            read_round()
+            for ent in mon.traces.list_traces():
+                asm = mon.traces.assemble(ent["trace_id"])
+                subs = [s for s in asm["spans"]
+                        if s["name"] == "retro.subop"]
+                if subs and any(s["name"] == "retro.op"
+                                for s in asm["spans"]):
+                    roots = {s["daemon"] for s in asm["spans"]
+                             if s["name"] == "retro.op"}
+                    if {s["daemon"] for s in subs} - roots:
+                        return asm
+            return None
+        asm = _wait_for(retro_covered, 40,
+                        "retro.subop spans from a replica")
+        assert asm["critical_path"]["store"] > 0
+
+        # clear: stop injecting; one clean interval un-breaches
+        cl.config_set("osd_inject_op_delay", 0)
+        cl.config_set("osd_op_complaint_time", 30.0)
+
+        def cleared():
+            read_round()
+            return "SLO_BURN" not in [x["code"] for x in
+                                      cl.health(detail=True)["checks"]]
+        _wait_for(cleared, 30, "SLO_BURN clear after injection")
+
+
+@pytest.mark.slow
+class TestLiveTelemetrySoak:
+    """Heavy sweep cells (slow; TestLiveSLOBurn is the tier-1
+    representative): a multi-interval soak exercising the regression
+    probe live, and a profile-rollup sweep over forced-sample
+    traffic."""
+
+    def test_regression_probe_live(self):
+        from ceph_tpu.osd.standalone import StandaloneCluster
+        c = StandaloneCluster(n_osds=3, pg_num=2, cephx=True,
+                              secret=os.urandom(32))
+        try:
+            c.wait_for_clean(timeout=40)
+            cl = c.client()
+            cl.config_set("mgr_history_interval", 0.5)
+            cl.config_set("mgr_slo_rules",
+                          "client_read_p99 < 10s over 60s")
+            cl.config_set("mgr_latency_regression_factor", 4.0)
+            objs = {f"soak-{i}": b"z" * 256 for i in range(8)}
+            cl.write(objs)
+            # several flat baseline intervals...
+            t_end = time.monotonic() + 4.0 * _lf()
+            while time.monotonic() < t_end:
+                for n in objs:
+                    cl.read(n)
+                time.sleep(0.1)
+            # ...then a big drift (no SLO breach: threshold is 10s).
+            # The regression probe needs >= 16 samples in the newest
+            # interval; at ~150ms per injected op on a single op
+            # shard that takes seconds — widen the interval for the
+            # drift phase (also exercises the live resize path)
+            cl.config_set("mgr_history_interval", 4.0)
+            cl.config_set("osd_inject_op_delay", 0.15)
+
+            def regressed():
+                for n in objs:
+                    cl.read(n)
+                return "LATENCY_REGRESSION" in [
+                    x["code"] for x in
+                    cl.health(detail=True)["checks"]]
+            _wait_for(regressed, 40, "LATENCY_REGRESSION flip")
+            checks = {x["code"] for x in
+                      cl.health(detail=True)["checks"]}
+            assert "SLO_BURN" not in checks     # drift != breach
+        finally:
+            c.shutdown()
+
+    def test_profile_rollup_sweep_live(self):
+        from ceph_tpu.osd.standalone import StandaloneCluster
+        c = StandaloneCluster(n_osds=3, pg_num=2, cephx=True,
+                              secret=os.urandom(32))
+        try:
+            c.wait_for_clean(timeout=40)
+            cl = c.client(trace_sample_rate=1.0)
+            cl.config_set("mgr_history_interval", 0.5)
+            objs = {f"prof-{i}": b"p" * 512 for i in range(6)}
+            t_end = time.monotonic() + 3.0 * _lf()
+            while time.monotonic() < t_end:
+                cl.write(objs)
+                for n in objs:
+                    cl.read(n)
+                time.sleep(0.05)
+
+            def profiled():
+                prof = cl.mon_command("profile")
+                ivs = [iv for iv in prof["intervals"]
+                       if iv["traces"] > 0]
+                return prof if ivs else None
+            prof = _wait_for(profiled, 30, "profile rollup data")
+            iv = max(prof["intervals"], key=lambda x: x["traces"])
+            # every share in [0,1], and recorded span time landed in
+            # real categories (store/encode/queue/crypto), not all
+            # in the wire gap
+            assert all(0.0 <= v <= 1.0 for v in iv["share"].values())
+            assert sum(iv["self_s"][k] for k in
+                       ("queue", "crypto", "encode", "store",
+                        "other")) > 0
+        finally:
+            c.shutdown()

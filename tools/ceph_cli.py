@@ -161,6 +161,79 @@ def cmd_live_trace(asok_dir: str, args) -> None:
               f"{s['name']}")
 
 
+def cmd_live_top(asok_dir: str, args) -> None:
+    """`ceph_cli top` — per-daemon op rates over the newest telemetry
+    interval + cluster latency quantiles (the r18 time-series plane's
+    live view; answered from any monitor's TelemetryAggregator)."""
+    t = live_mon_command(asok_dir, "top")
+    if args.json:
+        print(json.dumps(t, sort_keys=True))
+        return
+    cl = t.get("cluster") or {}
+    ocl = t.get("observed_client_latency") or {}
+    print(f"  cluster op latency: p50 {cl.get('p50_ms')}ms  "
+          f"p95 {cl.get('p95_ms')}ms  p99 {cl.get('p99_ms')}ms "
+          f"({cl.get('count', 0)} samples)")
+    print(f"  observed client latency ({ocl.get('source')}): "
+          f"p99 {ocl.get('p99_ms')}ms ({ocl.get('count', 0)} samples)")
+    if t.get("totals"):
+        tot = t["totals"]
+        print(f"  {tot.get('ops_in_flight', 0)} ops in flight, "
+              f"{tot.get('slow_ops', 0)} slow, "
+              f"{tot.get('daemons_reporting', 0)} daemons reporting")
+    print(f"  DAEMON      OPS/S   SUBOPS/S   OP-MS-AVG  "
+          f"(interval {t.get('interval_s')}s)")
+    for name, row in sorted((t.get("daemons") or {}).items()):
+        print(f"  {name:<10} {row['ops_per_s']:>7} "
+              f"{row['subops_per_s']:>10} {row['op_ms_avg']:>10}")
+
+
+def cmd_live_slo(asok_dir: str, args) -> None:
+    """`ceph_cli slo` — declared SLO rules with burn-rate windows
+    (mgr_slo_rules; SLO_BURN fires on a hot fast window)."""
+    s = live_mon_command(asok_dir, "slo")
+    if args.json:
+        print(json.dumps(s, sort_keys=True))
+        return
+    rules = s.get("rules") or []
+    if not rules:
+        print("  (no SLO rules declared — "
+              "`config set mgr_slo_rules ...`)")
+        return
+    print(f"  cluster burn rate: {s.get('burn_rate')}")
+    for r in rules:
+        state = "BREACH" if r["breach"] else "ok"
+        print(f"  {r['name']:<24} < {r['threshold_ms']}ms over "
+              f"{r['window_s']}s  current={r['current_ms']}ms  "
+              f"burn fast={r['burn_fast']} slow={r['burn_slow']}  "
+              f"[{state}]")
+    for reg in s.get("regressions") or []:
+        print(f"  LATENCY_REGRESSION {reg['feed']}: p99 "
+              f"{reg['current_p99_ms']}ms = {reg['factor']}x "
+              f"baseline {reg['baseline_p99_ms']}ms")
+
+
+def cmd_live_profile(asok_dir: str, args) -> None:
+    """`ceph_cli profile` — the continuous critical-path profile:
+    per-interval queue/crypto/encode/store/wire self-time shares of
+    the sampled traces (attribution drift as a time-series)."""
+    p = live_mon_command(asok_dir, "profile")
+    if args.json:
+        print(json.dumps(p, sort_keys=True))
+        return
+    ivs = p.get("intervals") or []
+    if not ivs:
+        print("  (no sampled traces folded yet)")
+        return
+    cats = ("queue", "crypto", "encode", "store", "wire", "other")
+    print(f"  interval {p['interval_s']}s; shares per category:")
+    print("  BUCKET      TRACES  " + "  ".join(f"{c:>7}" for c in cats))
+    for iv in ivs:
+        shares = "  ".join(f"{iv['share'].get(c, 0.0):>7.2%}"
+                           for c in cats)
+        print(f"  {iv['bucket']:<11} {iv['traces']:>6}  {shares}")
+
+
 def build_cluster(name: str, n_osds: int, pg_num: int):
     from ceph_tpu.osd.cluster import SimCluster
     c = SimCluster(n_osds=n_osds, pg_num=pg_num,
@@ -399,6 +472,19 @@ def main(argv=None) -> None:
     tr.add_argument("--chrome", metavar="FILE", default=None,
                     help="write the trace's Chrome trace-event JSON "
                          "to FILE (requires a trace id)")
+    sub.add_parser(
+        "top", help="LIVE mode: per-daemon op rates + cluster latency "
+                    "quantiles from the r18 telemetry plane")
+    sub.add_parser(
+        "slo", help="LIVE mode: declared SLO rules with burn-rate "
+                    "windows (mgr_slo_rules)")
+    sub.add_parser(
+        "profile", help="LIVE mode: continuous critical-path profile "
+                        "(per-interval attribution shares of sampled "
+                        "traces)")
+    sub.add_parser(
+        "telemetry", help="LIVE mode: raw telemetry dump (series + "
+                          "merged quantiles + SLO verdicts)")
     sub.add_parser("df")
     sub.add_parser("osd-df")
     pg = sub.add_parser("pg")
@@ -419,7 +505,8 @@ def main(argv=None) -> None:
     cfg.add_argument("value", nargs="?")
     args = ap.parse_args(argv)
 
-    if args.cmd in ("daemon", "trace") and not args.asok_dir:
+    if args.cmd in ("daemon", "trace", "top", "slo", "profile",
+                    "telemetry") and not args.asok_dir:
         raise SystemExit(f"`{args.cmd}` needs --asok-dir (live mode "
                          f"only)")
     if args.asok_dir:
@@ -455,6 +542,17 @@ def main(argv=None) -> None:
                              sort_keys=True))
         elif args.cmd == "trace":
             cmd_live_trace(args.asok_dir, args)
+        elif args.cmd == "top":
+            cmd_live_top(args.asok_dir, args)
+        elif args.cmd == "slo":
+            cmd_live_slo(args.asok_dir, args)
+        elif args.cmd == "profile":
+            cmd_live_profile(args.asok_dir, args)
+        elif args.cmd == "telemetry":
+            print(json.dumps(live_mon_command(args.asok_dir,
+                                              "telemetry"),
+                             indent=None if args.json else 2,
+                             sort_keys=True))
         else:
             raise SystemExit(f"{args.cmd!r} has no live-mode "
                              f"implementation; drop --asok-dir")

@@ -146,6 +146,23 @@ def main(argv=None) -> None:
                          "parity, not speedup). Implies --store tin "
                          "semantics for revive; shares the persistent "
                          "jit cache across children")
+    ap.add_argument("--history-interval", type=float, default=1.0,
+                    help="standalone: mgr_history_interval committed "
+                         "for the run (seconds per telemetry "
+                         "interval; small so a short window still "
+                         "yields a series)")
+    ap.add_argument("--slo", default="client_read_p99 < 1s over 30s;"
+                                     "client_write_p99 < 1s over 30s",
+                    help="standalone: SLO rules evaluated into the "
+                         "JSON `telemetry` block (mgr_slo_rules "
+                         "grammar)")
+    ap.add_argument("--telemetry-off", action="store_true",
+                    help="standalone: disable the r18 telemetry "
+                         "plane for this run — history rings off "
+                         "(mgr_history_interval 0) AND latency "
+                         "histograms off (process-wide) — the "
+                         "overhead-guard OFF arm; the JSON then "
+                         "carries no telemetry block")
     ap.add_argument("--tenants", type=int, default=1,
                     help="standalone: run ops round-robin across N "
                          "client entities (per-tenant mClock classes "
@@ -229,6 +246,16 @@ def main(argv=None) -> None:
         c.wait_for_clean(timeout=30)
         shutdown = c.shutdown
         wire_client = c.client()
+        # r18 telemetry plane: small history intervals so even a
+        # sub-second window yields a series; --telemetry-off is the
+        # overhead-guard OFF arm (ring ticks off, histograms off)
+        if args.telemetry_off:
+            import ceph_tpu.utils.perf_counters as _pcmod
+            _pcmod.LHIST_ENABLED = False
+            wire_client.config_set("mgr_history_interval", 0)
+        else:
+            wire_client.config_set("mgr_history_interval",
+                                   args.history_interval)
         if args.hedge_delay_ms is not None:
             # committed centrally: every current AND future client of
             # this cluster resolves it live (the config-observer path)
@@ -759,6 +786,54 @@ def main(argv=None) -> None:
                 "hedge": hc}
         out["hedge"] = agg
         out["tenants"] = tenants
+        out["config"]["history_interval"] = args.history_interval
+        out["config"]["telemetry_off"] = args.telemetry_off
+        if not args.telemetry_off:
+            # r18 telemetry block: interval series + merged
+            # quantiles + the observed-client-latency feed + SLO
+            # verdicts, assembled from the daemons' OWN history
+            # rings (in-process directly, asok for --osd-procs
+            # children) so a short window doesn't depend on the
+            # MgrReport cadence. Schema pinned by
+            # tests/test_bench_schema.py.
+            from ceph_tpu.mgr.telemetry import (TelemetryAggregator,
+                                                parse_slo_rules)
+            tagg = TelemetryAggregator()
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                try:
+                    if hasattr(d, "metrics_history"):
+                        d.metrics_history.tick()   # close the tail
+                        hist = d.metrics_history.dump()
+                    else:
+                        hist = d.asok("perf history")
+                except Exception:  # noqa: BLE001 — a dying daemon
+                    continue       # drops out of the block
+                tagg.ingest(d.name, hist.get("entries") or [])
+            for tcl in tenant_clients:
+                tagg.ingest_client(tcl.msgr.name, tcl.perf.dump())
+            try:
+                rules = parse_slo_rules(args.slo)
+            except ValueError as e:
+                raise SystemExit(f"rados_bench: --slo: {e}")
+            out["telemetry"] = {
+                "interval_s": args.history_interval,
+                "series": {
+                    "osd.op": tagg.series("osd", "op"),
+                    "osd.op_in_bytes":
+                        tagg.series("osd", "op_in_bytes"),
+                },
+                "quantiles": {
+                    "osd.op_latency_hist":
+                        tagg.quantiles("osd", "op_latency_hist"),
+                    "osd.subop_latency_hist":
+                        tagg.quantiles("osd", "subop_latency_hist"),
+                },
+                "observed_client_latency":
+                    tagg.observed_client_latency(),
+                "slo": tagg.slo_status(rules=rules),
+            }
     if args.recovery_kill:
         # latency split around the kill + the schedulers' class grants:
         # the QoS claim ("client p95 bounded during recovery", seq:

@@ -43,6 +43,22 @@ def main(argv=None) -> None:
                          "phase into DIR (view with tensorboard/xprof; "
                          "the ecbackend.recover.{stage,launch,fetch,"
                          "writeback} spans mark the pipeline stages)")
+    ap.add_argument("--history-interval", type=float, default=0.25,
+                    help="seconds per telemetry interval for the "
+                         "run's local MetricsHistory ring (the JSON "
+                         "`telemetry` block's series granularity)")
+    ap.add_argument("--slo",
+                    default="ec.recover_launch_time_hist_p99 < 5s "
+                            "over 60s",
+                    help="SLO rules evaluated into the `telemetry` "
+                         "block (mgr_slo_rules grammar; explicit "
+                         "<logger>.<key> feeds work)")
+    ap.add_argument("--telemetry-off", action="store_true",
+                    help="disable the r18 telemetry plane for this "
+                         "run (no history ring, latency histograms "
+                         "off process-wide) — the overhead-guard OFF "
+                         "arm; the JSON then carries no telemetry "
+                         "block")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -102,9 +118,20 @@ def main(argv=None) -> None:
             cluster.stores.pop(be.acting[s], None)
         repl = {s: 2000 + s for s in lost}
 
-    from ceph_tpu.utils.perf_counters import dump_delta
+    from ceph_tpu.utils.perf_counters import MetricsHistory, dump_delta
     from ceph_tpu.utils.tracing import trace
+    if args.telemetry_off:
+        import ceph_tpu.utils.perf_counters as _pcmod
+        _pcmod.LHIST_ENABLED = False
     perf_before = be.perf.dump()
+    # r18: a local per-interval history ring over the "ec" logger —
+    # the in-process analog of a daemon's MetricsHistory, feeding the
+    # JSON telemetry block (series + merged quantiles + SLO verdicts)
+    hist = None
+    if not args.telemetry_off:
+        hist = MetricsHistory(lambda: {"ec": be.perf.dump()},
+                              interval=args.history_interval)
+        hist.tick()               # baseline snapshot
 
     def timed_recover():
         """The timed phase runs through the SAME plan/runner/mClock
@@ -130,6 +157,8 @@ def main(argv=None) -> None:
                 continue
             queued = False
             more = got[1].step()
+            if hist is not None:
+                hist.maybe_tick()    # close passed interval bounds
         runner.finish()
         return plan, runner, sched
 
@@ -233,6 +262,35 @@ def main(argv=None) -> None:
         "spans": len(rec_asm["spans"]),
         "critical_path": rec_asm["critical_path"],
     }
+    # r18 telemetry block: the run's interval series + merged
+    # quantiles + SLO verdicts from the local history ring (schema
+    # pinned by tests/test_bench_schema.py)
+    if hist is not None:
+        from ceph_tpu.mgr.telemetry import (TelemetryAggregator,
+                                            parse_slo_rules)
+        hist.tick()                  # close the final interval
+        tagg = TelemetryAggregator()
+        tagg.ingest("recovery_bench", hist.dump()["entries"])
+        try:
+            rules = parse_slo_rules(args.slo)
+        except ValueError as e:
+            raise SystemExit(f"recovery_bench: --slo: {e}")
+        stats["telemetry"] = {
+            "interval_s": args.history_interval,
+            "series": {
+                "ec.recovered_bytes":
+                    tagg.series("ec", "recovered_bytes"),
+                "ec.recover_launches":
+                    tagg.series("ec", "recover_launches"),
+            },
+            "quantiles": {
+                "ec.recover_launch_time_hist":
+                    tagg.quantiles("ec", "recover_launch_time_hist"),
+                "ec.decode_time_hist":
+                    tagg.quantiles("ec", "decode_time_hist"),
+            },
+            "slo": tagg.slo_status(rules=rules),
+        }
     if args.json:
         print(json.dumps(stats))
     else:
