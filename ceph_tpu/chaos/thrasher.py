@@ -251,7 +251,10 @@ class Thrasher:
         self.c = StandaloneCluster(
             n_osds=self.n_osds, pg_num=self.pg_num, store=self.store,
             store_dir=self.store_dir, cephx=True, secret=secret,
-            op_timeout=6.0, op_shards=self.op_shards,
+            # op_timeout scales too (r19 deflake): a 6s budget tuned
+            # idle let in-flight ops time out under full-suite load
+            # and read as transient-smoke failures [311]
+            op_timeout=6.0 * self.load, op_shards=self.op_shards,
             osd_procs=self.osd_procs,
             # a loaded host stretches every ping round trip: scale the
             # grace with the observed load so CPU starvation doesn't
@@ -263,9 +266,10 @@ class Thrasher:
         # injection + scheduled scrub live from the start
         self._set_injection()
         try:
-            self.cl.config_set("osd_scrub_interval", 3.0, timeout=20)
+            self.cl.config_set("osd_scrub_interval", 3.0,
+                                timeout=20 * self.load)
             self.cl.config_set("osd_scrub_auto_repair", "true",
-                               timeout=20)
+                               timeout=20 * self.load)
         except TimeoutError as e:
             self._parked("config_set scrub", e)
         if self.transient_fraction > 0:
@@ -275,7 +279,8 @@ class Thrasher:
                                  "daemon RAM)")
             try:
                 self.cl.config_set("osd_repair_delay",
-                                   self.repair_delay, timeout=20)
+                                   self.repair_delay,
+                                   timeout=20 * self.load)
             except TimeoutError as e:
                 self._parked("config_set osd_repair_delay", e)
         return self

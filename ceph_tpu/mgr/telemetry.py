@@ -221,6 +221,16 @@ class TelemetryAggregator:
                 streak = 0
             self._flight[name] = (cur, streak)
 
+    def flight_drops(self) -> dict[str, int]:
+        """Per-daemon flight-ring dropped_unshipped gauges (newest
+        reported value) — surfaced by `ceph_cli top` next to the r19
+        sampler gauges so ring overflow is visible BEFORE the
+        TRACE_RING_OVERFLOW streak trips."""
+        with self._lock:
+            return {name: last
+                    for name, (last, _streak) in sorted(
+                        self._flight.items())}
+
     # -- views ----------------------------------------------------------------
 
     def _buckets_locked(self, window_s: float | None = None

@@ -3117,6 +3117,13 @@ class OSDDaemon:
         from ..utils.perf_counters import MetricsHistory
         self.metrics_history = MetricsHistory(self.perf_dump_all,
                                               config=self.config)
+        # r19 continuous CPU profiling: a dedicated sampler thread
+        # folds every thread's stack into span-tagged collapsed
+        # stacks at daemon_profile_hz (live; 0 = off). In-RAM like
+        # the rest of the plane — a revive gets a fresh profile.
+        from ..utils.profiler import SamplingProfiler
+        self.profiler = SamplingProfiler(self.name,
+                                         config=self.config).start()
         # r18 sub-op retro ring (the r15 replica gap): completed store
         # sub-ops remembered by carried trace id so a primary's slow-op
         # retro assembly can pull this hop's timing after the fact
@@ -3184,7 +3191,7 @@ class OSDDaemon:
                    "log dump",
                    "config show",
                    "config diff", "trace start", "trace stop",
-                   "trace dump",
+                   "trace dump", "profile",
                    "status")
 
     def _pg_states(self) -> dict:
@@ -3267,6 +3274,19 @@ class OSDDaemon:
             # spans, optionally filtered to one trace id (hex)
             arg = cmd[len("trace dump"):].strip() or None
             return self.flight.dump(trace_id=arg)
+        if cmd.startswith("profile"):
+            # the r19 CPU sampler's cumulative span-tagged profile
+            # (this daemon only; the cluster fold is the monitors'
+            # `profile cpu`). `profile --collapsed` emits folded-
+            # stack text instead of the raw category->stack counts.
+            from ..utils.profiler import (category_split,
+                                          collapsed_lines)
+            dump = self.profiler.dump()
+            if "--collapsed" in cmd:
+                return {"name": self.name,
+                        "collapsed": collapsed_lines(dump["stacks"])}
+            dump["categories"] = category_split(dump["stacks"])
+            return dump
         if cmd.startswith("trace start"):
             from ..utils.tracing import start_trace
             log_dir = cmd[len("trace start"):].strip() \
@@ -3624,6 +3644,17 @@ class OSDDaemon:
                 inject = float(self.config["osd_inject_op_delay"])
                 if inject > 0:
                     time.sleep(inject)
+                # DEBUG CPU burn (osd_inject_cpu_burn, r19): a busy
+                # spin INSIDE the osd.op span — the deterministic hot
+                # loop the profile-attribution tests drive. The r15
+                # taxonomy puts osd.op self-time in "other", so the
+                # burn must surface there in the flame profile (and
+                # in profile_diff's regression verdict)
+                burn = float(self.config["osd_inject_cpu_burn"])
+                if burn > 0:
+                    t_burn = time.perf_counter() + burn
+                    while time.perf_counter() < t_burn:
+                        pass
                 # per-PG execution lock, not the daemon lock: ops to
                 # independent PGs really do run concurrently across
                 # shards; reconcile/recovery exclude themselves per PG
@@ -4230,6 +4261,8 @@ class OSDDaemon:
                 # its wall-clock boundary passed) BEFORE reporting so
                 # the fresh entry ships on this same beat
                 self.metrics_history.maybe_tick()
+                # r19: same rule for the CPU sampler's profile ring
+                self.profiler.maybe_tick()
                 self._maybe_mgr_report()
             except Exception as e:  # noqa: BLE001 — stats shipping
                 # must never kill the heartbeat thread
@@ -4286,6 +4319,12 @@ class OSDDaemon:
         self.perf.set("trace_dropped_unshipped",
                       fstats["dropped_unshipped"])
         report["flight"] = fstats
+        # r19: freshly closed profile-ring intervals (span-tagged
+        # stack deltas) + the sampler's accounting ride the same pipe
+        # into the monitors' ProfileAggregators
+        report["profile"] = {
+            "entries": self.profiler.drain_unshipped(),
+            "stats": self.profiler.stats()}
         self._mgr_last_perf = perf
         # PG states want the daemon lock; never stall the heartbeat
         # for them — a busy beat ships without, and the aggregator
@@ -4309,6 +4348,7 @@ class OSDDaemon:
     def kill(self) -> None:
         """SIGKILL: stop answering everything, drop RAM state."""
         self._stop.set()
+        self.profiler.stop()
         self.asok.stop()
         self.msgr.shutdown()
         self.store.crash()
@@ -4488,6 +4528,15 @@ class MonDaemon:
             lambda: {self.perf.name: self.perf.dump(),
                      "msgr": self.msgr.perf.dump()},
             config=self.conf_view)
+        # r19 continuous profiling: every monitor folds the profile
+        # entries riding MgrReports into cluster/per-daemon flame
+        # profiles, and is a profiled citizen itself (its own sampler
+        # ticks on the self-report cadence)
+        from ..mgr.profiles import ProfileAggregator
+        from ..utils.profiler import SamplingProfiler
+        self.profiles = ProfileAggregator(config=self.conf_view)
+        self.profiler = SamplingProfiler(self.name,
+                                         config=self.conf_view).start()
         self._mgr_seq = 0
         self._mgr_last_sent = 0.0
         from ..utils.admin_socket import AdminSocket
@@ -4503,6 +4552,14 @@ class MonDaemon:
             "trace",
             lambda args: self._mon_cmd_obj(("trace " + args).strip()),
             "assembled distributed traces: slow | list | <trace-id>")
+        # argumented; longest-prefix dispatch keeps it ahead of the
+        # bare `profile` (the r18 critical-path series)
+        self.asok.register(
+            "profile cpu",
+            lambda args: self._mon_cmd_obj(
+                ("profile cpu " + args).strip()),
+            "cluster CPU flame profiles (r19): [daemon] "
+            "[--collapsed|--speedscope]")
         self.asok.start()
         m = self.msgr
         m.register_handler(MMgrReport.type_id, self._on_mgr_report)
@@ -4924,6 +4981,11 @@ class MonDaemon:
             if report.get("flight") is not None:
                 self.telemetry.note_flight(report.get("name", "?"),
                                            report["flight"])
+            # r19: span-tagged profile deltas feed the flame
+            # aggregation (same pipe, independent consumer)
+            if report.get("profile"):
+                self.profiles.ingest(report.get("name", "?"),
+                                     report["profile"])
             if report.get("client_perf"):
                 self.telemetry.ingest_client(report.get("name", "?"),
                                              report["client_perf"])
@@ -4968,6 +5030,13 @@ class MonDaemon:
                 if history:
                     report["history"] = history
                     self.telemetry.ingest(self.name, history)
+                # r19: the monitor's own CPU profile rides the same
+                # cadence — folded locally, shipped to peers
+                self.profiler.maybe_tick()
+                pblock = {"entries": self.profiler.drain_unshipped(),
+                          "stats": self.profiler.stats()}
+                report["profile"] = pblock
+                self.profiles.ingest(self.name, pblock)
             except Exception:   # noqa: BLE001 — observability must
                 pass            # not break the monitor's reporting
         self.mgr.ingest(report)
@@ -5087,8 +5156,22 @@ class MonDaemon:
                     "burn_rate": self.telemetry.burn_rate(),
                     "regressions": self.telemetry.regressions()}
         if kind == "top":
-            # per-daemon rates over the newest history interval
-            return self.telemetry.top(reports=self.mgr)
+            # per-daemon rates over the newest history interval; the
+            # r19 observability drop gauges ride along (sampler +
+            # flight-ring loss is an operator-visible condition, not
+            # a silent one)
+            out = self.telemetry.top(reports=self.mgr)
+            out["observability"] = {
+                "flight_dropped_unshipped":
+                    self.telemetry.flight_drops(),
+                "profiler": self.profiles.stats(),
+            }
+            return out
+        if kind == "profile cpu" or kind.startswith("profile cpu "):
+            # r19 flame profiles: cluster/per-daemon span-tagged CPU
+            # attribution from the daemons' sampling rings
+            return self.profiles.cpu_cmd(
+                kind[len("profile cpu"):].strip())
         if kind == "profile":
             # continuous critical-path attribution series (sampled
             # traces folded per interval — the drift view)
@@ -5480,6 +5563,7 @@ class MonDaemon:
 
     def kill(self) -> None:
         self._stop.set()
+        self.profiler.stop()
         self.asok.stop()
         self.msgr.shutdown()
 

@@ -247,6 +247,28 @@ OPTIONS: list[Option] = [
            "execution (the deterministic slowness source the SLO-burn "
            "tests drive; the osd_debug_inject_dispatch_delay role). "
            "Live via central config; 0 = off", min=0.0),
+    Option("daemon_profile_hz", float, 10.0,
+           "continuous CPU profiling sample rate (r19): each daemon's "
+           "sampler thread snapshots every thread's Python stack this "
+           "many times a second and folds it into span-tagged "
+           "collapsed stacks (utils/profiler.py). The default is "
+           "sized for always-on use on an oversubscribed host (the "
+           "BENCH_r19 ON/OFF guard bounds it); raise it for a "
+           "focused capture. 0 disables sampling entirely (the "
+           "overhead-guard OFF arm). Live via central config",
+           min=0.0),
+    Option("daemon_profile_ring", int, 64,
+           "per-daemon profile-delta ring length in history intervals "
+           "(the r18 MetricsHistory shape over folded stacks; bounds "
+           "daemon memory, evictions count as dropped_unshipped). "
+           "Live: shrinking trims on the next tick", min=4),
+    Option("osd_inject_cpu_burn", float, 0.0,
+           "DEBUG: seconds of BUSY-SPIN (not sleep) injected into "
+           "every client op's execution, inside the osd.op span — the "
+           "deterministic hot loop the r19 profile-attribution tests "
+           "drive (tools/profile_diff.py must attribute it to the "
+           "op-path category). Live via central config; 0 = off",
+           min=0.0),
 ]
 
 

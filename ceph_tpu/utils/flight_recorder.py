@@ -55,6 +55,8 @@ import struct
 import threading
 import time
 
+from . import profiler as _prof
+
 __all__ = [
     "TraceContext", "FlightRecorder", "trace_span", "activate",
     "current", "current_sampled", "declare_span_names",
@@ -393,22 +395,39 @@ def activate(ctx: TraceContext | None, recorder: FlightRecorder | None):
 def trace_span(name: str, **tags):
     """Record `name` as a span under the active SAMPLED context (else
     a no-op costing one contextvar read). The body runs under a child
-    context so nested spans parent correctly."""
+    context so nested spans parent correctly. Sampled or not, the
+    name's attribution category tags the executing thread for the r19
+    CPU sampler (utils/profiler) — unsampled sub-ops still burn CPU,
+    and the flame profile must see store/crypto time the trace plane
+    skipped."""
     ctx = _CUR.get()
     if ctx is None or not ctx.sampled:
-        yield None
+        tagged = _prof.push_span(name)
+        try:
+            yield None
+        finally:
+            if tagged:
+                _prof.pop_span()
         return
     rec = _REC.get()
     if rec is None:
-        yield None
+        tagged = _prof.push_span(name)
+        try:
+            yield None
+        finally:
+            if tagged:
+                _prof.pop_span()
         return
     sid = new_trace_id()
     tok = _CUR.set(ctx.child(sid))
+    tagged = _prof.push_span(name)
     t0w = time.time()
     t0 = time.perf_counter()
     try:
         yield ctx
     finally:
+        if tagged:
+            _prof.pop_span()
         _CUR.reset(tok)
         rec.record(ctx.trace_id, sid, ctx.parent_span_id, name,
                    t0w, time.perf_counter() - t0, tags or None)

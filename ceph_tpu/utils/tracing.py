@@ -31,6 +31,7 @@ import contextlib
 import time
 
 from . import flight_recorder as _fr
+from . import profiler as _prof
 
 
 #: memoized jax.profiler.TraceAnnotation class (False = unresolved):
@@ -59,19 +60,24 @@ def _annotation(name: str):
 @contextlib.contextmanager
 def span(name: str, counters=None, key: str | None = None):
     """Named span: visible in jax.profiler traces; optionally tincs
-    `counters[key]` (a time_avg) with the wall duration; and — when a
+    `counters[key]` (a time_avg) with the wall duration; when a
     SAMPLED trace context is active (utils/flight_recorder) — recorded
-    into the executing daemon's flight ring under that trace. One
-    instrumentation point, three consumers (profiler timeline,
-    production counters, per-op distributed trace), so none of them
-    can drift from the others. Off-trace the extra cost is a single
-    contextvar read."""
+    into the executing daemon's flight ring under that trace; and —
+    when the r19 CPU sampler is on — tags this thread with the span's
+    attribution category so wall-clock samples land in the same
+    queue/crypto/encode/store buckets the trace critical-path uses.
+    One instrumentation point, four consumers (profiler timeline,
+    production counters, per-op distributed trace, CPU flame
+    attribution), so none of them can drift from the others.
+    Off-trace with sampling off the extra cost is a contextvar read
+    plus one int compare."""
     ann = _annotation(name)
     t0 = time.perf_counter() if counters is not None else 0.0
     fspan = _fr.trace_span(name) \
         if _fr.current_sampled() is not None else None
     if fspan is not None:
         fspan.__enter__()
+    tagged = _prof.push_span(name)
     try:
         if ann is not None:
             with ann:
@@ -81,6 +87,8 @@ def span(name: str, counters=None, key: str | None = None):
     finally:
         # record even when the body raises — failing/slow-error ops are
         # exactly the ones worth timing (PerfCounters.time() semantics)
+        if tagged:
+            _prof.pop_span()
         if fspan is not None:
             fspan.__exit__(None, None, None)
         if counters is not None and key is not None:

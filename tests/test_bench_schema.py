@@ -31,6 +31,14 @@ TRACE_CP_KEYS = {"queue", "crypto", "encode", "store", "wire",
 # merged lhist quantiles + SLO verdicts; rados_bench adds the
 # observed-client-latency feed
 TELEMETRY_KEYS = {"interval_s", "series", "quantiles", "slo"}
+
+# r19 continuous-profiling block (rados/recovery/repair bench emit
+# it): folded-stack flame summary + sampler overhead accounting
+PROFILE_KEYS = {"daemons", "hz", "samples", "idle_samples",
+                "categories", "category_share", "top_stacks",
+                "sampler_overhead"}
+PROFILE_CATS = {"queue", "crypto", "encode", "store", "wire",
+                "reactor", "other"}
 QUANTILE_KEYS = {"p50_ms", "p95_ms", "p99_ms", "count"}
 SLO_VERDICT_KEYS = {"name", "logger", "key", "quantile",
                     "threshold_ms", "window_s", "intervals",
@@ -51,6 +59,49 @@ def _check_telemetry_block(tel, want_ocl=False):
         assert isinstance(v["breach"], bool)
     if want_ocl:
         assert set(tel["observed_client_latency"]) == OCL_KEYS
+
+
+def _check_profile_block(prof):
+    assert PROFILE_KEYS <= set(prof)
+    assert prof["daemons"]
+    assert prof["hz"] > 0
+    assert set(prof["categories"]) == PROFILE_CATS
+    assert set(prof["category_share"]) == PROFILE_CATS
+    for row in prof["top_stacks"]:
+        assert {"category", "stack", "samples"} <= set(row)
+        assert row["category"] in PROFILE_CATS
+    ov = prof["sampler_overhead"]
+    assert ov["busy_s"] >= 0 and ov["busy_share"] >= 0
+
+
+def test_bench_r19_artifact_pinned():
+    """The committed r19 continuous-profiling artifact: a live
+    cephx+secure cluster assembles a flame from >= 3 daemons over the
+    MgrReport pipe, `ceph_cli flame --speedscope` exports a valid
+    document, profile_diff attributes the injected osd.op busy-spin
+    to its own stack in the op-path category, and the interleaved
+    ON/OFF guard holds the default-hz sampler at <= ~1.05x median
+    pairwise slowdown."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r19.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "profile_r19/1"
+    acc = data["acceptance"]
+    assert acc["flame_daemons_reporting"] >= 3
+    assert acc["speedscope_valid"] is True
+    assert acc["burn_attributed_to_expected_category"] is True
+    assert 0.95 <= acc["overhead_median_pairwise_slowdown"] <= 1.10
+    burn = data["cells"]["burn_attribution"]
+    assert burn["expected_category"] == "other"
+    assert burn["burn_mover"]["category"] == "other"
+    assert burn["burn_mover"]["delta_share"] > 0
+    assert "_one_client_op" in burn["burn_mover"]["stack"]
+    guard = data["cells"]["overhead_guard"]
+    assert len(guard["pairs"]) >= 6
+    assert all(p["on"] > 0 and p["off"] > 0 for p in guard["pairs"])
+    assert set(data["cells"]["flame_assembly"]["categories"]) \
+        == PROFILE_CATS
 
 
 def test_bench_r18_artifact_pinned():
@@ -154,6 +205,11 @@ def test_rados_bench_json_schema(capsys):
     assert {r["name"] for r in out["telemetry"]["slo"]} \
         == {"client_read_p99", "client_write_p99"}
     assert out["config"]["telemetry_off"] is False
+    # r19: the continuous-profiling block — every OSD's sampling ring
+    # folded into the flame summary CI diffs with profile_diff
+    _check_profile_block(out["profile"])
+    assert len(out["profile"]["daemons"]) == 4
+    assert out["profile"]["samples"] >= 0
 
 
 def test_bench_r13_artifact_pinned():
@@ -255,6 +311,9 @@ def test_recovery_bench_json_schema_live():
     _check_telemetry_block(data["telemetry"])
     assert data["telemetry"]["quantiles"][
         "ec.recover_launch_time_hist"]["count"] > 0
+    # r19: the bench's own sampling profile rides the same JSON
+    _check_profile_block(data["profile"])
+    assert data["profile"]["daemons"] == ["recovery_bench"]
 
 
 RMW_KEYS = {"ops", "logical_bytes", "wire_bytes",

@@ -1,0 +1,449 @@
+"""r19 continuous CPU profiling plane: span-tagged sampling profiler,
+interval delta ring, bit-exact cluster flame merge, export formats,
+and the before/after attribution diff.
+
+One live-cluster cell at the end (ONE boot for the whole module — the
+r15 CI rule): a cephx+secure cluster assembles a cluster CPU flame
+from every daemon's sampling ring over the MgrReport pipe, serves it
+as `profile cpu`, exports valid speedscope JSON through `ceph_cli
+flame`, and goes quiet when `daemon_profile_hz` is set to 0.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.utils import profiler as prof_mod
+from ceph_tpu.utils.perf_counters import fold_delta
+from ceph_tpu.utils.profiler import (PROFILE_CATEGORIES,
+                                     SamplingProfiler, category_of,
+                                     category_split, collapsed_lines,
+                                     merge_stacks, profile_block,
+                                     push_span, speedscope, top_stacks)
+
+
+def _bump(p: SamplingProfiler, cat: str, stack: str, n: int = 1):
+    """Deterministic sample injection (white-box: the ring/merge
+    tests must not depend on real thread scheduling)."""
+    with p._lock:
+        b = p._stacks.setdefault(cat, {})
+        b[stack] = b.get(stack, 0) + n
+        p._samples += n
+
+
+class TestSpanTagging:
+    def test_category_of_matches_trace_taxonomy(self):
+        from ceph_tpu.mgr.tracing import CATEGORY_OF
+        for name, cat in CATEGORY_OF.items():
+            assert category_of(name) == cat
+        assert category_of("no.such.span") == "other"
+        # every trace category is a declared profile category
+        assert set(CATEGORY_OF.values()) <= set(PROFILE_CATEGORIES)
+
+    def test_push_is_free_when_no_sampler_active(self):
+        assert prof_mod._ACTIVE == 0
+        assert push_span("store.apply") is False
+        assert threading.get_ident() not in prof_mod._SPAN_CATS
+
+    def test_attribution_lands_in_span_category(self):
+        """A thread inside span('store.apply') is sampled as `store`
+        — the acceptance semantics (same units as `trace slow`)."""
+        from ceph_tpu.utils.tracing import span
+        with span("warmup"):     # resolve the lazy jax import OUTSIDE
+            pass                 # the sampled window
+        p = SamplingProfiler("t", hz=100.0)
+        p._set_active(True)
+        stop = threading.Event()
+        ready = threading.Event()
+
+        def worker():
+            with span("store.apply"):
+                ready.set()
+                while not stop.is_set():
+                    pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            assert ready.wait(5.0)
+            skip = tuple(th.ident for th in threading.enumerate()
+                         if th.ident != t.ident)
+            for _ in range(20):
+                p.sample_once(skip_tids=skip)
+        finally:
+            stop.set()
+            t.join(2.0)
+            p._set_active(False)
+        d = p.dump()
+        assert d["samples"] == 20
+        assert sum((d["stacks"].get("store") or {}).values()) == 20
+        # the collapsed stack names the worker frame, no line numbers
+        assert any("test_profiler:worker" in s
+                   for s in d["stacks"]["store"])
+
+    def test_nested_spans_attribute_to_innermost(self):
+        p = SamplingProfiler("t", hz=100.0)
+        p._set_active(True)
+        try:
+            assert push_span("osd.op") is True          # -> other
+            assert push_span("msgr.seal") is True       # -> crypto
+            tid = threading.get_ident()
+            assert prof_mod._SPAN_CATS[tid][-1] == "crypto"
+            prof_mod.pop_span()
+            assert prof_mod._SPAN_CATS[tid][-1] == "other"
+            prof_mod.pop_span()
+            assert tid not in prof_mod._SPAN_CATS
+        finally:
+            p._set_active(False)
+
+    def test_hz_zero_records_nothing(self):
+        """The off-switch invariant: an hz=0 profiler's thread idles
+        without sampling and never activates span tagging."""
+        p = SamplingProfiler("t", hz=0.0).start()
+        try:
+            time.sleep(0.5)
+            assert p.dump()["samples"] == 0
+            assert p.dump()["stacks"] == {}
+            assert push_span("store.apply") is False
+        finally:
+            p.stop()
+
+
+class TestIntervalRing:
+    def test_tick_emits_pruned_deltas(self):
+        clk = [1000.0]
+        p = SamplingProfiler("t", hz=0, interval=10.0, ring=8,
+                             now_fn=lambda: clk[0])
+        _bump(p, "store", "a;b", 3)
+        assert p.tick() is False          # baseline snapshot
+        _bump(p, "store", "a;b", 2)
+        _bump(p, "encode", "x;y", 1)
+        clk[0] = 1010.0
+        assert p.maybe_tick() is True
+        ents = p.drain_unshipped()
+        assert len(ents) == 1
+        e = ents[0]
+        assert e["bucket"] == 101
+        assert e["samples"] == 3
+        assert e["stacks"] == {"store": {"a;b": 2}, "encode": {"x;y": 1}}
+        # same bucket -> no new entry
+        clk[0] = 1011.0
+        assert p.maybe_tick() is False
+        # an interval with no new samples ships NO zero-count stacks
+        clk[0] = 1020.0
+        _bump(p, "store", "a;b", 1)
+        assert p.maybe_tick() is True
+        e2 = p.drain_unshipped()[0]
+        assert e2["stacks"] == {"store": {"a;b": 1}}
+        assert "encode" not in e2["stacks"]
+
+    def test_ring_eviction_counts_unshipped_drops(self):
+        clk = [0.0]
+        p = SamplingProfiler("t", hz=0, interval=1.0, ring=4,
+                             now_fn=lambda: clk[0])
+        p.tick()
+        for i in range(7):
+            clk[0] += 1.0
+            _bump(p, "other", "s", 1)
+            p.tick()
+        assert p.stats()["dropped_unshipped"] == 3     # 7 - ring 4
+        # drained entries are consecutive and newest-aligned
+        ents = p.drain_unshipped(limit=99)
+        assert [e["seq"] for e in ents] == [4, 5, 6, 7]
+        # nothing left after a drain; a new tick ships exactly one
+        assert p.drain_unshipped() == []
+        clk[0] += 1.0
+        _bump(p, "other", "s", 1)
+        p.tick()
+        assert len(p.drain_unshipped()) == 1
+
+
+class TestMerge:
+    def test_cluster_merge_is_bit_exact(self):
+        """The r18 rule on stacks: merge of per-daemon merges ==
+        merge of all entries, exact integer equality."""
+        from ceph_tpu.mgr.profiles import ProfileAggregator
+        ents_a = [{"seq": 1, "t": 10.0, "bucket": 1, "interval_s": 10,
+                   "hz": 10, "samples": 5, "busy_s": 0.0,
+                   "stacks": {"store": {"a;b": 3}, "other": {"z": 2}}},
+                  {"seq": 2, "t": 20.0, "bucket": 2, "interval_s": 10,
+                   "hz": 10, "samples": 4, "busy_s": 0.0,
+                   "stacks": {"store": {"a;b": 1, "a;c": 3}}}]
+        ents_b = [{"seq": 1, "t": 10.0, "bucket": 1, "interval_s": 10,
+                   "hz": 10, "samples": 7, "busy_s": 0.0,
+                   "stacks": {"encode": {"e;f": 7}}}]
+        agg = ProfileAggregator()
+        agg.ingest("osd.0", {"entries": ents_a})
+        agg.ingest("osd.1", {"entries": ents_b})
+        hand = {}
+        for e in ents_a + ents_b:
+            hand = fold_delta(hand, e["stacks"])
+        assert agg.flame() == hand
+        assert agg.flame() == merge_stacks(
+            [agg.flame("osd.0"), agg.flame("osd.1")])
+        assert agg.flame("osd.0") == {"store": {"a;b": 4, "a;c": 3},
+                                      "other": {"z": 2}}
+        # interval alignment: bucket 1 folded across both daemons
+        iv = {i["bucket"]: i for i in agg.intervals()}
+        assert iv[1]["samples"] == 12
+        assert iv[1]["daemons"] == ["osd.0", "osd.1"]
+        assert iv[1]["categories"]["store"] == 3
+        assert iv[1]["categories"]["encode"] == 7
+
+    def test_stack_cap_folds_smallest_never_drops_samples(self):
+        from ceph_tpu.mgr import profiles as profiles_mod
+        from ceph_tpu.mgr.profiles import ProfileAggregator
+        agg = ProfileAggregator()
+        n = profiles_mod.MAX_STACKS + 50
+        stacks = {"other": {f"s{i:05d}": i + 1 for i in range(n)}}
+        agg.ingest("osd.0", {"entries": [
+            {"seq": 1, "t": 1.0, "bucket": 0, "interval_s": 1,
+             "hz": 10, "samples": 1, "busy_s": 0.0, "stacks": stacks}]})
+        bucket = agg.flame("osd.0")["other"]
+        assert len(bucket) <= profiles_mod.MAX_STACKS + 1
+        assert "..." in bucket
+        assert sum(bucket.values()) == sum(range(1, n + 1))
+        assert agg.stats()["osd.0"]["stacks_folded"] == 50
+
+    def test_cpu_cmd_parses_and_reports_unknown_daemon(self):
+        from ceph_tpu.mgr.profiles import ProfileAggregator
+        agg = ProfileAggregator()
+        agg.ingest("osd.0", {"entries": [
+            {"seq": 1, "t": 1.0, "bucket": 0, "interval_s": 1,
+             "hz": 10, "samples": 2, "busy_s": 0.0,
+             "stacks": {"store": {"a;b": 2}}}]})
+        out = agg.cpu_cmd("")
+        assert out["found"] and out["daemon"] == "cluster"
+        assert out["samples"] == 2
+        assert set(out["categories"]) == set(PROFILE_CATEGORIES)
+        assert agg.cpu_cmd("osd.0 --collapsed")["collapsed"] \
+            == ["store;a;b 2"]
+        ss = agg.cpu_cmd("--speedscope")["speedscope"]
+        assert ss["$schema"].startswith("https://www.speedscope.app")
+        bad = agg.cpu_cmd("osd.9")
+        assert bad["found"] is False and bad["daemons"] == ["osd.0"]
+        with pytest.raises(ValueError):
+            agg.cpu_cmd("--bogus")
+
+
+class TestExports:
+    STACKS = {"store": {"a;b": 3, "a;c": 1}, "encode": {"x": 2}}
+
+    def test_category_split_declares_every_category(self):
+        split = category_split(self.STACKS)
+        assert set(split) == set(PROFILE_CATEGORIES)
+        assert split["store"] == 4 and split["encode"] == 2
+        assert split["wire"] == 0
+
+    def test_top_stacks_deterministic_order(self):
+        rows = top_stacks(self.STACKS, n=2)
+        assert rows == [
+            {"category": "store", "stack": "a;b", "samples": 3},
+            {"category": "encode", "stack": "x", "samples": 2}]
+
+    def test_collapsed_lines_roundtrip(self):
+        lines = collapsed_lines(self.STACKS)
+        assert "store;a;b 3" in lines
+        total = 0
+        for ln in lines:
+            stack, cnt = ln.rsplit(" ", 1)
+            cat = stack.split(";")[0]
+            assert cat in PROFILE_CATEGORIES
+            total += int(cnt)
+        assert total == 6
+
+    def test_speedscope_document_is_valid(self):
+        doc = speedscope(self.STACKS, name="t")
+        assert doc["$schema"] \
+            == "https://www.speedscope.app/file-format-schema.json"
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled"
+        assert len(prof["samples"]) == len(prof["weights"]) == 3
+        assert prof["endValue"] == sum(prof["weights"]) == 6
+        nframes = len(doc["shared"]["frames"])
+        for s in prof["samples"]:
+            assert all(0 <= i < nframes for i in s)
+        # first frame of each sample is the category
+        cats = {doc["shared"]["frames"][s[0]]["name"]
+                for s in prof["samples"]}
+        assert cats == {"store", "encode"}
+
+    def test_profile_block_folds_daemon_dumps(self):
+        block = profile_block([
+            {"name": "osd.0", "hz": 10.0, "samples": 4,
+             "stacks": {"store": {"a;b": 3, "a;c": 1}},
+             "sampler_busy_s": 0.1, "uptime_s": 10.0},
+            {"name": "osd.1", "hz": 10.0, "samples": 2,
+             "stacks": {"encode": {"x": 2}},
+             "sampler_busy_s": 0.1, "uptime_s": 10.0}])
+        assert block["daemons"] == ["osd.0", "osd.1"]
+        assert block["samples"] == 6
+        assert block["categories"]["store"] == 4
+        assert block["category_share"]["encode"] == pytest.approx(1 / 3,
+                                                                  abs=1e-3)
+        assert block["top_stacks"][0]["stack"] == "a;b"
+        assert block["sampler_overhead"]["busy_s"] == pytest.approx(0.2)
+        assert block["sampler_overhead"]["busy_share"] \
+            == pytest.approx(0.01)
+
+
+class TestProfileDiff:
+    def _block(self, cats, stacks=()):
+        return {"samples": sum(cats.values()), "categories": cats,
+                "top_stacks": [{"category": c, "stack": s,
+                                "samples": n} for c, s, n in stacks]}
+
+    def test_injected_burn_attributed_to_regressed_category(self):
+        """The acceptance shape: a hot loop grows one category's
+        share; the diff names that category and the mover stack."""
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        from profile_diff import diff_blocks
+        before = self._block(
+            {"queue": 0, "crypto": 10, "encode": 40, "store": 30,
+             "wire": 0, "reactor": 10, "other": 10},
+            [("encode", "a;encode", 40)])
+        after = self._block(
+            {"queue": 0, "crypto": 10, "encode": 40, "store": 30,
+             "wire": 0, "reactor": 10, "other": 110},
+            [("encode", "a;encode", 40),
+             ("other", "standalone:_one_client_op;burn", 100)])
+        d = diff_blocks(before, after, threshold=0.05)
+        assert d["regressed"] == ["other"]
+        assert d["verdict"].startswith("REGRESSED: other")
+        assert d["top_movers"][0]["stack"] \
+            == "standalone:_one_client_op;burn"
+        # and a no-change pair stays quiet
+        ok = diff_blocks(before, before)
+        assert ok["regressed"] == [] and ok["verdict"] == "OK"
+
+    def test_extract_block_accepts_artifact_and_raw_shapes(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        "..", "tools"))
+        from profile_diff import extract_block
+        block = self._block({"store": 4, "other": 1})
+        assert extract_block({"profile": block}) is block
+        assert extract_block(block) is block
+        raw = extract_block({"store": {"a;b": 4}})
+        assert raw["samples"] == 4 and raw["categories"]["store"] == 4
+        with pytest.raises(ValueError):
+            extract_block({"unrelated": 1})
+
+
+# -- the live cell: ONE cluster boot for the whole module ------------------
+
+def _lf() -> float:
+    from ceph_tpu.chaos.thrasher import load_factor
+    return load_factor()
+
+
+@pytest.fixture(scope="module")
+def live_cluster():
+    from ceph_tpu.osd.standalone import StandaloneCluster
+    c = StandaloneCluster(n_osds=3, pg_num=2, cephx=True,
+                          secret=os.urandom(32))
+    c.wait_for_clean(timeout=40 * _lf())
+    yield c
+    c.shutdown()
+
+
+class TestLiveProfilingCell:
+    """The acceptance cell: a cephx+secure cluster's monitor
+    assembles a cluster CPU flame from >= 3 daemons over the
+    MgrReport pipe, bit-exactly equal to the per-daemon fold; the
+    command surface serves it end to end (mon cmd, asok, ceph_cli
+    flame --speedscope); hz=0 stops sampling live."""
+
+    def test_flame_assembles_and_exports(self, live_cluster, tmp_path):
+        c = live_cluster
+        cl = c.client()
+        cl.config_set("mgr_history_interval", 0.5)
+        cl.config_set("mgr_report_interval", 0.5)
+        objs = {f"fl-{i}": bytes([i % 251]) * 512 for i in range(6)}
+        cl.write(objs)
+        mon = next(m for m in c.mons if not m._stop.is_set())
+        deadline = time.monotonic() + 30 * _lf()
+        while time.monotonic() < deadline:
+            for n in sorted(objs):
+                assert cl.read(n) == objs[n]
+            st = mon.profiles.stats()
+            if len(st) >= 3 and \
+                    sum(d["samples"] for d in st.values()) > 30:
+                break
+            time.sleep(0.3)
+        st = mon.profiles.stats()
+        assert len(st) >= 3, f"profiles from {sorted(st)} only"
+
+        # the mon command: cluster fold, schema-complete
+        out = cl.mon_command("profile cpu")
+        assert out["found"] and len(out["daemons"]) >= 3
+        assert out["samples"] > 0
+        assert set(out["categories"]) == set(PROFILE_CATEGORIES)
+        assert out["top_stacks"]
+
+        # bit-exact: cluster flame == fold of per-daemon flames
+        cluster_flame = mon.profiles.flame()
+        hand = merge_stacks(mon.profiles.flame(d)
+                            for d in mon.profiles.daemons())
+        assert cluster_flame == hand
+
+        # per-daemon view + unknown daemon
+        name = sorted(st)[0]
+        assert cl.mon_command(f"profile cpu {name}")["daemon"] == name
+        assert cl.mon_command("profile cpu no.such")["found"] is False
+
+        # asok: one OSD's own cumulative profile
+        osd = next(d for d in c.osds.values() if not d._stop.is_set())
+        adump = osd._admin_obj("profile")
+        assert adump["samples"] > 0 and adump["stacks"]
+
+        # ceph_cli flame --speedscope writes a valid document
+        ss_path = tmp_path / "flame.json"
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "ceph_cli.py"),
+             "--asok-dir", c.admin_dir, "flame",
+             "--speedscope", str(ss_path)],
+            capture_output=True, text=True, timeout=60 * _lf())
+        assert r.returncode == 0, r.stderr
+        doc = json.loads(ss_path.read_text())
+        assert doc["$schema"] \
+            == "https://www.speedscope.app/file-format-schema.json"
+        prof = doc["profiles"][0]
+        assert prof["endValue"] == sum(prof["weights"]) > 0
+
+        # `top` carries the observability drop gauges (satellite)
+        top = cl.mon_command("top")
+        gauges = top["observability"]["profiler"]
+        assert len(gauges) >= 3
+        assert all("dropped_unshipped" in g for g in gauges.values())
+
+    def test_hz_zero_stops_sampling_live(self, live_cluster):
+        c = live_cluster
+        cl = c.client()
+        cl.config_set("daemon_profile_hz", 0)
+        osd = next(d for d in c.osds.values() if not d._stop.is_set())
+        deadline = time.monotonic() + 10 * _lf()
+        frozen = None
+        while time.monotonic() < deadline:
+            a = osd.profiler.dump()["samples"]
+            time.sleep(0.5)
+            b = osd.profiler.dump()["samples"]
+            if a == b:
+                frozen = a
+                break
+        assert frozen is not None, "sampler never stopped at hz=0"
+        # and back on: sampling resumes from the live option
+        cl.config_set("daemon_profile_hz", 10)
+        deadline = time.monotonic() + 10 * _lf()
+        while time.monotonic() < deadline:
+            if osd.profiler.dump()["samples"] > frozen:
+                break
+            time.sleep(0.2)
+        assert osd.profiler.dump()["samples"] > frozen

@@ -368,20 +368,22 @@ class TestWireRangeRecovery:
     test_standalone deflake rule): tuned on an idle box, these cells
     passed alone but flaked in-suite at r15 when the 1-core host was
     oversubscribed — the load factor stretches the DEADLINE without
-    loosening the assertion."""
+    loosening the assertion. The factor is RE-SAMPLED at each wait
+    (r19 deflake): one reading taken while the suite was momentarily
+    idle under-scaled the long recovery wait minutes later, which is
+    exactly when the box is busiest."""
 
     def test_clay_wire_rebuild_over_range_frames(self):
         from ceph_tpu.chaos import load_factor
         from ceph_tpu.osd.standalone import StandaloneCluster
-        lf = load_factor()
         # 5 OSDs for a size-4 pool: the killed slot needs a spare OSD
         # to re-home onto, or the PG can never go clean
         c = StandaloneCluster(
-            n_osds=5, pg_num=2, op_timeout=5.0 * lf,
+            n_osds=5, pg_num=2, op_timeout=5.0 * load_factor(),
             profile="plugin=clay k=2 m=2 impl=bitlinear",
             chunk_size=512)
         try:
-            c.wait_for_clean(timeout=30 * lf)
+            c.wait_for_clean(timeout=30 * load_factor())
             cl = c.client()
             rng = np.random.default_rng(7)
             objs = {f"wr-{i}": rng.integers(0, 256, 2048,
@@ -393,8 +395,8 @@ class TestWireRangeRecovery:
             victim = next(o for o in c.osd_ids()
                           if o not in primaries)
             c.kill_osd(victim)
-            c.wait_for_down(victim, timeout=30 * lf)
-            c.wait_for_clean(timeout=90 * lf)
+            c.wait_for_down(victim, timeout=30 * load_factor())
+            c.wait_for_clean(timeout=90 * load_factor())
             cl2 = c.client("client.admin2")
             for name, want in objs.items():
                 assert cl2.read(name) == want, name

@@ -186,6 +186,73 @@ def cmd_live_top(asok_dir: str, args) -> None:
     for name, row in sorted((t.get("daemons") or {}).items()):
         print(f"  {name:<10} {row['ops_per_s']:>7} "
               f"{row['subops_per_s']:>10} {row['op_ms_avg']:>10}")
+    # r19: per-daemon observability drop gauges — sampler ring +
+    # flight ring losses are operator-visible, not silent
+    obs = t.get("observability") or {}
+    prof = obs.get("profiler") or {}
+    fdrops = obs.get("flight_dropped_unshipped") or {}
+    if prof or fdrops:
+        print(f"  DAEMON          HZ   SAMPLES  PROF-DROP  FLIGHT-DROP")
+        for name in sorted(set(prof) | set(fdrops)):
+            p = prof.get(name) or {}
+            print(f"  {name:<10} {p.get('hz', 0):>7} "
+                  f"{p.get('samples', 0):>9} "
+                  f"{p.get('dropped_unshipped', 0):>10} "
+                  f"{fdrops.get(name, 0):>12}")
+
+
+def cmd_live_flame(asok_dir: str, args) -> None:
+    """`ceph_cli flame [daemon]` — the r19 continuous CPU profile:
+    span-tagged wall-clock flame profiles folded from every daemon's
+    sampling ring over the MgrReport pipe (any monitor's
+    ProfileAggregator answers). --collapsed prints folded-stack text
+    (flamegraph.pl grain), --speedscope FILE writes a complete
+    speedscope JSON document for https://speedscope.app."""
+    arg = args.daemon or ""
+    if args.speedscope is not None:
+        arg = (arg + " --speedscope").strip()
+    elif args.collapsed:
+        arg = (arg + " --collapsed").strip()
+    out = live_mon_command(asok_dir, f"profile cpu {arg}".rstrip())
+    if not out.get("found", True):
+        raise SystemExit(
+            f"flame: no profile for daemon {out.get('daemon')!r} "
+            f"(known: {', '.join(out.get('daemons') or []) or 'none'})")
+    if args.speedscope is not None:
+        with open(args.speedscope, "w") as f:
+            json.dump(out["speedscope"], f)
+        doc = out["speedscope"]
+        print(f"wrote {len(doc['profiles'][0]['samples'])} stacks "
+              f"({doc['profiles'][0]['endValue']} samples) to "
+              f"{args.speedscope}")
+        return
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+        return
+    if args.collapsed:
+        for line in out["collapsed"]:
+            print(line)
+        return
+    total = out.get("samples") or 0
+    print(f"  {out['daemon']}: {total} samples from "
+          f"{len(out.get('daemons') or [])} daemon(s)")
+    share = out.get("category_share") or {}
+    print("  attribution: " + ", ".join(
+        f"{c}={share.get(c, 0.0):.1%}"
+        for c in ("queue", "crypto", "encode", "store", "wire",
+                  "reactor", "other") if share.get(c)))
+    for row in out.get("top_stacks") or []:
+        stk = row["stack"]
+        if len(stk) > 64:
+            stk = "..." + stk[-61:]
+        print(f"  {row['samples']:>7}  [{row['category']}] {stk}")
+    st = out.get("stats") or {}
+    if st:
+        print("  DAEMON          HZ   SAMPLES   DROPPED")
+        for name, p in sorted(st.items()):
+            print(f"  {name:<10} {p.get('hz', 0):>7} "
+                  f"{p.get('samples', 0):>9} "
+                  f"{p.get('dropped_unshipped', 0):>9}")
 
 
 def cmd_live_slo(asok_dir: str, args) -> None:
@@ -482,6 +549,19 @@ def main(argv=None) -> None:
         "profile", help="LIVE mode: continuous critical-path profile "
                         "(per-interval attribution shares of sampled "
                         "traces)")
+    fl = sub.add_parser(
+        "flame", help="LIVE mode: r19 continuous CPU flame profiles "
+                      "(span-tagged wall-clock samples folded from "
+                      "every daemon's sampling ring); --collapsed "
+                      "prints folded-stack text, --speedscope FILE "
+                      "writes speedscope JSON")
+    fl.add_argument("daemon", nargs="?", default=None,
+                    help="one daemon's profile (default: cluster "
+                         "fold)")
+    fl.add_argument("--collapsed", action="store_true",
+                    help="folded-stack text (flamegraph.pl input)")
+    fl.add_argument("--speedscope", metavar="FILE", default=None,
+                    help="write a speedscope JSON document to FILE")
     sub.add_parser(
         "telemetry", help="LIVE mode: raw telemetry dump (series + "
                           "merged quantiles + SLO verdicts)")
@@ -506,7 +586,7 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     if args.cmd in ("daemon", "trace", "top", "slo", "profile",
-                    "telemetry") and not args.asok_dir:
+                    "flame", "telemetry") and not args.asok_dir:
         raise SystemExit(f"`{args.cmd}` needs --asok-dir (live mode "
                          f"only)")
     if args.asok_dir:
@@ -548,6 +628,8 @@ def main(argv=None) -> None:
             cmd_live_slo(args.asok_dir, args)
         elif args.cmd == "profile":
             cmd_live_profile(args.asok_dir, args)
+        elif args.cmd == "flame":
+            cmd_live_flame(args.asok_dir, args)
         elif args.cmd == "telemetry":
             print(json.dumps(live_mon_command(args.asok_dir,
                                               "telemetry"),

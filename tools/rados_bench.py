@@ -163,6 +163,12 @@ def main(argv=None) -> None:
                          "histograms off (process-wide) — the "
                          "overhead-guard OFF arm; the JSON then "
                          "carries no telemetry block")
+    ap.add_argument("--profile-hz", type=float, default=None,
+                    help="standalone: daemon_profile_hz committed for "
+                         "the run (r19 CPU sampler rate; 0 = off, the "
+                         "profiling overhead-guard OFF arm; default "
+                         "leaves the config default). The JSON gains "
+                         "a `profile` block when sampling is on")
     ap.add_argument("--tenants", type=int, default=1,
                     help="standalone: run ops round-robin across N "
                          "client entities (per-tenant mClock classes "
@@ -253,9 +259,15 @@ def main(argv=None) -> None:
             import ceph_tpu.utils.perf_counters as _pcmod
             _pcmod.LHIST_ENABLED = False
             wire_client.config_set("mgr_history_interval", 0)
+            # the OFF arm silences the whole observability plane,
+            # r19 CPU sampler included
+            wire_client.config_set("daemon_profile_hz", 0)
         else:
             wire_client.config_set("mgr_history_interval",
                                    args.history_interval)
+            if args.profile_hz is not None:
+                wire_client.config_set("daemon_profile_hz",
+                                       args.profile_hz)
         if args.hedge_delay_ms is not None:
             # committed centrally: every current AND future client of
             # this cluster resolves it live (the config-observer path)
@@ -834,6 +846,25 @@ def main(argv=None) -> None:
                     tagg.observed_client_latency(),
                 "slo": tagg.slo_status(rules=rules),
             }
+        if not args.telemetry_off and (args.profile_hz is None
+                                       or args.profile_hz > 0):
+            # r19 profile block: the daemons' cumulative flame
+            # profiles folded in-process (asok for --osd-procs
+            # children), top stacks + category split + sampler
+            # overhead. Schema pinned by tests/test_bench_schema.py.
+            from ceph_tpu.utils.profiler import profile_block
+            pdumps = []
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                try:
+                    if hasattr(d, "profiler"):
+                        pdumps.append(d.profiler.dump())
+                    else:
+                        pdumps.append(d.asok("profile"))
+                except Exception:  # noqa: BLE001 — a dying daemon
+                    continue       # drops out of the block
+            out["profile"] = profile_block(pdumps)
     if args.recovery_kill:
         # latency split around the kill + the schedulers' class grants:
         # the QoS claim ("client p95 bounded during recovery", seq:

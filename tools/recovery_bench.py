@@ -53,6 +53,11 @@ def main(argv=None) -> None:
                     help="SLO rules evaluated into the `telemetry` "
                          "block (mgr_slo_rules grammar; explicit "
                          "<logger>.<key> feeds work)")
+    ap.add_argument("--profile-hz", type=float, default=25.0,
+                    help="r19 CPU sampler rate for the run's local "
+                         "profiler (0 = off, the profiling overhead-"
+                         "guard OFF arm; the JSON gains a `profile` "
+                         "block when on)")
     ap.add_argument("--telemetry-off", action="store_true",
                     help="disable the r18 telemetry plane for this "
                          "run (no history ring, latency histograms "
@@ -132,6 +137,14 @@ def main(argv=None) -> None:
         hist = MetricsHistory(lambda: {"ec": be.perf.dump()},
                               interval=args.history_interval)
         hist.tick()               # baseline snapshot
+    # r19: no daemons here — the bench process carries its OWN
+    # sampling profiler, so the recovery pipeline's CPU split
+    # (encode vs store vs other) lands in the JSON like a daemon's
+    prof = None
+    if not args.telemetry_off and args.profile_hz > 0:
+        from ceph_tpu.utils.profiler import SamplingProfiler
+        prof = SamplingProfiler("recovery_bench",
+                                hz=args.profile_hz).start()
 
     def timed_recover():
         """The timed phase runs through the SAME plan/runner/mClock
@@ -291,6 +304,12 @@ def main(argv=None) -> None:
             },
             "slo": tagg.slo_status(rules=rules),
         }
+    if prof is not None:
+        # r19 profile block (schema pinned by test_bench_schema):
+        # the run's own flame — stop FIRST so the dump is final
+        from ceph_tpu.utils.profiler import profile_block
+        prof.stop()
+        stats["profile"] = profile_block([prof.dump()])
     if args.json:
         print(json.dumps(stats))
     else:

@@ -138,7 +138,14 @@ def run_storm(seed: int, delay: float, integrity: str,
         c.wait_for_clean(timeout=90 * load)
         time.sleep(1.0 * load)           # let async persists settle
         audit = _verify(c, cl, objects)
+        # r19: fold the daemons' flame profiles BEFORE shutdown (the
+        # clusters are storm-local, so this is the only window)
+        from ceph_tpu.utils.profiler import profile_block
+        pblock = profile_block(
+            [d.profiler.dump() for d in c.osds.values()
+             if not d._stop.is_set() and hasattr(d, "profiler")])
         return {
+            "profile": pblock,
             "seed": seed, "delay_s": delay, "integrity": integrity,
             "pulses": pulses, "revives_inside": inside,
             "revives_inside_fraction": round(inside / pulses, 3),
@@ -231,6 +238,12 @@ def main(argv=None) -> None:
                         load, log)
     rack = run_rack_loss(log=log)
 
+    # r19: one profile block per artifact (the deferred-host arm —
+    # the headline cell); the per-arm copies would triple the size
+    profile = def_host.pop("profile", None)
+    eager.pop("profile", None)
+    def_dev.pop("profile", None)
+
     ratio = round(max(def_host["repair_bytes"],
                       def_dev["repair_bytes"])
                   / max(1, eager["repair_bytes"]), 4)
@@ -269,6 +282,8 @@ def main(argv=None) -> None:
         },
         "elapsed_s": round(time.monotonic() - t0, 1),
     }
+    if profile is not None:
+        result["profile"] = profile
     text = json.dumps(result, indent=1, sort_keys=True)
     if args.out:
         with open(args.out, "w") as f:
