@@ -92,7 +92,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
                   op_shards: int = 1, osd_procs: bool = False,
                   rotate_secrets: bool = False,
                   overwrite_during_faults: bool = False,
-                  transient_fraction: float = 0.0) -> str:
+                  transient_fraction: float = 0.0,
+                  workload_profile: str | None = None) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
@@ -106,6 +107,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
         cmd += " --overwrite-during-faults"
     if transient_fraction:
         cmd += f" --transient-fraction {transient_fraction}"
+    if workload_profile:
+        cmd += f" --workload-profile {workload_profile}"
     return cmd
 
 
@@ -130,7 +133,8 @@ class Thrasher:
                  rotate_secrets: bool = False,
                  overwrite_during_faults: bool = False,
                  transient_fraction: float = 0.0,
-                 profile: str | None = None):
+                 profile: str | None = None,
+                 workload_profile: str | None = None):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -179,6 +183,14 @@ class Thrasher:
         # invariant checkers read policy counters from daemon RAM).
         self.transient_fraction = float(transient_fraction)
         self.profile = profile
+        # r20: a seeded tenant-profile op burst rides each round's
+        # fault window — the workload engine's stream generator
+        # (ceph_tpu.workload) keyed on (profile, seed ^ round), so
+        # the burst is fully deterministic and, like rmw_rng, lives
+        # OUTSIDE the action menu: pinned cells replay unchanged
+        # when the flag is off
+        self.workload_profile = workload_profile
+        self.workload_ops = 0
         self.trans_rng = random.Random(self.seed ^ 0x7AB5)
         # victim -> (revive deadline, inside_window, quiet_start,
         #            kill schedule idx, repair-bytes snapshot at kill)
@@ -207,7 +219,8 @@ class Thrasher:
             op_shards=self.op_shards, osd_procs=self.osd_procs,
             rotate_secrets=self.rotate_secrets,
             overwrite_during_faults=self.overwrite_during_faults,
-            transient_fraction=self.transient_fraction)
+            transient_fraction=self.transient_fraction,
+            workload_profile=self.workload_profile)
         self.c = None
         self.cl = None
 
@@ -635,6 +648,8 @@ class Thrasher:
                     self._tick_transients()
                 if self.overwrite_during_faults:
                     self._overwrite_sweep_during_faults(round_i)
+                if self.workload_profile:
+                    self._workload_sweep_during_faults(round_i)
                 if self.read_during_faults:
                     self._read_sweep_during_faults(round_i)
                 self._heal_and_check(round_i)
@@ -710,6 +725,75 @@ class Thrasher:
             self._log(f"round {round_i}: write_at {name} "
                       f"[{off},{off + len(patch)})")
 
+    def _workload_sweep_during_faults(self, round_i: int) -> None:
+        """r20 invariant input: a tenant-profile traffic burst WITH
+        the round's faults still live — the workload engine's seeded
+        stream generator drives reads, write_at patches, appends and
+        full rewrites against thrash-owned objects, so fault windows
+        see realistic mixed traffic, not just the menu's writes.
+        Streams come from (profile, seed ^ round) alone — the
+        dedicated-stream discipline: a seed replays the identical
+        burst, and cells without --workload-profile are untouched."""
+        from ..workload import OpStream
+        from ..workload.profiles import BUILTIN_PROFILES, TenantProfile
+        from ..workload.streams import payload_for
+        spec = BUILTIN_PROFILES.get(self.workload_profile)
+        if spec is None:
+            import json as _json
+            spec = _json.loads(self.workload_profile)
+        p = TenantProfile.from_dict(spec)
+        seed = self.seed ^ 0x301D ^ round_i
+        # ~0.5 s of the profile's schedule, executed back-to-back (a
+        # sweep, not a paced run); payload slices are seed-derived too
+        ops = OpStream(p, seed).generate(0.5)
+        payload = payload_for(p, seed)
+        for op in ops:
+            name = f"wl-{self.seed}-{p.name}-{op.obj}"
+            try:
+                if op.kind == "read":
+                    if name not in self.shadow \
+                            or name in self.unknown:
+                        continue
+                    got = self.cl.read(name)
+                    if got != self.shadow[name]:
+                        self._violate(
+                            f"round {round_i}: workload read of "
+                            f"{name!r} diverged from last acked "
+                            f"bytes")
+                elif op.kind == "write_at":
+                    patch = payload[:op.size]
+                    self.cl.write_at(name, op.offset, patch)
+                    if name not in self.unknown:
+                        old = self.shadow.get(name, b"")
+                        buf = bytearray(max(len(old),
+                                            op.offset + len(patch)))
+                        buf[:len(old)] = old
+                        buf[op.offset:op.offset + len(patch)] = patch
+                        self.shadow[name] = bytes(buf)
+                        self.removed.discard(name)
+                elif op.kind == "append":
+                    data = payload[:op.size]
+                    self.cl.append(name, data)
+                    if name not in self.unknown:
+                        self.shadow[name] = \
+                            self.shadow.get(name, b"") + data
+                        self.removed.discard(name)
+                else:       # write_full
+                    data = payload[:p.object_size]
+                    self.cl.write({name: data})
+                    self.shadow[name] = data
+                    self.removed.discard(name)
+                    self.unknown.discard(name)
+            except (ConnectionError, OSError, RuntimeError,
+                    KeyError) as e:
+                if op.kind != "read":
+                    self.unknown.add(name)
+                self._parked(f"workload {op.kind} {name}", e)
+                continue
+            self.workload_ops += 1
+        self._log(f"round {round_i}: workload sweep "
+                  f"[{p.name}] {self.workload_ops} ops total")
+
     def _heal_and_check(self, round_i: int) -> None:
         # transient victims first: the heal waits their windows out so
         # outside-window draws exercise the expire->rebuild path
@@ -775,6 +859,7 @@ class Thrasher:
             "unknown_fate": len(self.unknown),
             "degraded_read_checks": self.degraded_read_checks,
             "rmw_overwrite_checks": self.rmw_overwrite_checks,
+            "workload_ops": self.workload_ops,
             "transient_kills": self.transient_kills,
             "transient_revives_inside": self.transient_revives_inside,
             "transient_noop_checks": self.transient_noop_checks,

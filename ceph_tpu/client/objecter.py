@@ -102,6 +102,10 @@ class Objecter:
             return sum(len(np.asarray(d, np.uint8).reshape(-1))
                        if not isinstance(d, (bytes, bytearray)) else len(d)
                        for _, _, d in payload)
+        if kind == "append":
+            _name, data = payload
+            return (len(data) if isinstance(data, (bytes, bytearray))
+                    else len(np.asarray(data, np.uint8).reshape(-1)))
         return 0  # reads are charged on the reply side in the reference
 
     def _submit(self, kind: str, ps: int, payload,
@@ -185,6 +189,15 @@ class Objecter:
         ps, _ = self._calc_target(name)
         self._submit("write_ranges", ps, [(name, offset, data)],
                      snapc=snapc)
+
+    def append(self, name: str, data: bytes | np.ndarray,
+               snapc: int = 0) -> int:
+        """Tail append — the primary resolves the current object size
+        server-side and lands the bytes there (librados rados_append;
+        r16's append fast path skips the pre-read when the tail lands
+        in stripe padding). Returns the offset the data landed at."""
+        ps, _ = self._calc_target(name)
+        return self._submit("append", ps, (name, data), snapc=snapc)
 
     def _by_pg(self, names: list[str]) -> dict[int, list[str]]:
         by_pg: dict[int, list[str]] = {}

@@ -131,6 +131,15 @@ class IoCtx:
               offset: int = 0, snapc: int = 0) -> None:
         self._ob.write_at(name, offset, data, snapc=snapc)
 
+    def append(self, name: str, data: bytes | np.ndarray,
+               snapc: int = 0) -> int:
+        """rados_append: bytes land at the object's current tail (the
+        primary resolves the size server-side, so concurrent appenders
+        serialize there). Returns the landed offset. On an EC pool a
+        tail inside stripe padding takes the r16 no-preread fast
+        path."""
+        return self._ob.append(name, data, snapc=snapc)
+
     def read(self, name: str, length: int | None = None,
              offset: int = 0, snap: int | None = None) -> bytes:
         """`snap` reads the object's state as of that pool snapshot
@@ -308,7 +317,8 @@ class RadosStriper:
     """
 
     def __init__(self, ioctx: IoCtx, stripe_unit: int = 1 << 16,
-                 stripe_count: int = 4, object_size: int = 1 << 22):
+                 stripe_count: int = 4, object_size: int = 1 << 22,
+                 full_stripe_writes: bool = False):
         if object_size % stripe_unit:
             raise ValueError("object_size must be a multiple of "
                              "stripe_unit")
@@ -318,6 +328,18 @@ class RadosStriper:
         self.su = stripe_unit
         self.sc = stripe_count
         self.osz = object_size
+        # r20 routing knob: False (default) sends each piece as a
+        # range write (write_at -> the r16 parity-delta/append fast
+        # path on EC pools); True forces the pre-r16 full-stripe
+        # fallback (read-merge-write_full per piece object) — kept as
+        # the A/B baseline the bench amplification cells measure
+        # against and as an escape hatch.
+        self.full_stripe_writes = bool(full_stripe_writes)
+        #: soids this instance knows are DENSE (only ever tail-
+        #: appended from empty) — the only streams append() may route
+        #: through the server-side-offset rados append op; a sparse
+        #: write evicts (server tail != expected piece offset there)
+        self._dense: set[str] = set()
         # the size/hwm metadata update is a read-modify-write spanning
         # two ops; concurrent aio writers to one striped object could
         # interleave and lose a size extension. RLock: truncate holds
@@ -397,18 +419,84 @@ class RadosStriper:
         arr = np.frombuffer(bytes(data), dtype=np.uint8) \
             if isinstance(data, (bytes, bytearray, memoryview)) \
             else np.asarray(data, np.uint8).reshape(-1)
-        for q, ooff, lpos, ln in self._extents(offset, len(arr)):
-            piece = arr[lpos - offset:lpos - offset + ln]
-            self.io.write(self._obj(soid, q), piece, offset=ooff,
-                          snapc=snapc)
+        if self.full_stripe_writes:
+            self._write_full_stripe(soid, arr, offset, snapc)
+        else:
+            for q, ooff, lpos, ln in self._extents(offset, len(arr)):
+                piece = arr[lpos - offset:lpos - offset + ln]
+                self.io.write(self._obj(soid, q), piece, offset=ooff,
+                              snapc=snapc)
         with self._meta_lock(soid):
             try:
                 cur, hwm = self._read_meta(soid)
             except KeyError:
                 cur = hwm = 0
+            if offset > cur:
+                # a hole opened below the tail: the stream is no
+                # longer dense, append() must stop trusting the
+                # server-side tail to equal the computed piece offset
+                self._dense.discard(soid)
             new = max(cur, offset + len(arr))
             if new != cur:
                 self._write_meta(soid, new, max(hwm, new), snapc=snapc)
+
+    def _write_full_stripe(self, soid: str, arr: np.ndarray,
+                           offset: int, snapc: int) -> None:
+        """The full-stripe fallback: read-merge-write_full every piece
+        object the range touches (each rados write re-encodes the
+        whole object — the k+m wire fan-out the r16 delta path
+        avoids). Kept selectable so the benches can measure the
+        amplification win on the SAME workload."""
+        by_obj: dict[int, list] = {}
+        for q, ooff, lpos, ln in self._extents(offset, len(arr)):
+            by_obj.setdefault(q, []).append((ooff, lpos, ln))
+        for q in sorted(by_obj):
+            name = self._obj(soid, q)
+            try:
+                cur = np.frombuffer(self.io.read(name),
+                                    dtype=np.uint8)
+            except KeyError:
+                cur = np.zeros(0, dtype=np.uint8)
+            need = max(len(cur),
+                       max(ooff + ln for ooff, _, ln in by_obj[q]))
+            buf = np.zeros(need, dtype=np.uint8)
+            buf[:len(cur)] = cur
+            for ooff, lpos, ln in by_obj[q]:
+                buf[ooff:ooff + ln] = arr[lpos - offset:
+                                          lpos - offset + ln]
+            self.io.write_full(name, buf, snapc=snapc)
+
+    def append(self, soid: str, data: bytes | np.ndarray,
+               snapc: int = 0) -> int:
+        """Tail append on the logical stream; returns the offset the
+        bytes landed at. DENSE streams (only ever appended from
+        empty by this instance) ride the rados append op — the
+        primary resolves each piece's tail server-side and the r16
+        append-into-padding fast path skips the pre-read. Streams
+        with holes (or inherited from elsewhere) take the plain
+        write_at path at the same logical offset, which is equally
+        correct and still delta-eligible."""
+        arr = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if isinstance(data, (bytes, bytearray, memoryview)) \
+            else np.asarray(data, np.uint8).reshape(-1)
+        with self._meta_lock(soid):
+            try:
+                cur, hwm = self._read_meta(soid)
+            except KeyError:
+                cur = hwm = 0
+            dense = (cur == 0 and hwm == 0) or soid in self._dense
+            if dense and not self.full_stripe_writes:
+                for q, ooff, lpos, ln in self._extents(cur, len(arr)):
+                    piece = arr[lpos - cur:lpos - cur + ln]
+                    self.io.append(self._obj(soid, q), piece,
+                                   snapc=snapc)
+                self._dense.add(soid)
+                new = cur + len(arr)
+                self._write_meta(soid, new, max(hwm, new),
+                                 snapc=snapc)
+            else:
+                self.write(soid, arr, offset=cur, snapc=snapc)
+            return cur
 
     def read(self, soid: str, length: int | None = None,
              offset: int = 0, snap: int | None = None) -> bytes:
@@ -441,6 +529,9 @@ class RadosStriper:
         contract; the reference trims/zeroes objects)."""
         if new_size < 0:
             raise ValueError(f"truncate to {new_size} < 0")
+        self._dense.discard(soid)   # object tails now exceed the
+        #                             logical size; append() must
+        #                             compute offsets again
         with self._meta_lock(soid):
             old, hwm = self._read_meta(soid)
             if new_size < old:
@@ -465,5 +556,6 @@ class RadosStriper:
             except KeyError:
                 pass  # sparse stripe: unit never written
         self.io.remove(self._meta(soid), snapc=snapc)
+        self._dense.discard(soid)
         with self._meta_locks_guard:
             self._meta_locks.pop(soid, None)

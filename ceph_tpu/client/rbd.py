@@ -59,9 +59,14 @@ class RBD:
     """Image administration (the RBD() role)."""
 
     def __init__(self, ioctx: IoCtx, stripe_unit: int = 1 << 16,
-                 stripe_count: int = 4, object_size: int = 1 << 22):
+                 stripe_count: int = 4, object_size: int = 1 << 22,
+                 full_stripe_writes: bool = False):
         self.io = ioctx
         self._geom = (stripe_unit, stripe_count, object_size)
+        # r20: block IO rides write_at (the r16 partial-stripe fast
+        # path on EC pools) by default; True falls back to the
+        # read-merge-write_full full-stripe path (the A/B baseline)
+        self.full_stripe_writes = bool(full_stripe_writes)
 
     def _hdr(self, name: str) -> str:
         return f"rbd_header.{name}"
@@ -108,7 +113,8 @@ class RBD:
                 "remove them first (rbd: image has snapshots)")
         if hdr["parent"]:
             self._deregister_child(hdr["parent"], name)
-        st = RadosStriper(self.io, *self._geom)
+        st = RadosStriper(self.io, *self._geom,
+                          full_stripe_writes=self.full_stripe_writes)
         try:
             st.remove(f"rbd_data.{name}")
         except KeyError:
@@ -199,8 +205,9 @@ class Image:
         self.rbd = rbd
         self.name = name
         su, sc, osz = rbd._geom
-        self._striper = RadosStriper(rbd.io, stripe_unit=su,
-                                     stripe_count=sc, object_size=osz)
+        self._striper = RadosStriper(
+            rbd.io, stripe_unit=su, stripe_count=sc, object_size=osz,
+            full_stripe_writes=rbd.full_stripe_writes)
         self._soid = f"rbd_data.{name}"
         self._at_snap: int | None = None   # set_snap read mode
         self._pcache: dict[tuple, "Image"] = {}   # parent-at-snap

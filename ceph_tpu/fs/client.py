@@ -198,7 +198,8 @@ class FsClient:
     def __init__(self, ioctx: IoCtx, name: str = "fsclient",
                  frag_split_threshold: int = 128,
                  frag_merge_threshold: int | None = None,
-                 max_frag_bits: int = 6):
+                 max_frag_bits: int = 6,
+                 full_stripe_writes: bool = False):
         self.io = ioctx
         self.name = name
         # directory fragmentation knobs (ref: mds_bal_split_size /
@@ -211,10 +212,13 @@ class FsClient:
                                      if frag_merge_threshold is None
                                      else frag_merge_threshold)
         self.max_frag_bits = max_frag_bits
+        # r20: file data rides write_at (partial-stripe fast path on
+        # EC pools) unless the full-stripe fallback knob is set
         self._striper = RadosStriper(
             ioctx, stripe_unit=self.STRIPE_UNIT,
             stripe_count=self.STRIPE_COUNT,
-            object_size=self.OBJECT_SIZE)
+            object_size=self.OBJECT_SIZE,
+            full_stripe_writes=full_stripe_writes)
         # mkfs-on-first-mount: root dirfrag + ino allocator
         try:
             self.io.stat(_META_OBJ)

@@ -74,7 +74,7 @@ class MgrReportAggregator:
             ent["seq"] = seq
             ent["stamp"] = now
             for key in ("ops_in_flight", "slow_ops", "pgs", "epoch",
-                        "pool_bytes"):
+                        "pool_bytes", "mclock"):
                 if key in report:
                     ent[key] = report[key]
 
@@ -114,6 +114,37 @@ class MgrReportAggregator:
             for pid, b in claim.items():
                 pid = int(pid)
                 out[pid] = out.get(pid, 0) + int(b)
+        return out
+
+    def tenants(self) -> dict:
+        """Per-tenant mClock accounting summed over every daemon's
+        latest `mclock` claim (r20): class "tenant:<entity>" rows fold
+        into one row per entity — served/served_cost (grants),
+        throttled (limit-bound dequeue passes) and queued depth, plus
+        the (ρ, w, λ) profile the class last ran under. The view
+        `ceph_cli top` and the workload bench use to say WHICH tenant
+        mClock is holding back."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            claims = [e.get("mclock") or {}
+                      for e in self._daemons.values()]
+        for claim in claims:
+            for cls, row in claim.items():
+                if not cls.startswith("tenant:"):
+                    continue
+                entity = cls[len("tenant:"):]
+                cur = out.setdefault(
+                    entity, {"queued": 0, "served": 0,
+                             "served_cost": 0.0, "throttled": 0,
+                             "profile": row.get("profile")})
+                cur["queued"] += int(row.get("queued", 0))
+                cur["served"] += int(row.get("served", 0))
+                cur["served_cost"] = round(
+                    cur["served_cost"]
+                    + float(row.get("served_cost", 0.0)), 3)
+                cur["throttled"] += int(row.get("throttled", 0))
+                if row.get("profile"):
+                    cur["profile"] = row["profile"]
         return out
 
     def totals(self) -> dict:

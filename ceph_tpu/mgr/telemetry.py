@@ -71,7 +71,9 @@ _WIN_S = {"s": 1.0, "m": 60.0, "h": 3600.0}
 _RULE_RE = re.compile(
     r"^\s*(?P<metric>[A-Za-z0-9_.]+?)_p(?P<q>\d{1,3})\s*<\s*"
     r"(?P<val>\d+(?:\.\d+)?)\s*(?P<unit>us|ms|s)\s+over\s+"
-    r"(?P<win>\d+(?:\.\d+)?)\s*(?P<wu>s|m|h)\s*$")
+    r"(?P<win>\d+(?:\.\d+)?)\s*(?P<wu>s|m|h)"
+    r"(?:\s*\[\s*tenant\s*=\s*(?P<tenant>[A-Za-z0-9_.:@-]+)\s*\])?"
+    r"\s*$")
 
 #: the fast burn window, in data intervals: a rule breaches when the
 #: newest FAST_INTERVALS intervals with samples all violate — so an
@@ -82,25 +84,35 @@ FAST_INTERVALS = 2
 
 
 class SLORule:
-    """One parsed rule: `client_read_p99 < 50ms over 5m`."""
+    """One parsed rule: `client_read_p99 < 50ms over 5m`. r20 adds an
+    optional tenant qualifier — `client_observed_p99 < 30ms over 2m
+    [tenant=client.interactive]` — which evaluates the rule against
+    that tenant's OWN observed-latency feed (the per-tenant snapshots
+    the workload engine ships via ingest_client(tenant=...)) instead
+    of the cluster merge."""
 
     __slots__ = ("name", "logger", "key", "q", "threshold_s",
-                 "window_s")
+                 "window_s", "tenant")
 
     def __init__(self, name: str, logger: str, key: str, q: float,
-                 threshold_s: float, window_s: float):
+                 threshold_s: float, window_s: float,
+                 tenant: str | None = None):
         self.name = name
         self.logger = logger
         self.key = key
         self.q = q
         self.threshold_s = threshold_s
         self.window_s = window_s
+        self.tenant = tenant
 
     def to_dict(self) -> dict:
-        return {"name": self.name, "logger": self.logger,
-                "key": self.key, "quantile": self.q,
-                "threshold_ms": round(self.threshold_s * 1e3, 3),
-                "window_s": self.window_s}
+        out = {"name": self.name, "logger": self.logger,
+               "key": self.key, "quantile": self.q,
+               "threshold_ms": round(self.threshold_s * 1e3, 3),
+               "window_s": self.window_s}
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        return out
 
 
 def parse_slo_rules(text: str) -> list[SLORule]:
@@ -131,12 +143,22 @@ def parse_slo_rules(text: str) -> list[SLORule]:
         if not 0.0 < q < 1.0:
             raise ValueError(f"bad SLO rule {frag!r}: quantile "
                              f"p{m.group('q')} out of (0, 100)")
+        tenant = m.group("tenant")
+        if tenant is not None and metric != "client_observed":
+            raise ValueError(
+                f"bad SLO rule {frag!r}: [tenant=...] only applies "
+                f"to the client_observed feed (per-tenant data comes "
+                f"from client-shipped snapshots)")
+        name = f"{metric}_p{m.group('q')}"
+        if tenant is not None:
+            name += f"[{tenant}]"
         rules.append(SLORule(
-            name=f"{metric}_p{m.group('q')}", logger=logger, key=key,
+            name=name, logger=logger, key=key,
             q=q,
             threshold_s=float(m.group("val"))
             * _UNIT_S[m.group("unit")],
-            window_s=float(m.group("win")) * _WIN_S[m.group("wu")]))
+            window_s=float(m.group("win")) * _WIN_S[m.group("wu")],
+            tenant=tenant))
     return rules
 
 
@@ -162,6 +184,12 @@ class TelemetryAggregator:
         self._clients: dict[str, dict] = {}
         #: daemon -> (last dropped_unshipped, consecutive growths)
         self._flight: dict[str, tuple[int, int]] = {}
+        #: r20 per-tenant observed-latency feed: tenant -> last
+        #: cumulative op_lat_hist, and tenant -> bounded ring of
+        #: (t, interval-delta hist) points the tenant-qualified SLO
+        #: rules evaluate over
+        self._tenant_last: dict[str, dict] = {}
+        self._tenant_points: dict[str, list] = {}
 
     # -- ingest ---------------------------------------------------------------
 
@@ -195,12 +223,32 @@ class TelemetryAggregator:
                                 self._intervals[b]["t"])[:over]:
                     del self._intervals[b]
 
-    def ingest_client(self, name: str, client_perf: dict) -> None:
+    def ingest_client(self, name: str, client_perf: dict,
+                      tenant: str | None = None) -> None:
         """A client's CUMULATIVE "client" logger dump (ships with its
-        trace flushes): newest snapshot wins per client."""
-        if isinstance(client_perf, dict):
-            with self._lock:
-                self._clients[name] = client_perf
+        trace flushes): newest snapshot wins per client. With
+        `tenant=` (r20, the workload engine's per-tenant feed) the
+        snapshot ALSO folds into that tenant's interval ring: each
+        call appends the op_lat_hist delta vs the previous snapshot
+        as one (t, hist) point, so tenant-qualified SLO rules get the
+        same interval/burn-window semantics the cluster feeds have."""
+        if not isinstance(client_perf, dict):
+            return
+        with self._lock:
+            self._clients[name] = client_perf
+            if tenant is None:
+                return
+            hist = (client_perf.get("client") or client_perf
+                    ).get("op_lat_hist")
+            if not isinstance(hist, dict) or "buckets" not in hist:
+                return
+            delta = _lhist_sub(hist, self._tenant_last.get(tenant))
+            self._tenant_last[tenant] = hist
+            if not delta.get("count"):
+                return
+            ring = self._tenant_points.setdefault(tenant, [])
+            ring.append((self._now(), delta))
+            del ring[:-self._max]
 
     def note_flight(self, name: str, stats: dict) -> None:
         """Track a daemon's flight-ring `dropped_unshipped` across
@@ -316,6 +364,23 @@ class TelemetryAggregator:
         return {"source": "osd", "pool": 1,
                 **lhist_quantiles(merged)}
 
+    def tenant_latency(self) -> dict:
+        """Per-tenant observed-latency quantiles merged over each
+        tenant's interval ring (r20) — the per-tenant complement of
+        observed_client_latency(), empty until the workload engine
+        ships tenant-tagged snapshots."""
+        with self._lock:
+            rings = {t: [h for _t, h in pts]
+                     for t, pts in self._tenant_points.items()}
+        out = {}
+        for tenant, hists in sorted(rings.items()):
+            merged: dict = {}
+            for h in hists:
+                merged = lhist_merge(merged, h)
+            out[tenant] = {"intervals": len(hists),
+                           **lhist_quantiles(merged)}
+        return out
+
     # -- SLO evaluation -------------------------------------------------------
 
     def _rules(self) -> list[SLORule]:
@@ -340,14 +405,25 @@ class TelemetryAggregator:
         for rule in (self._rules() if rules is None else rules):
             with self._lock:
                 points = []
-                for b in self._buckets_locked(rule.window_s):
-                    ent = self._intervals[b]
-                    h = (ent["delta"].get(rule.logger)
-                         or {}).get(rule.key)
-                    if isinstance(h, dict) and h.get("count"):
-                        points.append(
-                            (b, lhist_quantile(h, rule.q),
-                             int(h["count"])))
+                if rule.tenant is not None:
+                    # tenant-qualified rule: evaluate over that
+                    # tenant's own interval ring (r20)
+                    cutoff = self._now() - rule.window_s
+                    for i, (t, h) in enumerate(
+                            self._tenant_points.get(rule.tenant, [])):
+                        if t >= cutoff and h.get("count"):
+                            points.append(
+                                (i, lhist_quantile(h, rule.q),
+                                 int(h["count"])))
+                else:
+                    for b in self._buckets_locked(rule.window_s):
+                        ent = self._intervals[b]
+                        h = (ent["delta"].get(rule.logger)
+                             or {}).get(rule.key)
+                        if isinstance(h, dict) and h.get("count"):
+                            points.append(
+                                (b, lhist_quantile(h, rule.q),
+                                 int(h["count"])))
             violated = [q > rule.threshold_s for _b, q, _n in points]
             fast = violated[-FAST_INTERVALS:]
             burn_fast = (sum(fast) / len(fast)) if fast else 0.0
@@ -513,9 +589,36 @@ class TelemetryAggregator:
                "cluster": self.quantiles("osd", "op_latency_hist"),
                "observed_client_latency":
                    self.observed_client_latency()}
+        tl = self.tenant_latency()
+        if tl:
+            out["tenant_latency"] = tl
         if reports is not None:
             out["totals"] = reports.totals()
         return out
+
+
+def _lhist_sub(cur: dict, prev: dict | None) -> dict:
+    """Bucket-wise lhist subtraction cur - prev (both cumulative
+    dumps). A fresh/reset snapshot (no prev, shorter buckets, or any
+    bucket that went DOWN — client restart) deltas against zero, i.e.
+    returns cur whole; never a negative histogram."""
+    cb = list(cur.get("buckets") or [])
+    if prev is None:
+        return {"buckets": cb, "sum": float(cur.get("sum", 0.0)),
+                "count": int(cur.get("count", 0))}
+    pb = list(prev.get("buckets") or [])
+    if len(pb) > len(cb):
+        return {"buckets": cb, "sum": float(cur.get("sum", 0.0)),
+                "count": int(cur.get("count", 0))}
+    pb += [0] * (len(cb) - len(pb))
+    if any(c < p for c, p in zip(cb, pb)):
+        return {"buckets": cb, "sum": float(cur.get("sum", 0.0)),
+                "count": int(cur.get("count", 0))}
+    return {"buckets": [c - p for c, p in zip(cb, pb)],
+            "sum": float(cur.get("sum", 0.0))
+            - float(prev.get("sum", 0.0)),
+            "count": int(cur.get("count", 0))
+            - int(prev.get("count", 0))}
 
 
 def _normalize_loggers(delta: dict) -> dict:

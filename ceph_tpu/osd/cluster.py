@@ -807,6 +807,20 @@ class SimCluster:
                            f"pg 1.{ps} is {res.state}; op parked")
         op.mark_event("reached_pg")  # map checks + peering gate passed
         dead = self._dead_osds()
+        if kind == "append":
+            # tail append (librados rados_append): the PRIMARY owns
+            # the authoritative size, so the offset resolves here —
+            # two appenders racing through the same primary serialize
+            # instead of clobbering. Rides _apply_write as a range
+            # write so COW + backfill requeue apply; on an EC pool a
+            # tail inside stripe padding takes the r16 append fast
+            # path (no pre-read) inside write_ranges.
+            name, data = payload
+            off = int(self.pgs[ps].object_sizes.get(name, 0))
+            self._apply_write(ps, "write_ranges", [(name, off, data)],
+                              dead, snapc=snapc)
+            op.mark_event("commit_sent")
+            return off
         if kind in ("write", "write_ranges", "remove"):
             self._apply_write(ps, kind, payload, dead, snapc=snapc)
             op.mark_event("commit_sent")

@@ -213,7 +213,7 @@ class DomainBudgets:
 
 class _ClassQueue:
     __slots__ = ("profile", "items", "r_prev", "l_prev", "p_prev",
-                 "busy", "served", "served_cost")
+                 "busy", "served", "served_cost", "throttled")
 
     def __init__(self, profile: ClientProfile):
         self.profile = profile
@@ -224,6 +224,7 @@ class _ClassQueue:
         self.busy = False
         self.served = 0             # ops granted (occupancy dumps)
         self.served_cost = 0.0      # cost units granted
+        self.throttled = 0          # dequeue passes skipped limit-bound
 
 
 class MClockScheduler:
@@ -320,6 +321,14 @@ class MClockScheduler:
                 q.busy = False
                 continue
             r_tag, l_tag, p_tag = self._head_tags(q, now)
+            if r_tag > now and l_tag > now:
+                # head has queued work but its limit tag is in the
+                # future: this pass the class is LIMIT-BOUND. Count it —
+                # the per-tenant throttle attribution dump_mclock and
+                # the workload engine surface (which tenant mClock is
+                # actually holding back, not just who is slow).
+                q.throttled += 1
+                continue
             if r_tag <= now and (best_r is None or r_tag < best_r[0]):
                 best_r = (r_tag, name, l_tag, p_tag)
             if l_tag <= now and (best_w is None or p_tag < best_w[0]):
@@ -363,6 +372,7 @@ class MClockScheduler:
         return {name: {"queued": len(q.items),
                        "served": q.served,
                        "served_cost": round(q.served_cost, 3),
+                       "throttled": q.throttled,
                        "profile": {"reservation": q.profile.reservation,
                                    "weight": q.profile.weight,
                                    "limit": q.profile.limit}}

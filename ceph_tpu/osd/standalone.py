@@ -1796,6 +1796,7 @@ class OSDDaemon:
                     cur["served"] += row["served"]
                     cur["served_cost"] = round(
                         cur["served_cost"] + row["served_cost"], 3)
+                    cur["throttled"] += row.get("throttled", 0)
         return out
 
     # -- store service (the SubOp executor) ---------------------------------
@@ -4297,6 +4298,10 @@ class OSDDaemon:
             "slow_ops": len(self.op_tracker.slow_ops()),
             "epoch": self.osdmap.epoch
             if self.osdmap is not None else 0,
+            # r20: merged mClock class occupancy rides every report so
+            # the mon-side aggregate (and `ceph_cli top`) can attribute
+            # WHICH tenant is being throttled, not just who is slow
+            "mclock": self.sched_dump(),
         }
         if full:
             report["schema"] = self.perf_schema_all()
@@ -5166,6 +5171,9 @@ class MonDaemon:
                     self.telemetry.flight_drops(),
                 "profiler": self.profiles.stats(),
             }
+            # r20: per-tenant mClock grant/throttle accounting folded
+            # from the daemons' mclock report claims
+            out["tenants"] = self.mgr.tenants()
             return out
         if kind == "profile cpu" or kind.startswith("profile cpu "):
             # r19 flame profiles: cluster/per-daemon span-tagged CPU

@@ -24,3 +24,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache (r20): the suite's placement /
+# kernel cells recompile the same programs every run — ~55 s of
+# test_crush's 81 s alone is compile. One warm cache run cuts the
+# whole tier-1 by minutes on this 1-core box. Honors an explicit
+# JAX_COMPILATION_CACHE_DIR; defaults to a shared tmp dir so CI's
+# next run (same container) starts warm. Safe across processes —
+# jax writes cache entries atomically.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    import tempfile
+    _cache_dir = os.path.join(tempfile.gettempdir(),
+                              "ceph_tpu_xla_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

@@ -135,6 +135,100 @@ def test_slo_rule_schema_pinned():
         "threshold_ms": 50.0, "window_s": 300.0}]
 
 
+def test_slo_rule_tenant_qualifier_pinned():
+    """The r20 grammar extension: an optional `[tenant=...]` suffix
+    scopes a client_observed rule to one tenant's own latency ring
+    (the workload engine's per-tenant feed); the qualifier is only
+    legal on the client_observed feed, and unqualified rules keep the
+    exact pre-r20 dict shape (pinned above)."""
+    import pytest
+
+    from ceph_tpu.mgr.telemetry import parse_slo_rules
+    rules = parse_slo_rules(
+        "client_observed_p99 < 30ms over 2m [tenant=client.noisy]")
+    assert [r.to_dict() for r in rules] == [{
+        "name": "client_observed_p99[client.noisy]",
+        "logger": "client", "key": "op_lat_hist", "quantile": 0.99,
+        "threshold_ms": 30.0, "window_s": 120.0,
+        "tenant": "client.noisy"}]
+    with pytest.raises(ValueError, match="only applies"):
+        parse_slo_rules("client_read_p99 < 30ms over 2m "
+                        "[tenant=client.noisy]")
+
+
+WL_TENANT_KEYS = {"entity", "klass", "stream_ops", "ops", "errors",
+                  "routed", "digest", "mclock", "slo", "pre_kill",
+                  "post_kill"}
+WL_ROUTED_KEYS = {"read", "write_at", "append", "write_full"}
+
+
+def test_workload_r20_artifact_pinned():
+    """The committed r20 multi-tenant workload artifact: a live
+    cephx+secure run of the 4-tenant builtin mix with a daemon kill
+    mid-run. The acceptance floors: the noisy neighbor is visibly
+    THROTTLED by its own mClock class (throttle counters > 0, its
+    own SLO burning) while every other tenant's p99 SLO verdict
+    stays green; the op streams replay bit-exactly from
+    (profiles, seed); and the write_at block path ships less than
+    half the full-stripe baseline's wire bytes per overwrite."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "WORKLOAD_r20.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "workload_r20/1"
+    cfg = data["config"]
+    assert cfg["cephx"] and cfg["secure"] and cfg["kill"]
+    assert cfg["mclock_table"] and cfg["slo_rules"]
+    assert set(data["tenants"]) == {"interactive", "streaming",
+                                    "bursty", "noisy"}
+    for name, row in data["tenants"].items():
+        assert WL_TENANT_KEYS <= set(row), name
+        assert row["ops"] > 0 and PCT_KEYS <= set(row)
+        assert set(row["routed"]) == WL_ROUTED_KEYS
+    # streams block: every digest is a sha256 the --repro path can
+    # regenerate from the committed profiles + seed alone
+    for name, srow in data["streams"].items():
+        assert len(srow["digest"]) == 64 and srow["ops"] > 0, name
+        assert srow["digest"] == data["tenants"][name]["digest"]
+    # block-path routing did what the profiles declared
+    assert data["tenants"]["interactive"]["routed"]["write_at"] > 0
+    assert data["tenants"]["streaming"]["routed"]["write_full"] > 0
+    assert data["tenants"]["bursty"]["routed"]["append"] > 0
+    # the noisy neighbor: limit-bound by ITS class, SLO burning
+    noisy = data["tenants"]["noisy"]
+    assert noisy["mclock"]["throttled"] > 0
+    assert noisy["mclock"]["profile"]["limit"] == 25.0
+    assert any(v["breach"] for v in noisy["slo"])
+    # every quiet tenant held its SLO, non-vacuously, across a kill
+    for q in ("interactive", "streaming", "bursty"):
+        vs = data["tenants"][q]["slo"]
+        assert vs and all(v["intervals"] >= 2 and not v["breach"]
+                          for v in vs), q
+    # the mon-side per-tenant aggregate rode the MgrReport pipe
+    assert "client.noisy" in data["mclock"]["mgr_aggregate"]
+    assert data["mclock"]["mgr_aggregate"]["client.noisy"][
+        "throttled"] > 0
+    # amplification: the write_at cell stayed on the delta path and
+    # beat the full-stripe baseline
+    amp = data["amplification"]
+    assert amp["write_at"]["rmw_ops"] > 0
+    assert amp["write_at"]["full_fallbacks"] == 0
+    # the r19 profiling plane attributed the run: folded flames from
+    # the surviving daemons (the kill victim drops out of the block)
+    pb = data["profile_block"]
+    assert pb["samples"] > 0 and pb["daemons"]
+    assert "category_share" in pb and pb["top_stacks"]
+    acc = data["acceptance"]
+    assert acc["noisy_visibly_throttled"] is True
+    assert acc["noisy_throttled"] > 0
+    assert acc["quiet_tenants_green"] is True
+    assert acc["replay_digest_match"] is True
+    assert acc["every_tenant_completed_ops"] is True
+    assert acc["daemon_killed"] is True
+    assert acc["overwrite_wire_vs_full_stripe"] <= 0.5
+    assert data["recovery_kill"]["victim_killed_at_s"] > 0
+
+
 def _check_trace_block(tr):
     assert TRACE_KEYS <= set(tr)
     assert tr["found"] is True

@@ -23,7 +23,11 @@ from ceph_tpu.chaos import Thrasher
 # the matrix axes: seeds are arbitrary but FIXED — a failure report
 # names (seed, store) and tools/thrash.py replays it bit-for-bit
 MATRIX_SEEDS = [11, 23, 37, 41, 59, 67, 73, 89, 97, 101]
-SMOKE = [(11, "mem"), (23, "tin")]
+# the tin cell + the sharded smoke stay tier-1 (store-backed + r13
+# dispatch); the plain mem seed repeats their schedule shape at ~14 s
+# and moved to the nightly (r20 CI-budget trim)
+SMOKE = [pytest.param(11, "mem", marks=pytest.mark.slow),
+         (23, "tin")]
 
 
 def run_cell(seed: int, store: str, tmp_path) -> dict:
@@ -138,9 +142,15 @@ def test_thrash_degraded_reads_never_block(seed, store, tmp_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [311])
 def test_thrash_transient_smoke(seed, tmp_path):
-    """r17 tier-1 cell: the transient-vs-real failure mix — a seeded
+    """r17 cell (slow since r20: 7-9s on a quiet box but >120s with
+    repeated in-suite load flakes when heartbeat stretching pushes the
+    policy mid-override — the r18/r19-noted flake; tier-1 keeps the
+    transient plane through test_repair_policy's deterministic
+    virtual-clock cells, which don't ride real heartbeats): the
+    transient-vs-real failure mix — a seeded
     kill stream whose victims auto-revive inside/outside the
     osd_repair_delay window (k=2 m=3 so single losses keep >= 2 spare
     redundancy and really defer). The run itself asserts the two

@@ -186,6 +186,20 @@ def cmd_live_top(asok_dir: str, args) -> None:
     for name, row in sorted((t.get("daemons") or {}).items()):
         print(f"  {name:<10} {row['ops_per_s']:>7} "
               f"{row['subops_per_s']:>10} {row['op_ms_avg']:>10}")
+    # r20: per-tenant mClock accounting — served grants vs limit-bound
+    # passes, so the operator sees WHICH tenant is being throttled
+    tenants = t.get("tenants") or {}
+    if tenants:
+        print(f"  TENANT            SERVED      COST  THROTTLED  "
+              f"QUEUED  (res/wgt/lim)")
+        for ent, row in sorted(tenants.items()):
+            prof = row.get("profile") or {}
+            print(f"  {ent:<16} {row.get('served', 0):>7} "
+                  f"{row.get('served_cost', 0.0):>9} "
+                  f"{row.get('throttled', 0):>10} "
+                  f"{row.get('queued', 0):>7}  "
+                  f"({prof.get('reservation', 0)}/"
+                  f"{prof.get('weight', 0)}/{prof.get('limit', 0)})")
     # r19: per-daemon observability drop gauges — sampler ring +
     # flight ring losses are operator-visible, not silent
     obs = t.get("observability") or {}

@@ -1,0 +1,129 @@
+"""fs_bench — timed file I/O through the FS client ladder.
+
+The CephFS analog of `rbd bench` (ref: the fio cephfs engine's role):
+a timed loop of file writes/reads through FsClient -> RadosStriper ->
+librados -> EC pool on a hermetic SimCluster, reporting latency
+percentiles and — for writes — the r20 `amplification` block: EC
+wire-byte deltas over the timed loop, so the write_at partial-stripe
+default and the `--full-stripe-writes` fallback are A/B-comparable on
+one workload (the r16 item-3c measurement, FS side).
+
+  python tools/fs_bench.py --io-size 4K --ios 32
+  python tools/fs_bench.py --io-size 4K --ios 32 --full-stripe-writes
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_size(s: str) -> int:
+    s = s.strip().upper()
+    mult = 1
+    for suf, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if s.endswith(suf):
+            mult, s = m, s[:-1]
+            break
+    return int(float(s) * mult)
+
+
+def ec_counter_totals(cluster) -> dict:
+    """Scalar EC-backend counters summed over every PG (the
+    amplification numerators; rbd_cli._ec_counter_totals twin)."""
+    tot: dict = {}
+    for ps in range(cluster.pg_num):
+        perf = getattr(cluster.pgs[ps], "perf", None)
+        if perf is None:
+            continue
+        for k, v in perf.dump().items():
+            if isinstance(v, (int, float)):
+                tot[k] = tot.get(k, 0) + v
+    return tot
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="fs_bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--io-size", default="4K")
+    ap.add_argument("--ios", type=int, default=32)
+    ap.add_argument("--io-type", dest="io_type", default="write",
+                    choices=["write", "read"])
+    ap.add_argument("--file-size", default="1M",
+                    help="logical file size the offsets spread over")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--full-stripe-writes", action="store_true",
+                    help="fall back to read-merge-write_full (the "
+                         "pre-r16 baseline the amplification block "
+                         "compares against)")
+    a = ap.parse_args(argv)
+
+    import numpy as np
+    from ceph_tpu.client.rados import Rados
+    from ceph_tpu.fs.client import FsClient
+    from ceph_tpu.osd.cluster import SimCluster
+
+    io_size = parse_size(a.io_size)
+    file_size = max(parse_size(a.file_size), io_size)
+    cluster = SimCluster(n_osds=6, pg_num=4)
+    io = Rados(cluster).open_ioctx()
+    fs = FsClient(io, full_stripe_writes=a.full_stripe_writes)
+    rng = np.random.default_rng(a.seed)
+    payload = rng.integers(0, 256, io_size, np.uint8).tobytes()
+    fs.create("/bench.dat")
+    # materialize the file once so the timed loop measures OVERWRITES
+    # (the partial-stripe case), then one warm op outside the window
+    for off in range(0, file_size, max(io_size, 1 << 16)):
+        fs.write("/bench.dat", payload[:min(io_size, file_size - off)],
+                 offset=off)
+    offsets = rng.integers(0, max(1, file_size - io_size), a.ios)
+    fs.write("/bench.dat", payload, offset=0)   # warm (jit outside)
+    if a.io_type == "read":
+        fs.read("/bench.dat", io_size, 0)
+
+    ec0 = ec_counter_totals(cluster)
+    lat = []
+    t_start = time.perf_counter()
+    for off in offsets:
+        t0 = time.perf_counter()
+        if a.io_type == "write":
+            fs.write("/bench.dat", payload, offset=int(off))
+        else:
+            fs.read("/bench.dat", io_size, int(off))
+        lat.append(time.perf_counter() - t0)
+    dt = time.perf_counter() - t_start
+    ec1 = ec_counter_totals(cluster)
+
+    arr = sorted(lat)
+    pick = lambda q: arr[min(len(arr) - 1, int(q * len(arr)))]  # noqa: E731
+    out = {"io_type": a.io_type, "io_size": io_size,
+           "file_size": file_size, "ios": len(lat),
+           "seconds": round(dt, 3),
+           "iops": round(len(lat) / dt, 1),
+           "mb_per_s": round(len(lat) * io_size / dt / 1e6, 2),
+           "p50_ms": round(pick(0.5) * 1e3, 3),
+           "p99_ms": round(pick(0.99) * 1e3, 3)}
+    if a.io_type == "write":
+        d = {k: ec1.get(k, 0) - ec0.get(k, 0)
+             for k in ("rmw_ops", "rmw_wire_bytes",
+                       "rmw_preread_bytes", "rmw_append_fast",
+                       "rmw_full_fallbacks", "write_wire_bytes")}
+        wire = d["rmw_wire_bytes"] + d["write_wire_bytes"]
+        logical = len(lat) * io_size
+        out["amplification"] = {
+            "full_stripe_writes": bool(a.full_stripe_writes),
+            **d,
+            "wire_bytes_total": wire,
+            "wire_bytes_per_op": round(wire / max(1, len(lat)), 1),
+            "wire_per_logical": round(wire / max(1, logical), 3)}
+    print(json.dumps(out, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -177,15 +177,35 @@ def cmd_children(st: State, a) -> None:
         print(c)
 
 
+def _ec_counter_totals(st: State) -> dict:
+    """Scalar EC-backend counters summed over every PG — the
+    amplification numerators (rmw_wire_bytes vs write_wire_bytes)
+    the bench JSON reports deltas of."""
+    tot: dict = {}
+    for ps in range(st.cluster.pg_num):
+        perf = getattr(st.cluster.pgs[ps], "perf", None)
+        if perf is None:
+            continue
+        for k, v in perf.dump().items():
+            if isinstance(v, (int, float)):
+                tot[k] = tot.get(k, 0) + v
+    return tot
+
+
 def cmd_bench(st: State, a) -> None:
     """`rbd bench --io-type write|read` (ref: src/tools/rbd/action/
     Bench.cc): timed sequential or random I/O against the image
     through the full stack (librbd-shaped Image -> striper ->
-    librados -> EC pool)."""
+    librados -> EC pool). Writes report an `amplification` block —
+    EC wire-byte deltas over the timed loop — and
+    `--full-stripe-writes` pins the pre-r16 read-merge-write_full
+    baseline so the two paths are A/B-comparable on one workload."""
     import time
 
     import numpy as np
     from ceph_tpu.client.rbd import Image
+    st.rbd.full_stripe_writes = bool(
+        getattr(a, "full_stripe_writes", False))
     img = Image(st.rbd, a.image)
     size = img.size()
     io_size = parse_size(a.io_size)
@@ -214,6 +234,7 @@ def cmd_bench(st: State, a) -> None:
         img.write(0, payload)
     else:
         img.read(0, io_size)
+    ec0 = _ec_counter_totals(st)
     lat = []
     t_start = time.perf_counter()
     for off in offsets:
@@ -224,6 +245,7 @@ def cmd_bench(st: State, a) -> None:
             img.read(int(off), io_size)
         lat.append(time.perf_counter() - t0)
     dt = time.perf_counter() - t_start
+    ec1 = _ec_counter_totals(st)
     arr = sorted(lat)
     pick = lambda q: arr[min(len(arr) - 1, int(q * len(arr)))]  # noqa: E731
     out = {"image": a.image, "io_type": a.io_type,
@@ -233,6 +255,19 @@ def cmd_bench(st: State, a) -> None:
            "mb_per_s": round(len(lat) * io_size / dt / 1e6, 2),
            "p50_ms": round(pick(0.5) * 1e3, 3),
            "p99_ms": round(pick(0.99) * 1e3, 3)}
+    if a.io_type == "write":
+        d = {k: ec1.get(k, 0) - ec0.get(k, 0)
+             for k in ("rmw_ops", "rmw_wire_bytes",
+                       "rmw_preread_bytes", "rmw_append_fast",
+                       "rmw_full_fallbacks", "write_wire_bytes")}
+        wire = d["rmw_wire_bytes"] + d["write_wire_bytes"]
+        logical = len(lat) * io_size
+        out["amplification"] = {
+            "full_stripe_writes": st.rbd.full_stripe_writes,
+            **d,
+            "wire_bytes_total": wire,
+            "wire_bytes_per_op": round(wire / max(1, len(lat)), 1),
+            "wire_per_logical": round(wire / max(1, logical), 3)}
     print(json.dumps(out, sort_keys=True))
 
 
@@ -318,6 +353,11 @@ def main(argv=None) -> None:
     p.add_argument("--io-total", dest="io_total", default="4M")
     p.add_argument("--io-pattern", dest="pattern", default="seq",
                    choices=["seq", "rand"])
+    p.add_argument("--full-stripe-writes", dest="full_stripe_writes",
+                   action="store_true",
+                   help="fall back to the read-merge-write_full "
+                        "full-stripe path (the pre-r16 baseline the "
+                        "amplification block compares against)")
     p = sub.add_parser("export"); p.add_argument("image")
     p.add_argument("dest"); p.add_argument("--snap")
     p = sub.add_parser("import"); p.add_argument("src")

@@ -32,7 +32,8 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
              overwrite_during_faults: bool = False,
              transient_fraction: float = 0.0,
              n_osds: int | None = None,
-             profile: str | None = None) -> dict:
+             profile: str | None = None,
+             workload_profile: str | None = None) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     if osd_procs:
         store = "tin"            # children need a real on-disk store
@@ -52,6 +53,7 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
                   store_dir=tmp, verbose=verbose, op_shards=op_shards,
                   osd_procs=osd_procs, rotate_secrets=rotate_secrets,
                   overwrite_during_faults=overwrite_during_faults,
+                  workload_profile=workload_profile,
                   **kwargs)
     try:
         report = th.run()
@@ -97,6 +99,14 @@ def main() -> int:
                          "journal must replay clean (drawn from a "
                          "dedicated seeded stream; pinned cells "
                          "replay unchanged)")
+    ap.add_argument("--workload-profile", default=None,
+                    help="r20: per-round tenant-traffic burst with "
+                         "the faults still live — a builtin profile "
+                         "name (interactive/streaming/bursty/noisy) "
+                         "or inline profile JSON; streams come from "
+                         "the workload engine's seeded generator "
+                         "(dedicated stream, outside the action "
+                         "menu: pinned cells replay unchanged)")
     ap.add_argument("--transient-fraction", type=float, default=0.0,
                     help="r17: fraction of a dedicated seeded kill "
                          "stream whose victims AUTO-REVIVE inside/"
@@ -131,7 +141,8 @@ def main() -> int:
                        osd_procs=args.osd_procs,
                        rotate_secrets=args.rotate_secrets,
                        overwrite_during_faults=args.overwrite_during_faults,
-                       transient_fraction=args.transient_fraction)
+                       transient_fraction=args.transient_fraction,
+                       workload_profile=args.workload_profile)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
