@@ -93,7 +93,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
                   rotate_secrets: bool = False,
                   overwrite_during_faults: bool = False,
                   transient_fraction: float = 0.0,
-                  workload_profile: str | None = None) -> str:
+                  workload_profile: str | None = None,
+                  disk_full: bool = False) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
@@ -109,6 +110,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
         cmd += f" --transient-fraction {transient_fraction}"
     if workload_profile:
         cmd += f" --workload-profile {workload_profile}"
+    if disk_full:
+        cmd += " --disk-full"
     return cmd
 
 
@@ -134,7 +137,8 @@ class Thrasher:
                  overwrite_during_faults: bool = False,
                  transient_fraction: float = 0.0,
                  profile: str | None = None,
-                 workload_profile: str | None = None):
+                 workload_profile: str | None = None,
+                 disk_full: bool = False):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -191,6 +195,24 @@ class Thrasher:
         # when the flag is off
         self.workload_profile = workload_profile
         self.workload_ops = 0
+        # r21: the disk_full fault stream — capacity-exhaustion
+        # windows (every live store shrunk to just over the failsafe
+        # ratio, mon ladder flips FULL, a background writer must PARK
+        # with zero op_errors and drain exactly-once after restore)
+        # plus one-shot ENOSPC injection at a drawn store txn phase
+        # each round. Own stream (OUTSIDE the action menu, like
+        # rmw_rng): pinned cells replay unchanged with the flag off.
+        # In-process only: the sweep reaches stores and perf counters
+        # through daemon RAM.
+        self.disk_full = bool(disk_full)
+        self.full_rng = random.Random(self.seed ^ 0xF011)
+        self.full_windows = 0
+        self.full_reads_served = 0
+        self.full_parked_drained = 0
+        self.enospc_injected = 0
+        self.enospc_fired = 0
+        #: armed one-shot ENOSPC faults: (osd, phase, {"n": shots})
+        self._armed_faults: list[tuple[int, str, dict]] = []
         self.trans_rng = random.Random(self.seed ^ 0x7AB5)
         # victim -> (revive deadline, inside_window, quiet_start,
         #            kill schedule idx, repair-bytes snapshot at kill)
@@ -220,7 +242,8 @@ class Thrasher:
             rotate_secrets=self.rotate_secrets,
             overwrite_during_faults=self.overwrite_during_faults,
             transient_fraction=self.transient_fraction,
-            workload_profile=self.workload_profile)
+            workload_profile=self.workload_profile,
+            disk_full=self.disk_full)
         self.c = None
         self.cl = None
 
@@ -285,6 +308,10 @@ class Thrasher:
                                timeout=20 * self.load)
         except TimeoutError as e:
             self._parked("config_set scrub", e)
+        if self.disk_full and self.osd_procs:
+            raise ValueError("disk_full needs in-process daemons "
+                             "(capacity shrink + fault arming reach "
+                             "stores through daemon RAM)")
         if self.transient_fraction > 0:
             if self.osd_procs:
                 raise ValueError("transient_fraction needs in-process "
@@ -622,6 +649,225 @@ class Thrasher:
                           f"inversion(s) in the rebuild queue under "
                           f"risk order")
 
+    # -- capacity exhaustion (r21) --------------------------------------------
+
+    #: store txn phases the one-shot ENOSPC draw picks from (the
+    #: store/KV `set_fault` hook points; mem has no WAL/flush plane)
+    _ENOSPC_PHASES = {
+        "mem": ("txn.apply",),
+        "tin": ("txn.apply", "wal.append", "flush.segment-written",
+                "flush.manifest-swapped", "compact.segments-written",
+                "compact.manifest-swapped"),
+    }
+
+    def _enospc_sweep(self, round_i: int) -> None:
+        """Arm ONE one-shot ENOSPC at a drawn (victim, txn phase) for
+        this round's fault window. Whatever path trips it — a client
+        write's apply, a replica subop, WAL append, a background
+        flush/compact — must abort atomically: the op parks as
+        unknown like any other mid-chaos failure, and the torn-store
+        claim is settled by the heal's exactly-once reads plus the
+        final offline fsck. Draws come from full_rng only."""
+        if not self.disk_full:
+            return
+        victims = sorted(self.c.osd_ids())
+        victim = victims[self.full_rng.randrange(len(victims))]
+        phases = self._ENOSPC_PHASES[self.store]
+        phase = phases[self.full_rng.randrange(len(phases))]
+        armed = {"n": 1}
+
+        def fault(point, _phase=phase, _armed=armed):
+            if point == _phase and _armed["n"] > 0:
+                _armed["n"] -= 1
+                import errno
+                raise OSError(errno.ENOSPC,
+                              f"injected ENOSPC at {point}")
+
+        self.c.osds[victim].store.set_fault(fault)
+        self._armed_faults.append((victim, phase, armed))
+        self.enospc_injected += 1
+        self._log(f"round {round_i}: armed one-shot ENOSPC on "
+                  f"osd.{victim} at {phase}")
+
+    def _clear_faults(self) -> None:
+        """Disarm every injected fault (heal entry: an unfired flush/
+        compact fault must not land mid-recovery-writeback AFTER the
+        window it belonged to) and tally what actually fired."""
+        if not self._armed_faults:
+            return
+        for _victim, _phase, armed in self._armed_faults:
+            self.enospc_fired += 1 - armed["n"]
+        self._armed_faults.clear()
+        for d in self._live_daemons():
+            d.store.set_fault(None)
+
+    def _disk_full_window(self, round_i: int) -> None:
+        """One capacity-exhaustion window against a CLEAN cluster
+        (post-heal): shrink every store with data to just over the
+        failsafe ratio, wait for the mon ladder to commit FULL, and
+        assert the RADOS full contract under live injection:
+
+          * a background writer PARKS — zero op_errors surface;
+          * every acked object still READS bit-exact mid-FULL;
+          * after capacity restore the flag clears and every parked
+            write drains EXACTLY-ONCE (bytes verified by read-back
+            here and again by the next heal's sweep).
+
+        Draw values come from full_rng; deadlines are load-scaled
+        wall clock that never feeds back into any RNG stream."""
+        if not self.disk_full:
+            return
+        import threading
+        names = self._fresh_names(self.full_rng.randrange(3, 6))
+        objs = {n: self.full_rng.randbytes(
+                    self.full_rng.randrange(100, 600))
+                for n in names}
+        shrunk: list[int] = []
+        empty: list[int] = []
+        cl2 = self.c.client()
+        acked: dict[str, bytes] = {}
+        errors: list[str] = []
+
+        def _writer():
+            for n_, data in objs.items():
+                try:
+                    cl2.write({n_: data})
+                except Exception as e:   # noqa: BLE001 — ANY error
+                    errors.append(       # here violates the contract
+                        f"{n_}: {type(e).__name__}: {e}")
+                    return
+                acked[n_] = data
+
+        t = threading.Thread(target=_writer, daemon=True)
+        try:
+            for o in sorted(self.c.osd_ids()):
+                st = self.c.osds[o].store.statfs()
+                used = int(st.get("used", 0))
+                if used <= 0:
+                    empty.append(o)   # no ratio to push over: leave
+                    continue          # unbounded (can't ENOSPC either)
+                # used/total ~ 0.98: over failsafe (0.97) AND over
+                # mon_osd_full_ratio (0.95) in one move
+                self.c.osds[o].store.set_capacity(
+                    max(1, int(used / 0.98)))
+                shrunk.append(o)
+            if not shrunk:
+                self._log(f"round {round_i}: disk_full window skipped "
+                          f"(no store holds data yet)")
+                return
+            self._log(f"round {round_i}: disk_full window — shrank "
+                      f"{len(shrunk)} store(s) over failsafe"
+                      + (f" ({len(empty)} empty left unbounded)"
+                         if empty else ""))
+            if not empty:
+                # every primary is gated: the first write bounces at
+                # the OSD failsafe (statfs-only, pre-map) and the
+                # client parks on the pinned epoch — start now so the
+                # hard-stop path gets chaos coverage too
+                t.start()
+            if not self._poll_df(True, 30.0 * self.load):
+                self._violate(
+                    f"round {round_i}: mon ladder never committed "
+                    f"cluster FULL ({len(shrunk)} stores over the "
+                    f"full ratio)")
+            if not t.is_alive():
+                # an empty-store primary could have raced writes
+                # through pre-flip: start (or observe) post-flip
+                if empty and not acked and not errors:
+                    t.start()
+            self.full_windows += 1
+            # reads must keep serving while writes are parked
+            for name in sorted(set(self.shadow) - self.unknown):
+                try:
+                    got = self.cl.read(name)
+                except Exception as e:   # noqa: BLE001
+                    self._violate(
+                        f"round {round_i}: read of acked {name!r} "
+                        f"failed under cluster FULL "
+                        f"({type(e).__name__}: {e}) — reads must not "
+                        f"park behind the full ladder")
+                if got != self.shadow[name]:
+                    self._violate(
+                        f"round {round_i}: read of {name!r} under "
+                        f"cluster FULL diverged from last acked bytes")
+                self.full_reads_served += 1
+            # the writer must be PARKED, not errored: backoff counter
+            # growing and no op_errors surfaced
+            deadline = time.monotonic() + 30.0 * self.load
+            parked = False
+            while time.monotonic() < deadline:
+                if errors:
+                    break
+                fb = cl2.perf.dump().get("full_backoff_time") or {}
+                if int(fb.get("avgcount", 0)) > 0:
+                    parked = True
+                    break
+                time.sleep(0.2)
+            if errors:
+                self._violate(
+                    f"round {round_i}: op_error surfaced to a writer "
+                    f"under cluster FULL (must park, never error): "
+                    f"{errors[0]}")
+            if not parked:
+                self._violate(
+                    f"round {round_i}: writer neither parked nor "
+                    f"errored under cluster FULL within "
+                    f"{30.0 * self.load:.0f}s")
+        finally:
+            for o in shrunk:
+                self.c.osds[o].store.set_capacity(
+                    self.c.store_capacity)
+        if not self._poll_df(False, 30.0 * self.load):
+            self._violate(f"round {round_i}: cluster FULL flag never "
+                          f"cleared after capacity restore")
+        t.join(60.0 * self.load)
+        if t.is_alive():
+            self._violate(
+                f"round {round_i}: parked writes failed to drain "
+                f"within {60.0 * self.load:.0f}s of the FULL flag "
+                f"clearing")
+        if errors:
+            self._violate(
+                f"round {round_i}: op_error surfaced draining parked "
+                f"writes: {errors[0]}")
+        if len(acked) != len(objs):
+            self._violate(
+                f"round {round_i}: only {len(acked)}/{len(objs)} "
+                f"parked writes drained after restore")
+        # exactly-once: every drained write reads back bit-exact NOW
+        # (and again at the next heal via the shadow oracle)
+        for n_, data in sorted(acked.items()):
+            try:
+                got = self.cl.read(n_)
+            except Exception as e:   # noqa: BLE001
+                self._violate(f"round {round_i}: drained write "
+                              f"{n_!r} unreadable ({e})")
+            if got != data:
+                self._violate(f"round {round_i}: drained write "
+                              f"{n_!r} bytes diverged")
+        self.shadow.update(acked)
+        self.removed -= set(acked)
+        self.full_parked_drained += len(acked)
+        self._log(f"round {round_i}: disk_full window ok — "
+                  f"{len(acked)} parked writes drained exactly-once, "
+                  f"{self.full_reads_served} reads served under FULL")
+
+    def _poll_df(self, want_full: bool, deadline_s: float) -> dict:
+        """Poll the mon `df` command until its committed-map FULL flag
+        matches; {} on deadline (the caller decides the violation)."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                df = self.cl.mon_command("df",
+                                         timeout=10.0 * self.load)
+            except Exception:   # noqa: BLE001 — mon hunt mid-chaos
+                df = None
+            if isinstance(df, dict) \
+                    and bool(df.get("cluster_full")) == want_full:
+                return df
+            time.sleep(0.2)
+        return {}
+
     # -- the schedule --------------------------------------------------------
 
     def _menu(self):
@@ -642,6 +888,7 @@ class Thrasher:
             for round_i in range(self.rounds):
                 self.act_write()     # every round has data on the line
                 self._transient_sweep(round_i)
+                self._enospc_sweep(round_i)
                 for _ in range(self.ops):
                     menu[self.rng.randrange(len(menu))]()
                     time.sleep(0.15)
@@ -653,6 +900,10 @@ class Thrasher:
                 if self.read_during_faults:
                     self._read_sweep_during_faults(round_i)
                 self._heal_and_check(round_i)
+                # r21: the capacity-exhaustion window runs against the
+                # healed (clean) cluster so the only thing parking the
+                # writer is the full ladder itself
+                self._disk_full_window(round_i)
             report = self._final_report(time.monotonic() - t0)
         finally:
             self.teardown()
@@ -795,6 +1046,10 @@ class Thrasher:
                   f"[{p.name}] {self.workload_ops} ops total")
 
     def _heal_and_check(self, round_i: int) -> None:
+        # r21: disarm any unfired ENOSPC faults first — heal-time
+        # recovery writeback must not trip a fault that belonged to
+        # the closed window
+        self._clear_faults()
         # transient victims first: the heal waits their windows out so
         # outside-window draws exercise the expire->rebuild path
         self._tick_transients(final=True)
@@ -864,6 +1119,15 @@ class Thrasher:
             "transient_revives_inside": self.transient_revives_inside,
             "transient_noop_checks": self.transient_noop_checks,
             "transient_noop_skips": self.transient_noop_skips,
+            "full_windows": self.full_windows,
+            "full_reads_served": self.full_reads_served,
+            "full_parked_drained": self.full_parked_drained,
+            "enospc_injected": self.enospc_injected,
+            "enospc_fired": self.enospc_fired,
+            "writes_rejected_full":
+                sum(d.perf.get("writes_rejected_full")
+                    for d in self._live_daemons())
+                if self.c is not None and not self.osd_procs else 0,
             "repair_deferred_stripes":
                 self._policy_counter("repair_deferred_stripes")
                 if self.c is not None and not self.osd_procs else 0,

@@ -713,10 +713,31 @@ class TinDB(KeyValueDB):
         with self._lock:
             self._alive()
             ops = self._expand(txn)
-            self._seq += 1
             body = _encode_batch(ops)
-            append_wal_record(self._wal_f, self._seq, body,
-                              self.o_dsync)
+            self._hook("wal.append")
+            # the append must be ATOMIC against ENOSPC (r21): seq only
+            # advances once the record is durably on disk, and a
+            # partial append (f.write stops mid-record when the device
+            # fills) is truncated back to the sealed prefix —
+            # shrinking a file needs no space. Without the rollback a
+            # failed append left _seq advanced past the last durable
+            # record (fatal seq-jump on replay) and without the
+            # truncate a LATER successful append would bury garbage
+            # mid-log (fatal "bad magic", not the recoverable torn
+            # tail).
+            start = self._wal_f.tell()
+            try:
+                append_wal_record(self._wal_f, self._seq + 1, body,
+                                  self.o_dsync)
+            except OSError:
+                try:
+                    self._wal_f.truncate(start)
+                    self._wal_f.seek(start)
+                except OSError:
+                    pass    # crash-before-truncate = torn tail, which
+                    #         scan_wal already recovers
+                raise
+            self._seq += 1
             for op in ops:
                 self._mem_apply(op)
             self.stats["submitted"] += 1
@@ -724,7 +745,14 @@ class TinDB(KeyValueDB):
                 (("wal_records", 1),
                  ("wal_bytes", _REC_HDR.size + len(body) + 4)))
             if self._mem_bytes >= self.memtable_max_bytes:
-                self.flush()
+                try:
+                    self.flush()
+                except OSError:
+                    # ENOSPC flushing a full memtable: the txn above
+                    # already committed to the WAL — swallow, keep
+                    # accepting (bounded by the WAL) and retry the
+                    # flush on a later submit
+                    pass
         self.perf.tinc("submit_time", _time.perf_counter() - t0)
 
     # -- flush + compaction --------------------------------------------------
@@ -750,9 +778,21 @@ class TinDB(KeyValueDB):
                 seg_id = self._next_seg
                 self._next_seg += 1
                 path = self._seg_path(seg_id)
-                write_segment(path, ((k, self._mem[k])
-                                     for k in sorted(self._mem)))
-                self._hook("flush.segment-written")
+                try:
+                    write_segment(path, ((k, self._mem[k])
+                                         for k in sorted(self._mem)))
+                    self._hook("flush.segment-written")
+                except OSError:
+                    # ENOSPC mid-segment (r21): unlink the partial
+                    # run and abort — memtable, WAL and manifest are
+                    # untouched, so the flush simply retries later
+                    # (the seg-id gap is harmless; mount reclaims any
+                    # leftover as an orphan)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise
                 if not self._levels:
                     self._levels.append([])
                 self._levels[0].append(Segment(path))
@@ -778,7 +818,13 @@ class TinDB(KeyValueDB):
             while any(len(lvl) >= self.fanout for lvl in self._levels):
                 for i, lvl in enumerate(self._levels):
                     if len(lvl) >= self.fanout:
-                        self.compact_level(i)
+                        try:
+                            self.compact_level(i)
+                        except OSError:
+                            # ENOSPC: compaction is advisory — the
+                            # flush that triggered us already
+                            # committed; retry on a later flush
+                            return
                         break
 
     def compact_level(self, i: int) -> None:
@@ -801,9 +847,19 @@ class TinDB(KeyValueDB):
             seg_id = self._next_seg
             self._next_seg += 1
             path = self._seg_path(seg_id)
-            write_segment(path, _merge_layers(
-                layers, keep_tombstones=not deepest))
-            self._hook("compact.segments-written")
+            try:
+                write_segment(path, _merge_layers(
+                    layers, keep_tombstones=not deepest))
+                self._hook("compact.segments-written")
+            except OSError:
+                # ENOSPC mid-merge (r21): unlink the partial output
+                # and abort — levels and manifest untouched, every
+                # victim still live; the merge retries later
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                raise
             merged = Segment(path)
             if i + 1 >= len(self._levels):
                 self._levels.append([])

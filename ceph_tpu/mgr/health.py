@@ -56,6 +56,38 @@ def health_checks(osdmap=None, quorum: list[int] | None = None,
                 "OSD_OUT", HEALTH_WARN,
                 f"{len(out)} osds out",
                 [f"osd.{o} is out (weight 0)" for o in out]))
+        # r21 capacity ladder (ref: OSDMap::check_health OSD_NEARFULL/
+        # OSD_BACKFILLFULL/OSD_FULL + PG_POOL_FULL): rendered straight
+        # from the COMMITTED map's ladder state — health says exactly
+        # what the mon decided, never a re-derivation from raw statfs
+        full_state = getattr(osdmap, "osd_full_state", {}) or {}
+        for state, code, sev, why in (
+                (3, "OSD_FULL", HEALTH_ERR,
+                 "at/over mon_osd_full_ratio — client writes parked"),
+                (2, "OSD_BACKFILLFULL", HEALTH_WARN,
+                 "at/over osd_backfillfull_ratio — recovery into it "
+                 "parks"),
+                (1, "OSD_NEARFULL", HEALTH_WARN,
+                 "at/over mon_osd_nearfull_ratio")):
+            osds = sorted(o for o, s in full_state.items()
+                          if s == state)
+            if osds:
+                checks.append(_check(
+                    code, sev,
+                    f"{len(osds)} osd(s) {code[4:].lower()}",
+                    [f"osd.{o} is {why}" for o in osds]))
+        full_pools = getattr(osdmap, "full_pools", None) or set()
+        if full_pools:
+            checks.append(_check(
+                "POOL_FULL", HEALTH_ERR,
+                f"{len(full_pools)} pool(s) full",
+                [f"pool {p} hit its quota "
+                 f"(quota_max_bytes="
+                 f"{osdmap.pools[p].quota_max_bytes}, "
+                 f"quota_max_objects="
+                 f"{osdmap.pools[p].quota_max_objects}) — client "
+                 f"writes parked"
+                 for p in sorted(full_pools) if p in osdmap.pools]))
 
     if quorum is not None and mon_members is not None:
         missing = sorted(set(mon_members) - set(quorum))

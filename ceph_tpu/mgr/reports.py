@@ -74,7 +74,8 @@ class MgrReportAggregator:
             ent["seq"] = seq
             ent["stamp"] = now
             for key in ("ops_in_flight", "slow_ops", "pgs", "epoch",
-                        "pool_bytes", "mclock"):
+                        "pool_bytes", "pool_objects", "mclock",
+                        "statfs"):
                 if key in report:
                     ent[key] = report[key]
 
@@ -115,6 +116,31 @@ class MgrReportAggregator:
                 pid = int(pid)
                 out[pid] = out.get(pid, 0) + int(b)
         return out
+
+    def pool_objects(self) -> dict[int, int]:
+        """Object count per pool summed over every reporting primary's
+        claim — what quota_max_objects is enforced against (role of
+        pg_stat_t num_objects aggregation in the mgr)."""
+        out: dict[int, int] = {}
+        with self._lock:
+            claims = [e.get("pool_objects") or {}
+                      for e in self._daemons.values()]
+        for claim in claims:
+            for pid, n in claim.items():
+                pid = int(pid)
+                out[pid] = out.get(pid, 0) + int(n)
+        return out
+
+    def statfs(self) -> dict[str, dict]:
+        """Latest raw statfs claim per reporting OSD ("osd.N" ->
+        {"total","used","avail"}) — the r21 capacity ladder's only
+        input (the mon never guesses at space it wasn't told about).
+        Daemons with no claim (mons, unbounded stores reporting
+        total=0) simply appear without a usable ratio."""
+        with self._lock:
+            return {n: dict(e["statfs"])
+                    for n, e in self._daemons.items()
+                    if e.get("statfs")}
 
     def tenants(self) -> dict:
         """Per-tenant mClock accounting summed over every daemon's

@@ -63,7 +63,26 @@ FEED_ALIASES = {
     "client_op": ("osd", "op_latency_hist"),
     "subop": ("osd", "subop_latency_hist"),
     "client_observed": ("client", "op_lat_hist"),
+    # r21: wall time mutating ops sat parked behind FULL flags — a
+    # COUNT/DURATION feed, not a latency feed: parked time never
+    # enters the write-latency feeds (parked ops are not dispatched),
+    # and the write-feed verdicts disclose backoff activity instead
+    # of letting a capacity stall read as a latency regression
+    "full_backoff": ("client", "full_backoff_time_hist"),
 }
+
+#: feeds whose verdicts carry the r21 full-backoff disclosure (write
+#: paths a FULL flag parks; read feeds keep serving and stay quiet)
+_WRITE_FEEDS = frozenset({"client_write", "client_op",
+                          "client_observed"})
+
+
+def _is_write_rule(rule: "SLORule") -> bool:
+    """Does this rule watch a feed a FULL flag parks? Matched on the
+    resolved (logger, key) so both the alias spelling and an explicit
+    `osd.op_w_latency_hist` rule get the disclosure."""
+    return any((rule.logger, rule.key) == FEED_ALIASES[f]
+               for f in _WRITE_FEEDS)
 
 _UNIT_S = {"us": 1e-6, "ms": 1e-3, "s": 1.0}
 _WIN_S = {"s": 1.0, "m": 60.0, "h": 3600.0}
@@ -190,6 +209,10 @@ class TelemetryAggregator:
         #: rules evaluate over
         self._tenant_last: dict[str, dict] = {}
         self._tenant_points: dict[str, list] = {}
+        #: r21 full-backoff tracking: client -> (last cumulative
+        #: backoff count, wall stamp of the last observed GROWTH) —
+        #: the write-feed verdicts' disclosure source
+        self._backoff: dict[str, tuple[int, float]] = {}
 
     # -- ingest ---------------------------------------------------------------
 
@@ -236,6 +259,20 @@ class TelemetryAggregator:
             return
         with self._lock:
             self._clients[name] = client_perf
+            # r21: note full-backoff growth (cumulative time_avg
+            # avgcount) — stamps the last interval a client was
+            # observed parked, read by the write-feed verdicts
+            fb = (client_perf.get("client") or client_perf
+                  ).get("full_backoff_time")
+            if isinstance(fb, dict):
+                try:
+                    cur = int(fb.get("avgcount", 0))
+                except (TypeError, ValueError):
+                    cur = 0
+                last, stamp = self._backoff.get(name, (0, 0.0))
+                if cur > last:
+                    stamp = self._now()
+                self._backoff[name] = (cur, stamp)
             if tenant is None:
                 return
             hist = (client_perf.get("client") or client_perf
@@ -249,6 +286,30 @@ class TelemetryAggregator:
             ring = self._tenant_points.setdefault(tenant, [])
             ring.append((self._now(), delta))
             del ring[:-self._max]
+
+    def full_backoff_active(self, window_s: float) -> bool:
+        """r21: was ANY client observed growing its full-backoff
+        counter within the trailing window? The disclosure gate the
+        write-feed SLO verdicts and the regression probe consult."""
+        cutoff = self._now() - window_s
+        with self._lock:
+            return any(stamp >= cutoff and cnt > 0
+                       for cnt, stamp in self._backoff.values())
+
+    def full_backoff(self) -> dict:
+        """Per-client cumulative full-backoff accounting (count +
+        total seconds parked) from the newest client snapshots —
+        `ceph_cli slo`'s capacity-stall disclosure block."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            for name, perf in self._clients.items():
+                fb = (perf.get("client") or perf
+                      ).get("full_backoff_time")
+                if isinstance(fb, dict) and fb.get("avgcount"):
+                    out[name] = {
+                        "count": int(fb.get("avgcount", 0)),
+                        "total_s": round(float(fb.get("sum", 0.0)), 3)}
+        return out
 
     def note_flight(self, name: str, stats: dict) -> None:
         """Track a daemon's flight-ring `dropped_unshipped` across
@@ -430,7 +491,7 @@ class TelemetryAggregator:
             burn_slow = (sum(violated) / len(violated)) \
                 if violated else 0.0
             breach = len(fast) >= FAST_INTERVALS and all(fast)
-            out.append({
+            verdict = {
                 **rule.to_dict(),
                 "intervals": len(points),
                 "samples": sum(n for _b, _q, n in points),
@@ -439,7 +500,14 @@ class TelemetryAggregator:
                 "burn_fast": round(burn_fast, 3),
                 "burn_slow": round(burn_slow, 3),
                 "breach": breach,
-            })
+            }
+            # r21 disclosure: a write-feed verdict evaluated while
+            # clients sat in full-backoff says so — the operator reads
+            # "capacity stall", not "the write path got slow"
+            if _is_write_rule(rule) \
+                    and self.full_backoff_active(rule.window_s):
+                verdict["full_backoff_active"] = True
+            out.append(verdict)
         return out
 
     def burn_rate(self) -> float:
@@ -476,6 +544,13 @@ class TelemetryAggregator:
                         points.append((lhist_quantile(h, 0.99),
                                        int(h["count"])))
             if len(points) < 4 or points[-1][1] < 16:
+                continue
+            if _is_write_rule(rule) and self.full_backoff_active(
+                    max(60.0, rule.window_s)):
+                # r21: a capacity stall is not a latency regression —
+                # the parked interval is disclosed on the SLO verdict
+                # (full_backoff_active) and in `slo`'s full_backoff
+                # block instead of tripping LATENCY_REGRESSION
                 continue
             baseline = sorted(q for q, _n in points[:-1])
             median = baseline[len(baseline) // 2]

@@ -122,9 +122,17 @@ class MemStore:
     #: no on-disk footprint (the lifecycle contract shared w/ TinStore)
     path: str | None = None
 
-    def __init__(self):
+    def __init__(self, capacity_bytes: int = 0):
         self.collections: dict[str, dict[str, _Object]] = {}
         self.committed_txns = 0
+        #: capacity ceiling in bytes; 0 = unbounded (no statfs ratio,
+        #: no ENOSPC). Live-shrinkable via set_capacity — the r21
+        #: disk_full injection path.
+        self.capacity_bytes = int(capacity_bytes)
+        #: deterministic ENOSPC injection hook: fn(point) called at
+        #: "txn.apply" before any mutation; raising OSError there
+        #: aborts the whole batch (nothing applied — trivially atomic)
+        self._fault = None
 
     # -- lifecycle (shared store contract; see tinstore.TinStore) -----------
     # RAM-only semantics: "process death keeps bytes by fiat", so
@@ -140,10 +148,71 @@ class MemStore:
     def remount(self) -> None:
         pass
 
+    # -- capacity (r21 capacity plane; contract shared w/ TinStore) ---------
+
+    def set_capacity(self, nbytes: int) -> None:
+        """Live capacity change (shrinkable below current usage — the
+        ratio then reads > 1.0 and every new mutation ENOSPCs, which
+        is exactly what the disk_full fault stream wants)."""
+        self.capacity_bytes = int(nbytes)
+
+    def set_fault(self, fn) -> None:
+        self._fault = fn
+
+    def used_bytes(self) -> int:
+        total = 0
+        for coll in self.collections.values():
+            for o in coll.values():
+                total += len(o.data)
+                total += sum(len(v) for v in o.xattrs.values())
+                total += sum(len(k) + len(v)
+                             for k, v in o.omap.items())
+        return total
+
+    def statfs(self) -> dict:
+        """Bytes total/used/avail (the ObjectStore::statfs contract).
+        total == 0 means unbounded: the mon ladder never computes a
+        ratio for such a store."""
+        used = self.used_bytes()
+        total = int(self.capacity_bytes)
+        return {"total": total, "used": used,
+                "avail": max(0, total - used) if total else 0}
+
+    def _txn_grow_bytes(self, txn: Transaction) -> int:
+        """Conservative upper bound of bytes this batch can ADD —
+        growth is what ENOSPC gates; frees inside the same batch are
+        deliberately not credited (a real allocator can't reuse them
+        until commit either)."""
+        grow = 0
+        for op in txn.ops:
+            kind = op[0]
+            if kind in ("write", "xor"):
+                grow += len(op[4])
+            elif kind == "truncate":
+                grow += op[3]
+            elif kind == "setattr":
+                grow += len(op[4])
+            elif kind == "omap_set":
+                grow += sum(len(k) + len(v) for k, v in op[3].items())
+        return grow
+
     # -- transaction apply --------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
         self._validate(txn)
+        if self._fault is not None:
+            # injection point BEFORE any mutation: an injected ENOSPC
+            # aborts with nothing applied (atomic by construction)
+            self._fault("txn.apply")
+        cap = self.capacity_bytes
+        grow = self._txn_grow_bytes(txn) if cap else 0
+        # zero-growth batches (deletes, truncate-down, omap rm) pass
+        # even when usage already exceeds a shrunk capacity: freeing
+        # space is how a full store recovers
+        if cap and grow and self.used_bytes() + grow > cap:
+            import errno
+            raise OSError(errno.ENOSPC,
+                          f"memstore over capacity ({cap} bytes)")
         for op in txn.ops:
             self._apply(op)
         self.committed_txns += 1

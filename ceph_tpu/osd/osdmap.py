@@ -79,6 +79,18 @@ def str_hash_rjenkins(s: bytes | str) -> int:
     return c
 
 
+#: per-OSD fullness ladder states carried on the map (r21 capacity
+#: plane; ref: osd_state NEARFULL/BACKFILLFULL/FULL in osd_types.h).
+#: Absent from osd_full_state == 0 == plenty of room.
+FULL_NONE = 0
+FULL_NEARFULL = 1
+FULL_BACKFILLFULL = 2
+FULL_FULL = 3
+FULL_STATE_NAMES = {FULL_NEARFULL: "nearfull",
+                    FULL_BACKFILLFULL: "backfillfull",
+                    FULL_FULL: "full"}
+
+
 @dataclass
 class PGPool:
     """pg_pool_t equivalent: placement parameters of one pool."""
@@ -94,6 +106,11 @@ class PGPool:
     # distributed to OSDs/clients inside the map): sid -> snap name
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)
+    # pool quotas (ref: pg_pool_t::quota_max_bytes/quota_max_objects):
+    # the leader compares MgrReport pool aggregates against these and
+    # flips the pool's FULL flag on the map; 0 = unlimited
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
 
     def __post_init__(self):
         if self.pgp_num is None:
@@ -112,9 +129,9 @@ class PGPool:
 
 
 def _encode_pool(en, p: "PGPool") -> None:
-    # v2 appends snap_seq + snaps; compat 1 (old readers skip the
-    # tail via the section length)
-    en.start(2, 1)
+    # v2 appends snap_seq + snaps, v3 quotas; compat 1 (old readers
+    # skip the tail via the section length)
+    en.start(3, 1)
     en.i32(p.pool_id).u32(p.pg_num).u32(p.size).u32(p.min_size)
     en.i32(p.crush_rule).boolean(p.is_erasure).u32(p.pgp_num)
     en.mapping(p.ec_profile, lambda e2, k: e2.string(k),
@@ -122,11 +139,13 @@ def _encode_pool(en, p: "PGPool") -> None:
     en.u64(p.snap_seq)
     en.mapping(p.snaps, lambda e2, k: e2.u64(k),
                lambda e2, v: e2.string(v))
+    en.u64(p.quota_max_bytes)
+    en.u64(p.quota_max_objects)
     en.finish()
 
 
 def _decode_pool(dd) -> "PGPool":
-    pv = dd.start(2)
+    pv = dd.start(3)
     p = PGPool(dd.i32(), dd.u32(), dd.u32(), dd.u32(), dd.i32(),
                dd.boolean(), dd.u32(),
                dd.mapping(lambda e2: e2.string(),
@@ -135,6 +154,9 @@ def _decode_pool(dd) -> "PGPool":
         p.snap_seq = dd.u64()
         p.snaps = dd.mapping(lambda e2: e2.u64(),
                              lambda e2: e2.string())
+    if pv >= 3:
+        p.quota_max_bytes = dd.u64()
+        p.quota_max_objects = dd.u64()
     dd.finish()
     return p
 
@@ -183,6 +205,14 @@ class OSDMap:
         # which a boot reverses (ref: osd_state AUTOOUT vs admin
         # weight changes in OSDMonitor)
         self.osd_admin_out: set[int] = set()
+        # r21 capacity plane: per-OSD fullness ladder state (osd ->
+        # FULL_NEARFULL/BACKFILLFULL/FULL; absent = fine), the
+        # cluster-wide FULL flag (any device at mon_osd_full_ratio —
+        # clients park writes), and per-pool FULL flags from quota
+        # enforcement (ref: OSDMAP_FULL + pg_pool_t FLAG_FULL)
+        self.osd_full_state: dict[int, int] = {}
+        self.cluster_full: bool = False
+        self.full_pools: set[int] = set()
         self._vm = VectorMapper(crush)
         self._om = OracleMapper(crush)
 
@@ -193,10 +223,11 @@ class OSDMap:
         pools, temp overrides (ref: src/osd/OSDMap.cc encode)."""
         from ..utils.encoding import Encoder
         # v2 appends pg_upmap_items, v3 config_kv, v4 mon_members,
-        # v5 osd_admin_out, v6 osd_up_thru; compat stays 1 (an old
-        # reader skips the tail via the section length — the
+        # v5 osd_admin_out, v6 osd_up_thru, v7 the capacity plane
+        # (osd_full_state + cluster_full + full_pools); compat stays 1
+        # (an old reader skips the tail via the section length — the
         # ENCODE_START contract)
-        e = Encoder().start(6, 1)
+        e = Encoder().start(7, 1)
         e.u32(self.epoch)
         e.blob(self.crush.encode())
         e.list([int(w) for w in self.osd_weight],
@@ -220,13 +251,18 @@ class OSDMap:
         e.list(sorted(self.osd_admin_out), lambda e2, o: e2.i32(o))
         e.list([int(t) for t in self.osd_up_thru],
                lambda e2, t: e2.u64(t))
+        e.mapping({int(o): int(s)
+                   for o, s in sorted(self.osd_full_state.items())},
+                  lambda e2, o: e2.i32(o), lambda e2, s: e2.u32(s))
+        e.boolean(self.cluster_full)
+        e.list(sorted(self.full_pools), lambda e2, p: e2.i32(p))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "OSDMap":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        v = d.start(6)
+        v = d.start(7)
         epoch = d.u32()
         crush = CrushMap.decode(d.blob())
         m = cls(crush, epoch=epoch)
@@ -254,6 +290,11 @@ class OSDMap:
         if v >= 6:
             m.osd_up_thru = np.asarray(d.list(lambda dd: dd.u64()),
                                        dtype=np.int64)
+        if v >= 7:
+            m.osd_full_state = d.mapping(lambda dd: dd.i32(),
+                                         lambda dd: dd.u32())
+            m.cluster_full = d.boolean()
+            m.full_pools = set(d.list(lambda dd: dd.i32()))
         d.finish()
         return m
 
@@ -443,6 +484,45 @@ class OSDMap:
             self.primary_temp[pg] = osd
         self._bump()
 
+    # -- capacity plane (r21) -----------------------------------------------
+
+    def full_state_of(self, osd: int) -> int:
+        """Ladder state of one OSD (FULL_NONE when unlisted)."""
+        return self.osd_full_state.get(int(osd), FULL_NONE)
+
+    def set_full_states(self, osd_states: dict[int, int],
+                        cluster_full: bool,
+                        full_pools) -> None:
+        """Commit the leader's evaluated ladder in ONE epoch (per-OSD
+        states + cluster flag + quota-tripped pools). Idempotent: the
+        closure rebases to a no-op when the committed map already
+        carries the same evaluation — the ladder re-runs every leader
+        tick and must not churn epochs."""
+        osd_states = {int(o): int(s) for o, s in osd_states.items()
+                      if int(s) != FULL_NONE}
+        cluster_full = bool(cluster_full)
+        full_pools = {int(p) for p in full_pools}
+        if (osd_states == self.osd_full_state
+                and cluster_full == self.cluster_full
+                and full_pools == self.full_pools):
+            return
+        self.osd_full_state = osd_states
+        self.cluster_full = cluster_full
+        self.full_pools = full_pools
+        self._bump()
+
+    def set_pool_quota(self, pool_id: int, max_bytes: int,
+                       max_objects: int) -> None:
+        """`ceph osd pool set-quota` — idempotent like config_set."""
+        p = self.pools[pool_id]
+        max_bytes, max_objects = int(max_bytes), int(max_objects)
+        if (p.quota_max_bytes, p.quota_max_objects) \
+                == (max_bytes, max_objects):
+            return
+        p.quota_max_bytes = max_bytes
+        p.quota_max_objects = max_objects
+        self._bump()
+
     # -- object -> PG -------------------------------------------------------
 
     def object_to_pg(self, pool_id: int, name: bytes | str) -> tuple[int, int]:
@@ -598,7 +678,8 @@ class OSDMap:
         c.pools = {
             pid: PGPool(p.pool_id, p.pg_num, p.size, p.min_size,
                         p.crush_rule, p.is_erasure, p.pgp_num,
-                        dict(p.ec_profile), p.snap_seq, dict(p.snaps))
+                        dict(p.ec_profile), p.snap_seq, dict(p.snaps),
+                        p.quota_max_bytes, p.quota_max_objects)
             for pid, p in self.pools.items()}
         c.osd_weight = self.osd_weight.copy()
         c.osd_up = self.osd_up.copy()
@@ -610,6 +691,9 @@ class OSDMap:
         c.config_kv = dict(self.config_kv)
         c.mon_members = list(self.mon_members)
         c.osd_admin_out = set(self.osd_admin_out)
+        c.osd_full_state = dict(self.osd_full_state)
+        c.cluster_full = self.cluster_full
+        c.full_pools = set(self.full_pools)
         c._vm = self._vm
         c._om = self._om
         return c
@@ -632,6 +716,10 @@ def same_state(a: "OSDMap", b: "OSDMap") -> bool:
         return False
     if a.config_kv != b.config_kv or a.mon_members != b.mon_members \
             or a.osd_admin_out != b.osd_admin_out:
+        return False
+    if a.osd_full_state != b.osd_full_state \
+            or a.cluster_full != b.cluster_full \
+            or a.full_pools != b.full_pools:
         return False
     return (a.crush is b.crush) or a.crush.encode() == b.crush.encode()
 
@@ -672,6 +760,12 @@ class Incremental:
         self.removed_config: list[str] = []
         self.new_mon_members: list[int] | None = None
         self.new_admin_out: list[int] | None = None
+        # r21 capacity plane: full-replacement deltas (the state is
+        # O(n_osds) at worst, and a partial merge could resurrect a
+        # cleared flag) — presence-boolean encoded like mon_members
+        self.new_full_state: dict[int, int] | None = None
+        self.new_cluster_full: bool | None = None
+        self.new_full_pools: list[int] | None = None
 
     # -- construction --------------------------------------------------------
 
@@ -716,6 +810,12 @@ class Incremental:
             inc.new_mon_members = list(new.mon_members)
         if old.osd_admin_out != new.osd_admin_out:
             inc.new_admin_out = sorted(new.osd_admin_out)
+        if old.osd_full_state != new.osd_full_state:
+            inc.new_full_state = dict(new.osd_full_state)
+        if old.cluster_full != new.cluster_full:
+            inc.new_cluster_full = new.cluster_full
+        if old.full_pools != new.full_pools:
+            inc.new_full_pools = sorted(new.full_pools)
         return inc
 
     # -- application ---------------------------------------------------------
@@ -766,6 +866,12 @@ class Incremental:
             m.mon_members = list(self.new_mon_members)
         if self.new_admin_out is not None:
             m.osd_admin_out = set(self.new_admin_out)
+        if self.new_full_state is not None:
+            m.osd_full_state = dict(self.new_full_state)
+        if self.new_cluster_full is not None:
+            m.cluster_full = self.new_cluster_full
+        if self.new_full_pools is not None:
+            m.full_pools = set(self.new_full_pools)
         m.epoch = self.epoch
         m.__dict__.pop("_placement_cache", None)
         return m
@@ -774,7 +880,7 @@ class Incremental:
 
     def encode(self) -> bytes:
         from ..utils.encoding import Encoder
-        e = Encoder().start(1, 1)
+        e = Encoder().start(2, 1)
         e.u32(self.epoch).u32(self.base_epoch)
         e.boolean(self.full_blob is not None)
         if self.full_blob is not None:
@@ -806,13 +912,24 @@ class Incremental:
         e.boolean(self.new_admin_out is not None)
         if self.new_admin_out is not None:
             e.list(self.new_admin_out, lambda en, o: en.i32(o))
+        e.boolean(self.new_full_state is not None)
+        if self.new_full_state is not None:
+            e.mapping({int(o): int(s)
+                       for o, s in sorted(self.new_full_state.items())},
+                      lambda e2, o: e2.i32(o), lambda e2, s: e2.u32(s))
+        e.boolean(self.new_cluster_full is not None)
+        if self.new_cluster_full is not None:
+            e.boolean(self.new_cluster_full)
+        e.boolean(self.new_full_pools is not None)
+        if self.new_full_pools is not None:
+            e.list(self.new_full_pools, lambda e2, p: e2.i32(p))
         return e.finish().bytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "Incremental":
         from ..utils.encoding import Decoder
         d = Decoder(data)
-        d.start(1)
+        v = d.start(2)
         inc = cls(d.u32(), d.u32())
         if d.boolean():
             inc.full_blob = d.blob()
@@ -841,5 +958,13 @@ class Incremental:
             inc.new_mon_members = d.list(lambda dd: dd.i32())
         if d.boolean():
             inc.new_admin_out = d.list(lambda dd: dd.i32())
+        if v >= 2:
+            if d.boolean():
+                inc.new_full_state = d.mapping(lambda dd: dd.i32(),
+                                               lambda dd: dd.u32())
+            if d.boolean():
+                inc.new_cluster_full = d.boolean()
+            if d.boolean():
+                inc.new_full_pools = d.list(lambda dd: dd.i32())
         d.finish()
         return inc

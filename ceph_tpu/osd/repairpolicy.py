@@ -160,6 +160,12 @@ POLICY_COUNTERS = (
     "repair_domain_throttles",       # grants deferred by a domain
     #                                  token bucket
     "repair_time_at_m1_ms",          # cumulative stripe-time at m-1
+    # r21 capacity plane
+    "repair_backfillfull_parked",    # rounds parked: a replacement
+    #                                  target sat at/over backfillfull
+    "repair_enospc_parked",          # rounds parked: writeback hit
+    #                                  ENOSPC mid-rebuild (cursors
+    #                                  intact, retried next reconcile)
 )
 
 

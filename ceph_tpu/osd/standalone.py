@@ -74,7 +74,8 @@ from ..utils.flight_recorder import current as _trace_current
 from ..utils.flight_recorder import declare_span_names
 from .ecbackend import ECBackend, ShardSet, shard_cid
 from .memstore import MemStore, Transaction
-from .osdmap import Incremental, OSDMap, PGPool
+from .osdmap import (FULL_BACKFILLFULL, FULL_FULL, FULL_NEARFULL,
+                     FULL_STATE_NAMES, Incremental, OSDMap, PGPool)
 from .pgbackend import ReplicatedBackend
 from .pglog import PGLog, divergent_names, share_history
 from .tinstore import _decode_txn, _encode_txn, _encode_txn_iov
@@ -454,6 +455,32 @@ class MPoolOp(Message):
     def decode_payload(cls, d: Decoder) -> "MPoolOp":
         d.start(1)
         m = cls(d.string(), d.string())
+        d.finish()
+        return m
+
+
+@register_message
+class MPoolQuotaOp(Message):
+    """`ceph osd pool set-quota` over the wire (r21, ref: OSDMonitor
+    prepare_command POOL_SET quota_max_bytes/objects): quotas ride
+    the committed map like every pool attribute, so the capacity
+    ladder's quota evaluation reads from Paxos state, never from a
+    side channel. Broadcast to every monitor; value-idempotent."""
+
+    type_id = 0x4E
+
+    def __init__(self, pool_id: int, max_bytes: int, max_objects: int):
+        self.pool_id = pool_id
+        self.max_bytes, self.max_objects = max_bytes, max_objects
+
+    def encode_payload(self, e: Encoder) -> None:
+        (e.start(1, 1).u32(self.pool_id).u64(self.max_bytes)
+         .u64(self.max_objects).finish())
+
+    @classmethod
+    def decode_payload(cls, d: Decoder) -> "MPoolQuotaOp":
+        d.start(1)
+        m = cls(d.u32(), d.u64(), d.u64())
         d.finish()
         return m
 
@@ -1247,6 +1274,13 @@ class _RecoveryRound:
             # reconcile re-plans the leftover names against the fresh
             # map (plan.remaining tracks exactly what didn't land)
             self.failed = True
+            import errno as _errno
+            if isinstance(e, OSError) and e.errno == _errno.ENOSPC:
+                # r21: writeback hit a full store — same park contract
+                # (cursors intact, the re-plan retries once space or a
+                # better target shows up), but counted separately so
+                # the capacity plane can see recovery being starved
+                d.repair_policy._count("repair_enospc_parked")
             d.c.log(f"{d.name}: recovery round deferred: {e}")
             return
         sleep = float(d.config["osd_recovery_sleep"])
@@ -1513,6 +1547,10 @@ class OSDDaemon:
         self._pg_locks: dict[int, threading.RLock] = {}
         self._pg_locks_guard = threading.Lock()
         self._recovering: dict[int, "_RecoveryRound"] = {}
+        # r21: PGs whose rebuild is parked because a replacement
+        # target sits at/over backfillfull (one counter tick per
+        # park transition, not per reconcile beat)
+        self._bff_parked: set[int] = set()
         # r17 repair policy plane: per-peer DownClocks + parked
         # rebuilds + exposure accounting, and the per-failure-domain
         # repair token buckets. Built per boot (in-RAM policy state
@@ -2859,6 +2897,35 @@ class OSDDaemon:
                             f"delay="
                             f"{self.config['osd_repair_delay']}s)")
                     return
+            # r21 capacity gate: a rebuild writes a full shard into
+            # every replacement target — parking while a target sits
+            # at/over backfillfull is what keeps recovery from driving
+            # a nearly-full OSD through the FULL cliff. Re-evaluated
+            # every reconcile (flag clears / CRUSH repoints resolve it
+            # within a beat); an m-1 stripe overrides — losing the
+            # stripe is strictly worse than the space risk.
+            if lost:
+                blocked = sorted(
+                    acting[s] for s in lost
+                    if _valid_osd(acting[s], n_osds)
+                    and self.osdmap.full_state_of(acting[s])
+                    >= FULL_BACKFILLFULL)
+                if blocked:
+                    urgent = (be.n - be.min_live) - len(lost) <= 1
+                    if not urgent:
+                        if ps not in self._bff_parked:
+                            self._bff_parked.add(ps)
+                            self.repair_policy._count(
+                                "repair_backfillfull_parked")
+                            self.c.log(
+                                f"{self.name}: pg 1.{ps} rebuild "
+                                f"parked (targets {blocked} "
+                                f"backfillfull)")
+                        return
+                    self.c.log(f"{self.name}: pg 1.{ps} rebuild into "
+                               f"backfillfull {blocked} (m-1 urgent "
+                               f"override)")
+                self._bff_parked.discard(ps)
             # an acting change subsumes any queued revive re-check
             # (the move/loss handling below re-derives freshness)
             self.repair_policy.take_recheck(ps)
@@ -3096,7 +3163,12 @@ class OSDDaemon:
          .add_u64_counter("retro_subop_published",
                           "retro.subop spans published from the "
                           "sub-op retro ring on a peer's slow-op "
-                          "fan-out"))
+                          "fan-out")
+         .add_u64_counter("writes_rejected_full",
+                          "mutating client ops bounced for capacity "
+                          "(failsafe hard-stop or map FULL flag) — "
+                          "each bounce parks the client, it never "
+                          "surfaces as an op_error"))
         # r17 repair-policy counters: declared from the policy
         # module's ONE list so the daemon schema and the policy's own
         # counter dict cannot drift (the r9 declared-names rule)
@@ -3232,6 +3304,36 @@ class OSDDaemon:
         total = sum(sum(be.object_sizes.values())
                     for be in self.backends.values())
         return {"1": int(total)} if self.backends else {}
+
+    def _pool_objects(self) -> dict:
+        """Object count per pool across primaried PGs (the
+        pg_stat_t num_objects slice quota_max_objects is enforced
+        against at the mon). Caller holds self._lock."""
+        total = sum(len(be.object_sizes)
+                    for be in self.backends.values())
+        return {"1": int(total)} if self.backends else {}
+
+    def _failsafe_gate(self, ps: int) -> None:
+        """r21 osd_failsafe_full_ratio hard-stop (ref: OSDService::
+        check_failsafe_full): statfs ratio at/over the failsafe bounces
+        every mutating client op with the retryable park pattern. Local
+        statfs only — deliberately map-independent, so it holds during
+        the stale-map window before the mon ladder commits FULL."""
+        try:
+            st = self.store.statfs()
+        except Exception:
+            return
+        total = int(st.get("total", 0))
+        if not total:
+            return                      # unbounded store: no ladder
+        ratio = float(self.config["osd_failsafe_full_ratio"])
+        if int(st.get("used", 0)) < ratio * total:
+            return
+        self.perf.inc("writes_rejected_full")
+        raise RuntimeError(
+            f"pg 1.{ps} osd.{self.osd_id} failsafe full "
+            f"({st['used']}/{total} >= {ratio:.2f}, "
+            f"epoch {self.osdmap.epoch})")
 
     def _admin_obj(self, cmd: str):
         """ONE dispatcher for both admin surfaces — the wire `admin`
@@ -3818,6 +3920,14 @@ class OSDDaemon:
             raise RuntimeError(
                 f"pg 1.{ps} peering (wait_up_thru {need_ut}, "
                 f"epoch {self.osdmap.epoch})")
+        if kind in ("write", "write_at", "append"):
+            # r21 failsafe hard-stop: the LOCAL store ratio, not the
+            # map — a full disk must never take another byte even
+            # when this daemon's map is stale. Deletes ("remove")
+            # pass: freeing space is how a full cluster recovers.
+            # The raise is the retryable park shape (like WaitUpThru):
+            # the client parks the op, nothing surfaces as op_error.
+            self._failsafe_gate(ps)
         if kind == "write":
             self._check_snapc(d.u64())
             objs = d.mapping(Decoder.string, Decoder.blob)
@@ -4330,6 +4440,13 @@ class OSDDaemon:
         report["profile"] = {
             "entries": self.profiler.drain_unshipped(),
             "stats": self.profiler.stats()}
+        # r21 capacity plane: raw statfs on EVERY report (the store
+        # has its own lock — no daemon-lock hazard). The mon ladder
+        # only ever acts on these claims, never on local guesses.
+        try:
+            report["statfs"] = self.store.statfs()
+        except Exception:
+            pass
         self._mgr_last_perf = perf
         # PG states want the daemon lock; never stall the heartbeat
         # for them — a busy beat ships without, and the aggregator
@@ -4338,6 +4455,7 @@ class OSDDaemon:
             try:
                 report["pgs"] = self._pg_states()
                 report["pool_bytes"] = self._pool_bytes()
+                report["pool_objects"] = self._pool_objects()
             finally:
                 self._lock.release()
         blob = _json.dumps(report, separators=(",", ":")).encode()
@@ -4506,6 +4624,12 @@ class MonDaemon:
                                       "MgrReports ingested")
                      .add_u64_counter("mon_cmds",
                                       "read-only commands answered")
+                     .add_u64_counter("full_flag_flips",
+                                      "capacity-ladder commits: any "
+                                      "per-OSD nearfull/backfillfull/"
+                                      "full state, the cluster FULL "
+                                      "flag, or a pool-quota flag "
+                                      "changed in the map")
                      .add_u64("osdmap_epoch", "committed map epoch")
                      .create_perf_counters())
         self.mgr = MgrReportAggregator()
@@ -4549,7 +4673,7 @@ class MonDaemon:
         for _cmd in ("status", "health", "health detail", "prometheus",
                      "perf dump", "perf schema", "report dump",
                      "mon_status", "log dump", "autoscale status",
-                     "telemetry", "slo", "top", "profile"):
+                     "telemetry", "slo", "top", "profile", "df"):
             self.asok.register(_cmd,
                                lambda args, c=_cmd: self._mon_cmd_obj(c))
         # argumented: `trace slow` / `trace list` / `trace <id-hex>`
@@ -4598,6 +4722,7 @@ class MonDaemon:
                 "mon", cluster.key_server.export_rotating("mon"))
             m.register_handler(MAuthOp.type_id, self._on_auth)
         m.register_handler(MPoolOp.type_id, self._on_pool_op)
+        m.register_handler(MPoolQuotaOp.type_id, self._on_pool_quota)
         m.register_handler(MConfigOp.type_id, self._on_config_op)
         m.register_handler(MOSDPing.type_id, self._on_ping)
         m.register_handler(MOSDPingReply.type_id, self._on_pong)
@@ -4676,6 +4801,13 @@ class MonDaemon:
             # duel the real leader's pn (its mutations requeue and
             # re-propose if leadership ever returns).
             if self.is_leader():
+                # r21 capacity ladder: only the leader evaluates — a
+                # queued mutation from a stale evaluation rebases to a
+                # no-op against the committed map anyway
+                try:
+                    self._capacity_tick()
+                except Exception:  # noqa: BLE001 — the ladder must
+                    pass           # never kill the mon heartbeat
                 with self._lock:
                     col = self._collecting
                     infl = self._inflight
@@ -5108,6 +5240,115 @@ class MonDaemon:
             **self.mgr.totals(),
         }
 
+    def _capacity_tick(self) -> None:
+        """r21 full-ratio ladder (ref: OSDMonitor::update_full_status
+        + get_full_ratios): leader-only heartbeat evaluation. Folds
+        every OSD's latest statfs claim through the committed ratio
+        ladder (mon_osd_nearfull_ratio / osd_backfillfull_ratio /
+        mon_osd_full_ratio) into per-OSD states, derives the cluster
+        FULL flag (any OSD at full) and pool-quota flags
+        (quota_max_bytes/objects vs the MgrReport pool aggregates),
+        and commits ONLY deltas — a queued closure rebases to a no-op
+        when the committed map already agrees, so a quiet cluster
+        proposes nothing."""
+        if self.osdmap is None:
+            return
+        near = float(self.conf_view["mon_osd_nearfull_ratio"])
+        bff = float(self.conf_view["osd_backfillfull_ratio"])
+        full = float(self.conf_view["mon_osd_full_ratio"])
+        states: dict[int, int] = {}
+        up = self.osdmap.osd_up
+        for name, st in self.mgr.statfs().items():
+            if not name.startswith("osd."):
+                continue
+            osd_id = int(name[4:])
+            if osd_id < len(up) and not up[osd_id]:
+                # down OSD: its last claim is frozen history, not
+                # capacity — a dead reporter must not hold a ladder
+                # rung (ref: OSDMonitor skips down/out in
+                # get_full_osd_counts)
+                continue
+            total = int(st.get("total", 0))
+            if total <= 0:
+                continue               # unbounded store: no ratio
+            ratio = int(st.get("used", 0)) / total
+            if ratio >= full:
+                states[int(name[4:])] = FULL_FULL
+            elif ratio >= bff:
+                states[int(name[4:])] = FULL_BACKFILLFULL
+            elif ratio >= near:
+                states[int(name[4:])] = FULL_NEARFULL
+        cluster_full = any(s >= FULL_FULL for s in states.values())
+        pool_bytes = self.mgr.pool_bytes()
+        pool_objects = self.mgr.pool_objects()
+        full_pools: set[int] = set()
+        for pid, p in self.osdmap.pools.items():
+            qb, qo = int(p.quota_max_bytes), int(p.quota_max_objects)
+            if (qb and pool_bytes.get(pid, 0) >= qb) \
+                    or (qo and pool_objects.get(pid, 0) >= qo):
+                full_pools.add(pid)
+        cur = self.osdmap
+        if (cur.osd_full_state == states
+                and cur.cluster_full == cluster_full
+                and cur.full_pools == full_pools):
+            return
+        self.perf.inc("full_flag_flips")
+        self._commit(lambda m, s=dict(states), cf=cluster_full,
+                     fp=tuple(sorted(full_pools)):
+                     m.set_full_states(dict(s), cf, set(fp)))
+
+    def _df_obj(self) -> dict:
+        """`ceph df` (r21): per-OSD statfs + committed ladder state +
+        per-pool usage vs quota — rendered from the same two sources
+        the ladder itself uses (MgrReport claims, committed map), so
+        the operator sees exactly what the mon decided from."""
+        m = self.osdmap
+        stat = self.mgr.statfs()
+        osds: dict[str, dict] = {}
+        tot_b = used_b = 0
+        for name in sorted(stat):
+            st = stat[name]
+            total = int(st.get("total", 0))
+            used = int(st.get("used", 0))
+            ent = {"total": total, "used": used,
+                   "avail": int(st.get("avail", 0)),
+                   "ratio": round(used / total, 4) if total else 0.0}
+            if name.startswith("osd.") and m is not None:
+                ent["state"] = FULL_STATE_NAMES.get(
+                    m.full_state_of(int(name[4:])), "ok")
+            tot_b += total
+            used_b += used
+            osds[name] = ent
+        pool_bytes = self.mgr.pool_bytes()
+        pool_objects = self.mgr.pool_objects()
+        pools: dict[str, dict] = {}
+        if m is not None:
+            for pid, p in sorted(m.pools.items()):
+                pools[str(pid)] = {
+                    "bytes": int(pool_bytes.get(pid, 0)),
+                    "objects": int(pool_objects.get(pid, 0)),
+                    "quota_max_bytes": int(p.quota_max_bytes),
+                    "quota_max_objects": int(p.quota_max_objects),
+                    "full": pid in m.full_pools}
+        return {
+            "epoch": m.epoch if m is not None else 0,
+            "cluster_full": bool(m.cluster_full)
+            if m is not None else False,
+            "full_ratios": {
+                "nearfull": float(
+                    self.conf_view["mon_osd_nearfull_ratio"]),
+                "backfillfull": float(
+                    self.conf_view["osd_backfillfull_ratio"]),
+                "full": float(self.conf_view["mon_osd_full_ratio"]),
+                "failsafe": float(
+                    self.conf_view["osd_failsafe_full_ratio"])},
+            "total_bytes": tot_b,
+            "total_used_bytes": used_b,
+            "total_avail_bytes": max(0, tot_b - used_b),
+            "osds": osds,
+            "pools": pools,
+        }
+
     def _mon_cmd_obj(self, kind: str):
         """ONE dispatcher for the wire MMonCmd and the monitor's admin
         socket — the `ceph status / health / prometheus` surface,
@@ -5126,6 +5367,8 @@ class MonDaemon:
             return self._health_obj(detail=False)
         if kind == "health detail":
             return self._health_obj(detail=True)
+        if kind == "df":
+            return self._df_obj()
         if kind == "prometheus":
             return {"text": _reports.prometheus_text(self.mgr)}
         if kind == "perf dump":
@@ -5159,7 +5402,11 @@ class MonDaemon:
         if kind == "slo":
             return {"rules": self.telemetry.slo_status(),
                     "burn_rate": self.telemetry.burn_rate(),
-                    "regressions": self.telemetry.regressions()}
+                    "regressions": self.telemetry.regressions(),
+                    # r21: per-client capacity-stall accounting, so a
+                    # flat write feed during a FULL window reads as
+                    # "parked", not "idle" or "regressed"
+                    "full_backoff": self.telemetry.full_backoff()}
         if kind == "top":
             # per-daemon rates over the newest history interval; the
             # r19 observability drop gauges ride along (sampler +
@@ -5548,6 +5795,24 @@ class MonDaemon:
                 m.pool_rmsnap(1, snap)
         self._commit(mutate)
 
+    def _on_pool_quota(self, peer: str, msg: MPoolQuotaOp) -> None:
+        """`ceph osd pool set-quota` (r21): commit the quota onto the
+        map; the leader's next capacity tick evaluates it against the
+        MgrReport pool aggregates and raises/clears POOL_FULL."""
+        if self.osdmap is None:
+            return
+        if self._mon_admin_denied(peer, f"pool quota {msg.pool_id}"):
+            return
+        if msg.pool_id not in self.osdmap.pools:
+            self.c.log(f"{self.name}: REJECT pool quota "
+                       f"(no pool {msg.pool_id})")
+            return
+        self.c.log(f"{self.name}: pool {msg.pool_id} quota "
+                   f"bytes={msg.max_bytes} objects={msg.max_objects} "
+                   f"from {peer}")
+        self._commit(lambda m, p=msg.pool_id, b=msg.max_bytes,
+                     o=msg.max_objects: m.set_pool_quota(p, b, o))
+
     def _on_config_op(self, peer: str, msg: MConfigOp) -> None:
         """Centralized config mutation (the ConfigMonitor role): the
         KV rides the same Paxos-committed value as the map, so a
@@ -5710,7 +5975,8 @@ class _WireOp:
     straight to a surviving shard."""
 
     __slots__ = ("kind", "ps", "body_fn", "blob", "last", "done",
-                 "fatal", "names", "avoid", "try_degraded")
+                 "fatal", "names", "avoid", "try_degraded",
+                 "full_wait", "full_pin_t")
 
     def __init__(self, kind: str, ps: int, body_fn, names=None):
         self.kind, self.ps, self.body_fn = kind, ps, body_fn
@@ -5721,6 +5987,15 @@ class _WireOp:
         self.names: list[str] | None = names
         self.avoid: set[str] = set()
         self.try_degraded = False
+        # r21: the map epoch an OSD failsafe-full bounce parked this
+        # op at — the op sits out every round until a NEWER epoch
+        # shows up (capacity-ladder commits bump it), then probes
+        # once. full_pin_t (monotonic seconds at pin time) bounds the
+        # park: a bounce whose cause clears before the ladder ever
+        # commits it (sub-report-beat full window) produces NO newer
+        # epoch, so a stale pin must eventually probe on its own
+        self.full_wait: int | None = None
+        self.full_pin_t: float | None = None
 
 
 class _TracedCall:
@@ -5860,7 +6135,21 @@ class Client:
                                    "window wait included) — the r18 "
                                    "observed_client_latency feed",
                                    hist=True)
+                     .add_time_avg("full_backoff_time",
+                                   "wall time mutating ops sat parked "
+                                   "behind a FULL cluster/pool flag "
+                                   "or an OSD failsafe bounce (the "
+                                   "RADOS full-wait contract: parked, "
+                                   "never errored) — the SLO plane "
+                                   "discloses these intervals instead "
+                                   "of charging them to write latency",
+                                   hist=True)
                      .create_perf_counters())
+        # r21 FULL_TRY (ref: CEPH_OSD_FLAG_FULL_TRY): an admin client
+        # sets this to push mutations through a map-level FULL flag
+        # (deletes already pass — they free space); the OSD failsafe
+        # still bounces when the local disk truly has no room
+        self.full_try = False
         # per-target read-latency EWMA: orders the fallback/hedge
         # candidates ("next-best shard")
         self._lat_ewma: dict[str, float] = {}
@@ -6031,6 +6320,16 @@ class Client:
             # reappear
             op.fatal = KeyError(err[9:] or err)
             return
+        if "failsafe full" in err:
+            # r21: the OSD's local hard-stop. Park until a NEWER map
+            # could have changed the picture (capacity-ladder commits
+            # bump the epoch) — the op never burns retry budget and
+            # never surfaces while parked (the RADOS full-wait
+            # contract); a fresh epoch probes exactly once.
+            op.full_wait = self.osdmap.epoch \
+                if self.osdmap is not None else 0
+            op.full_pin_t = time.monotonic()
+            return
         # anything else is transport-shaped: retarget and retry
         if op.kind in self._HEDGE_KINDS \
                 and ("peering" in err or "not primary" in err):
@@ -6039,6 +6338,64 @@ class Client:
             # straight to a surviving shard as a degraded read
             # instead of sleeping out the peering window
             op.try_degraded = True
+
+    #: kinds the FULL flags park — writes that ADD bytes; "remove"
+    #: deliberately passes (freeing space is how a full cluster
+    #: recovers — the implicit FULL_TRY every delete carries)
+    _FULL_WAIT_KINDS = frozenset({"write", "write_at", "append"})
+
+    #: longest a failsafe-bounced op parks without a newer map before
+    #: probing again anyway — liveness for full windows too short for
+    #: the ladder to ever commit (each probe costs one retry round, so
+    #: a persistently-failsafe cluster still errors out eventually
+    #: instead of wedging the client forever)
+    _FAILSAFE_REPROBE_S = 2.0
+
+    def _full_parked(self, op: "_WireOp") -> bool:
+        """r21: does this op sit out the current dispatch round?
+        True while (a) an OSD failsafe bounce pinned it to an epoch
+        the cached map hasn't passed yet, or (b) the map flies the
+        cluster FULL flag or the pool's quota-full flag (full_try
+        clients push through the map flags, never the failsafe)."""
+        m = self.osdmap
+        if m is None:
+            return False
+        if op.full_wait is not None:
+            if m.epoch > op.full_wait:
+                op.full_wait = None    # newer map: probe again
+            elif op.full_pin_t is not None and \
+                    time.monotonic() - op.full_pin_t \
+                    >= self._FAILSAFE_REPROBE_S:
+                # stale-map liveness valve: a failsafe bounce whose
+                # cause cleared before any MgrReport reached the mon
+                # never produces a newer epoch — probe anyway after a
+                # bounded park; a store still at failsafe just
+                # re-bounces and re-pins (slow periodic probe)
+                op.full_wait = None
+            else:
+                return True
+        if op.kind not in self._FULL_WAIT_KINDS or self.full_try:
+            return False
+        return m.cluster_full or 1 in m.full_pools
+
+    def _full_backoff(self, base_sleep: float) -> None:
+        """One parked-write beat: re-probe the map from a monitor
+        (flag clears arrive as ordinary map fan-out; the request
+        covers a client the broadcast missed), then a jittered sleep.
+        The whole interval lands in full_backoff_time — the telemetry
+        plane discloses it instead of charging it to write latency."""
+        import random as _random
+        t0 = time.monotonic()
+        with self._lock:
+            epoch = self.osdmap.epoch if self.osdmap is not None else 0
+        for mon in self.c.mon_names():
+            try:
+                self.msgr.send(mon, MOSDMapRequest(epoch))
+                break
+            except (KeyError, OSError, ConnectionError):
+                continue
+        time.sleep(base_sleep * (0.5 + _random.random()))
+        self.perf.tinc("full_backoff_time", time.monotonic() - t0)
 
     # -- degraded / hedged read dispatch --------------------------------------
 
@@ -6382,11 +6739,30 @@ class Client:
         stays exactly-once per handle; mutations never hedge."""
         if timeout is None:
             timeout = self.c.op_timeout + 8.0   # server-side retry room
-        for _ in range(retries):
+        rounds = 0
+        while rounds < retries:
             outstanding = [op for op in ops
                            if not op.done and op.fatal is None]
             if not outstanding:
                 break
+            # r21 full-wait (ref: Objecter::_maybe_request_map +
+            # the pool/cluster FULL pause): a mutating op parks —
+            # undisplayed, unerrored, retry budget untouched — while
+            # the cached map flies a FULL flag over its pool/cluster,
+            # or while an OSD failsafe bounce pins it to the bounced
+            # epoch. A round where EVERY outstanding op is parked
+            # sleeps a jittered beat + re-probes the map instead of
+            # dispatching; the ops resume exactly-once when a newer
+            # epoch clears the gate.
+            parked = [op for op in outstanding
+                      if self._full_parked(op)]
+            if parked and len(parked) == len(outstanding):
+                self._full_backoff(retry_sleep)
+                continue
+            if parked:
+                outstanding = [op for op in outstanding
+                               if not self._full_parked(op)]
+            rounds += 1
             hedge_s = self._hedge_delay_s()
             by_tgt: dict[str, list[_WireOp]] = {}
             deg_ops: list[tuple[_WireOp, str]] = []
@@ -6679,6 +7055,25 @@ class Client:
             and self.osdmap.osd_weight[osd] == want,
             timeout, f"osd.{osd} reweighted")
 
+    def pool_set_quota(self, max_bytes: int = 0, max_objects: int = 0,
+                       pool_id: int = 1,
+                       timeout: float = 15.0) -> None:
+        """`ceph osd pool set-quota` (r21) — quorum-committed onto the
+        map; 0 clears that bound. POOL_FULL raises/clears on the
+        leader's next capacity tick (it needs the MgrReport pool
+        aggregates, so the flag follows the quota by up to a beat)."""
+        self._ensure_mon_sessions()
+        self._mon_cast(MPoolQuotaOp(pool_id, int(max_bytes),
+                                    int(max_objects)))
+        self.c._wait(
+            lambda: self.osdmap is not None
+            and pool_id in self.osdmap.pools
+            and self.osdmap.pools[pool_id].quota_max_bytes
+            == int(max_bytes)
+            and self.osdmap.pools[pool_id].quota_max_objects
+            == int(max_objects),
+            timeout, f"pool {pool_id} quota committed")
+
     # -- centralized config over the wire ------------------------------------
 
     def config_set(self, key: str, value, timeout: float = 15.0) -> None:
@@ -6741,7 +7136,8 @@ class StandaloneCluster:
                  chunk_size: int = 256, verbose: bool | None = None,
                  op_window: int = 8, admin_dir: str | None = None,
                  op_shards: int = 1, msgr_workers: int = 1,
-                 osd_procs: bool = False, msgr_uds: bool = True):
+                 osd_procs: bool = False, msgr_uds: bool = True,
+                 store_capacity: int = 0):
         import os as _os
         if verbose is None:
             verbose = bool(_os.environ.get("STANDALONE_VERBOSE"))
@@ -6815,6 +7211,10 @@ class StandaloneCluster:
         self.pg_num = pg_num
         self.n_osds = n_osds
         self.store_kind = store
+        # r21: per-OSD byte budget (0 = unbounded — statfs reports
+        # total 0 and the mon ladder never computes a ratio); the
+        # osd_store_capacity_bytes config role for the harness tier
+        self.store_capacity = int(store_capacity)
         self.store_dir = store_dir
         if store == "tin" and store_dir is None:
             import tempfile
@@ -6901,8 +7301,9 @@ class StandaloneCluster:
             return TinStore(os.path.join(self.store_dir,
                                          f"osd.{osd_id}"),
                             verify_reads=False,
-                            cache_bytes=64 << 10)
-        return MemStore()
+                            cache_bytes=64 << 10,
+                            capacity_bytes=self.store_capacity)
+        return MemStore(capacity_bytes=self.store_capacity)
 
     def _wire_peers(self) -> None:
         every = ([(d.name, d.msgr) for d in self.osds.values()]

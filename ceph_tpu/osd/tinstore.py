@@ -587,7 +587,8 @@ class TinStore:
                  kv_fanout: int = 4,
                  compression: str | None = None,
                  compression_min_blob: int = 4096,
-                 compression_required_ratio: float = 0.875):
+                 compression_required_ratio: float = 0.875,
+                 capacity_bytes: int = 0):
         if compression is not None \
                 and compression not in self.COMPRESSION_ALGS:
             raise ValueError(f"unknown compression {compression!r}; "
@@ -616,6 +617,15 @@ class TinStore:
         self._db: TinDB | None = None
         self._dev_fd: int | None = None
         self.committed_txns = 0
+        #: capacity ceiling in bytes over device extents + WAL; 0 =
+        #: unbounded. Live-shrinkable (set_capacity) for the r21
+        #: disk_full injection path — enforcement is in _stage, BEFORE
+        #: the allocator grows the device.
+        self.capacity_bytes = int(capacity_bytes)
+        #: deterministic ENOSPC injection: fn(point) raised-from at
+        #: "txn.apply" (here) and every TinDB hook point (wal.append,
+        #: flush.*, compact.*) — survives remounts (rewired in mount)
+        self._fault = None
         os.makedirs(path, exist_ok=True)
         self.mount()
 
@@ -668,6 +678,10 @@ class TinStore:
                         fanout=self.kv_fanout, wal_name="wal.log")
                 except TinDBCorruption as e:
                     raise TinStoreCorruption(str(e)) from None
+                # fault hook survives remounts: each mount builds a
+                # fresh TinDB, so the injection fn must be rewired or
+                # a revive would silently disarm the chaos stream
+                self._db._fault = getattr(self, "_fault", None)
                 self._meta = {}
                 self._load_mirror()
                 self._derive_allocator()
@@ -755,6 +769,42 @@ class TinStore:
             raise RuntimeError(f"TinStore {self.path} is down "
                                f"(crashed/umounted; remount() first)")
         return self._meta
+
+    # -- capacity (r21 capacity plane; contract shared w/ MemStore) ----------
+
+    def set_capacity(self, nbytes: int) -> None:
+        """Live capacity change; shrinking below current usage makes
+        the ratio read > 1.0 and every staging alloc ENOSPC — the
+        disk_full fault stream's lever."""
+        with self._lock:
+            self.capacity_bytes = int(nbytes)
+
+    def set_fault(self, fn) -> None:
+        """Install the deterministic injection hook on the store AND
+        its KV plane (wal.append / flush.* / compact.* points)."""
+        with self._lock:
+            self._fault = fn
+            if self._db is not None:
+                self._db._fault = fn
+
+    def used_bytes(self) -> int:
+        """Allocated device extents + unflushed WAL — what counts
+        against capacity. Sealed KV segments are deliberately excluded
+        (they are O(metadata), bounded by compaction; documented in
+        ARCHITECTURE's capacity-plane section)."""
+        with self._lock:
+            used = self._alloc.used_bytes()
+            if self._db is not None and not self._db.is_down:
+                used += self._db.wal_size()
+            return used
+
+    def statfs(self) -> dict:
+        """Bytes total/used/avail (ObjectStore::statfs). total == 0
+        means unbounded: the mon ladder never computes a ratio."""
+        used = self.used_bytes()
+        total = int(self.capacity_bytes)
+        return {"total": total, "used": used,
+                "avail": max(0, total - used) if total else 0}
 
     # -- legacy (pre-KV) store migration -------------------------------------
 
@@ -925,6 +975,10 @@ class TinStore:
         with self._lock:
             self._alive()
             self._validate(txn)
+            if self._fault is not None:
+                # injection point BEFORE any staging: an injected
+                # ENOSPC aborts with nothing allocated or written
+                self._fault("txn.apply")
             staged: dict[tuple[str, str], np.ndarray] = {}
             # objects removed EARLIER IN THIS TXN: a later write must
             # start from empty, not resurrect the pre-txn bytes
@@ -983,7 +1037,16 @@ class TinStore:
                 raise
             if self.o_dsync and new_extents:
                 os.fsync(self._dev_fd)     # data durable BEFORE the WAL
-            self._db.submit_transaction(self._kv_txn_for(meta_ops))
+            try:
+                self._db.submit_transaction(self._kv_txn_for(meta_ops))
+            except OSError:
+                # ENOSPC on the WAL append (r21): the KV plane rolled
+                # its seq/tail back and nothing references the staged
+                # extents — free them so the abort is atomic live,
+                # not just after a remount re-derives the allocator
+                for doff, dlen in new_extents:
+                    self._alloc.free(doff, dlen)
+                raise
             for op in meta_ops:
                 self._apply_meta(op)
             for key, arr in staged.items():
@@ -992,7 +1055,14 @@ class TinStore:
                     self._cache.put(key, arr)
             self.committed_txns += 1
             if self._db.wal_size() >= self.wal_max_bytes:
-                self._db.flush()
+                try:
+                    self._db.flush()
+                except OSError:
+                    # ENOSPC (real or injected) on the post-commit
+                    # flush: the txn above already committed — the
+                    # memtable/WAL stay whole and the next txn retries
+                    # the flush once space returns
+                    pass
 
     def _kv_txn_for(self, meta_ops: list[tuple]):
         """Translate one metadata-op batch into ONE TinDB transaction
@@ -1132,6 +1202,18 @@ class TinStore:
             comp = self._compress(self.compression, stored)
             if len(comp) <= self.compression_required_ratio * len(arr):
                 stored, calg = comp, self.compression
+        # capacity gate BEFORE the allocator grows the device: the
+        # raise unwinds through queue_transaction's except path, which
+        # frees every extent this txn already staged — the ENOSPC
+        # abort is atomic (nothing hit the KV plane yet)
+        if self.capacity_bytes:
+            need = ExtentAllocator.round_up(max(1, len(stored)))
+            if self.used_bytes() + need > self.capacity_bytes:
+                import errno
+                raise OSError(
+                    errno.ENOSPC,
+                    f"tinstore over capacity "
+                    f"({self.capacity_bytes} bytes)")
         doff, dlen = self._alloc.alloc(len(stored))
         if self._alloc.device_size > os.fstat(self._dev_fd).st_size:
             os.ftruncate(self._dev_fd, self._alloc.device_size)

@@ -269,6 +269,35 @@ OPTIONS: list[Option] = [
            "drive (tools/profile_diff.py must attribute it to the "
            "op-path category). Live via central config; 0 = off",
            min=0.0),
+    Option("osd_store_capacity_bytes", int, 0,
+           "store capacity ceiling in bytes (r21 capacity plane): "
+           "statfs() reports this as total and the store raises "
+           "ENOSPC when a transaction would push used past it. "
+           "0 = unbounded (statfs total falls back to the real "
+           "device/RAM view and no ratio ever trips). Live-shrinkable "
+           "per store via set_capacity() for fault injection",
+           min=0),
+    Option("mon_osd_nearfull_ratio", float, 0.85,
+           "used/total ratio at which the leader marks an OSD "
+           "NEARFULL on the committed map (warning only — IO "
+           "continues; the OSD_NEARFULL health source)",
+           min=0.0, max=1.0),
+    Option("osd_backfillfull_ratio", float, 0.90,
+           "used/total ratio at which recovery/backfill INTO an OSD "
+           "parks (client IO continues; urgent m-1 repairs override "
+           "— losing the stripe is worse than an over-full device)",
+           min=0.0, max=1.0),
+    Option("mon_osd_full_ratio", float, 0.95,
+           "used/total ratio at which the leader raises the cluster "
+           "FULL flag: clients park writes (no error surfaced) until "
+           "an epoch clears it; reads and deletes keep serving",
+           min=0.0, max=1.0),
+    Option("osd_failsafe_full_ratio", float, 0.97,
+           "LOCAL hard-stop: an OSD whose own statfs crosses this "
+           "rejects mutating ops even when its map is stale (the "
+           "window between a device filling and the FULL epoch "
+           "arriving must not tear through the last 3%)",
+           min=0.0, max=1.0),
 ]
 
 

@@ -37,6 +37,27 @@ TELEMETRY_KEYS = {"interval_s", "series", "quantiles", "slo"}
 PROFILE_KEYS = {"daemons", "hz", "samples", "idle_samples",
                 "categories", "category_share", "top_stacks",
                 "sampler_overhead"}
+# r21 capacity block (rados_bench + workload_bench emit it): the
+# mon's df view at run end plus the two capacity-stall counters the
+# acceptance numbers are read from (OSD failsafe rejections, client
+# parked-write backoff)
+CAPACITY_KEYS = {"cluster_full", "full_ratios", "total_bytes",
+                 "total_used_bytes", "osds", "pools",
+                 "writes_rejected_full", "client_full_backoff"}
+RATIO_KEYS = {"nearfull", "backfillfull", "full", "failsafe"}
+
+
+def _check_capacity_block(cap):
+    assert set(cap) == CAPACITY_KEYS
+    assert set(cap["full_ratios"]) == RATIO_KEYS
+    assert set(cap["client_full_backoff"]) == {"count", "total_s"}
+    assert isinstance(cap["cluster_full"], bool)
+    assert isinstance(cap["writes_rejected_full"], int)
+    for name, row in cap["osds"].items():
+        assert {"total", "used", "avail", "ratio", "state"} \
+            <= set(row), name
+
+
 PROFILE_CATS = {"queue", "crypto", "encode", "store", "wire",
                 "reactor", "other"}
 QUANTILE_KEYS = {"p50_ms", "p95_ms", "p99_ms", "count"}
@@ -102,6 +123,51 @@ def test_bench_r19_artifact_pinned():
     assert all(p["on"] > 0 and p["off"] > 0 for p in guard["pairs"])
     assert set(data["cells"]["flame_assembly"]["categories"]) \
         == PROFILE_CATS
+
+
+def test_bench_r21_artifact_pinned():
+    """The committed r21 capacity-exhaustion artifact (generated by
+    tools/capacity_bench.py): a live cephx+secure cluster driven FULL
+    mid-write-window with ZERO surfaced client errors — writes park
+    and drain exactly-once bit-exact, reads + the implicit-FULL_TRY
+    delete keep serving; recovery into backfillfull targets parks
+    (counted) while degraded reads serve; the REAL-capacity failsafe
+    window bounces, parks and drains; and one-shot ENOSPC at every
+    TinStore txn phase leaves the store fsck-clean across SIGKILL."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r21.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "capacity_r21/1"
+    assert data["config"]["cephx"] and data["config"]["secure"]
+    assert data["config"]["full_ratios"] == {
+        "nearfull": 0.85, "backfillfull": 0.90,
+        "full": 0.95, "failsafe": 0.97}
+    acc = data["acceptance"]
+    assert acc["client_op_errors"] == 0
+    assert acc["reads_served_under_full"] > 0
+    assert acc["delete_passed_under_full"] is True
+    assert acc["parked_drained_fraction"] == 1.0
+    assert acc["drained_bit_exact"] is True
+    assert acc["recovery_parked_backfillfull"] > 0
+    assert acc["degraded_reads_served_under_backfillfull"] > 0
+    assert acc["failsafe_writes_rejected"] > 0
+    assert acc["enospc_phases_covered"] == 6
+    assert acc["enospc_all_fsck_clean"] is True
+    fw = data["cells"]["full_window"]
+    assert fw["writer_parked_during_window"] is True
+    assert fw["parked_drained"] == fw["parked_writes"] > 0
+    assert fw["full_backoff"]["count"] > 0
+    assert fw["full_backoff"]["total_s"] > 0
+    matrix = data["cells"]["enospc_matrix"]
+    assert set(matrix) == {
+        "txn.apply", "wal.append", "flush.segment-written",
+        "flush.manifest-swapped", "compact.segments-written",
+        "compact.manifest-swapped"}
+    for phase, row in matrix.items():
+        assert row["fired"] == 1, phase
+        assert row["fsck_clean"] is True, phase
+        assert row["acked_bit_exact_and_accepts_after"] is True, phase
 
 
 def test_bench_r18_artifact_pinned():
@@ -304,6 +370,15 @@ def test_rados_bench_json_schema(capsys):
     _check_profile_block(out["profile"])
     assert len(out["profile"]["daemons"]) == 4
     assert out["profile"]["samples"] >= 0
+    # r21: the capacity block — the mon's df view plus the two
+    # capacity-stall counters; this clean unbounded run never
+    # laddered, so both counters pin at zero (non-vacuously: the df
+    # rode the MgrReport statfs pipe for all 4 OSDs)
+    _check_capacity_block(out["capacity"])
+    assert out["capacity"]["cluster_full"] is False
+    assert len(out["capacity"]["osds"]) == 4
+    assert out["capacity"]["writes_rejected_full"] == 0
+    assert out["capacity"]["client_full_backoff"]["count"] == 0
 
 
 def test_bench_r13_artifact_pinned():

@@ -220,6 +220,93 @@ class TestDurability:
         assert db.get("O", b"last") == b"c" * 40
         db.crash()
 
+    def test_enospc_append_fuzz_every_byte_boundary(self, tmp_path):
+        """ENOSPC-torn appends, exhaustively (r21): the device fills
+        after EVERY possible byte prefix of one WAL append. The submit
+        must fail loudly, roll the log back to the sealed prefix (seq
+        NOT advanced — a seq jump would be fatal on replay), keep
+        serving, and accept the SAME txn once space returns; the
+        crash-before-rollback shape (partial bytes persisted because
+        the truncate never ran) must remount as a plain torn tail and
+        fsck clean."""
+        import errno
+
+        class _FillsAfter:
+            """File proxy: the device has room for exactly `allow`
+            more bytes — a write larger than that lands its prefix
+            (what a real short write persists) then raises ENOSPC."""
+
+            def __init__(self, f, allow, truncate_fails=False):
+                self._f = f
+                self._allow = allow
+                self._truncate_fails = truncate_fails
+
+            def write(self, b):
+                if len(b) > self._allow:
+                    self._f.write(b[:self._allow])
+                    self._f.flush()
+                    self._allow = 0
+                    raise OSError(errno.ENOSPC, "injected ENOSPC")
+                self._allow -= len(b)
+                return self._f.write(b)
+
+            def truncate(self, n):
+                if self._truncate_fails:
+                    raise OSError(errno.ENOSPC, "injected ENOSPC")
+                return self._f.truncate(n)
+
+            def __getattr__(self, a):
+                return getattr(self._f, a)
+
+        db = mk(tmp_path)
+        put(db, "O", (b"sealed", b"x"))
+        body = b"torn-" + b"v" * 24
+        # measure one full append (same key/body length as the torn
+        # txn below) so the cut range covers every byte of the record
+        db.crash()
+        wal = os.path.join(db.path, "wal.log")
+        base_len = os.path.getsize(wal)
+        db.mount()
+        put(db, "O", (b"tron", body))
+        db.crash()
+        rec_len = os.path.getsize(wal) - base_len
+        assert rec_len > 12
+        db.mount()
+
+        for cut in range(rec_len):                # rollback path
+            real = db._wal_f
+            db._wal_f = _FillsAfter(real, cut)
+            t = db.transaction()
+            t.set("O", b"torn", body)
+            with pytest.raises(OSError):
+                db.submit_transaction(t)
+            db._wal_f = real
+            assert db.get("O", b"sealed") == b"x"
+            assert db.get("O", b"torn") is None
+            # space returns: the SAME txn lands cleanly, then make
+            # room for the next iteration (rm is just another record)
+            put(db, "O", (b"torn", body))
+            assert db.get("O", b"torn") == body
+            db.submit_transaction(
+                db.transaction().rmkey("O", b"torn"))
+
+        for cut in range(rec_len):                # crash-before-rollback
+            real = db._wal_f
+            db._wal_f = _FillsAfter(real, cut, truncate_fails=True)
+            t = db.transaction()
+            t.set("O", b"torn", body)
+            with pytest.raises(OSError):
+                db.submit_transaction(t)
+            db._wal_f = real
+            db.crash()                            # partial bytes on disk
+            db.mount()                            # = torn tail, recovered
+            assert db.get("O", b"sealed") == b"x"
+            assert db.get("O", b"torn") is None
+            db.crash()
+            rep = TinDB.fsck(db.path)
+            assert rep["errors"] == [] and not rep["torn_tail"]
+            db.mount()
+
     def test_mid_log_corruption_fatal(self, tmp_path):
         db = mk(tmp_path)
         put(db, "O", (b"a", b"1"))

@@ -295,6 +295,61 @@ class TestSLOBurn:
         assert agg.health_checks() == []
 
 
+class TestFullBackoffDisclosure:
+    """r21: capacity stalls are DISCLOSED on write-feed verdicts
+    (full_backoff_active) and suppress LATENCY_REGRESSION for the
+    write feeds — parked time is a count/duration feed, never write
+    latency."""
+
+    RULE = "client_write_p99 < 20ms over 60s"
+
+    def _agg(self, factor=0.0):
+        return TelemetryAggregator(
+            config=_Cfg(mgr_slo_rules=self.RULE,
+                        mgr_latency_regression_factor=factor))
+
+    @staticmethod
+    def _parked_client(agg, count=3, total=1.2):
+        agg.ingest_client("client.x", {"client": {
+            "full_backoff_time": {"avgcount": count, "sum": total}}})
+
+    def test_write_verdict_discloses_backoff(self):
+        agg = self._agg()
+        now = time.time()
+        agg.ingest("osd.0", [_entry(1, ms=2, t=now - 2,
+                                    key="op_w_latency_hist")])
+        assert "full_backoff_active" not in agg.slo_status()[0]
+        self._parked_client(agg)
+        v = agg.slo_status()[0]
+        assert v["full_backoff_active"] is True
+        assert v["breach"] is False       # disclosure, not a breach
+        # the `ceph_cli slo` capacity-stall block: per-client totals
+        assert agg.full_backoff() == {
+            "client.x": {"count": 3, "total_s": 1.2}}
+
+    def test_read_verdicts_never_carry_the_flag(self):
+        agg = TelemetryAggregator(
+            config=_Cfg(mgr_slo_rules="client_read_p99 < 20ms over 60s",
+                        mgr_latency_regression_factor=0.0))
+        agg.ingest("osd.0", [_entry(1, ms=2, t=time.time() - 2)])
+        self._parked_client(agg)
+        assert "full_backoff_active" not in agg.slo_status()[0]
+
+    def test_backoff_suppresses_write_latency_regression(self):
+        agg = self._agg(factor=4.0)
+        now = time.time()
+        for b in range(4):
+            agg.ingest("osd.0", [_entry(b, ms=4, t=now - 8 + b,
+                                        key="op_w_latency_hist")])
+        agg.ingest("osd.0", [_entry(9, ms=400, t=now - 1,
+                                    key="op_w_latency_hist")])
+        assert len(agg.regressions()) == 1    # no backoff: real drift
+        self._parked_client(agg)
+        # same data, but clients were observed parked in the window:
+        # a capacity stall, not a write-path regression
+        assert agg.regressions() == []
+
+
 class TestMergeBitExact:
     def test_cluster_merge_equals_per_daemon_fold(self):
         agg = TelemetryAggregator()

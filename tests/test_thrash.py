@@ -192,6 +192,45 @@ def test_thrash_transient_matrix(seed, store, fraction, tmp_path):
     assert report["objects_verified"] > 0, report
 
 
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed,store", [(3, "mem")])
+def test_thrash_disk_full_smoke(seed, store, tmp_path):
+    """r21 tier-1 cell: the seeded disk_full fault stream — live
+    capacity shrinks drive the ladder to FULL mid-write-window
+    (writes park RADOS-style, reads keep serving, the window heals by
+    restoring capacity and every parked write drains exactly-once)
+    plus one-shot ENOSPC injection at seeded store txn phases. The
+    heal asserts zero surfaced client write errors and fsck-clean
+    stores on top of the four standing invariants."""
+    th = Thrasher(seed, store=store, rounds=1, ops=6, disk_full=True)
+    report = th.run()
+    assert report["full_windows"] > 0, report
+    assert report["full_reads_served"] > 0, report
+    assert report["full_parked_drained"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store,rounds", [(5, "tin", 2),
+                                               (7, "mem", 2)])
+def test_thrash_disk_full_matrix(seed, store, rounds, tmp_path):
+    """Deeper disk_full cells (`-m chaos`): more rounds and the
+    TinStore path, where the seeded ENOSPC injection lands across the
+    WAL/flush/compaction phase set and every directory must come back
+    fsck-clean after the round's crash-heal."""
+    th = Thrasher(seed, store=store, rounds=rounds, ops=6,
+                  disk_full=True,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["full_windows"] > 0, report
+    assert report["full_parked_drained"] > 0, report
+    assert report["enospc_injected"] > 0, report
+    if store == "tin":
+        assert report["fsck_clean_stores"] > 0, report
+
+
 def test_same_seed_same_schedule(tmp_path):
     """Reproducibility contract: two Thrashers with one seed draw the
     IDENTICAL fault schedule (victims, knob values, data sizes) —

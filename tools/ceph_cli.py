@@ -284,6 +284,9 @@ def cmd_live_slo(asok_dir: str, args) -> None:
     print(f"  cluster burn rate: {s.get('burn_rate')}")
     for r in rules:
         state = "BREACH" if r["breach"] else "ok"
+        if r.get("full_backoff_active"):
+            state += ", FULL-BACKOFF"   # r21: capacity stall, not a
+            #                           # slow write path
         print(f"  {r['name']:<24} < {r['threshold_ms']}ms over "
               f"{r['window_s']}s  current={r['current_ms']}ms  "
               f"burn fast={r['burn_fast']} slow={r['burn_slow']}  "
@@ -292,6 +295,46 @@ def cmd_live_slo(asok_dir: str, args) -> None:
         print(f"  LATENCY_REGRESSION {reg['feed']}: p99 "
               f"{reg['current_p99_ms']}ms = {reg['factor']}x "
               f"baseline {reg['baseline_p99_ms']}ms")
+    for name, row in sorted((s.get("full_backoff") or {}).items()):
+        print(f"  full-backoff {name}: {row['count']} parked op(s), "
+              f"{row['total_s']}s total")
+
+
+def cmd_live_df(asok_dir: str, args) -> None:
+    """`ceph_cli df` (live) — the r21 capacity plane from any
+    monitor's committed map + MgrReport statfs claims: per-OSD
+    usage with its ladder state (nearfull/backfillfull/full), the
+    cluster FULL flag, and per-pool usage against quotas."""
+    d = live_mon_command(asok_dir, "df")
+    if args.json:
+        print(json.dumps(d, sort_keys=True))
+        return
+    r = d.get("full_ratios") or {}
+    print(f"  epoch {d.get('epoch')}  cluster_full="
+          f"{d.get('cluster_full')}  ratios nearfull="
+          f"{r.get('nearfull')} backfillfull={r.get('backfillfull')} "
+          f"full={r.get('full')} failsafe={r.get('failsafe')}")
+    print(f"  RAW: {d.get('total_used_bytes')} / "
+          f"{d.get('total_bytes')} B used "
+          f"({d.get('total_avail_bytes')} B avail)")
+    print("  OSD        TOTAL(B)     USED(B)    AVAIL(B)  RATIO  "
+          "STATE")
+    for name, o in sorted((d.get("osds") or {}).items()):
+        ratio = o.get("ratio")
+        print(f"  {name:<8} {o.get('total', 0):>11} "
+              f"{o.get('used', 0):>11} {o.get('avail', 0):>11} "
+              f"{ratio if ratio is None else format(ratio, '.3f'):>6}"
+              f"  {o.get('state', 'ok')}")
+    pools = d.get("pools") or {}
+    if pools:
+        print("  POOL  BYTES      OBJECTS  QUOTA-BYTES  QUOTA-OBJS  "
+              "FULL")
+        for pid, p in sorted(pools.items()):
+            print(f"  {pid:<5} {p.get('bytes', 0):<10} "
+                  f"{p.get('objects', 0):<8} "
+                  f"{p.get('quota_max_bytes', 0):<12} "
+                  f"{p.get('quota_max_objects', 0):<11} "
+                  f"{p.get('full', False)}")
 
 
 def cmd_live_profile(asok_dir: str, args) -> None:
@@ -640,6 +683,8 @@ def main(argv=None) -> None:
             cmd_live_top(args.asok_dir, args)
         elif args.cmd == "slo":
             cmd_live_slo(args.asok_dir, args)
+        elif args.cmd == "df":
+            cmd_live_df(args.asok_dir, args)
         elif args.cmd == "profile":
             cmd_live_profile(args.asok_dir, args)
         elif args.cmd == "flame":

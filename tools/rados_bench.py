@@ -798,6 +798,43 @@ def main(argv=None) -> None:
                 "hedge": hc}
         out["hedge"] = agg
         out["tenants"] = tenants
+        # r21 capacity block: the monitors' committed ladder view
+        # (`df` — per-OSD statfs claims + full-ratio states + pool
+        # quota flags) and the full-ladder counters: OSD failsafe
+        # bounces and the bench client's time parked in full-backoff.
+        # An unbounded run reads all-zeros with cluster_full false —
+        # the schema (pinned by tests/test_bench_schema.py) is the
+        # contract either way.
+        try:
+            df = wire_client.mon_command("df")
+        except Exception:   # noqa: BLE001 — a dying cluster still
+            df = {}         # ships the block, flagged empty
+
+        def _counter_total(key):
+            tot = 0
+            for d in c.osds.values():
+                if d._stop.is_set():
+                    continue
+                for counters in _osd_perf(d).values():
+                    if isinstance(counters, dict) \
+                            and isinstance(counters.get(key),
+                                           (int, float)):
+                        tot += int(counters[key])
+            return tot
+        fb = wire_client.perf.dump().get("full_backoff_time") or {}
+        out["capacity"] = {
+            "cluster_full": bool(df.get("cluster_full", False)),
+            "full_ratios": df.get("full_ratios") or {},
+            "total_bytes": int(df.get("total_bytes", 0)),
+            "total_used_bytes": int(df.get("total_used_bytes", 0)),
+            "osds": df.get("osds") or {},
+            "pools": df.get("pools") or {},
+            "writes_rejected_full":
+                _counter_total("writes_rejected_full"),
+            "client_full_backoff": {
+                "count": int(fb.get("avgcount", 0)),
+                "total_s": round(float(fb.get("sum", 0.0)), 3)},
+        }
         out["config"]["history_interval"] = args.history_interval
         out["config"]["telemetry_off"] = args.telemetry_off
         if not args.telemetry_off:

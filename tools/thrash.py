@@ -33,7 +33,8 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
              transient_fraction: float = 0.0,
              n_osds: int | None = None,
              profile: str | None = None,
-             workload_profile: str | None = None) -> dict:
+             workload_profile: str | None = None,
+             disk_full: bool = False) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     if osd_procs:
         store = "tin"            # children need a real on-disk store
@@ -54,6 +55,7 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
                   osd_procs=osd_procs, rotate_secrets=rotate_secrets,
                   overwrite_during_faults=overwrite_during_faults,
                   workload_profile=workload_profile,
+                  disk_full=disk_full,
                   **kwargs)
     try:
         report = th.run()
@@ -107,6 +109,15 @@ def main() -> int:
                          "the workload engine's seeded generator "
                          "(dedicated stream, outside the action "
                          "menu: pinned cells replay unchanged)")
+    ap.add_argument("--disk-full", action="store_true",
+                    help="r21: per-round capacity-exhaustion window "
+                         "(stores shrunk over the failsafe ratio, mon "
+                         "ladder commits FULL, a background writer "
+                         "must park with zero op_errors and drain "
+                         "exactly-once after restore) plus one-shot "
+                         "ENOSPC at a drawn store txn phase each "
+                         "round (dedicated seeded stream; pinned "
+                         "cells replay unchanged)")
     ap.add_argument("--transient-fraction", type=float, default=0.0,
                     help="r17: fraction of a dedicated seeded kill "
                          "stream whose victims AUTO-REVIVE inside/"
@@ -142,7 +153,8 @@ def main() -> int:
                        rotate_secrets=args.rotate_secrets,
                        overwrite_during_faults=args.overwrite_during_faults,
                        transient_fraction=args.transient_fraction,
-                       workload_profile=args.workload_profile)
+                       workload_profile=args.workload_profile,
+                       disk_full=args.disk_full)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1

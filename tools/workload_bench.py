@@ -296,6 +296,41 @@ def main(argv=None) -> None:
             continue
     profile_blk = profile_block(pdumps)
 
+    # r21 capacity block: the committed ladder view (`df`) + the
+    # full-ladder counters, same schema as rados_bench's block
+    # (pinned by tests/test_bench_schema.py) — all-zeros on an
+    # unbounded run, the contract either way
+    try:
+        df = admin.mon_command("df")
+    except Exception:   # noqa: BLE001 — a dying cluster still ships
+        df = {}         # the block, flagged empty
+
+    def _counter_total(key):
+        tot = 0
+        for d in c.osds.values():
+            if d._stop.is_set():
+                continue
+            for counters in _osd_perf(d).values():
+                if isinstance(counters, dict) \
+                        and isinstance(counters.get(key),
+                                       (int, float)):
+                    tot += int(counters[key])
+        return tot
+    fb = admin.perf.dump().get("full_backoff_time") or {}
+    capacity_blk = {
+        "cluster_full": bool(df.get("cluster_full", False)),
+        "full_ratios": df.get("full_ratios") or {},
+        "total_bytes": int(df.get("total_bytes", 0)),
+        "total_used_bytes": int(df.get("total_used_bytes", 0)),
+        "osds": df.get("osds") or {},
+        "pools": df.get("pools") or {},
+        "writes_rejected_full":
+            _counter_total("writes_rejected_full"),
+        "client_full_backoff": {
+            "count": int(fb.get("avgcount", 0)),
+            "total_s": round(float(fb.get("sum", 0.0)), 3)},
+    }
+
     results = engine.results(killed_at=killed["at"])
     noisy_names = [p.name for p in profiles if p.mclock]
     quiet_names = [p.name for p in profiles
@@ -367,6 +402,7 @@ def main(argv=None) -> None:
             "tenant_latency": tagg.tenant_latency(),
         },
         "amplification": amplification,
+        "capacity": capacity_blk,
         "profile_block": profile_blk,
         "recovery_kill": {
             "victim": killed["victim"],
