@@ -94,7 +94,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
                   overwrite_during_faults: bool = False,
                   transient_fraction: float = 0.0,
                   workload_profile: str | None = None,
-                  disk_full: bool = False) -> str:
+                  disk_full: bool = False,
+                  link_degrade: bool = False) -> str:
     """The one-command local reproduction for a failing cell."""
     cmd = (f"python tools/thrash.py --seed {seed} --store {store} "
            f"--rounds {rounds} --ops {ops}")
@@ -112,6 +113,8 @@ def repro_command(seed: int, store: str, rounds: int, ops: int,
         cmd += f" --workload-profile {workload_profile}"
     if disk_full:
         cmd += " --disk-full"
+    if link_degrade:
+        cmd += " --link-degrade"
     return cmd
 
 
@@ -138,7 +141,8 @@ class Thrasher:
                  transient_fraction: float = 0.0,
                  profile: str | None = None,
                  workload_profile: str | None = None,
-                 disk_full: bool = False):
+                 disk_full: bool = False,
+                 link_degrade: bool = False):
         self.seed = int(seed)
         self.store = store
         self.rounds = rounds
@@ -213,6 +217,23 @@ class Thrasher:
         self.enospc_fired = 0
         #: armed one-shot ENOSPC faults: (osd, phase, {"n": shots})
         self._armed_faults: list[tuple[int, str, dict]] = []
+        # r22: the link_degrade fault stream — one directed-link
+        # degrade window per round against the HEALED cluster: a drawn
+        # one-way delay+jitter on exactly one sender->peer edge, and
+        # the netobs plane must (a) flip OSD_SLOW_PING_TIME naming
+        # exactly that link within two grace windows, (b) reprice the
+        # degraded peer worst in the sender's helper-cost feed
+        # (counter-pinned on net_helper_penalties), (c) clear after
+        # heal. Own stream (OUTSIDE the action menu, like rmw_rng):
+        # pinned cells replay unchanged with the flag off. In-process
+        # only (the window reads link trackers and perf counters from
+        # daemon RAM).
+        self.link_degrade = bool(link_degrade)
+        self.link_rng = random.Random(self.seed ^ 0x11CD)
+        self.link_windows = 0
+        self.link_health_flips = 0
+        self.link_health_clears = 0
+        self.link_repriced = 0
         self.trans_rng = random.Random(self.seed ^ 0x7AB5)
         # victim -> (revive deadline, inside_window, quiet_start,
         #            kill schedule idx, repair-bytes snapshot at kill)
@@ -222,7 +243,14 @@ class Thrasher:
         self.transient_noop_checks = 0
         self.transient_noop_skips = 0
         # deadline scaling, NOT schedule input: the RNG stream never
-        # sees it, so a seed replays identically on an idle box
+        # sees it, so a seed replays identically on an idle box.
+        # self.load is the CONSTRUCTION-TIME sample — it pins the
+        # config the daemons run under (op_timeout, hb_grace,
+        # osd_repair_delay) so those stay stable for the whole run.
+        # Wait-site deadlines re-sample via _load() instead (r22
+        # deflake): a full-suite run's load ramps over minutes, and a
+        # deadline scaled by a stale sample taken at construction
+        # under-budgets the waits that actually hit the loaded phase.
         self.load = load_factor()
         # wall seconds of the r17 repair delay the transient cells run
         # under (load-scaled at execution, never an RNG input)
@@ -243,11 +271,19 @@ class Thrasher:
             overwrite_during_faults=self.overwrite_during_faults,
             transient_fraction=self.transient_fraction,
             workload_profile=self.workload_profile,
-            disk_full=self.disk_full)
+            disk_full=self.disk_full,
+            link_degrade=self.link_degrade)
         self.c = None
         self.cl = None
 
     # -- plumbing ------------------------------------------------------------
+
+    def _load(self) -> float:
+        """Fresh load sample for a WAIT-SITE deadline (never for
+        config, never for an RNG stream): at least the construction
+        sample, so a deadline never shrinks mid-run below what the
+        daemons' own load-pinned config was budgeted for."""
+        return max(self.load, load_factor())
 
     def _log(self, msg: str) -> None:
         self.schedule.append(msg)
@@ -297,21 +333,25 @@ class Thrasher:
             # read as daemon death (the [41-tin] full-suite flake)
             hb_grace=1.2 * self.load, **kwargs)
         self.m = self.c.pool_size - self.c.pool_min_size
-        self.c.wait_for_clean(timeout=40 * self.load)
+        self.c.wait_for_clean(timeout=40 * self._load())
         self.cl = self.c.client()
         # injection + scheduled scrub live from the start
         self._set_injection()
         try:
             self.cl.config_set("osd_scrub_interval", 3.0,
-                                timeout=20 * self.load)
+                                timeout=20 * self._load())
             self.cl.config_set("osd_scrub_auto_repair", "true",
-                               timeout=20 * self.load)
+                               timeout=20 * self._load())
         except TimeoutError as e:
             self._parked("config_set scrub", e)
         if self.disk_full and self.osd_procs:
             raise ValueError("disk_full needs in-process daemons "
                              "(capacity shrink + fault arming reach "
                              "stores through daemon RAM)")
+        if self.link_degrade and self.osd_procs:
+            raise ValueError("link_degrade needs in-process daemons "
+                             "(delay injection + link trackers live "
+                             "in daemon RAM)")
         if self.transient_fraction > 0:
             if self.osd_procs:
                 raise ValueError("transient_fraction needs in-process "
@@ -320,7 +360,7 @@ class Thrasher:
             try:
                 self.cl.config_set("osd_repair_delay",
                                    self.repair_delay,
-                                   timeout=20 * self.load)
+                                   timeout=20 * self._load())
             except TimeoutError as e:
                 self._parked("config_set osd_repair_delay", e)
         return self
@@ -330,6 +370,7 @@ class Thrasher:
             return
         self.c.inject_socket_failures(0)
         self.c.inject_delays(0, 0.0)
+        self.c.heal_link_degrades()
         self.c.shutdown()
 
     def _set_injection(self) -> None:
@@ -596,7 +637,7 @@ class Thrasher:
         re-check. Waits (load-scaled) for the cancel to land, then
         compares the cluster repair-bytes counter to the at-kill
         snapshot."""
-        deadline = time.monotonic() + 10.0 * self.load
+        deadline = time.monotonic() + 10.0 * self._load()
         while time.monotonic() < deadline:
             parked = any(victim in ent["dead"]
                          for d in self._live_daemons()
@@ -606,7 +647,7 @@ class Thrasher:
                     for d in self._live_daemons()):
                 break
             time.sleep(0.1)
-        time.sleep(0.3 * self.load)      # let an (illegal) rebuild
+        time.sleep(0.3 * self._load())   # let an (illegal) rebuild
         b1 = self._repair_bytes()        # actually show up
         # a spurious down-mark of ANOTHER osd during the window (load
         # + injection stretching heartbeats) can legitimately move
@@ -765,7 +806,7 @@ class Thrasher:
                 # client parks on the pinned epoch — start now so the
                 # hard-stop path gets chaos coverage too
                 t.start()
-            if not self._poll_df(True, 30.0 * self.load):
+            if not self._poll_df(True, 30.0 * self._load()):
                 self._violate(
                     f"round {round_i}: mon ladder never committed "
                     f"cluster FULL ({len(shrunk)} stores over the "
@@ -793,7 +834,8 @@ class Thrasher:
                 self.full_reads_served += 1
             # the writer must be PARKED, not errored: backoff counter
             # growing and no op_errors surfaced
-            deadline = time.monotonic() + 30.0 * self.load
+            full_wait = 30.0 * self._load()
+            deadline = time.monotonic() + full_wait
             parked = False
             while time.monotonic() < deadline:
                 if errors:
@@ -812,19 +854,20 @@ class Thrasher:
                 self._violate(
                     f"round {round_i}: writer neither parked nor "
                     f"errored under cluster FULL within "
-                    f"{30.0 * self.load:.0f}s")
+                    f"{full_wait:.0f}s")
         finally:
             for o in shrunk:
                 self.c.osds[o].store.set_capacity(
                     self.c.store_capacity)
-        if not self._poll_df(False, 30.0 * self.load):
+        if not self._poll_df(False, 30.0 * self._load()):
             self._violate(f"round {round_i}: cluster FULL flag never "
                           f"cleared after capacity restore")
-        t.join(60.0 * self.load)
+        drain_wait = 60.0 * self._load()
+        t.join(drain_wait)
         if t.is_alive():
             self._violate(
                 f"round {round_i}: parked writes failed to drain "
-                f"within {60.0 * self.load:.0f}s of the FULL flag "
+                f"within {drain_wait:.0f}s of the FULL flag "
                 f"clearing")
         if errors:
             self._violate(
@@ -859,7 +902,7 @@ class Thrasher:
         while time.monotonic() < deadline:
             try:
                 df = self.cl.mon_command("df",
-                                         timeout=10.0 * self.load)
+                                         timeout=10.0 * self._load())
             except Exception:   # noqa: BLE001 — mon hunt mid-chaos
                 df = None
             if isinstance(df, dict) \
@@ -867,6 +910,162 @@ class Thrasher:
                 return df
             time.sleep(0.2)
         return {}
+
+    # -- network degrade (r22) ------------------------------------------------
+
+    def _link_degrade_window(self, round_i: int) -> None:
+        """One directed-link degrade window against a CLEAN cluster
+        (post-heal): inject a drawn one-way delay on exactly one
+        sender->peer edge and hold the netobs plane to its contract:
+
+          * OSD_SLOW_PING_TIME flips within two heartbeat grace
+            windows (plus the MgrReport pipe), naming EXACTLY the
+            degraded link and no other;
+          * the sender's helper-cost feed reprices the degraded peer
+            worst among live helpers, pinned on the
+            net_helper_penalties counter (the planner input r14/r11
+            rank by — routing around the link IS this repricing);
+          * after heal the check clears within the same budget.
+
+        Draw values come from link_rng only; deadlines are load-scaled
+        wall clock that never feeds back into any RNG stream."""
+        if not self.link_degrade:
+            return
+        live = sorted(set(self.c.osd_ids()) - self.dead_osds)
+        if len(live) < 3:
+            self._log(f"round {round_i}: link_degrade window skipped "
+                      f"(<3 live osds)")
+            return
+        a = live[self.link_rng.randrange(len(live))]
+        others = [o for o in live if o != a]
+        b = others[self.link_rng.randrange(len(others))]
+        delay_ms = self.link_rng.uniform(250.0, 400.0)
+        jitter_ms = self.link_rng.uniform(0.0, 30.0)
+        thr_ms = 100.0   # 10-50x an in-proc RTT, 1/3 of the delay
+        try:
+            self.cl.config_set("mon_warn_on_slow_ping_time", thr_ms,
+                               timeout=20 * self._load())
+        except TimeoutError as e:
+            self._parked("config_set mon_warn_on_slow_ping_time", e)
+            return
+        d = self.c.osds[a]
+        pen0 = d.perf.get("net_helper_penalties")
+        grace = float(d.config["osd_heartbeat_grace"])
+        report_s = float(d.config["mgr_report_interval"])
+        budget = 2.0 * grace + 2.0 * report_s + 2.0 * self._load()
+        # settle: the kill/revive phase just before this window leaves
+        # REAL slow residue in the matrix (pings to a dead peer are
+        # answered late on its revive), and the exact-link contract
+        # only holds against a quiet baseline — wait for any residue
+        # to decay below the threshold before injecting
+        settle = budget + 4.0 * self._load()
+        deadline = time.monotonic() + settle
+        while self._poll_slow_ping(0.0) is not None:
+            if time.monotonic() >= deadline:
+                self._log(f"round {round_i}: link_degrade window "
+                          f"skipped — pre-existing slow links never "
+                          f"settled in {settle:.1f}s")
+                try:
+                    self.cl.config_set("mon_warn_on_slow_ping_time",
+                                       0.0, timeout=20 * self._load())
+                except TimeoutError as e:
+                    self._parked(
+                        "config_set mon_warn_on_slow_ping_time", e)
+                return
+            time.sleep(0.3)
+        self.c.link_degrade(a, b, delay_ms, jitter_ms, seed=self.seed)
+        self.link_windows += 1
+        self._log(f"round {round_i}: link_degrade window — "
+                  f"osd.{a} -> osd.{b} +{delay_ms:.0f}ms "
+                  f"(jitter {jitter_ms:.0f}ms, threshold {thr_ms:.0f}ms)")
+        want = f"osd.{a} -> osd.{b} (hb)"
+        try:
+            fired = self._poll_slow_ping(budget)
+            if fired is None:
+                self._violate(
+                    f"round {round_i}: OSD_SLOW_PING_TIME never fired "
+                    f"within {budget:.1f}s of degrading "
+                    f"osd.{a} -> osd.{b} by {delay_ms:.0f}ms")
+            if not any(want in ln for ln in fired["detail"]):
+                self._violate(
+                    f"round {round_i}: OSD_SLOW_PING_TIME fired but "
+                    f"named {fired['detail']!r}, not the degraded "
+                    f"link {want!r}")
+            strays = [ln for ln in fired["detail"] if want not in ln]
+            if strays:
+                self._violate(
+                    f"round {round_i}: OSD_SLOW_PING_TIME named "
+                    f"links beyond the degraded one: {strays!r}")
+            self.link_health_flips += 1
+            # the feed must shift helper selection: the sender now
+            # prices b worst among live helpers, and the blend took
+            # the hb-EWMA branch (counter-pinned)
+            from types import SimpleNamespace
+            costs = d._helper_costs(SimpleNamespace(acting=live))
+            ranked = sorted((s for s, o in enumerate(live) if o != a),
+                            key=lambda s: costs[s])
+            if live[ranked[-1]] != b:
+                self._violate(
+                    f"round {round_i}: degraded helper osd.{b} not "
+                    f"priced worst by osd.{a}'s feed "
+                    f"(costs {dict(zip(live, (costs[s] for s in range(len(live)))))!r})")
+            pen1 = d.perf.get("net_helper_penalties")
+            if pen1 <= pen0:
+                self._violate(
+                    f"round {round_i}: net_helper_penalties never "
+                    f"moved ({pen0} -> {pen1}) — the hb-RTT feed did "
+                    f"not join the helper-cost blend")
+            self.link_repriced += 1
+            self._log(f"round {round_i}: link_degrade flip ok — "
+                      f"named {want!r}, osd.{b} priced "
+                      f"{costs[ranked[-1]]}us (next worst "
+                      f"{costs[ranked[-2]]}us)")
+        finally:
+            self.c.heal_link_degrades()
+        # clear: the ewma halves per undelayed ping (alpha 0.5), so a
+        # couple of sweeps bring it under the threshold; budget the
+        # same pipe slack plus a few extra pings
+        clear_budget = budget + 4.0 * self._load()
+        deadline = time.monotonic() + clear_budget
+        cleared = False
+        while time.monotonic() < deadline:
+            if self._poll_slow_ping(0.0) is None:
+                cleared = True
+                break
+            time.sleep(0.3)
+        if not cleared:
+            self._violate(
+                f"round {round_i}: OSD_SLOW_PING_TIME failed to "
+                f"clear within {clear_budget:.1f}s of healing "
+                f"osd.{a} -> osd.{b}")
+        self.link_health_clears += 1
+        try:
+            self.cl.config_set("mon_warn_on_slow_ping_time", 0.0,
+                               timeout=20 * self._load())
+        except TimeoutError as e:
+            self._parked("config_set mon_warn_on_slow_ping_time", e)
+        self._log(f"round {round_i}: link_degrade window ok — "
+                  f"health cleared after heal")
+
+    def _poll_slow_ping(self, budget_s: float) -> dict | None:
+        """Poll `health detail` up to budget_s for OSD_SLOW_PING_TIME;
+        the check dict if present, None if absent at deadline (a
+        budget of 0 means one immediate look)."""
+        deadline = time.monotonic() + budget_s
+        while True:
+            try:
+                h = self.cl.health(detail=True)
+            except Exception:   # noqa: BLE001 — mon hunt mid-chaos
+                h = None
+            if h is not None:
+                fired = next((ck for ck in h.get("checks", [])
+                              if ck["code"] == "OSD_SLOW_PING_TIME"),
+                             None)
+                if fired is not None:
+                    return fired
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.2)
 
     # -- the schedule --------------------------------------------------------
 
@@ -904,6 +1103,9 @@ class Thrasher:
                 # healed (clean) cluster so the only thing parking the
                 # writer is the full ladder itself
                 self._disk_full_window(round_i)
+                # r22: likewise post-heal — the only slow link must be
+                # the injected one, or exact-link naming can't hold
+                self._link_degrade_window(round_i)
             report = self._final_report(time.monotonic() - t0)
         finally:
             self.teardown()
@@ -1071,7 +1273,7 @@ class Thrasher:
         # must settle with injection still live (deadline scaled by
         # the host's load, not loosened: see load_factor)
         try:
-            self.c.wait_for_clean(timeout=90 * self.load)
+            self.c.wait_for_clean(timeout=90 * self._load())
         except TimeoutError as e:
             self._violate(f"round {round_i}: cluster did not "
                           f"converge after heal ({e})")
@@ -1124,6 +1326,10 @@ class Thrasher:
             "full_parked_drained": self.full_parked_drained,
             "enospc_injected": self.enospc_injected,
             "enospc_fired": self.enospc_fired,
+            "link_windows": self.link_windows,
+            "link_health_flips": self.link_health_flips,
+            "link_health_clears": self.link_health_clears,
+            "link_repriced": self.link_repriced,
             "writes_rejected_full":
                 sum(d.perf.get("writes_rejected_full")
                     for d in self._live_daemons())

@@ -27,13 +27,16 @@ def health_checks(osdmap=None, quorum: list[int] | None = None,
                   mon_members: list[int] | None = None,
                   reports=None, stale_grace: float = 15.0,
                   pg_num: int | None = None,
-                  telemetry=None) -> dict:
+                  telemetry=None, netobs=None) -> dict:
     """-> {"status", "checks": [check...]}. Any argument may be None
     (a monitor answering before its first map simply has fewer
     producers). `telemetry` (r18, a TelemetryAggregator) contributes
     SLO_BURN / LATENCY_REGRESSION / TRACE_RING_OVERFLOW from the
     retained metric history — quiet unless SLO rules are declared
-    (mgr_slo_rules) or a flight ring persistently overflows."""
+    (mgr_slo_rules) or a flight ring persistently overflows.
+    `netobs` (r22, a NetworkAggregator) contributes
+    OSD_SLOW_PING_TIME naming the links whose heartbeat RTT ewma
+    crossed the live slow-ping threshold."""
     checks: list[dict] = []
 
     if telemetry is not None:
@@ -41,6 +44,12 @@ def health_checks(osdmap=None, quorum: list[int] | None = None,
             checks.extend(telemetry.health_checks())
         except Exception:   # noqa: BLE001 — a telemetry bug must not
             pass            # take down status/health itself
+
+    if netobs is not None:
+        try:
+            checks.extend(netobs.health_checks())
+        except Exception:   # noqa: BLE001 — same containment rule
+            pass
 
     if osdmap is not None:
         down = [o for o, up in enumerate(osdmap.osd_up) if not up]

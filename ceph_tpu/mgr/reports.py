@@ -75,7 +75,7 @@ class MgrReportAggregator:
             ent["stamp"] = now
             for key in ("ops_in_flight", "slow_ops", "pgs", "epoch",
                         "pool_bytes", "pool_objects", "mclock",
-                        "statfs"):
+                        "statfs", "network"):
                 if key in report:
                     ent[key] = report[key]
 
@@ -141,6 +141,15 @@ class MgrReportAggregator:
             return {n: dict(e["statfs"])
                     for n, e in self._daemons.items()
                     if e.get("statfs")}
+
+    def network(self) -> dict[str, dict]:
+        """Latest links+flow claim per reporting daemon (r22, the
+        NetworkAggregator's raw input — kept here too so a bench or
+        test can replay the fold from the same aggregator state)."""
+        with self._lock:
+            return {n: dict(e["network"])
+                    for n, e in self._daemons.items()
+                    if e.get("network")}
 
     def tenants(self) -> dict:
         """Per-tenant mClock accounting summed over every daemon's

@@ -495,12 +495,19 @@ class _Conn:
                  peer_inst: bytes = b"", comp: int = COMP_NONE,
                  stats: dict | None = None,
                  stats_lock: threading.Lock | None = None,
-                 perf=None):
+                 perf=None, flow: dict | None = None,
+                 flow_lock: threading.Lock | None = None):
         self.sock = sock
         self.wlock = threading.Lock()
         self.alive = True
         self.box = box
         self.perf = perf
+        # per-PEER flow ledger (r22): shared with the Messenger so the
+        # numbers survive reconnects — the ledger is keyed by peer
+        # name, the conn just holds its entry. flow_lock is a leaf
+        # lock (never taken while acquiring another).
+        self.flow = flow
+        self.flow_lock = flow_lock
         # receive-side cumulative-ack cursor: highest peer seq this
         # side has ACKED on this conn (reader + ack flusher both
         # advance it; acks are idempotent so the benign race costs at
@@ -599,6 +606,10 @@ class _Conn:
             self.perf.inc_many((("frames_tx", 1), ("bytes_tx", wire),
                                 ("segments_tx", nseg))
                                + ((("acks_tx", 1),) if is_ack else ()))
+        if self.flow is not None:
+            with self.flow_lock:
+                self.flow["frames_tx"] += 1
+                self.flow["bytes_tx"] += wire
 
     # -- write queue (reactor-bound conns) ------------------------------------
 
@@ -613,10 +624,14 @@ class _Conn:
             t0 = _time_mod.perf_counter()
             while self.alive and self._wq_bytes > _WQ_HIGH // 2:
                 self._wcond.wait(0.2)
+            dt = _time_mod.perf_counter() - t0
             if self.perf is not None:
                 self.perf.inc("writeq_stalls")
-                self.perf.tinc("writeq_stall_time",
-                               _time_mod.perf_counter() - t0)
+                self.perf.tinc("writeq_stall_time", dt)
+            if self.flow is not None:
+                with self.flow_lock:
+                    self.flow["stalls"] += 1
+                    self.flow["stall_time_s"] += dt
             if not self.alive:
                 raise ConnectionError("connection closed")
         for p in parts:
@@ -666,6 +681,10 @@ class _Conn:
             self._wcond.notify_all()
         if self.perf is not None:
             self.perf.set("writeq_depth", self._wq_bytes)
+        if self.flow is not None:
+            with self.flow_lock:
+                self.flow["writeq_bytes"] = self._wq_bytes
+                self.flow["writeq_frames"] = len(self._wq)
 
     def _arm_write_locked(self) -> None:
         if self._write_armed or self.reactor is None:
@@ -793,6 +812,23 @@ class Messenger:
         self._delay_max_ms = 0.0
         self._delay_count = 0
         self._delay_fired = 0
+        # r22 link-degrade injection: a PER-PEER one-way delay (base +
+        # uniform jitter, ms) applied on the sender's dispatch path
+        # before every transmit toward that peer — a directed slow
+        # LINK, where set_inject_delay is a slow PROCESS. Reactor
+        # threads never sleep, so fast-dispatch replies (pongs) pass
+        # undelayed: the delay lands on exactly one direction of one
+        # link, which is what gives the health check its sharp
+        # attribution.
+        self._link_delay: dict[str, tuple[float, float]] = {}
+        self._link_delay_fired = 0
+        # r22 per-peer flow ledger: bytes/frames both ways, write-queue
+        # stalls, live queue depth — same counters the perf logger
+        # aggregates, kept per peer so traffic and RTT share a key.
+        # Entries persist across reconnects (session scope, like
+        # _out_seq); _flow_lock is a leaf lock.
+        self._flow: dict[str, dict] = {}
+        self._flow_lock = threading.Lock()
         # injection decisions come from a PER-MESSENGER RNG, never the
         # global `random`: a thrash run that logs its seed must replay
         # the same delay schedule, and the global stream is perturbed
@@ -991,7 +1027,8 @@ class Messenger:
         self._check_incarnation(peer, peer_inst)   # post-validation
         conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
                      stats=self.stats, stats_lock=self._stats_lock,
-                     perf=self.perf)
+                     perf=self.perf, flow=self._flow_entry(peer),
+                     flow_lock=self._flow_lock)
         # adopt+replay must be one atomic step under the peer lock:
         # published-but-not-yet-replayed is a window where a concurrent
         # send() (which holds only the peer lock) could emit a NEW
@@ -1105,7 +1142,8 @@ class Messenger:
             self.perf.inc("reconnects")
             conn = _Conn(sock, box, peer_inst=peer_inst, comp=comp,
                          stats=self.stats, stats_lock=self._stats_lock,
-                         perf=self.perf)
+                         perf=self.perf, flow=self._flow_entry(peer),
+                         flow_lock=self._flow_lock)
             if not self._adopt(peer, conn, inbound=False):
                 # a crossing dial won (we're the non-designated side):
                 # the WINNING connection carries the session now — put
@@ -1241,9 +1279,19 @@ class Messenger:
                 if self._delay_count % self._delay_every == 0:
                     delay_s = self._inject_rng.uniform(
                         0, self._delay_max_ms) / 1e3
+                    self._delay_fired += 1
+            ld = self._link_delay.get(peer)
+            if ld is not None and not getattr(_TLS, "in_reactor",
+                                              False):
+                # directed link degrade: base + seeded jitter, drawn
+                # under the lock from the SAME injection RNG so a
+                # logged thrash seed replays the jitter schedule
+                base_ms, jitter_ms = ld
+                delay_s += (base_ms + (self._inject_rng.uniform(
+                    0, jitter_ms) if jitter_ms else 0.0)) / 1e3
+                self._link_delay_fired += 1
         if delay_s:
             import time as _time
-            self._delay_fired += 1
             _time.sleep(delay_s)
         if victim is not None and victim.alive:
             self._inject_fired += 1
@@ -1282,6 +1330,65 @@ class Messenger:
         with self._lock:
             self._delay_every = int(every)
             self._delay_max_ms = float(max_ms)
+
+    def set_link_delay(self, peer: str, delay_ms: float,
+                       jitter_ms: float = 0.0) -> None:
+        """Degrade the directed link self→peer: sleep delay_ms plus
+        uniform [0, jitter_ms] before every transmit toward `peer`
+        (sender dispatch path, same seat as set_inject_delay — but
+        per-LINK and every send, not every-Nth process-wide).
+        delay_ms <= 0 heals the link. Reactor threads are exempt
+        (they must never sleep), so fast-dispatch replies cross
+        undelayed — the degrade stays one-way."""
+        if delay_ms < 0 or jitter_ms < 0:
+            delay_ms, jitter_ms = 0.0, 0.0
+        with self._lock:
+            if delay_ms <= 0 and jitter_ms <= 0:
+                self._link_delay.pop(peer, None)
+            else:
+                self._link_delay[peer] = (float(delay_ms),
+                                          float(jitter_ms))
+
+    def clear_link_delays(self) -> None:
+        """Heal every degraded link (thrasher _clear_faults hook)."""
+        with self._lock:
+            self._link_delay.clear()
+
+    def link_delays(self) -> dict:
+        """Active link degrades, {peer: {delay_ms, jitter_ms}}."""
+        with self._lock:
+            return {p: {"delay_ms": d, "jitter_ms": j}
+                    for p, (d, j) in self._link_delay.items()}
+
+    def _flow_entry(self, peer: str) -> dict:
+        """The per-peer flow ledger entry (created zeroed). Shared by
+        every conn toward `peer` across reconnects."""
+        with self._flow_lock:
+            f = self._flow.get(peer)
+            if f is None:
+                f = self._flow[peer] = {
+                    "bytes_tx": 0, "frames_tx": 0,
+                    "bytes_rx": 0, "frames_rx": 0,
+                    "stalls": 0, "stall_time_s": 0.0,
+                    "writeq_bytes": 0, "writeq_frames": 0,
+                }
+            return f
+
+    def flow_dump(self) -> dict:
+        """Snapshot of per-peer flow: counters plus LIVE write-queue
+        depth for peers with an open conn (the ledger's gauge is only
+        as fresh as the last flush; prefer the queue itself)."""
+        with self._flow_lock:
+            out = {p: dict(f) for p, f in self._flow.items()}
+        with self._lock:
+            conns = list(self._conns.items())
+        for p, c in conns:
+            if p in out and c.alive:
+                out[p]["writeq_bytes"] = c._wq_bytes
+                out[p]["writeq_frames"] = len(c._wq)
+        for f in out.values():
+            f["stall_time_s"] = round(f["stall_time_s"], 6)
+        return out
 
     def seed_injection(self, seed: int) -> None:
         """Reset the injection RNG and counters to a deterministic
@@ -1394,6 +1501,7 @@ class Messenger:
                            _time_mod.perf_counter() - t0)
             self.perf.inc_many((("frames_rx", 1),
                                 ("bytes_rx", 8 + blen)))
+            rx_wire = 8 + blen
         else:
             # secure mode: the GCM tag is the integrity check
             # (and the length header is bound in as AAD)
@@ -1403,6 +1511,11 @@ class Messenger:
                            _time_mod.perf_counter() - t0)
             self.perf.inc_many((("frames_rx", 1),
                                 ("bytes_rx", 4 + blen)))
+            rx_wire = 4 + blen
+        if conn.flow is not None:
+            with conn.flow_lock:
+                conn.flow["frames_rx"] += 1
+                conn.flow["bytes_rx"] += rx_wire
         seq, tid = struct.unpack_from("<QH", body)
         # zero-copy view over the payload (Decoder accepts a
         # memoryview; blob fields copy out only what they keep)

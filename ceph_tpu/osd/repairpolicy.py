@@ -166,6 +166,11 @@ POLICY_COUNTERS = (
     "repair_enospc_parked",          # rounds parked: writeback hit
     #                                  ENOSPC mid-rebuild (cursors
     #                                  intact, retried next reconcile)
+    # r22 network plane
+    "slow_link_suspects",            # peers marked DownClock-suspect
+    #                                  on measured slow-link evidence
+    #                                  (hb RTT ewma over the slow-ping
+    #                                  line; one tick per flip)
 )
 
 
@@ -282,6 +287,14 @@ class RepairPolicy:
 
     def note_suspect(self, osd: int) -> None:
         self.clock(osd).mark_suspect()
+
+    def note_slow_link(self, osd: int) -> None:
+        """r22: measured slow-link evidence (heartbeat RTT ewma over
+        the slow-ping line) — same DownClock suspect mark as
+        heartbeat silence, but counted separately so operators can
+        tell a sick WIRE from a silent peer."""
+        self.clock(osd).mark_suspect()
+        self._count("slow_link_suspects")
 
     # -- decisions -----------------------------------------------------------
 

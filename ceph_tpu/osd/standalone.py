@@ -2045,6 +2045,10 @@ class OSDDaemon:
         prev = self._peer_lat.get(osd)
         self._peer_lat[osd] = dt if prev is None \
             else 0.75 * prev + 0.25 * dt
+        # r22: the same sample feeds the link plane's "store" channel
+        # (wire + service time, vs the hb channel's wire + dispatch)
+        if bool(self.config["osd_network_observability"]):
+            self.link_tracker.note(peer, dt, channel="store")
 
     #: client-observed latency claims older than this are ignored (a
     #: one-off slow window must not bias helper picks for hours)
@@ -2093,6 +2097,14 @@ class OSDDaemon:
                 if claim is not None \
                         and now - claim[1] < self._CLIENT_LAT_TTL:
                     lat = max(lat, claim[0])
+                # r22 link-cost feed: the heartbeat-RTT EWMA toward
+                # this helper joins the blend — slowest view wins, so
+                # a degraded WIRE ranks a helper down even while its
+                # store answers the few ops that do arrive quickly
+                hb = self.link_tracker.ewma_s(f"osd.{osd}")
+                if hb > lat:
+                    lat = hb
+                    self.perf.inc("net_helper_penalties")
                 cost = int(lat * 1e6)
             if osd in self.suspect or (
                     _valid_osd(osd, n_osds)
@@ -3168,7 +3180,20 @@ class OSDDaemon:
                           "mutating client ops bounced for capacity "
                           "(failsafe hard-stop or map FULL flag) — "
                           "each bounce parks the client, it never "
-                          "surfaces as an op_error"))
+                          "surfaces as an op_error")
+         # r22 network observability: the DECLARED aggregate over all
+         # peer links (per-link detail is dynamic-keyed, so it rides
+         # the MgrReport "network" side-field, never counter names)
+         .add_time_avg("hb_ping_rtt",
+                       "heartbeat ping round trip, all peer links "
+                       "folded (per-link lhists ride the report's "
+                       "network block into the mon NetworkAggregator)",
+                       hist=True)
+         .add_u64_counter("net_helper_penalties",
+                          "helper-cost slots where the hb-RTT link "
+                          "feed (r22) raised the cost above the "
+                          "store/client view — the planner saw the "
+                          "wire, not just the service time"))
         # r17 repair-policy counters: declared from the policy
         # module's ONE list so the daemon schema and the policy's own
         # counter dict cannot drift (the r9 declared-names rule)
@@ -3197,6 +3222,15 @@ class OSDDaemon:
         from ..utils.profiler import SamplingProfiler
         self.profiler = SamplingProfiler(self.name,
                                          config=self.config).start()
+        # r22 network observability: per-(peer, channel) RTT fold —
+        # in-RAM like the rest of the plane (a revive measures fresh;
+        # _init_observability runs on both paths). Pong fast dispatch
+        # and store RPC completions feed it; the heartbeat ships it.
+        from ..mgr.netobs import LinkTracker
+        self.link_tracker = LinkTracker(perf=self.perf)
+        # peers currently flagged slow-link (hysteresis for the r17
+        # DownClock evidence: flag at threshold, clear at half)
+        self._slow_links: set[int] = set()
         # r18 sub-op retro ring (the r15 replica gap): completed store
         # sub-ops remembered by carried trace id so a primary's slow-op
         # retro assembly can pull this hop's timing after the fact
@@ -3260,7 +3294,7 @@ class OSDDaemon:
                    "dump_ops_in_flight", "slow_ops", "pg stat",
                    "pg clean",
                    "dump_mclock", "dump_op_shards", "dump_scrubs",
-                   "dump_repair",
+                   "dump_repair", "dump_osd_network",
                    "log dump",
                    "config show",
                    "config diff", "trace start", "trace stop",
@@ -3418,6 +3452,20 @@ class OSDDaemon:
             with self._lock:
                 return {"policy": self.repair_policy.dump(),
                         "domains": self.domain_budgets.dump()}
+        if cmd == "dump_osd_network":
+            # the r22 link plane, THIS daemon's slice (ref: the
+            # identically named OSD admin command): its own measured
+            # links + flow ledger + any active injected degrades.
+            # The cluster matrix is the monitors' dump_osd_network.
+            return {
+                "name": self.name,
+                "threshold_ms": round(
+                    self._slow_ping_threshold_s() * 1e3, 3),
+                "links": self.link_tracker.dump(),
+                "flow": self.msgr.flow_dump(),
+                "slow_links": sorted(self._slow_links),
+                "link_delays": self.msgr.link_delays(),
+            }
         if cmd == "status":
             with self._lock:
                 return {
@@ -4202,7 +4250,15 @@ class OSDDaemon:
 
     def _on_pong(self, peer: str, msg: MOSDPingReply) -> None:
         if peer.startswith("osd."):
-            self._last_pong[int(peer[4:])] = time.monotonic()
+            now = time.monotonic()
+            self._last_pong[int(peer[4:])] = now
+            # r22: the reply echoes OUR monotonic send stamp, so the
+            # round trip needs no wire change and no clock agreement
+            # (even cross-process CLOCK_MONOTONIC is one clock here).
+            # Fast dispatch: the fold is a leaf-locked bucket add.
+            if bool(self.config["osd_network_observability"]):
+                self.link_tracker.note(peer, now - msg.stamp,
+                                       channel="hb")
 
     def _maybe_scheduled_scrub(self) -> None:
         """Background scrub scheduling (ref: PG scrub scheduling off
@@ -4324,7 +4380,13 @@ class OSDDaemon:
                     continue
                 self._last_pong.setdefault(osd, now)
                 try:
-                    self.msgr.send(f"osd.{osd}", MOSDPing(now))
+                    # stamp per send, not per sweep: an injected link
+                    # delay sleeps THIS thread before the transmit, so
+                    # a sweep-wide stamp would charge peer k's delay to
+                    # every peer pinged after it (r22 netobs needs the
+                    # RTT attributed to exactly the degraded link)
+                    self.msgr.send(f"osd.{osd}",
+                                   MOSDPing(time.monotonic()))
                 except (KeyError, OSError, ConnectionError):
                     pass
                 stale = now - self._last_pong[osd] \
@@ -4364,9 +4426,30 @@ class OSDDaemon:
                                            MOSDFailure(osd, alive=True))
                         except (KeyError, OSError, ConnectionError):
                             pass
-            # scrub LAST: this beat's pings are already out, so a long
-            # deep scrub cannot push our liveness past peers' grace
-            self._maybe_scheduled_scrub()
+                # r22: a link whose RTT ewma crosses the slow-ping
+                # line is DownClock suspect evidence (r17) — the peer
+                # is alive but its wire is sick, so repair planning
+                # should treat it warily. Hysteresis: flag at the
+                # threshold, clear at half, one policy note per flip.
+                if bool(self.config["osd_network_observability"]):
+                    thr_s = self._slow_ping_threshold_s()
+                    ewma = self.link_tracker.ewma_s(f"osd.{osd}")
+                    if ewma > thr_s:
+                        if osd not in self._slow_links:
+                            self._slow_links.add(osd)
+                            self.repair_policy.note_slow_link(osd)
+                            self.c.log(
+                                f"{self.name}: slow link to osd.{osd}"
+                                f" (rtt ewma {ewma * 1e3:.1f}ms > "
+                                f"{thr_s * 1e3:.1f}ms)")
+                    elif ewma < thr_s / 2 \
+                            and osd in self._slow_links:
+                        self._slow_links.discard(osd)
+                        # heartbeat-silence suspicion is separate
+                        # evidence; only clear when it isn't active
+                        if osd not in self.suspect:
+                            self.repair_policy.clock(
+                                osd).clear_suspect()
             try:
                 # r18: close the current metric-history interval (if
                 # its wall-clock boundary passed) BEFORE reporting so
@@ -4378,6 +4461,24 @@ class OSDDaemon:
             except Exception as e:  # noqa: BLE001 — stats shipping
                 # must never kill the heartbeat thread
                 self.c.log(f"{self.name}: mgr report failed: {e!r}")
+            # scrub LAST — after pings AND the report: this beat's
+            # pings are already out so a long deep scrub cannot push
+            # our liveness past peers' grace, and the report shipped
+            # first so the same scrub cannot starve the MgrReport
+            # pipe either (r22: the mon's slow-link verdict reads our
+            # link claims; a multi-second TinStore deep scrub parked
+            # here used to freeze them mid-degrade)
+            self._maybe_scheduled_scrub()
+
+    def _slow_ping_threshold_s(self) -> float:
+        """The slow-link line in SECONDS, the same resolution the mon
+        NetworkAggregator uses (mon_warn_on_slow_ping_time ms when
+        set, else ratio x grace) — daemon and mon judge one line."""
+        warn_ms = float(self.config["mon_warn_on_slow_ping_time"])
+        if warn_ms > 0:
+            return warn_ms / 1e3
+        return (float(self.config["mon_warn_on_slow_ping_ratio"])
+                * float(self.config["osd_heartbeat_grace"]))
 
     def _maybe_mgr_report(self) -> None:
         """Periodically ship this daemon's counters + op stats + the
@@ -4447,6 +4548,16 @@ class OSDDaemon:
             report["statfs"] = self.store.statfs()
         except Exception:
             pass
+        # r22 network plane: per-link RTT state + per-peer flow ride
+        # every report (side-field like statfs/mclock — per-peer keys
+        # are dynamic, so they must never be counter names). The OFF
+        # arm (osd_network_observability=false) ships nothing, which
+        # is what the overhead-parity bench measures against.
+        if bool(self.config["osd_network_observability"]):
+            report["network"] = {
+                "links": self.link_tracker.dump(),
+                "flow": self.msgr.flow_dump(),
+            }
         self._mgr_last_perf = perf
         # PG states want the daemon lock; never stall the heartbeat
         # for them — a busy beat ships without, and the aggregator
@@ -4666,6 +4777,12 @@ class MonDaemon:
         self.profiles = ProfileAggregator(config=self.conf_view)
         self.profiler = SamplingProfiler(self.name,
                                          config=self.conf_view).start()
+        # r22 network observability: every monitor independently folds
+        # the links+flow claims riding MgrReports into the cluster
+        # link matrix — serves dump_osd_network, raises
+        # OSD_SLOW_PING_TIME, and feeds link_cost to the consumers
+        from ..mgr.netobs import NetworkAggregator
+        self.netobs = NetworkAggregator(config=self.conf_view)
         self._mgr_seq = 0
         self._mgr_last_sent = 0.0
         from ..utils.admin_socket import AdminSocket
@@ -4673,7 +4790,8 @@ class MonDaemon:
         for _cmd in ("status", "health", "health detail", "prometheus",
                      "perf dump", "perf schema", "report dump",
                      "mon_status", "log dump", "autoscale status",
-                     "telemetry", "slo", "top", "profile", "df"):
+                     "telemetry", "slo", "top", "profile", "df",
+                     "dump_osd_network"):
             self.asok.register(_cmd,
                                lambda args, c=_cmd: self._mon_cmd_obj(c))
         # argumented: `trace slow` / `trace list` / `trace <id-hex>`
@@ -5126,6 +5244,11 @@ class MonDaemon:
             if report.get("client_perf"):
                 self.telemetry.ingest_client(report.get("name", "?"),
                                              report["client_perf"])
+            # r22: links+flow claims feed the link matrix (same pipe,
+            # independent consumer)
+            if report.get("network"):
+                self.netobs.ingest(report.get("name", "?"),
+                                   report["network"])
             if report.get("kind") != "trace":
                 self.mgr.ingest(report)
             self.perf.inc("mgr_reports_rx")
@@ -5174,6 +5297,12 @@ class MonDaemon:
                           "stats": self.profiler.stats()}
                 report["profile"] = pblock
                 self.profiles.ingest(self.name, pblock)
+                # r22: the monitor is a flow citizen too — it measures
+                # no heartbeat links (empty links), but its per-peer
+                # msgr ledger belongs in the cluster flow totals
+                nblock = {"links": {}, "flow": self.msgr.flow_dump()}
+                report["network"] = nblock
+                self.netobs.ingest(self.name, nblock)
             except Exception:   # noqa: BLE001 — observability must
                 pass            # not break the monitor's reporting
         self.mgr.ingest(report)
@@ -5205,7 +5334,8 @@ class MonDaemon:
             reports=self.mgr,
             stale_grace=float(g_conf["mgr_stale_report_grace"]),
             pg_num=self.c.pg_num,
-            telemetry=self.telemetry)
+            telemetry=self.telemetry,
+            netobs=self.netobs)
         if not detail:
             for c in res["checks"]:
                 c.pop("detail", None)
@@ -5370,7 +5500,15 @@ class MonDaemon:
         if kind == "df":
             return self._df_obj()
         if kind == "prometheus":
-            return {"text": _reports.prometheus_text(self.mgr)}
+            # r22: the link plane's bounded-cardinality exposition
+            # (worst-N by p99) appends to the counter exposition
+            return {"text": _reports.prometheus_text(self.mgr)
+                    + self.netobs.prometheus_text()}
+        if kind == "dump_osd_network" or kind == "netstat":
+            # r22: the cluster link matrix (ref: the OSD-level
+            # dump_osd_network, served cluster-wide here because the
+            # aggregator already holds every daemon's claim)
+            return self.netobs.dump()
         if kind == "perf dump":
             return {"cluster": self.mgr.cluster_perf(),
                     self.name: {self.perf.name: self.perf.dump(),
@@ -6129,6 +6267,15 @@ class Client:
                      .add_u64_counter("degraded_served",
                                       "ops settled by a degraded "
                                       "shard reply")
+                     .add_u64_counter("link_cost_refreshes",
+                                      "r22 link-cost feed pulls from "
+                                      "the mon link matrix (TTL-"
+                                      "gated, background)")
+                     .add_u64_counter("link_cost_demotions",
+                                      "fallback/hedge candidates "
+                                      "ranked down because the link "
+                                      "feed's cost exceeded the "
+                                      "client's own EWMA view")
                      .add_time_avg("op_lat",
                                    "client-observed frame time "
                                    "(submit -> reply, wire and "
@@ -6158,6 +6305,14 @@ class Client:
         # later reads skip the hedge delay and go straight degraded
         # until a newer map (or a successful reply) clears the entry
         self._tgt_suspect: dict[str, int] = {}
+        # r22 link-cost feed: worst measured cost per OSD (µs) pulled
+        # from the mon link matrix — MEASURED wire health joining the
+        # client's own op-latency inference in the fallback/hedge
+        # ordering. TTL-gated and refreshed on a background thread
+        # (single-flight): the read path only ever consults the cache.
+        self._link_costs: dict[int, int] = {}
+        self._link_costs_at = -1e9
+        self._link_gate = threading.Lock()
         self.msgr.register_handler(MOSDMapMsg.type_id, self._on_map)
         self.msgr.register_handler(MOSDIncMapMsg.type_id,
                                    self._on_inc_map)
@@ -6503,11 +6658,57 @@ class Client:
             except (KeyError, OSError, ConnectionError):
                 pass
 
+    #: link-cost feed cache TTL (seconds): the matrix only changes on
+    #: the report cadence, so pulling faster buys nothing
+    _LINK_COST_TTL = 5.0
+
+    def _maybe_refresh_link_costs(self) -> None:
+        """Kick ONE background pull of the mon link matrix when the
+        cache aged out (r22). Never blocks the caller: a read-path
+        consumer racing a dead monitor must not inherit the mon-hunt
+        timeout — it uses the stale cache and the refresh lands for
+        the next op."""
+        now = time.monotonic()
+        if now - self._link_costs_at < self._LINK_COST_TTL:
+            return
+        if not self._link_gate.acquire(blocking=False):
+            return                  # a pull is already in flight
+
+        def _pull():
+            try:
+                d = self.mon_command("dump_osd_network", timeout=5.0)
+                costs: dict[int, int] = {}
+                for row in d.get("links") or []:
+                    cost = int(float(row.get("ewma_ms", 0.0)) * 1e3)
+                    for end in (row.get("from"), row.get("to")):
+                        if isinstance(end, str) \
+                                and end.startswith("osd."):
+                            try:
+                                o = int(end[4:])
+                            except ValueError:
+                                continue
+                            costs[o] = max(costs.get(o, 0), cost)
+                self._link_costs = costs
+                self.perf.inc("link_cost_refreshes")
+            except Exception:   # noqa: BLE001 — no mon, no feed: the
+                pass            # client's own EWMAs still order reads
+            finally:
+                # stamp AFTER the attempt (success or not): a dead
+                # quorum retries at TTL cadence, not per read
+                self._link_costs_at = time.monotonic()
+                self._link_gate.release()
+
+        threading.Thread(target=_pull, daemon=True).start()
+
     def _read_fallback(self, ps: int, avoid: set[str]) -> str | None:
         """Next-best acting shard for a degraded/hedged read: an
         acting member that is up in OUR map and not in `avoid`,
-        preferring the one with the best recent latency (EWMA per
-        target), then acting order."""
+        preferring the one with the best recent latency — the WORSE of
+        the client's own per-target EWMA and the mon link matrix's
+        measured cost (r22), so a shard behind a degraded wire ranks
+        down even when this client hasn't personally paid it yet —
+        then acting order."""
+        self._maybe_refresh_link_costs()
         acting = self.osdmap.pg_to_up_acting_osds(1, ps)[2]
         n = len(self.osdmap.osd_up)
         cands = []
@@ -6518,9 +6719,20 @@ class Client:
             if name in avoid or self._target_suspected(name):
                 continue
             # unmeasured targets rank after measured ones, in acting
-            # order — "next-best" prefers a shard we know answers fast
-            cands.append((self._lat_ewma.get(name, float("inf")),
-                          rank, name))
+            # order — "next-best" prefers a shard we know answers
+            # fast. The link feed COUNTS as measurement: it is a real
+            # RTT some daemon paid, not a guess.
+            own = self._lat_ewma.get(name)
+            feed = self._link_costs.get(o)
+            if own is None and feed is None:
+                key = float("inf")
+            elif own is None:
+                key = feed / 1e6
+            else:
+                key = own if feed is None else max(own, feed / 1e6)
+                if feed is not None and feed / 1e6 > own:
+                    self.perf.inc("link_cost_demotions")
+            cands.append((key, rank, name))
         if not cands:
             return None
         return min(cands)[2]
@@ -7415,6 +7627,31 @@ class StandaloneCluster:
                 if seed is not None:
                     d.msgr.seed_injection(seed * 131 + o)
                 d.msgr.set_inject_delay(every, max_ms)
+
+    def link_degrade(self, from_osd: int, to_osd: int,
+                     delay_ms: float, jitter_ms: float = 0.0,
+                     seed: int | None = None) -> None:
+        """r22: degrade the DIRECTED link osd.from→osd.to — every
+        non-reactor transmit from_osd makes toward to_osd sleeps
+        delay_ms plus uniform [0, jitter_ms] first (heartbeat pings
+        included; the pong crosses back undelayed). delay_ms <= 0
+        heals this link. `seed` re-seeds the sender's injection RNG
+        so the jitter schedule replays (same derivation as
+        inject_socket_failures)."""
+        d = self.osds[from_osd]
+        if d._stop.is_set():
+            return
+        self.log(f"link_degrade: osd.{from_osd} -> osd.{to_osd} "
+                 f"+{delay_ms}ms jitter {jitter_ms}ms")
+        if seed is not None:
+            d.msgr.seed_injection(seed * 131 + from_osd)
+        d.msgr.set_link_delay(f"osd.{to_osd}", delay_ms, jitter_ms)
+
+    def heal_link_degrades(self) -> None:
+        """Clear every injected link degrade, every endpoint."""
+        self.log("link_degrade: healed")
+        for _, msgr in self._endpoints():
+            msgr.clear_link_delays()
 
     def partition(self, *groups) -> None:
         """Install a network partition (the partition-injection

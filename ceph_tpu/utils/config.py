@@ -117,6 +117,23 @@ OPTIONS: list[Option] = [
            "seconds between peer pings", min=0.1),
     Option("osd_heartbeat_grace", float, 20.0,
            "seconds of silence before reporting a peer down", min=0.1),
+    Option("osd_network_observability", bool, True,
+           "r22: fold heartbeat/store round trips into per-link RTT "
+           "state and ship links+flow in MgrReports (the overhead-"
+           "guard OFF arm flips this; pings themselves are unaffected)"),
+    Option("mon_warn_on_slow_ping_time", float, 0.0,
+           "r22: raise OSD_SLOW_PING_TIME when a link's heartbeat RTT "
+           "ewma exceeds this many MILLISECONDS (0 = derive from "
+           "mon_warn_on_slow_ping_ratio, the reference's fallback)",
+           min=0.0),
+    Option("mon_warn_on_slow_ping_ratio", float, 0.05,
+           "r22: slow-link threshold as a fraction of "
+           "osd_heartbeat_grace when mon_warn_on_slow_ping_time is 0",
+           min=0.0, max=1.0),
+    Option("mgr_netobs_prom_links", int, 8,
+           "r22: worst-N links (by p99) exposed per prometheus "
+           "scrape; the rest are counted in the disclosed "
+           "netobs_links_dropped gauge (cardinality bound)", min=0),
     Option("mon_osd_down_out_interval", float, 600.0,
            "seconds down before auto-out"),
     Option("osd_scrub_auto_repair", bool, False,

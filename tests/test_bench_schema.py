@@ -37,6 +37,18 @@ TELEMETRY_KEYS = {"interval_s", "series", "quantiles", "slo"}
 PROFILE_KEYS = {"daemons", "hz", "samples", "idle_samples",
                 "categories", "category_share", "top_stacks",
                 "sampler_overhead"}
+# r22 network block (rados_bench + recovery_bench emit it): the
+# mon's link matrix roll-up — threshold, bounded worst-first link
+# rows, slow verdicts, and the cluster flow totals
+NETWORK_KEYS = {"enabled", "threshold_ms", "links_total", "links",
+                "slow", "flow_totals", "daemons_reporting"}
+FLOW_TOTAL_KEYS = {"bytes_tx", "frames_tx", "bytes_rx", "frames_rx",
+                   "stalls", "stall_time_s", "writeq_bytes",
+                   "writeq_frames"}
+LINK_ROW_KEYS = {"from", "to", "channel", "ewma_ms", "last_ms",
+                 "min_ms", "max_ms", "count", "p50_ms", "p95_ms",
+                 "p99_ms"}
+
 # r21 capacity block (rados_bench + workload_bench emit it): the
 # mon's df view at run end plus the two capacity-stall counters the
 # acceptance numbers are read from (OSD failsafe rejections, client
@@ -66,6 +78,19 @@ SLO_VERDICT_KEYS = {"name", "logger", "key", "quantile",
                     "samples", "current_ms", "burn_fast",
                     "burn_slow", "breach"}
 OCL_KEYS = {"source", "pool"} | QUANTILE_KEYS
+
+
+def _check_network_block(net):
+    assert NETWORK_KEYS <= set(net)
+    assert isinstance(net["enabled"], bool)
+    assert net["threshold_ms"] >= 0
+    assert isinstance(net["links_total"], int)
+    if net["flow_totals"]:
+        assert FLOW_TOTAL_KEYS <= set(net["flow_totals"])
+    for row in net["links"]:
+        assert LINK_ROW_KEYS <= set(row)
+        assert row["channel"] in {"hb", "store"}
+        assert row["count"] >= 0 and row["ewma_ms"] >= 0
 
 
 def _check_telemetry_block(tel, want_ocl=False):
@@ -168,6 +193,47 @@ def test_bench_r21_artifact_pinned():
         assert row["fired"] == 1, phase
         assert row["fsck_clean"] is True, phase
         assert row["acked_bit_exact_and_accepts_after"] is True, phase
+
+
+def test_bench_r22_artifact_pinned():
+    """The committed r22 network-observability artifact (generated by
+    tools/netobs_bench.py): a one-way delay injected on one directed
+    link of a live cephx+secure cluster flips OSD_SLOW_PING_TIME
+    naming EXACTLY that link within two grace windows and clears
+    after the heal; the r14 helper ranking reprices the degraded peer
+    worst (net_helper_penalties pinned) and the mon link_cost feed
+    separates the edges; and the whole plane ON holds wire write
+    throughput at parity with OFF (median of >= 6 interleaved
+    same-binary pairs inside the r15 noise envelope)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "BENCH_r22.json")
+    with open(path) as f:
+        data = json.load(f)
+    assert data["schema"] == "netobs_r22/1"
+    assert data["config"]["cephx"] and data["config"]["secure"]
+    acc = data["acceptance"]
+    assert acc["flip_within_two_grace_windows"] is True
+    assert acc["named_exact_link"] is True
+    assert acc["cleared_after_heal"] is True
+    assert acc["helper_repriced_counter_pinned"] is True
+    assert 0.95 <= acc["overhead_median_pairwise"] <= 1.10
+    ld = data["cells"]["link_degrade"]
+    assert ld["degraded_link"].endswith("(hb)")
+    assert ld["flip_s"] <= ld["flip_budget_s"]
+    assert ld["named_exact_link"] is True and ld["detail"]
+    assert all(ld["degraded_link"] in ln for ln in ld["detail"])
+    assert ld["clear_s"] <= ld["clear_budget_s"]
+    assert ld["slow_link_suspects"] >= 1
+    ha = data["cells"]["helper_avoidance"]
+    assert ha["degraded_priced_worst"] is True
+    assert ha["net_helper_penalties_after"] \
+        > ha["net_helper_penalties_before"]
+    feed = ha["mon_link_cost_us"]
+    assert feed["degraded_us"] > 10 * max(1, feed["healthy_us"])
+    og = data["cells"]["overhead_guard"]
+    assert len(og["pairs"]) >= 6
+    assert all(p["on"] > 0 and p["off"] > 0 for p in og["pairs"])
+    assert 0.95 <= og["median_pairwise_on_over_off"] <= 1.10
 
 
 def test_bench_r18_artifact_pinned():
@@ -379,6 +445,15 @@ def test_rados_bench_json_schema(capsys):
     assert len(out["capacity"]["osds"]) == 4
     assert out["capacity"]["writes_rejected_full"] == 0
     assert out["capacity"]["client_full_backoff"]["count"] == 0
+    # r22: the network block — the mon's link matrix + cluster flow
+    # roll-up off the MgrReport side-field; even this short window
+    # gets at least one report cycle (the bench holds the cluster
+    # open past min-ops), so the flow totals are never vacuous
+    _check_network_block(out["network"])
+    assert out["network"]["enabled"] is True
+    assert out["network"]["daemons_reporting"] >= 1
+    assert out["network"]["flow_totals"]["bytes_tx"] > 0
+    assert out["config"]["netobs_off"] is False
 
 
 def test_bench_r13_artifact_pinned():

@@ -1,0 +1,395 @@
+"""Network observability plane (r22), unit + live.
+
+Unit half: the LinkTracker fold is BIT-EXACT against a hand-built
+lhist (and merge_link_dumps against lhist_merge), the aggregator's
+threshold/staleness/slow-link semantics are pinned with a fake
+clock, and the prometheus exposition holds its cardinality bound
+with real cumulative histogram series.
+
+Live half: one cephx + secure-frames boot per module. The link
+matrix fills from real heartbeats, `dump_osd_network` answers over
+the asok AND the wire, and a one-way injected delay walks the full
+lifecycle — OSD_SLOW_PING_TIME flips naming exactly the degraded
+directed link, the r14 helper ranking reprices that peer worst
+(counter-pinned), the mon link_cost feed separates the edges, and
+the check clears after the heal.
+"""
+
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from ceph_tpu.mgr.netobs import (EWMA_ALPHA, MIN_SAMPLES, LinkTracker,
+                                 NetworkAggregator, link_key,
+                                 merge_link_dumps, split_link_key)
+from ceph_tpu.utils.perf_counters import (LHIST_BUCKETS, lhist_bucket,
+                                          lhist_merge)
+
+# -- unit: the tracker fold ---------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_link_key_round_trip():
+    assert link_key("osd.3", "hb") == "osd.3|hb"
+    assert split_link_key("osd.3|hb") == ("osd.3", "hb")
+    assert split_link_key("osd.3|store") == ("osd.3", "store")
+    assert split_link_key("osd.3") == ("osd.3", "hb")
+
+
+def test_tracker_fold_bit_exact():
+    """Every sample lands in exactly the lhist bucket lhist_bucket
+    says, sum/count agree, and the EWMA replays the published
+    recurrence — the fold is arithmetic, not approximation."""
+    clk = FakeClock()
+    tr = LinkTracker(now_fn=clk)
+    rtts = [0.0011, 0.0042, 0.0009, 0.0300, 0.0007, 0.0042]
+    for r in rtts:
+        tr.note("osd.1", r, channel="hb")
+    want = [0] * LHIST_BUCKETS
+    for r in rtts:
+        want[lhist_bucket(r)] += 1
+    ewma = rtts[0]
+    for r in rtts[1:]:
+        ewma = EWMA_ALPHA * r + (1.0 - EWMA_ALPHA) * ewma
+    d = tr.dump()["osd.1|hb"]
+    assert d["hist"]["buckets"] == want
+    assert d["hist"]["count"] == len(rtts)
+    assert d["hist"]["sum"] == pytest.approx(sum(rtts), abs=0)
+    assert d["count"] == len(rtts)
+    assert d["ewma_ms"] == pytest.approx(ewma * 1e3, rel=1e-3)
+    assert d["min_ms"] == pytest.approx(0.7, rel=1e-3)
+    assert d["max_ms"] == pytest.approx(30.0, rel=1e-3)
+    assert d["last_ms"] == pytest.approx(4.2, rel=1e-3)
+
+
+def test_tracker_channels_are_separate_links():
+    tr = LinkTracker(now_fn=FakeClock())
+    tr.note("osd.1", 0.001, channel="hb")
+    tr.note("osd.1", 0.050, channel="store")
+    d = tr.dump()
+    assert set(d) == {"osd.1|hb", "osd.1|store"}
+    # ewma_s answers the worst channel toward the peer (the r14 blend)
+    assert tr.ewma_s("osd.1") == pytest.approx(0.050)
+    assert tr.ewma_s("osd.9") == 0.0
+
+
+def test_tracker_minmax_spans_two_windows():
+    """min/max cover the current + previous window, so a spike stays
+    visible for at least one full window after its own rolls off."""
+    clk = FakeClock()
+    tr = LinkTracker(now_fn=clk, window_s=10.0)
+    tr.note("osd.1", 0.500)            # the spike, window 1
+    clk.t += 11.0
+    tr.note("osd.1", 0.001)            # window 2: spike still in prev
+    d = tr.dump()["osd.1|hb"]
+    assert d["max_ms"] == pytest.approx(500.0, rel=1e-3)
+    clk.t += 11.0
+    tr.note("osd.1", 0.002)            # window 3: spike aged out
+    d = tr.dump()["osd.1|hb"]
+    assert d["max_ms"] == pytest.approx(2.0, rel=1e-3)
+    assert d["min_ms"] == pytest.approx(1.0, rel=1e-3)
+
+
+def test_tracker_drops_negative_samples():
+    tr = LinkTracker(now_fn=FakeClock())
+    tr.note("osd.1", -0.5)
+    assert tr.dump() == {}
+
+
+def test_merge_link_dumps_matches_lhist_merge():
+    """The aggregator-side merge is the r18 lhist merge: bucket-wise
+    integer adds, counts add, min/max fold — replayed by hand."""
+    clk = FakeClock()
+    a, b = LinkTracker(now_fn=clk), LinkTracker(now_fn=clk)
+    for r in (0.001, 0.004, 0.016):
+        a.note("osd.2", r)
+    for r in (0.002, 0.064):
+        b.note("osd.2", r)
+    b.note("osd.3", 0.008)
+    da, db = a.dump(), b.dump()
+    merged = merge_link_dumps(da, db)
+    assert set(merged) == {"osd.2|hb", "osd.3|hb"}
+    m = merged["osd.2|hb"]
+    assert m["hist"] == lhist_merge(da["osd.2|hb"]["hist"],
+                                    db["osd.2|hb"]["hist"])
+    assert m["count"] == 5
+    assert m["min_ms"] == pytest.approx(1.0, rel=1e-3)
+    assert m["max_ms"] == pytest.approx(64.0, rel=1e-3)
+    # newest claim's EWMA wins (EWMAs don't merge)
+    assert m["ewma_ms"] == db["osd.2|hb"]["ewma_ms"]
+
+
+# -- unit: the aggregator -----------------------------------------------------
+
+
+def _claim(rtt_s, n=MIN_SAMPLES, peer="osd.1", channel="hb"):
+    clk = FakeClock()
+    tr = LinkTracker(now_fn=clk)
+    for _ in range(n):
+        tr.note(peer, rtt_s, channel=channel)
+    return {"links": tr.dump(), "flow": {}}
+
+
+def test_aggregator_threshold_resolution():
+    cfg = {"mon_warn_on_slow_ping_time": 0.0,
+           "mon_warn_on_slow_ping_ratio": 0.05,
+           "osd_heartbeat_grace": 20.0}
+    agg = NetworkAggregator(config=cfg)
+    # the reference fallback: ratio x grace
+    assert agg.threshold_ms() == pytest.approx(1000.0)
+    cfg["mon_warn_on_slow_ping_time"] = 75.0   # explicit wins, live
+    assert agg.threshold_ms() == pytest.approx(75.0)
+
+
+def test_aggregator_slow_links_hb_only_and_min_samples():
+    """The OSD_SLOW_PING_TIME verdict reads the hb channel ONLY (a
+    ping-RTT check, like the reference's) and never judges a link
+    below MIN_SAMPLES — one cold outlier must not flip health."""
+    cfg = {"mon_warn_on_slow_ping_time": 50.0}
+    clk = FakeClock()
+    agg = NetworkAggregator(config=cfg, now_fn=clk)
+    agg.ingest("osd.0", _claim(0.200))                      # slow hb
+    agg.ingest("osd.2", _claim(0.200, channel="store"))     # slow store
+    agg.ingest("osd.3", _claim(0.200, n=MIN_SAMPLES - 1))   # too few
+    slow = agg.slow_links()
+    assert [(r["from"], r["to"], r["channel"]) for r in slow] \
+        == [("osd.0", "osd.1", "hb")]
+    assert slow[0]["threshold_ms"] == 50.0
+    checks = agg.health_checks()
+    assert checks[0]["code"] == "OSD_SLOW_PING_TIME"
+    assert "osd.0 -> osd.1 (hb)" in checks[0]["detail"][0]
+    # the healed claim clears the verdict (newest claim wins)
+    agg.ingest("osd.0", _claim(0.001))
+    assert agg.slow_links() == [] and agg.health_checks() == []
+
+
+def test_aggregator_stale_claims_never_judge():
+    """A dead daemon's last claim ages out of every verdict: it can
+    neither pin a slow link nor hide a healed one forever."""
+    cfg = {"mon_warn_on_slow_ping_time": 50.0,
+           "osd_heartbeat_grace": 20.0}
+    clk = FakeClock()
+    agg = NetworkAggregator(config=cfg, now_fn=clk)
+    agg.ingest("osd.0", _claim(0.200))
+    assert agg.slow_links()
+    clk.t += agg.stale_after_s() + 1.0
+    assert agg.slow_links() == []
+    assert agg.links(fresh_only=False)          # still in the matrix
+    assert agg.dump()["daemons_reporting"] == 1
+
+
+def test_aggregator_link_cost_feed():
+    cfg = {"mon_warn_on_slow_ping_time": 50.0}
+    agg = NetworkAggregator(config=cfg, now_fn=FakeClock())
+    agg.ingest("osd.0", _claim(0.120, peer="osd.1"))
+    agg.ingest("osd.0", {"links": {
+        **_claim(0.120, peer="osd.1")["links"],
+        **_claim(0.002, peer="osd.2")["links"]}, "flow": {}})
+    # directed, µs, accepts ids or names, 0 when unmeasured
+    assert agg.link_cost(0, 1) == pytest.approx(120_000, rel=0.05)
+    assert agg.link_cost("osd.0", "osd.2") \
+        == pytest.approx(2_000, rel=0.05)
+    assert agg.link_cost(1, 0) == 0
+    worst = agg.worst_cost_per_osd()
+    assert worst[1] > worst[2] > 0
+    assert worst[0] == worst[1]     # the bad edge touches both ends
+
+
+def test_aggregator_flow_totals():
+    agg = NetworkAggregator(config={}, now_fn=FakeClock())
+    flow = {"osd.1": {"bytes_tx": 100, "frames_tx": 2, "bytes_rx": 50,
+                      "frames_rx": 1, "stalls": 0, "stall_time_s": 0.0,
+                      "writeq_bytes": 0, "writeq_frames": 0}}
+    agg.ingest("osd.0", {"links": {}, "flow": flow})
+    agg.ingest("osd.1", {"links": {}, "flow": flow})
+    tot = agg.flow_totals()
+    assert tot["bytes_tx"] == 200 and tot["frames_rx"] == 2
+
+
+def test_prometheus_bounded_cardinality():
+    """Worst-N by p99 as REAL cumulative histogram series; everything
+    past the cap is DISCLOSED via the dropped gauge."""
+    agg = NetworkAggregator(
+        config={"mgr_netobs_prom_links": 3}, now_fn=FakeClock())
+    links = {}
+    for i in range(1, 9):
+        # 4x spacing: every peer lands in a DIFFERENT lhist bucket,
+        # so the worst-by-p99 order is unambiguous
+        links.update(_claim(0.0005 * (4 ** i),
+                            peer=f"osd.{i}")["links"])
+    agg.ingest("osd.0", {"links": links, "flow": {}})
+    text = agg.prometheus_text()
+    assert "# TYPE ceph_tpu_netobs_link_rtt_seconds histogram" in text
+    series = {ln.split("{")[1].split(",")[1]
+              for ln in text.splitlines()
+              if ln.startswith("ceph_tpu_netobs_link_rtt_seconds_count")}
+    assert len(series) == 3                      # the bound held
+    assert "ceph_tpu_netobs_links_dropped 5" in text
+    # worst by p99 kept: the slowest peers, not the first ones
+    assert 'peer="osd.8"' in text and 'peer="osd.1"' not in text
+    # cumulative buckets end at +Inf with the full count
+    inf = [ln for ln in text.splitlines() if 'le="+Inf"' in ln]
+    assert inf and all(ln.endswith(f" {MIN_SAMPLES}") for ln in inf)
+
+
+# -- live: one cephx + secure boot --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ceph_tpu.osd.standalone import StandaloneCluster
+    c = StandaloneCluster(n_osds=4, pg_num=2, cephx=True,
+                          secret=os.urandom(32), hb_interval=0.25,
+                          hb_grace=2.0)
+    c.wait_for_clean(timeout=40)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    cl = cluster.client()
+    cl.config_set("mgr_report_interval", 0.5)
+    cl.write({f"net-{i}": bytes([i % 251]) * 300 for i in range(6)})
+    return cl
+
+
+def _wait_for(pred, timeout, what):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.2)
+    raise TimeoutError(what)
+
+
+def _slow_check(cl):
+    h = cl.health(detail=True)
+    return next((ck for ck in h["checks"]
+                 if ck["code"] == "OSD_SLOW_PING_TIME"), None)
+
+
+class TestLiveNetObs:
+    def test_matrix_fills_from_heartbeats(self, cluster, client):
+        """Real MOSDPing round trips populate the mon's directed link
+        matrix over the MgrReport side-field."""
+        dump = _wait_for(
+            lambda: (d := client.mon_command("dump_osd_network"))
+            and any(r["channel"] == "hb" and r["count"] >= MIN_SAMPLES
+                    for r in d["links"]) and d,
+            20, "a warm hb link matrix")
+        assert dump["daemons_reporting"] >= 4
+        assert dump["flow_totals"]["bytes_tx"] > 0
+        assert dump["flow_totals"]["frames_tx"] > 0
+        hb = [r for r in dump["links"] if r["channel"] == "hb"]
+        # 4 osds ping each other: directed pairs both ways
+        assert {(r["from"], r["to"]) for r in hb} >= {
+            ("osd.0", "osd.1"), ("osd.1", "osd.0")}
+        for r in hb:
+            assert r["ewma_ms"] >= 0 and r["p99_ms"] >= 0
+
+    def test_dump_over_asok_and_wire(self, cluster, client):
+        """The same dump_osd_network body answers over the daemon
+        admin socket (daemon-local view) and the mon wire command
+        (cluster matrix) on one cephx+secure boot."""
+        from ceph_tpu.utils.admin_socket import admin_command
+        a = admin_command(cluster.asok_path("osd.0"),
+                          "dump_osd_network")
+        assert a["name"] == "osd.0"
+        assert "links" in a and "flow" in a and "slow_links" in a
+        # daemon-local links are keyed peer|channel with full lhists
+        assert any(split_link_key(k)[1] == "hb" for k in a["links"])
+        w = client.mon_command("dump_osd_network")
+        assert {"threshold_ms", "links", "slow", "flow_totals",
+                "links_total", "daemons_reporting"} <= set(w)
+        # the mon command also answers over the mon's own asok
+        m = admin_command(cluster.asok_path("mon.0"),
+                          "dump_osd_network")
+        assert m["links_total"] == len(m["links"]) or \
+            m["links_total"] >= len(m["links"])
+
+    def test_prometheus_exposition_live(self, cluster, client):
+        prom = _wait_for(
+            lambda: (t := client.prometheus_text())
+            and "ceph_tpu_netobs_link_rtt_seconds_bucket" in t and t,
+            20, "netobs series in the prometheus exposition")
+        assert "# TYPE ceph_tpu_netobs_link_rtt_seconds histogram" \
+            in prom
+        assert "ceph_tpu_netobs_links_dropped" in prom
+
+    def test_degrade_lifecycle_flip_reprice_clear(self, cluster,
+                                                  client):
+        """The acceptance walk on one live boot: a one-way injected
+        delay flips OSD_SLOW_PING_TIME naming EXACTLY osd.0 -> osd.2,
+        the helper ranking reprices osd.2 worst with the declared
+        penalty counter moving, the mon feed separates the edges, and
+        the heal clears the check."""
+        client.config_set("mon_warn_on_slow_ping_time", 80.0)
+        d = cluster.osds[0]
+        pen0 = d.perf.get("net_helper_penalties")
+        try:
+            cluster.link_degrade(0, 2, 250.0, 20.0, seed=7)
+            fired = _wait_for(lambda: _slow_check(client), 20,
+                              "OSD_SLOW_PING_TIME")
+            want = "osd.0 -> osd.2 (hb)"
+            assert any(want in ln for ln in fired["detail"]), fired
+            assert not [ln for ln in fired["detail"]
+                        if want not in ln], fired
+            assert d.perf.dump()["slow_link_suspects"] >= 1
+            # the r14 helper ranking reprices the degraded peer worst
+            live = sorted(cluster.osds)
+
+            def repriced():
+                costs = d._helper_costs(SimpleNamespace(acting=live))
+                others = {o: v for o, v in costs.items() if o != 0}
+                return (max(others, key=others.get) == 2
+                        and d.perf.get("net_helper_penalties") > pen0)
+            _wait_for(repriced, 20, "the helper ranking to reprice")
+            # the mon feed separates the degraded edge from a healthy
+            agg = cluster.mons[0].netobs
+            _wait_for(lambda: agg.link_cost(0, 2) >
+                      10 * max(1, agg.link_cost(0, 1)), 20,
+                      "the link_cost feed to separate the edges")
+        finally:
+            cluster.heal_link_degrades()
+        _wait_for(lambda: _slow_check(client) is None, 30,
+                  "OSD_SLOW_PING_TIME clearing after the heal")
+        client.config_set("mon_warn_on_slow_ping_time", 0.0)
+
+    def test_netobs_off_stops_the_fold(self, cluster, client):
+        """The overhead-guard knob: osd_network_observability=false
+        stops the RTT folds (counts freeze) while heartbeats keep
+        flowing; flipping it back resumes."""
+        client.config_set("osd_network_observability", "false")
+        try:
+            d = cluster.osds[1]
+            _wait_for(lambda: not bool(
+                d.config["osd_network_observability"]), 10,
+                "the knob to commit")
+            before = {k: v["count"]
+                      for k, v in d.link_tracker.dump().items()}
+            time.sleep(1.2)             # several hb intervals
+            after = {k: v["count"]
+                     for k, v in d.link_tracker.dump().items()}
+            assert before == after
+        finally:
+            client.config_set("osd_network_observability", "true")
+        _wait_for(lambda: bool(
+            cluster.osds[1].config["osd_network_observability"]), 10,
+            "the knob to commit back")
+        counts0 = sum(v["count"] for v in
+                      cluster.osds[1].link_tracker.dump().values())
+        _wait_for(lambda: sum(
+            v["count"] for v in
+            cluster.osds[1].link_tracker.dump().values()) > counts0,
+            10, "the fold to resume")

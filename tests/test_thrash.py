@@ -231,6 +231,45 @@ def test_thrash_disk_full_matrix(seed, store, rounds, tmp_path):
         assert report["fsck_clean_stores"] > 0, report
 
 
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed,store", [(3, "mem")])
+def test_thrash_link_degrade_smoke(seed, store, tmp_path):
+    """r22 tier-1 cell: the seeded link_degrade fault stream — a
+    one-way delay injected on one directed link must flip
+    OSD_SLOW_PING_TIME naming EXACTLY that link within two grace
+    windows, reprice the r14 helper ranking off the degraded peer
+    (net_helper_penalties pinned), and clear after the heal — on top
+    of the standing integrity invariants."""
+    th = Thrasher(seed, store=store, rounds=1, ops=4,
+                  link_degrade=True)
+    report = th.run()
+    assert report["link_windows"] > 0, report
+    assert report["link_health_flips"] > 0, report
+    assert report["link_repriced"] > 0, report
+    assert report["link_health_clears"] > 0, report
+    assert report["objects_verified"] > 0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,store,rounds", [(5, "mem", 2),
+                                               (11, "tin", 2)])
+def test_thrash_link_degrade_matrix(seed, store, rounds, tmp_path):
+    """Deeper link_degrade cells (`-m chaos`): more rounds (a fresh
+    seeded victim pair each) and the TinStore path, where the
+    degraded link's store sub-ops ride the same injected delay and
+    the exact-link naming contract must still hold."""
+    th = Thrasher(seed, store=store, rounds=rounds, ops=4,
+                  link_degrade=True,
+                  store_dir=str(tmp_path / "osds")
+                  if store == "tin" else None)
+    report = th.run()
+    assert report["link_windows"] > 0, report
+    assert report["link_health_flips"] == report["link_windows"]
+    assert report["link_health_clears"] == report["link_windows"]
+    assert report["link_repriced"] == report["link_windows"]
+
+
 def test_same_seed_same_schedule(tmp_path):
     """Reproducibility contract: two Thrashers with one seed draw the
     IDENTICAL fault schedule (victims, knob values, data sizes) —

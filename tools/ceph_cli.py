@@ -337,6 +337,38 @@ def cmd_live_df(asok_dir: str, args) -> None:
                   f"{p.get('full', False)}")
 
 
+def cmd_live_netstat(asok_dir: str, args) -> None:
+    """`ceph_cli netstat` — the r22 network observability plane from
+    any monitor: the per-link RTT matrix (worst EWMA first), the
+    slow-link verdicts against the live threshold, and cluster flow
+    totals."""
+    n = live_mon_command(asok_dir, "dump_osd_network")
+    if args.json:
+        print(json.dumps(n, sort_keys=True))
+        return
+    print(f"  threshold {n.get('threshold_ms')}ms  "
+          f"{n.get('daemons_reporting')} daemon(s) reporting  "
+          f"{n.get('links_total')} link(s)"
+          + (f"  ({n.get('links_dropped')} dropped from view)"
+             if n.get("links_dropped") else ""))
+    print("  FROM       TO         CHAN   EWMA(ms)   P99(ms)   "
+          "MAX(ms)  COUNT")
+    for r in n.get("links") or []:
+        print(f"  {r['from']:<10} {r['to']:<10} {r['channel']:<6} "
+              f"{r['ewma_ms']:>8.3f} {r.get('p99_ms', 0.0):>9.3f} "
+              f"{r['max_ms']:>9.3f} {r['count']:>6}")
+    for r in n.get("slow") or []:
+        print(f"  SLOW: {r['from']} -> {r['to']} ({r['channel']}): "
+              f"ewma {r['ewma_ms']}ms > {r['threshold_ms']}ms")
+    f = n.get("flow_totals") or {}
+    print(f"  flow: tx {f.get('bytes_tx', 0)} B / "
+          f"{f.get('frames_tx', 0)} frames, rx {f.get('bytes_rx', 0)} "
+          f"B / {f.get('frames_rx', 0)} frames, "
+          f"{f.get('stalls', 0)} stall(s) "
+          f"({f.get('stall_time_s', 0.0)}s), queued "
+          f"{f.get('writeq_bytes', 0)} B")
+
+
 def cmd_live_profile(asok_dir: str, args) -> None:
     """`ceph_cli profile` — the continuous critical-path profile:
     per-interval queue/crypto/encode/store/wire self-time shares of
@@ -622,6 +654,10 @@ def main(argv=None) -> None:
     sub.add_parser(
         "telemetry", help="LIVE mode: raw telemetry dump (series + "
                           "merged quantiles + SLO verdicts)")
+    sub.add_parser(
+        "netstat", help="LIVE mode: r22 per-link RTT matrix, "
+                        "slow-link verdicts and cluster flow totals "
+                        "from the monitors' network aggregate")
     sub.add_parser("df")
     sub.add_parser("osd-df")
     pg = sub.add_parser("pg")
@@ -643,7 +679,8 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     if args.cmd in ("daemon", "trace", "top", "slo", "profile",
-                    "flame", "telemetry") and not args.asok_dir:
+                    "flame", "telemetry", "netstat") \
+            and not args.asok_dir:
         raise SystemExit(f"`{args.cmd}` needs --asok-dir (live mode "
                          f"only)")
     if args.asok_dir:
@@ -689,6 +726,8 @@ def main(argv=None) -> None:
             cmd_live_profile(args.asok_dir, args)
         elif args.cmd == "flame":
             cmd_live_flame(args.asok_dir, args)
+        elif args.cmd == "netstat":
+            cmd_live_netstat(args.asok_dir, args)
         elif args.cmd == "telemetry":
             print(json.dumps(live_mon_command(args.asok_dir,
                                               "telemetry"),

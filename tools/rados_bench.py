@@ -163,6 +163,13 @@ def main(argv=None) -> None:
                          "histograms off (process-wide) — the "
                          "overhead-guard OFF arm; the JSON then "
                          "carries no telemetry block")
+    ap.add_argument("--netobs-off", action="store_true",
+                    help="standalone: disable the r22 network "
+                         "observability plane for this run — "
+                         "osd_network_observability false (no RTT "
+                         "folds, no flow side-field, no link matrix) "
+                         "— the netobs overhead-guard OFF arm; the "
+                         "JSON `network` block then reads disabled")
     ap.add_argument("--profile-hz", type=float, default=None,
                     help="standalone: daemon_profile_hz committed for "
                          "the run (r19 CPU sampler rate; 0 = off, the "
@@ -203,6 +210,9 @@ def main(argv=None) -> None:
             or args.osd_procs) and args.transport != "standalone":
         raise SystemExit("rados_bench: --op-shards/--msgr-workers/"
                          "--osd-procs need --transport standalone")
+    if args.netobs_off and args.transport != "standalone":
+        raise SystemExit("rados_bench: --netobs-off needs "
+                         "--transport standalone")
     if args.osd_procs and (args.tenants > 1 or args.recovery_kill):
         raise SystemExit("rados_bench: --osd-procs composes with the "
                          "plain write/seq workloads (tenant/recovery-"
@@ -268,6 +278,11 @@ def main(argv=None) -> None:
             if args.profile_hz is not None:
                 wire_client.config_set("daemon_profile_hz",
                                        args.profile_hz)
+        if args.netobs_off:
+            # r22 overhead-guard OFF arm: no RTT folds on any daemon,
+            # no network side-field in the MgrReports
+            wire_client.config_set("osd_network_observability",
+                                   "false")
         if args.hedge_delay_ms is not None:
             # committed centrally: every current AND future client of
             # this cluster resolves it live (the config-observer path)
@@ -902,6 +917,30 @@ def main(argv=None) -> None:
                 except Exception:  # noqa: BLE001 — a dying daemon
                     continue       # drops out of the block
             out["profile"] = profile_block(pdumps)
+        # r22 network block: the monitors' link matrix (per-link RTT
+        # EWMAs/quantiles off the shipped lhists), slow-link verdicts
+        # against the live threshold, and cluster flow totals. All
+        # REAL aggregates from the MgrReport pipe — a short window can
+        # legitimately show a sparse matrix (the claims ride the
+        # report cadence); with --netobs-off the block says disabled
+        # and the matrix is empty by construction. Schema pinned by
+        # tests/test_bench_schema.py.
+        out["config"]["netobs_off"] = args.netobs_off
+        try:
+            net = wire_client.mon_command("dump_osd_network")
+        except Exception:   # noqa: BLE001 — a dying cluster still
+            net = {}        # ships the block, flagged empty
+        out["network"] = {
+            "enabled": not args.netobs_off,
+            "threshold_ms": float(net.get("threshold_ms", 0.0)),
+            "links_total": int(net.get("links_total", 0)),
+            "links": [
+                {k: v for k, v in row.items()}
+                for row in (net.get("links") or [])[:16]],
+            "slow": net.get("slow") or [],
+            "flow_totals": net.get("flow_totals") or {},
+            "daemons_reporting": int(net.get("daemons_reporting", 0)),
+        }
     if args.recovery_kill:
         # latency split around the kill + the schedulers' class grants:
         # the QoS claim ("client p95 bounded during recovery", seq:

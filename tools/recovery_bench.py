@@ -310,6 +310,21 @@ def main(argv=None) -> None:
         from ceph_tpu.utils.profiler import profile_block
         prof.stop()
         stats["profile"] = profile_block([prof.dump()])
+    # r22 network block — truthfully empty: this bench is hermetic
+    # (no messenger, no heartbeats, no MgrReport pipe), so there is
+    # no link matrix to claim. The schema is the contract either way
+    # (pinned by tests/test_bench_schema.py); the wire-tier numbers
+    # live in rados_bench's block and BENCH_r22.json.
+    stats["network"] = {
+        "enabled": False,
+        "threshold_ms": 0.0,
+        "links_total": 0,
+        "links": [],
+        "slow": [],
+        "flow_totals": {},
+        "daemons_reporting": 0,
+        "note": "hermetic run: no wire tier, no link matrix",
+    }
     if args.json:
         print(json.dumps(stats))
     else:

@@ -34,7 +34,8 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
              n_osds: int | None = None,
              profile: str | None = None,
              workload_profile: str | None = None,
-             disk_full: bool = False) -> dict:
+             disk_full: bool = False,
+             link_degrade: bool = False) -> dict:
     from ceph_tpu.chaos import InvariantViolation, Thrasher
     if osd_procs:
         store = "tin"            # children need a real on-disk store
@@ -56,6 +57,7 @@ def run_cell(seed: int, store: str, rounds: int, ops: int,
                   overwrite_during_faults=overwrite_during_faults,
                   workload_profile=workload_profile,
                   disk_full=disk_full,
+                  link_degrade=link_degrade,
                   **kwargs)
     try:
         report = th.run()
@@ -118,6 +120,16 @@ def main() -> int:
                          "ENOSPC at a drawn store txn phase each "
                          "round (dedicated seeded stream; pinned "
                          "cells replay unchanged)")
+    ap.add_argument("--link-degrade", action="store_true",
+                    help="r22: per-round directed-link degrade window "
+                         "against the healed cluster — a drawn one-way "
+                         "delay on one sender->peer edge; "
+                         "OSD_SLOW_PING_TIME must flip naming exactly "
+                         "that link within two grace windows, the "
+                         "sender's helper-cost feed must reprice the "
+                         "peer worst (counter-pinned), and the check "
+                         "must clear after heal (dedicated seeded "
+                         "stream; pinned cells replay unchanged)")
     ap.add_argument("--transient-fraction", type=float, default=0.0,
                     help="r17: fraction of a dedicated seeded kill "
                          "stream whose victims AUTO-REVIVE inside/"
@@ -154,7 +166,8 @@ def main() -> int:
                        overwrite_during_faults=args.overwrite_during_faults,
                        transient_fraction=args.transient_fraction,
                        workload_profile=args.workload_profile,
-                       disk_full=args.disk_full)
+                       disk_full=args.disk_full,
+                       link_degrade=args.link_degrade)
         print(json.dumps(rep, sort_keys=True))
         if not rep["ok"]:
             failed += 1
